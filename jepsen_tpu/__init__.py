@@ -1,0 +1,32 @@
+"""jepsen_tpu — a TPU-native distributed-systems correctness-testing framework.
+
+Capabilities mirror the reference framework (fluree/jepsen; see SURVEY.md): a
+host plane orchestrates a database cluster (SSH control, OS/DB lifecycle,
+clients, nemesis fault injection) while a pure-functional generator schedules
+concurrent operations into an append-only *history*; a device plane then
+verifies the history against consistency models with JAX/XLA kernels —
+linearizability as a vmapped breadth-first frontier search (the Knossos
+capability; reference consumed it at jepsen/src/jepsen/checker.clj:182-213)
+and transactional anomaly cycles as tensorized reachability (the Elle
+capability; jepsen/src/jepsen/tests/cycle.clj).
+
+Layout (bottom-up, mirroring SURVEY.md §1's layer map):
+
+- ``jepsen_tpu.history``   op/history data model (+ EDN interop in ``edn``)
+- ``jepsen_tpu.models``    consistency models (host semantics + device encodings)
+- ``jepsen_tpu.ops``       device kernels: history tensorization, WGL frontier
+                           search, cycle detection
+- ``jepsen_tpu.parallel``  mesh/sharding layer: vmapped batch replay, sharded
+                           frontiers, ICI collectives
+- ``jepsen_tpu.checker``   Checker protocol + invariant checkers + plots
+- ``jepsen_tpu.generator`` scheduling DSL + deterministic simulator + interpreter
+- ``jepsen_tpu.control``   remote execution (SSH/docker/dummy)
+- ``jepsen_tpu.core``      test lifecycle (run/analyze)
+- ``jepsen_tpu.store``     persistence, reference-compatible history.edn
+- ``jepsen_tpu.cli``       command line runner
+
+Nothing here imports jax at package-import time; device code lives behind
+``jepsen_tpu.ops`` / ``jepsen_tpu.parallel`` so host-only uses stay light.
+"""
+
+__version__ = "0.1.0"

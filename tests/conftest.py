@@ -1,0 +1,16 @@
+"""Test harness config.
+
+Tests run on CPU with 8 virtual devices so the multi-chip sharding paths
+(jepsen_tpu.parallel) execute without TPU hardware; the driver's bench runs
+on the real chip separately. Must run before any jax import.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,102 @@
+from jepsen_tpu import edn
+from jepsen_tpu.edn import K, Keyword, Symbol, Tagged, EdnList
+
+
+def test_scalars():
+    assert edn.read_string("nil") is None
+    assert edn.read_string("true") is True
+    assert edn.read_string("false") is False
+    assert edn.read_string("42") == 42
+    assert edn.read_string("-7") == -7
+    assert edn.read_string("3.5") == 3.5
+    assert edn.read_string("1e3") == 1000.0
+    assert edn.read_string("12N") == 12
+    assert edn.read_string('"hi\\nthere"') == "hi\nthere"
+    assert edn.read_string("##Inf") == float("inf")
+    assert edn.read_string("##-Inf") == float("-inf")
+
+
+def test_keywords_interned():
+    assert edn.read_string(":foo") is K("foo")
+    assert edn.read_string(":ns/name") is K("ns/name")
+    assert repr(K("foo")) == ":foo"
+
+
+def test_collections():
+    assert edn.read_string("[1 2 3]") == [1, 2, 3]
+    assert edn.read_string("(1 2)") == EdnList((1, 2))
+    assert edn.read_string("{:a 1, :b [2 3]}") == {K("a"): 1, K("b"): [2, 3]}
+    assert edn.read_string("#{1 2 3}") == frozenset({1, 2, 3})
+    # nested op-map like jepsen history lines
+    op = edn.read_string(
+        "{:type :invoke, :f :cas, :value [0 3], :process 2, :time 12345, :index 7}"
+    )
+    assert op[K("type")] is K("invoke")
+    assert op[K("value")] == [0, 3]
+    assert op[K("process")] == 2
+
+
+def test_comments_and_discard():
+    assert edn.read_string("; hello\n[1 #_2 3]") == [1, 3]
+
+
+def test_tagged():
+    v = edn.read_string('#inst "2020-01-01T00:00:00Z"')
+    assert v == Tagged("inst", "2020-01-01T00:00:00Z")
+
+
+def test_symbols():
+    assert edn.read_string("foo/bar") == Symbol("foo/bar")
+
+
+def test_read_all():
+    forms = list(edn.read_all("{:a 1}\n{:b 2}\n"))
+    assert forms == [{K("a"): 1}, {K("b"): 2}]
+
+
+def test_roundtrip():
+    cases = [
+        None, True, False, 42, -1.5, "a\"b",
+        [1, [2, {K("x"): None}]],
+        {K("type"): K("ok"), K("value"): [0, 3]},
+        frozenset({1, 2}),
+        Tagged("uuid", "abc"),
+        EdnList((1, 2)),
+        float("inf"),
+    ]
+    for c in cases:
+        assert edn.read_string(edn.write_string(c)) == c
+
+
+def test_elle_style_txn_values():
+    # txn micro-op lists as in cycle/append tests: [[:r 3 nil] [:append 3 2]]
+    v = edn.read_string("[[:r 3 nil] [:append 3 2]]")
+    assert v == [[K("r"), 3, None], [K("append"), 3, 2]]
+
+
+def test_stray_close_delim_raises():
+    import pytest
+    with pytest.raises(ValueError):
+        edn.read_string("[1)")
+    with pytest.raises(ValueError):
+        list(edn.read_all("{:a 1}\n]\n{:b 2}"))
+
+
+def test_nested_list_in_set_and_map_key():
+    v = edn.read_string("#{(1 [2])}")
+    assert EdnList((1, (2,))) in v
+    m = edn.read_string("{(1 [2]) 5}")
+    assert list(m.values()) == [5]
+
+
+def test_delimiter_char_literals_roundtrip():
+    from jepsen_tpu.edn import Char
+    for c in '()[]{}";,\\':
+        ch = Char(c)
+        assert edn.read_string(edn.write_string(ch)) == ch
+
+
+def test_trailing_content_raises():
+    import pytest
+    with pytest.raises(ValueError):
+        edn.read_string("1 2")

@@ -1,0 +1,106 @@
+import math
+
+from jepsen_tpu.history import History, Interval, Op, invoke_op, NEMESIS
+from jepsen_tpu.edn import K
+
+
+def mk(typ, proc, f, value=None, time=-1):
+    return Op(typ, proc, f, value, time=time)
+
+
+def test_edn_roundtrip():
+    h = History(
+        [
+            mk("invoke", 0, "read", None, 10),
+            mk("invoke", 1, "write", 3, 11),
+            mk("ok", 1, "write", 3, 20),
+            mk("ok", 0, "read", 3, 25),
+            Op("info", NEMESIS, "start", None, time=30),
+        ]
+    )
+    s = h.to_edn_string()
+    h2 = History.from_edn_string(s)
+    assert h2 == h
+    assert h2[4].process == NEMESIS
+
+
+def test_reads_reference_style_lines():
+    s = (
+        "{:type :invoke, :f :read, :value nil, :process 0, :time 3291485317, :index 0}\n"
+        "{:type :ok, :f :read, :value 3, :process 0, :time 3291595317, :index 1}\n"
+    )
+    h = History.from_edn_string(s)
+    assert len(h) == 2
+    assert h[0].is_invoke and h[1].is_ok
+    assert h[1].value == 3
+    assert h[0].time == 3291485317
+
+
+def test_pairs():
+    h = History(
+        [
+            mk("invoke", 0, "read", None, 0),
+            mk("invoke", 1, "write", 5, 1),
+            mk("ok", 0, "read", None, 2),
+            mk("fail", 1, "write", 5, 3),
+            mk("invoke", 2, "cas", (0, 1), 4),
+        ]
+    )
+    ps = h.pairs()
+    assert len(ps) == 3
+    assert ps[0].type == "ok" and ps[0].f == "read"
+    assert ps[1].type == "fail"
+    assert ps[2].type == "info" and ps[2].completion is None
+    assert ps[2].ret_time == math.inf
+
+
+def test_complete_adds_info():
+    h = History([mk("invoke", 0, "write", 1, 0)])
+    hc = h.complete()
+    assert len(hc) == 2
+    assert hc[1].is_info and hc[1].process == 0
+
+
+def test_indexing():
+    h = History([mk("invoke", 0, "read"), mk("ok", 0, "read")])
+    assert [op.index for op in h] == [0, 1]
+
+
+def test_crashed_process_reassignment_pairing():
+    # process 0 crashes (info), thread continues as process 2 (conc=2)
+    h = History(
+        [
+            mk("invoke", 0, "write", 1, 0),
+            mk("info", 0, "write", 1, 1),
+            mk("invoke", 2, "write", 2, 2),
+            mk("ok", 2, "write", 2, 3),
+        ]
+    )
+    ps = h.pairs()
+    assert ps[0].type == "info"
+    assert ps[0].ret_time == math.inf
+    assert ps[1].type == "ok"
+
+
+def test_extra_fields_roundtrip():
+    op = Op("info", NEMESIS, "clock-offsets", None, time=5, extra=(("node", "n1"),))
+    m = op.to_edn()
+    assert m[K("node")] == "n1"
+    op2 = Op.from_edn(m)
+    assert op2.get("node") == "n1"
+
+
+def test_string_f_preserved_on_roundtrip():
+    s = '{:type :ok, :f "read", :process 0, :value 1, :time 5}\n'
+    h = History.from_edn_string(s)
+    assert h[0].f == "read"
+    out = h.to_edn_string()
+    assert ':f "read"' in out
+
+
+def test_heterogeneous_extra_keys():
+    from jepsen_tpu.edn import read_string
+    m = read_string('{:type :ok, :f :read, :process 0, :value 1, 5 "x", :node "n1"}')
+    op = Op.from_edn(m)
+    assert op.get("node") == "n1"
+    assert op.get(5) == "x"
