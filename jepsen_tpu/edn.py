@@ -113,6 +113,15 @@ class EdnList(tuple):
     """An EDN list ``(...)`` — distinct from a vector, printed with parens."""
 
 
+class FrozenMap(tuple):
+    """An immutable map usable as a dict key / set member: a tuple of sorted
+    (key, value) pairs that prints back as an EDN map, keeping map-keyed
+    maps and sets-of-maps round-trippable."""
+
+    def to_dict(self) -> dict:
+        return {k: v for k, v in self}
+
+
 @dataclass(frozen=True)
 class Tagged:
     tag: str
@@ -338,7 +347,7 @@ def _hashable(x: Any) -> Any:
     if isinstance(x, (list, tuple)):
         return tuple(_hashable(e) for e in x)
     if isinstance(x, dict):
-        return tuple(sorted(((k, _hashable(v)) for k, v in x.items()), key=repr))
+        return FrozenMap(sorted(((k, _hashable(v)) for k, v in x.items()), key=repr))
     if isinstance(x, Tagged):
         return Tagged(x.tag, _hashable(x.value))
     return x
@@ -433,6 +442,15 @@ def _write(x: Any, buf: list[str]) -> None:
                 buf.append(" ")
             _write(e, buf)
         buf.append(")")
+    elif isinstance(x, FrozenMap):
+        buf.append("{")
+        for j, (k, v) in enumerate(x):
+            if j:
+                buf.append(", ")
+            _write(k, buf)
+            buf.append(" ")
+            _write(v, buf)
+        buf.append("}")
     elif isinstance(x, dict):
         buf.append("{")
         for j, (k, v) in enumerate(x.items()):

@@ -90,9 +90,11 @@ class Op:
     def with_(self, **kw: Any) -> "Op":
         return replace(self, **kw)
 
-    def get(self, key: str, default: Any = None) -> Any:
+    def get(self, key: Any, default: Any = None) -> Any:
+        """Look up an extra field; a string key also matches the keyword
+        with that name (extras parsed from EDN keep their Keyword keys)."""
         for k, v in self.extra:
-            if k == key:
+            if k == key or (isinstance(k, Keyword) and k.name == key):
                 return v
         return default
 
@@ -102,17 +104,10 @@ class Op:
         typ = m.get(K("type"))
         f = m.get(K("f"))
         proc = m.get(K("process"))
-        if isinstance(proc, Keyword):
-            proc = proc.name
+        if proc is K(NEMESIS):
+            proc = NEMESIS  # normalised; other keyword processes stay Keywords
         extra = tuple(
-            sorted(
-                (
-                    (k.name if isinstance(k, Keyword) else k, v)
-                    for k, v in m.items()
-                    if k not in _STD_KEYS
-                ),
-                key=repr,
-            )
+            sorted(((k, v) for k, v in m.items() if k not in _STD_KEYS), key=repr)
         )
         return cls(
             type=typ.name if isinstance(typ, Keyword) else typ,
@@ -139,7 +134,7 @@ class Op:
         if self.error is not None:
             m[K("error")] = self.error
         for k, v in self.extra:
-            m[K(k) if isinstance(k, str) else k] = v
+            m[k] = v
         return m
 
     def __repr__(self) -> str:  # compact, jepsen-log-like

@@ -1,0 +1,158 @@
+"""Consistency models.
+
+The reference consumes knossos models (`model/cas-register`, `model/mutex`,
+`model/register`, `model/multi-register`; jepsen/src/jepsen/checker.clj:17-23)
+plus five custom CP-subsystem models in the hazelcast suite
+(hazelcast/src/jepsen/hazelcast.clj:515-649). Each model here carries *two*
+step implementations over one integer encoding:
+
+- ``step_scalar(state, opcode, a1, a2) -> (ok, state')`` — plain Python on
+  tuples of ints; the trusted oracle used by the host checker and the
+  differential tests.
+- ``step_jax(states, opcodes, a1s, a2s) -> (ok, states')`` — the same
+  transition vectorized over a batch of configurations with jax.numpy; this
+  is what the TPU frontier kernel jits. Written so it also works on plain
+  numpy arrays.
+
+States are fixed-width int32 lane tuples so a configuration (linearized-set,
+model-state) packs into a small tensor row. Arbitrary op *values* are
+interned to dense int ids by :class:`ValueTable` at encode time
+(`jepsen_tpu.ops.encode`); models only ever see ints.
+
+``UNKNOWN`` marks an unobserved value (e.g. a read whose completion never
+arrived); models must treat it as "matches anything" where a comparison
+against observed data is involved.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Sequence
+
+# int32-safe sentinel: an interned value id can never equal it.
+UNKNOWN = -(2**31)
+
+
+class ValueTable:
+    """Interns arbitrary hashable op values to dense non-negative int ids."""
+
+    def __init__(self) -> None:
+        self.ids: dict[Any, int] = {}
+        self.values: list[Any] = []
+
+    def intern(self, v: Any) -> int:
+        v = _freeze(v)
+        i = self.ids.get(v)
+        if i is None:
+            i = len(self.values)
+            self.ids[v] = i
+            self.values.append(v)
+        return i
+
+    def lookup(self, i: int) -> Any:
+        if i == UNKNOWN:
+            return None
+        return self.values[i]
+
+    def __len__(self) -> int:
+        return len(self.values)
+
+
+def _freeze(v: Any) -> Any:
+    if isinstance(v, list):
+        return tuple(_freeze(e) for e in v)
+    if isinstance(v, dict):
+        return tuple(sorted(((k, _freeze(x)) for k, x in v.items()), key=repr))
+    if isinstance(v, set):
+        return frozenset(_freeze(e) for e in v)
+    return v
+
+
+class EncodeError(Exception):
+    """Raised when an op cannot be expressed in the model's encoding
+    (the checker then falls back to a host-side rich-value model)."""
+
+
+class Model:
+    """Base class. Subclasses define the encoding + transition function.
+
+    Class attributes:
+
+    - ``name``: registry key (mirrors the knossos model fn name).
+    - ``state_width``: number of int32 lanes of model state.
+    - ``n_opcodes``: size of the opcode space.
+    """
+
+    name: str = "model"
+    state_width: int = 1
+    n_opcodes: int = 1
+    device_capable: bool = True  # False => host-only model (no step_jax)
+
+    def init_state(self, table: ValueTable) -> tuple[int, ...]:
+        """Initial model state as int32 lanes; interns any initial values
+        into ``table`` so ops referring to them encode consistently."""
+        raise NotImplementedError
+
+    def encode_op(self, interval, table: ValueTable) -> Optional[tuple[int, int, int]]:
+        """Map a paired op (:class:`jepsen_tpu.history.Interval`) to
+        ``(opcode, a1, a2)`` ints, or ``None`` to drop it as irrelevant to
+        the model (e.g. an indeterminate read — it cannot change state and
+        constrains nothing). ``:fail`` ops are dropped by the encoder before
+        this hook. Raise :class:`EncodeError` for inexpressible ops."""
+        raise NotImplementedError
+
+    def step_scalar(
+        self, state: tuple[int, ...], opcode: int, a1: int, a2: int
+    ) -> tuple[bool, tuple[int, ...]]:
+        raise NotImplementedError
+
+    def step_jax(self, states, opcodes, a1s, a2s):
+        """Vectorized transition. ``states``: int32 [N, state_width];
+        ``opcodes``/``a1s``/``a2s``: int32 [N]. Returns (ok [N] bool,
+        states' [N, state_width]). Must be jax-traceable (no Python
+        branching on data)."""
+        raise NotImplementedError
+
+    # -- description helpers -------------------------------------------------
+    def describe_op(self, opcode: int, a1: int, a2: int, table: ValueTable) -> str:
+        return f"op{opcode}({a1}, {a2})"
+
+    def __repr__(self) -> str:
+        return f"<model {self.name}>"
+
+
+_REGISTRY: dict[str, Callable[..., Model]] = {}
+
+
+def register_model(cls):
+    """Class decorator: adds the model to the by-name registry used by the
+    CLI / EDN-driven checker configuration."""
+    _REGISTRY[cls.name] = cls
+    return cls
+
+
+def model_by_name(name: str, *args: Any, **kw: Any) -> Model:
+    try:
+        cls = _REGISTRY[name]
+    except KeyError:
+        raise KeyError(f"unknown model {name!r}; known: {sorted(_REGISTRY)}") from None
+    return cls(*args, **kw)
+
+
+def known_models() -> Sequence[str]:
+    return sorted(_REGISTRY)
+
+
+# Import concrete models for their registration side effects.
+from . import register as _register_mod  # noqa: E402,F401
+from . import mutex as _mutex_mod  # noqa: E402,F401
+from . import queue as _queue_mod  # noqa: E402,F401
+
+from .register import Register, CasRegister, MultiRegister  # noqa: E402,F401
+from .mutex import (  # noqa: E402,F401
+    Mutex,
+    ReentrantMutex,
+    OwnerAwareMutex,
+    FencedMutex,
+    Semaphore,
+)
+from .queue import FIFOQueue, UnorderedQueue  # noqa: E402,F401

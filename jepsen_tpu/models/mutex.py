@@ -1,0 +1,242 @@
+"""Mutual-exclusion and semaphore models.
+
+Equivalents of knossos ``model/mutex`` (consumed by the reference's
+hazelcast suite, hazelcast/src/jepsen/hazelcast.clj:674-675) and the
+hazelcast suite's custom CP-subsystem models (hazelcast.clj:515-649):
+ReentrantMutex, OwnerAwareMutex, FencedMutex, AcquiredPermitsModel
+(here: :class:`Semaphore`).
+
+Op shapes: ``{:f :acquire}`` / ``{:f :release}``; fenced locks observe the
+fence token as the ok-acquire's value; semaphores carry the permit count as
+the op value (default 1).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from . import EncodeError, Model, UNKNOWN, ValueTable, register_model
+from ..history import OK
+
+ACQUIRE, RELEASE = 0, 1
+
+
+def _count(iv) -> int:
+    v = iv.value_in if iv.value_in is not None else 1
+    if not isinstance(v, int) or isinstance(v, bool) or v <= 0:
+        raise EncodeError(f"permit count must be a positive int, got {v!r}")
+    return v
+
+
+@register_model
+class Mutex(Model):
+    """knossos model/mutex: acquire fails when held, release fails when free."""
+
+    name = "mutex"
+    state_width = 1
+    n_opcodes = 2
+
+    def init_state(self, table: ValueTable) -> tuple[int, ...]:
+        return (0,)
+
+    def encode_op(self, iv, table: ValueTable) -> Optional[tuple[int, int, int]]:
+        if iv.f == "acquire":
+            return (ACQUIRE, 0, 0)
+        if iv.f == "release":
+            return (RELEASE, 0, 0)
+        raise EncodeError(f"mutex: unknown f {iv.f!r}")
+
+    def step_scalar(self, state, opcode, a1, a2):
+        (locked,) = state
+        if opcode == ACQUIRE:
+            return (locked == 0, (1,))
+        return (locked == 1, (0,))
+
+    def step_jax(self, states, opcodes, a1s, a2s):
+        locked = states[..., 0]
+        is_acq = opcodes == ACQUIRE
+        ok = (is_acq & (locked == 0)) | (~is_acq & (locked == 1))
+        locked2 = (is_acq).astype(states.dtype)
+        return ok, locked2[..., None]
+
+    def describe_op(self, opcode, a1, a2, table):
+        return "acquire" if opcode == ACQUIRE else "release"
+
+
+@register_model
+class OwnerAwareMutex(Model):
+    """Mutex whose release is only legal from the process holding it
+    (hazelcast.clj:538-557). State lane = owner-id + 1, 0 when free."""
+
+    name = "owner-aware-mutex"
+    state_width = 1
+    n_opcodes = 2
+
+    def init_state(self, table: ValueTable) -> tuple[int, ...]:
+        return (0,)
+
+    def encode_op(self, iv, table: ValueTable) -> Optional[tuple[int, int, int]]:
+        p = table.intern(("process", iv.process))
+        if iv.f == "acquire":
+            return (ACQUIRE, p, 0)
+        if iv.f == "release":
+            return (RELEASE, p, 0)
+        raise EncodeError(f"owner-aware-mutex: unknown f {iv.f!r}")
+
+    def step_scalar(self, state, opcode, a1, a2):
+        (owner,) = state
+        if opcode == ACQUIRE:
+            return (owner == 0, (a1 + 1,))
+        return (owner == a1 + 1, (0,))
+
+    def step_jax(self, states, opcodes, a1s, a2s):
+        import jax.numpy as jnp
+
+        owner = states[..., 0]
+        is_acq = opcodes == ACQUIRE
+        ok = (is_acq & (owner == 0)) | (~is_acq & (owner == a1s + 1))
+        owner2 = jnp.where(is_acq, a1s + 1, 0)
+        return ok, owner2[..., None]
+
+    def describe_op(self, opcode, a1, a2, table):
+        verb = "acquire" if opcode == ACQUIRE else "release"
+        return f"{verb} by {table.lookup(a1)!r}"
+
+
+@register_model
+class ReentrantMutex(Model):
+    """A lock the same holder may take up to ``max_depth`` times
+    (hazelcast.clj:515-534; hazelcast CP locks allow depth 2)."""
+
+    name = "reentrant-mutex"
+    state_width = 1
+    n_opcodes = 2
+
+    def __init__(self, max_depth: int = 2):
+        self.max_depth = max_depth
+
+    def init_state(self, table: ValueTable) -> tuple[int, ...]:
+        return (0,)
+
+    def encode_op(self, iv, table: ValueTable) -> Optional[tuple[int, int, int]]:
+        if iv.f == "acquire":
+            return (ACQUIRE, 0, 0)
+        if iv.f == "release":
+            return (RELEASE, 0, 0)
+        raise EncodeError(f"reentrant-mutex: unknown f {iv.f!r}")
+
+    def step_scalar(self, state, opcode, a1, a2):
+        (depth,) = state
+        if opcode == ACQUIRE:
+            return (depth < self.max_depth, (depth + 1,))
+        return (depth > 0, (max(depth - 1, 0),))
+
+    def step_jax(self, states, opcodes, a1s, a2s):
+        import jax.numpy as jnp
+
+        depth = states[..., 0]
+        is_acq = opcodes == ACQUIRE
+        ok = (is_acq & (depth < self.max_depth)) | (~is_acq & (depth > 0))
+        depth2 = jnp.where(is_acq, depth + 1, jnp.maximum(depth - 1, 0))
+        return ok, depth2[..., None]
+
+    def describe_op(self, opcode, a1, a2, table):
+        return "acquire" if opcode == ACQUIRE else "release"
+
+
+@register_model
+class FencedMutex(Model):
+    """Owner-aware mutex whose successful acquires observe strictly
+    increasing fence tokens (hazelcast.clj:565-586). State lanes:
+    [owner+1, last-fence]. The fence is the raw int token from the ok
+    acquire's value (UNKNOWN when unobserved)."""
+
+    name = "fenced-mutex"
+    state_width = 2
+    n_opcodes = 2
+
+    def init_state(self, table: ValueTable) -> tuple[int, ...]:
+        return (0, -1)
+
+    def encode_op(self, iv, table: ValueTable) -> Optional[tuple[int, int, int]]:
+        p = table.intern(("process", iv.process))
+        if iv.f == "acquire":
+            fence = iv.value_out if iv.type == OK else None
+            if fence is None:
+                return (ACQUIRE, p, UNKNOWN)
+            if not isinstance(fence, int) or isinstance(fence, bool) or fence < 0:
+                raise EncodeError(f"fence token must be a non-negative int, got {fence!r}")
+            return (ACQUIRE, p, fence)
+        if iv.f == "release":
+            return (RELEASE, p, 0)
+        raise EncodeError(f"fenced-mutex: unknown f {iv.f!r}")
+
+    def step_scalar(self, state, opcode, a1, a2):
+        owner, last = state
+        if opcode == ACQUIRE:
+            ok = owner == 0 and (a2 == UNKNOWN or a2 > last)
+            new_last = last if a2 == UNKNOWN else a2
+            return (ok, (a1 + 1, new_last))
+        return (owner == a1 + 1, (0, last))
+
+    def step_jax(self, states, opcodes, a1s, a2s):
+        import jax.numpy as jnp
+
+        owner, last = states[..., 0], states[..., 1]
+        is_acq = opcodes == ACQUIRE
+        fence_ok = (a2s == UNKNOWN) | (a2s > last)
+        ok = (is_acq & (owner == 0) & fence_ok) | (~is_acq & (owner == a1s + 1))
+        owner2 = jnp.where(is_acq, a1s + 1, 0)
+        last2 = jnp.where(is_acq & (a2s != UNKNOWN), a2s, last)
+        return ok, jnp.stack([owner2, last2], axis=-1)
+
+    def describe_op(self, opcode, a1, a2, table):
+        if opcode == ACQUIRE:
+            fence = "?" if a2 == UNKNOWN else a2
+            return f"acquire (fence {fence}) by {table.lookup(a1)!r}"
+        return f"release by {table.lookup(a1)!r}"
+
+
+@register_model
+class Semaphore(Model):
+    """Counting semaphore with ``capacity`` permits (hazelcast
+    AcquiredPermitsModel, hazelcast.clj:630-649). Op value = permit count."""
+
+    name = "semaphore"
+    state_width = 1
+    n_opcodes = 2
+
+    def __init__(self, capacity: int = 1):
+        if capacity <= 0:
+            raise ValueError("capacity must be positive")
+        self.capacity = capacity
+
+    def init_state(self, table: ValueTable) -> tuple[int, ...]:
+        return (0,)
+
+    def encode_op(self, iv, table: ValueTable) -> Optional[tuple[int, int, int]]:
+        n = _count(iv)
+        if iv.f == "acquire":
+            return (ACQUIRE, n, 0)
+        if iv.f == "release":
+            return (RELEASE, n, 0)
+        raise EncodeError(f"semaphore: unknown f {iv.f!r}")
+
+    def step_scalar(self, state, opcode, a1, a2):
+        (acquired,) = state
+        if opcode == ACQUIRE:
+            return (acquired + a1 <= self.capacity, (acquired + a1,))
+        return (acquired >= a1, (max(acquired - a1, 0),))
+
+    def step_jax(self, states, opcodes, a1s, a2s):
+        import jax.numpy as jnp
+
+        acquired = states[..., 0]
+        is_acq = opcodes == ACQUIRE
+        ok = (is_acq & (acquired + a1s <= self.capacity)) | (~is_acq & (acquired >= a1s))
+        acq2 = jnp.where(is_acq, acquired + a1s, jnp.maximum(acquired - a1s, 0))
+        return ok, acq2[..., None]
+
+    def describe_op(self, opcode, a1, a2, table):
+        verb = "acquire" if opcode == ACQUIRE else "release"
+        return f"{verb} {a1} permit(s)"

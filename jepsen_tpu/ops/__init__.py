@@ -1,0 +1,11 @@
+"""Device plane: history tensorization + verification kernels.
+
+- ``encode``    history -> fixed-width int32 arrays for a given model
+- ``wgl_host``  trusted host-side linearizability oracle (reference
+                semantics of knossos linear/wgl analyses)
+- ``wgl``       the JAX frontier-search kernel (jit/vmap; the north star)
+- ``cycles``    Elle-style transactional anomaly detection as tensorized
+                graph reachability
+
+Import of jax is deferred to the modules that need it.
+"""

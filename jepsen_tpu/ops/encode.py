@@ -1,0 +1,141 @@
+"""History -> tensor encoding for the linearizability kernels.
+
+Turns a :class:`jepsen_tpu.history.History` into fixed-width int32 arrays:
+one row per *operation interval* (invoke..completion pair, timeline.clj:33-53
+pairing), sorted by invocation, with:
+
+- ``inv``/``ret``: the interval's endpoints as history indexes (the history
+  order is the real-time order; knossos's history/index seam, core.clj:229).
+  ``ret`` is ``OPEN`` (int32 max) for indeterminate (:info) ops — they stay
+  open to the end of time (generator/interpreter.clj:142-157 semantics).
+- ``opcode``/``a1``/``a2``: the model's encoding (models/__init__.py).
+- ``skippable``: 1 for :info ops, which may legally never take effect.
+
+Failed ops (:fail — definitely didn't happen) and model-dropped ops (e.g.
+indeterminate reads) are excluded entirely.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..history import FAIL, History, INFO, Interval
+from ..models import Model, ValueTable
+
+OPEN = np.int32(2**31 - 1)  # ret sentinel for never-completing ops
+
+
+@dataclass
+class EncodedHistory:
+    model: Model
+    table: ValueTable
+    init_state: np.ndarray  # [state_width] int32
+    inv: np.ndarray  # [n] int32, strictly increasing
+    ret: np.ndarray  # [n] int32 (OPEN for info)
+    opcode: np.ndarray  # [n] int32
+    a1: np.ndarray  # [n] int32
+    a2: np.ndarray  # [n] int32
+    skippable: np.ndarray  # [n] bool
+    intervals: list  # original Interval per row, for reporting
+
+    @property
+    def n(self) -> int:
+        return len(self.inv)
+
+    def describe(self, i: int) -> str:
+        iv = self.intervals[i]
+        return (
+            f"{self.model.describe_op(int(self.opcode[i]), int(self.a1[i]), int(self.a2[i]), self.table)}"
+            f" [proc {iv.process}, {iv.type}, idx {iv.invoke.index}]"
+        )
+
+    def max_concurrency(self) -> int:
+        """Max number of intervals open at once — bounds the window width the
+        device kernel needs. Open (:info) intervals stay open forever."""
+        events = []
+        for i in range(self.n):
+            events.append((int(self.inv[i]), 1))
+            if self.ret[i] != OPEN:
+                events.append((int(self.ret[i]), -1))
+        events.sort()
+        cur = peak = 0
+        for _, d in events:
+            cur += d
+            peak = max(peak, cur)
+        return peak
+
+
+def _event_keys(pairs: list[Interval]) -> list[tuple[int, int]]:
+    """Derive (inv, ret) int event ranks per interval.
+
+    Prefers history indexes (the reference's real-time order seam); falls
+    back to op times when indexes are unassigned, ranking invocations before
+    completions at equal timestamps (equal times => concurrent, never a
+    false real-time edge). Raises when neither is usable.
+    """
+    if all(
+        iv.invoke.index >= 0 and (iv.completion is None or iv.completion.index >= 0)
+        for iv in pairs
+    ):
+        out = []
+        for iv in pairs:
+            ret = int(OPEN) if iv.type == INFO else iv.completion.index
+            out.append((iv.invoke.index, ret))
+        return out
+    if not all(
+        iv.invoke.time >= 0 and (iv.completion is None or iv.completion.time >= 0)
+        for iv in pairs
+    ):
+        raise ValueError(
+            "history has neither indexes nor times on every op; "
+            "reindex the History before encoding"
+        )
+    events: list[tuple[int, int, int, int]] = []  # (time, kind, pair_idx, which)
+    for i, iv in enumerate(pairs):
+        events.append((iv.invoke.time, 0, i, 0))
+        if iv.type != INFO:
+            events.append((iv.completion.time, 1, i, 1))
+    events.sort(key=lambda e: (e[0], e[1]))
+    ranks: list[list[int]] = [[-1, int(OPEN)] for _ in pairs]
+    for rank, (_, _, i, which) in enumerate(events):
+        ranks[i][which] = rank
+    return [(a, b) for a, b in ranks]
+
+
+def encode_history(model: Model, history: History) -> EncodedHistory:
+    """Encode ``history`` (or a pre-paired list of Intervals) for ``model``."""
+    if isinstance(history, History):
+        pairs = history.pairs()
+    else:
+        pairs = list(history)
+    table = ValueTable()
+    init_state = np.asarray(model.init_state(table), dtype=np.int32)
+
+    keys = _event_keys(pairs)
+    rows = []
+    for iv, (inv_i, ret_i) in zip(pairs, keys):
+        if iv.type == FAIL:
+            continue
+        enc = model.encode_op(iv, table)
+        if enc is None:
+            continue
+        opcode, a1, a2 = enc
+        rows.append((inv_i, ret_i, opcode, a1, a2, iv.type == INFO, iv))
+
+    rows.sort(key=lambda r: r[0])
+    n = len(rows)
+    out = EncodedHistory(
+        model=model,
+        table=table,
+        init_state=init_state,
+        inv=np.fromiter((r[0] for r in rows), dtype=np.int32, count=n),
+        ret=np.fromiter((r[1] for r in rows), dtype=np.int32, count=n),
+        opcode=np.fromiter((r[2] for r in rows), dtype=np.int32, count=n),
+        a1=np.fromiter((r[3] for r in rows), dtype=np.int32, count=n),
+        a2=np.fromiter((r[4] for r in rows), dtype=np.int32, count=n),
+        skippable=np.fromiter((r[5] for r in rows), dtype=bool, count=n),
+        intervals=[r[6] for r in rows],
+    )
+    return out

@@ -1,0 +1,152 @@
+"""Host-side linearizability oracle.
+
+A deliberately simple Wing & Gong / Lowe-style search over
+(linearized-set, model-state) configurations — the same analysis the
+reference gets from knossos ``linear``/``wgl`` (consumed at
+jepsen/src/jepsen/checker.clj:196-207). This implementation optimizes for
+*obvious correctness*, not speed: it is the differential oracle the TPU
+kernel (`jepsen_tpu.ops.wgl`) is validated against, and the fallback for
+host-only models (queues) and histories exceeding device limits.
+
+Semantics:
+
+- A linearization must respect real-time order: op j may be linearized next
+  only if no still-unlinearized op completed before j was invoked, i.e.
+  ``inv[j] < min(ret[i] for unlinearized i != j)``.
+- Indeterminate (:info) ops have ``ret = OPEN`` (open interval) and are
+  *skippable*: they may legally never take effect, so acceptance requires
+  only that every non-skippable op is linearized.
+- Model transitions must succeed (``step_scalar`` ok) for an op to be
+  applied; configurations are deduplicated per BFS level.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from .encode import EncodedHistory, OPEN, encode_history
+from ..history import History
+from ..models import Model
+
+
+def check_encoded(
+    enc: EncodedHistory,
+    max_configs: int = 500_000,
+) -> dict:
+    """Decide linearizability of an encoded history.
+
+    Returns a result map in the reference checker's shape
+    (checker.clj:182-213): ``valid`` True/False/"unknown", plus a witness
+    linearization (history row order) when valid and diagnostic info when
+    not.
+    """
+    n = enc.n
+    inv = enc.inv
+    ret = enc.ret
+    skippable = enc.skippable
+    required = frozenset(i for i in range(n) if not skippable[i])
+    init = tuple(int(x) for x in enc.init_state)
+    model = enc.model
+
+    if n == 0:
+        return {"valid": True, "op_count": 0, "witness": [], "configs_explored": 0}
+
+    ret_order = sorted(range(n), key=lambda i: int(ret[i]))  # for fast min-ret scans
+    start = (frozenset(), init)
+    frontier: set[tuple] = {start}
+    parents: dict[tuple, Optional[tuple]] = {start: None}  # config -> (parent, op)
+    explored = 0
+    frontier_max = 1
+    deepest: tuple[int, list] = (0, [start])
+
+    def accepting(cfg) -> bool:
+        return required <= cfg[0]
+
+    if accepting(start):
+        return {"valid": True, "op_count": n, "witness": [], "configs_explored": 0}
+
+    while frontier:
+        nxt: set[tuple] = set()
+        for cfg in frontier:
+            linearized, state = cfg
+            explored += 1
+            if explored > max_configs:
+                return {
+                    "valid": "unknown",
+                    "op_count": n,
+                    "configs_explored": explored,
+                    "frontier_max": frontier_max,
+                    "info": f"config budget {max_configs} exhausted",
+                }
+            # min completion among unlinearized ops (first unlinearized in
+            # ret order)
+            min_ret = int(OPEN) + 1
+            for i in ret_order:
+                if i not in linearized:
+                    min_ret = int(ret[i])
+                    break
+            for j in range(n):
+                if j in linearized:
+                    continue
+                # j's own ret may be the min; exclude it from the bound
+                if inv[j] >= min_ret and ret[j] != min_ret:
+                    continue
+                ok, state2 = model.step_scalar(state, int(enc.opcode[j]), int(enc.a1[j]), int(enc.a2[j]))
+                if not ok:
+                    continue
+                cfg2 = (linearized | {j}, state2)
+                if cfg2 not in parents:
+                    parents[cfg2] = (cfg, j)
+                    if accepting(cfg2):
+                        return {
+                            "valid": True,
+                            "op_count": n,
+                            "witness": _witness(parents, cfg2),
+                            "configs_explored": explored,
+                            "frontier_max": frontier_max,
+                        }
+                    nxt.add(cfg2)
+        if nxt:
+            depth = len(next(iter(nxt))[0])
+            if depth > deepest[0]:
+                deepest = (depth, list(nxt)[:10])
+        frontier = nxt
+        frontier_max = max(frontier_max, len(frontier))
+
+    # exhausted without accepting: not linearizable
+    stuck_depth, stuck = deepest
+    return {
+        "valid": False,
+        "op_count": n,
+        "configs_explored": explored,
+        "frontier_max": frontier_max,
+        "max_linearized": stuck_depth,
+        "stuck_configs": [
+            {
+                "linearized": sorted(cfg[0]),
+                "state": cfg[1],
+                "pending": [enc.describe(j) for j in range(n) if j not in cfg[0]][:10],
+            }
+            for cfg in stuck[:5]
+        ],
+    }
+
+
+def _witness(parents, cfg) -> list:
+    out = []
+    while True:
+        p = parents[cfg]
+        if p is None:
+            break
+        cfg, j = p[0], p[1]
+        out.append(j)
+    out.reverse()
+    return out
+
+
+def check_history_host(model: Model, history: History, max_configs: int = 500_000) -> dict:
+    """Convenience: encode + check. ``history`` may also be a list of
+    pre-paired Intervals."""
+    enc = encode_history(model, history)
+    res = check_encoded(enc, max_configs=max_configs)
+    return res

@@ -1,0 +1,502 @@
+"""Test support: history builders, golden corpus, random history generators.
+
+Mirrors the reference's test strategy (SURVEY.md §4): hand-written synthetic
+histories fed straight to checkers (checker_test.clj style), plus the
+fourth tier the reference lacks — differential corpora for CPU-oracle vs
+TPU-kernel agreement on valid AND invalid histories.
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass
+from typing import Any, Optional
+
+from ..history import History, Op
+from ..models import Model
+
+
+def build(rows, time_step: int = 10) -> History:
+    """Build a History from compact rows ``(type, process, f, value)``.
+    Times are assigned in row order; indexes too."""
+    ops = []
+    for i, (typ, proc, f, value) in enumerate(rows):
+        ops.append(Op(typ, proc, f, value, time=i * time_step))
+    return History(ops)
+
+
+@dataclass
+class Case:
+    name: str
+    model: Model
+    history: History
+    valid: Any  # True | False
+
+
+def corpus() -> list[Case]:
+    """Hand-written golden histories with known verdicts."""
+    from ..models import CasRegister, FIFOQueue, Mutex, MultiRegister, Register, Semaphore, UnorderedQueue
+
+    cases: list[Case] = []
+
+    # --- registers ---------------------------------------------------------
+    cases.append(
+        Case(
+            "register sequential rw",
+            Register(init=0),
+            build(
+                [
+                    ("invoke", 0, "read", None),
+                    ("ok", 0, "read", 0),
+                    ("invoke", 0, "write", 3),
+                    ("ok", 0, "write", 3),
+                    ("invoke", 1, "read", None),
+                    ("ok", 1, "read", 3),
+                ]
+            ),
+            True,
+        )
+    )
+    cases.append(
+        Case(
+            "register stale read",
+            Register(init=0),
+            build(
+                [
+                    ("invoke", 0, "write", 3),
+                    ("ok", 0, "write", 3),
+                    ("invoke", 1, "read", None),
+                    ("ok", 1, "read", 0),  # observes overwritten initial value
+                ]
+            ),
+            False,
+        )
+    )
+    cases.append(
+        Case(
+            "register concurrent write/read either way",
+            Register(init=0),
+            build(
+                [
+                    ("invoke", 0, "write", 5),
+                    ("invoke", 1, "read", None),
+                    ("ok", 1, "read", 0),  # read linearizes before the write
+                    ("ok", 0, "write", 5),
+                ]
+            ),
+            True,
+        )
+    )
+    cases.append(
+        Case(
+            "cas basic success chain",
+            CasRegister(init=0),
+            build(
+                [
+                    ("invoke", 0, "cas", [0, 1]),
+                    ("ok", 0, "cas", [0, 1]),
+                    ("invoke", 1, "cas", [1, 2]),
+                    ("ok", 1, "cas", [1, 2]),
+                    ("invoke", 0, "read", None),
+                    ("ok", 0, "read", 2),
+                ]
+            ),
+            True,
+        )
+    )
+    cases.append(
+        Case(
+            "cas impossible double swap",
+            CasRegister(init=0),
+            build(
+                [
+                    ("invoke", 0, "cas", [0, 1]),
+                    ("ok", 0, "cas", [0, 1]),
+                    ("invoke", 1, "cas", [0, 2]),  # 0 already gone, not concurrent
+                    ("ok", 1, "cas", [0, 2]),
+                ]
+            ),
+            False,
+        )
+    )
+    cases.append(
+        Case(
+            "cas concurrent either order",
+            CasRegister(init=0),
+            build(
+                [
+                    ("invoke", 0, "cas", [0, 1]),
+                    ("invoke", 1, "read", None),
+                    ("ok", 1, "read", 1),  # must order cas first
+                    ("ok", 0, "cas", [0, 1]),
+                    ("invoke", 1, "read", None),
+                    ("ok", 1, "read", 1),
+                ]
+            ),
+            True,
+        )
+    )
+    # knossos-style crashed-write cases: an :info write may or may not apply
+    cases.append(
+        Case(
+            "info write observed later",
+            Register(init=0),
+            build(
+                [
+                    ("invoke", 0, "write", 7),
+                    ("info", 0, "write", 7),  # indeterminate
+                    ("invoke", 1, "read", None),
+                    ("ok", 1, "read", 7),  # legal: the write did happen
+                ]
+            ),
+            True,
+        )
+    )
+    cases.append(
+        Case(
+            "info write never observed",
+            Register(init=0),
+            build(
+                [
+                    ("invoke", 0, "write", 7),
+                    ("info", 0, "write", 7),
+                    ("invoke", 1, "read", None),
+                    ("ok", 1, "read", 0),  # legal: the write never happened
+                ]
+            ),
+            True,
+        )
+    )
+    cases.append(
+        Case(
+            "info write applies then unapplies (impossible)",
+            Register(init=0),
+            build(
+                [
+                    ("invoke", 0, "write", 7),
+                    ("info", 0, "write", 7),
+                    ("invoke", 1, "read", None),
+                    ("ok", 1, "read", 7),
+                    ("invoke", 1, "read", None),
+                    ("ok", 1, "read", 0),  # cannot revert
+                ]
+            ),
+            False,
+        )
+    )
+    cases.append(
+        Case(
+            "failed write definitely absent",
+            Register(init=0),
+            build(
+                [
+                    ("invoke", 0, "write", 7),
+                    ("fail", 0, "write", 7),
+                    ("invoke", 1, "read", None),
+                    ("ok", 1, "read", 7),  # observes a write that failed
+                ]
+            ),
+            False,
+        )
+    )
+    # real-time ordering violation
+    cases.append(
+        Case(
+            "real-time order violated",
+            Register(init=0),
+            build(
+                [
+                    ("invoke", 0, "write", 1),
+                    ("ok", 0, "write", 1),
+                    ("invoke", 0, "write", 2),
+                    ("ok", 0, "write", 2),
+                    ("invoke", 1, "read", None),
+                    ("ok", 1, "read", 1),  # both writes completed before read
+                ]
+            ),
+            False,
+        )
+    )
+
+    # --- multi-register ----------------------------------------------------
+    cases.append(
+        Case(
+            "multi-register independent keys",
+            MultiRegister({"x": 0, "y": 0}),
+            build(
+                [
+                    ("invoke", 0, "write", {"x": 1}),
+                    ("ok", 0, "write", {"x": 1}),
+                    ("invoke", 1, "read", {"y": None}),
+                    ("ok", 1, "read", {"y": 0}),
+                    ("invoke", 0, "read", {"x": None}),
+                    ("ok", 0, "read", {"x": 1}),
+                ]
+            ),
+            True,
+        )
+    )
+    cases.append(
+        Case(
+            "multi-register stale",
+            MultiRegister({"x": 0}),
+            build(
+                [
+                    ("invoke", 0, "write", {"x": 1}),
+                    ("ok", 0, "write", {"x": 1}),
+                    ("invoke", 1, "read", {"x": None}),
+                    ("ok", 1, "read", {"x": 0}),
+                ]
+            ),
+            False,
+        )
+    )
+
+    # --- mutexes -----------------------------------------------------------
+    cases.append(
+        Case(
+            "mutex clean alternation",
+            Mutex(),
+            build(
+                [
+                    ("invoke", 0, "acquire", None),
+                    ("ok", 0, "acquire", None),
+                    ("invoke", 0, "release", None),
+                    ("ok", 0, "release", None),
+                    ("invoke", 1, "acquire", None),
+                    ("ok", 1, "acquire", None),
+                ]
+            ),
+            True,
+        )
+    )
+    cases.append(
+        Case(
+            "mutex double acquire",
+            Mutex(),
+            build(
+                [
+                    ("invoke", 0, "acquire", None),
+                    ("ok", 0, "acquire", None),
+                    ("invoke", 1, "acquire", None),
+                    ("ok", 1, "acquire", None),  # second grant while held
+                ]
+            ),
+            False,
+        )
+    )
+    cases.append(
+        Case(
+            "mutex concurrent acquires one wins",
+            Mutex(),
+            build(
+                [
+                    ("invoke", 0, "acquire", None),
+                    ("invoke", 1, "acquire", None),
+                    ("ok", 0, "acquire", None),
+                    ("info", 1, "acquire", None),  # other acquire indeterminate
+                ]
+            ),
+            True,
+        )
+    )
+    cases.append(
+        Case(
+            "semaphore overdraw",
+            Semaphore(capacity=2),
+            build(
+                [
+                    ("invoke", 0, "acquire", 1),
+                    ("ok", 0, "acquire", 1),
+                    ("invoke", 1, "acquire", 1),
+                    ("ok", 1, "acquire", 1),
+                    ("invoke", 2, "acquire", 1),
+                    ("ok", 2, "acquire", 1),  # third permit from capacity 2
+                ]
+            ),
+            False,
+        )
+    )
+    cases.append(
+        Case(
+            "semaphore acquire release cycle",
+            Semaphore(capacity=2),
+            build(
+                [
+                    ("invoke", 0, "acquire", 2),
+                    ("ok", 0, "acquire", 2),
+                    ("invoke", 0, "release", 2),
+                    ("ok", 0, "release", 2),
+                    ("invoke", 1, "acquire", 1),
+                    ("ok", 1, "acquire", 1),
+                ]
+            ),
+            True,
+        )
+    )
+
+    # --- queues (host-only models) ----------------------------------------
+    cases.append(
+        Case(
+            "fifo order respected",
+            FIFOQueue(),
+            build(
+                [
+                    ("invoke", 0, "enqueue", "a"),
+                    ("ok", 0, "enqueue", "a"),
+                    ("invoke", 0, "enqueue", "b"),
+                    ("ok", 0, "enqueue", "b"),
+                    ("invoke", 1, "dequeue", None),
+                    ("ok", 1, "dequeue", "a"),
+                ]
+            ),
+            True,
+        )
+    )
+    cases.append(
+        Case(
+            "fifo order violated",
+            FIFOQueue(),
+            build(
+                [
+                    ("invoke", 0, "enqueue", "a"),
+                    ("ok", 0, "enqueue", "a"),
+                    ("invoke", 0, "enqueue", "b"),
+                    ("ok", 0, "enqueue", "b"),
+                    ("invoke", 1, "dequeue", None),
+                    ("ok", 1, "dequeue", "b"),
+                ]
+            ),
+            False,
+        )
+    )
+    cases.append(
+        Case(
+            "unordered queue any order",
+            UnorderedQueue(),
+            build(
+                [
+                    ("invoke", 0, "enqueue", "a"),
+                    ("ok", 0, "enqueue", "a"),
+                    ("invoke", 0, "enqueue", "b"),
+                    ("ok", 0, "enqueue", "b"),
+                    ("invoke", 1, "dequeue", None),
+                    ("ok", 1, "dequeue", "b"),
+                ]
+            ),
+            True,
+        )
+    )
+    cases.append(
+        Case(
+            "dequeue from empty",
+            UnorderedQueue(),
+            build(
+                [
+                    ("invoke", 1, "dequeue", None),
+                    ("ok", 1, "dequeue", "x"),
+                ]
+            ),
+            False,
+        )
+    )
+    return cases
+
+
+# ---------------------------------------------------------------------------
+# Random linearizable-by-construction histories + perturbations
+
+
+def random_register_history(
+    rng: random.Random,
+    n_ops: int = 40,
+    n_procs: int = 4,
+    cas: bool = True,
+    crash_p: float = 0.1,
+    fail_p: float = 0.05,
+    values: int = 5,
+) -> History:
+    """Simulate concurrent processes against an atomic (cas-)register.
+
+    Each op atomically takes effect at a random point inside its interval,
+    so the result is linearizable by construction. ``crash_p`` turns
+    completions into :info (indeterminate, effect applied or not with 50/50
+    odds); ``fail_p`` produces :fail ops whose effect definitely did not
+    apply.
+    """
+    state = 0
+    ops: list[Op] = []
+    t = 0
+    pending: dict[int, Optional[tuple]] = {p: None for p in range(n_procs)}
+    crashes = 0
+
+    def now() -> int:
+        nonlocal t
+        t += rng.randint(1, 5)
+        return t
+
+    emitted = 0
+    while emitted < n_ops or any(v is not None for v in pending.values()):
+        # pick a process to advance
+        p = rng.randrange(n_procs)
+        slot = pending[p]
+        if slot is None:
+            if emitted >= n_ops:
+                continue
+            kinds = ["read", "write"] + (["cas"] if cas else [])
+            f = rng.choice(kinds)
+            if f == "read":
+                value = None
+            elif f == "write":
+                value = rng.randrange(values)
+            else:
+                value = [rng.randrange(values), rng.randrange(values)]
+            ops.append(Op("invoke", p, f, value, time=now()))
+            pending[p] = (f, value, len(ops) - 1)
+            emitted += 1
+        else:
+            f, value, inv_pos = slot
+            pending[p] = None
+            r = rng.random()
+            if r < fail_p:
+                # op definitely did not execute
+                ops.append(Op("fail", p, f, value, time=now()))
+                continue
+            crashed = rng.random() < crash_p
+            applies = not crashed or rng.random() < 0.5
+            out_value = value
+            okflag = True
+            if applies:
+                if f == "read":
+                    out_value = state
+                elif f == "write":
+                    state = value
+                else:
+                    old, new = value
+                    if state == old:
+                        state = new
+                    else:
+                        okflag = False
+            if crashed:
+                ops.append(Op("info", p, f, value, time=now()))
+                crashes += 1
+            elif f == "read":
+                ops.append(Op("ok", p, f, out_value, time=now()))
+            elif okflag:
+                ops.append(Op("ok", p, f, value, time=now()))
+            else:
+                ops.append(Op("fail", p, f, value, time=now()))
+    hist = History(ops)
+    return hist
+
+
+def perturb_history(rng: random.Random, history: History) -> History:
+    """Mutate one completion value — usually breaking linearizability."""
+    ops = list(history)
+    ok_reads = [i for i, op in enumerate(ops) if op.is_ok and op.f == "read"]
+    if not ok_reads:
+        return history
+    i = rng.choice(ok_reads)
+    op = ops[i]
+    ops[i] = op.with_(value=(op.value if op.value is None else op.value + 17) or 23)
+    return History(ops, reindex=False)

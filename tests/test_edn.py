@@ -100,3 +100,9 @@ def test_trailing_content_raises():
     import pytest
     with pytest.raises(ValueError):
         edn.read_string("1 2")
+
+
+def test_map_as_key_roundtrip():
+    s = '{{:a 1} 2}'
+    v = edn.read_string(s)
+    assert edn.read_string(edn.write_string(v)) == v

@@ -1,0 +1,5 @@
+def test_virtual_cpu_mesh_available():
+    import jax
+
+    assert jax.default_backend() == "cpu"
+    assert len(jax.devices()) == 8

@@ -83,11 +83,11 @@ def test_crashed_process_reassignment_pairing():
 
 
 def test_extra_fields_roundtrip():
-    op = Op("info", NEMESIS, "clock-offsets", None, time=5, extra=(("node", "n1"),))
+    op = Op("info", NEMESIS, "clock-offsets", None, time=5, extra=((K("node"), "n1"),))
     m = op.to_edn()
     assert m[K("node")] == "n1"
     op2 = Op.from_edn(m)
-    assert op2.get("node") == "n1"
+    assert op2.get("node") == "n1"  # string lookup matches keyword key
 
 
 def test_string_f_preserved_on_roundtrip():
@@ -104,3 +104,12 @@ def test_heterogeneous_extra_keys():
     op = Op.from_edn(m)
     assert op.get("node") == "n1"
     assert op.get(5) == "x"
+
+
+def test_keyword_process_and_string_keys_roundtrip():
+    s = '{:type :ok, :f :read, :process :writer-nemesis, :value 1, "node" "n1", :host "n2"}\n'
+    h = History.from_edn_string(s)
+    out = h.to_edn_string()
+    assert ':process :writer-nemesis' in out
+    assert '"node" "n1"' in out
+    assert ':host "n2"' in out

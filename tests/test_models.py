@@ -1,0 +1,151 @@
+"""Model semantics + scalar/jax step agreement."""
+
+import numpy as np
+import pytest
+
+from jepsen_tpu.models import (
+    CasRegister,
+    EncodeError,
+    FencedMutex,
+    Mutex,
+    MultiRegister,
+    OwnerAwareMutex,
+    ReentrantMutex,
+    Register,
+    Semaphore,
+    UNKNOWN,
+    ValueTable,
+    known_models,
+    model_by_name,
+)
+
+
+def test_registry():
+    assert "cas-register" in known_models()
+    m = model_by_name("cas-register", init=0)
+    assert isinstance(m, CasRegister)
+    with pytest.raises(KeyError):
+        model_by_name("nope")
+
+
+def test_value_table():
+    t = ValueTable()
+    assert t.intern(None) == 0
+    assert t.intern(3) == 1
+    assert t.intern(None) == 0
+    assert t.intern([1, 2]) == t.intern((1, 2))  # freeze lists
+    assert t.lookup(1) == 3
+    assert t.lookup(UNKNOWN) is None
+
+
+def _agree(model, states_ops):
+    """Assert step_scalar and step_jax agree on a batch of transitions."""
+    states = np.array([s for s, *_ in states_ops], dtype=np.int32)
+    opcodes = np.array([o for _, o, *_ in states_ops], dtype=np.int32)
+    a1 = np.array([a for _, _, a, _ in states_ops], dtype=np.int32)
+    a2 = np.array([b for _, _, _, b in states_ops], dtype=np.int32)
+    ok_j, st_j = model.step_jax(states, opcodes, a1, a2)
+    ok_j = np.asarray(ok_j)
+    st_j = np.asarray(st_j)
+    for i, (s, o, x, y) in enumerate(states_ops):
+        ok_s, st_s = model.step_scalar(tuple(s), o, x, y)
+        assert bool(ok_j[i]) == ok_s, (model.name, i)
+        if ok_s:  # state contract: only meaningful when the transition succeeds
+            assert tuple(int(v) for v in st_j[i]) == tuple(st_s), (model.name, i)
+
+
+def test_cas_register_agreement():
+    m = CasRegister()
+    cases = []
+    for s in [0, 1, 2]:
+        cases += [
+            ([s], 0, 0, 0),  # read expecting 0
+            ([s], 0, UNKNOWN, 0),  # read unknown
+            ([s], 1, 2, 0),  # write 2
+            ([s], 2, s, 1),  # cas hit
+            ([s], 2, s + 1, 1),  # cas miss
+        ]
+    _agree(m, cases)
+
+
+def test_multi_register_agreement():
+    m = MultiRegister({"x": 0, "y": 1})
+    cases = [
+        ([5, 6], 0, 0, 5),  # read x == 5 ok
+        ([5, 6], 0, 1, 5),  # read y == 5 fails
+        ([5, 6], 1, 0, 9),  # write x=9
+        ([5, 6], 0, 1, UNKNOWN),
+    ]
+    _agree(m, cases)
+
+
+def test_mutex_agreement():
+    _agree(
+        Mutex(),
+        [([0], 0, 0, 0), ([1], 0, 0, 0), ([0], 1, 0, 0), ([1], 1, 0, 0)],
+    )
+
+
+def test_owner_aware_mutex_agreement():
+    m = OwnerAwareMutex()
+    _agree(
+        m,
+        [
+            ([0], 0, 2, 0),  # acquire by proc-id 2
+            ([3], 1, 2, 0),  # release by owner (2+1==3)
+            ([3], 1, 1, 0),  # release by non-owner
+            ([3], 0, 1, 0),  # acquire while held
+        ],
+    )
+
+
+def test_reentrant_mutex_agreement():
+    m = ReentrantMutex(max_depth=2)
+    _agree(m, [([0], 0, 0, 0), ([1], 0, 0, 0), ([2], 0, 0, 0), ([2], 1, 0, 0), ([0], 1, 0, 0)])
+
+
+def test_fenced_mutex_agreement():
+    m = FencedMutex()
+    _agree(
+        m,
+        [
+            ([0, -1], 0, 1, 5),  # acquire fence 5
+            ([0, 5], 0, 2, 3),  # stale fence: fails
+            ([0, 5], 0, 2, 9),  # newer fence ok
+            ([2, 5], 1, 1, 0),  # release by owner
+            ([2, 5], 1, 3, 0),  # release by stranger fails
+            ([0, 5], 0, 1, UNKNOWN),  # unknown fence: allowed, fence kept
+        ],
+    )
+
+
+def test_semaphore_agreement():
+    m = Semaphore(capacity=3)
+    _agree(
+        m,
+        [([0], 0, 2, 0), ([2], 0, 2, 0), ([2], 0, 1, 0), ([2], 1, 2, 0), ([0], 1, 1, 0)],
+    )
+
+
+def test_encode_errors():
+    from jepsen_tpu.history import Interval, Op
+
+    t = ValueTable()
+    iv = Interval(Op("invoke", 0, "frobnicate", None, time=0, index=0), Op("ok", 0, "frobnicate", None, time=1, index=1))
+    with pytest.raises(EncodeError):
+        CasRegister().encode_op(iv, t)
+    with pytest.raises(EncodeError):
+        Mutex().encode_op(iv, t)
+
+
+def test_queue_models_host_only():
+    from jepsen_tpu.models import FIFOQueue
+
+    q = FIFOQueue()
+    assert not q.device_capable
+    ok, st = q.step_scalar((), 0, 4, 0)  # enqueue id 4
+    assert ok and st == (4,)
+    ok, st = q.step_scalar(st, 1, 4, 0)  # dequeue id 4
+    assert ok and st == ()
+    ok, _ = q.step_scalar((), 1, 4, 0)  # dequeue empty
+    assert not ok

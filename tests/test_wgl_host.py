@@ -1,0 +1,110 @@
+"""Host linearizability oracle tests: golden corpus + randomized histories."""
+
+import random
+
+import pytest
+
+from jepsen_tpu.models import CasRegister
+from jepsen_tpu.ops.encode import encode_history
+from jepsen_tpu.ops.wgl_host import check_history_host
+from jepsen_tpu.testing import corpus, perturb_history, random_register_history
+
+
+@pytest.mark.parametrize("case", corpus(), ids=lambda c: c.name)
+def test_corpus(case):
+    res = check_history_host(case.model, case.history)
+    assert res["valid"] == case.valid, res
+
+
+def test_witness_is_a_real_linearization():
+    case = next(c for c in corpus() if c.name == "cas basic success chain")
+    res = check_history_host(case.model, case.history)
+    assert res["valid"] is True
+    enc = encode_history(case.model, case.history)
+    # replay the witness through the model: every step must succeed
+    state = tuple(int(x) for x in enc.init_state)
+    for j in res["witness"]:
+        ok, state = case.model.step_scalar(
+            state, int(enc.opcode[j]), int(enc.a1[j]), int(enc.a2[j])
+        )
+        assert ok
+
+
+def test_random_valid_histories():
+    for seed in range(30):
+        rng = random.Random(seed)
+        h = random_register_history(rng, n_ops=30, n_procs=4)
+        res = check_history_host(CasRegister(init=0), h)
+        assert res["valid"] is True, (seed, res)
+
+
+def test_perturbed_histories_agree_with_semantics():
+    # perturbation usually invalidates; either way the oracle must terminate
+    invalid = 0
+    for seed in range(30):
+        rng = random.Random(1000 + seed)
+        h = perturb_history(rng, random_register_history(rng, n_ops=30, n_procs=4))
+        res = check_history_host(CasRegister(init=0), h)
+        assert res["valid"] in (True, False)
+        if res["valid"] is False:
+            invalid += 1
+            assert res["stuck_configs"]
+    assert invalid > 10  # the mutation does break most histories
+
+
+def test_config_budget_returns_unknown():
+    rng = random.Random(7)
+    h = random_register_history(rng, n_ops=40, n_procs=8)
+    res = check_history_host(CasRegister(init=0), h, max_configs=3)
+    assert res["valid"] == "unknown"
+
+
+def test_encode_drops_fails_and_info_reads():
+    from jepsen_tpu.testing import build
+
+    h = build(
+        [
+            ("invoke", 0, "write", 1),
+            ("fail", 0, "write", 1),
+            ("invoke", 1, "read", None),
+            ("info", 1, "read", None),
+            ("invoke", 2, "write", 2),
+            ("ok", 2, "write", 2),
+        ]
+    )
+    enc = encode_history(CasRegister(init=0), h)
+    assert enc.n == 1  # only the ok write survives
+
+
+def test_max_concurrency():
+    from jepsen_tpu.testing import build
+
+    h = build(
+        [
+            ("invoke", 0, "write", 1),
+            ("invoke", 1, "write", 2),
+            ("invoke", 2, "write", 3),
+            ("ok", 0, "write", 1),
+            ("ok", 1, "write", 2),
+            ("ok", 2, "write", 3),
+        ]
+    )
+    enc = encode_history(CasRegister(init=0), h)
+    assert enc.max_concurrency() == 3
+
+
+def test_unindexed_intervals_use_times():
+    from jepsen_tpu.history import Interval, Op
+    from jepsen_tpu.models import Register
+
+    ivs = [
+        Interval(Op("invoke", 0, "write", 3, time=0), Op("ok", 0, "write", 3, time=1)),
+        Interval(Op("invoke", 1, "read", None, time=2), Op("ok", 1, "read", 0, time=3)),
+    ]
+    assert check_history_host(Register(init=0), ivs)["valid"] is False
+
+    with pytest.raises(ValueError):
+        check_history_host(
+            Register(init=0),
+            [Interval(Op("invoke", 0, "write", 3), Op("ok", 0, "write", 3))],
+        )
