@@ -112,6 +112,21 @@ class Model:
         branching on data)."""
         raise NotImplementedError
 
+    # -- kernel-cache identity ----------------------------------------------
+    # The device kernel (ops/wgl.py) compiles one XLA program per model
+    # *behavior*; these hooks define the hashable identity and how to rebuild
+    # an equivalent instance inside the cached kernel factory.
+    def cache_key(self) -> tuple:
+        return (self.name, self.state_width, self.n_opcodes)
+
+    def cache_args(self) -> tuple:
+        """Hashable constructor args that affect step_jax behavior."""
+        return ()
+
+    @classmethod
+    def _from_cache_key(cls, args: tuple) -> "Model":
+        return cls(*args)
+
     # -- description helpers -------------------------------------------------
     def describe_op(self, opcode: int, a1: int, a2: int, table: ValueTable) -> str:
         return f"op{opcode}({a1}, {a2})"

@@ -115,6 +115,9 @@ class ReentrantMutex(Model):
     def __init__(self, max_depth: int = 2):
         self.max_depth = max_depth
 
+    def cache_args(self):
+        return (self.max_depth,)
+
     def init_state(self, table: ValueTable) -> tuple[int, ...]:
         return (0,)
 
@@ -210,6 +213,9 @@ class Semaphore(Model):
         if capacity <= 0:
             raise ValueError("capacity must be positive")
         self.capacity = capacity
+
+    def cache_args(self):
+        return (self.capacity,)
 
     def init_state(self, table: ValueTable) -> tuple[int, ...]:
         return (0,)

@@ -118,6 +118,16 @@ class MultiRegister(Model):
         self.reg_ids = {r: i for i, r in enumerate(self.regs)}
         self.state_width = len(self.regs)
 
+    def cache_key(self):
+        return (self.name, self.state_width, self.n_opcodes)
+
+    def cache_args(self):
+        return (tuple(sorted(self.init.items(), key=repr)),)
+
+    @classmethod
+    def _from_cache_key(cls, args):
+        return cls(dict(args[0]))
+
     def init_state(self, table: ValueTable) -> tuple[int, ...]:
         return tuple(table.intern(self.init[r]) for r in self.regs)
 

@@ -1,0 +1,462 @@
+"""Device (TPU) linearizability kernel — the north-star capability.
+
+The reference delegates linearizability to knossos's WGL search (consumed at
+jepsen/src/jepsen/checker.clj:196-207), a CPU breadth-first search over
+(linearized-set, model-state) configurations that needs 32 GB heaps
+(jepsen/project.clj:32) and times out on long histories. This module is that
+search re-designed for a systolic/SIMD machine:
+
+**Representation.** A configuration is a fixed-width int row::
+
+    [ p | window bitmask (KD u32 words) | open bitmask (KO u32 words) | state ]
+
+- History rows are split into *determinate* ops (completed: finite return
+  index) and *open* ops (:info — indeterminate, interval open to the end of
+  time; generator/interpreter.clj:142-157 semantics).
+- ``p`` is a prefix pointer over determinate rows sorted by invocation: all
+  rows ``< p`` are linearized, row ``p`` is not. The window bitmask covers
+  rows ``p .. p+W-1``; real-time order guarantees no determinate op beyond
+  the window can linearize while row ``p`` hasn't (its invocation lies after
+  row p's return), so a *small* window bitset replaces knossos's unbounded
+  linearized-set — W is computed exactly per history as
+  ``max_p |{j >= p : inv[j] < ret[p]}|``.
+- Open ops never bound others (their return never happens), can be
+  linearized at any later point, and are never *required*; they get global
+  bitmask slots.
+
+**Search.** One BFS level per linearized op. Each level is a fixed-shape
+tensor program: for every (config, candidate-slot) pair test the real-time
+rule ``inv[j] < min ret over unlinearized-excluding-j`` (two-min reduction
+over the window + a precomputed suffix-min for beyond-window rows), run the
+model transition (``model.step_jax``, vectorized over all F×C pairs — MXU/
+VPU-friendly), set the bit, renormalize the prefix (trailing-ones popcount +
+multi-word shift), then deduplicate by lexicographic ``lax.sort`` and
+compact. The whole level loop is a single ``lax.while_loop`` under ``jit``;
+the host only re-enters to escalate frontier capacity geometrically when a
+level overflows.
+
+Configurations at BFS level ℓ all have exactly ℓ ops linearized, so
+per-level dedup is equivalent to knossos's global memoization.
+
+Verdicts: ``accepted`` ⇒ linearizable (trustworthy even after overflow);
+frontier exhausted with no overflow ⇒ **not** linearizable; capacity
+schedule exhausted ⇒ unknown (caller may fall back to the host oracle,
+`jepsen_tpu.ops.wgl_host`, which this kernel is differentially tested
+against).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+import time as _time
+from typing import Any, Optional
+
+import numpy as np
+
+from .encode import EncodedHistory, OPEN, encode_history
+from ..history import History
+from ..models import Model
+
+INT32_MAX = np.int32(2**31 - 1)
+
+# Default frontier-capacity escalation schedule (configs per BFS level).
+F_SCHEDULE = (128, 1024, 8192, 65536)
+
+
+def _next_pow2(x: int, lo: int = 32) -> int:
+    return max(lo, 1 << (int(x) - 1).bit_length())
+
+
+# ---------------------------------------------------------------------------
+# Kernel construction (one compiled program per static shape bucket + model)
+
+
+@functools.lru_cache(maxsize=64)
+def _build_kernel(model_key, F: int, W: int, KO: int, S: int, ND: int, NO: int):
+    """Returns a jitted BFS driver with static shapes.
+
+    model_key = (model-class, cache signature) — step_jax must be a pure
+    function of the class + signature.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    model_cls, _sig, model_args = model_key
+    model = model_cls._from_cache_key(model_args)
+    KD = W // 32
+    OB = KO * 32  # open candidate slots
+    C = W + OB  # candidates per config
+    M = F * C
+
+    u32 = jnp.uint32
+    slots = np.arange(W, dtype=np.int32)
+    oslots = np.arange(OB, dtype=np.int32)
+    # Precomputed bit tables: candidate slot -> mask word one-hots.
+    bitD = np.zeros((C, KD), dtype=np.uint32)
+    for t in range(W):
+        bitD[t, t // 32] = np.uint32(1) << np.uint32(t % 32)
+    bitO = np.zeros((C, max(KO, 1)), dtype=np.uint32)
+    for o in range(OB):
+        bitO[W + o, o // 32] = np.uint32(1) << np.uint32(o % 32)
+
+    def trailing_ones(mask):  # [.., KD] u32 -> [..] i32
+        # trailing ones of x == trailing zeros of ~x == popcount(x & (~x - 1))
+        s = jnp.zeros(mask.shape[:-1], dtype=jnp.int32)
+        carry = jnp.ones(mask.shape[:-1], dtype=bool)
+        for w in range(KD):
+            x = mask[..., w]
+            t1 = lax.population_count(x & (~x - u32(1))).astype(jnp.int32)
+            s = s + jnp.where(carry, t1, 0)
+            carry = carry & (t1 == 32)
+        return s
+
+    def shift_words_right(mask, s):  # [.., KD] u32 >> s bits (s [..] i32)
+        sw = (s // 32)[..., None]
+        sb = (s % 32)[..., None].astype(jnp.uint32)
+        idx = jnp.arange(KD, dtype=jnp.int32)
+        src_lo = idx + sw  # [.., KD]
+        src_hi = src_lo + 1
+        lo = jnp.where(
+            src_lo < KD,
+            jnp.take_along_axis(mask, jnp.minimum(src_lo, KD - 1), axis=-1),
+            u32(0),
+        )
+        hi = jnp.where(
+            src_hi < KD,
+            jnp.take_along_axis(mask, jnp.minimum(src_hi, KD - 1), axis=-1),
+            u32(0),
+        )
+        out = (lo >> sb) | jnp.where(sb == 0, u32(0), hi << ((u32(32) - sb) % u32(32)))
+        return out
+
+    def kernel(
+        nD,
+        nO,
+        max_levels,
+        invD,
+        retD,
+        opD,
+        a1D,
+        a2D,
+        sufretD,  # [ND+1]
+        invO,
+        opO,
+        a1O,
+        a2O,
+        init_state,  # [S] i32
+    ):
+        # --- initial frontier: one config, nothing linearized --------------
+        fr_p = jnp.zeros((F,), dtype=jnp.int32)
+        fr_mD = jnp.zeros((F, KD), dtype=jnp.uint32)
+        fr_mO = jnp.zeros((F, max(KO, 1)), dtype=jnp.uint32)
+        fr_st = jnp.broadcast_to(init_state, (F, S)).astype(jnp.int32)
+        fr_valid = jnp.zeros((F,), dtype=bool).at[0].set(True)
+
+        ow = np.int32(W)
+        word_of_slot = slots // 32
+        bit_of_slot = (slots % 32).astype(np.uint32)
+        oword_of_slot = oslots // 32
+        obit_of_slot = (oslots % 32).astype(np.uint32)
+
+        def level(carry):
+            p, mD, mO, st, valid, lvl, acc, ovf, fmax = carry
+
+            rows = p[:, None] + slots[None, :]  # [F, W]
+            in_rng = rows < nD
+            rc = jnp.minimum(rows, ND - 1)
+            retw = jnp.where(in_rng, retD[rc], INT32_MAX)
+            invw = jnp.where(in_rng, invD[rc], INT32_MAX)
+            bits = (mD[:, word_of_slot] >> bit_of_slot[None, :]) & u32(1)
+            linz = bits == u32(1)
+            unlin = in_rng & ~linz
+            vals = jnp.where(unlin, retw, INT32_MAX)
+            m1 = vals.min(axis=1)
+            am = vals.argmin(axis=1).astype(jnp.int32)
+            m2 = jnp.where(slots[None, :] == am[:, None], INT32_MAX, vals).min(axis=1)
+            tail = sufretD[jnp.minimum(p + ow, nD)]  # min ret beyond window
+            minret_all = jnp.minimum(m1, tail)
+            minret_excl = jnp.minimum(
+                jnp.where(slots[None, :] == am[:, None], m2[:, None], m1[:, None]),
+                tail[:, None],
+            )
+            cand_D = unlin & (invw < minret_excl)  # [F, W]
+
+            if KO:
+                obits = (mO[:, oword_of_slot] >> obit_of_slot[None, :]) & u32(1)
+                o_in = oslots[None, :] < nO
+                invo = jnp.where(
+                    o_in, invO[jnp.minimum(oslots, NO - 1)][None, :], INT32_MAX
+                )
+                cand_O = o_in & (obits == u32(0)) & (invo < minret_all[:, None])
+            else:
+                cand_O = jnp.zeros((F, 0), dtype=bool)
+
+            # --- model transition over all F*C candidate pairs -------------
+            opw = jnp.where(in_rng, opD[rc], 0)
+            a1w = jnp.where(in_rng, a1D[rc], 0)
+            a2w = jnp.where(in_rng, a2D[rc], 0)
+            if KO:
+                oc = jnp.minimum(oslots, NO - 1)
+                opc = jnp.concatenate(
+                    [opw, jnp.broadcast_to(opO[oc][None, :], (F, OB))], axis=1
+                )
+                a1c = jnp.concatenate(
+                    [a1w, jnp.broadcast_to(a1O[oc][None, :], (F, OB))], axis=1
+                )
+                a2c = jnp.concatenate(
+                    [a2w, jnp.broadcast_to(a2O[oc][None, :], (F, OB))], axis=1
+                )
+                cand = jnp.concatenate([cand_D, cand_O], axis=1)
+            else:
+                opc, a1c, a2c, cand = opw, a1w, a2w, cand_D
+
+            st_rep = jnp.broadcast_to(st[:, None, :], (F, C, S)).reshape(M, S)
+            ok, st2 = model.step_jax(
+                st_rep, opc.reshape(M), a1c.reshape(M), a2c.reshape(M)
+            )
+            st2 = st2.reshape(M, S).astype(jnp.int32)
+            cand = cand & ok.reshape(F, C) & valid[:, None]  # [F, C]
+
+            # --- build new configs -----------------------------------------
+            nmD = mD[:, None, :] | bitD[None, :, :]  # [F, C, KD]
+            nmD = nmD.reshape(M, KD)
+            if KO:
+                nmO = (mO[:, None, :] | bitO[None, :, :]).reshape(M, max(KO, 1))
+            else:
+                nmO = jnp.zeros((M, 1), dtype=jnp.uint32)
+            s = trailing_ones(nmD)
+            np_ = jnp.broadcast_to(p[:, None], (F, C)).reshape(M) + s
+            nmD = shift_words_right(nmD, s)
+            nvalid = cand.reshape(M)
+
+            acc_now = jnp.any(nvalid & (np_ >= nD))
+
+            # --- dedup (lexicographic sort; dups are adjacent) -------------
+            key0 = (~nvalid).astype(jnp.uint32)
+            cols = [key0, np_.astype(jnp.uint32)]
+            cols += [nmD[:, w] for w in range(KD)]
+            if KO:
+                cols += [nmO[:, w] for w in range(KO)]
+            cols += [
+                lax.bitcast_convert_type(st2[:, i], jnp.uint32) for i in range(S)
+            ]
+            nk = len(cols)
+            sorted_cols = lax.sort(tuple(cols), dimension=0, num_keys=nk)
+            same = jnp.ones((M,), dtype=bool)
+            for c in sorted_cols:
+                same = same & jnp.concatenate([jnp.zeros((1,), bool), c[1:] == c[:-1]])
+            svalid = sorted_cols[0] == u32(0)
+            keep = svalid & ~same
+            count = keep.sum()
+            ovf2 = ovf | (count > F)
+
+            # --- compact the unique rows to the front ----------------------
+            packed = lax.sort(
+                ((~keep).astype(jnp.uint32),) + sorted_cols[1:], dimension=0, num_keys=1
+            )
+            kvalid = packed[0][:F] == u32(0)
+            kp = packed[1][:F].astype(jnp.int32)
+            kmD = jnp.stack([packed[2 + w][:F] for w in range(KD)], axis=1)
+            off = 2 + KD
+            if KO:
+                kmO = jnp.stack([packed[off + w][:F] for w in range(KO)], axis=1)
+                off += KO
+            else:
+                kmO = jnp.zeros((F, 1), dtype=jnp.uint32)
+            kst = jnp.stack(
+                [
+                    lax.bitcast_convert_type(packed[off + i][:F], jnp.int32)
+                    for i in range(S)
+                ],
+                axis=1,
+            )
+            return (
+                kp,
+                kmD,
+                kmO,
+                kst,
+                kvalid,
+                lvl + 1,
+                acc | acc_now,
+                ovf2,
+                jnp.maximum(fmax, jnp.minimum(count, F).astype(jnp.int32)),
+            )
+
+        def cond(carry):
+            _p, _mD, _mO, _st, valid, lvl, acc, ovf, _fm = carry
+            return (~acc) & (~ovf) & jnp.any(valid) & (lvl < max_levels)
+
+        init = (
+            fr_p,
+            fr_mD,
+            fr_mO,
+            fr_st,
+            fr_valid,
+            jnp.int32(0),
+            jnp.asarray(False),
+            jnp.asarray(False),
+            jnp.int32(1),
+        )
+        out = lax.while_loop(cond, level, init)
+        _p, _mD, _mO, _st, valid, lvl, acc, ovf, fmax = out
+        return acc, ovf, jnp.any(valid), lvl, fmax
+
+    return jax.jit(kernel)
+
+
+# ---------------------------------------------------------------------------
+# Host driver
+
+
+def _model_cache_key(model: Model):
+    return (type(model), model.cache_key(), model.cache_args())
+
+
+def check_encoded_device(
+    enc: EncodedHistory,
+    f_schedule=F_SCHEDULE,
+    max_open: int = 128,
+    window_cap: int = 1024,
+) -> dict:
+    """Decide linearizability of an encoded history on the default JAX
+    backend (TPU when present). Result map mirrors the host oracle
+    (`wgl_host.check_encoded`) plus device diagnostics."""
+    t0 = _time.perf_counter()
+    n = enc.n
+    det = ~enc.skippable
+    nD = int(det.sum())
+    nO = n - nD
+    if nD == 0:
+        # No required op — the empty linearization (skip all open ops) wins.
+        return {"valid": True, "op_count": n, "device": True, "levels": 0}
+    if nO > max_open:
+        return {
+            "valid": "unknown",
+            "op_count": n,
+            "device": True,
+            "info": f"{nO} open (:info) ops exceeds device cap {max_open}",
+        }
+
+    invD = enc.inv[det].astype(np.int32)
+    retD = enc.ret[det].astype(np.int32)
+    opD = enc.opcode[det].astype(np.int32)
+    a1D = enc.a1[det].astype(np.int32)
+    a2D = enc.a2[det].astype(np.int32)
+    invO = enc.inv[~det].astype(np.int32)
+    opO = enc.opcode[~det].astype(np.int32)
+    a1O = enc.a1[~det].astype(np.int32)
+    a2O = enc.a2[~det].astype(np.int32)
+
+    # Exact window requirement: max_p |{j >= p : inv[j] < ret[p]}| over
+    # determinate rows (sorted by inv).
+    cnt = np.searchsorted(invD, retD, side="left") - np.arange(nD)
+    W = int(cnt.max()) if nD else 1
+    W = max(W, 1)
+    if W > window_cap:
+        return {
+            "valid": "unknown",
+            "op_count": n,
+            "device": True,
+            "info": f"window requirement {W} exceeds cap {window_cap}",
+        }
+    W = ((W + 31) // 32) * 32
+    KO = (nO + 31) // 32
+
+    ND = _next_pow2(nD)
+    NO = _next_pow2(max(nO, 1))
+    S = len(enc.init_state)
+
+    padD = lambda a: np.pad(a, (0, ND - nD))
+    padO = lambda a: np.pad(a, (0, NO - nO))
+    sufret = np.full(ND + 1, INT32_MAX, dtype=np.int32)
+    if nD:
+        sufret[:nD] = np.minimum.accumulate(retD[::-1])[::-1]
+
+    args = (
+        np.int32(nD),
+        np.int32(nO),
+        np.int32(nD + nO + 1),
+        padD(invD),
+        padD(retD),
+        padD(opD),
+        padD(a1D),
+        padD(a2D),
+        sufret,
+        padO(invO),
+        padO(opO),
+        padO(a1O),
+        padO(a2O),
+        enc.init_state.astype(np.int32),
+    )
+
+    mk = _model_cache_key(enc.model)
+    attempts = []
+    for F in f_schedule:
+        kern = _build_kernel(mk, F, W, KO, S, ND, NO)
+        acc, ovf, nonempty, lvl, fmax = [np.asarray(x) for x in kern(*args)]
+        attempts.append({"F": F, "levels": int(lvl), "frontier_max": int(fmax)})
+        if bool(acc):
+            return {
+                "valid": True,
+                "op_count": n,
+                "device": True,
+                "levels": int(lvl),
+                "frontier_max": int(fmax),
+                "window": W,
+                "attempts": attempts,
+                "wall_s": _time.perf_counter() - t0,
+            }
+        if not bool(ovf):
+            return {
+                "valid": False,
+                "op_count": n,
+                "device": True,
+                "levels": int(lvl),
+                "max_linearized": int(lvl),
+                "frontier_max": int(fmax),
+                "window": W,
+                "attempts": attempts,
+                "wall_s": _time.perf_counter() - t0,
+            }
+    return {
+        "valid": "unknown",
+        "op_count": n,
+        "device": True,
+        "info": f"frontier capacity schedule {list(f_schedule)} exhausted",
+        "attempts": attempts,
+        "wall_s": _time.perf_counter() - t0,
+    }
+
+
+def check_history_device(model: Model, history: History, **kw) -> dict:
+    return check_encoded_device(encode_history(model, history), **kw)
+
+
+def check_history(
+    model: Model,
+    history: History,
+    backend: str = "auto",
+    host_max_configs: int = 500_000,
+    **kw,
+) -> dict:
+    """Unified entry: dispatch to the device kernel or the host oracle.
+
+    ``backend``: "auto" (device for device-capable models, host fallback on
+    unknown), "device", or "host". This is the seam the Checker layer's
+    ``:checker-backend`` option rides (BASELINE dispatch story; reference
+    seam checker.clj:49-64).
+    """
+    from . import wgl_host
+
+    if backend == "host" or not model.device_capable:
+        return wgl_host.check_history_host(model, history, max_configs=host_max_configs)
+    enc = encode_history(model, history)
+    res = check_encoded_device(enc, **kw)
+    if backend == "auto" and res["valid"] == "unknown":
+        host = wgl_host.check_encoded(enc, max_configs=host_max_configs)
+        if host["valid"] != "unknown":
+            host["device_attempt"] = res
+            return host
+    return res
