@@ -1,0 +1,59 @@
+"""Headline benchmark: decide linearizability of a 10k-op CAS-register
+history on one TPU chip.
+
+North star (BASELINE.md): CPU Knossos times out at 300 s on this size; the
+target is < 60 s on one chip. Prints ONE JSON line:
+``{"metric", "value", "unit", "vs_baseline"}`` where value = wall seconds
+for the decision (steady-state: program compiled, history resident) and
+vs_baseline = 300 / value (speedup over the CPU-checker timeout budget).
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import sys
+import time
+
+
+N_OPS = int(__import__("os").environ.get("BENCH_N_OPS", "10000"))
+BASELINE_S = 300.0
+
+
+def main() -> int:
+    from jepsen_tpu.models import CasRegister
+    from jepsen_tpu.ops import wgl
+    from jepsen_tpu.ops.encode import encode_history
+    from jepsen_tpu.testing import random_register_history
+
+    rng = random.Random(2026)
+    model = CasRegister(init=0)
+    history = random_register_history(
+        rng, n_ops=N_OPS, n_procs=10, cas=True, crash_p=0.002, fail_p=0.02
+    )
+    enc = encode_history(model, history)
+
+    # Warm-up run compiles the kernel for this shape bucket; the measured
+    # run is steady-state device execution.
+    res = wgl.check_encoded_device(enc)
+    assert res["valid"] is True, res
+    t0 = time.perf_counter()
+    res = wgl.check_encoded_device(enc)
+    dt = time.perf_counter() - t0
+    assert res["valid"] is True, res
+
+    print(
+        json.dumps(
+            {
+                "metric": f"linearizability_check_{N_OPS}op_cas_register",
+                "value": round(dt, 3),
+                "unit": "s",
+                "vs_baseline": round(BASELINE_S / dt, 1),
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

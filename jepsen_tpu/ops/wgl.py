@@ -61,7 +61,10 @@ from ..models import Model
 INT32_MAX = np.int32(2**31 - 1)
 
 # Default frontier-capacity escalation schedule (configs per BFS level).
-F_SCHEDULE = (128, 1024, 8192, 65536)
+# Escalation resumes from the last completed level (lossless), so starting
+# tiny is nearly free and keeps the common case (frontier of a handful of
+# configs) cheap.
+F_SCHEDULE = (16, 128, 1024, 8192, 65536)
 
 
 def _next_pow2(x: int, lo: int = 32) -> int:
@@ -145,15 +148,13 @@ def _build_kernel(model_key, F: int, W: int, KO: int, S: int, ND: int, NO: int):
         opO,
         a1O,
         a2O,
-        init_state,  # [S] i32
+        fr_p,  # [F] initial frontier (resumable across capacity escalation)
+        fr_mD,  # [F, KD]
+        fr_mO,  # [F, max(KO,1)]
+        fr_st,  # [F, S]
+        fr_valid,  # [F] bool
+        lvl0,  # i32 starting level
     ):
-        # --- initial frontier: one config, nothing linearized --------------
-        fr_p = jnp.zeros((F,), dtype=jnp.int32)
-        fr_mD = jnp.zeros((F, KD), dtype=jnp.uint32)
-        fr_mO = jnp.zeros((F, max(KO, 1)), dtype=jnp.uint32)
-        fr_st = jnp.broadcast_to(init_state, (F, S)).astype(jnp.int32)
-        fr_valid = jnp.zeros((F,), dtype=bool).at[0].set(True)
-
         ow = np.int32(W)
         word_of_slot = slots // 32
         bit_of_slot = (slots % 32).astype(np.uint32)
@@ -233,54 +234,64 @@ def _build_kernel(model_key, F: int, W: int, KO: int, S: int, ND: int, NO: int):
 
             acc_now = jnp.any(nvalid & (np_ >= nD))
 
-            # --- dedup (lexicographic sort; dups are adjacent) -------------
-            key0 = (~nvalid).astype(jnp.uint32)
-            cols = [key0, np_.astype(jnp.uint32)]
+            # --- dedup + compact -------------------------------------------
+            # One sort on (validity, 64-bit FNV hash of the config row) with
+            # an iota payload; exact duplicate rows hash equal and land
+            # adjacent, so one neighbor compare marks them. A hash collision
+            # can only *miss* a dedup (soundness unaffected). Compaction is
+            # a cumsum/scatter, not a second sort.
+            cols = [np_.astype(jnp.uint32)]
             cols += [nmD[:, w] for w in range(KD)]
             if KO:
                 cols += [nmO[:, w] for w in range(KO)]
-            cols += [
-                lax.bitcast_convert_type(st2[:, i], jnp.uint32) for i in range(S)
-            ]
-            nk = len(cols)
-            sorted_cols = lax.sort(tuple(cols), dimension=0, num_keys=nk)
+            cols += [lax.bitcast_convert_type(st2[:, i], jnp.uint32) for i in range(S)]
+            h1 = jnp.full((M,), u32(2166136261))
+            h2 = jnp.full((M,), u32(0x9E3779B9))
+            for c in cols:
+                h1 = (h1 ^ c) * u32(16777619)
+                h2 = (h2 ^ (c + u32(0x85EBCA6B))) * u32(0xC2B2AE35)
+            key0 = (~nvalid).astype(jnp.uint32)
+            iota = lax.iota(jnp.int32, M)
+            _, _, _, perm = lax.sort((key0, h1, h2, iota), dimension=0, num_keys=3)
+            gvalid = nvalid[perm]
+            gcols = [c[perm] for c in cols]
             same = jnp.ones((M,), dtype=bool)
-            for c in sorted_cols:
+            for c in gcols:
                 same = same & jnp.concatenate([jnp.zeros((1,), bool), c[1:] == c[:-1]])
-            svalid = sorted_cols[0] == u32(0)
-            keep = svalid & ~same
+            prev_valid = jnp.concatenate([jnp.zeros((1,), bool), gvalid[:-1]])
+            keep = gvalid & ~(same & prev_valid)
             count = keep.sum()
-            ovf2 = ovf | (count > F)
+            ovf_now = count > F
 
-            # --- compact the unique rows to the front ----------------------
-            packed = lax.sort(
-                ((~keep).astype(jnp.uint32),) + sorted_cols[1:], dimension=0, num_keys=1
-            )
-            kvalid = packed[0][:F] == u32(0)
-            kp = packed[1][:F].astype(jnp.int32)
-            kmD = jnp.stack([packed[2 + w][:F] for w in range(KD)], axis=1)
-            off = 2 + KD
-            if KO:
-                kmO = jnp.stack([packed[off + w][:F] for w in range(KO)], axis=1)
-                off += KO
-            else:
-                kmO = jnp.zeros((F, 1), dtype=jnp.uint32)
-            kst = jnp.stack(
-                [
-                    lax.bitcast_convert_type(packed[off + i][:F], jnp.int32)
-                    for i in range(S)
-                ],
-                axis=1,
-            )
+            pos = jnp.cumsum(keep.astype(jnp.int32)) - 1
+            tgt = jnp.where(keep, pos, F)  # F = out-of-range -> dropped
+            kp = jnp.zeros((F,), jnp.int32).at[tgt].set(gcols[0].astype(jnp.int32), mode="drop")
+            kmD = jnp.zeros((F, KD), jnp.uint32)
+            for w in range(KD):
+                kmD = kmD.at[tgt, w].set(gcols[1 + w], mode="drop")
+            kmO = jnp.zeros((F, max(KO, 1)), jnp.uint32)
+            for w in range(KO):
+                kmO = kmO.at[tgt, w].set(gcols[1 + KD + w], mode="drop")
+            kst = jnp.zeros((F, S), jnp.int32)
+            for i in range(S):
+                kst = kst.at[tgt, i].set(
+                    lax.bitcast_convert_type(gcols[1 + KD + KO + i], jnp.int32),
+                    mode="drop",
+                )
+            kvalid = lax.iota(jnp.int32, F) < jnp.minimum(count, F)
+
+            # On overflow keep the pre-expansion frontier intact so the
+            # search can resume losslessly at a larger capacity.
+            sel = lambda new, old: jnp.where(ovf_now, old, new)
             return (
-                kp,
-                kmD,
-                kmO,
-                kst,
-                kvalid,
-                lvl + 1,
+                sel(kp, p),
+                sel(kmD, mD),
+                sel(kmO, mO),
+                sel(kst, st),
+                sel(kvalid, valid),
+                jnp.where(ovf_now, lvl, lvl + 1),
                 acc | acc_now,
-                ovf2,
+                ovf | ovf_now,
                 jnp.maximum(fmax, jnp.minimum(count, F).astype(jnp.int32)),
             )
 
@@ -294,16 +305,27 @@ def _build_kernel(model_key, F: int, W: int, KO: int, S: int, ND: int, NO: int):
             fr_mO,
             fr_st,
             fr_valid,
-            jnp.int32(0),
+            lvl0,
             jnp.asarray(False),
             jnp.asarray(False),
             jnp.int32(1),
         )
         out = lax.while_loop(cond, level, init)
-        _p, _mD, _mO, _st, valid, lvl, acc, ovf, fmax = out
-        return acc, ovf, jnp.any(valid), lvl, fmax
+        p, mD, mO, st, valid, lvl, acc, ovf, fmax = out
+        return acc, ovf, jnp.any(valid), lvl, fmax, p, mD, mO, st, valid
 
-    return jax.jit(kernel)
+    return kernel, jax.jit(kernel)
+
+
+@functools.lru_cache(maxsize=32)
+def _build_batch_kernel(model_key, F: int, W: int, KO: int, S: int, ND: int, NO: int):
+    """vmapped kernel over a leading batch axis on every argument — the
+    batch-replay path (jepsen_tpu.parallel.batch); shardable over a device
+    mesh by placing the batch axis on the mesh's data axis."""
+    import jax
+
+    raw, _ = _build_kernel(model_key, F, W, KO, S, ND, NO)
+    return jax.jit(jax.vmap(raw))
 
 
 # ---------------------------------------------------------------------------
@@ -314,30 +336,68 @@ def _model_cache_key(model: Model):
     return (type(model), model.cache_key(), model.cache_args())
 
 
-def check_encoded_device(
+def initial_frontier(F: int, W: int, KO: int, S: int, init_state) -> tuple:
+    """The 6-tuple of resumable frontier args (p, maskD, maskO, state,
+    valid, level) for a fresh search: one valid config, nothing linearized."""
+    KD = W // 32
+    return (
+        np.zeros((F,), np.int32),
+        np.zeros((F, KD), np.uint32),
+        np.zeros((F, max(KO, 1)), np.uint32),
+        np.broadcast_to(np.asarray(init_state, np.int32), (F, S)).copy(),
+        np.arange(F) == 0,
+        np.int32(0),
+    )
+
+
+def _pad_frontier(fr: tuple, F_new: int) -> tuple:
+    """Grow a returned frontier to a larger capacity (escalation resume)."""
+    p, mD, mO, st, valid, lvl = fr
+    grow = lambda a: np.pad(np.asarray(a), [(0, F_new - a.shape[0])] + [(0, 0)] * (a.ndim - 1))
+    return (grow(p), grow(mD), grow(mO), grow(st), grow(valid), np.int32(lvl))
+
+
+class DevicePlan:
+    """Prepared device arrays + static dims for one encoded history.
+
+    ``dims = (W, KO, S, ND, NO)`` are the kernel's static shape parameters;
+    ``args`` is the positional argument tuple the kernel consumes. Shared by
+    the single-history driver, the batched/sharded checker
+    (jepsen_tpu.parallel) and the graft entry point.
+    """
+
+    __slots__ = ("dims", "args", "nD", "nO", "init_state", "reason")
+
+    def __init__(self, dims, args, nD, nO, init_state=None, reason=None):
+        self.dims = dims
+        self.args = args
+        self.nD = nD
+        self.nO = nO
+        self.init_state = init_state
+        self.reason = reason
+
+    @property
+    def ok(self) -> bool:
+        return self.reason is None
+
+
+def plan_device(
     enc: EncodedHistory,
-    f_schedule=F_SCHEDULE,
     max_open: int = 128,
     window_cap: int = 1024,
-) -> dict:
-    """Decide linearizability of an encoded history on the default JAX
-    backend (TPU when present). Result map mirrors the host oracle
-    (`wgl_host.check_encoded`) plus device diagnostics."""
-    t0 = _time.perf_counter()
-    n = enc.n
+    pad_to: Optional[tuple] = None,
+) -> DevicePlan:
+    """Prepare kernel arrays. ``pad_to = (W, KO, ND, NO)`` forces the static
+    dims (for batching many histories under one compiled program); they must
+    dominate this history's own requirements."""
     det = ~enc.skippable
     nD = int(det.sum())
-    nO = n - nD
-    if nD == 0:
-        # No required op — the empty linearization (skip all open ops) wins.
-        return {"valid": True, "op_count": n, "device": True, "levels": 0}
+    nO = enc.n - nD
     if nO > max_open:
-        return {
-            "valid": "unknown",
-            "op_count": n,
-            "device": True,
-            "info": f"{nO} open (:info) ops exceeds device cap {max_open}",
-        }
+        return DevicePlan(
+            None, None, nD, nO,
+            reason=f"{nO} open (:info) ops exceeds device cap {max_open}",
+        )
 
     invD = enc.inv[det].astype(np.int32)
     retD = enc.ret[det].astype(np.int32)
@@ -351,22 +411,33 @@ def check_encoded_device(
 
     # Exact window requirement: max_p |{j >= p : inv[j] < ret[p]}| over
     # determinate rows (sorted by inv).
-    cnt = np.searchsorted(invD, retD, side="left") - np.arange(nD)
-    W = int(cnt.max()) if nD else 1
-    W = max(W, 1)
+    if nD:
+        cnt = np.searchsorted(invD, retD, side="left") - np.arange(nD)
+        W = max(int(cnt.max()), 1)
+    else:
+        W = 1
     if W > window_cap:
-        return {
-            "valid": "unknown",
-            "op_count": n,
-            "device": True,
-            "info": f"window requirement {W} exceeds cap {window_cap}",
-        }
+        return DevicePlan(
+            None, None, nD, nO,
+            reason=f"window requirement {W} exceeds cap {window_cap}",
+        )
     W = ((W + 31) // 32) * 32
     KO = (nO + 31) // 32
 
-    ND = _next_pow2(nD)
+    ND = _next_pow2(max(nD, 1))
     NO = _next_pow2(max(nO, 1))
     S = len(enc.init_state)
+    if pad_to is not None:
+        pW, pKO, pND, pNO = pad_to
+        if pW % 32 or pW < W or pKO < KO or pND < nD or pNO < max(nO, 1):
+            return DevicePlan(
+                None,
+                None,
+                nD,
+                nO,
+                reason=f"pad_to {pad_to} below requirement {(W, KO, nD, nO)} or W not x32",
+            )
+        W, KO, ND, NO = pW, pKO, pND, pNO
 
     padD = lambda a: np.pad(a, (0, ND - nD))
     padO = lambda a: np.pad(a, (0, NO - nO))
@@ -388,14 +459,41 @@ def check_encoded_device(
         padO(opO),
         padO(a1O),
         padO(a2O),
-        enc.init_state.astype(np.int32),
     )
+    return DevicePlan(
+        (W, KO, S, ND, NO), args, nD, nO, init_state=enc.init_state.astype(np.int32)
+    )
+
+
+def check_encoded_device(
+    enc: EncodedHistory,
+    f_schedule=F_SCHEDULE,
+    max_open: int = 128,
+    window_cap: int = 1024,
+) -> dict:
+    """Decide linearizability of an encoded history on the default JAX
+    backend (TPU when present). Result map mirrors the host oracle
+    (`wgl_host.check_encoded`) plus device diagnostics."""
+    t0 = _time.perf_counter()
+    n = enc.n
+    plan = plan_device(enc, max_open=max_open, window_cap=window_cap)
+    if plan.nD == 0:
+        # No required op — the empty linearization (skip all open ops) wins.
+        return {"valid": True, "op_count": n, "device": True, "levels": 0}
+    if not plan.ok or not f_schedule:
+        info = plan.reason or "empty frontier-capacity schedule"
+        return {"valid": "unknown", "op_count": n, "device": True, "info": info}
+    W, KO, S, ND, NO = plan.dims
 
     mk = _model_cache_key(enc.model)
     attempts = []
+    fr = initial_frontier(f_schedule[0], W, KO, S, plan.init_state)
     for F in f_schedule:
-        kern = _build_kernel(mk, F, W, KO, S, ND, NO)
-        acc, ovf, nonempty, lvl, fmax = [np.asarray(x) for x in kern(*args)]
+        _, kern = _build_kernel(mk, F, W, KO, S, ND, NO)
+        fr = _pad_frontier(fr, F)
+        out = [np.asarray(x) for x in kern(*plan.args, *fr)]
+        acc, ovf, nonempty, lvl, fmax = out[:5]
+        fr = tuple(out[5:]) + (lvl,)  # resume point for the next capacity
         attempts.append({"F": F, "levels": int(lvl), "frontier_max": int(fmax)})
         if bool(acc):
             return {

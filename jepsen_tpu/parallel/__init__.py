@@ -1,0 +1,43 @@
+"""Mesh / sharding layer: scale the analyzers across TPU chips.
+
+The reference has no collective-communication layer — its scaling axes are
+key-space sharding checked via ``bounded-pmap`` (jepsen/src/jepsen/
+independent.clj:263-314) and ``pmap`` over composed checkers
+(checker.clj:84-96). Here those axes become device axes: a batch of
+histories (per-key subhistories, or archived ``store/*/history.edn`` runs —
+BASELINE config 5) is checked under ONE compiled XLA program, vmapped over
+the batch and sharded over a `jax.sharding.Mesh` so each chip replays its
+slice; collectives ride ICI within a host and DCN across hosts, inserted by
+XLA from the sharding annotations (no hand-written NCCL/MPI analogue).
+
+- :func:`make_mesh` — build the device mesh (``dp`` = history/key batch
+  axis, ``mp`` = reserved intra-analysis axis).
+- `jepsen_tpu.parallel.batch` — the batched linearizability checker.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+
+def make_mesh(n_devices: Optional[int] = None, shape: Optional[Sequence[int]] = None,
+              axis_names: Sequence[str] = ("dp", "mp")):
+    """Create a Mesh over the first ``n_devices`` JAX devices.
+
+    ``shape`` defaults to (n, 1): pure data parallelism over histories/keys.
+    """
+    import numpy as np
+    import jax
+    from jax.sharding import Mesh
+
+    devs = jax.devices()
+    if n_devices is None:
+        n_devices = len(devs)
+    devs = devs[:n_devices]
+    if shape is None:
+        shape = (n_devices, 1)
+    arr = np.asarray(devs).reshape(tuple(shape))
+    return Mesh(arr, tuple(axis_names[: arr.ndim]))
+
+
+from .batch import check_batch, check_histories  # noqa: E402,F401
