@@ -1,0 +1,180 @@
+"""Shared utilities (the reference's jepsen.util, util.clj).
+
+Only the pieces the framework actually consumes: the monotonic relative
+test clock (util.clj:291-309), crash-propagating parallel map
+(util.clj:60-73), timeouts, retries, majority math, and op logging."""
+
+from __future__ import annotations
+
+import concurrent.futures
+import logging
+import threading
+import time
+from contextlib import contextmanager
+from typing import Any, Callable, Iterable, Optional, Sequence
+
+LOG = logging.getLogger("jepsen")
+
+_relative_origin: Optional[int] = None
+_origin_lock = threading.Lock()
+
+
+def with_relative_time():
+    """Context manager zeroing the relative test clock
+    (util.clj:291-309)."""
+
+    @contextmanager
+    def ctx():
+        global _relative_origin
+        with _origin_lock:
+            prev = _relative_origin
+            _relative_origin = time.monotonic_ns()
+        try:
+            yield
+        finally:
+            with _origin_lock:
+                _relative_origin = prev
+
+    return ctx()
+
+
+def relative_time_nanos() -> int:
+    """Nanoseconds since the enclosing with_relative_time() began (process
+    start when none is active)."""
+    origin = _relative_origin
+    if origin is None:
+        origin = 0
+    return time.monotonic_ns() - origin
+
+
+def majority(n: int) -> int:
+    """Smallest majority of n nodes (util.clj:79-83)."""
+    return n // 2 + 1
+
+
+def name_plus(x: Any) -> str:
+    return x if isinstance(x, str) else str(x)
+
+
+def log_op(op: dict) -> None:
+    LOG.info(
+        "%s\t%s\t%s\t%s%s",
+        op.get("process"),
+        op.get("type"),
+        op.get("f"),
+        op.get("value"),
+        f"\t{op.get('error')}" if op.get("error") else "",
+    )
+
+
+def real_pmap(f: Callable, coll: Sequence) -> list:
+    """Parallel map over real threads; the first exception propagates after
+    all tasks settle (util.clj:60-73 semantics)."""
+    coll = list(coll)
+    if not coll:
+        return []
+    with concurrent.futures.ThreadPoolExecutor(max_workers=len(coll)) as ex:
+        futs = [ex.submit(f, x) for x in coll]
+        done = [f_.exception() for f_ in concurrent.futures.as_completed(futs)]
+    for exc in (f_.exception() for f_ in futs):
+        if exc is not None:
+            raise exc
+    return [f_.result() for f_ in futs]
+
+
+class TimeoutError_(Exception):
+    pass
+
+
+def timeout(seconds: float, f: Callable, *args, default=TimeoutError_):
+    """Run f with a timeout; returns default (or raises) on expiry
+    (util.clj:332 macro). The worker thread is left to finish in the
+    background — Python threads can't be safely killed."""
+    with concurrent.futures.ThreadPoolExecutor(max_workers=1) as ex:
+        fut = ex.submit(f, *args)
+        try:
+            return fut.result(timeout=seconds)
+        except concurrent.futures.TimeoutError:
+            fut.cancel()
+            if default is TimeoutError_:
+                raise TimeoutError_(f"timed out after {seconds}s") from None
+            return default
+
+
+def with_retry(tries: int, f: Callable, *args, delay_s: float = 0.0,
+               exceptions=(Exception,)):
+    """Retry f up to `tries` times (util.clj:360)."""
+    for attempt in range(tries):
+        try:
+            return f(*args)
+        except exceptions:
+            if attempt == tries - 1:
+                raise
+            if delay_s:
+                time.sleep(delay_s)
+
+
+def nanos_to_secs(ns: float) -> float:
+    return ns / 1e9
+
+
+def secs_to_nanos(s: float) -> int:
+    return int(s * 1e9)
+
+
+def integer_interval_set_str(xs: Iterable[int]) -> str:
+    """Compact '#{1-3 5}' rendering of an integer set (util.clj:549)."""
+    xs = sorted(set(xs))
+    if not xs:
+        return "#{}"
+    parts = []
+    lo = prev = xs[0]
+    for x in xs[1:]:
+        if x == prev + 1:
+            prev = x
+            continue
+        parts.append(f"{lo}" if lo == prev else f"{lo}-{prev}")
+        lo = prev = x
+    parts.append(f"{lo}" if lo == prev else f"{lo}-{prev}")
+    return "#{" + " ".join(parts) + "}"
+
+
+def history_to_latencies(history) -> list[tuple]:
+    """[(invoke-op, latency-nanos)] for completed client ops
+    (util.clj:620)."""
+    out = []
+    pending: dict = {}
+    for op in history:
+        if not getattr(op, "is_client", False):
+            continue
+        if op.is_invoke:
+            pending[op.process] = op
+        else:
+            inv = pending.pop(op.process, None)
+            if inv is not None and inv.time >= 0 and op.time >= 0:
+                out.append((inv, op.time - inv.time))
+    return out
+
+
+def nemesis_intervals(history, fs: Optional[dict] = None) -> list[tuple]:
+    """Pair nemesis start/stop ops into [start, stop] op intervals
+    (util.clj:656). ``fs`` maps start-f -> stop-f; default pairs :start
+    with :stop."""
+    fs = fs or {"start": "stop"}
+    stops = set(fs.values())
+    out = []
+    open_: dict = {}
+    for op in history:
+        if not getattr(op, "is_nemesis", False):
+            continue
+        f = op.f
+        if f in fs:
+            open_.setdefault(fs[f], []).append(op)
+        elif f in stops:
+            starts = open_.get(f)
+            if starts:
+                out.append((starts.pop(0), op))
+    for stop_f, starts in open_.items():
+        for s in starts:
+            out.append((s, None))
+    return out
