@@ -234,51 +234,76 @@ def _build_kernel(model_key, F: int, W: int, KO: int, S: int, ND: int, NO: int):
 
             acc_now = jnp.any(nvalid & (np_ >= nD))
 
-            # --- dedup + compact -------------------------------------------
-            # One sort on (validity, 64-bit FNV hash of the config row) with
-            # an iota payload; exact duplicate rows hash equal and land
-            # adjacent, so one neighbor compare marks them. A hash collision
-            # can only *miss* a dedup (soundness unaffected). Compaction is
-            # a cumsum/scatter, not a second sort.
+            # --- compact + dedup -------------------------------------------
+            # TPU-shaped: no scatters (XLA serializes colliding scatters on
+            # TPU) and no M-wide sort. (1) gather the valid candidates into a
+            # P = min(M, 8F) buffer via cumsum + searchsorted; >P survivors
+            # is treated as frontier overflow (lossless: the pre-expansion
+            # frontier is kept and the search resumes at a larger F).
+            # (2) sort the P buffer by a 64-bit FNV-style hash; exact
+            # duplicate rows hash equal and land adjacent, so one neighbor
+            # compare (on the full columns, so a collision can only *miss* a
+            # dedup — soundness unaffected) marks them. (3) gather the first
+            # F kept rows, again via cumsum + searchsorted.
             cols = [np_.astype(jnp.uint32)]
             cols += [nmD[:, w] for w in range(KD)]
             if KO:
                 cols += [nmO[:, w] for w in range(KO)]
             cols += [lax.bitcast_convert_type(st2[:, i], jnp.uint32) for i in range(S)]
-            h1 = jnp.full((M,), u32(2166136261))
-            h2 = jnp.full((M,), u32(0x9E3779B9))
-            for c in cols:
+
+            P = min(M, max(8 * F, 64))
+            posv = jnp.cumsum(nvalid.astype(jnp.int32))
+            n_cand = posv[M - 1]
+            pre_ovf = n_cand > P
+            vidx = jnp.searchsorted(
+                posv, jnp.arange(1, P + 1, dtype=jnp.int32), side="left"
+            )
+            vidx = jnp.minimum(vidx, M - 1)
+            pvalid = lax.iota(jnp.int32, P) < jnp.minimum(n_cand, P)
+            pcols = [c[vidx] for c in cols]
+
+            h1 = jnp.full((P,), u32(2166136261))
+            h2 = jnp.full((P,), u32(0x9E3779B9))
+            for c in pcols:
                 h1 = (h1 ^ c) * u32(16777619)
                 h2 = (h2 ^ (c + u32(0x85EBCA6B))) * u32(0xC2B2AE35)
-            key0 = (~nvalid).astype(jnp.uint32)
-            iota = lax.iota(jnp.int32, M)
+            key0 = (~pvalid).astype(jnp.uint32)
+            iota = lax.iota(jnp.int32, P)
             _, _, _, perm = lax.sort((key0, h1, h2, iota), dimension=0, num_keys=3)
-            gvalid = nvalid[perm]
-            gcols = [c[perm] for c in cols]
-            same = jnp.ones((M,), dtype=bool)
+            gvalid = pvalid[perm]
+            gcols = [c[perm] for c in pcols]
+            same = jnp.ones((P,), dtype=bool)
             for c in gcols:
                 same = same & jnp.concatenate([jnp.zeros((1,), bool), c[1:] == c[:-1]])
             prev_valid = jnp.concatenate([jnp.zeros((1,), bool), gvalid[:-1]])
             keep = gvalid & ~(same & prev_valid)
-            count = keep.sum()
-            ovf_now = count > F
+            pos = jnp.cumsum(keep.astype(jnp.int32))
+            count = pos[P - 1]
+            ovf_now = pre_ovf | (count > F)
 
-            pos = jnp.cumsum(keep.astype(jnp.int32)) - 1
-            tgt = jnp.where(keep, pos, F)  # F = out-of-range -> dropped
-            kp = jnp.zeros((F,), jnp.int32).at[tgt].set(gcols[0].astype(jnp.int32), mode="drop")
-            kmD = jnp.zeros((F, KD), jnp.uint32)
-            for w in range(KD):
-                kmD = kmD.at[tgt, w].set(gcols[1 + w], mode="drop")
-            kmO = jnp.zeros((F, max(KO, 1)), jnp.uint32)
-            for w in range(KO):
-                kmO = kmO.at[tgt, w].set(gcols[1 + KD + w], mode="drop")
-            kst = jnp.zeros((F, S), jnp.int32)
-            for i in range(S):
-                kst = kst.at[tgt, i].set(
-                    lax.bitcast_convert_type(gcols[1 + KD + KO + i], jnp.int32),
-                    mode="drop",
-                )
+            oidx = jnp.searchsorted(
+                pos, jnp.arange(1, F + 1, dtype=jnp.int32), side="left"
+            )
+            oidx = jnp.minimum(oidx, P - 1)
             kvalid = lax.iota(jnp.int32, F) < jnp.minimum(count, F)
+            kp = gcols[0][oidx].astype(jnp.int32) * kvalid
+            kmD = jnp.stack(
+                [gcols[1 + w][oidx] * kvalid for w in range(KD)], axis=1
+            )
+            if KO:
+                kmO = jnp.stack(
+                    [gcols[1 + KD + w][oidx] * kvalid for w in range(KO)], axis=1
+                )
+            else:
+                kmO = jnp.zeros((F, 1), jnp.uint32)
+            kst = jnp.stack(
+                [
+                    lax.bitcast_convert_type(gcols[1 + KD + KO + i][oidx], jnp.int32)
+                    * kvalid
+                    for i in range(S)
+                ],
+                axis=1,
+            )
 
             # On overflow keep the pre-expansion frontier intact so the
             # search can resume losslessly at a larger capacity.
@@ -289,7 +314,7 @@ def _build_kernel(model_key, F: int, W: int, KO: int, S: int, ND: int, NO: int):
                 sel(kmO, mO),
                 sel(kst, st),
                 sel(kvalid, valid),
-                jnp.where(ovf_now, lvl, lvl + 1),
+                jnp.where(ovf_now | (count == 0), lvl, lvl + 1),
                 acc | acc_now,
                 ovf | ovf_now,
                 jnp.maximum(fmax, jnp.minimum(count, F).astype(jnp.int32)),
@@ -470,10 +495,17 @@ def check_encoded_device(
     f_schedule=F_SCHEDULE,
     max_open: int = 128,
     window_cap: int = 1024,
+    levels_per_call: int = 512,
 ) -> dict:
     """Decide linearizability of an encoded history on the default JAX
     backend (TPU when present). Result map mirrors the host oracle
-    (`wgl_host.check_encoded`) plus device diagnostics."""
+    (`wgl_host.check_encoded`) plus device diagnostics.
+
+    The BFS is chunked: each device call runs at most ``levels_per_call``
+    levels (the kernel's ``max_levels`` argument is dynamic, so chunking
+    costs no recompiles), then the host resumes from the returned frontier.
+    Bounding single-program runtime keeps the TPU runtime's watchdog happy
+    on long histories and gives the host a progress heartbeat."""
     t0 = _time.perf_counter()
     n = enc.n
     plan = plan_device(enc, max_open=max_open, window_cap=window_cap)
@@ -484,40 +516,52 @@ def check_encoded_device(
         info = plan.reason or "empty frontier-capacity schedule"
         return {"valid": "unknown", "op_count": n, "device": True, "info": info}
     W, KO, S, ND, NO = plan.dims
+    total_levels = int(plan.args[2])
 
     mk = _model_cache_key(enc.model)
     attempts = []
+    fmax_all = 1
     fr = initial_frontier(f_schedule[0], W, KO, S, plan.init_state)
+
+    def result(valid, lvl, **extra):
+        r = {
+            "valid": valid,
+            "op_count": n,
+            "device": True,
+            "levels": int(lvl),
+            "frontier_max": fmax_all,
+            "window": W,
+            "attempts": attempts,
+            "wall_s": _time.perf_counter() - t0,
+        }
+        r.update(extra)
+        return r
+
     for F in f_schedule:
         _, kern = _build_kernel(mk, F, W, KO, S, ND, NO)
         fr = _pad_frontier(fr, F)
-        out = [np.asarray(x) for x in kern(*plan.args, *fr)]
-        acc, ovf, nonempty, lvl, fmax = out[:5]
-        fr = tuple(out[5:]) + (lvl,)  # resume point for the next capacity
-        attempts.append({"F": F, "levels": int(lvl), "frontier_max": int(fmax)})
-        if bool(acc):
-            return {
-                "valid": True,
-                "op_count": n,
-                "device": True,
-                "levels": int(lvl),
-                "frontier_max": int(fmax),
-                "window": W,
-                "attempts": attempts,
-                "wall_s": _time.perf_counter() - t0,
-            }
-        if not bool(ovf):
-            return {
-                "valid": False,
-                "op_count": n,
-                "device": True,
-                "levels": int(lvl),
-                "max_linearized": int(lvl),
-                "frontier_max": int(fmax),
-                "window": W,
-                "attempts": attempts,
-                "wall_s": _time.perf_counter() - t0,
-            }
+        attempt = {"F": F, "levels": 0, "calls": 0}
+        attempts.append(attempt)
+        while True:
+            lvl0 = int(fr[-1])
+            budget = np.int32(min(total_levels, lvl0 + levels_per_call))
+            call_args = plan.args[:2] + (budget,) + plan.args[3:]
+            out = [np.asarray(x) for x in kern(*call_args, *fr)]
+            acc, ovf, nonempty, lvl, fmax = out[:5]
+            fr = tuple(out[5:]) + (lvl,)  # resume point (next chunk or next F)
+            fmax_all = max(fmax_all, int(fmax))
+            attempt["levels"] = int(lvl)
+            attempt["calls"] += 1
+            if bool(acc):
+                return result(True, lvl)
+            if bool(ovf):
+                break  # escalate frontier capacity, resuming from `fr`
+            if not bool(nonempty):
+                return result(False, lvl, max_linearized=int(lvl))
+            if int(lvl) >= total_levels:
+                return result(
+                    "unknown", lvl, info="level budget exhausted without verdict"
+                )
     return {
         "valid": "unknown",
         "op_count": n,
@@ -549,7 +593,15 @@ def check_history(
     from . import wgl_host
 
     if backend == "host" or not model.device_capable:
-        return wgl_host.check_history_host(model, history, max_configs=host_max_configs)
+        res = wgl_host.check_history_host(model, history, max_configs=host_max_configs)
+        if backend == "device":
+            # An explicit device request can't be honored for this model;
+            # say so rather than silently running on host (ADVICE r1) —
+            # without clobbering the host oracle's own diagnostics.
+            res["backend"] = "host"
+            note = f"model {model.name} is not device-capable; ran on host oracle"
+            res["info"] = f"{res['info']}; {note}" if res.get("info") else note
+        return res
     enc = encode_history(model, history)
     res = check_encoded_device(enc, **kw)
     if backend == "auto" and res["valid"] == "unknown":

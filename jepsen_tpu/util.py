@@ -68,18 +68,22 @@ def log_op(op: dict) -> None:
 
 
 def real_pmap(f: Callable, coll: Sequence) -> list:
-    """Parallel map over real threads; the first exception propagates after
-    all tasks settle (util.clj:60-73 semantics)."""
+    """Parallel map over real threads; the first exception *thrown* is
+    re-raised promptly, without waiting for slower tasks
+    (util.clj:60-73 semantics)."""
     coll = list(coll)
     if not coll:
         return []
-    with concurrent.futures.ThreadPoolExecutor(max_workers=len(coll)) as ex:
+    ex = concurrent.futures.ThreadPoolExecutor(max_workers=len(coll))
+    try:
         futs = [ex.submit(f, x) for x in coll]
-        done = [f_.exception() for f_ in concurrent.futures.as_completed(futs)]
-    for exc in (f_.exception() for f_ in futs):
-        if exc is not None:
-            raise exc
-    return [f_.result() for f_ in futs]
+        for fut in concurrent.futures.as_completed(futs):
+            exc = fut.exception()
+            if exc is not None:
+                raise exc
+        return [fut.result() for fut in futs]
+    finally:
+        ex.shutdown(wait=False, cancel_futures=True)
 
 
 class TimeoutError_(Exception):
@@ -87,18 +91,22 @@ class TimeoutError_(Exception):
 
 
 def timeout(seconds: float, f: Callable, *args, default=TimeoutError_):
-    """Run f with a timeout; returns default (or raises) on expiry
+    """Run f with a timeout; returns default (or raises) *at* the deadline
     (util.clj:332 macro). The worker thread is left to finish in the
-    background — Python threads can't be safely killed."""
-    with concurrent.futures.ThreadPoolExecutor(max_workers=1) as ex:
-        fut = ex.submit(f, *args)
-        try:
-            return fut.result(timeout=seconds)
-        except concurrent.futures.TimeoutError:
-            fut.cancel()
-            if default is TimeoutError_:
-                raise TimeoutError_(f"timed out after {seconds}s") from None
-            return default
+    background — Python threads can't be safely killed — so the executor is
+    shut down without waiting (ADVICE r1: a `with` block here would block
+    until f finished, defeating the timeout)."""
+    ex = concurrent.futures.ThreadPoolExecutor(max_workers=1)
+    fut = ex.submit(f, *args)
+    try:
+        return fut.result(timeout=seconds)
+    except concurrent.futures.TimeoutError:
+        fut.cancel()
+        if default is TimeoutError_:
+            raise TimeoutError_(f"timed out after {seconds}s") from None
+        return default
+    finally:
+        ex.shutdown(wait=False)
 
 
 def with_retry(tries: int, f: Callable, *args, delay_s: float = 0.0,

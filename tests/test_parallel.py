@@ -39,6 +39,46 @@ def test_batch_on_mesh():
     assert [g["valid"] for g in got] == [w["valid"] for w in want]
 
 
+def test_batch_replay_100_histories_sharded():
+    """BASELINE config 5 shape: ~100 archived histories replayed as one
+    sharded device batch, results differentially checked per history."""
+    import jax
+    import numpy as np
+
+    from jepsen_tpu.ops import wgl
+    from jepsen_tpu.ops.encode import encode_history
+    from jepsen_tpu.parallel import batch as pbatch
+
+    rng = random.Random(31)
+    model = CasRegister(init=0)
+    hists = []
+    for i in range(100):
+        h = random_register_history(rng, n_ops=14, n_procs=3, crash_p=0.05)
+        if i % 5 == 4:
+            h = perturb_history(rng, h)
+        hists.append(h)
+    mesh = make_mesh(len(jax.devices()), shape=(len(jax.devices()), 1))
+    got = check_batch(model, hists, f=64, mesh=mesh)
+    want = [wgl_host.check_history_host(model, h) for h in hists]
+    assert [g["valid"] for g in got] == [w["valid"] for w in want]
+
+    # Per-device placement: the stacked batch axis must actually shard
+    # across the mesh's dp axis (one shard per device, B/dp rows each).
+    encs = [encode_history(model, h) for h in hists[:16]]
+    plans = [wgl.plan_device(e) for e in encs]
+    dims = np.array([p.dims for p in plans])
+    W, KO, ND, NO = (int(dims[:, 0].max()), int(dims[:, 1].max()),
+                     int(dims[:, 3].max()), int(dims[:, 4].max()))
+    S = int(dims[0, 2])
+    padded = [wgl.plan_device(e, pad_to=(W, KO, ND, NO)) for e in encs]
+    stacked = pbatch._stack(padded, 64, (W, KO, S, ND, NO), mesh, "dp")
+    arr = stacked[3]  # a representative per-history device array
+    n_dev = len(mesh.devices.flatten())
+    assert len(arr.sharding.device_set) == n_dev
+    shard_rows = {s.index[0].start for s in arr.addressable_shards}
+    assert len(shard_rows) == n_dev  # distinct batch slices per device
+
+
 def test_batch_escalation():
     rng = random.Random(23)
     model = CasRegister(init=0)
