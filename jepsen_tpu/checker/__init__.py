@@ -1,0 +1,257 @@
+"""Checker protocol layer.
+
+The reference seam this mirrors: ``Checker.check(test, history, opts)``
+(jepsen/src/jepsen/checker.clj:49-64), the valid-merge priority lattice
+true < :unknown < false (checker.clj:26-47), ``check-safe`` (:71-82),
+``compose`` (:84-96) and ``concurrency-limit`` (:98-113). The
+``linearizable`` checker dispatches through the ``:checker-backend`` option
+onto the TPU WGL kernel (jepsen_tpu.ops.wgl) — the BASELINE dispatch story —
+with the host oracle as fallback.
+
+Result maps use the key ``"valid"`` with values True / False / "unknown"
+(the EDN writers render it as ``:valid?``).
+"""
+
+from __future__ import annotations
+
+import threading
+import traceback
+from typing import Any, Callable, Optional
+
+from ..history import History
+from ..util import LOG, real_pmap
+
+# Priority lattice: larger dominates when composing (checker.clj:26-31).
+_VALID_PRIORITY = {True: 0, "unknown": 0.5, False: 1}
+
+
+class Checker:
+    """Base checker. Subclasses (or `checker_fn` wrappers) implement
+    :meth:`check`."""
+
+    def check(self, test: dict, history: History, opts: Optional[dict] = None) -> dict:
+        raise NotImplementedError
+
+    def __call__(self, test, history, opts=None):
+        return self.check(test, history, opts)
+
+
+class _FnChecker(Checker):
+    __slots__ = ("fn", "_name")
+
+    def __init__(self, fn: Callable, name: str = "checker"):
+        self.fn = fn
+        self._name = name
+
+    def check(self, test, history, opts=None):
+        return self.fn(test, history, opts or {})
+
+    def __repr__(self):
+        return f"<checker {self._name}>"
+
+
+def checker_fn(fn: Callable, name: Optional[str] = None) -> Checker:
+    """Lift ``fn(test, history, opts) -> result-map`` into a Checker."""
+    return _FnChecker(fn, name or getattr(fn, "__name__", "checker"))
+
+
+def merge_valid(valids) -> Any:
+    """Merge valid values; highest priority (worst) wins
+    (checker.clj:33-47)."""
+    out = True
+    for v in valids:
+        if v not in _VALID_PRIORITY:
+            raise ValueError(f"{v!r} is not a known valid value")
+        if _VALID_PRIORITY[v] > _VALID_PRIORITY[out]:
+            out = v
+    return out
+
+
+def noop() -> Checker:
+    """Returns None from check (checker.clj:65-69)."""
+    return checker_fn(lambda test, history, opts: None, "noop")
+
+
+def unbridled_optimism() -> Checker:
+    """Everything is awesoooommmmme! (checker.clj:115-119)"""
+    return checker_fn(lambda test, history, opts: {"valid": True}, "unbridled-optimism")
+
+
+def check_safe(checker: Checker, test: dict, history: History,
+               opts: Optional[dict] = None) -> dict:
+    """Like check, but exceptions become {"valid": "unknown", "error": ...}
+    (checker.clj:71-82)."""
+    try:
+        return checker.check(test, history, opts or {})
+    except Exception:
+        LOG.warning("Error while checking history:", exc_info=True)
+        return {"valid": "unknown", "error": traceback.format_exc()}
+
+
+class _Compose(Checker):
+    def __init__(self, checker_map: dict):
+        self.checker_map = dict(checker_map)
+
+    def check(self, test, history, opts=None):
+        items = list(self.checker_map.items())
+        results = real_pmap(
+            lambda kv: (kv[0], check_safe(kv[1], test, history, opts)), items
+        )
+        out = dict(results)
+        out["valid"] = merge_valid(
+            r.get("valid") for _, r in results if r is not None
+        )
+        return out
+
+
+def compose(checker_map: dict) -> Checker:
+    """Map of names -> checkers; runs each (in parallel) and merges valid
+    (checker.clj:84-96)."""
+    return _Compose(checker_map)
+
+
+class _ConcurrencyLimit(Checker):
+    def __init__(self, limit: int, checker: Checker):
+        self.sem = threading.Semaphore(limit)
+        self.checker = checker
+
+    def check(self, test, history, opts=None):
+        with self.sem:
+            return self.checker.check(test, history, opts)
+
+
+def concurrency_limit(limit: int, checker: Checker) -> Checker:
+    """Bound concurrent executions of a memory-hungry checker
+    (checker.clj:98-113)."""
+    return _ConcurrencyLimit(limit, checker)
+
+
+# ---------------------------------------------------------------------------
+# History statistics + exception surfacing (checker.clj:120-180)
+
+
+def unhandled_exceptions() -> Checker:
+    """Surface client exceptions recorded on :info ops, grouped by class,
+    most frequent first (checker.clj:120-147)."""
+
+    def chk(test, history, opts):
+        groups: dict[Any, list] = {}
+        for op in history:
+            exc = op.get("exception")
+            if exc is None or not op.is_info:
+                continue
+            cls = exc.get("type") if isinstance(exc, dict) else type(exc).__name__
+            groups.setdefault(cls, []).append(op)
+        exes = [
+            {"count": len(ops), "class": cls, "example": ops[0]}
+            for cls, ops in sorted(
+                groups.items(), key=lambda kv: len(kv[1]), reverse=True
+            )
+        ]
+        return {"valid": True, "exceptions": exes} if exes else {"valid": True}
+
+    return checker_fn(chk, "unhandled-exceptions")
+
+
+def _stats_counts(ops) -> dict:
+    ok = sum(1 for op in ops if op.is_ok)
+    fail = sum(1 for op in ops if op.is_fail)
+    info = sum(1 for op in ops if op.is_info)
+    return {
+        "valid": ok > 0,
+        "count": ok + fail + info,
+        "ok_count": ok,
+        "fail_count": fail,
+        "info_count": info,
+    }
+
+
+def stats() -> Checker:
+    """Success/failure rates, overall and by :f; valid iff every :f has some
+    ok ops (checker.clj:149-179)."""
+
+    def chk(test, history, opts):
+        ops = [op for op in history if not op.is_invoke and op.is_client]
+        by_f: dict[Any, list] = {}
+        for op in ops:
+            by_f.setdefault(op.f, []).append(op)
+        groups = {f: _stats_counts(sub) for f, sub in sorted(by_f.items(), key=lambda kv: str(kv[0]))}
+        out = _stats_counts(ops)
+        out["by_f"] = groups
+        out["valid"] = merge_valid(g["valid"] for g in groups.values())
+        return out
+
+    return checker_fn(chk, "stats")
+
+
+# ---------------------------------------------------------------------------
+# Linearizability — the TPU-kernel seam (checker.clj:182-213)
+
+
+def linearizable(options: Optional[dict] = None, **kw) -> Checker:
+    """Validate linearizability on the WGL kernel.
+
+    ``options`` / kwargs:
+
+    - ``model``: a `jepsen_tpu.models.Model` (required).
+    - ``backend``: "auto" (default) | "device" | "host" — overridden by the
+      test map's ``checker_backend`` when present (the BASELINE
+      ``:checker-backend :tpu`` dispatch; "tpu" is accepted as an alias for
+      "device").
+
+    Mirrors checker.clj:182-213 (including truncating bulky diagnostics).
+    """
+    o = dict(options or {})
+    o.update(kw)
+    model = o.get("model")
+    if model is None:
+        raise ValueError(
+            f"the linearizable checker requires a model; received {model!r}"
+        )
+    default_backend = o.get("backend", "auto")
+
+    def chk(test, history, opts):
+        from ..ops import wgl
+
+        backend = (test or {}).get("checker_backend", default_backend)
+        if backend == "tpu":
+            backend = "device"
+        res = wgl.check_history(model, history.client_ops(), backend=backend)
+        # Writing full search diagnostics "can take hours" in the reference
+        # (checker.clj:210-213); keep attempts bounded likewise.
+        if isinstance(res.get("attempts"), list):
+            res["attempts"] = res["attempts"][:10]
+        return res
+
+    return checker_fn(chk, "linearizable")
+
+
+# Invariant checkers live in their own module; re-export the public set.
+from .invariants import (  # noqa: E402
+    counter,
+    queue,
+    set_checker,
+    set_full,
+    total_queue,
+    unique_ids,
+)
+
+__all__ = [
+    "Checker",
+    "checker_fn",
+    "check_safe",
+    "compose",
+    "concurrency_limit",
+    "counter",
+    "linearizable",
+    "merge_valid",
+    "noop",
+    "queue",
+    "set_checker",
+    "set_full",
+    "stats",
+    "total_queue",
+    "unbridled_optimism",
+    "unhandled_exceptions",
+    "unique_ids",
+]

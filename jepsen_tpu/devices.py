@@ -1,0 +1,46 @@
+"""JAX platform/device pinning helpers.
+
+One shared implementation of the "force a CPU platform with N virtual
+devices" recipe used by both the test harness (tests/conftest.py) and the
+driver entry (__graft_entry__.dryrun_multichip) — the multi-chip sharding
+paths run on a virtual CPU mesh when TPU hardware isn't attached.
+
+Must run before any JAX backend initializes. The image's sitecustomize
+registers an `axon` TPU-relay PJRT backend in every process and pins
+JAX_PLATFORMS=axon; when the relay is wedged the first jax op hangs
+forever, so CPU-only work must drop the non-CPU factories in-process, not
+just set env vars.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+
+def force_cpu_devices(n_devices: int = 8) -> None:
+    """Pin this process to a CPU platform with ``n_devices`` virtual XLA
+    devices, replacing any conflicting device-count flag. Safe to call
+    repeatedly; rebuilds the backend if one already initialized with fewer
+    devices."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "", flags)
+    want = f"--xla_force_host_platform_device_count={n_devices}"
+    os.environ["XLA_FLAGS"] = (flags + " " + want).strip()
+
+    import jax
+    import jax._src.xla_bridge as _xb
+
+    _xb._backend_factories.pop("axon", None)
+    _xb._backend_factories.pop("tpu", None)
+    jax.config.update("jax_platforms", "cpu")
+    if _xb._backends:
+        try:
+            n = len(jax.devices())
+        except Exception:
+            n = 0
+        if n < n_devices:
+            from jax.extend.backend import clear_backends
+
+            clear_backends()

@@ -76,7 +76,8 @@ def _next_pow2(x: int, lo: int = 32) -> int:
 
 
 @functools.lru_cache(maxsize=64)
-def _build_kernel(model_key, F: int, W: int, KO: int, S: int, ND: int, NO: int):
+def _build_kernel(model_key, F: int, W: int, KO: int, S: int, ND: int, NO: int,
+                  full_dedup: bool = False):
     """Returns a jitted BFS driver with static shapes.
 
     model_key = (model-class, cache signature) — step_jax must be a pure
@@ -251,7 +252,11 @@ def _build_kernel(model_key, F: int, W: int, KO: int, S: int, ND: int, NO: int):
                 cols += [nmO[:, w] for w in range(KO)]
             cols += [lax.bitcast_convert_type(st2[:, i], jnp.uint32) for i in range(S)]
 
-            P = min(M, max(8 * F, 64))
+            # At the terminal escalation capacity (full_dedup), dedup over
+            # the whole expansion so heavy duplication can't force a
+            # spurious "unknown"; below it, the 8F buffer is cheaper and
+            # overflow escalates losslessly.
+            P = M if full_dedup else min(M, max(8 * F, 64))
             posv = jnp.cumsum(nvalid.astype(jnp.int32))
             n_cand = posv[M - 1]
             pre_ovf = n_cand > P
@@ -538,7 +543,9 @@ def check_encoded_device(
         return r
 
     for F in f_schedule:
-        _, kern = _build_kernel(mk, F, W, KO, S, ND, NO)
+        _, kern = _build_kernel(
+            mk, F, W, KO, S, ND, NO, full_dedup=(F == f_schedule[-1])
+        )
         fr = _pad_frontier(fr, F)
         attempt = {"F": F, "levels": 0, "calls": 0}
         attempts.append(attempt)

@@ -6,7 +6,6 @@ test clock (util.clj:291-309), crash-propagating parallel map
 
 from __future__ import annotations
 
-import concurrent.futures
 import logging
 import threading
 import time
@@ -67,23 +66,56 @@ def log_op(op: dict) -> None:
     )
 
 
+def _daemon_call(f: Callable, args: tuple) -> tuple[threading.Thread, list]:
+    """Run f(*args) on a daemon thread; returns (thread, cell) where cell
+    fills with ("ok", result) or ("error", exc). Daemon threads can be
+    abandoned on timeout without blocking interpreter exit (a non-daemon
+    executor worker would be joined by concurrent.futures' atexit hook)."""
+    cell: list = []
+
+    def run():
+        try:
+            cell.append(("ok", f(*args)))
+        except BaseException as e:  # noqa: BLE001 - propagated to caller
+            cell.append(("error", e))
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    return t, cell
+
+
 def real_pmap(f: Callable, coll: Sequence) -> list:
-    """Parallel map over real threads; the first exception *thrown* is
-    re-raised promptly, without waiting for slower tasks
+    """Parallel map over real (daemon) threads; the first exception
+    *thrown* is re-raised promptly, without waiting for slower tasks
     (util.clj:60-73 semantics)."""
     coll = list(coll)
     if not coll:
         return []
-    ex = concurrent.futures.ThreadPoolExecutor(max_workers=len(coll))
-    try:
-        futs = [ex.submit(f, x) for x in coll]
-        for fut in concurrent.futures.as_completed(futs):
-            exc = fut.exception()
-            if exc is not None:
-                raise exc
-        return [fut.result() for fut in futs]
-    finally:
-        ex.shutdown(wait=False, cancel_futures=True)
+    done = threading.Semaphore(0)
+
+    def wrap(x):
+        def call():
+            try:
+                return f(x)
+            finally:
+                done.release()
+
+        return call
+
+    tasks = [_daemon_call(wrap(x), ()) for x in coll]
+    for _ in coll:
+        done.acquire()
+        for _t, cell in tasks:
+            if cell and cell[0][0] == "error":
+                raise cell[0][1]
+    out = []
+    for t, cell in tasks:
+        t.join()
+        status, value = cell[0]
+        if status == "error":
+            raise value
+        out.append(value)
+    return out
 
 
 class TimeoutError_(Exception):
@@ -92,21 +124,21 @@ class TimeoutError_(Exception):
 
 def timeout(seconds: float, f: Callable, *args, default=TimeoutError_):
     """Run f with a timeout; returns default (or raises) *at* the deadline
-    (util.clj:332 macro). The worker thread is left to finish in the
-    background — Python threads can't be safely killed — so the executor is
-    shut down without waiting (ADVICE r1: a `with` block here would block
-    until f finished, defeating the timeout)."""
-    ex = concurrent.futures.ThreadPoolExecutor(max_workers=1)
-    fut = ex.submit(f, *args)
-    try:
-        return fut.result(timeout=seconds)
-    except concurrent.futures.TimeoutError:
-        fut.cancel()
-        if default is TimeoutError_:
-            raise TimeoutError_(f"timed out after {seconds}s") from None
-        return default
-    finally:
-        ex.shutdown(wait=False)
+    (util.clj:332 macro). The worker is a daemon thread left to finish in
+    the background — Python threads can't be safely killed, and a
+    non-daemon worker would block interpreter exit (ADVICE r1 + r2 review:
+    both the old `with`-block and ThreadPoolExecutor's atexit join defeat
+    the timeout)."""
+    t, cell = _daemon_call(f, args)
+    t.join(timeout=seconds)
+    if cell:
+        status, value = cell[0]
+        if status == "error":
+            raise value
+        return value
+    if default is TimeoutError_:
+        raise TimeoutError_(f"timed out after {seconds}s") from None
+    return default
 
 
 def with_retry(tries: int, f: Callable, *args, delay_s: float = 0.0,

@@ -7,26 +7,18 @@ on the real chip separately.
 The image's sitecustomize registers an `axon` TPU-relay PJRT backend in
 every python process and pins JAX_PLATFORMS=axon; when the relay is wedged
 the first jax op hangs forever. Tests must never depend on TPU-relay
-health, so before any backend initializes (conftest runs first) we drop the
-non-CPU backend factories and repin the platform to cpu, in-process.
+health, so before any backend initializes (conftest runs first) we repin
+the platform to CPU in-process via the shared helper.
 """
 
 import os
 import sys
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 try:  # jax is preloaded by sitecustomize; backends are still uninitialized
-    import jax
-    import jax._src.xla_bridge as _xb
+    from jepsen_tpu.devices import force_cpu_devices
 
-    _xb._backend_factories.pop("axon", None)
-    _xb._backend_factories.pop("tpu", None)
-    jax.config.update("jax_platforms", "cpu")
+    force_cpu_devices(8)
 except Exception:  # pragma: no cover - jax-less environments
     pass
-
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,516 @@
+"""Checker-layer tests, ported from the reference's
+jepsen/test/jepsen/checker_test.clj (the assertions are the spec being
+matched; see SURVEY.md §4)."""
+
+import pytest
+
+from jepsen_tpu import checker as C
+from jepsen_tpu.history import History, Op
+from jepsen_tpu.models import FIFOQueue, UnorderedQueue
+
+
+def h(rows, time_step=1_000_000):
+    """History from (type, process, f, value[, extra]) rows; time = index *
+    1 ms, matching the knossos `history` indexing the reference tests use."""
+    ops = []
+    for i, row in enumerate(rows):
+        typ, proc, f, value = row[:4]
+        extra = row[4] if len(row) > 4 else {}
+        ops.append(
+            Op(typ, proc, f, value, time=i * time_step,
+               extra=tuple(sorted(extra.items(), key=repr)))
+        )
+    return History(ops)
+
+
+def inv(p, f, v):
+    return ("invoke", p, f, v)
+
+
+def ok(p, f, v):
+    return ("ok", p, f, v)
+
+
+def fail(p, f, v):
+    return ("fail", p, f, v)
+
+
+# -- lattice / compose (checker.clj:26-96) -----------------------------------
+
+
+def test_merge_valid():
+    assert C.merge_valid([]) is True
+    assert C.merge_valid([True, True]) is True
+    assert C.merge_valid([True, "unknown"]) == "unknown"
+    assert C.merge_valid([True, "unknown", False]) is False
+    with pytest.raises(ValueError):
+        C.merge_valid([None])
+
+
+def test_compose():
+    res = C.compose(
+        {"a": C.unbridled_optimism(), "b": C.unbridled_optimism()}
+    ).check({}, h([]), {})
+    assert res == {"a": {"valid": True}, "b": {"valid": True}, "valid": True}
+
+
+def test_compose_merges_worst():
+    bad = C.checker_fn(lambda t, hi, o: {"valid": False}, "bad")
+    res = C.compose({"a": C.unbridled_optimism(), "b": bad}).check({}, h([]), {})
+    assert res["valid"] is False
+
+
+def test_check_safe_wraps_exceptions():
+    def boom(t, hi, o):
+        raise RuntimeError("kaboom")
+
+    res = C.check_safe(C.checker_fn(boom), {}, h([]))
+    assert res["valid"] == "unknown"
+    assert "kaboom" in res["error"]
+
+
+def test_noop_returns_none():
+    assert C.noop().check({}, h([]), {}) is None
+
+
+# -- unhandled-exceptions (checker_test.clj:14-39) ---------------------------
+
+
+def test_unhandled_exceptions():
+    e1 = {"type": "IllegalArgumentException", "message": "bad args"}
+    e2 = {"type": "IllegalArgumentException", "message": "bad args 2"}
+    e3 = {"type": "IllegalStateException", "message": "bad state"}
+    res = C.unhandled_exceptions().check(
+        {},
+        h(
+            [
+                inv(0, "foo", 1),
+                ("info", 0, "foo", 1, {"exception": e1, "error": ["Whoops!"]}),
+                inv(0, "foo", 1),
+                ("info", 0, "foo", 1, {"exception": e2, "error": ["Whoops!", 2]}),
+                inv(0, "foo", 1),
+                ("info", 0, "foo", 1, {"exception": e3, "error": "oh-no"}),
+            ]
+        ),
+        {},
+    )
+    assert res["valid"] is True
+    assert [
+        (x["class"], x["count"]) for x in res["exceptions"]
+    ] == [("IllegalArgumentException", 2), ("IllegalStateException", 1)]
+
+
+# -- stats (checker_test.clj:41-63) ------------------------------------------
+
+
+def test_stats():
+    res = C.stats().check(
+        {},
+        h(
+            [
+                ok(0, "foo", None),
+                fail(0, "foo", None),
+                ("info", 0, "bar", None),
+                fail(0, "bar", None),
+                fail(0, "bar", None),
+            ]
+        ),
+        {},
+    )
+    assert res["valid"] is False
+    assert res["count"] == 5
+    assert (res["ok_count"], res["fail_count"], res["info_count"]) == (1, 3, 1)
+    assert res["by_f"]["foo"] == {
+        "valid": True, "count": 2, "ok_count": 1, "fail_count": 1, "info_count": 0,
+    }
+    assert res["by_f"]["bar"]["valid"] is False
+
+
+# -- queue (checker_test.clj:65-85) ------------------------------------------
+
+
+def test_queue_empty():
+    assert C.queue(UnorderedQueue()).check({}, h([]), {})["valid"] is True
+
+
+def test_queue_possible_enqueue_no_dequeue():
+    res = C.queue(UnorderedQueue()).check({}, h([inv(1, "enqueue", 1)]), {})
+    assert res["valid"] is True
+
+
+def test_queue_definite_enqueue_no_dequeue():
+    res = C.queue(UnorderedQueue()).check(
+        {}, h([inv(1, "enqueue", 1), ok(1, "enqueue", 1)]), {}
+    )
+    assert res["valid"] is True
+
+
+def test_queue_concurrent_enqueue_dequeue():
+    res = C.queue(UnorderedQueue()).check(
+        {},
+        h([inv(2, "dequeue", None), inv(1, "enqueue", 1), ok(2, "dequeue", 1)]),
+        {},
+    )
+    assert res["valid"] is True
+
+
+def test_queue_dequeue_but_no_enqueue():
+    res = C.queue(UnorderedQueue()).check(
+        {}, h([inv(1, "dequeue", None), ok(1, "dequeue", 1)]), {}
+    )
+    assert res["valid"] is False
+
+
+def test_queue_fifo_order():
+    res = C.queue(FIFOQueue()).check(
+        {},
+        h(
+            [
+                inv(1, "enqueue", 1), ok(1, "enqueue", 1),
+                inv(1, "enqueue", 2), ok(1, "enqueue", 2),
+                inv(1, "dequeue", None), ok(1, "dequeue", 2),
+            ]
+        ),
+        {},
+    )
+    assert res["valid"] is False  # 1 must come out first
+
+
+# -- total-queue (checker_test.clj:87-140) -----------------------------------
+
+
+def test_total_queue_sane():
+    res = C.total_queue().check(
+        {},
+        h(
+            [
+                inv(1, "enqueue", 1),
+                inv(2, "enqueue", 2),
+                ok(2, "enqueue", 2),
+                inv(3, "dequeue", None), ok(3, "dequeue", 1),
+                inv(3, "dequeue", None), ok(3, "dequeue", 2),
+            ]
+        ),
+        {},
+    )
+    assert res["valid"] is True
+    assert res["attempt_count"] == 2
+    assert res["acknowledged_count"] == 1
+    assert res["ok_count"] == 2
+    assert res["recovered_count"] == 1
+    assert res["lost"] == {} and res["unexpected"] == {}
+
+
+def test_total_queue_pathological():
+    res = C.total_queue().check(
+        {},
+        h(
+            [
+                inv(1, "enqueue", "hung"),
+                inv(2, "enqueue", "enqueued"), ok(2, "enqueue", "enqueued"),
+                inv(3, "enqueue", "dup"), ok(3, "enqueue", "dup"),
+                inv(4, "dequeue", None),  # nope
+                inv(5, "dequeue", None), ok(5, "dequeue", "wtf"),
+                inv(6, "dequeue", None), ok(6, "dequeue", "dup"),
+                inv(7, "dequeue", None), ok(7, "dequeue", "dup"),
+            ]
+        ),
+        {},
+    )
+    assert res["valid"] is False
+    assert res["lost"] == {"enqueued": 1}
+    assert res["unexpected"] == {"wtf": 1}
+    assert res["duplicated"] == {"dup": 1}
+    assert res["recovered_count"] == 0
+    assert (res["attempt_count"], res["acknowledged_count"], res["ok_count"]) == (3, 2, 1)
+
+
+def test_total_queue_drain_expansion():
+    res = C.total_queue().check(
+        {},
+        h(
+            [
+                inv(1, "enqueue", 1), ok(1, "enqueue", 1),
+                inv(1, "enqueue", 2), ok(1, "enqueue", 2),
+                inv(2, "drain", None), ok(2, "drain", [1, 2]),
+            ]
+        ),
+        {},
+    )
+    assert res["valid"] is True
+    assert res["ok_count"] == 2
+
+
+# -- counter (checker_test.clj:142-218) --------------------------------------
+
+
+def test_counter_empty():
+    res = C.counter().check({}, h([]), {})
+    assert res == {"valid": True, "reads": [], "errors": []}
+
+
+def test_counter_initial_read():
+    res = C.counter().check(
+        {}, h([inv(0, "read", None), ok(0, "read", 0)]), {}
+    )
+    assert res == {"valid": True, "reads": [[0, 0, 0]], "errors": []}
+
+
+def test_counter_ignores_failed_ops():
+    res = C.counter().check(
+        {},
+        h([inv(0, "add", 1), fail(0, "add", 1), inv(0, "read", None), ok(0, "read", 0)]),
+        {},
+    )
+    assert res == {"valid": True, "reads": [[0, 0, 0]], "errors": []}
+
+
+def test_counter_initial_invalid_read():
+    res = C.counter().check(
+        {}, h([inv(0, "read", None), ok(0, "read", 1)]), {}
+    )
+    assert res["valid"] is False
+    assert res["errors"] == [[0, 1, 0]]
+
+
+def test_counter_interleaved():
+    res = C.counter().check(
+        {},
+        h(
+            [
+                inv(0, "read", None),
+                inv(1, "add", 1),
+                inv(2, "read", None),
+                inv(3, "add", 2),
+                inv(4, "read", None),
+                inv(5, "add", 4),
+                inv(6, "read", None),
+                inv(7, "add", 8),
+                inv(8, "read", None),
+                ok(0, "read", 6),
+                ok(1, "add", 1),
+                ok(2, "read", 0),
+                ok(3, "add", 2),
+                ok(4, "read", 3),
+                ok(5, "add", 4),
+                ok(6, "read", 100),
+                ok(7, "add", 8),
+                ok(8, "read", 15),
+            ]
+        ),
+        {},
+    )
+    assert res["valid"] is False
+    assert res["reads"] == [[0, 6, 15], [0, 0, 15], [0, 3, 15], [0, 100, 15], [0, 15, 15]]
+    assert res["errors"] == [[0, 100, 15]]
+
+
+def test_counter_rolling():
+    res = C.counter().check(
+        {},
+        h(
+            [
+                inv(0, "read", None),
+                inv(1, "add", 1),
+                ok(0, "read", 0),
+                inv(0, "read", None),
+                ok(1, "add", 1),
+                inv(1, "add", 2),
+                ok(0, "read", 3),
+                inv(0, "read", None),
+                ok(1, "add", 2),
+                ok(0, "read", 5),
+            ]
+        ),
+        {},
+    )
+    assert res["valid"] is False
+    assert res["reads"] == [[0, 0, 1], [0, 3, 3], [1, 5, 3]]
+    assert res["errors"] == [[1, 5, 3]]
+
+
+# -- set (checker.clj:237-288) -----------------------------------------------
+
+
+def test_set_never_read():
+    res = C.set_checker().check({}, h([inv(0, "add", 0), ok(0, "add", 0)]), {})
+    assert res["valid"] == "unknown"
+
+
+def test_set_ok_lost_unexpected_recovered():
+    res = C.set_checker().check(
+        {},
+        h(
+            [
+                inv(0, "add", 0), ok(0, "add", 0),          # acked, read: ok
+                inv(0, "add", 1), ok(0, "add", 1),          # acked, missing: lost
+                inv(0, "add", 2),                            # crashed, read: recovered
+                inv(1, "read", None), ok(1, "read", [0, 2, 9]),  # 9: unexpected
+            ]
+        ),
+        {},
+    )
+    assert res["valid"] is False
+    assert res["lost"] == "#{1}"
+    assert res["unexpected"] == "#{9}"
+    assert res["recovered"] == "#{2}"
+    assert (res["attempt_count"], res["acknowledged_count"], res["ok_count"]) == (3, 2, 2)
+
+
+# -- set-full (checker_test.clj:513-680) -------------------------------------
+
+
+def sf(rows, **kw):
+    return C.set_full(**kw).check({}, h(rows), {})
+
+
+A = inv(0, "add", 0)
+A_ = ok(0, "add", 0)
+R = inv(1, "read", None)
+Rp = ok(1, "read", [0])
+Rm = ok(1, "read", [])
+
+
+def test_set_full_never_read():
+    res = sf([A, A_])
+    assert res["valid"] == "unknown"
+    assert res["never_read"] == [0]
+    assert res["attempt_count"] == 1 and res["stable_count"] == 0
+
+
+def test_set_full_never_confirmed_never_read():
+    res = sf([A, R, Rm])
+    assert res["valid"] == "unknown"
+    assert res["never_read"] == [0] and res["lost"] == []
+
+
+@pytest.mark.parametrize(
+    "rows",
+    [
+        [R, A, Rp, A_],   # concurrent read before
+        [R, A, A_, Rp],   # concurrent read outside
+        [A, R, Rp, A_],   # concurrent read inside
+        [A, R, A_, Rp],   # concurrent read after
+        [A, A_, R, Rp],   # subsequent read
+    ],
+)
+def test_set_full_successful_read(rows):
+    res = sf(rows)
+    assert res["valid"] is True
+    assert res["stable_count"] == 1 and res["never_read"] == []
+    assert res["stable_latencies"] == {0: 0, 0.5: 0, 0.95: 0, 0.99: 0, 1: 0}
+
+
+def test_set_full_absent_read_after():
+    res = sf([A, A_, R, Rm])
+    assert res["valid"] is False
+    assert res["lost"] == [0] and res["lost_count"] == 1
+    assert res["lost_latencies"] == {0: 0, 0.5: 0, 0.95: 0, 0.99: 0, 1: 0}
+
+
+@pytest.mark.parametrize(
+    "rows",
+    [
+        [R, A, Rm, A_],
+        [R, A, A_, Rm],
+        [A, R, Rm, A_],
+        [A, R, A_, Rm],
+    ],
+)
+def test_set_full_absent_read_concurrently(rows):
+    res = sf(rows)
+    assert res["valid"] == "unknown"
+    assert res["never_read"] == [0] and res["lost"] == []
+
+
+def test_set_full_write_present_missing():
+    a0, a0_ = inv(0, "add", 0), ok(0, "add", 0)
+    a1, a1_ = inv(1, "add", 1), ok(1, "add", 1)
+    r2 = inv(2, "read", None)
+    res = sf(
+        [a0, a1, r2, ok(2, "read", [1]), a0_, a1_,
+         r2, ok(2, "read", [0, 1]), r2, ok(2, "read", [0]), r2, ok(2, "read", [])]
+    )
+    assert res["valid"] is False
+    assert res["lost"] == [0, 1] and res["lost_count"] == 2
+    assert res["lost_latencies"] == {0: 3, 0.5: 4, 0.95: 4, 0.99: 4, 1: 4}
+
+
+def test_set_full_flutter_stable_lost():
+    a0, a0_ = inv(0, "add", 0), ok(0, "add", 0)
+    a1, a1_ = inv(1, "add", 1), ok(1, "add", 1)
+    r2, r3 = inv(2, "read", None), inv(3, "read", None)
+    # t  0   1    2   3   4                5    6   7   8                 9
+    res = sf(
+        [a0, a0_, a1, r2, ok(2, "read", [1]), a1_, r2, r3, ok(3, "read", [1]),
+         ok(2, "read", [0])]
+    )
+    assert res["valid"] is False
+    assert res["lost"] == [0] and res["stale"] == [1]
+    assert res["stable_count"] == 1
+    assert res["lost_latencies"] == {0: 5, 0.5: 5, 0.95: 5, 0.99: 5, 1: 5}
+    assert res["stable_latencies"] == {0: 2, 0.5: 2, 0.95: 2, 0.99: 2, 1: 2}
+    ws = res["worst_stale"]
+    assert len(ws) == 1 and ws[0]["element"] == 1
+    assert ws[0]["known"].index == 4 and ws[0]["known"].time == 4_000_000
+    assert ws[0]["last_absent"].index == 6 and ws[0]["last_absent"].time == 6_000_000
+    assert ws[0]["stable_latency"] == 2
+
+
+def test_set_full_linearizable_fails_stale():
+    a0, a0_ = inv(0, "add", 0), ok(0, "add", 0)
+    r2, r3 = inv(2, "read", None), inv(3, "read", None)
+    rows = [a0, a0_, r2, ok(2, "read", []), r3, ok(3, "read", [0])]
+    assert sf(rows)["valid"] is True
+    assert sf(rows, linearizable=True)["valid"] is False
+
+
+# -- unique-ids (checker.clj:686-731) ----------------------------------------
+
+
+def test_unique_ids():
+    res = C.unique_ids().check(
+        {},
+        h(
+            [
+                inv(0, "generate", None), ok(0, "generate", 10),
+                inv(0, "generate", None), ok(0, "generate", 11),
+                inv(0, "generate", None), ok(0, "generate", 10),
+                inv(0, "generate", None),
+            ]
+        ),
+        {},
+    )
+    assert res["valid"] is False
+    assert res["duplicated"] == {10: 2}
+    assert res["attempted_count"] == 4 and res["acknowledged_count"] == 3
+    assert res["range"] == [10, 11]
+
+
+# -- linearizable dispatch (checker.clj:182-213 + BASELINE backend story) ----
+
+
+def test_linearizable_checker_device_backend():
+    from jepsen_tpu.models import CasRegister
+
+    chk = C.linearizable(model=CasRegister(init=0))
+    good = h(
+        [
+            inv(0, "write", 1), ok(0, "write", 1),
+            inv(1, "read", None), ok(1, "read", 1),
+        ]
+    )
+    bad = h(
+        [
+            inv(0, "write", 1), ok(0, "write", 1),
+            inv(1, "read", None), ok(1, "read", 2),
+        ]
+    )
+    assert chk.check({"checker_backend": "tpu"}, good, {})["valid"] is True
+    assert chk.check({"checker_backend": "tpu"}, bad, {})["valid"] is False
+    assert chk.check({}, good, {})["valid"] is True
+
+
+def test_linearizable_requires_model():
+    with pytest.raises(ValueError):
+        C.linearizable()
