@@ -27,7 +27,7 @@ from ..util import integer_interval_set_str
 
 
 def _client_ops(history: History):
-    return [op for op in history if op.is_client]
+    return list(history.client_ops())
 
 
 # ---------------------------------------------------------------------------
@@ -202,30 +202,40 @@ def set_full(checker_opts: Optional[dict] = None, **kw) -> Checker:
         first_obs_idx = np.full(E, np.inf)
         first_obs_time = np.full(E, np.inf)
         if E and R:
-            member = np.zeros((R, E), dtype=bool)
-            for r, (_, _, _, _, members, _, _) in enumerate(reads):
-                if members:
-                    member[r, list(members)] = True
+            # Chunk over reads so memory stays O(chunk * E) rather than
+            # O(R * E): running E-wide max/min reductions across chunks.
             inv_idx = np.array([r[0] for r in reads], float)
             inv_time = np.array([r[1] for r in reads], float)
             ret_idx = np.array([r[2] for r in reads], float)
             ret_time = np.array([r[3] for r in reads], float)
-            pres = np.where(member, inv_idx[:, None], -1.0)
-            rbest = pres.argmax(axis=0)
-            last_present_idx = pres.max(axis=0)
-            last_present_time = np.where(
-                last_present_idx >= 0, inv_time[rbest], -1.0
-            )
-            absn = np.where(~member, inv_idx[:, None], -1.0)
-            rabs = absn.argmax(axis=0)
-            last_absent_idx = absn.max(axis=0)
-            last_absent_time = np.where(last_absent_idx >= 0, inv_time[rabs], -1.0)
-            obs = np.where(member, ret_idx[:, None], np.inf)
-            robs = obs.argmin(axis=0)
-            first_obs_idx = obs.min(axis=0)
-            first_obs_time = np.where(
-                np.isfinite(first_obs_idx), ret_time[robs], np.inf
-            )
+            chunk = max(1, min(R, (1 << 24) // max(E, 1)))
+            for lo in range(0, R, chunk):
+                hi = min(lo + chunk, R)
+                member = np.zeros((hi - lo, E), dtype=bool)
+                for r in range(lo, hi):
+                    members = reads[r][4]
+                    if members:
+                        member[r - lo, list(members)] = True
+                ci, ct = inv_idx[lo:hi], inv_time[lo:hi]
+                cri, crt = ret_idx[lo:hi], ret_time[lo:hi]
+                pres = np.where(member, ci[:, None], -1.0)
+                rbest = pres.argmax(axis=0)
+                cmax = pres.max(axis=0)
+                upd = cmax > last_present_idx
+                last_present_idx = np.where(upd, cmax, last_present_idx)
+                last_present_time = np.where(upd, ct[rbest], last_present_time)
+                absn = np.where(~member, ci[:, None], -1.0)
+                rabs = absn.argmax(axis=0)
+                amax = absn.max(axis=0)
+                upd = amax > last_absent_idx
+                last_absent_idx = np.where(upd, amax, last_absent_idx)
+                last_absent_time = np.where(upd, ct[rabs], last_absent_time)
+                obs = np.where(member, cri[:, None], np.inf)
+                robs = obs.argmin(axis=0)
+                omin = obs.min(axis=0)
+                upd = omin < first_obs_idx
+                first_obs_idx = np.where(upd, omin, first_obs_idx)
+                first_obs_time = np.where(upd, crt[robs], first_obs_time)
 
         add_ok_idx_a = np.array(add_ok_idx, float) if E else np.zeros(0)
         add_ok_time_a = np.array(add_ok_time, float) if E else np.zeros(0)

@@ -1,0 +1,212 @@
+"""Test lifecycle orchestration.
+
+Mirrors jepsen.core (jepsen/src/jepsen/core.clj): bring up OS + DB on every
+node, open clients and the nemesis, drive the generator through the threaded
+interpreter to produce a history, run the checker, persist everything.
+
+    run(test)                               core.clj:254-361
+    ├ defaults: concurrency, start-time     core.clj:309-324
+    ├ store.start_logging                   core.clj:325
+    ├ control.with_remote sessions/node     core.clj:328-338
+    ├ os.setup on nodes                     core.clj:340,93-100
+    ├ db.cycle (teardown→setup, retries)    core.clj:341,170-179
+    ├ with_relative_time                    core.clj:342
+    ├ run_case: nemesis.setup ∥ client
+    │   open+setup per node; interpreter    core.clj:181-220
+    ├ store.save_1 (history durable)        core.clj:354
+    ├ analyze: index, check_safe, save_2    core.clj:222-237
+    └ log_results                           core.clj:239-252
+    finally: client/nemesis teardown, DB teardown (unless
+    leave-db-running?), OS teardown, session close
+
+The *test map* is the configuration system (core.clj:255-277): plain keys,
+defaults merged from workloads.noop_test. Key names keep the reference's
+spelling minus the colon ("concurrency", "time-limit", "leave-db-running?").
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time as _time
+from typing import Any, Optional
+
+from . import client as jclient
+from . import db as jdb
+from . import nemesis as jnemesis
+from . import os_ as jos
+from . import store
+from .checker import check_safe
+from .generator import interpreter
+from .history import History, Op
+from .util import real_pmap, with_relative_time
+
+LOG = logging.getLogger("jepsen.core")
+
+
+def synchronize(test: dict, timeout_s: Optional[float] = None) -> None:
+    """Block until all nodes reach this barrier (core.clj:44-57). The
+    barrier is a threading.Barrier of #nodes parties, stored on the test."""
+    b = test.get("barrier")
+    if isinstance(b, threading.Barrier):
+        b.wait(timeout=timeout_s)
+
+
+def primary(test: dict) -> Any:
+    """The node considered primary for setup purposes (core.clj:65-68)."""
+    return test["nodes"][0]
+
+
+def _with_sessions(test: dict):
+    """Open a control session per node (core.clj:330-338); returns the
+    sessions map (may be empty when no remote is configured — the
+    in-process fake-cluster path)."""
+    remote = test.get("remote")
+    if remote is None:
+        return None
+    from . import control
+
+    return control.setup_sessions(test, remote)
+
+
+def run_case(test: dict) -> list[dict]:
+    """Spawn nemesis + clients, run the generator, return the history
+    (core.clj:181-220)."""
+    client = test.get("client") or jclient.noop()
+    nemesis = jnemesis.validate(test.get("nemesis") or jnemesis.noop())
+
+    # Nemesis setup runs concurrently with per-node client open+setup
+    # (core.clj:187-196).
+    nemesis_box: list = [None]
+
+    def setup_nemesis():
+        nemesis_box[0] = nemesis.setup(test)
+
+    nt = threading.Thread(target=setup_nemesis, name="jepsen nemesis setup")
+    nt.start()
+
+    def open_setup(node):
+        c = jclient.validate(client).open(test, node)
+        c.setup(test)
+        return c
+
+    clients = real_pmap(open_setup, test.get("nodes") or [])
+    nt.join()
+    if nemesis_box[0] is None:
+        raise RuntimeError("nemesis setup failed")
+
+    test_for_run = dict(test)
+    test_for_run["nemesis"] = nemesis_box[0]
+    try:
+        return interpreter.run(test_for_run)
+    finally:
+        def teardown_nemesis():
+            nemesis_box[0].teardown(test)
+
+        nt2 = threading.Thread(target=teardown_nemesis,
+                               name="jepsen nemesis teardown")
+        nt2.start()
+
+        def teardown_close(cn):
+            c, node = cn
+            try:
+                c.teardown(test)
+            finally:
+                c.close(test)
+
+        real_pmap(teardown_close, list(zip(clients, test.get("nodes") or [])))
+        nt2.join()
+
+
+def analyze(test: dict) -> dict:
+    """Index the history, run the checker, persist results
+    (core.clj:222-237)."""
+    LOG.info("Analyzing...")
+    h = test.get("history")
+    if not isinstance(h, History):
+        h = History(
+            [Op.from_dict(o) if isinstance(o, dict) else o for o in h or []],
+            reindex=True,
+        )
+    else:
+        h = h.reindex()
+    test = dict(test)
+    test["history"] = h
+    checker = test.get("checker")
+    if checker is not None:
+        test["results"] = check_safe(checker, test, h)
+    else:
+        test["results"] = {"valid": True}
+    LOG.info("Analysis complete")
+    if test.get("name") and test.get("start-time") and not test.get("no-store?"):
+        store.save_2(test)
+    return test
+
+
+def log_results(test: dict) -> dict:
+    """core.clj:239-252."""
+    results = test.get("results") or {}
+    valid = results.get("valid")
+    tail = {
+        False: "Analysis invalid! (ﾉಥ益ಥ）ﾉ ┻━┻",
+        "unknown": "Errors occurred during analysis, but no anomalies found. ಠ~ಠ",
+        True: "Everything looks good! ヽ(‘ー`)ノ",
+    }.get(valid, f"Unknown validity: {valid!r}")
+    LOG.info("%r\n\n%s", results, tail)
+    return test
+
+
+def prepare_test(test: dict) -> dict:
+    """Fill computed defaults (core.clj:309-324)."""
+    test = dict(test)
+    nodes = test.get("nodes") or []
+    test.setdefault("concurrency", max(len(nodes), 1))
+    test.setdefault("start-time", store.time_str())
+    test["barrier"] = (
+        threading.Barrier(len(nodes)) if nodes else threading.Barrier(1)
+    )
+    return test
+
+
+def run(test: dict) -> dict:
+    """Run a complete test; returns the test map with :history and
+    :results. See module docstring for the phase diagram."""
+    test = prepare_test(test)
+    persist = bool(test.get("name")) and not test.get("no-store?")
+    if persist:
+        store.path_mk(test)
+        store.start_logging(test)
+    try:
+        LOG.info("Running test: %s/%s", test.get("name"), test["start-time"])
+        sessions = _with_sessions(test)
+        osys: jos.OS = test.get("os") or jos.noop()
+        nodes = test.get("nodes") or []
+        try:
+            real_pmap(lambda n: osys.setup(test, n), nodes)
+            try:
+                jdb.cycle(test)
+                with with_relative_time():
+                    history = run_case(test)
+                test["history"] = history
+                if persist:
+                    store.save_1(test)
+                test = analyze(test)
+                return log_results(test)
+            finally:
+                if not test.get("leave-db-running?"):
+                    try:
+                        jdb.teardown_all(test)
+                    except Exception:
+                        LOG.warning("DB teardown failed", exc_info=True)
+        finally:
+            try:
+                real_pmap(lambda n: osys.teardown(test, n), nodes)
+            except Exception:
+                LOG.warning("OS teardown failed", exc_info=True)
+            if sessions is not None:
+                from . import control
+
+                control.close_sessions(sessions)
+    finally:
+        if persist:
+            store.stop_logging(test)

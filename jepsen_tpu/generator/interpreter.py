@@ -1,0 +1,324 @@
+"""The runtime that turns a generator into a real history.
+
+Mirrors jepsen.generator.interpreter (jepsen/src/jepsen/generator/
+interpreter.clj): a single scheduler loop plus one OS thread per worker,
+coupled through size-1 queues. The scheduler:
+
+1. polls completions FIRST (latency-sensitive: a stale completion makes
+   the generator believe ops are concurrent when they're not —
+   interpreter.clj:215-241);
+2. otherwise evaluates the pure generator for the next op
+   (interpreter.clj:244-248);
+3. dispatches ops whose :time has arrived to their worker's in-queue,
+   sleeps until pending ops mature, and exits when the generator is
+   exhausted and all outstanding ops have completed
+   (interpreter.clj:252-292).
+
+Soundness rule: a worker that catches ANY exception from a client invoke
+completes the op as ``:info`` (indeterminate — the fault may have taken
+effect), and the scheduler hands that thread a fresh process id so the
+next op can't be confused with the crashed one
+(interpreter.clj:142-157,233-236). Nemesis crashes do NOT bump the
+process (the nemesis is a singleton).
+
+Worker kinds come from the thread id: integer threads are client workers,
+the ``"nemesis"`` thread drives the test's nemesis
+(interpreter.clj:33-97).
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+import time as _time
+from typing import Any, Optional
+
+from .. import client as jclient
+from .. import nemesis as jnemesis
+from ..history import INFO, INVOKE, NEMESIS
+from ..util import log_op, relative_time_nanos
+from . import (
+    PENDING,
+    Context,
+    FriendlyExceptions,
+    Validate,
+    context as make_context,
+    next_process,
+    op as gen_op,
+    update as gen_update,
+)
+
+LOG = logging.getLogger("jepsen.interpreter")
+
+# Don't sleep longer than this when the generator is :pending — it may
+# become ready as completions arrive (interpreter.clj:166-170).
+MAX_PENDING_INTERVAL_S = 0.001
+
+
+def goes_in_history(op: dict) -> bool:
+    """:sleep and :log ops are scheduler directives, not history events
+    (interpreter.clj:172-179)."""
+    return op.get("type") not in ("sleep", "log")
+
+
+class Worker:
+    """One executor of ops (interpreter.clj:19-31)."""
+
+    def open(self, test: dict, thread_id: Any) -> "Worker":
+        return self
+
+    def invoke(self, test: dict, op: dict) -> dict:
+        raise NotImplementedError
+
+    def close(self, test: dict) -> None:
+        pass
+
+
+class ClientWorker(Worker):
+    """Wraps a Client; re-opens it when the worker's process changes and
+    the client isn't Reusable (interpreter.clj:33-67)."""
+
+    def __init__(self, node: Any, process: Any = None,
+                 client: Optional[jclient.Client] = None):
+        self.node = node
+        self.process = process
+        self.client = client
+
+    def invoke(self, test, op):
+        if self.process != op.get("process") and not (
+            self.client is not None
+            and jclient.is_reusable(self.client, test)
+        ):
+            # Process changed; tear down the old connection, open a fresh one.
+            if self.client is not None:
+                try:
+                    self.client.close(test)
+                except Exception:
+                    LOG.warning("error closing client", exc_info=True)
+                self.client = None
+            try:
+                self.client = jclient.validate(test["client"]).open(
+                    test, self.node
+                )
+                self.process = op.get("process")
+            except Exception:
+                LOG.warning(
+                    "error opening client for process %s on node %s",
+                    op.get("process"), self.node, exc_info=True,
+                )
+                return {
+                    **op,
+                    "type": "fail",
+                    "error": ["no-client", "cannot open client"],
+                }
+        return self.client.invoke(test, op)
+
+    def close(self, test):
+        if self.client is not None:
+            self.client.close(test)
+            self.client = None
+
+
+class NemesisWorker(Worker):
+    """Applies ops to the test's (already set-up) nemesis
+    (interpreter.clj:69-76)."""
+
+    def __init__(self, nemesis: jnemesis.Nemesis):
+        self.nemesis = nemesis
+
+    def invoke(self, test, op):
+        return self.nemesis.invoke(test, op)
+
+
+def client_nodes(test: dict) -> list:
+    """Thread i's home node: round-robin over :nodes
+    (interpreter.clj:83-97)."""
+    nodes = test.get("nodes") or [None]
+    conc = test.get("concurrency", len(nodes))
+    return [nodes[i % len(nodes)] for i in range(conc)]
+
+
+def make_worker(test: dict, thread_id: Any, nemesis: jnemesis.Nemesis) -> Worker:
+    if thread_id == NEMESIS:
+        return NemesisWorker(nemesis)
+    node = client_nodes(test)[thread_id]
+    return ClientWorker(node)
+
+
+class _WorkerThread:
+    """A worker plus its size-1 in/out queues and OS thread
+    (interpreter.clj:99-164)."""
+
+    def __init__(self, test: dict, thread_id: Any, worker: Worker):
+        self.thread_id = thread_id
+        self.worker = worker
+        self.inbox: "queue.Queue[dict]" = queue.Queue(maxsize=1)
+        self.outbox: "queue.Queue[dict]" = queue.Queue(maxsize=1)
+        self.thread = threading.Thread(
+            target=self._run, args=(test,),
+            name=f"jepsen-worker-{thread_id}", daemon=True,
+        )
+        self.thread.start()
+
+    def _run(self, test: dict) -> None:
+        while True:
+            op = self.inbox.get()
+            typ = op.get("type")
+            if typ == "exit":
+                try:
+                    self.worker.close(test)
+                except Exception:
+                    LOG.warning("error closing worker %s", self.thread_id,
+                                exc_info=True)
+                return
+            if typ == "sleep":
+                _time.sleep(op.get("value") or 0)
+                self.outbox.put(dict(op))
+                continue
+            if typ == "log":
+                LOG.info("%s", op.get("value"))
+                self.outbox.put(dict(op))
+                continue
+            try:
+                res = self.worker.invoke(test, op)
+                log_op(res)
+                self.outbox.put(res)
+            except Exception as e:  # noqa: BLE001 - soundness rule
+                # Coarse-grained failure: we don't know whether the op took
+                # effect. :info keeps its interval open to end-of-history
+                # (interpreter.clj:142-157).
+                LOG.warning("process %s %s indeterminate", op.get("process"),
+                            op.get("f"), exc_info=True)
+                self.outbox.put({
+                    **op,
+                    "type": INFO,
+                    "error": f"indeterminate: {e}",
+                    "exception": e,
+                })
+
+    def send(self, op: dict) -> None:
+        self.inbox.put(op)
+
+    def poll(self) -> Optional[dict]:
+        try:
+            return self.outbox.get_nowait()
+        except queue.Empty:
+            return None
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        self.thread.join(timeout)
+
+
+def run(test: dict) -> list[dict]:
+    """Run the test's generator to completion against its client and
+    nemesis; returns the history as a list of op dicts
+    (interpreter.clj:181-310).
+
+    Requires: test["client"] (a Client prototype), test["nemesis"] (already
+    set up), test["generator"], test["concurrency"], test["nodes"]."""
+    ctx = make_context(test)
+    nemesis = test.get("nemesis") or jnemesis.noop()
+    threads = ctx.free_thread_list()
+    workers: dict[Any, _WorkerThread] = {
+        t: _WorkerThread(test, t, make_worker(test, t, nemesis))
+        for t in threads
+    }
+    gen = Validate(FriendlyExceptions(test.get("generator")))
+    history: list[dict] = []
+    # Ops in flight: thread id -> invoke op.
+    outstanding: dict[Any, dict] = {}
+    poll_timeout = 0.0
+    exc: Optional[BaseException] = None
+
+    try:
+        while True:
+            # 1. Completions first (interpreter.clj:215-241).
+            completed = None
+            for t, w in list(workers.items()):
+                if t not in outstanding:
+                    continue
+                op2 = w.poll()
+                if op2 is None:
+                    continue
+                completed = True
+                outstanding.pop(t)
+                op2 = dict(op2)
+                op2.pop("exception", None)
+                op2["time"] = relative_time_nanos()
+                thread = t
+                ctx = ctx.with_(
+                    time=max(ctx.time, op2["time"]),
+                    free_threads=ctx.free_threads | {thread},
+                )
+                gen = gen_update(gen, test, ctx, op2)
+                # Client crash ⇒ fresh process id for this thread
+                # (interpreter.clj:233-236).
+                if thread != NEMESIS and op2.get("type") == INFO:
+                    new_workers = dict(ctx.workers)
+                    new_workers[thread] = next_process(ctx, thread)
+                    ctx = ctx.with_(workers=new_workers)
+                if goes_in_history(op2):
+                    history.append(op2)
+                poll_timeout = 0.0
+            if completed:
+                continue
+
+            # 2. Ask the generator (interpreter.clj:244-292).
+            res = gen_op(gen, test, ctx)
+            if res is None:
+                # Exhausted: wait for stragglers, then shut workers down.
+                if outstanding:
+                    _time.sleep(poll_timeout or MAX_PENDING_INTERVAL_S)
+                    poll_timeout = MAX_PENDING_INTERVAL_S
+                    continue
+                break
+            op_, gen2 = res
+            now = relative_time_nanos()
+            if op_ is PENDING:
+                _time.sleep(MAX_PENDING_INTERVAL_S)
+                continue
+            if op_["time"] > now:
+                # Future op: sleep towards it, but wake early for
+                # completions (interpreter.clj:268-275).
+                _time.sleep(
+                    min((op_["time"] - now) / 1e9, MAX_PENDING_INTERVAL_S)
+                )
+                continue
+            # Dispatch. The op keeps its scheduled :time.
+            op_ = dict(op_)
+            op_["time"] = max(op_["time"], now) if op_["time"] >= 0 else now
+            thread = None
+            for t, p in ctx.workers.items():
+                if p == op_["process"]:
+                    thread = t
+                    break
+            assert thread is not None, f"no thread for process {op_['process']}"
+            workers[thread].send(dict(op_))
+            outstanding[thread] = op_
+            ctx = ctx.with_(
+                time=max(ctx.time, op_["time"]),
+                free_threads=ctx.free_threads - {thread},
+            )
+            gen = gen_update(gen2, test, ctx, op_)
+            if goes_in_history(op_):
+                history.append(op_)
+    except BaseException as e:  # noqa: BLE001 - propagate after cleanup
+        exc = e
+    finally:
+        # Drain & stop workers (interpreter.clj:252-261,294-309). Workers
+        # stuck in a client call are daemon threads; exit ops queue behind
+        # whatever they're doing.
+        for t, w in workers.items():
+            if t in outstanding:
+                # Wait briefly for in-flight ops so exit can enqueue.
+                w.poll()
+            try:
+                w.inbox.put({"type": "exit"}, timeout=1.0)
+            except queue.Full:
+                pass
+        for w in workers.values():
+            w.join(timeout=5.0)
+    if exc is not None:
+        raise exc
+    return history

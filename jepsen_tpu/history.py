@@ -98,6 +98,26 @@ class Op:
                 return v
         return default
 
+    # -- plain-dict interop (the generator DSL + interpreter speak dicts) --
+    @classmethod
+    def from_dict(cls, m: dict) -> "Op":
+        """Build an Op from a plain scheduler op map (string keys, as
+        produced by the generator DSL / interpreter)."""
+        std = {"type", "process", "f", "value", "time", "index", "error"}
+        extra = tuple(
+            sorted(((k, v) for k, v in m.items() if k not in std), key=repr)
+        )
+        return cls(
+            type=m.get("type"),
+            process=m.get("process"),
+            f=m.get("f"),
+            value=m.get("value"),
+            time=m.get("time", -1),
+            index=m.get("index", -1),
+            error=m.get("error"),
+            extra=extra,
+        )
+
     # -- EDN interop --------------------------------------------------------
     @classmethod
     def from_edn(cls, m: dict) -> "Op":

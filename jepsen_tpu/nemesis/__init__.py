@@ -1,0 +1,98 @@
+"""Nemesis protocol: fault injection as a special singleton client.
+
+Mirrors jepsen.nemesis (jepsen/src/jepsen/nemesis.clj):
+
+- :class:`Nemesis` — setup/invoke/teardown (nemesis.clj:10-15). A nemesis
+  receives :info ops from the generator's nemesis track and performs
+  faults against the cluster.
+- :class:`Reflection` — optional ``fs()`` enumerating the op :f's a
+  nemesis handles, used by compose for routing (nemesis.clj:17-20).
+- :func:`validate` — wraps a nemesis so a nil completion raises
+  (nemesis.clj:29-70).
+- :func:`noop` — accepts every op unchanged (nemesis.clj:72-79).
+
+Partitioners, grudges, and the package algebra live in
+:mod:`jepsen_tpu.nemesis.grudge` / :mod:`jepsen_tpu.nemesis.combined`.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterable, Optional
+
+
+class Nemesis:
+    """Fault injector (nemesis.clj:10-15). ``setup`` returns the nemesis to
+    use (may be self); ``invoke`` applies a fault op and returns its
+    completion."""
+
+    def setup(self, test: dict) -> "Nemesis":
+        return self
+
+    def invoke(self, test: dict, op: dict) -> dict:
+        raise NotImplementedError
+
+    def teardown(self, test: dict) -> None:
+        pass
+
+
+class Reflection:
+    """Optional: enumerate handled op fs (nemesis.clj:17-20)."""
+
+    def fs(self) -> Iterable[Any]:
+        raise NotImplementedError
+
+
+class _Noop(Nemesis, Reflection):
+    """Does nothing (nemesis.clj:72-79)."""
+
+    def invoke(self, test, op):
+        return dict(op)
+
+    def fs(self):
+        return []
+
+    def __repr__(self):
+        return "<nemesis.noop>"
+
+
+def noop() -> Nemesis:
+    return _Noop()
+
+
+class ValidationError(Exception):
+    pass
+
+
+class _Validate(Nemesis):
+    """Nil completions raise instead of vanishing (nemesis.clj:29-70)."""
+
+    def __init__(self, nemesis: Nemesis):
+        self.nemesis = nemesis
+
+    def setup(self, test):
+        inner = self.nemesis.setup(test)
+        if inner is None:
+            raise ValidationError(
+                f"nemesis setup returned None (from {self.nemesis!r})"
+            )
+        return _Validate(inner)
+
+    def invoke(self, test, op):
+        res = self.nemesis.invoke(test, op)
+        if res is None:
+            raise ValidationError(
+                f"nemesis {self.nemesis!r} returned None for op {op!r}"
+            )
+        return res
+
+    def teardown(self, test):
+        self.nemesis.teardown(test)
+
+    def __repr__(self):
+        return f"<nemesis.validate {self.nemesis!r}>"
+
+
+def validate(nemesis: Nemesis) -> Nemesis:
+    if isinstance(nemesis, _Validate):
+        return nemesis
+    return _Validate(nemesis)

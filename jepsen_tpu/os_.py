@@ -1,0 +1,28 @@
+"""Operating-system provisioning protocol.
+
+Mirrors jepsen.os (jepsen/src/jepsen/os.clj:4-8): prepare a node's OS before
+DB install (hostfiles, packages, users) and undo it after. Distro
+implementations (debian/centos/ubuntu equivalents, ref jepsen/src/jepsen/os/
+debian.clj etc.) layer on the control session's package helpers.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+
+class OS:
+    def setup(self, test: dict, node: Any) -> None:
+        pass
+
+    def teardown(self, test: dict, node: Any) -> None:
+        pass
+
+
+class _Noop(OS):
+    def __repr__(self):
+        return "<os.noop>"
+
+
+def noop() -> OS:
+    return _Noop()

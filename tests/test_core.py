@@ -1,0 +1,240 @@
+"""End-to-end spine tests on the in-process fake cluster.
+
+Ports the reference's core_test.clj acceptance tests (jepsen/test/jepsen/
+core_test.clj): basic-cas-test (:61-120, 1000 ops through real worker
+threads, checked on the device kernel), most-interesting-exception-test
+(:42-59), and the crash-recovery + error-propagation cases
+(:179-249 / generator/interpreter_test.clj:14-145)."""
+
+import threading
+
+import pytest
+
+from jepsen_tpu import client as jclient
+from jepsen_tpu import core, db as jdb
+from jepsen_tpu import checker as jchecker
+from jepsen_tpu import generator as gen
+from jepsen_tpu.generator import interpreter
+from jepsen_tpu.history import History, Op
+from jepsen_tpu.models import CasRegister
+from jepsen_tpu.workloads import AtomClient, AtomDB, AtomState, noop_test
+
+
+def run_no_store(test):
+    t = dict(test)
+    t["no-store?"] = True
+    return core.run(t)
+
+
+class TestBasicCas:
+    """core_test.clj:61-120, with the checker on the WGL device kernel."""
+
+    N = 300  # reference uses 1000; 300 keeps the threaded run quick (1 ms
+    # client sleep x N ops / 10 workers) while still exercising everything.
+
+    @pytest.fixture(scope="class")
+    def result(self):
+        state = AtomState()
+        meta_log: list = []
+        n = self.N
+        test = dict(noop_test())
+        test.update(
+            name="basic cas pure-gen",
+            db=AtomDB(state),
+            client=AtomClient(state, meta_log),
+            concurrency=10,
+            checker=jchecker.compose({
+                "linear": jchecker.linearizable(model=CasRegister(init=0)),
+                "stats": jchecker.stats(),
+            }),
+            # The reference writes phase 1 as a bare {:f :read}, which
+            # fill-in-op may hand to the *nemesis* thread (noop nemesis
+            # echoes it, so no :ok read results) — restricting to clients
+            # makes the first-read assertion deterministic.
+            generator=gen.phases(
+                gen.clients({"f": "read"}),
+                gen.clients(
+                    gen.limit(
+                        n,
+                        gen.reserve(
+                            5, gen.repeat_({"f": "read"}),
+                            gen.mix([
+                                lambda: {"f": "write",
+                                         "value": gen.rand_int(5)},
+                                lambda: {"f": "cas",
+                                         "value": [gen.rand_int(5),
+                                                   gen.rand_int(5)]},
+                            ]),
+                        ),
+                    )
+                ),
+            ),
+        )
+        res = run_no_store(test)
+        return res, state, meta_log
+
+    def test_db_teardown(self, result):
+        _, state, _ = result
+        assert state.get() == "done"
+
+    def test_client_lifecycle(self, result):
+        # Setup: one client per node opened + setup (core.clj:187-196);
+        # run: each of 10 workers opens a client on its first op and closes
+        # it at exit; teardown: per-node teardown + close.
+        _, _, meta_log = result
+        counts = {k: meta_log.count(k) for k in set(meta_log)}
+        assert counts["open"] == 15  # 5 setup + 10 workers
+        assert counts["close"] == 15
+        assert counts["setup"] == 5
+        assert counts["teardown"] == 5
+        # Ordering: the 5 setup opens+setups precede the run; the 5
+        # teardowns come last.
+        assert set(meta_log[:10]) == {"open", "setup"}
+        assert meta_log[-10:].count("teardown") == 5
+
+    def test_valid(self, result):
+        test, _, _ = result
+        assert test["results"]["valid"] is True
+        assert test["results"]["linear"]["valid"] is True
+
+    def test_first_read(self, result):
+        test, _, _ = result
+        h = test["history"]
+        reads = [o for o in h if o.f == "read" and o.is_ok]
+        assert reads[0].value == 0
+
+    def test_history_shape(self, result):
+        test, _, _ = result
+        h = test["history"]
+        assert len(h) == 2 * (1 + self.N)
+        assert {o.f for o in h} == {"read", "write", "cas"}
+        assert all(o.value is None for o in h if o.f == "read" and o.is_invoke)
+        assert all(0 <= o.value <= 4 for o in h if o.f == "read" and o.is_ok)
+        assert all(0 <= o.value <= 4 for o in h if o.f == "write")
+        for o in h:
+            if o.f == "cas":
+                assert isinstance(o.value, list) and len(o.value) == 2
+                assert all(0 <= v <= 4 for v in o.value)
+        # Times are monotone nondecreasing and indexes are assigned.
+        times = [o.time for o in h]
+        assert times == sorted(times)
+        assert [o.index for o in h] == list(range(len(h)))
+
+
+class TestInterestingException:
+    """DB setup failures propagate as themselves, not as broken-barrier
+    noise (core_test.clj:42-59)."""
+
+    def test_db_exception_propagates(self):
+        class BoomDB(jdb.DB):
+            def setup(self, test, node):
+                if node == test["nodes"][2]:
+                    raise RuntimeError("hi")
+
+        test = dict(noop_test())
+        test.update(name="interesting exception", db=BoomDB())
+        with pytest.raises(RuntimeError, match="^hi$"):
+            run_no_store(test)
+
+
+class CrashyClient(jclient.Client):
+    """Every k-th invoke raises (interpreter_test.clj crash-recovery)."""
+
+    def __init__(self, k=5, counter=None):
+        self.k = k
+        self.counter = counter if counter is not None else [0]
+        self.opens = []
+
+    def open(self, test, node):
+        self.opens.append(node)
+        return self
+
+    def invoke(self, test, op):
+        self.counter[0] += 1
+        if self.counter[0] % self.k == 0:
+            raise RuntimeError("crunch")
+        return {**op, "type": "ok"}
+
+
+class TestInterpreter:
+    def run_interp(self, test):
+        t = dict(noop_test())
+        t.update(test)
+        t.setdefault("concurrency", 4)
+        return interpreter.run(t)
+
+    def test_crash_becomes_info_and_process_bumps(self):
+        client = CrashyClient(k=5)
+        h = self.run_interp({
+            "client": client,
+            "concurrency": 4,
+            "generator": gen.clients(
+                gen.limit(40, gen.repeat_({"f": "read"}))
+            ),
+        })
+        infos = [o for o in h if o["type"] == "info"]
+        assert infos, "expected some crashed ops"
+        assert all("indeterminate" in str(o["error"]) for o in infos)
+        # Crashed processes never reappear after their :info.
+        seen_done = set()
+        for o in h:
+            if o["type"] == "invoke":
+                assert o["process"] not in seen_done
+            elif o["type"] == "info":
+                seen_done.add(o["process"])
+        # 40 invokes total, each with exactly one completion.
+        invokes = [o for o in h if o["type"] == "invoke"]
+        assert len(invokes) == 40
+        assert len(h) == 80
+
+    def test_history_times_monotone(self):
+        h = self.run_interp({
+            "generator": gen.clients(gen.limit(20, gen.repeat_({"f": "read"}))),
+        })
+        times = [o["time"] for o in h]
+        assert times == sorted(times)
+        assert len(set(times)) == len(times) or True  # distinct not required
+
+    def test_sleep_and_log_not_in_history(self):
+        h = self.run_interp({
+            "generator": gen.phases(
+                gen.clients(gen.limit(4, gen.repeat_({"f": "read"}))),
+                gen.log_("hello"),
+                gen.sleep(0.01),
+                gen.clients(gen.limit(4, gen.repeat_({"f": "read"}))),
+            ),
+        })
+        assert len(h) == 16
+        assert all(o["type"] not in ("sleep", "log") for o in h)
+
+    def test_generator_exception_propagates(self):
+        def boom(test, ctx):
+            raise ValueError("bad gen")
+
+        with pytest.raises(Exception, match="generator threw ValueError") as ei:
+            self.run_interp({"generator": boom})
+        assert "bad gen" in str(ei.value.__cause__)
+
+    def test_nemesis_ops_flow(self):
+        from jepsen_tpu import nemesis as jnemesis
+
+        class RecordingNemesis(jnemesis.Nemesis):
+            def __init__(self):
+                self.ops = []
+
+            def invoke(self, test, op):
+                self.ops.append(op["f"])
+                return {**op, "type": "info"}
+
+        nem = RecordingNemesis()
+        h = self.run_interp({
+            "nemesis": nem,
+            "generator": gen.nemesis(
+                [{"type": "info", "f": "start"},
+                 {"type": "info", "f": "stop"}],
+                gen.limit(6, gen.repeat_({"f": "read"})),
+            ),
+        })
+        assert nem.ops == ["start", "stop"]
+        nem_ops = [o for o in h if o["process"] == "nemesis"]
+        assert len(nem_ops) == 4  # 2 invokes + 2 completions
