@@ -223,7 +223,38 @@ def linearizable(options: Optional[dict] = None, **kw) -> Checker:
             res["attempts"] = res["attempts"][:10]
         return res
 
-    return checker_fn(chk, "linearizable")
+    out = checker_fn(chk, "linearizable")
+
+    def batch_check(test, keyed_histories: dict, opts=None) -> dict:
+        """Decide many subhistories as ONE vmapped (mesh-shardable) device
+        program — jepsen_tpu.independent's device-batched check axis.
+        Returns {key: result-map}. Raises if the device path is
+        unavailable so the caller can fall back to per-key checking."""
+        backend = (test or {}).get("checker_backend", default_backend)
+        if backend == "tpu":
+            backend = "device"
+        if backend == "host" or not model.device_capable:
+            raise RuntimeError("batch check requires the device backend")
+        from ..ops import wgl
+        from ..parallel import check_batch
+
+        ks = list(keyed_histories)
+        results = check_batch(
+            model, [keyed_histories[k].client_ops() for k in ks]
+        )
+        out_map = dict(zip(ks, results))
+        # Keys the shared batch couldn't decide (didn't fit the common
+        # shape bucket, capacity exhausted) get the full per-key path,
+        # which includes the auto backend's host-oracle fallback.
+        for k, r in out_map.items():
+            if r.get("valid") == "unknown":
+                out_map[k] = wgl.check_history(
+                    model, keyed_histories[k].client_ops(), backend=backend
+                )
+        return out_map
+
+    out.batch_check = batch_check
+    return out
 
 
 # Invariant checkers live in their own module; re-export the public set.

@@ -1,0 +1,283 @@
+"""Lift single-key workloads to keyed maps; shard histories by key.
+
+Mirrors jepsen.independent (jepsen/src/jepsen/independent.clj): expensive
+checkers (linearizability) need short histories, so a single-register test
+is lifted to a *map* of keys to registers — generators wrap op values in
+``[k v]`` tuples, and the checker partitions the history into per-key
+subhistories checked independently (independent.clj:2-7).
+
+The reference checks keys with ``bounded-pmap`` (independent.clj:263-314) —
+host thread parallelism. Here that axis becomes the device batch axis: when
+the lifted checker exposes ``batch_check`` (the `linearizable` checker
+does), ALL per-key subhistories are encoded into one shape bucket and
+decided as a single vmapped, mesh-shardable XLA program
+(jepsen_tpu.parallel.batch) — the BASELINE "batch replay" config.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Callable, Iterable, Optional, Sequence
+
+from . import generator as gen
+from .checker import Checker, check_safe, merge_valid
+from .history import History, Op
+from .util import real_pmap
+
+LOG = logging.getLogger("jepsen.independent")
+
+DIR = "independent"
+
+
+class KV(tuple):
+    """A key/value tuple in an op's :value (independent.clj:21-29).
+    Serializes to EDN as a plain ``[k v]`` vector (how the reference's
+    MapEntry prints)."""
+
+    __slots__ = ()
+
+    def __new__(cls, k, v):
+        return super().__new__(cls, (k, v))
+
+    @property
+    def key(self):
+        return self[0]
+
+    @property
+    def value(self):
+        return self[1]
+
+    def __repr__(self):
+        return f"[{self[0]!r} {self[1]!r}]"
+
+
+def tuple_(k, v) -> KV:
+    return KV(k, v)
+
+
+def is_tuple(value: Any) -> bool:
+    return isinstance(value, KV)
+
+
+def tuple_gen(k, g):
+    """Wrap a generator so its ops carry [k v] values
+    (independent.clj:96-101)."""
+    return gen.map_(lambda op: {**op, "value": KV(k, op.get("value"))}, g)
+
+
+def sequential_generator(keys: Iterable, fgen: Callable):
+    """One key at a time: run fgen(k1) to exhaustion, then k2, …
+    (independent.clj:31-47). fgen must be pure."""
+    return [tuple_gen(k, fgen(k)) for k in keys]
+
+
+def group_threads(n: int, ctx: gen.Context) -> list[list]:
+    """Partition sorted worker threads into groups of n
+    (independent.clj:49-76)."""
+    threads = sorted(t for t in gen.all_threads(ctx) if isinstance(t, int))
+    count = len(threads)
+    groups = count // n
+    assert n <= count, (
+        f"With {count} worker threads, concurrent-generator cannot run a key "
+        f"with {n} threads concurrently. Raise :concurrency to at least {n}."
+    )
+    assert count == n * groups, (
+        f"concurrent-generator has {count} threads but can only use "
+        f"{n * groups} of them for {groups} concurrent keys with {n} threads "
+        f"apiece. Raise or lower :concurrency to a multiple of {n}."
+    )
+    return [threads[i * n:(i + 1) * n] for i in range(groups)]
+
+
+class ConcurrentGenerator(gen.Generator):
+    """Groups of n threads each work a key; exhausted groups pull the next
+    key (independent.clj:103-209). Nemesis excluded; updates route to the
+    executing thread's group."""
+
+    __slots__ = ("n", "fgen", "group_threads", "thread_group", "keys", "gens")
+
+    def __init__(self, n, fgen, group_threads_=None, thread_group=None,
+                 keys=None, gens=None):
+        self.n = n
+        self.fgen = fgen
+        self.group_threads = group_threads_
+        self.thread_group = thread_group
+        self.keys = list(keys) if keys is not None else []
+        self.gens = gens
+
+    def _init(self, ctx: gen.Context):
+        gt = self.group_threads or [set(g) for g in group_threads(self.n, ctx)]
+        tg = self.thread_group or {
+            t: gi for gi, g in enumerate(gt) for t in g
+        }
+        if self.gens is None:
+            groups = len(gt)
+            ks = self.keys[:groups]
+            gens = [tuple_gen(k, self.fgen(k)) for k in ks]
+            gens += [None] * (groups - len(gens))
+            keys = self.keys[groups:]
+        else:
+            gens, keys = self.gens, self.keys
+        return gt, tg, keys, gens
+
+    def op(self, test, ctx):
+        gt, tg, keys, gens = self._init(ctx)
+        free_groups = {tg[t] for t in ctx.free_threads if t in tg}
+        soonest = None
+        gens = list(gens)
+        for group in free_groups:
+            while True:
+                g = gens[group]
+                if g is None:
+                    break
+                gctx = gen.on_threads_context(
+                    lambda t, grp=gt[group]: t in grp, ctx
+                )
+                res = gen.op(g, test, gctx)
+                if res is None:
+                    if keys:
+                        k, keys = keys[0], keys[1:]
+                        gens[group] = tuple_gen(k, self.fgen(k))
+                        continue
+                    gens[group] = None
+                    break
+                o, g2 = res
+                soonest = gen.soonest_op_map(
+                    soonest,
+                    {"op": o, "group": group, "gen'": g2,
+                     "weight": len(gt[group])},
+                )
+                break
+        if soonest is not None and soonest.get("op") is not None:
+            o = soonest["op"]
+            if o is gen.PENDING:
+                return (gen.PENDING, ConcurrentGenerator(
+                    self.n, self.fgen, gt, tg, keys, gens))
+            gens2 = list(gens)
+            gens2[soonest["group"]] = soonest["gen'"]
+            return (o, ConcurrentGenerator(
+                self.n, self.fgen, gt, tg, keys, gens2))
+        if any(g is not None for g in gens):
+            return (gen.PENDING, ConcurrentGenerator(
+                self.n, self.fgen, gt, tg, keys, gens))
+        return None
+
+    def update(self, test, ctx, event):
+        if self.thread_group is None or self.gens is None:
+            return self
+        thread = gen.process_to_thread(ctx, event.get("process"))
+        group = self.thread_group.get(thread)
+        if group is None or self.gens[group] is None:
+            return self
+        gens = list(self.gens)
+        gens[group] = gen.update(gens[group], test, ctx, event)
+        return ConcurrentGenerator(
+            self.n, self.fgen, self.group_threads, self.thread_group,
+            self.keys, gens)
+
+
+def concurrent_generator(n: int, keys: Iterable, fgen: Callable):
+    """n threads per key, keys taken in order as groups free up
+    (independent.clj:211-236)."""
+    assert isinstance(n, int) and n > 0
+    return gen.clients(ConcurrentGenerator(n, fgen, keys=list(keys)))
+
+
+# ---------------------------------------------------------------------------
+# History sharding (independent.clj:238-261)
+
+
+def history_keys(history) -> set:
+    ks = set()
+    for op in history:
+        v = op.value if isinstance(op, Op) else op.get("value")
+        if is_tuple(v):
+            ks.add(v.key)
+    return ks
+
+
+def subhistory(k, history) -> History:
+    """Ops without a differing key, tuples unwrapped
+    (independent.clj:250-261)."""
+    out = []
+    for op in history:
+        v = op.value if isinstance(op, Op) else op.get("value")
+        if not is_tuple(v):
+            out.append(op)
+        elif v.key == k:
+            out.append(op.with_(value=v.value) if isinstance(op, Op)
+                       else {**op, "value": v.value})
+    return History(out, reindex=False) if all(
+        isinstance(o, Op) for o in out
+    ) else out
+
+
+# ---------------------------------------------------------------------------
+# Lifted checker (independent.clj:263-314)
+
+
+class _IndependentChecker(Checker):
+    def __init__(self, checker: Checker):
+        self.checker = checker
+
+    def check(self, test, history, opts=None):
+        opts = opts or {}
+        ks = sorted(history_keys(history), key=repr)
+        subs = {k: subhistory(k, history) for k in ks}
+        batch = getattr(self.checker, "batch_check", None)
+        if batch is not None and len(ks) > 1:
+            try:
+                results = batch(test, subs, opts)
+            except Exception:
+                LOG.warning(
+                    "batched independent check failed; falling back to "
+                    "per-key checking", exc_info=True)
+                results = None
+        else:
+            results = None
+        if results is None:
+            pairs = real_pmap(
+                lambda k: (k, check_safe(self.checker, test, subs[k], opts)),
+                ks,
+            )
+            results = dict(pairs)
+        self._store_subresults(test, subs, results, opts)
+        failures = [k for k in ks if results[k].get("valid") is not True]
+        return {
+            "valid": merge_valid(r.get("valid") for r in results.values()),
+            "results": results,
+            "failures": failures,
+        }
+
+    def _store_subresults(self, test, subs, results, opts):
+        """Write per-key history.edn + results.edn under
+        store/<…>/independent/<k>/ (independent.clj:288-301)."""
+        if not (test.get("name") and test.get("start-time")) or test.get(
+            "no-store?"
+        ):
+            return
+        from . import store
+
+        for k, res in results.items():
+            sub = subs[k]
+            d = store.path_mk(test, DIR, str(k), "x").parent
+            d.mkdir(parents=True, exist_ok=True)
+            try:
+                h = sub if isinstance(sub, History) else History(
+                    [Op.from_dict(o) if isinstance(o, dict) else o
+                     for o in sub], reindex=False)
+                h.save(d / "history.edn")
+                with open(d / "results.edn", "w") as f:
+                    f.write(store.edn.write_string(store.to_edn_value(res)))
+                    f.write("\n")
+            except Exception:
+                LOG.warning("could not store independent results for %r", k,
+                            exc_info=True)
+
+
+def checker(inner: Checker) -> Checker:
+    """Lift ``inner`` over [k v]-tuple histories; valid iff valid for every
+    key's subhistory (independent.clj:263-314). When ``inner`` supports
+    ``batch_check`` (e.g. the linearizable checker), all keys are decided
+    in one batched device program."""
+    return _IndependentChecker(inner)

@@ -64,7 +64,7 @@ INT32_MAX = np.int32(2**31 - 1)
 # Escalation resumes from the last completed level (lossless), so starting
 # tiny is nearly free and keeps the common case (frontier of a handful of
 # configs) cheap.
-F_SCHEDULE = (16, 128, 1024, 8192, 65536)
+F_SCHEDULE = (16, 128, 1024, 8192, 32768)
 
 
 def _next_pow2(x: int, lo: int = 32) -> int:
@@ -75,18 +75,47 @@ def _next_pow2(x: int, lo: int = 32) -> int:
 # Kernel construction (one compiled program per static shape bucket + model)
 
 
+@functools.lru_cache(maxsize=1)
+def _enable_compile_cache() -> None:
+    """Persist compiled programs across processes — the kernel's
+    multi-operand sorts take 15-90 s to compile per (shape, capacity)
+    bucket on TPU."""
+    import os
+
+    import jax
+
+    try:
+        if (
+            jax.config.jax_compilation_cache_dir
+            or os.environ.get("JAX_COMPILATION_CACHE_DIR")
+        ):
+            return  # respect an existing cache configuration
+        d = os.path.join(os.path.expanduser("~"), ".cache", "jax_jepsen")
+        os.makedirs(d, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", d)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 2)
+    except Exception:  # pragma: no cover - older jax without these flags
+        pass
+
+
 @functools.lru_cache(maxsize=64)
-def _build_kernel(model_key, F: int, W: int, KO: int, S: int, ND: int, NO: int,
-                  full_dedup: bool = False):
+def _build_kernel(model_key, F: int, W: int, KO: int, S: int, ND: int, NO: int):
     """Returns a jitted BFS driver with static shapes.
 
     model_key = (model-class, cache signature) — step_jax must be a pure
     function of the class + signature.
-    """
+
+    TPU shape notes (calibrated on-chip): in-loop gathers cost ~0.3 ms
+    regardless of payload width (so the five window tables are packed into
+    ONE [ND, 8] gather), multi-operand `lax.sort` costs ~30-70 µs at 64k
+    rows (so dedup + compaction are TWO sorts and a static slice — no
+    cumsum/searchsorted/permutation-gather chains, which cost ~1 ms each),
+    and `searchsorted` is never used on the hot path."""
     import jax
     import jax.numpy as jnp
     from jax import lax
 
+    _enable_compile_cache()
     model_cls, _sig, model_args = model_key
     model = model_cls._from_cache_key(model_args)
     KD = W // 32
@@ -122,16 +151,23 @@ def _build_kernel(model_key, F: int, W: int, KO: int, S: int, ND: int, NO: int,
         idx = jnp.arange(KD, dtype=jnp.int32)
         src_lo = idx + sw  # [.., KD]
         src_hi = src_lo + 1
-        lo = jnp.where(
-            src_lo < KD,
-            jnp.take_along_axis(mask, jnp.minimum(src_lo, KD - 1), axis=-1),
-            u32(0),
-        )
-        hi = jnp.where(
-            src_hi < KD,
-            jnp.take_along_axis(mask, jnp.minimum(src_hi, KD - 1), axis=-1),
-            u32(0),
-        )
+
+        def pick(src):  # word at index src, 0 beyond KD — select-chain:
+            # constant-index selects stay elementwise on TPU, where a
+            # take_along_axis would lower to a (slow) general gather.
+            if KD <= 8:
+                out = jnp.zeros_like(mask)
+                for k in range(KD):
+                    out = jnp.where(src == k, mask[..., k : k + 1], out)
+                return out
+            return jnp.where(
+                src < KD,
+                jnp.take_along_axis(mask, jnp.minimum(src, KD - 1), axis=-1),
+                u32(0),
+            )
+
+        lo = pick(src_lo)
+        hi = pick(src_hi)
         out = (lo >> sb) | jnp.where(sb == 0, u32(0), hi << ((u32(32) - sb) % u32(32)))
         return out
 
@@ -139,11 +175,7 @@ def _build_kernel(model_key, F: int, W: int, KO: int, S: int, ND: int, NO: int,
         nD,
         nO,
         max_levels,
-        invD,
-        retD,
-        opD,
-        a1D,
-        a2D,
+        tabD,  # [ND, 8] packed (inv, ret, op, a1, a2, pad…) — ONE gather/level
         sufretD,  # [ND+1]
         invO,
         opO,
@@ -155,6 +187,11 @@ def _build_kernel(model_key, F: int, W: int, KO: int, S: int, ND: int, NO: int,
         fr_st,  # [F, S]
         fr_valid,  # [F] bool
         lvl0,  # i32 starting level
+        lossy,  # i32: nonzero = beam mode — on overflow keep the best F
+        # configs (by progress p) and continue instead of stopping. An
+        # ``accepted`` verdict stays sound under truncation; a refutation
+        # does not, so the driver reports "unknown" instead of False once
+        # any lossy level ran.
     ):
         ow = np.int32(W)
         word_of_slot = slots // 32
@@ -162,15 +199,25 @@ def _build_kernel(model_key, F: int, W: int, KO: int, S: int, ND: int, NO: int,
         oword_of_slot = oslots // 32
         obit_of_slot = (oslots % 32).astype(np.uint32)
 
+        # Open-op rows use STATIC slot indices — hoistable out of the loop.
+        if KO:
+            oc = jnp.minimum(oslots, NO - 1)
+            o_in_row = (oslots < nO)[None, :]
+            invo_row = jnp.where(o_in_row, invO[oc][None, :], INT32_MAX)
+            opO_row = jnp.broadcast_to(opO[oc][None, :], (F, OB))
+            a1O_row = jnp.broadcast_to(a1O[oc][None, :], (F, OB))
+            a2O_row = jnp.broadcast_to(a2O[oc][None, :], (F, OB))
+
         def level(carry):
             p, mD, mO, st, valid, lvl, acc, ovf, fmax = carry
 
             rows = p[:, None] + slots[None, :]  # [F, W]
             in_rng = rows < nD
             rc = jnp.minimum(rows, ND - 1)
-            retw = jnp.where(in_rng, retD[rc], INT32_MAX)
-            invw = jnp.where(in_rng, invD[rc], INT32_MAX)
-            bits = (mD[:, word_of_slot] >> bit_of_slot[None, :]) & u32(1)
+            win = tabD[rc]  # [F, W, 8] — the level's single dynamic gather
+            invw = jnp.where(in_rng, win[..., 0], INT32_MAX)
+            retw = jnp.where(in_rng, win[..., 1], INT32_MAX)
+            bits = (jnp.repeat(mD, 32, axis=1)[:, :W] >> bit_of_slot[None, :]) & u32(1)
             linz = bits == u32(1)
             unlin = in_rng & ~linz
             vals = jnp.where(unlin, retw, INT32_MAX)
@@ -186,30 +233,23 @@ def _build_kernel(model_key, F: int, W: int, KO: int, S: int, ND: int, NO: int,
             cand_D = unlin & (invw < minret_excl)  # [F, W]
 
             if KO:
-                obits = (mO[:, oword_of_slot] >> obit_of_slot[None, :]) & u32(1)
-                o_in = oslots[None, :] < nO
-                invo = jnp.where(
-                    o_in, invO[jnp.minimum(oslots, NO - 1)][None, :], INT32_MAX
+                obits = (
+                    jnp.repeat(mO, 32, axis=1)[:, :OB] >> obit_of_slot[None, :]
+                ) & u32(1)
+                cand_O = o_in_row & (obits == u32(0)) & (
+                    invo_row < minret_all[:, None]
                 )
-                cand_O = o_in & (obits == u32(0)) & (invo < minret_all[:, None])
             else:
                 cand_O = jnp.zeros((F, 0), dtype=bool)
 
             # --- model transition over all F*C candidate pairs -------------
-            opw = jnp.where(in_rng, opD[rc], 0)
-            a1w = jnp.where(in_rng, a1D[rc], 0)
-            a2w = jnp.where(in_rng, a2D[rc], 0)
+            opw = jnp.where(in_rng, win[..., 2], 0)
+            a1w = jnp.where(in_rng, win[..., 3], 0)
+            a2w = jnp.where(in_rng, win[..., 4], 0)
             if KO:
-                oc = jnp.minimum(oslots, NO - 1)
-                opc = jnp.concatenate(
-                    [opw, jnp.broadcast_to(opO[oc][None, :], (F, OB))], axis=1
-                )
-                a1c = jnp.concatenate(
-                    [a1w, jnp.broadcast_to(a1O[oc][None, :], (F, OB))], axis=1
-                )
-                a2c = jnp.concatenate(
-                    [a2w, jnp.broadcast_to(a2O[oc][None, :], (F, OB))], axis=1
-                )
+                opc = jnp.concatenate([opw, opO_row], axis=1)
+                a1c = jnp.concatenate([a1w, a1O_row], axis=1)
+                a2c = jnp.concatenate([a2w, a2O_row], axis=1)
                 cand = jnp.concatenate([cand_D, cand_O], axis=1)
             else:
                 opc, a1c, a2c, cand = opw, a1w, a2w, cand_D
@@ -235,75 +275,104 @@ def _build_kernel(model_key, F: int, W: int, KO: int, S: int, ND: int, NO: int,
 
             acc_now = jnp.any(nvalid & (np_ >= nD))
 
-            # --- compact + dedup -------------------------------------------
-            # TPU-shaped: no scatters (XLA serializes colliding scatters on
-            # TPU) and no M-wide sort. (1) gather the valid candidates into a
-            # P = min(M, 8F) buffer via cumsum + searchsorted; >P survivors
-            # is treated as frontier overflow (lossless: the pre-expansion
-            # frontier is kept and the search resumes at a larger F).
-            # (2) sort the P buffer by a 64-bit FNV-style hash; exact
-            # duplicate rows hash equal and land adjacent, so one neighbor
-            # compare (on the full columns, so a collision can only *miss* a
-            # dedup — soundness unaffected) marks them. (3) gather the first
-            # F kept rows, again via cumsum + searchsorted.
-            cols = [np_.astype(jnp.uint32)]
-            cols += [nmD[:, w] for w in range(KD)]
-            if KO:
-                cols += [nmO[:, w] for w in range(KO)]
-            cols += [lax.bitcast_convert_type(st2[:, i], jnp.uint32) for i in range(S)]
-
-            # At the terminal escalation capacity (full_dedup), dedup over
-            # the whole expansion so heavy duplication can't force a
-            # spurious "unknown"; below it, the 8F buffer is cheaper and
-            # overflow escalates losslessly.
-            P = M if full_dedup else min(M, max(8 * F, 64))
-            posv = jnp.cumsum(nvalid.astype(jnp.int32))
-            n_cand = posv[M - 1]
-            pre_ovf = n_cand > P
-            vidx = jnp.searchsorted(
-                posv, jnp.arange(1, P + 1, dtype=jnp.int32), side="left"
+            # --- dedup + dominance prune + compact: two sorts, no gathers --
+            # Sort the FULL expansion by (validity, group-hash, open-mask):
+            # rows with equal (p, maskD, state) — one *group* — land
+            # adjacent (modulo hash collision, which can only cost a missed
+            # prune: all compares below are on the real columns), ordered by
+            # open-mask within the group.
+            pcol = np_.astype(jnp.uint32)
+            dcols = [nmD[:, w] for w in range(KD)]
+            scols = [
+                lax.bitcast_convert_type(st2[:, i], jnp.uint32) for i in range(S)
+            ]
+            ocols = [nmO[:, w] for w in range(max(KO, 1))]
+            gh1 = jnp.full((M,), u32(2166136261))
+            gh2 = jnp.full((M,), u32(0x9E3779B9))
+            for c in [pcol] + dcols + scols:
+                gh1 = (gh1 ^ c) * u32(16777619)
+                gh2 = (gh2 ^ (c + u32(0x85EBCA6B))) * u32(0xC2B2AE35)
+            key0 = (~nvalid).astype(u32)  # valid rows first
+            n_keys = 3 + len(ocols)
+            sorted_ = lax.sort(
+                tuple([key0, gh1, gh2] + ocols + [pcol] + dcols + scols),
+                dimension=0,
+                num_keys=n_keys,
             )
-            vidx = jnp.minimum(vidx, M - 1)
-            pvalid = lax.iota(jnp.int32, P) < jnp.minimum(n_cand, P)
-            pcols = [c[vidx] for c in cols]
+            skey0 = sorted_[0]
+            socols = list(sorted_[3:3 + len(ocols)])
+            spcol = sorted_[3 + len(ocols)]
+            sdcols = list(sorted_[4 + len(ocols):4 + len(ocols) + KD])
+            sscols = list(sorted_[4 + len(ocols) + KD:])
+            svalid = skey0 == u32(0)
 
-            h1 = jnp.full((P,), u32(2166136261))
-            h2 = jnp.full((P,), u32(0x9E3779B9))
-            for c in pcols:
-                h1 = (h1 ^ c) * u32(16777619)
-                h2 = (h2 ^ (c + u32(0x85EBCA6B))) * u32(0xC2B2AE35)
-            key0 = (~pvalid).astype(jnp.uint32)
-            iota = lax.iota(jnp.int32, P)
-            _, _, _, perm = lax.sort((key0, h1, h2, iota), dimension=0, num_keys=3)
-            gvalid = pvalid[perm]
-            gcols = [c[perm] for c in pcols]
-            same = jnp.ones((P,), dtype=bool)
-            for c in gcols:
-                same = same & jnp.concatenate([jnp.zeros((1,), bool), c[1:] == c[:-1]])
-            prev_valid = jnp.concatenate([jnp.zeros((1,), bool), gvalid[:-1]])
-            keep = gvalid & ~(same & prev_valid)
-            pos = jnp.cumsum(keep.astype(jnp.int32))
-            count = pos[P - 1]
-            ovf_now = pre_ovf | (count > F)
+            def shifted(c, fill):
+                return jnp.concatenate([jnp.full((1,), fill, c.dtype), c[:-1]])
 
-            oidx = jnp.searchsorted(
-                pos, jnp.arange(1, F + 1, dtype=jnp.int32), side="left"
-            )
-            oidx = jnp.minimum(oidx, P - 1)
-            kvalid = lax.iota(jnp.int32, F) < jnp.minimum(count, F)
-            kp = gcols[0][oidx].astype(jnp.int32) * kvalid
-            kmD = jnp.stack(
-                [gcols[1 + w][oidx] * kvalid for w in range(KD)], axis=1
-            )
-            if KO:
-                kmO = jnp.stack(
-                    [gcols[1 + KD + w][oidx] * kvalid for w in range(KO)], axis=1
+            prev_valid = shifted(svalid, False)
+            same_group = svalid & prev_valid
+            for c in [spcol] + sdcols + sscols:
+                same_group = same_group & (c == shifted(c, u32(0xFFFFFFFF)))
+            # Adjacent-subset rule: predecessor's open-set ⊆ ours ⇒ we are
+            # subsumed (covers exact duplicates too). Sound by induction
+            # even when the predecessor was itself dropped.
+            prev_sub = same_group
+            for c in socols:
+                prev_sub = prev_sub & ((shifted(c, u32(0)) & ~c) == u32(0))
+            # Group-head rule: the group's first row has the numerically
+            # smallest open-mask; propagate it down the group (log-shift
+            # segmented copy) and drop any superset of it.
+            is_start = svalid & ~same_group
+            head = list(socols)
+            done = is_start
+            d = 1
+            while d < M:
+                prev_head = [
+                    jnp.concatenate([h[:d], h[:-d]]) for h in head
+                ]
+                prev_done = jnp.concatenate(
+                    [jnp.ones((d,), bool), done[:-d]]
                 )
-            else:
-                kmO = jnp.zeros((F, 1), jnp.uint32)
+                head = [
+                    jnp.where(done, h, ph) for h, ph in zip(head, prev_head)
+                ]
+                done = done | prev_done
+                d *= 2
+            head_sub = svalid & ~is_start
+            for h, c in zip(head, socols):
+                head_sub = head_sub & ((h & ~c) == u32(0))
+            # (The done-flag propagation stops at is_start rows, so
+            # head[i] always comes from row i's own segment.)
+            keep = svalid & ~(same_group & prev_sub) & ~head_sub
+            count = jnp.sum(keep.astype(jnp.int32))
+            ovf_now = count > F
+
+            # Compaction: one stable sort brings kept rows to the front,
+            # most-advanced (largest p) first — so beam-mode truncation
+            # keeps the configs closest to acceptance; a static slice
+            # takes the first F.
+            ck = (~keep).astype(u32)
+            comp = lax.sort(
+                tuple([ck, ~spcol, spcol] + sdcols + socols + sscols),
+                dimension=0,
+                num_keys=2,
+                is_stable=True,
+            )
+            kvalid = lax.iota(jnp.int32, F) < jnp.minimum(count, F)
+            top = lambda c: lax.slice_in_dim(c, 0, F, axis=0)
+            kp = top(comp[2]).astype(jnp.int32) * kvalid
+            kmD = jnp.stack(
+                [top(comp[3 + w]) * kvalid for w in range(KD)], axis=1
+            )
+            kmO = jnp.stack(
+                [top(comp[3 + KD + w]) * kvalid for w in range(max(KO, 1))],
+                axis=1,
+            )
             kst = jnp.stack(
                 [
-                    lax.bitcast_convert_type(gcols[1 + KD + KO + i][oidx], jnp.int32)
+                    lax.bitcast_convert_type(
+                        top(comp[3 + KD + max(KO, 1) + i]), jnp.int32
+                    )
                     * kvalid
                     for i in range(S)
                 ],
@@ -311,15 +380,17 @@ def _build_kernel(model_key, F: int, W: int, KO: int, S: int, ND: int, NO: int,
             )
 
             # On overflow keep the pre-expansion frontier intact so the
-            # search can resume losslessly at a larger capacity.
-            sel = lambda new, old: jnp.where(ovf_now, old, new)
+            # search can resume losslessly at a larger capacity — unless
+            # in beam mode, where the truncated frontier advances.
+            lossy_b = lossy != 0
+            sel = lambda new, old: jnp.where(ovf_now & ~lossy_b, old, new)
             return (
                 sel(kp, p),
                 sel(kmD, mD),
                 sel(kmO, mO),
                 sel(kst, st),
                 sel(kvalid, valid),
-                jnp.where(ovf_now | (count == 0), lvl, lvl + 1),
+                jnp.where((ovf_now & ~lossy_b) | (count == 0), lvl, lvl + 1),
                 acc | acc_now,
                 ovf | ovf_now,
                 jnp.maximum(fmax, jnp.minimum(count, F).astype(jnp.int32)),
@@ -327,7 +398,12 @@ def _build_kernel(model_key, F: int, W: int, KO: int, S: int, ND: int, NO: int,
 
         def cond(carry):
             _p, _mD, _mO, _st, valid, lvl, acc, ovf, _fm = carry
-            return (~acc) & (~ovf) & jnp.any(valid) & (lvl < max_levels)
+            return (
+                (~acc)
+                & ((lossy != 0) | (~ovf))
+                & jnp.any(valid)
+                & (lvl < max_levels)
+            )
 
         init = (
             fr_p,
@@ -356,6 +432,15 @@ def _build_batch_kernel(model_key, F: int, W: int, KO: int, S: int, ND: int, NO:
 
     raw, _ = _build_kernel(model_key, F, W, KO, S, ND, NO)
     return jax.jit(jax.vmap(raw))
+
+
+def _levels_per_call(M: int, target_s: float = 3.0) -> int:
+    """Bound single-program wall time: the TPU runtime (and the relay in
+    front of it) kills long-running programs, which is what crashed the
+    worker on long histories. Empirical per-level cost ≈ 0.35 ms fixed
+    (window gather) + 12 ns × M (sorts + streaming over the expansion)."""
+    est = 3.5e-4 + 1.2e-8 * M
+    return max(8, min(4096, int(target_s / est)))
 
 
 # ---------------------------------------------------------------------------
@@ -475,15 +560,21 @@ def plan_device(
     if nD:
         sufret[:nD] = np.minimum.accumulate(retD[::-1])[::-1]
 
+    # Pack the five determinate-op tables into one [ND, 8] array so each
+    # BFS level costs ONE dynamic gather (TPU gathers are latency-bound,
+    # ~0.3 ms regardless of payload width).
+    tabD = np.zeros((ND, 8), dtype=np.int32)
+    tabD[:, 0] = padD(invD)
+    tabD[:, 1] = padD(retD)
+    tabD[:, 2] = padD(opD)
+    tabD[:, 3] = padD(a1D)
+    tabD[:, 4] = padD(a2D)
+
     args = (
         np.int32(nD),
         np.int32(nO),
         np.int32(nD + nO + 1),
-        padD(invD),
-        padD(retD),
-        padD(opD),
-        padD(a1D),
-        padD(a2D),
+        tabD,
         sufret,
         padO(invO),
         padO(opO),
@@ -500,17 +591,19 @@ def check_encoded_device(
     f_schedule=F_SCHEDULE,
     max_open: int = 128,
     window_cap: int = 1024,
-    levels_per_call: int = 512,
+    levels_per_call: Optional[int] = None,
 ) -> dict:
     """Decide linearizability of an encoded history on the default JAX
     backend (TPU when present). Result map mirrors the host oracle
     (`wgl_host.check_encoded`) plus device diagnostics.
 
     The BFS is chunked: each device call runs at most ``levels_per_call``
-    levels (the kernel's ``max_levels`` argument is dynamic, so chunking
-    costs no recompiles), then the host resumes from the returned frontier.
-    Bounding single-program runtime keeps the TPU runtime's watchdog happy
-    on long histories and gives the host a progress heartbeat."""
+    levels (default: scaled to keep one program under a few seconds at the
+    current frontier capacity — the kernel's ``max_levels`` argument is
+    dynamic, so chunking costs no recompiles), then the host resumes from
+    the returned frontier. Bounding single-program runtime keeps the TPU
+    runtime's watchdog happy on long histories and gives the host a
+    progress heartbeat."""
     t0 = _time.perf_counter()
     n = enc.n
     plan = plan_device(enc, max_open=max_open, window_cap=window_cap)
@@ -526,7 +619,7 @@ def check_encoded_device(
     mk = _model_cache_key(enc.model)
     attempts = []
     fmax_all = 1
-    fr = initial_frontier(f_schedule[0], W, KO, S, plan.init_state)
+    schedule = sorted(set(f_schedule))
 
     def result(valid, lvl, **extra):
         r = {
@@ -542,41 +635,83 @@ def check_encoded_device(
         r.update(extra)
         return r
 
-    for F in f_schedule:
-        _, kern = _build_kernel(
-            mk, F, W, KO, S, ND, NO, full_dedup=(F == f_schedule[-1])
-        )
-        fr = _pad_frontier(fr, F)
-        attempt = {"F": F, "levels": 0, "calls": 0}
-        attempts.append(attempt)
-        while True:
-            lvl0 = int(fr[-1])
-            budget = np.int32(min(total_levels, lvl0 + levels_per_call))
-            call_args = plan.args[:2] + (budget,) + plan.args[3:]
-            out = [np.asarray(x) for x in kern(*call_args, *fr)]
-            acc, ovf, nonempty, lvl, fmax = out[:5]
-            fr = tuple(out[5:]) + (lvl,)  # resume point (next chunk or next F)
-            fmax_all = max(fmax_all, int(fmax))
-            attempt["levels"] = int(lvl)
-            attempt["calls"] += 1
-            if bool(acc):
-                return result(True, lvl)
-            if bool(ovf):
-                break  # escalate frontier capacity, resuming from `fr`
-            if not bool(nonempty):
-                return result(False, lvl, max_linearized=int(lvl))
-            if int(lvl) >= total_levels:
+    def pick_capacity(count: int) -> int:
+        """Smallest scheduled capacity with ≥4x headroom over the current
+        frontier (frontier sizes spike transiently — probe data shows
+        steady counts orders of magnitude below the peaks, so capacity
+        must fall back down after a spike or every later level pays the
+        spike's cost)."""
+        for F in schedule:
+            if F >= 4 * count:
+                return F
+        return schedule[-1]
+
+    F = schedule[0]
+    fr = initial_frontier(F, W, KO, S, plan.init_state)
+    # Beam (lossy) mode is active ONLY at the top capacity: there is no
+    # lossless escalation left, so on overflow the kernel keeps the best F
+    # configs and continues. `truncated` records whether any level actually
+    # dropped configs — False verdicts are only sound when it never did.
+    truncated = False
+    while True:
+        _, kern = _build_kernel(mk, F, W, KO, S, ND, NO)
+        if fr[0].shape[0] < F:
+            fr = _pad_frontier(fr, F)
+        attempt = {"F": F, "levels": 0, "calls": 0, "wall_s": 0.0}
+        if attempts and attempts[-1]["F"] == F:
+            attempt = attempts[-1]
+        else:
+            attempts.append(attempt)
+        t_call = _time.perf_counter()
+        lpc = levels_per_call or _levels_per_call(F * (W + KO * 32))
+        lvl0 = int(fr[-1])
+        budget = np.int32(min(total_levels, lvl0 + lpc))
+        lossy = F == schedule[-1]
+        call_args = plan.args[:2] + (budget,) + plan.args[3:]
+        out = [np.asarray(x) for x in kern(*call_args, *fr, np.int32(lossy))]
+        acc, ovf, nonempty, lvl, fmax = out[:5]
+        fr = tuple(out[5:]) + (lvl,)  # resume point (next chunk / capacity)
+        fmax_all = max(fmax_all, int(fmax))
+        attempt["levels"] = int(lvl)
+        attempt["calls"] += 1
+        attempt["wall_s"] = round(attempt["wall_s"] + _time.perf_counter() - t_call, 3)
+        if lossy and bool(ovf):
+            truncated = True
+        if bool(acc):
+            # Sound even after truncation: dropping configs only removes
+            # accepting paths, never invents one.
+            return result(True, lvl, **({"beam": True} if truncated else {}))
+        if not bool(nonempty):
+            if truncated:
+                # A beam exhaustion is NOT a refutation — configs were
+                # dropped along the way.
                 return result(
-                    "unknown", lvl, info="level budget exhausted without verdict"
+                    "unknown", lvl,
+                    info=f"beam (lossy frontier, capacity {F}) exhausted",
+                    beam=True,
                 )
-    return {
-        "valid": "unknown",
-        "op_count": n,
-        "device": True,
-        "info": f"frontier capacity schedule {list(f_schedule)} exhausted",
-        "attempts": attempts,
-        "wall_s": _time.perf_counter() - t0,
-    }
+            return result(False, lvl, max_linearized=int(lvl))
+        if int(lvl) >= total_levels:
+            return result(
+                "unknown", lvl, info="level budget exhausted without verdict"
+            )
+        if bool(ovf) and not lossy:
+            # Escalate, resuming losslessly from the kept frontier. (At the
+            # top capacity the kernel already continued past the overflow
+            # as a greedy beam.)
+            F = schedule[schedule.index(F) + 1]
+        else:
+            # De-escalate when the frontier has shrunk: resume at the
+            # smallest adequate capacity (never below the last overflow's
+            # escalation floor... which transient spikes may re-trigger —
+            # that's fine, escalation is lossless).
+            count = int(np.asarray(fr[4]).sum())
+            F2 = pick_capacity(count)
+            if F2 < F:
+                fr = tuple(
+                    np.asarray(a)[:F2] if np.ndim(a) >= 1 else a for a in fr[:-1]
+                ) + (fr[-1],)
+                F = F2
 
 
 def check_history_device(model: Model, history: History, **kw) -> dict:

@@ -29,7 +29,9 @@ def _stack(plans, f: int, dims, mesh=None, batch_axis: str = "dp"):
     batch axis and (when a mesh is given) shard that axis across the mesh."""
     W, KO, S, _ND, _NO = dims
     full = [
-        p.args + wgl.initial_frontier(f, W, KO, S, p.init_state) for p in plans
+        p.args + wgl.initial_frontier(f, W, KO, S, p.init_state)
+        + (np.int32(0),)  # lossless mode in the shared batch pass
+        for p in plans
     ]
     cols = list(zip(*full))
     stacked = [np.stack(c, axis=0) for c in cols]

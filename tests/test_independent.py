@@ -1,0 +1,150 @@
+"""jepsen.independent ports: sequential/concurrent generators, subhistory,
+and the lifted checker (reference: jepsen/test/jepsen/independent_test.clj
+and generator_test.clj:386-451), plus the device-batched ~100-key check
+(VERDICT r1 item 7) on the 8-virtual-device mesh."""
+
+import random
+
+import pytest
+
+from jepsen_tpu import checker as jchecker
+from jepsen_tpu import generator as gen
+from jepsen_tpu import independent as ind
+from jepsen_tpu.generator import sim
+from jepsen_tpu.history import History, Op
+from jepsen_tpu.models import CasRegister
+
+
+def tpv(ops):
+    return [(o["time"], o["process"], o["value"]) for o in ops]
+
+
+class TestSequential:
+    def test_sequential(self):
+        # generator_test.clj:386-401
+        g = gen.clients(ind.sequential_generator(
+            ["x", "y"],
+            lambda k: gen.limit(3, [
+                {"type": "invoke", "value": i} for i in range(100)
+            ]),
+        ))
+        out = tpv(sim.perfect(g))
+        # Exact thread picks depend on the seeded RNG stream (ours differs
+        # from the JVM's); times, key order, and per-key value order are the
+        # semantics (generator_test.clj:386-401 expects the same shape).
+        assert [(t, v) for t, _p, v in out] == [
+            (0, ind.KV("x", 0)),
+            (0, ind.KV("x", 1)),
+            (10, ind.KV("x", 2)),
+            (10, ind.KV("y", 0)),
+            (20, ind.KV("y", 1)),
+            (20, ind.KV("y", 2)),
+        ]
+        assert {p for _t, p, _v in out} == {0, 1}
+
+
+class TestConcurrent:
+    def test_concurrent_groups(self):
+        # generator_test.clj:403-438: 3 groups of 2 threads over 5 keys,
+        # 3 values per key. Exact interleaving depends on the seeded RNG's
+        # weighted tie-breaks; assert the invariants the reference sequence
+        # demonstrates instead of the byte-exact order.
+        g = ind.concurrent_generator(
+            2, ["k0", "k1", "k2", "k3", "k4"],
+            lambda k: [{"type": "invoke", "value": v}
+                       for v in ("v0", "v1", "v2")],
+        )
+        ops = sim.perfect(g, sim.n_plus_nemesis_context(6))
+        assert len(ops) == 15  # 5 keys x 3 values
+        by_key = {}
+        for o in ops:
+            kv = o["value"]
+            assert isinstance(kv, ind.KV)
+            by_key.setdefault(kv.key, []).append(o)
+        # Every key's values appear in order, on threads of ONE group.
+        for k, kops in by_key.items():
+            assert [o["value"].value for o in kops] == ["v0", "v1", "v2"]
+            groups = {o["process"] // 2 for o in kops}
+            assert len(groups) == 1, (k, kops)
+        # First timeslice: all 3 groups work concurrently on k0..k2.
+        t0_keys = {o["value"].key for o in ops if o["time"] == 0}
+        assert t0_keys == {"k0", "k1", "k2"}
+
+    def test_deadlock_case(self):
+        # generator_test.clj:440-451: each-thread inside concurrent groups
+        # must not deadlock when keys run out.
+        g = gen.clients(gen.limit(5, ind.concurrent_generator(
+            2, iter(range(10**6)),
+            lambda k: gen.each_thread({"f": "meow"}),
+        )))
+        ops = sim.perfect(g)
+        assert len(ops) == 5
+        assert all(o["f"] == "meow" for o in ops)
+        assert all(isinstance(o["value"], ind.KV) for o in ops)
+
+
+class TestSubhistory:
+    def test_history_keys_and_subhistory(self):
+        h = [
+            {"type": "invoke", "process": 0, "f": "w", "value": ind.KV(1, "a")},
+            {"type": "ok", "process": 0, "f": "w", "value": ind.KV(1, "a")},
+            {"type": "info", "process": "nemesis", "f": "start", "value": None},
+            {"type": "invoke", "process": 1, "f": "w", "value": ind.KV(2, "b")},
+        ]
+        assert ind.history_keys(h) == {1, 2}
+        s1 = ind.subhistory(1, h)
+        assert [o["value"] for o in s1] == ["a", "a", None]
+        assert s1[2]["process"] == "nemesis"
+
+
+class TestChecker:
+    def test_even_checker(self):
+        # independent_test.clj:16-35: valid iff every subhistory valid.
+        even = jchecker.checker_fn(
+            lambda test, history, opts: {"valid": len(history) % 2 == 0},
+            "even",
+        )
+        h = []
+        for k in (1, 2, 3):
+            for i in range(k):
+                h.append(Op.from_dict({
+                    "type": "invoke", "process": 0, "f": "x",
+                    "value": ind.KV(k, i), "time": i, "index": len(h)}))
+        hist = History(h, reindex=False)
+        res = ind.checker(even).check({"no-store?": True}, hist, {})
+        assert res["valid"] is False
+        assert res["results"][1]["valid"] is False  # 1 op
+        assert res["results"][2]["valid"] is True
+        assert res["results"][3]["valid"] is False
+        assert res["failures"] == [1, 3]
+
+
+class TestDeviceBatch:
+    def test_100_keys_batched_on_mesh(self):
+        # ~100 per-key CAS subhistories decided as one sharded program.
+        from jepsen_tpu.parallel import make_mesh
+        from jepsen_tpu.testing import perturb_history, random_register_history
+
+        rng = random.Random(11)
+        model = CasRegister(init=0)
+        ops = []
+        bad_keys = set()
+        for k in range(100):
+            h = random_register_history(rng, n_ops=10, n_procs=2, crash_p=0.0)
+            if k % 9 == 0:
+                h = perturb_history(rng, h)
+                bad_keys.add(k)
+            for o in h:
+                ops.append(o.with_(value=ind.KV(k, o.value),
+                                   index=len(ops)))
+        hist = History(ops, reindex=False)
+        chk = ind.checker(jchecker.linearizable(model=model))
+        res = chk.check({"no-store?": True}, hist, {})
+        assert set(res["results"]) == set(range(100))
+        # perturb_history usually (not always) breaks linearizability; every
+        # reported failure must be a perturbed key, and clean keys all pass.
+        assert set(res["failures"]) <= bad_keys
+        for k in set(range(100)) - bad_keys:
+            assert res["results"][k]["valid"] is True
+        if res["failures"]:
+            assert res["valid"] is False
