@@ -235,12 +235,17 @@ def linearizable(options: Optional[dict] = None, **kw) -> Checker:
             backend = "device"
         if backend == "host" or not model.device_capable:
             raise RuntimeError("batch check requires the device backend")
-        from ..ops import wgl
-        from ..parallel import check_batch
+        import jax
 
+        from ..ops import wgl
+        from ..parallel import check_batch, make_mesh
+
+        # Shard the batch over every local device (the reference's
+        # bounded-pmap key axis, mapped onto the mesh's dp axis).
+        mesh = make_mesh() if len(jax.devices()) > 1 else None
         ks = list(keyed_histories)
         results = check_batch(
-            model, [keyed_histories[k].client_ops() for k in ks]
+            model, [keyed_histories[k].client_ops() for k in ks], mesh=mesh
         )
         out_map = dict(zip(ks, results))
         # Keys the shared batch couldn't decide (didn't fit the common

@@ -1,0 +1,180 @@
+"""Transactional-anomaly checker (the reference's elle dependency).
+
+The reference consumes elle for txn cycle checking
+(jepsen/src/jepsen/tests/cycle/append.clj:11-22, cycle/wr.clj:14-54,
+cycle.clj:9-16); elle itself is an external library. This package is the
+capability rebuilt TPU-first: interpretation layers
+(:mod:`jepsen_tpu.elle.append` for list-append histories,
+:mod:`jepsen_tpu.elle.wr` for read/write registers) construct a typed
+dependency graph, and cycle detection runs as dense boolean matrix
+closures on the MXU (:mod:`jepsen_tpu.elle.graph`), with a host Tarjan
+oracle for witnesses and differential testing.
+
+Anomaly taxonomy (cycle/wr.clj:31-45):
+
+- G0        cycle of ww edges only
+- G1a       aborted read (observed a failed txn's write)
+- G1b       intermediate read (observed a non-final write)
+- G1c       cycle of ww+wr edges (with at least one wr)
+- G-single  cycle with exactly one rw (anti-dependency) edge
+- G2        cycle with two or more rw edges ("G2-item")
+- internal  txn inconsistent with its own reads/writes
+- incompatible-order  reads of one key disagree on version order
+
+``G2 implies G-single and G1c; G1 implies G1a, G1b, G1c; G1c implies G0``
+— requesting an umbrella anomaly enables its implied set, mirroring the
+reference's option semantics.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Optional
+
+import numpy as np
+
+from .graph import (
+    DepGraph,
+    RW,
+    WR,
+    WW,
+    closure_host,
+    closures_device,
+    find_cycle_host,
+    find_cycle_with_edge_host,
+    sccs_host,
+)
+
+# Umbrella expansion (cycle/wr.clj:44-45).
+_EXPANSION = {
+    "G1": {"G1a", "G1b", "G1c", "G0"},  # G1c implies G0
+    "G1c": {"G0", "G1c"},
+    "G2": {"G2", "G-single", "G1c", "G0"},
+    "G-single": {"G-single", "G1c", "G0"},
+}
+
+DEFAULT_ANOMALIES = ("G1", "G2", "internal")
+
+# Device closures pay off once the matmul amortizes dispatch; below this
+# txn count the numpy closure wins.
+DEVICE_MIN_TXNS = 512
+
+
+def expand_anomalies(anomalies: Iterable[str]) -> set:
+    out: set = set()
+    for a in anomalies:
+        out |= _EXPANSION.get(a, {a})
+    return out
+
+
+def cycle_anomalies(g: DepGraph, device: Optional[bool] = None) -> dict:
+    """Classify cycles in a typed dependency graph. Returns
+    {anomaly-type: [witness]} where a witness is {"cycle": [txn indices],
+    "kinds": [edge kinds along it]}."""
+    n = g.n
+    if n == 0 or not g.edges:
+        return {}
+    adj = g.adjacency()
+    if device is None:
+        device = n >= DEVICE_MIN_TXNS
+    if device:
+        has_ww, has_wwr, has_full, c_wwr, c_full = closures_device(adj)
+    else:
+        c_ww = closure_host(adj, WW)
+        c_wwr = closure_host(adj, WW | WR)
+        c_full = closure_host(adj, 0xFF)
+        has_ww = bool(np.diag(c_ww).any())
+        has_wwr = bool(np.diag(c_wwr).any())
+        has_full = bool(np.diag(c_full).any())
+
+    out: dict = {}
+
+    if has_ww:
+        for scc in sccs_host(adj, WW):
+            cyc = find_cycle_host(adj, WW, scc)
+            if cyc:
+                out.setdefault("G0", []).append(_witness(g, cyc))
+                break
+    if has_wwr:
+        # A G1c witness must use >= 1 wr edge.
+        srcs, dsts = np.nonzero((adj & WR) > 0)
+        for a, b in zip(srcs.tolist(), dsts.tolist()):
+            if c_wwr[b, a]:
+                back = _path_host(adj, WW | WR, b, a)  # [b, ..., a]
+                if back:
+                    out.setdefault("G1c", []).append(_witness(g, [a, *back]))
+                    break
+    # rw-closing cycles.
+    srcs, dsts = np.nonzero((adj & RW) > 0)
+    g_single = None
+    g2 = None
+    for a, b in zip(srcs.tolist(), dsts.tolist()):
+        if g_single is None and c_wwr[b, a]:
+            cyc = find_cycle_with_edge_host(adj, WW | WR, a, b)
+            if cyc:
+                g_single = _witness(g, cyc)
+        if g2 is None and has_full and c_full[b, a] and not c_wwr[b, a]:
+            cyc = find_cycle_with_edge_host(adj, 0xFF, a, b)
+            if cyc:
+                g2 = _witness(g, cyc)
+        if g_single is not None and g2 is not None:
+            break
+    if g_single is not None:
+        out.setdefault("G-single", []).append(g_single)
+    if g2 is not None:
+        out.setdefault("G2", []).append(g2)
+    return out
+
+
+KIND_LOOKUP = {WW: "ww", WR: "wr", RW: "rw"}
+
+
+def _path_host(adj, mask, src, dst):
+    """Shortest src→dst node path over masked edges (BFS); [] if none,
+    else [src, ..., dst]."""
+    if src == dst:
+        return [src]
+    prev = {src: None}
+    frontier = [src]
+    while frontier:
+        nxt = []
+        for v in frontier:
+            for w in np.flatnonzero(adj[v] & mask):
+                w = int(w)
+                if w not in prev:
+                    prev[w] = v
+                    if w == dst:
+                        path = []
+                        node = w
+                        while node is not None:
+                            path.append(node)
+                            node = prev[node]
+                        return path[::-1]
+                    nxt.append(w)
+        frontier = nxt
+    return []
+
+
+def _witness(g: DepGraph, cycle: list[int]) -> dict:
+    if cycle[0] != cycle[-1]:
+        cycle = cycle + [cycle[0]]
+    kinds = []
+    for i in range(len(cycle) - 1):
+        k = g.edges.get((cycle[i], cycle[i + 1]), 0)
+        kinds.append([KIND_LOOKUP[b] for b in (WW, WR, RW) if k & b])
+    return {"cycle": cycle, "kinds": kinds}
+
+
+def result_map(anomalies: dict, requested: set, txn_of=None) -> dict:
+    """Shape the final checker result (elle-style): valid iff no requested
+    anomaly was found."""
+    found = {k: v for k, v in anomalies.items() if k in requested and v}
+    if txn_of is not None:
+        for ws in found.values():
+            for w in ws:
+                if "cycle" in w:
+                    w["txns"] = [txn_of(i) for i in w["cycle"]]
+    return {
+        "valid": not found,
+        "anomaly_types": sorted(found),
+        "anomalies": found,
+    }

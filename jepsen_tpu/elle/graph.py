@@ -1,0 +1,230 @@
+"""Dependency-graph cycle machinery for the transactional checker.
+
+The reference delegates txn-anomaly detection to elle (consumed at
+jepsen/src/jepsen/tests/cycle/append.clj:11-22, cycle/wr.clj:14-54), whose
+core is cycle search over a typed dependency graph (ww/wr/rw edges between
+transactions). TPU-first re-design:
+
+- **Device path** (:func:`closures_device`): the graph lives as a dense
+  bool adjacency matrix; transitive closure = ``ceil(log2 n)`` squarings
+  ``A ← A ∨ A·A`` where the bool matmul runs on the MXU in f32. One fused
+  jit computes the closures of the WW, WW∪WR, and full graphs — exactly
+  the masks the G0/G1c/G-single/G2 taxonomy needs (cycle/wr.clj:31-45).
+  n = #txns; a 10k-txn graph is a 10k×10k matmul chain — MXU territory.
+- **Host path** (:func:`sccs_host`): iterative Tarjan SCC — the oracle the
+  device path is differentially tested against, the witness-cycle
+  extractor for reports, and the small-n fast path.
+
+Edge kinds are bitmasks so one int8 matrix carries the typed graph.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Iterable, Optional
+
+import numpy as np
+
+WW = 1  # write -> write (version order)
+WR = 2  # write -> read  (reader observed writer)
+RW = 4  # read -> write  (anti-dependency: reader missed the next version)
+
+KIND_NAMES = {WW: "ww", WR: "wr", RW: "rw"}
+
+
+class DepGraph:
+    """Typed dependency graph over txn indices 0..n-1."""
+
+    def __init__(self, n: int):
+        self.n = n
+        self.edges: dict[tuple[int, int], int] = {}
+
+    def add(self, src: int, dst: int, kind: int) -> None:
+        if src == dst:
+            return  # self-deps are internal, not cycles
+        self.edges[(src, dst)] = self.edges.get((src, dst), 0) | kind
+
+    def adjacency(self) -> np.ndarray:
+        a = np.zeros((self.n, self.n), dtype=np.uint8)
+        for (s, d), kind in self.edges.items():
+            a[s, d] = kind
+        return a
+
+    def edge_list(self):
+        return [(s, d, k) for (s, d), k in sorted(self.edges.items())]
+
+
+# ---------------------------------------------------------------------------
+# Host oracle: Tarjan SCC + witness cycles
+
+
+def sccs_host(adj: np.ndarray, mask: int = 0xFF) -> list[list[int]]:
+    """Strongly connected components (size > 1, or self-loop) of the
+    subgraph with edge kinds in ``mask``. Iterative Tarjan."""
+    n = adj.shape[0]
+    succ = [np.flatnonzero(adj[i] & mask).tolist() for i in range(n)]
+    index = [-1] * n
+    low = [0] * n
+    on_stack = [False] * n
+    stack: list[int] = []
+    out: list[list[int]] = []
+    counter = [0]
+    for root in range(n):
+        if index[root] != -1:
+            continue
+        work = [(root, 0)]
+        while work:
+            v, pi = work[-1]
+            if pi == 0:
+                index[v] = low[v] = counter[0]
+                counter[0] += 1
+                stack.append(v)
+                on_stack[v] = True
+            advanced = False
+            for j in range(pi, len(succ[v])):
+                w = succ[v][j]
+                if index[w] == -1:
+                    work[-1] = (v, j + 1)
+                    work.append((w, 0))
+                    advanced = True
+                    break
+                if on_stack[w]:
+                    low[v] = min(low[v], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                pv = work[-1][0]
+                low[pv] = min(low[pv], low[v])
+            if low[v] == index[v]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    on_stack[w] = False
+                    comp.append(w)
+                    if w == v:
+                        break
+                if len(comp) > 1:
+                    out.append(sorted(comp))
+    return out
+
+
+def find_cycle_host(adj: np.ndarray, mask: int, scc: Iterable[int]
+                    ) -> Optional[list[int]]:
+    """A concrete cycle within ``scc`` using only ``mask`` edges (BFS from
+    each node back to itself); None if none exists. Returns node list
+    ``[a, b, …, a]``."""
+    nodes = set(int(x) for x in scc)
+    for start in sorted(nodes):
+        prev = {start: None}
+        frontier = [start]
+        while frontier:
+            nxt = []
+            for v in frontier:
+                for w in np.flatnonzero(adj[v] & mask):
+                    w = int(w)
+                    if w == start:
+                        # Reconstruct start → … → v → start.
+                        path = []
+                        node = v
+                        while node is not None:
+                            path.append(node)
+                            node = prev[node]
+                        path.reverse()  # [start, ..., v]
+                        return _normalize_cycle(path)
+                    if w in nodes and w not in prev:
+                        prev[w] = v
+                        nxt.append(w)
+            frontier = nxt
+    return None
+
+
+def _normalize_cycle(path: list[int]) -> list[int]:
+    if path[0] != path[-1]:
+        path = path + [path[0]]
+    return path
+
+
+def find_cycle_with_edge_host(adj: np.ndarray, back_mask: int,
+                              rw_src: int, rw_dst: int) -> Optional[list[int]]:
+    """A cycle that takes the single edge rw_src→rw_dst then returns to
+    rw_src via ``back_mask`` edges only (G-single witness)."""
+    n = adj.shape[0]
+    prev = {rw_dst: None}
+    frontier = [rw_dst]
+    while frontier:
+        nxt = []
+        for v in frontier:
+            for w in np.flatnonzero(adj[v] & back_mask):
+                w = int(w)
+                if w == rw_src:
+                    # Reconstruct rw_dst → … → v, then close the loop
+                    # rw_src → rw_dst … v → rw_src.
+                    path = []
+                    node = v
+                    while node is not None:
+                        path.append(node)
+                        node = prev[node]
+                    path.reverse()  # [rw_dst, ..., v]
+                    return _normalize_cycle([rw_src, *path])
+                if w not in prev:
+                    prev[w] = v
+                    nxt.append(w)
+        frontier = nxt
+    return None
+
+
+def closure_host(adj: np.ndarray, mask: int) -> np.ndarray:
+    """Boolean transitive closure of the masked subgraph (repeated
+    squaring, numpy)."""
+    a = (adj & mask) > 0
+    n = a.shape[0]
+    steps = max(1, int(np.ceil(np.log2(max(n, 2)))))
+    for _ in range(steps):
+        a2 = a | (a @ a)
+        if np.array_equal(a2, a):
+            break
+        a = a2
+    return a
+
+
+# ---------------------------------------------------------------------------
+# Device path: fused closures on the MXU
+
+
+@functools.lru_cache(maxsize=16)
+def _build_closures_kernel(n: int):
+    import jax
+    import jax.numpy as jnp
+
+    def close(a):  # [n, n] f32 0/1
+        def step(a, _):
+            return jnp.minimum(a + a @ a, 1.0), None
+        steps = max(1, int(np.ceil(np.log2(max(n, 2)))))
+        from jax import lax
+        a, _ = lax.scan(step, a, None, length=steps)
+        return a
+
+    def kernel(ww, wwr, full):
+        cw, cwr, cf = close(ww), close(wwr), close(full)
+        return (
+            jnp.any(jnp.diag(cw) > 0),
+            jnp.any(jnp.diag(cwr) > 0),
+            jnp.any(jnp.diag(cf) > 0),
+            cwr,
+            cf,
+        )
+
+    return jax.jit(kernel)
+
+
+def closures_device(adj: np.ndarray):
+    """Compute (has_ww_cycle, has_wwr_cycle, has_full_cycle,
+    closure(ww|wr), closure(full)) on the default JAX backend."""
+    n = adj.shape[0]
+    ww = ((adj & WW) > 0).astype(np.float32)
+    wwr = ((adj & (WW | WR)) > 0).astype(np.float32)
+    full = (adj > 0).astype(np.float32)
+    kern = _build_closures_kernel(n)
+    g0, g1c, g2, cwr, cf = kern(ww, wwr, full)
+    return bool(g0), bool(g1c), bool(g2), np.asarray(cwr) > 0, np.asarray(cf) > 0

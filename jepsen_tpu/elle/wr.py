@@ -1,0 +1,176 @@
+"""Read/write-register txn interpretation (elle.rw-register equivalent).
+
+Histories of transactions over registers with micro-ops ``["w", k, v]``
+and ``["r", k, v]``; writes are globally unique (cycle/wr.clj:2-4), so a
+read traces exactly to its writer (wr edges). Unlike list-append, the raw
+history does NOT recover a version order, so ww/rw edges need an extra
+assumption (cycle/wr.clj:20-30):
+
+- ``linearizable_keys=True``: each key independently linearizable — the
+  realtime order of ok writes per key is its version order.
+- ``sequential_keys=True``: each key sequentially consistent — version
+  order from per-process write order, merged by observation order.
+  (Implemented as: realtime per-process chains; cross-process order only
+  via reads — conservative.)
+- default: only wr edges + the direct anomalies (G1a, G1b, internal) —
+  what elle can infer with no assumptions.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Optional
+
+from . import DEFAULT_ANOMALIES, DepGraph, RW, WR, WW, cycle_anomalies, \
+    expand_anomalies, result_map
+from ..history import FAIL, INFO, OK
+from ..txn import ext_reads, ext_writes
+
+
+def _value(op):
+    return op.value if hasattr(op, "value") else op.get("value")
+
+
+def _type(op):
+    return op.type if hasattr(op, "type") else op.get("type")
+
+
+def _f(op):
+    return op.f if hasattr(op, "f") else op.get("f")
+
+
+def _proc(op):
+    return op.process if hasattr(op, "process") else op.get("process")
+
+
+def _ret_index(op):
+    idx = op.index if hasattr(op, "index") else op.get("index", -1)
+    return idx if idx is not None else -1
+
+
+def _invocation_indexes(history, oks):
+    """Map id(completion-op) -> invocation index, when the history is a
+    full paired History; None for bare completion lists (then only
+    program-order ww edges are derivable)."""
+    try:
+        from ..history import History
+
+        if not isinstance(history, History):
+            return None
+        return {
+            id(iv.completion): iv.invoke.index
+            for iv in history.pairs()
+            if iv.completion is not None
+        }
+    except Exception:
+        return None
+
+
+def check(history, anomalies: Iterable[str] = DEFAULT_ANOMALIES,
+          linearizable_keys: bool = False, sequential_keys: bool = False,
+          device: Optional[bool] = None) -> dict:
+    requested = expand_anomalies(anomalies)
+    oks = [op for op in history if _type(op) == OK and _f(op) == "txn"]
+    fails = [op for op in history if _type(op) == FAIL and _f(op) == "txn"]
+    problems: dict = {}
+
+    # Authorship: (k, v) -> ok txn index (writes unique).
+    author: dict = {}
+    for i, op in enumerate(oks):
+        for f, k, v in _value(op) or []:
+            if f == "w":
+                if (k, v) in author:
+                    problems.setdefault("duplicate-writes", []).append(
+                        {"key": k, "value": v})
+                author[(k, v)] = i
+    fail_writes = {
+        (k, v) for op in fails for f, k, v in _value(op) or [] if f == "w"
+    }
+
+    # Internal: a txn's reads must agree with its own prior writes/reads.
+    for op in oks:
+        seen: dict = {}
+        for f, k, v in _value(op) or []:
+            if f == "w":
+                seen[k] = v
+            elif f == "r" and v is not None:
+                if k in seen and seen[k] != v:
+                    problems.setdefault("internal", []).append(
+                        {"op": repr(op), "key": k, "expected": seen[k],
+                         "read": v})
+                seen[k] = v
+
+    # G1a: observing a failed write. G1b: observing a non-final write.
+    for op in oks:
+        for k, v in ext_reads(_value(op) or []).items():
+            if v is None:
+                continue
+            if (k, v) in fail_writes:
+                problems.setdefault("G1a", []).append(
+                    {"key": k, "value": v, "reader": repr(op)})
+            w = author.get((k, v))
+            if w is not None and ext_writes(_value(oks[w]) or []).get(k) != v:
+                problems.setdefault("G1b", []).append(
+                    {"key": k, "value": v, "reader": repr(op)})
+
+    g = DepGraph(len(oks))
+    # wr edges: writer -> reader (external reads only).
+    for ri, op in enumerate(oks):
+        for k, v in ext_reads(_value(op) or []).items():
+            w = author.get((k, v))
+            if w is not None and w != ri:
+                g.add(w, ri, WR)
+
+    if linearizable_keys or sequential_keys:
+        # Version order per key. Ordering two writes by raw ok-completion
+        # order is UNSOUND for concurrent txns (either order is legal), so
+        # an edge w1 -> w2 is added only when the order is forced:
+        # - same process: program order (the sequential_keys assumption);
+        # - linearizable_keys: true realtime precedence — w1's completion
+        #   strictly before w2's invocation, when invocation indexes are
+        #   recoverable from a full (paired) history.
+        inv_index = _invocation_indexes(history, oks)
+        writes_by_key: dict = {}
+        for i, op in enumerate(oks):
+            for k, v in ext_writes(_value(op) or []).items():
+                writes_by_key.setdefault(k, []).append((i, v))
+        for k, ws in writes_by_key.items():
+            chains: list[tuple[int, int]] = []
+            for a in range(len(ws)):
+                for b in range(a + 1, len(ws)):
+                    i1, _v1 = ws[a]
+                    i2, _v2 = ws[b]
+                    if i1 == i2:
+                        continue
+                    if _proc(oks[i1]) == _proc(oks[i2]):
+                        chains.append((i1, i2))
+                    elif (
+                        linearizable_keys
+                        and inv_index is not None
+                        and _ret_index(oks[i1]) < inv_index.get(id(oks[i2]),
+                                                               -1)
+                    ):
+                        chains.append((i1, i2))
+            for i1, i2 in chains:
+                g.add(i1, i2, WW)
+            # rw edges: reader of version v -> any write FORCED after v's
+            # writer (conservative: only chain successors).
+            succ: dict = {}
+            for i1, i2 in chains:
+                succ.setdefault(i1, set()).add(i2)
+            for ri, op in enumerate(oks):
+                r = ext_reads(_value(op) or []).get(k)
+                if r is None:
+                    continue
+                w = author.get((k, r))
+                if w is None:
+                    continue
+                for i2 in succ.get(w, ()):
+                    if i2 != ri:
+                        g.add(ri, i2, RW)
+
+    problems.update(cycle_anomalies(g, device=device))
+    res = result_map(
+        problems, requested | {"duplicate-writes"}, lambda i: repr(oks[i])
+    )
+    res["txn_count"] = len(oks)
+    return res

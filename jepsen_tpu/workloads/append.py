@@ -1,0 +1,46 @@
+"""List-append txn workload (jepsen.tests.cycle.append equivalent).
+
+Op shapes (cycle/append.clj:29-40)::
+
+    invoke {"f": "txn", "value": [["r", 3, None], ["append", 3, 2]]}
+    ok     {"f": "txn", "value": [["r", 3, [1]],  ["append", 3, 2]]}
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from .. import txn as jtxn
+from ..checker import Checker, checker_fn
+from ..elle import append as elle_append
+
+
+def checker(opts: Optional[dict] = None) -> Checker:
+    """Full checker for append/read histories (cycle/append.clj:11-22);
+    default anomalies [G1, G2] like the reference."""
+    o = dict(opts or {})
+    anomalies = o.get("anomalies", ["G1", "G2"])
+
+    def chk(test, history, copts):
+        return elle_append.check(
+            history, anomalies=anomalies,
+            device=o.get("device"),
+        )
+
+    return checker_fn(chk, "append")
+
+
+def gen(opts: Optional[dict] = None):
+    """Append-txn generator (cycle/append.clj:23-27)."""
+    o = dict(opts or {})
+    return jtxn.append_txns(
+        key_count=o.get("key_count", 3),
+        min_txn_length=o.get("min_txn_length", 1),
+        max_txn_length=o.get("max_txn_length", 4),
+        max_writes_per_key=o.get("max_writes_per_key", 32),
+    )
+
+
+def test(opts: Optional[dict] = None) -> dict:
+    """Partial test: generator + checker (cycle/append.clj:28-55)."""
+    return {"generator": gen(opts), "checker": checker(opts)}

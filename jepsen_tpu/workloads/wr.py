@@ -1,0 +1,44 @@
+"""Write/read register txn workload (jepsen.tests.cycle.wr equivalent).
+
+Anomaly taxonomy documented at cycle/wr.clj:31-45; writes are globally
+unique.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from .. import txn as jtxn
+from ..checker import Checker, checker_fn
+from ..elle import wr as elle_wr
+
+
+def checker(opts: Optional[dict] = None) -> Checker:
+    """cycle/wr.clj:14-54; default anomalies [G2, G1a, G1b, internal]."""
+    o = dict(opts or {})
+    anomalies = o.get("anomalies", ["G2", "G1a", "G1b", "internal"])
+
+    def chk(test, history, copts):
+        return elle_wr.check(
+            history,
+            anomalies=anomalies,
+            linearizable_keys=o.get("linearizable_keys", False),
+            sequential_keys=o.get("sequential_keys", False),
+            device=o.get("device"),
+        )
+
+    return checker_fn(chk, "wr")
+
+
+def gen(opts: Optional[dict] = None):
+    o = dict(opts or {})
+    return jtxn.wr_txns(
+        key_count=o.get("key_count", 2),
+        min_txn_length=o.get("min_txn_length", 1),
+        max_txn_length=o.get("max_txn_length", 2),
+        max_writes_per_key=o.get("max_writes_per_key", 32),
+    )
+
+
+def test(opts: Optional[dict] = None) -> dict:
+    return {"generator": gen(opts), "checker": checker(opts)}

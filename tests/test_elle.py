@@ -1,0 +1,252 @@
+"""Elle-equivalent txn checker tests: seeded anomalies of every class,
+txn-helper semantics (txn.clj:5-69), host/device closure agreement, and a
+simulated serializable history that must come back clean."""
+
+import random
+
+import numpy as np
+import pytest
+
+from jepsen_tpu import txn as jtxn
+from jepsen_tpu.elle import append as ea
+from jepsen_tpu.elle import graph as eg
+from jepsen_tpu.elle import wr as ew
+from jepsen_tpu.elle import cycle_anomalies, DepGraph, RW, WR, WW
+
+
+def T(value, type="ok", process=0):
+    return {"type": type, "f": "txn", "value": value, "process": process}
+
+
+class TestTxnHelpers:
+    def test_ext_reads(self):
+        # txn.clj:24-39: only first-access reads count.
+        t = [["r", "x", 1], ["w", "y", 2], ["r", "y", 3], ["r", "z", 4]]
+        assert jtxn.ext_reads(t) == {"x": 1, "z": 4}
+
+    def test_ext_writes(self):
+        t = [["w", "x", 1], ["w", "x", 2], ["r", "y", 3], ["w", "y", 4]]
+        assert jtxn.ext_writes(t) == {"x": 2, "y": 4}
+
+    def test_int_write_mops(self):
+        t = [["w", "x", 1], ["w", "x", 2], ["w", "y", 3]]
+        assert jtxn.int_write_mops(t) == {"x": [["w", "x", 1]]}
+
+
+class TestGraph:
+    def seeded_graph(self, n, rng, p=0.05):
+        g = DepGraph(n)
+        for _ in range(int(n * n * p)):
+            s, d = rng.randrange(n), rng.randrange(n)
+            if s != d:
+                g.add(s, d, rng.choice([WW, WR, RW]))
+        return g
+
+    def test_host_device_closure_agreement(self):
+        rng = random.Random(0)
+        for n in (8, 40, 130):
+            g = self.seeded_graph(n, rng)
+            adj = g.adjacency()
+            h_ww = eg.closure_host(adj, WW)
+            d = eg.closures_device(adj)
+            assert bool(np.diag(h_ww).any()) == d[0]
+            h_wwr = eg.closure_host(adj, WW | WR)
+            assert np.array_equal(h_wwr, d[3])
+            h_full = eg.closure_host(adj, 0xFF)
+            assert np.array_equal(h_full, d[4])
+
+    def test_scc_and_cycle(self):
+        g = DepGraph(5)
+        g.add(0, 1, WW)
+        g.add(1, 2, WW)
+        g.add(2, 0, WW)
+        g.add(3, 4, WR)
+        adj = g.adjacency()
+        sccs = eg.sccs_host(adj, 0xFF)
+        assert sccs == [[0, 1, 2]]
+        cyc = eg.find_cycle_host(adj, WW, sccs[0])
+        assert cyc[0] == cyc[-1] and set(cyc) == {0, 1, 2}
+
+
+class TestAppendAnomalies:
+    def test_clean_serial(self):
+        h = [
+            T([["append", "x", 1]]),
+            T([["r", "x", [1]], ["append", "x", 2]]),
+            T([["r", "x", [1, 2]]]),
+        ]
+        res = ea.check(h)
+        assert res["valid"] is True
+        assert res["anomaly_types"] == []
+
+    def test_g1a_aborted_read(self):
+        h = [
+            T([["append", "x", 1]], type="fail"),
+            T([["r", "x", [1]]]),
+        ]
+        res = ea.check(h)
+        assert "G1a" in res["anomaly_types"]
+        assert res["valid"] is False
+
+    def test_g1b_intermediate_read(self):
+        h = [
+            T([["append", "x", 1], ["append", "x", 2]]),
+            T([["r", "x", [1]]]),
+            T([["r", "x", [1, 2]]]),
+        ]
+        res = ea.check(h)
+        assert "G1b" in res["anomaly_types"]
+
+    def test_incompatible_order(self):
+        h = [
+            T([["r", "x", [1, 2]]]),
+            T([["r", "x", [1, 3]]]),
+        ]
+        res = ea.check(h)
+        assert "incompatible-order" in res["anomaly_types"]
+
+    def test_internal(self):
+        h = [T([["append", "x", 9], ["r", "x", [1]]])]
+        res = ea.check(h)
+        assert "internal" in res["anomaly_types"]
+
+    def test_g1c_wr_cycle(self):
+        # t0 observes t1's append and vice versa: circular information flow.
+        h = [
+            T([["append", "x", 1], ["r", "y", [1]]]),
+            T([["append", "y", 1], ["r", "x", [1]]]),
+        ]
+        res = ea.check(h)
+        assert "G1c" in res["anomaly_types"]
+        assert res["valid"] is False
+
+    def test_g_single(self):
+        # t0 missed t1's append to x but observed its append to y:
+        # exactly one anti-dependency edge in the cycle.
+        h = [
+            T([["r", "x", []], ["r", "y", [9]]]),
+            T([["append", "x", 1], ["append", "y", 9]]),
+            T([["r", "y", [9]]]),
+        ]
+        res = ea.check(h)
+        assert "G-single" in res["anomaly_types"]
+
+    def test_g2_write_skew(self):
+        # Classic write skew: both txns read the other's key as empty,
+        # both append — two anti-dependency edges, no ww/wr path.
+        h = [
+            T([["r", "x", []], ["append", "y", 1]]),
+            T([["r", "y", []], ["append", "x", 1]]),
+        ]
+        res = ea.check(h)
+        assert "G2" in res["anomaly_types"]
+        witness = res["anomalies"]["G2"][0]
+        assert len(witness["cycle"]) == 3  # a -> b -> a
+
+    def test_g0_write_cycle(self):
+        # Version orders interleave the two writers in opposite orders on
+        # two keys: pure ww cycle.
+        h = [
+            T([["append", "x", 1], ["append", "y", 2]]),
+            T([["append", "x", 2], ["append", "y", 1]]),
+            T([["r", "x", [1, 2]], ["r", "y", [1, 2]]]),
+        ]
+        res = ea.check(h, anomalies=["G0"])
+        assert "G0" in res["anomaly_types"]
+
+    def test_unrequested_anomalies_ignored(self):
+        h = [
+            T([["r", "x", []], ["append", "y", 1]]),
+            T([["r", "y", []], ["append", "x", 1]]),
+        ]
+        res = ea.check(h, anomalies=["G1"])  # G2 not requested
+        assert res["valid"] is True
+
+
+class TestWrAnomalies:
+    def test_clean(self):
+        h = [
+            T([["w", "x", 1]]),
+            T([["r", "x", 1]]),
+        ]
+        res = ew.check(h)
+        assert res["valid"] is True
+
+    def test_g1a(self):
+        h = [
+            T([["w", "x", 1]], type="fail"),
+            T([["r", "x", 1]]),
+        ]
+        res = ew.check(h)
+        assert "G1a" in res["anomaly_types"]
+
+    def test_g1b_intermediate(self):
+        h = [
+            T([["w", "x", 1], ["w", "x", 2]]),
+            T([["r", "x", 1]]),
+        ]
+        res = ew.check(h)
+        assert "G1b" in res["anomaly_types"]
+
+    def test_internal(self):
+        h = [T([["w", "x", 1], ["r", "x", 2], ["w", "x", 3]])]
+        res = ew.check(h)
+        assert "internal" in res["anomaly_types"]
+
+    def test_g1c_wr_cycle(self):
+        h = [
+            T([["w", "x", 1], ["r", "y", 2]]),
+            T([["w", "y", 2], ["r", "x", 1]]),
+        ]
+        res = ew.check(h)
+        assert "G1c" in res["anomaly_types"]
+
+    def test_write_skew_with_linearizable_keys(self):
+        # t0 reads x's initial write, writes y; t1 reads y's initial
+        # write, writes x — two rw edges under per-key realtime order.
+        h = [
+            T([["w", "x", 1], ["w", "y", 2]]),
+            T([["r", "x", 1], ["w", "y", 3]]),
+            T([["r", "y", 2], ["w", "x", 4]]),
+        ]
+        res = ew.check(h, linearizable_keys=True)
+        assert "G2" in res["anomaly_types"] or "G-single" in res["anomaly_types"]
+
+
+class TestGeneratedHistories:
+    def test_serializable_simulation_clean(self):
+        """Apply random append txns against an in-memory serial store —
+        the resulting history must be anomaly-free."""
+        from jepsen_tpu.generator import fixed_rand
+
+        store: dict = {}
+        h = []
+        with fixed_rand(7):
+            stream = jtxn.append_txns(key_count=4, max_txn_length=5)
+            for op in jtxn.take(stream, 200):
+                done = []
+                for f, k, v in op["value"]:
+                    if f == "append":
+                        store.setdefault(k, []).append(v)
+                        done.append([f, k, v])
+                    else:
+                        done.append([f, k, list(store.get(k, []))])
+                h.append(T(done))
+        res = ea.check(h)
+        assert res["valid"] is True, res
+
+    def test_device_path_large_graph(self):
+        """Force the device closure path (n >= DEVICE_MIN_TXNS would be
+        slow on CPU backend; pass device=True on a mid-size graph) and
+        compare with host."""
+        h = []
+        # Chain of 30 clean txns + one seeded wr cycle at the end.
+        for i in range(30):
+            h.append(T([["append", "k", i + 1],
+                        ["r", "k", [j + 1 for j in range(i + 1)]]]))
+        h.append(T([["append", "x", 1], ["r", "y", [1]]]))
+        h.append(T([["append", "y", 1], ["r", "x", [1]]]))
+        host = ea.check(h, device=False)
+        dev = ea.check(h, device=True)
+        assert host["valid"] is False and dev["valid"] is False
+        assert set(host["anomaly_types"]) == set(dev["anomaly_types"])
