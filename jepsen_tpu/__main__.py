@@ -1,0 +1,87 @@
+"""Built-in demo suite: `python -m jepsen_tpu test|analyze|test-all|serve`.
+
+Runs the in-process fake cluster (jepsen_tpu.workloads atom register —
+tests.clj:27-67 pattern) through the full lifecycle: generator →
+threaded interpreter → history → device checker → store/. The per-DB
+suites follow the same shape with real clients (cli.clj:342-418 usage).
+
+    python -m jepsen_tpu test --workload cas-register --time-limit 5
+    python -m jepsen_tpu analyze --workload cas-register
+    python -m jepsen_tpu test-all
+"""
+
+from __future__ import annotations
+
+from . import checker as jchecker
+from . import cli
+from . import generator as gen
+from .models import CasRegister
+from .workloads import AtomClient, AtomDB, AtomState, noop_test
+
+
+def cas_register_test(opts: dict) -> dict:
+    state = AtomState()
+    test = dict(noop_test())
+    rate = float(opts.get("rate") or 50.0)
+    test.update(
+        name="cas-register",
+        db=AtomDB(state),
+        client=AtomClient(state),
+        checker=jchecker.compose({
+            "linear": jchecker.linearizable(model=CasRegister(init=0)),
+            "stats": jchecker.stats(),
+        }),
+        generator=gen.clients(
+            gen.time_limit(
+                opts.get("time_limit", 10),
+                gen.stagger(1.0 / rate, gen.mix([
+                    lambda: {"f": "write", "value": gen.rand_int(5)},
+                    lambda: {"f": "cas",
+                             "value": [gen.rand_int(5), gen.rand_int(5)]},
+                    lambda: {"f": "read"},
+                ])),
+            )
+        ),
+    )
+    return test
+
+
+def noop_suite(opts: dict) -> dict:
+    test = dict(noop_test())
+    test["generator"] = gen.clients(
+        gen.limit(10, gen.repeat_({"f": "read", "value": None}))
+    )
+    from .workloads import atom_client, AtomState as _S
+
+    st = _S()
+    test["client"] = atom_client(st)
+    test["db"] = AtomDB(st)
+    return test
+
+
+WORKLOADS = {
+    "cas-register": cas_register_test,
+    "noop": noop_suite,
+}
+
+
+def test_fn(opts: dict) -> dict:
+    wl = opts.get("workload") or "cas-register"
+    return WORKLOADS[wl](opts)
+
+
+def _add_opts(p) -> None:
+    p.add_argument("--workload", choices=sorted(WORKLOADS),
+                   default="cas-register")
+    p.add_argument("--rate", default="50",
+                   help="target op rate (Hz) across all threads")
+
+
+COMMANDS = {
+    **cli.single_test_cmd(test_fn, add_opts=_add_opts),
+    **cli.test_all_cmd({n: f for n, f in WORKLOADS.items()}),
+    **cli.serve_cmd(),
+}
+
+if __name__ == "__main__":
+    cli.main_exit(COMMANDS)

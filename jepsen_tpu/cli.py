@@ -1,0 +1,264 @@
+"""CLI framework: build `test` / `analyze` / `test-all` / `serve` runners.
+
+Mirrors jepsen.cli (jepsen/src/jepsen/cli.clj): a suite supplies a
+``test_fn(options) -> test-map`` and gets standard commands with standard
+options; exit codes follow cli.clj:120-130::
+
+    0    all tests passed
+    1    some test failed (results invalid)
+    2    some test had unknown validity
+    254  invalid arguments
+    255  internal error
+
+Standard options (test-opt-spec, cli.clj:55-102): --node/--nodes/
+--nodes-file, --username, --password, --no-ssh, --concurrency (integer,
+optional ``n`` suffix multiplies by node count — parse-concurrency
+cli.clj:141-156), --leave-db-running, --logging-json, --test-count,
+--time-limit, --checker-backend (this build's device/host dispatch).
+
+Usage from a suite module::
+
+    from jepsen_tpu import cli
+
+    def my_test(opts): ...
+    if __name__ == "__main__":
+        cli.run(cli.single_test_cmd(my_test), sys.argv[1:])
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import re
+import sys
+from typing import Any, Callable, Optional
+
+from . import core, store
+
+LOG = logging.getLogger("jepsen.cli")
+
+DEFAULT_NODES = ["n1", "n2", "n3", "n4", "n5"]
+
+EXIT_OK = 0
+EXIT_INVALID = 1
+EXIT_UNKNOWN = 2
+EXIT_BAD_ARGS = 254
+EXIT_ERROR = 255
+
+
+def add_test_opts(p: argparse.ArgumentParser) -> None:
+    """test-opt-spec (cli.clj:55-102)."""
+    p.add_argument("-n", "--node", action="append", dest="node",
+                   help="node to run on; repeatable")
+    p.add_argument("--nodes", help="comma-separated node hostnames")
+    p.add_argument("--nodes-file", help="file of node hostnames, one/line")
+    p.add_argument("--username", default="root")
+    p.add_argument("--password", default="root")
+    p.add_argument("--strict-host-key-checking", action="store_true")
+    p.add_argument("--ssh-private-key")
+    p.add_argument("--no-ssh", action="store_true",
+                   help="don't establish SSH connections (dummy remote)")
+    p.add_argument("--concurrency", default="1n",
+                   help="worker count; integer with optional n suffix "
+                        "(3n = 3 x node count)")
+    p.add_argument("--leave-db-running", action="store_true")
+    p.add_argument("--logging-json", action="store_true")
+    p.add_argument("--test-count", type=int, default=1)
+    p.add_argument("--time-limit", type=int, default=60,
+                   help="test duration in seconds, excl. setup/teardown")
+    p.add_argument("--checker-backend", choices=["auto", "device", "tpu",
+                                                 "host"], default="auto")
+    p.add_argument("--store-root", default=None,
+                   help="directory for the store/ tree")
+
+
+def parse_concurrency(spec: str, n_nodes: int) -> int:
+    """'3n' -> 3 * node count (cli.clj:141-156)."""
+    m = re.fullmatch(r"(\d+)(n?)", spec)
+    if not m:
+        raise ValueError(
+            f"--concurrency {spec} should be an integer optionally "
+            "followed by n")
+    return int(m.group(1)) * (n_nodes if m.group(2) else 1)
+
+
+def parse_nodes(ns: argparse.Namespace) -> list[str]:
+    """Merge --node/--nodes/--nodes-file (cli.clj:158-193)."""
+    if ns.nodes_file:
+        with open(ns.nodes_file) as f:
+            return [line.strip() for line in f if line.strip()]
+    if ns.nodes:
+        return [s.strip() for s in ns.nodes.split(",")]
+    if ns.node:
+        return list(ns.node)
+    return list(DEFAULT_NODES)
+
+
+def options_map(ns: argparse.Namespace) -> dict:
+    """Parsed argparse namespace -> options dict for test_fn."""
+    nodes = parse_nodes(ns)
+    opts = dict(vars(ns))
+    opts["nodes"] = nodes
+    opts["concurrency"] = parse_concurrency(ns.concurrency, len(nodes))
+    opts["ssh"] = {
+        "username": ns.username,
+        "password": ns.password,
+        "strict-host-key-checking": ns.strict_host_key_checking,
+        "private-key-path": ns.ssh_private_key,
+        "dummy?": bool(ns.no_ssh),
+    }
+    return opts
+
+
+def _apply_std_opts(test: dict, opts: dict) -> dict:
+    test = dict(test)
+    test.setdefault("nodes", opts["nodes"])
+    test.setdefault("concurrency", opts["concurrency"])
+    test.setdefault("time-limit", opts["time_limit"])
+    if opts.get("leave_db_running"):
+        test["leave-db-running?"] = True
+    if opts.get("store_root"):
+        test["store-root"] = opts["store_root"]
+    if opts.get("checker_backend") and opts["checker_backend"] != "auto":
+        test["checker_backend"] = opts["checker_backend"]
+    test.setdefault("ssh", opts["ssh"])
+    return test
+
+
+def single_test_cmd(test_fn: Callable[[dict], dict],
+                    opt_fn: Optional[Callable] = None,
+                    add_opts: Optional[Callable] = None) -> dict:
+    """Commands `test` (run + analyze, repeat --test-count times) and
+    `analyze` (re-check the latest stored history against a fresh test
+    map) — cli.clj:342-418."""
+
+    def run_test(opts) -> int:
+        worst = EXIT_OK
+        for _ in range(opts["test_count"]):
+            test = _apply_std_opts(test_fn(opts), opts)
+            result = core.run(test)
+            valid = (result.get("results") or {}).get("valid")
+            if valid is False:
+                return EXIT_INVALID
+            if valid == "unknown":
+                worst = max(worst, EXIT_UNKNOWN)
+        return worst
+
+    def run_analyze(opts) -> int:
+        cli_test = _apply_std_opts(test_fn(opts), opts)
+        stored = store.latest(root=opts.get("store_root"))
+        if stored is None:
+            LOG.error("Not sure what the last test was")
+            return EXIT_ERROR
+        if stored.get("name") != cli_test.get("name"):
+            LOG.error(
+                "Stored test (%s) and CLI test (%s) have different names; "
+                "aborting", stored.get("name"), cli_test.get("name"))
+            return EXIT_ERROR
+        test = dict(stored)
+        test.pop("results", None)
+        history = stored.get("history")
+        test.update(cli_test)
+        test["history"] = history
+        test["name"] = stored["name"]
+        test["start-time"] = stored["start-time"]
+        analyzed = core.analyze(test)
+        core.log_results(analyzed)
+        valid = (analyzed.get("results") or {}).get("valid")
+        if valid is False:
+            return EXIT_INVALID
+        if valid == "unknown":
+            return EXIT_UNKNOWN
+        return EXIT_OK
+
+    return {
+        "test": {"run": run_test, "add_opts": add_opts, "opt_fn": opt_fn,
+                 "help": "Run the test and analyze the history."},
+        "analyze": {"run": run_analyze, "add_opts": add_opts,
+                    "opt_fn": opt_fn,
+                    "help": "Re-check the most recent stored history "
+                            "(no cluster needed)."},
+    }
+
+
+def test_all_cmd(test_fns: dict, opt_fn: Optional[Callable] = None) -> dict:
+    """Command `test-all`: sweep a map of name -> test_fn
+    (cli.clj:420-502); exit code is the worst across the sweep."""
+
+    def run_all(opts) -> int:
+        worst = EXIT_OK
+        for name, fn in test_fns.items():
+            LOG.info("Running test %s", name)
+            try:
+                test = _apply_std_opts(fn(opts), opts)
+                result = core.run(test)
+                valid = (result.get("results") or {}).get("valid")
+            except Exception:
+                LOG.error("Test %s crashed", name, exc_info=True)
+                valid = "unknown"
+            if valid is False:
+                worst = max(worst, EXIT_INVALID)
+            elif valid == "unknown":
+                worst = max(worst, EXIT_UNKNOWN)
+        return worst
+
+    return {"test-all": {"run": run_all, "opt_fn": opt_fn,
+                         "help": "Run every test in the suite."}}
+
+
+def serve_cmd() -> dict:
+    """Command `serve`: the results web server (cli.clj:323-340)."""
+
+    def run_serve(opts) -> int:
+        from . import web
+
+        web.serve(root=opts.get("store_root"),
+                  port=int(opts.get("port") or 8080))
+        return EXIT_OK
+
+    def add_opts(p):
+        p.add_argument("--port", default="8080")
+
+    return {"serve": {"run": run_serve, "add_opts": add_opts,
+                      "help": "Serve the store/ browser."}}
+
+
+def run(commands: dict, argv: Optional[list] = None) -> int:
+    """Dispatch argv against a command map; returns (and exits with) the
+    command's code. Merge several command maps with dict-union."""
+    argv = list(sys.argv[1:] if argv is None else argv)
+    parser = argparse.ArgumentParser(prog="jepsen-tpu")
+    sub = parser.add_subparsers(dest="command")
+    for name, spec in commands.items():
+        p = sub.add_parser(name, help=spec.get("help"))
+        add_test_opts(p)
+        if spec.get("add_opts"):
+            spec["add_opts"](p)
+    try:
+        ns = parser.parse_args(argv)
+    except SystemExit as e:
+        return EXIT_BAD_ARGS if e.code not in (0, None) else 0
+    if not ns.command:
+        parser.print_help()
+        return EXIT_BAD_ARGS
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s{%(threadName)s} %(levelname)s %(name)s - "
+               "%(message)s")
+    spec = commands[ns.command]
+    try:
+        opts = options_map(ns)
+        if spec.get("opt_fn"):
+            opts = spec["opt_fn"](opts)
+        code = spec["run"](opts)
+        return EXIT_OK if code is None else code
+    except ValueError as e:
+        LOG.error("%s", e)
+        return EXIT_BAD_ARGS
+    except Exception:
+        LOG.error("internal error", exc_info=True)
+        return EXIT_ERROR
+
+
+def main_exit(commands: dict, argv: Optional[list] = None) -> None:
+    sys.exit(run(commands, argv))

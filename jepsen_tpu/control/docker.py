@@ -1,0 +1,119 @@
+"""Alternate remotes: `docker exec` and `kubectl exec`.
+
+Mirrors jepsen/src/jepsen/control/docker.clj:75-90 and control/k8s.clj:
+79-111 — drop-in Remote implementations so tests drive containerized
+clusters without SSH.
+"""
+
+from __future__ import annotations
+
+import subprocess
+from typing import Any, Optional
+
+from . import Remote, RemoteError
+
+
+class DockerRemote(Remote):
+    """Runs actions via ``docker exec`` and copies via ``docker cp``
+    (control/docker.clj:75-90). The node name is the container name."""
+
+    def __init__(self, container: Any = None):
+        self.container = container
+
+    def connect(self, host):
+        return DockerRemote(host)
+
+    def execute(self, action):
+        p = subprocess.run(
+            ["docker", "exec", "-i", str(self.container), "bash", "-c",
+             action["cmd"]],
+            input=(action.get("in") or "").encode() or None,
+            capture_output=True,
+        )
+        return {"out": p.stdout.decode(errors="replace"),
+                "err": p.stderr.decode(errors="replace"),
+                "exit": p.returncode}
+
+    def upload(self, local_paths, remote_path):
+        paths = local_paths if isinstance(local_paths, (list, tuple)) else [
+            local_paths]
+        for lp in paths:
+            p = subprocess.run(
+                ["docker", "cp", str(lp), f"{self.container}:{remote_path}"],
+                capture_output=True)
+            if p.returncode:
+                raise RemoteError({
+                    "cmd": "docker cp", "host": self.container,
+                    "exit": p.returncode,
+                    "err": p.stderr.decode(errors="replace"), "out": ""})
+
+    def download(self, remote_paths, local_path):
+        paths = remote_paths if isinstance(remote_paths, (list, tuple)) else [
+            remote_paths]
+        for rp in paths:
+            p = subprocess.run(
+                ["docker", "cp", f"{self.container}:{rp}", str(local_path)],
+                capture_output=True)
+            if p.returncode:
+                raise RemoteError({
+                    "cmd": "docker cp", "host": self.container,
+                    "exit": p.returncode,
+                    "err": p.stderr.decode(errors="replace"), "out": ""})
+
+
+class K8sRemote(Remote):
+    """Runs actions via ``kubectl exec`` (control/k8s.clj:79-111). The
+    node name is the pod name."""
+
+    def __init__(self, pod: Any = None, namespace: Optional[str] = None,
+                 container: Optional[str] = None):
+        self.pod = pod
+        self.namespace = namespace
+        self.container = container
+
+    def connect(self, host):
+        return K8sRemote(host, self.namespace, self.container)
+
+    def _base(self) -> list:
+        cmd = ["kubectl"]
+        if self.namespace:
+            cmd += ["-n", self.namespace]
+        return cmd
+
+    def execute(self, action):
+        cmd = self._base() + ["exec", "-i", str(self.pod)]
+        if self.container:
+            cmd += ["-c", self.container]
+        cmd += ["--", "bash", "-c", action["cmd"]]
+        p = subprocess.run(
+            cmd, input=(action.get("in") or "").encode() or None,
+            capture_output=True)
+        return {"out": p.stdout.decode(errors="replace"),
+                "err": p.stderr.decode(errors="replace"),
+                "exit": p.returncode}
+
+    def upload(self, local_paths, remote_path):
+        paths = local_paths if isinstance(local_paths, (list, tuple)) else [
+            local_paths]
+        for lp in paths:
+            p = subprocess.run(
+                self._base() + ["cp", str(lp), f"{self.pod}:{remote_path}"],
+                capture_output=True)
+            if p.returncode:
+                raise RemoteError({
+                    "cmd": "kubectl cp", "host": self.pod,
+                    "exit": p.returncode,
+                    "err": p.stderr.decode(errors="replace"), "out": ""})
+
+    def download(self, remote_paths, local_path):
+        paths = remote_paths if isinstance(remote_paths, (list, tuple)) else [
+            remote_paths]
+        for rp in paths:
+            p = subprocess.run(
+                self._base() + ["cp", f"{self.pod}:{rp}", str(local_path)],
+                capture_output=True)
+            if p.returncode:
+                raise RemoteError({
+                    "cmd": "kubectl cp", "host": self.pod,
+                    "exit": p.returncode,
+                    "err": p.stderr.decode(errors="replace"), "out": ""})

@@ -1,0 +1,35 @@
+"""Node network identity helpers (jepsen.control.net, control/net.clj):
+resolve a hostname's IP from the node we're bound to, and our own IPs.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+from . import exec, exec_star
+
+
+def ip_uncached(host: str) -> str:
+    """Resolve host -> IP via getent on the bound node
+    (control/net.clj:14-31)."""
+    out = exec_star(
+        f"getent ahosts {host} | head -n1 | cut -d' ' -f1"
+    )
+    return out.strip()
+
+
+@lru_cache(maxsize=1024)
+def ip(host: str) -> str:
+    return ip_uncached(host)
+
+
+def local_ip() -> str:
+    """The bound node's first IP (control/net.clj:40-47)."""
+    return exec_star("hostname -I | cut -d' ' -f1").strip()
+
+
+def control_ip() -> str:
+    """The control node's IP as seen locally (control/net.clj:49-57)."""
+    import socket
+
+    return socket.gethostbyname(socket.gethostname())

@@ -1,0 +1,174 @@
+"""Node-side scripting helpers (jepsen.control.util, control/util.clj):
+file tests, downloads with caching, archive installs, daemon start/stop,
+grepkill. All run through the ambient control session, so they work over
+SSH, docker exec, the localhost shell, or the dummy remote alike.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Iterable, Optional
+
+from . import Lit, RemoteError, escape, exec, exec_star, su, upload
+
+LOG = logging.getLogger("jepsen.control.util")
+
+TMP_DIR_BASE = "/tmp/jepsen"
+
+
+def exists(path: str) -> bool:
+    """control/util.clj:20-26."""
+    try:
+        exec("test", "-e", path)
+        return True
+    except RemoteError:
+        return False
+
+
+def file_(path: str) -> str:
+    return exec("file", path)
+
+
+def ls(path: str = ".") -> list[str]:
+    out = exec("ls", "-1", path)
+    return [l for l in out.split("\n") if l]
+
+
+def ls_full(path: str) -> list[str]:
+    """Fully-qualified paths (control/util.clj:34-42)."""
+    base = path if path.endswith("/") else path + "/"
+    return [base + f for f in ls(path)]
+
+def tmp_dir() -> str:
+    """Create and return a fresh temp dir (control/util.clj:44-52)."""
+    return exec("mktemp", "-d", "-p", "/tmp", "jepsen.XXXXXX")
+
+
+def wget(url: str, dest: Optional[str] = None, force: bool = False) -> str:
+    """Download url on the node; returns the local filename
+    (control/util.clj:54-76)."""
+    fname = dest or url.rstrip("/").rsplit("/", 1)[-1]
+    if force:
+        exec("rm", "-f", fname)
+    if not exists(fname):
+        exec("wget", "--tries", "20", "--waitretry", "60",
+             "--retry-connrefused", "--dns-timeout", "60",
+             "--connect-timeout", "60", "--read-timeout", "60",
+             "-O", fname, url)
+    return fname
+
+
+CACHE_DIR = "/tmp/jepsen/wget-cache"
+
+
+def cached_wget(url: str, force: bool = False) -> str:
+    """Download url once per node, caching it for future runs
+    (control/util.clj:117-147)."""
+    fname = url.rstrip("/").rsplit("/", 1)[-1]
+    cached = f"{CACHE_DIR}/{fname}"
+    if force:
+        exec("rm", "-f", cached)
+    if not exists(cached):
+        exec("mkdir", "-p", CACHE_DIR)
+        exec("wget", "--tries", "20", "--waitretry", "60",
+             "--retry-connrefused", "-O", cached, url)
+    return cached
+
+
+def install_archive(url: str, dest: str, force: bool = False) -> str:
+    """Download (or copy file://) an archive and extract it to dest
+    (control/util.clj:149-233, simplified: tar + zip)."""
+    with su():
+        exec("rm", "-rf", dest) if force else None
+        if not exists(dest):
+            local = url[len("file://"):] if url.startswith("file://") else (
+                cached_wget(url))
+            tmp = tmp_dir()
+            try:
+                if local.endswith(".zip"):
+                    exec("unzip", "-d", tmp, local)
+                else:
+                    exec("tar", "--no-same-owner", "--extract", "--file",
+                         local, "--directory", tmp)
+                entries = ls_full(tmp)
+                src = entries[0] if len(entries) == 1 else tmp
+                exec("mkdir", "-p", Lit(escape(dest).rsplit("/", 1)[0] or "/"))
+                exec("mv", src, dest)
+            finally:
+                exec("rm", "-rf", tmp)
+    return dest
+
+
+def daemon_running(pidfile: str) -> Optional[bool]:
+    """control/util.clj:243-257."""
+    try:
+        pid = exec("cat", pidfile)
+    except RemoteError:
+        return None
+    try:
+        exec("ps", "-p", pid)
+        return True
+    except RemoteError:
+        return False
+
+
+def start_daemon(opts: dict, bin: str, *args: Any) -> Any:
+    """Start a daemon via start-stop-daemon (control/util.clj:259-287).
+
+    opts: chdir, env (dict), logfile, make-pidfile? (default True),
+    match-executable?, match-process-name?, pidfile, process-name."""
+    pidfile = opts.get("pidfile")
+    logfile = opts["logfile"]
+    LOG.info("starting %s", bin.split("/")[-1])
+    env = " ".join(
+        f"{k}={escape(v)}" for k, v in (opts.get("env") or {}).items())
+    cmd = ["start-stop-daemon", "--start", "--background",
+           "--no-close", "--oknodo"]
+    if opts.get("make-pidfile?", True) and pidfile:
+        cmd += ["--make-pidfile"]
+    if pidfile:
+        cmd += ["--pidfile", pidfile]
+    if opts.get("chdir"):
+        cmd += ["--chdir", opts["chdir"]]
+    if opts.get("match-executable?", True):
+        cmd += ["--exec", bin]
+    if opts.get("match-process-name?"):
+        cmd += ["--name", opts.get("process-name", bin.split("/")[-1])]
+    cmd += ["--startas", bin]
+    cmd += ["--", *args]
+    with su():
+        full = (f"{env} " if env else "") + " ".join(
+            escape(c) for c in cmd
+        ) + f" >> {escape(logfile)} 2>&1"
+        return exec_star(full)
+
+
+def stop_daemon(pidfile: str, bin: Optional[str] = None) -> None:
+    """Kill the daemon by pidfile (control/util.clj:289-315)."""
+    LOG.info("stopping daemon %s", bin or pidfile)
+    with su():
+        if exists(pidfile):
+            pid = exec("cat", pidfile)
+            try:
+                exec("kill", "-9", pid)
+            except RemoteError:
+                pass  # already gone
+            exec("rm", "-rf", pidfile)
+
+
+def grepkill(pattern: str, signal: Any = 9) -> None:
+    """Kill processes matching a pattern (control/util.clj:235-241)."""
+    with su():
+        try:
+            exec_star(
+                f"ps aux | grep {escape(pattern)} | grep -v grep | "
+                f"awk '{{print $2}}' | xargs -r kill -{signal}"
+            )
+        except RemoteError:
+            pass
+
+
+def signal(process_name: str, sig: Any) -> None:
+    """Send a signal by process name (control/util.clj:317-321)."""
+    with su():
+        exec("pkill", "--signal", sig, process_name)

@@ -99,9 +99,9 @@ def cycle_anomalies(g: DepGraph, device: Optional[bool] = None) -> dict:
         srcs, dsts = np.nonzero((adj & WR) > 0)
         for a, b in zip(srcs.tolist(), dsts.tolist()):
             if c_wwr[b, a]:
-                back = _path_host(adj, WW | WR, b, a)  # [b, ..., a]
-                if back:
-                    out.setdefault("G1c", []).append(_witness(g, [a, *back]))
+                cyc = find_cycle_with_edge_host(adj, WW | WR, a, b)
+                if cyc:
+                    out.setdefault("G1c", []).append(_witness(g, cyc))
                     break
     # rw-closing cycles.
     srcs, dsts = np.nonzero((adj & RW) > 0)
@@ -128,30 +128,22 @@ def cycle_anomalies(g: DepGraph, device: Optional[bool] = None) -> dict:
 KIND_LOOKUP = {WW: "ww", WR: "wr", RW: "rw"}
 
 
-def _path_host(adj, mask, src, dst):
-    """Shortest src→dst node path over masked edges (BFS); [] if none,
-    else [src, ..., dst]."""
-    if src == dst:
-        return [src]
-    prev = {src: None}
-    frontier = [src]
-    while frontier:
-        nxt = []
-        for v in frontier:
-            for w in np.flatnonzero(adj[v] & mask):
-                w = int(w)
-                if w not in prev:
-                    prev[w] = v
-                    if w == dst:
-                        path = []
-                        node = w
-                        while node is not None:
-                            path.append(node)
-                            node = prev[node]
-                        return path[::-1]
-                    nxt.append(w)
-        frontier = nxt
-    return []
+# Shared op accessors: checker layers accept both Op records and plain
+# completion dicts.
+def op_value(op):
+    return op.value if hasattr(op, "value") else op.get("value")
+
+
+def op_type(op):
+    return op.type if hasattr(op, "type") else op.get("type")
+
+
+def op_f(op):
+    return op.f if hasattr(op, "f") else op.get("f")
+
+
+def op_proc(op):
+    return op.process if hasattr(op, "process") else op.get("process")
 
 
 def _witness(g: DepGraph, cycle: list[int]) -> dict:

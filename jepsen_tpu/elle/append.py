@@ -29,16 +29,9 @@ from __future__ import annotations
 from typing import Any, Iterable, Optional
 
 from . import DEFAULT_ANOMALIES, DepGraph, RW, WR, WW, cycle_anomalies, \
-    expand_anomalies, result_map
+    expand_anomalies, op_f as _f, op_type as _type, op_value as _value, \
+    result_map
 from ..history import FAIL, INFO, OK
-
-
-def _value(op):
-    return op.value if hasattr(op, "value") else op.get("value")
-
-
-def _type(op):
-    return op.type if hasattr(op, "type") else op.get("type")
 
 
 def _mops(op):
@@ -205,10 +198,6 @@ def check(history, anomalies: Iterable[str] = DEFAULT_ANOMALIES,
     return res
 
 
-def _f(op):
-    return op.f if hasattr(op, "f") else op.get("f")
-
-
 def _internal_case(mops) -> Optional[dict]:
     """Within-txn consistency: reads must reflect the txn's own earlier
     appends and be extensions of its earlier reads of the same key."""
@@ -220,14 +209,19 @@ def _internal_case(mops) -> Optional[dict]:
         elif f == "r" and v is not None:
             v = list(v)
             if k in seen_reads:
-                prev, apps_then = seen_reads[k]
-                expect = prev + appended.get(k, [])[len(apps_then):]
-                if expect and v[-len(expect):] != expect:
-                    return {"key": k, "expected_suffix": expect, "read": v}
-            if appended.get(k):
+                # A later read must EQUAL the previous read plus the
+                # txn's own appends since — nothing else may appear
+                # mid-transaction.
+                prev, n_apps_then = seen_reads[k]
+                expect = prev + appended.get(k, [])[n_apps_then:]
+                if v != expect:
+                    return {"key": k, "expected": expect, "read": v}
+            elif appended.get(k):
+                # First read of k after own appends: must end with them
+                # (the prefix is external state).
                 suffix = appended[k]
                 if v[-len(suffix):] != suffix:
                     return {"key": k, "expected_suffix": list(suffix),
                             "read": v}
-            seen_reads[k] = (v, list(appended.get(k, [])))
+            seen_reads[k] = (v, len(appended.get(k, [])))
     return None

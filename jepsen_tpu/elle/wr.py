@@ -21,25 +21,10 @@ from __future__ import annotations
 from typing import Iterable, Optional
 
 from . import DEFAULT_ANOMALIES, DepGraph, RW, WR, WW, cycle_anomalies, \
-    expand_anomalies, result_map
+    expand_anomalies, op_f as _f, op_proc as _proc, op_type as _type, \
+    op_value as _value, result_map
 from ..history import FAIL, INFO, OK
 from ..txn import ext_reads, ext_writes
-
-
-def _value(op):
-    return op.value if hasattr(op, "value") else op.get("value")
-
-
-def _type(op):
-    return op.type if hasattr(op, "type") else op.get("type")
-
-
-def _f(op):
-    return op.f if hasattr(op, "f") else op.get("f")
-
-
-def _proc(op):
-    return op.process if hasattr(op, "process") else op.get("process")
 
 
 def _ret_index(op):

@@ -1,0 +1,204 @@
+"""Network fault layer.
+
+Mirrors jepsen.net (jepsen/src/jepsen/net.clj): the :class:`Net` protocol
+(drop/heal/slow/flaky/fast, net.clj:15-26), :func:`drop_all` with the
+`PartitionAll` fast path (net.clj:29-44, net/proto.clj:1-12), and the
+iptables + ipfilter implementations (net.clj:58-145). All node effects go
+through the ambient control session, so the same code drives SSH,
+containers, or the dummy remote (whose command log the tests assert
+against).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from . import control as c
+from .control import net as cnet
+from .util import real_pmap
+
+TC = "/sbin/tc"
+
+
+class Net:
+    """net.clj:15-26."""
+
+    def drop(self, test: dict, src: Any, dest: Any) -> None:
+        """Drop traffic from src as seen by dest."""
+        raise NotImplementedError
+
+    def heal(self, test: dict) -> None:
+        raise NotImplementedError
+
+    def slow(self, test: dict, mean_ms: float = 50, variance_ms: float = 10,
+             distribution: str = "normal") -> None:
+        raise NotImplementedError
+
+    def flaky(self, test: dict) -> None:
+        raise NotImplementedError
+
+    def fast(self, test: dict) -> None:
+        raise NotImplementedError
+
+
+class PartitionAll:
+    """Optional fast path: apply a whole grudge in one call per node
+    (net/proto.clj:1-12)."""
+
+    def drop_all(self, test: dict, grudge: dict) -> None:
+        raise NotImplementedError
+
+
+def drop_all(test: dict, grudge: dict) -> None:
+    """Apply a grudge — {node: iterable of nodes to drop} — via the
+    PartitionAll fast path or per-edge drop! (net.clj:29-44)."""
+    net = test.get("net")
+    if net is None:
+        raise RuntimeError("test has no :net")
+    if isinstance(net, PartitionAll):
+        net.drop_all(test, grudge)
+        return
+    edges = [(src, dst) for dst, srcs in grudge.items() for src in srcs]
+    real_pmap(lambda e: net.drop(test, e[0], e[1]), edges)
+
+
+class _NoopNet(Net):
+    """net.clj:52-57."""
+
+    def drop(self, test, src, dest):
+        pass
+
+    def heal(self, test):
+        pass
+
+    def slow(self, test, mean_ms=50, variance_ms=10, distribution="normal"):
+        pass
+
+    def flaky(self, test):
+        pass
+
+    def fast(self, test):
+        pass
+
+    def __repr__(self):
+        return "<net.noop>"
+
+
+def noop() -> Net:
+    return _NoopNet()
+
+
+class IptablesNet(Net, PartitionAll):
+    """Default iptables implementation (net.clj:58-111)."""
+
+    def drop(self, test, src, dest):
+        def f(t, node):
+            with c.su():
+                c.exec("iptables", "-A", "INPUT", "-s", cnet.ip(src),
+                       "-j", "DROP", "-w")
+
+        c.on_nodes(test, f, [dest])
+
+    def heal(self, test):
+        def f(t, node):
+            with c.su():
+                c.exec("iptables", "-F", "-w")
+                c.exec("iptables", "-X", "-w")
+
+        c.on_nodes(test, f)
+
+    def slow(self, test, mean_ms=50, variance_ms=10, distribution="normal"):
+        def f(t, node):
+            with c.su():
+                c.exec(TC, "qdisc", "add", "dev", "eth0", "root", "netem",
+                       "delay", f"{mean_ms}ms", f"{variance_ms}ms",
+                       "distribution", distribution)
+
+        c.on_nodes(test, f)
+
+    def flaky(self, test):
+        def f(t, node):
+            with c.su():
+                c.exec(TC, "qdisc", "add", "dev", "eth0", "root", "netem",
+                       "loss", "20%", "75%")
+
+        c.on_nodes(test, f)
+
+    def fast(self, test):
+        def f(t, node):
+            with c.su():
+                try:
+                    c.exec(TC, "qdisc", "del", "dev", "eth0", "root")
+                except c.RemoteError as e:
+                    if "No such file or directory" not in str(e):
+                        raise
+
+        c.on_nodes(test, f)
+
+    def drop_all(self, test, grudge):
+        def f(t, node):
+            srcs = list(grudge.get(node) or [])
+            if srcs:
+                with c.su():
+                    c.exec("iptables", "-A", "INPUT", "-s",
+                           ",".join(cnet.ip(s) for s in srcs),
+                           "-j", "DROP", "-w")
+
+        c.on_nodes(test, f, list(grudge.keys()))
+
+    def __repr__(self):
+        return "<net.iptables>"
+
+
+def iptables() -> IptablesNet:
+    return IptablesNet()
+
+
+class IpfilterNet(Net):
+    """BSD ipfilter rules (net.clj:113-145)."""
+
+    def drop(self, test, src, dest):
+        def f(t, node):
+            with c.su():
+                c.exec_star(
+                    f"echo block in from {c.escape(src)} to any | ipf -f -")
+
+        c.on_nodes(test, f, [dest])
+
+    def heal(self, test):
+        def f(t, node):
+            with c.su():
+                c.exec("ipf", "-Fa")
+
+        c.on_nodes(test, f)
+
+    def slow(self, test, mean_ms=50, variance_ms=10, distribution="normal"):
+        def f(t, node):
+            with c.su():
+                c.exec("tc", "qdisc", "add", "dev", "eth0", "root", "netem",
+                       "delay", f"{mean_ms}ms", f"{variance_ms}ms",
+                       "distribution", distribution)
+
+        c.on_nodes(test, f)
+
+    def flaky(self, test):
+        def f(t, node):
+            with c.su():
+                c.exec("tc", "qdisc", "add", "dev", "eth0", "root", "netem",
+                       "loss", "20%", "75%")
+
+        c.on_nodes(test, f)
+
+    def fast(self, test):
+        def f(t, node):
+            with c.su():
+                c.exec("tc", "qdisc", "del", "dev", "eth0", "root")
+
+        c.on_nodes(test, f)
+
+    def __repr__(self):
+        return "<net.ipfilter>"
+
+
+def ipfilter() -> IpfilterNet:
+    return IpfilterNet()

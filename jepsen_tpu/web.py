@@ -1,0 +1,163 @@
+"""Results browser: serve the ``store/`` tree over HTTP.
+
+Mirrors jepsen.web (jepsen/src/jepsen/web.clj): a table of tests (name,
+start time, validity) linking into each run's files, plain file serving
+for history.edn / results.edn / jepsen.log / plots, and zip download of a
+run (web.clj:48-69, served via cli serve — cli.clj:323-340).
+"""
+
+from __future__ import annotations
+
+import html
+import io
+import json
+import logging
+import zipfile
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from pathlib import Path
+from typing import Any, Optional
+from urllib.parse import unquote
+
+from . import store
+
+LOG = logging.getLogger("jepsen.web")
+
+
+def _valid_of(run_dir: Path) -> Any:
+    f = run_dir / "results.edn"
+    if not f.exists():
+        return None
+    try:
+        from . import edn
+
+        m = edn.read_string(f.read_text())
+        v = m.get(edn.K("valid?"))
+        if isinstance(v, edn.Keyword):
+            return v.name
+        return v
+    except Exception:
+        return "?"
+
+
+_STYLE = """
+body { font-family: sans-serif; margin: 2em; }
+table { border-collapse: collapse; }
+td, th { border: 1px solid #ccc; padding: 0.3em 0.8em; text-align: left; }
+.valid-true { background: #c8f7c5; } .valid-false { background: #f7c5c5; }
+.valid-unknown { background: #f7eec5; }
+"""
+
+
+def _index_page(root: Path) -> str:
+    rows = []
+    tests = store.tests(root=root)
+    for name in sorted(tests):
+        for start in sorted(tests[name], reverse=True):
+            run = tests[name][start]
+            v = _valid_of(run)
+            cls = {True: "valid-true", False: "valid-false",
+                   "unknown": "valid-unknown"}.get(v, "")
+            vs = {True: "valid", False: "INVALID",
+                  "unknown": "unknown"}.get(v, "—")
+            rows.append(
+                f'<tr class="{cls}"><td><a href="/files/{name}/{start}/">'
+                f'{html.escape(name)}</a></td>'
+                f"<td>{html.escape(start)}</td><td>{vs}</td>"
+                f'<td><a href="/zip/{name}/{start}">zip</a></td></tr>'
+            )
+    return (
+        f"<html><head><title>Jepsen</title><style>{_STYLE}</style></head>"
+        "<body><h1>Jepsen tests</h1><table>"
+        "<tr><th>Test</th><th>Started</th><th>Valid?</th><th></th></tr>"
+        + "".join(rows) + "</table></body></html>"
+    )
+
+
+def _listing_page(rel: str, d: Path) -> str:
+    items = "".join(
+        f'<li><a href="/files/{rel}{f.name}{"/" if f.is_dir() else ""}">'
+        f"{html.escape(f.name)}</a></li>"
+        for f in sorted(d.iterdir())
+    )
+    return (
+        f"<html><head><style>{_STYLE}</style></head><body>"
+        f"<h1>{html.escape(rel)}</h1><ul>{items}</ul></body></html>"
+    )
+
+
+def make_handler(root: Path):
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, fmt, *args):  # quiet
+            LOG.debug(fmt, *args)
+
+        def _send(self, code: int, body: bytes,
+                  ctype: str = "text/html; charset=utf-8"):
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            path = unquote(self.path)
+            try:
+                if path in ("/", "/index.html"):
+                    self._send(200, _index_page(root).encode())
+                    return
+                if path.startswith("/zip/"):
+                    rel = path[len("/zip/"):].strip("/")
+                    d = (root / rel).resolve()
+                    if root.resolve() not in d.parents or not d.is_dir():
+                        self._send(404, b"not found")
+                        return
+                    buf = io.BytesIO()
+                    with zipfile.ZipFile(buf, "w") as z:
+                        for f in d.rglob("*"):
+                            if f.is_file():
+                                z.write(f, f.relative_to(d.parent))
+                    self._send(200, buf.getvalue(), "application/zip")
+                    return
+                if path.startswith("/files/"):
+                    rel = path[len("/files/"):]
+                    f = (root / rel.strip("/")).resolve()
+                    if root.resolve() not in f.parents and f != root.resolve():
+                        self._send(404, b"not found")
+                        return
+                    if f.is_dir():
+                        self._send(
+                            200,
+                            _listing_page(
+                                rel if rel.endswith("/") else rel + "/", f
+                            ).encode(),
+                        )
+                        return
+                    if f.is_file():
+                        ctype = (
+                            "text/html" if f.suffix == ".html"
+                            else "image/png" if f.suffix == ".png"
+                            else "image/svg+xml" if f.suffix == ".svg"
+                            else "text/plain; charset=utf-8"
+                        )
+                        self._send(200, f.read_bytes(), ctype)
+                        return
+                self._send(404, b"not found")
+            except Exception:
+                LOG.warning("error serving %s", path, exc_info=True)
+                self._send(500, b"internal error")
+
+    return Handler
+
+
+def server(root: Optional[Any] = None, port: int = 8080
+           ) -> ThreadingHTTPServer:
+    """Build (without starting) the HTTP server — tests drive this."""
+    base = Path(root) if root else Path(store.BASE_DIR)
+    return ThreadingHTTPServer(("", port), make_handler(base))
+
+
+def serve(root: Optional[Any] = None, port: int = 8080) -> None:
+    """Serve forever (cli.clj:323-340 seam)."""
+    srv = server(root, port)
+    LOG.info("Serving store on http://0.0.0.0:%d", port)
+    print(f"Serving store on http://0.0.0.0:{port}")
+    srv.serve_forever()

@@ -1,0 +1,142 @@
+"""CLI tests: option parsing (cli.clj:55-102,141-193), the test/analyze
+commands and exit codes (cli.clj:120-130,342-418), and the analyze-a-
+stored-history seam with no cluster."""
+
+import argparse
+
+import pytest
+
+from jepsen_tpu import checker as jchecker
+from jepsen_tpu import cli
+from jepsen_tpu import client as jclient
+from jepsen_tpu import generator as gen
+from jepsen_tpu.models import CasRegister
+from jepsen_tpu.workloads import AtomClient, AtomDB, AtomState, noop_test
+
+
+class TestOptionParsing:
+    def test_parse_concurrency(self):
+        assert cli.parse_concurrency("10", 5) == 10
+        assert cli.parse_concurrency("3n", 5) == 15
+        assert cli.parse_concurrency("1n", 3) == 3
+        with pytest.raises(ValueError):
+            cli.parse_concurrency("n3", 5)
+
+    def test_parse_nodes_precedence(self, tmp_path):
+        p = argparse.ArgumentParser()
+        cli.add_test_opts(p)
+        ns = p.parse_args(["--nodes", "a, b,c"])
+        assert cli.parse_nodes(ns) == ["a", "b", "c"]
+        ns = p.parse_args(["-n", "x", "-n", "y"])
+        assert cli.parse_nodes(ns) == ["x", "y"]
+        f = tmp_path / "nodes.txt"
+        f.write_text("h1\nh2\n")
+        ns = p.parse_args(["--nodes-file", str(f)])
+        assert cli.parse_nodes(ns) == ["h1", "h2"]
+        ns = p.parse_args([])
+        assert cli.parse_nodes(ns) == cli.DEFAULT_NODES
+
+    def test_options_map(self):
+        p = argparse.ArgumentParser()
+        cli.add_test_opts(p)
+        ns = p.parse_args(["--nodes", "a,b", "--concurrency", "2n",
+                           "--no-ssh"])
+        opts = cli.options_map(ns)
+        assert opts["concurrency"] == 4
+        assert opts["ssh"]["dummy?"] is True
+
+
+class StaleClient(jclient.Client, jclient.Reusable):
+    """Returns reads from a snapshot that never sees writes — definitely
+    not linearizable once a write lands."""
+
+    def __init__(self, state):
+        self.state = state
+
+    def invoke(self, test, op):
+        if op["f"] == "read":
+            return {**op, "type": "ok", "value": 0}
+        if op["f"] == "write":
+            self.state.reset(op["value"])
+            return {**op, "type": "ok"}
+        cur, new = op["value"]
+        return {**op, "type": "ok" if self.state.cas(cur, new) else "fail"}
+
+
+def _suite(client_cls):
+    def test_fn(opts):
+        state = AtomState()
+        test = dict(noop_test())
+        test.update(
+            name="cli-suite",
+            db=AtomDB(state),
+            client=client_cls(state),
+            checker=jchecker.linearizable(model=CasRegister(init=0)),
+            generator=gen.clients(gen.limit(30, gen.mix([
+                lambda: {"f": "write", "value": 1 + gen.rand_int(4)},
+                lambda: {"f": "read"},
+            ]))),
+        )
+        return test
+
+    return test_fn
+
+
+class TestCommands:
+    def run_cli(self, commands, argv):
+        return cli.run(commands, argv)
+
+    def test_valid_run_exits_0(self, tmp_path):
+        cmds = cli.single_test_cmd(_suite(AtomClient))
+        code = self.run_cli(
+            cmds, ["test", "--store-root", str(tmp_path), "--concurrency",
+                   "4", "--nodes", "n1,n2"])
+        assert code == cli.EXIT_OK
+
+    def test_invalid_run_exits_1(self, tmp_path):
+        cmds = cli.single_test_cmd(_suite(StaleClient))
+        code = self.run_cli(
+            cmds, ["test", "--store-root", str(tmp_path), "--concurrency",
+                   "4", "--nodes", "n1,n2"])
+        assert code == cli.EXIT_INVALID
+
+    def test_analyze_reuses_stored_history(self, tmp_path):
+        cmds = cli.single_test_cmd(_suite(AtomClient))
+        assert self.run_cli(
+            cmds, ["test", "--store-root", str(tmp_path), "--concurrency",
+                   "4", "--nodes", "n1,n2"]) == cli.EXIT_OK
+        # Re-analysis without a cluster (BASELINE config 5's entry).
+        code = self.run_cli(
+            cmds, ["analyze", "--store-root", str(tmp_path),
+                   "--nodes", "n1,n2"])
+        assert code == cli.EXIT_OK
+
+    def test_analyze_name_mismatch(self, tmp_path):
+        cmds = cli.single_test_cmd(_suite(AtomClient))
+        assert self.run_cli(
+            cmds, ["test", "--store-root", str(tmp_path), "--nodes", "n1"],
+        ) == cli.EXIT_OK
+
+        def other_fn(opts):
+            t = _suite(AtomClient)(opts)
+            t["name"] = "other-name"
+            return t
+
+        cmds2 = cli.single_test_cmd(other_fn)
+        assert self.run_cli(
+            cmds2, ["analyze", "--store-root", str(tmp_path)],
+        ) == cli.EXIT_ERROR
+
+    def test_test_all(self, tmp_path):
+        cmds = cli.test_all_cmd({
+            "good": _suite(AtomClient),
+            "bad": _suite(StaleClient),
+        })
+        code = self.run_cli(
+            cmds, ["test-all", "--store-root", str(tmp_path),
+                   "--concurrency", "4", "--nodes", "n1,n2"])
+        assert code == cli.EXIT_INVALID
+
+    def test_bad_args(self):
+        cmds = cli.single_test_cmd(_suite(AtomClient))
+        assert self.run_cli(cmds, ["bogus-command"]) == cli.EXIT_BAD_ARGS

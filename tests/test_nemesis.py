@@ -1,0 +1,207 @@
+"""Nemesis + net + control tests: grudge algebra ports
+(jepsen/test/jepsen/nemesis_test.clj:17-60), shell escaping
+(control.clj:77-120), partitioner command generation against the dummy
+remote, compose routing, and a partition scheduled through the threaded
+interpreter showing up in nemesis_intervals."""
+
+import pytest
+
+from jepsen_tpu import control as c
+from jepsen_tpu import generator as gen
+from jepsen_tpu import nemesis as nem
+from jepsen_tpu import net as jnet
+from jepsen_tpu.generator import fixed_rand, interpreter
+from jepsen_tpu.util import nemesis_intervals
+from jepsen_tpu.workloads import noop_test
+
+
+class TestGrudges:
+    def test_bisect(self):
+        assert nem.bisect([]) == [[], []]
+        assert nem.bisect([1]) == [[], [1]]
+        assert nem.bisect([1, 2, 3, 4]) == [[1, 2], [3, 4]]
+        assert nem.bisect([1, 2, 3, 4, 5]) == [[1, 2], [3, 4, 5]]
+
+    def test_complete_grudge(self):
+        assert nem.complete_grudge(nem.bisect([1, 2, 3, 4, 5])) == {
+            1: {3, 4, 5},
+            2: {3, 4, 5},
+            3: {1, 2},
+            4: {1, 2},
+            5: {1, 2},
+        }
+
+    def test_bridge(self):
+        assert nem.bridge([1, 2, 3, 4, 5]) == {
+            1: {4, 5},
+            2: {4, 5},
+            4: {1, 2},
+            5: {1, 2},
+        }
+
+    def test_split_one(self):
+        assert nem.split_one([1, 2, 3], loner=2) == [[2], [1, 3]]
+
+    def test_majorities_ring(self):
+        nodes = list(range(5))
+        with fixed_rand(1):
+            grudge = nem.majorities_ring(nodes)
+        assert len(grudge) == len(nodes)
+        assert set(grudge) == set(nodes)
+        # Every node drops exactly n - majority = 2 others, never itself.
+        for node, snubbed in grudge.items():
+            assert len(snubbed) == 2
+            assert node not in snubbed
+        assert len({frozenset(v) for v in grudge.values()}) == len(nodes)
+
+
+class TestEscape:
+    def test_escape_rules(self):
+        # control.clj:77-120
+        assert c.escape(None) == ""
+        assert c.escape("") == '""'
+        assert c.escape("simple") == "simple"
+        assert c.escape("has space") == '"has space"'
+        assert c.escape('say "hi"') == '"say \\"hi\\""'
+        assert c.escape("$HOME") == '"\\$HOME"'
+        assert c.escape([1, "two words"]) == '1 "two words"'
+        assert c.escape(c.Lit("a|b")) == "a|b"
+        assert c.escape(">") == ">"
+
+
+def dummy_test(nodes=("n1", "n2", "n3", "n4", "n5")):
+    test = dict(noop_test())
+    test["nodes"] = list(nodes)
+    test["net"] = jnet.iptables()
+    log: list = []
+    remote = c.dummy(log, responses={
+        r"getent ahosts (\S+)": lambda host, action: "10.0.0.1 STREAM x\n",
+    })
+    c.setup_sessions(test, remote)
+    return test, log
+
+
+class TestPartitioner:
+    def test_partition_commands(self):
+        test, log = dummy_test()
+        p = nem.partitioner(lambda nodes: nem.complete_grudge(
+            nem.bisect(list(nodes))))
+        p = p.setup(test)
+        res = p.invoke(test, {"type": "info", "f": "start", "value": None})
+        assert res["value"][0] == "isolated"
+        cmds = [cmd for _h, cmd in log]
+        drops = [cmd for cmd in cmds if "-j DROP" in cmd]
+        # 5 nodes partitioned -> every node snubs the other side.
+        assert len(drops) == 5
+        assert any("iptables -A INPUT -s" in cmd for cmd in drops)
+        res = p.invoke(test, {"type": "info", "f": "stop", "value": None})
+        assert res["value"] == "network-healed"
+        flushes = [cmd for cmd in cmds if "iptables -F" in cmd]
+        assert flushes  # heal flushed chains
+
+    def test_explicit_grudge_value(self):
+        test, log = dummy_test(("a", "b"))
+        p = nem.partitioner().setup(test)
+        p.invoke(test, {"type": "info", "f": "start",
+                        "value": {"a": {"b"}}})
+        drops = [(h, cmd) for h, cmd in log if "DROP" in cmd]
+        assert len(drops) == 1
+        assert drops[0][0] == "a"
+
+
+class TestCompose:
+    def test_compose_set_and_rename(self):
+        class Recorder(nem.Nemesis, nem.Reflection):
+            def __init__(self, fs):
+                self._fs = fs
+                self.ops = []
+
+            def invoke(self, test, op):
+                self.ops.append(op["f"])
+                return dict(op)
+
+            def fs(self):
+                return list(self._fs)
+
+        a = Recorder(["start", "stop"])
+        b = Recorder(["kill"])
+        composed = nem.compose({
+            frozenset(["start", "stop"]): a,
+            frozenset(["kill"]): b,
+        }).setup({})
+        composed.invoke({}, {"f": "start"})
+        composed.invoke({}, {"f": "kill"})
+        assert a.ops == ["start"]
+        assert b.ops == ["kill"]
+        with pytest.raises(ValueError):
+            composed.invoke({}, {"f": "bogus"})
+        # Renaming route: split-start -> start.
+        a2 = Recorder(["start"])
+        renamed = nem.compose({(("split-start", "start"),): a2}).setup({})
+        out = renamed.invoke({}, {"f": "split-start"})
+        assert a2.ops == ["start"]
+        assert out["f"] == "split-start"
+
+    def test_compose_collection_by_reflection(self):
+        class R(nem.Nemesis, nem.Reflection):
+            def __init__(self, fs):
+                self._fs = fs
+                self.ops = []
+
+            def invoke(self, test, op):
+                self.ops.append(op["f"])
+                return dict(op)
+
+            def fs(self):
+                return list(self._fs)
+
+        a, b = R(["start", "stop"]), R(["kill"])
+        composed = nem.compose([a, b]).setup({})
+        composed.invoke({}, {"f": "kill"})
+        assert b.ops == ["kill"]
+
+
+class TestInterpreterIntegration:
+    def test_partition_through_interpreter(self):
+        test, log = dummy_test()
+        test["concurrency"] = 2
+        test["client"] = test["client"]  # atom client from noop_test
+        test["nemesis"] = nem.validate(
+            nem.partition_random_halves().setup(test))
+        test["generator"] = gen.phases(
+            gen.nemesis(
+                [{"type": "info", "f": "start"},
+                 gen.sleep(0.05),
+                 {"type": "info", "f": "stop"}],
+                gen.limit(10, gen.repeat_({"f": "read"})),
+            ),
+        )
+        history = interpreter.run(test)
+        nem_ops = [o for o in history if o["process"] == "nemesis"]
+        assert {o["f"] for o in nem_ops} == {"start", "stop"}
+        from jepsen_tpu.history import History, Op
+
+        h = History([Op.from_dict(o) for o in history], reindex=True)
+        intervals = nemesis_intervals(h)
+        assert len(intervals) >= 1
+        cmds = [cmd for _h, cmd in log]
+        assert any("DROP" in cmd for cmd in cmds)
+        assert any("iptables -F" in cmd for cmd in cmds)
+
+
+class TestHammerTime:
+    def test_hammer_commands(self):
+        test, log = dummy_test()
+        h = nem.hammer_time("mydb").setup(test)
+        with fixed_rand(2):
+            res = h.invoke(test, {"type": "info", "f": "start"})
+        assert res["type"] == "info"
+        assert any("killall -s STOP mydb" in cmd for _n, cmd in log)
+        res = h.invoke(test, {"type": "info", "f": "stop"})
+        assert any("killall -s CONT mydb" in cmd for _n, cmd in log)
+        # start while running -> refuses
+        with fixed_rand(2):
+            h.invoke(test, {"type": "info", "f": "start"})
+            res = h.invoke(test, {"type": "info", "f": "start"})
+        assert "already disrupting" in str(res["value"])
+        h.invoke(test, {"type": "info", "f": "stop"})
