@@ -91,13 +91,13 @@ def simulate(gen, complete_fn: Callable, ctx: Optional[Context] = None,
                 in_flight = in_flight[1:]
 
 
-def quick_ops(gen, ctx=None):
+def quick_ops(gen, ctx=None, test=None):
     """Every op succeeds instantly with zero latency."""
-    return simulate(gen, lambda ctx, o: {**o, "type": "ok"}, ctx)
+    return simulate(gen, lambda ctx, o: {**o, "type": "ok"}, ctx, test)
 
 
-def quick(gen, ctx=None):
-    return invocations(quick_ops(gen, ctx))
+def quick(gen, ctx=None, test=None):
+    return invocations(quick_ops(gen, ctx, test))
 
 
 def perfect_star(gen, ctx=None):

@@ -89,20 +89,51 @@ def group_threads(n: int, ctx: gen.Context) -> list[list]:
     return [threads[i * n:(i + 1) * n] for i in range(groups)]
 
 
+class _KeySeq:
+    """A lazily-memoized view over a (possibly infinite) key iterable:
+    ``get(i)`` pulls and caches up to index i. Shared by all generator
+    states, so probe-and-discard evaluation never consumes keys twice."""
+
+    __slots__ = ("it", "cache")
+
+    def __init__(self, keys):
+        if isinstance(keys, _KeySeq):
+            self.it = keys.it
+            self.cache = keys.cache
+        elif isinstance(keys, (list, tuple)):
+            self.it = iter(())
+            self.cache = list(keys)
+        else:
+            self.it = iter(keys)
+            self.cache = []
+
+    def get(self, i: int):
+        """Key at index i, or None past the end."""
+        while len(self.cache) <= i:
+            try:
+                self.cache.append(next(self.it))
+            except StopIteration:
+                return None
+        return self.cache[i]
+
+
 class ConcurrentGenerator(gen.Generator):
     """Groups of n threads each work a key; exhausted groups pull the next
     key (independent.clj:103-209). Nemesis excluded; updates route to the
     executing thread's group."""
 
-    __slots__ = ("n", "fgen", "group_threads", "thread_group", "keys", "gens")
+    __slots__ = ("n", "fgen", "group_threads", "thread_group", "keys",
+                 "next_key", "gens")
 
     def __init__(self, n, fgen, group_threads_=None, thread_group=None,
-                 keys=None, gens=None):
+                 keys=None, gens=None, next_key=0):
         self.n = n
         self.fgen = fgen
         self.group_threads = group_threads_
         self.thread_group = thread_group
-        self.keys = list(keys) if keys is not None else []
+        self.keys = keys if isinstance(keys, _KeySeq) else _KeySeq(
+            keys if keys is not None else [])
+        self.next_key = next_key
         self.gens = gens
 
     def _init(self, ctx: gen.Context):
@@ -112,16 +143,21 @@ class ConcurrentGenerator(gen.Generator):
         }
         if self.gens is None:
             groups = len(gt)
-            ks = self.keys[:groups]
-            gens = [tuple_gen(k, self.fgen(k)) for k in ks]
-            gens += [None] * (groups - len(gens))
-            keys = self.keys[groups:]
+            gens = []
+            nk = self.next_key
+            for _ in range(groups):
+                k = self.keys.get(nk)
+                if k is None:
+                    gens.append(None)
+                else:
+                    gens.append(tuple_gen(k, self.fgen(k)))
+                    nk += 1
         else:
-            gens, keys = self.gens, self.keys
-        return gt, tg, keys, gens
+            gens, nk = self.gens, self.next_key
+        return gt, tg, nk, gens
 
     def op(self, test, ctx):
-        gt, tg, keys, gens = self._init(ctx)
+        gt, tg, nk, gens = self._init(ctx)
         free_groups = {tg[t] for t in ctx.free_threads if t in tg}
         soonest = None
         gens = list(gens)
@@ -135,8 +171,9 @@ class ConcurrentGenerator(gen.Generator):
                 )
                 res = gen.op(g, test, gctx)
                 if res is None:
-                    if keys:
-                        k, keys = keys[0], keys[1:]
+                    k = self.keys.get(nk)
+                    if k is not None:
+                        nk += 1
                         gens[group] = tuple_gen(k, self.fgen(k))
                         continue
                     gens[group] = None
@@ -152,14 +189,14 @@ class ConcurrentGenerator(gen.Generator):
             o = soonest["op"]
             if o is gen.PENDING:
                 return (gen.PENDING, ConcurrentGenerator(
-                    self.n, self.fgen, gt, tg, keys, gens))
+                    self.n, self.fgen, gt, tg, self.keys, gens, nk))
             gens2 = list(gens)
             gens2[soonest["group"]] = soonest["gen'"]
             return (o, ConcurrentGenerator(
-                self.n, self.fgen, gt, tg, keys, gens2))
+                self.n, self.fgen, gt, tg, self.keys, gens2, nk))
         if any(g is not None for g in gens):
             return (gen.PENDING, ConcurrentGenerator(
-                self.n, self.fgen, gt, tg, keys, gens))
+                self.n, self.fgen, gt, tg, self.keys, gens, nk))
         return None
 
     def update(self, test, ctx, event):
@@ -173,14 +210,15 @@ class ConcurrentGenerator(gen.Generator):
         gens[group] = gen.update(gens[group], test, ctx, event)
         return ConcurrentGenerator(
             self.n, self.fgen, self.group_threads, self.thread_group,
-            self.keys, gens)
+            self.keys, gens, self.next_key)
 
 
 def concurrent_generator(n: int, keys: Iterable, fgen: Callable):
     """n threads per key, keys taken in order as groups free up
-    (independent.clj:211-236)."""
+    (independent.clj:211-236). ``keys`` may be an infinite iterable — it
+    is consumed lazily with memoization."""
     assert isinstance(n, int) and n > 0
-    return gen.clients(ConcurrentGenerator(n, fgen, keys=list(keys)))
+    return gen.clients(ConcurrentGenerator(n, fgen, keys=_KeySeq(keys)))
 
 
 # ---------------------------------------------------------------------------

@@ -66,6 +66,10 @@ INT32_MAX = np.int32(2**31 - 1)
 # configs) cheap.
 F_SCHEDULE = (16, 128, 1024, 8192, 32768)
 
+# Expansions larger than this use the two-stage compaction (pre-compact
+# valid rows to a 4F buffer before the dedup sort). Patchable for tests.
+BIG_M_THRESHOLD = 1 << 20
+
 
 def _next_pow2(x: int, lo: int = 32) -> int:
     return max(lo, 1 << (int(x) - 1).bit_length())
@@ -275,11 +279,11 @@ def _build_kernel(model_key, F: int, W: int, KO: int, S: int, ND: int, NO: int):
 
             acc_now = jnp.any(nvalid & (np_ >= nD))
 
-            # --- dedup + dominance prune + compact: two sorts, no gathers --
-            # Sort the FULL expansion by (validity, group-hash, open-mask):
-            # rows with equal (p, maskD, state) — one *group* — land
-            # adjacent (modulo hash collision, which can only cost a missed
-            # prune: all compares below are on the real columns), ordered by
+            # --- dedup + dominance prune + compact ------------------------
+            # Sort rows by (validity, group-hash, open-mask): rows with
+            # equal (p, maskD, state) — one *group* — land adjacent
+            # (modulo hash collision, which can only cost a missed prune:
+            # all compares below are on the real columns), ordered by
             # open-mask within the group.
             pcol = np_.astype(jnp.uint32)
             dcols = [nmD[:, w] for w in range(KD)]
@@ -287,8 +291,38 @@ def _build_kernel(model_key, F: int, W: int, KO: int, S: int, ND: int, NO: int):
                 lax.bitcast_convert_type(st2[:, i], jnp.uint32) for i in range(S)
             ]
             ocols = [nmO[:, w] for w in range(max(KO, 1))]
-            gh1 = jnp.full((M,), u32(2166136261))
-            gh2 = jnp.full((M,), u32(0x9E3779B9))
+
+            # Two-stage at large M: a multi-operand sort over the whole
+            # expansion dominates level cost once M is in the millions
+            # (bitonic passes scale ~log^2), while the candidate count is
+            # usually far below M. Pre-compact the valid rows into a 4F
+            # buffer (cumsum + searchsorted + ONE packed gather), treating
+            # >4F survivors as overflow (lossless: handled like any
+            # frontier overflow).
+            pre_ovf = jnp.asarray(False)
+            L = M
+            if M > BIG_M_THRESHOLD:
+                P = min(M, max(4 * F, 64))
+                posv = jnp.cumsum(nvalid.astype(jnp.int32))
+                n_cand = posv[M - 1]
+                pre_ovf = n_cand > P
+                vidx = jnp.searchsorted(
+                    posv, jnp.arange(1, P + 1, dtype=jnp.int32), side="left"
+                )
+                vidx = jnp.minimum(vidx, M - 1)
+                colmat = jnp.stack(
+                    [pcol] + dcols + scols + ocols, axis=1
+                )  # [M, NC]
+                pmat = colmat[vidx]  # ONE gather
+                pcol = pmat[:, 0]
+                dcols = [pmat[:, 1 + w] for w in range(KD)]
+                scols = [pmat[:, 1 + KD + i] for i in range(S)]
+                ocols = [pmat[:, 1 + KD + S + w] for w in range(len(ocols))]
+                nvalid = lax.iota(jnp.int32, P) < jnp.minimum(n_cand, P)
+                L = P
+
+            gh1 = jnp.full((L,), u32(2166136261))
+            gh2 = jnp.full((L,), u32(0x9E3779B9))
             for c in [pcol] + dcols + scols:
                 gh1 = (gh1 ^ c) * u32(16777619)
                 gh2 = (gh2 ^ (c + u32(0x85EBCA6B))) * u32(0xC2B2AE35)
@@ -326,7 +360,7 @@ def _build_kernel(model_key, F: int, W: int, KO: int, S: int, ND: int, NO: int):
             head = list(socols)
             done = is_start
             d = 1
-            while d < M:
+            while d < L:
                 prev_head = [
                     jnp.concatenate([h[:d], h[:-d]]) for h in head
                 ]
@@ -345,33 +379,39 @@ def _build_kernel(model_key, F: int, W: int, KO: int, S: int, ND: int, NO: int):
             # head[i] always comes from row i's own segment.)
             keep = svalid & ~(same_group & prev_sub) & ~head_sub
             count = jnp.sum(keep.astype(jnp.int32))
-            ovf_now = count > F
+            ovf_now = pre_ovf | (count > F)
 
             # Compaction: one stable sort brings kept rows to the front,
-            # most-advanced (largest p) first — so beam-mode truncation
-            # keeps the configs closest to acceptance; a static slice
-            # takes the first F.
+            # most-advanced (largest p) first and fewest-opens-used next —
+            # so beam-mode truncation keeps the configs closest to
+            # acceptance with the most flexibility left (a config using
+            # fewer opens subsumes more futures). A static slice takes the
+            # first F.
             ck = (~keep).astype(u32)
+            opc_used = socols[0] * u32(0)
+            for c in socols:
+                opc_used = opc_used + lax.population_count(c)
             comp = lax.sort(
-                tuple([ck, ~spcol, spcol] + sdcols + socols + sscols),
+                tuple([ck, ~spcol, opc_used, spcol] + sdcols + socols
+                      + sscols),
                 dimension=0,
-                num_keys=2,
+                num_keys=3,
                 is_stable=True,
             )
             kvalid = lax.iota(jnp.int32, F) < jnp.minimum(count, F)
             top = lambda c: lax.slice_in_dim(c, 0, F, axis=0)
-            kp = top(comp[2]).astype(jnp.int32) * kvalid
+            kp = top(comp[3]).astype(jnp.int32) * kvalid
             kmD = jnp.stack(
-                [top(comp[3 + w]) * kvalid for w in range(KD)], axis=1
+                [top(comp[4 + w]) * kvalid for w in range(KD)], axis=1
             )
             kmO = jnp.stack(
-                [top(comp[3 + KD + w]) * kvalid for w in range(max(KO, 1))],
+                [top(comp[4 + KD + w]) * kvalid for w in range(max(KO, 1))],
                 axis=1,
             )
             kst = jnp.stack(
                 [
                     lax.bitcast_convert_type(
-                        top(comp[3 + KD + max(KO, 1) + i]), jnp.int32
+                        top(comp[4 + KD + max(KO, 1) + i]), jnp.int32
                     )
                     * kvalid
                     for i in range(S)
@@ -592,6 +632,7 @@ def check_encoded_device(
     max_open: int = 128,
     window_cap: int = 1024,
     levels_per_call: Optional[int] = None,
+    pad_to: Optional[tuple] = None,
 ) -> dict:
     """Decide linearizability of an encoded history on the default JAX
     backend (TPU when present). Result map mirrors the host oracle
@@ -606,7 +647,8 @@ def check_encoded_device(
     progress heartbeat."""
     t0 = _time.perf_counter()
     n = enc.n
-    plan = plan_device(enc, max_open=max_open, window_cap=window_cap)
+    plan = plan_device(enc, max_open=max_open, window_cap=window_cap,
+                       pad_to=pad_to)
     if plan.nD == 0:
         # No required op — the empty linearization (skip all open ops) wins.
         return {"valid": True, "op_count": n, "device": True, "levels": 0}
