@@ -1,0 +1,80 @@
+"""CharybdeFS disk-fault injection driver.
+
+Mirrors the reference's charybdefs wrapper (charybdefs/src/jepsen/
+charybdefs.clj): builds ScyllaDB's CharybdeFS (a FUSE passthrough
+filesystem with a thrift control port) from source on each node
+(charybdefs.clj:7-38), mounts ``/faulty`` over ``/real``
+(charybdefs.clj:60-65), and injects faults — EIO on every op, or
+probabilistic 1% faults — via the thrift control interface
+(charybdefs.clj:72-85). The thrift calls run node-side through a small
+python snippet (the reference uses an in-process thrift client; the
+node-side client keeps our control plane dependency-free).
+"""
+
+from __future__ import annotations
+
+from . import control as c
+from .control import util as cu
+
+REPO = "https://github.com/scylladb/charybdefs.git"
+DIR = "/opt/jepsen/charybdefs"
+MOUNT = "/faulty"
+BACKING = "/real"
+
+
+def install() -> None:
+    """Build charybdefs + thrift from source (charybdefs.clj:7-38),
+    mount /faulty over /real (charybdefs.clj:40-65)."""
+    from .os_ import debian
+
+    with c.su():
+        debian.install(["git", "cmake", "g++", "fuse", "libfuse-dev",
+                        "thrift-compiler", "libthrift-dev",
+                        "python3-thrift"])
+        c.exec("mkdir", "-p", "/opt/jepsen")
+        with c.cd("/opt/jepsen"):
+            if not cu.exists(DIR):
+                c.exec("git", "clone", REPO, DIR)
+        with c.cd(DIR):
+            c.exec_star("thrift -r --gen cpp server.thrift && "
+                        "cmake CMakeLists.txt && make")
+        c.exec("mkdir", "-p", MOUNT, BACKING)
+        c.exec_star(
+            f"mount | grep -q {c.escape(MOUNT)} || "
+            f"{DIR}/charybdefs {MOUNT} -omodules=subdir,subdir={BACKING}")
+
+
+_THRIFT_SNIPPET = """
+import sys
+sys.path.insert(0, "{dir}/gen-py")
+from thrift.transport import TSocket, TTransport
+from thrift.protocol import TBinaryProtocol
+from server import server
+sock = TSocket.TSocket("127.0.0.1", 9090)
+transport = TTransport.TBufferedTransport(sock)
+client = server.Client(TBinaryProtocol.TBinaryProtocol(transport))
+transport.open()
+client.{call}
+transport.close()
+"""
+
+
+def _thrift(call: str) -> None:
+    """Run one thrift control call on the bound node."""
+    snippet = _THRIFT_SNIPPET.format(dir=DIR, call=call)
+    c.exec_star(f"python3 - <<'JEPSEN_EOF'\n{snippet}\nJEPSEN_EOF")
+
+
+def break_all() -> None:
+    """EIO on every filesystem op (charybdefs.clj:72-75)."""
+    _thrift('set_all_fault(False, 5, 0, 100000, "", False, 0, False)')
+
+
+def break_one_percent() -> None:
+    """Probabilistic faults on 1% of ops (charybdefs.clj:77-80)."""
+    _thrift('set_all_fault(True, 5, 1000, 0, "", False, 0, False)')
+
+
+def clear() -> None:
+    """Heal the filesystem (charybdefs.clj:82-85)."""
+    _thrift("clear_all_faults()")

@@ -389,8 +389,15 @@ def _wrap_cd(cmd: str) -> str:
 
 def _wrap_sudo(action: dict) -> dict:
     if _env.sudo:
+        cmd = escape(action["cmd"])
+        user = _env.sudo
+        # Skip sudo when we're already the target user (e.g. root inside a
+        # container without sudo installed).
         return {
-            "cmd": f"sudo -S -u {_env.sudo} bash -c {escape(action['cmd'])}",
+            "cmd": (
+                f'if [ "$(id -un)" = {user} ]; then bash -c {cmd}; '
+                f"else sudo -S -u {user} bash -c {cmd}; fi"
+            ),
             "in": action.get("in"),
         }
     return action

@@ -110,3 +110,70 @@ def cycle(test: dict, retries: int = 3) -> None:
 def teardown_all(test: dict) -> None:
     db: DB = test.get("db") or noop()
     real_pmap(lambda n: db.teardown(test, n), test.get("nodes") or [])
+
+
+class Tcpdump(DB, LogFiles):
+    """Packet capture running from setup to teardown (db.clj:49-115).
+
+    opts: ``ports`` (list), ``clients_only`` (filter to control-node
+    traffic), ``filter`` (extra pcap filter string)."""
+
+    DIR = "/tmp/jepsen/tcpdump"
+
+    def __init__(self, opts: Optional[dict] = None):
+        self.opts = dict(opts or {})
+        self.log_file = f"{self.DIR}/log"
+        self.cap_file = f"{self.DIR}/tcpdump"
+        self.pid_file = f"{self.DIR}/pid"
+
+    def setup(self, test, node):
+        from . import control as c
+        from .control import net as cnet
+        from .control import util as cu
+
+        with c.su():
+            c.exec("mkdir", "-p", self.DIR)
+            filters = []
+            ports = self.opts.get("ports") or []
+            if ports:
+                filters.append(
+                    "(" + " or ".join(f"port {p}" for p in ports) + ")")
+            if self.opts.get("clients_only"):
+                filters.append(f"host {cnet.control_ip()}")
+            if self.opts.get("filter"):
+                filters.append(self.opts["filter"])
+            cu.start_daemon(
+                {"logfile": self.log_file, "pidfile": self.pid_file,
+                 "chdir": self.DIR},
+                "/usr/sbin/tcpdump",
+                "-w", self.cap_file, "-s", 65535, "-B", 16384, "-U",
+                " and ".join(filters),
+            )
+
+    def teardown(self, test, node):
+        import time as _t
+
+        from . import control as c
+        from .control import util as cu
+
+        with c.su():
+            if cu.daemon_running(self.pid_file):
+                # Ask for a clean exit so the capture flushes.
+                pid = c.exec("cat", self.pid_file)
+                try:
+                    c.exec("kill", "-s", "INT", pid)
+                except c.RemoteError:
+                    pass
+                for _ in range(100):
+                    if not cu.daemon_running(self.pid_file):
+                        break
+                    _t.sleep(0.05)
+            cu.stop_daemon(self.pid_file, "tcpdump")
+            c.exec("rm", "-rf", self.DIR)
+
+    def log_files(self, test, node):
+        return [self.log_file, self.cap_file]
+
+
+def tcpdump(opts: Optional[dict] = None) -> DB:
+    return Tcpdump(opts)

@@ -1,0 +1,67 @@
+"""libfaketime wrappers: run DB binaries under warped clock *rates*.
+
+Mirrors jepsen.faketime (jepsen/src/jepsen/faketime.clj): replaces a DB
+binary with a shell script that re-execs the original under
+``faketime -m -f "<±offset>s x<rate>"`` (faketime.clj:24-47), plus the
+rand-factor helper for choosing per-node rates (faketime.clj:57-65).
+"""
+
+from __future__ import annotations
+
+from . import control as c
+from . import generator as gen
+from .control import util as cu
+
+
+def install() -> None:
+    """Build and install the patched libfaketime from source
+    (faketime.clj:8-22 installs the jepsen fork with COARSE-clock
+    support)."""
+    with c.su():
+        c.exec("mkdir", "-p", "/tmp/jepsen")
+        with c.cd("/tmp/jepsen"):
+            if not cu.exists("libfaketime-jepsen"):
+                c.exec("git", "clone",
+                       "https://github.com/jepsen-io/libfaketime.git",
+                       "libfaketime-jepsen")
+            with c.cd("libfaketime-jepsen"):
+                c.exec("git", "checkout", "0.9.6-jepsen1")
+                c.exec("make")
+                c.exec("make", "install")
+
+
+def script(cmd: str, init_offset: float, rate: float) -> str:
+    """The wrapper script body (faketime.clj:24-34)."""
+    off = int(init_offset)
+    sign = "-" if off < 0 else "+"
+    return (
+        "#!/bin/bash\n"
+        f'faketime -m -f "{sign}{abs(off)}s x{float(rate)}" '
+        f'{cmd} "$@"'
+    )
+
+
+def wrap(cmd: str, init_offset: float, rate: float) -> None:
+    """Replace ``cmd`` with a faketime wrapper, moving the original to
+    ``cmd.no-faketime``; idempotent (faketime.clj:36-47)."""
+    orig = f"{cmd}.no-faketime"
+    wrapper = script(orig, init_offset, rate)
+    if not cu.exists(orig):
+        c.exec("mv", cmd, orig)
+    c.exec_star(f"cat > {c.escape(cmd)} <<'JEPSEN_EOF'\n{wrapper}\nJEPSEN_EOF")
+    c.exec("chmod", "a+x", cmd)
+
+
+def unwrap(cmd: str) -> None:
+    """Restore the original binary (faketime.clj:49-55)."""
+    orig = f"{cmd}.no-faketime"
+    if cu.exists(orig):
+        c.exec("mv", orig, cmd)
+
+
+def rand_factor(factor: float) -> float:
+    """A random rate near 1 such that max/min == factor
+    (faketime.clj:57-65)."""
+    hi = 2 / (1 + 1 / factor)
+    lo = hi / factor
+    return lo + gen.rand_float(hi - lo)

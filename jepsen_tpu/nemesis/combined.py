@@ -1,0 +1,290 @@
+"""Composable "nemesis package" algebra.
+
+Mirrors jepsen.nemesis.combined (jepsen/src/jepsen/nemesis/combined.clj):
+a *package* is a map {"nemesis", "generator", "final-generator", "perf"}
+so fault modes compose as values — mixed generators, f-routed nemeses,
+sequential final healing, and perf-plot region specs
+(combined.clj:1-27,266-274).
+
+Node targeting uses the db-nodes spec DSL (combined.clj:29-50): None |
+"one" | "minority" | "majority" | "primaries" | "all" | explicit list.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterable, Optional
+
+from .. import db as jdb
+from .. import generator as gen
+from ..util import majority
+from . import (
+    Nemesis,
+    Reflection,
+    bisect,
+    complete_grudge,
+    compose,
+    majorities_ring,
+    partitioner,
+    split_one,
+    _shuffled,
+)
+from .time import (
+    bump_gen,
+    clock_nemesis,
+    random_nonempty_subset,
+    reset_gen,
+    strobe_gen,
+)
+
+DEFAULT_INTERVAL = 10  # seconds between nemesis ops (combined.clj:25-27)
+
+
+def db_nodes(test: dict, db, node_spec) -> list:
+    """Resolve a node spec to nodes (combined.clj:29-50)."""
+    nodes = test["nodes"]
+    if node_spec is None:
+        return random_nonempty_subset(nodes)
+    if node_spec == "one":
+        return [nodes[gen.rand_int(len(nodes))]]
+    if node_spec == "minority":
+        return _shuffled(nodes)[: majority(len(nodes)) - 1]
+    if node_spec == "majority":
+        return _shuffled(nodes)[: majority(len(nodes))]
+    if node_spec == "primaries":
+        assert isinstance(db, jdb.Primary)
+        return random_nonempty_subset(db.primaries(test))
+    if node_spec == "all":
+        return list(nodes)
+    return list(node_spec)
+
+
+def node_specs(db) -> list:
+    """All applicable node specs (combined.clj:52-57)."""
+    out = [None, "one", "minority", "majority", "all"]
+    if isinstance(db, jdb.Primary):
+        out.append("primaries")
+    return out
+
+
+class DbNemesis(Nemesis, Reflection):
+    """start/kill/pause/resume the DB's process on targeted nodes
+    (combined.clj:59-87); :value is a node spec."""
+
+    def __init__(self, db):
+        self.db = db
+
+    def invoke(self, test, op):
+        from .. import control as c
+
+        fns = {
+            "start": lambda t, n: self.db.start(t, n),
+            "kill": lambda t, n: self.db.kill(t, n),
+            "pause": lambda t, n: self.db.pause(t, n),
+            "resume": lambda t, n: self.db.resume(t, n),
+        }
+        f = fns[op["f"]]
+        nodes = db_nodes(test, self.db, op.get("value"))
+        res = c.on_nodes(test, f, nodes)
+        return {**op, "value": res}
+
+    def fs(self):
+        return ["start", "kill", "pause", "resume"]
+
+
+def db_nemesis(db) -> Nemesis:
+    return DbNemesis(db)
+
+
+def db_generators(opts: dict) -> dict:
+    """{"generator", "final-generator"} for kill/pause modes
+    (combined.clj:89-128)."""
+    db = opts["db"]
+    faults = set(opts.get("faults") or [])
+    kill = isinstance(db, jdb.Process) and "kill" in faults
+    pause = isinstance(db, jdb.Pause) and "pause" in faults
+    kill_targets = (opts.get("kill") or {}).get("targets") or node_specs(db)
+    pause_targets = (opts.get("pause") or {}).get("targets") or node_specs(db)
+
+    start = {"type": "info", "f": "start", "value": "all"}
+    resume = {"type": "info", "f": "resume", "value": "all"}
+
+    def kill_op(test=None, ctx=None):
+        return {"type": "info", "f": "kill",
+                "value": kill_targets[gen.rand_int(len(kill_targets))]}
+
+    def pause_op(test=None, ctx=None):
+        return {"type": "info", "f": "pause",
+                "value": pause_targets[gen.rand_int(len(pause_targets))]}
+
+    modes = []
+    final = []
+    if pause:
+        modes.append(gen.flip_flop(pause_op, gen.repeat_(resume)))
+        final.append(resume)
+    if kill:
+        modes.append(gen.flip_flop(kill_op, gen.repeat_(start)))
+        final.append(start)
+    return {"generator": gen.mix(modes) if modes else None,
+            "final-generator": final}
+
+
+def db_package(opts: dict) -> Optional[dict]:
+    """combined.clj:130-149."""
+    faults = set(opts.get("faults") or [])
+    if not ({"kill", "pause"} & faults):
+        return None
+    gens = db_generators(opts)
+    if gens["generator"] is None:
+        return None
+    interval = opts.get("interval", DEFAULT_INTERVAL)
+    return {
+        "generator": gen.stagger(interval, gens["generator"]),
+        "final-generator": gens["final-generator"],
+        "nemesis": db_nemesis(opts["db"]),
+        "perf": [
+            {"name": "kill", "start": {"kill"}, "stop": {"start"},
+             "color": "#E9A4A0"},
+            {"name": "pause", "start": {"pause"}, "stop": {"resume"},
+             "color": "#A0B1E9"},
+        ],
+    }
+
+
+def grudge(test: dict, db, part_spec) -> dict:
+    """Partition spec -> grudge (combined.clj:151-173)."""
+    nodes = test["nodes"]
+    if part_spec == "one":
+        return complete_grudge(split_one(list(nodes)))
+    if part_spec == "majority":
+        return complete_grudge(bisect(_shuffled(nodes)))
+    if part_spec == "majorities-ring":
+        return majorities_ring(nodes)
+    if part_spec == "primaries":
+        assert isinstance(db, jdb.Primary)
+        prims = random_nonempty_subset(db.primaries(test))
+        rest = [n for n in nodes if n not in set(prims)]
+        return complete_grudge([rest] + [[p] for p in prims])
+    return part_spec  # already a grudge
+
+
+def partition_specs(db) -> list:
+    """combined.clj:175-179."""
+    out = [None, "one", "majority", "majorities-ring"]
+    if isinstance(db, jdb.Primary):
+        out.append("primaries")
+    return out
+
+
+class PartitionNemesis(Nemesis, Reflection):
+    """Partitioner wrapper speaking partition specs
+    (combined.clj:181-209)."""
+
+    def __init__(self, db, p=None):
+        self.db = db
+        self.p = p or partitioner()
+
+    def setup(self, test):
+        return PartitionNemesis(self.db, self.p.setup(test))
+
+    def invoke(self, test, op):
+        f = op["f"]
+        if f == "start-partition":
+            spec = op.get("value")
+            g = grudge(test, self.db, spec) if spec is not None else None
+            if g is None:
+                g = complete_grudge(bisect(_shuffled(test["nodes"])))
+            res = self.p.invoke(test, {**op, "f": "start", "value": g})
+        elif f == "stop-partition":
+            res = self.p.invoke(test, {**op, "f": "stop", "value": None})
+        else:
+            raise ValueError(f"partition nemesis can't handle {f!r}")
+        return {**res, "f": f}
+
+    def teardown(self, test):
+        self.p.teardown(test)
+
+    def fs(self):
+        return ["start-partition", "stop-partition"]
+
+
+def partition_package(opts: dict) -> Optional[dict]:
+    """combined.clj:210-230."""
+    if "partition" not in set(opts.get("faults") or []):
+        return None
+    db = opts["db"]
+    targets = (opts.get("partition") or {}).get("targets") or \
+        partition_specs(db)
+    interval = opts.get("interval", DEFAULT_INTERVAL)
+
+    def start(test=None, ctx=None):
+        return {"type": "info", "f": "start-partition",
+                "value": targets[gen.rand_int(len(targets))]}
+
+    stop = {"type": "info", "f": "stop-partition", "value": None}
+    return {
+        "generator": gen.stagger(
+            interval, gen.flip_flop(start, gen.repeat_(stop))),
+        "final-generator": stop,
+        "nemesis": PartitionNemesis(db),
+        "perf": [{"name": "partition", "start": {"start-partition"},
+                  "stop": {"stop-partition"}, "color": "#E9DCA0"}],
+    }
+
+
+def clock_package(opts: dict) -> Optional[dict]:
+    """combined.clj:232-264."""
+    if "clock" not in set(opts.get("faults") or []):
+        return None
+    interval = opts.get("interval", DEFAULT_INTERVAL)
+    nemesis = compose({
+        (("reset-clock", "reset"),
+         ("check-clock-offsets", "check-offsets"),
+         ("strobe-clock", "strobe"),
+         ("bump-clock", "bump")): clock_nemesis(),
+    })
+    inner = gen.phases(
+        {"type": "info", "f": "check-offsets"},
+        gen.mix([reset_gen, bump_gen, strobe_gen]),
+    )
+    g = gen.stagger(interval, gen.f_map({
+        "reset": "reset-clock",
+        "check-offsets": "check-clock-offsets",
+        "strobe": "strobe-clock",
+        "bump": "bump-clock",
+    }, inner))
+    return {
+        "generator": g,
+        "final-generator": {"type": "info", "f": "reset-clock"},
+        "nemesis": nemesis,
+        "perf": [{"name": "clock", "start": {"bump-clock"},
+                  "stop": {"reset-clock"}, "fs": {"strobe-clock"},
+                  "color": "#A0E9E3"}],
+    }
+
+
+def compose_packages(packages: Iterable[dict]) -> dict:
+    """Mix generators, sequence final generators, compose nemeses
+    (combined.clj:266-274)."""
+    packages = [p for p in packages if p]
+    return {
+        "generator": gen.mix([p["generator"] for p in packages]),
+        "final-generator": [p["final-generator"] for p in packages
+                            if p.get("final-generator") is not None],
+        "nemesis": compose([p["nemesis"] for p in packages]),
+        "perf": [spec for p in packages for spec in (p.get("perf") or [])],
+    }
+
+
+def nemesis_packages(opts: dict) -> list:
+    """combined.clj:276-284."""
+    opts = dict(opts)
+    opts["faults"] = set(
+        opts.get("faults") or ["partition", "kill", "pause", "clock"])
+    return [p for p in (partition_package(opts), clock_package(opts),
+                        db_package(opts)) if p]
+
+
+def nemesis_package(opts: dict) -> dict:
+    """The all-in-one package (combined.clj:286-332). Mandatory: opts["db"].
+    Optional: interval, faults, partition/kill/pause/clock target specs."""
+    return compose_packages(nemesis_packages(opts))

@@ -1,0 +1,212 @@
+"""Clock-skew faults: compile C helpers on nodes, jump/strobe/reset
+clocks.
+
+Mirrors jepsen.nemesis.time (jepsen/src/jepsen/nemesis/time.clj): the C
+sources in jepsen_tpu/resources/ are uploaded and compiled with cc on
+each node (time.clj:14-52), the clock nemesis handles
+:reset/:strobe/:bump/:check-offsets ops and annotates completions with
+``clock-offsets`` maps (time.clj:89-139, consumed by
+jepsen_tpu.checker.clock), and the generators produce exponentially
+distributed skews from 4 ms to ~262 s (time.clj:141-198).
+"""
+
+from __future__ import annotations
+
+import time as _time
+from pathlib import Path
+from typing import Any, Optional
+
+from .. import control as c
+from .. import generator as gen
+from ..util import majority
+from . import Nemesis, Reflection
+
+RESOURCES = Path(__file__).resolve().parent.parent / "resources"
+INSTALL_DIR = "/opt/jepsen"
+
+
+def compile_c(source_path, bin_name: str) -> str:
+    """Upload a C source and build it with cc on the bound node
+    (time.clj:14-41)."""
+    with c.su():
+        c.exec("mkdir", "-p", INSTALL_DIR)
+        c.exec("chmod", "a+rwx", INSTALL_DIR)
+        c.upload(str(source_path), f"{INSTALL_DIR}/{bin_name}.c")
+        with c.cd(INSTALL_DIR):
+            c.exec("cc", "-O2", "-o", bin_name, f"{bin_name}.c")
+    return bin_name
+
+
+def compile_tools() -> None:
+    """time.clj:43-48."""
+    compile_c(RESOURCES / "bump_time.c", "bump-time")
+    compile_c(RESOURCES / "strobe_time.c", "strobe-time")
+
+
+def install() -> None:
+    """Compile the clock tools, installing a compiler first if needed
+    (time.clj:50-61)."""
+    try:
+        compile_tools()
+    except c.RemoteError:
+        for attempt in ("apt-get install -y build-essential",
+                        "yum install -y gcc"):
+            try:
+                with c.su():
+                    c.exec_star(attempt)
+                break
+            except c.RemoteError:
+                continue
+        compile_tools()
+
+
+def parse_time(s: str) -> float:
+    return float(s.strip())
+
+
+def clock_offset(remote_time: float) -> float:
+    """Remote wall time minus control-node wall time, seconds
+    (time.clj:67-72)."""
+    return remote_time - _time.time()
+
+
+def current_offset() -> float:
+    """Bound node's clock offset in seconds (time.clj:74-77)."""
+    return clock_offset(parse_time(c.exec("date", "+%s.%N")))
+
+
+def reset_time() -> None:
+    """NTP-reset the bound node's clock (time.clj:79-84)."""
+    with c.su():
+        c.exec("ntpdate", "-b", "time.google.com")
+
+
+def bump_time(delta_ms: float) -> float:
+    """Jump the bound node's clock by delta ms; returns the resulting
+    offset (time.clj:86-90)."""
+    with c.su():
+        return clock_offset(
+            parse_time(c.exec(f"{INSTALL_DIR}/bump-time", delta_ms)))
+
+
+def strobe_time(delta_ms: float, period_ms: float, duration_s: float) -> None:
+    """time.clj:92-96."""
+    with c.su():
+        c.exec(f"{INSTALL_DIR}/strobe-time", delta_ms, period_ms, duration_s)
+
+
+class ClockNemesis(Nemesis, Reflection):
+    """Clock manipulation (time.clj:98-139). Ops:
+
+    - {"f": "reset", "value": [node, ...]}
+    - {"f": "strobe", "value": {node: {"delta": ms, "period": ms,
+                                        "duration": s}}}
+    - {"f": "bump", "value": {node: delta-ms}}
+    - {"f": "check-offsets"}
+
+    Completions carry a ``clock-offsets`` {node: seconds} entry."""
+
+    def setup(self, test):
+        c.with_test_nodes(test, lambda node: install())
+
+        def stop_ntp(node):
+            for svc in ("ntp", "ntpd", "chronyd", "systemd-timesyncd"):
+                try:
+                    with c.su():
+                        c.exec("service", svc, "stop")
+                except c.RemoteError:
+                    pass
+
+        c.with_test_nodes(test, stop_ntp)
+        c.with_test_nodes(test, lambda node: reset_time())
+        return self
+
+    def invoke(self, test, op):
+        f = op.get("f")
+        if f == "reset":
+            res = c.on_nodes(
+                test,
+                lambda t, n: (reset_time(), current_offset())[1],
+                op.get("value"),
+            )
+        elif f == "check-offsets":
+            res = c.on_nodes(test, lambda t, n: current_offset())
+        elif f == "strobe":
+            m = op.get("value") or {}
+
+            def strobe(t, node):
+                spec = m[node]
+                strobe_time(spec["delta"], spec["period"], spec["duration"])
+                return current_offset()
+
+            res = c.on_nodes(test, strobe, list(m.keys()))
+        elif f == "bump":
+            m = op.get("value") or {}
+            res = c.on_nodes(
+                test, lambda t, n: bump_time(m[n]), list(m.keys()))
+        else:
+            raise ValueError(f"clock nemesis can't handle f={f!r}")
+        return {**op, "clock-offsets": res}
+
+    def teardown(self, test):
+        try:
+            c.with_test_nodes(test, lambda node: reset_time())
+        except Exception:
+            pass
+
+    def fs(self):
+        return ["reset", "strobe", "bump", "check-offsets"]
+
+
+def clock_nemesis() -> Nemesis:
+    return ClockNemesis()
+
+
+def random_nonempty_subset(nodes: list) -> list:
+    out = [n for n in nodes if gen.rand_int(2)]
+    if not out:
+        out = [nodes[gen.rand_int(len(nodes))]]
+    return out
+
+
+def _exp_ms() -> int:
+    """4 ms .. ~262 s, exponentially distributed (time.clj:158-190)."""
+    return int(2 ** (2 + gen.rand_float(16.0)))
+
+
+def reset_gen(test, ctx):
+    """time.clj:141-155."""
+    return {"type": "info", "f": "reset",
+            "value": random_nonempty_subset(test["nodes"])}
+
+
+def bump_gen(test, ctx):
+    """±(2^2 .. 2^18) ms bumps (time.clj:157-172)."""
+    sign = [-1, 1][gen.rand_int(2)]
+    return {
+        "type": "info", "f": "bump",
+        "value": {n: sign * _exp_ms()
+                  for n in random_nonempty_subset(test["nodes"])},
+    }
+
+
+def strobe_gen(test, ctx):
+    """time.clj:174-190."""
+    return {
+        "type": "info", "f": "strobe",
+        "value": {
+            n: {"delta": _exp_ms(),
+                "period": int(2 ** gen.rand_float(10.0)),
+                "duration": gen.rand_float(32.0)}
+            for n in random_nonempty_subset(test["nodes"])
+        },
+    }
+
+
+def clock_gen():
+    """Random schedule of clock skews, starting with a check-offsets to
+    establish a baseline (time.clj:192-198)."""
+    return gen.phases(
+        {"type": "info", "f": "check-offsets"},
+        gen.mix([reset_gen, bump_gen, strobe_gen]),
+    )

@@ -1,0 +1,48 @@
+"""CentOS provisioning (jepsen.os.centos, jepsen/src/jepsen/os/
+centos.clj): yum package management + OS implementation.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Optional
+
+from .. import control as c
+from . import OS
+
+
+def installed(pkgs: Iterable[str]) -> dict:
+    out = {}
+    for p in pkgs:
+        try:
+            v = c.exec_star(
+                "rpm -q --queryformat '%{VERSION}' " + c.escape(p))
+            out[p] = v.strip()
+        except c.RemoteError:
+            pass
+    return out
+
+
+def install(pkgs: Iterable[str]) -> None:
+    """centos.clj's yum install-if-missing."""
+    pkgs = list(pkgs)
+    missing = [p for p in pkgs if p not in installed(pkgs)]
+    if missing:
+        with c.su():
+            c.exec_star("yum install -y " +
+                        " ".join(c.escape(p) for p in missing))
+
+
+class Centos(OS):
+    def setup(self, test, node):
+        install(["curl", "wget", "unzip", "iptables", "ntpdate", "psmisc",
+                 "tar", "bzip2"])
+
+    def teardown(self, test, node):
+        pass
+
+    def __repr__(self):
+        return "<os.centos>"
+
+
+def os() -> OS:
+    return Centos()

@@ -1,0 +1,77 @@
+"""Debian provisioning (jepsen.os.debian, jepsen/src/jepsen/os/debian.clj):
+hostfile setup, apt package management, and the Debian OS implementation.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Optional
+
+from .. import control as c
+from . import OS
+
+
+def setup_hostfile() -> None:
+    """Add all test nodes to /etc/hosts... handled per-suite in the
+    reference (debian.clj:13-26); here: ensure hostname resolves."""
+    name = c.exec("hostname")
+    try:
+        c.exec("grep", name, "/etc/hosts")
+    except c.RemoteError:
+        with c.su():
+            c.exec_star(
+                f"echo 127.0.1.1 {c.escape(name)} >> /etc/hosts")
+
+
+def installed(pkgs: Iterable[str]) -> dict:
+    """Map of package -> version for installed packages
+    (debian.clj:35-46)."""
+    out = {}
+    for p in pkgs:
+        try:
+            v = c.exec_star(
+                f"dpkg-query -W -f='${{Version}}' {c.escape(p)}")
+            out[p] = v.strip()
+        except c.RemoteError:
+            pass
+    return out
+
+
+def installed_version(pkg: str) -> Optional[str]:
+    """debian.clj:72-78."""
+    return installed([pkg]).get(pkg)
+
+
+def install(pkgs: Iterable[str]) -> None:
+    """Install apt packages if missing (debian.clj:80-90)."""
+    pkgs = list(pkgs)
+    missing = [p for p in pkgs if p not in installed(pkgs)]
+    if missing:
+        with c.su():
+            c.exec_star(
+                "DEBIAN_FRONTEND=noninteractive apt-get install -y "
+                + " ".join(c.escape(p) for p in missing))
+
+
+def update() -> None:
+    with c.su():
+        c.exec("apt-get", "update")
+
+
+class Debian(OS):
+    """debian.clj's os implementation: hostfile + core packages."""
+
+    def setup(self, test, node):
+        setup_hostfile()
+        install(["curl", "wget", "unzip", "iptables", "iputils-ping",
+                 "ntpdate", "faketime", "psmisc", "tar", "bzip2",
+                 "rsyslog", "logrotate"])
+
+    def teardown(self, test, node):
+        pass
+
+    def __repr__(self):
+        return "<os.debian>"
+
+
+def os() -> OS:
+    return Debian()

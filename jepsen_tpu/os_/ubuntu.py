@@ -1,0 +1,16 @@
+"""Ubuntu provisioning (jepsen.os.ubuntu, jepsen/src/jepsen/os/
+ubuntu.clj) — Debian with Ubuntu's service handling."""
+
+from __future__ import annotations
+
+from . import OS
+from .debian import Debian, install, installed, installed_version  # noqa: F401
+
+
+class Ubuntu(Debian):
+    def __repr__(self):
+        return "<os.ubuntu>"
+
+
+def os() -> OS:
+    return Ubuntu()

@@ -1,0 +1,47 @@
+/* bump_time: jump the system wall clock by a signed delta, given in
+ * milliseconds, then print the resulting time as unix seconds with
+ * microsecond precision. Compiled on each DB node by the clock nemesis
+ * (equivalent role to the reference's jepsen/resources/bump-time.c:1-54,
+ * reimplemented over clock_gettime/clock_settime).
+ *
+ * usage: bump_time <delta-ms>
+ */
+#include <stdio.h>
+#include <stdlib.h>
+#include <time.h>
+
+int main(int argc, char **argv) {
+    if (argc < 2) {
+        fprintf(stderr, "usage: %s <delta-ms>\n", argv[0]);
+        return 1;
+    }
+
+    double delta_ms = atof(argv[1]);
+    long long delta_ns = (long long)(delta_ms * 1e6);
+
+    struct timespec now;
+    if (clock_gettime(CLOCK_REALTIME, &now) != 0) {
+        perror("clock_gettime");
+        return 1;
+    }
+
+    long long ns = (long long)now.tv_sec * 1000000000LL + now.tv_nsec;
+    ns += delta_ns;
+    if (ns < 0) ns = 0;
+
+    struct timespec next;
+    next.tv_sec = ns / 1000000000LL;
+    next.tv_nsec = ns % 1000000000LL;
+
+    if (clock_settime(CLOCK_REALTIME, &next) != 0) {
+        perror("clock_settime");
+        return 2;
+    }
+
+    if (clock_gettime(CLOCK_REALTIME, &now) != 0) {
+        perror("clock_gettime");
+        return 1;
+    }
+    printf("%lld.%06ld\n", (long long)now.tv_sec, now.tv_nsec / 1000);
+    return 0;
+}

@@ -1,0 +1,65 @@
+/* strobe_time: oscillate the system wall clock by +/- delta milliseconds
+ * every period milliseconds, for duration seconds, using the MONOTONIC
+ * clock as the reference for pacing and for when to stop (so the strobing
+ * itself can't confuse the schedule). Equivalent role to the reference's
+ * jepsen/resources/strobe-time.c, reimplemented over
+ * clock_gettime/clock_settime/nanosleep.
+ *
+ * usage: strobe_time <delta-ms> <period-ms> <duration-s>
+ */
+#include <stdio.h>
+#include <stdlib.h>
+#include <time.h>
+
+static long long mono_ns(void) {
+    struct timespec t;
+    clock_gettime(CLOCK_MONOTONIC, &t);
+    return (long long)t.tv_sec * 1000000000LL + t.tv_nsec;
+}
+
+static int shift_wall(long long delta_ns) {
+    struct timespec now, next;
+    if (clock_gettime(CLOCK_REALTIME, &now) != 0) {
+        perror("clock_gettime");
+        return -1;
+    }
+    long long ns = (long long)now.tv_sec * 1000000000LL + now.tv_nsec;
+    ns += delta_ns;
+    if (ns < 0) ns = 0;
+    next.tv_sec = ns / 1000000000LL;
+    next.tv_nsec = ns % 1000000000LL;
+    if (clock_settime(CLOCK_REALTIME, &next) != 0) {
+        perror("clock_settime");
+        return -1;
+    }
+    return 0;
+}
+
+int main(int argc, char **argv) {
+    if (argc < 4) {
+        fprintf(stderr, "usage: %s <delta-ms> <period-ms> <duration-s>\n",
+                argv[0]);
+        return 1;
+    }
+    long long delta_ns = (long long)(atof(argv[1]) * 1e6);
+    long long period_ns = (long long)(atof(argv[2]) * 1e6);
+    long long duration_ns = (long long)(atof(argv[3]) * 1e9);
+    if (period_ns <= 0) period_ns = 1000000;
+
+    long long start = mono_ns();
+    long long sign = 1;
+    while (mono_ns() - start < duration_ns) {
+        if (shift_wall(sign * delta_ns) != 0)
+            return 2;
+        sign = -sign;
+        struct timespec nap;
+        nap.tv_sec = period_ns / 1000000000LL;
+        nap.tv_nsec = period_ns % 1000000000LL;
+        nanosleep(&nap, NULL);
+    }
+    /* Leave the clock where an even number of strobes would have: if we
+     * exit mid-cycle with an odd number of shifts applied, undo one. */
+    if (sign < 0 && shift_wall(-delta_ns) != 0)
+        return 2;
+    return 0;
+}

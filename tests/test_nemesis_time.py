@@ -1,0 +1,162 @@
+"""Clock nemesis + combined package tests: C tools compile for real
+(usage path only — never actually setting this machine's clock), the
+clock nemesis's node-side commands against the dummy remote
+(time.clj:98-139), node-spec resolution, and package composition
+(combined.clj:29-332)."""
+
+import subprocess
+
+import pytest
+
+from jepsen_tpu import control as c
+from jepsen_tpu import db as jdb
+from jepsen_tpu import generator as gen
+from jepsen_tpu import net as jnet
+from jepsen_tpu.generator import fixed_rand, sim
+from jepsen_tpu.nemesis import combined as nc
+from jepsen_tpu.nemesis import time as nt
+from jepsen_tpu.workloads import noop_test
+
+
+class TestCTools:
+    def test_c_sources_compile(self, tmp_path):
+        for src, name in ((nt.RESOURCES / "bump_time.c", "bump-time"),
+                          (nt.RESOURCES / "strobe_time.c", "strobe-time")):
+            out = tmp_path / name
+            subprocess.run(["cc", "-O2", "-o", str(out), str(src)],
+                           check=True)
+            # Usage path only; applying a delta would skew this machine.
+            p = subprocess.run([str(out)], capture_output=True)
+            assert p.returncode == 1
+            assert b"usage" in p.stderr
+
+
+def dummy_test(nodes=("n1", "n2", "n3")):
+    test = dict(noop_test())
+    test["nodes"] = list(nodes)
+    test["net"] = jnet.iptables()
+    log: list = []
+    remote = c.dummy(log, responses={
+        r"date \+%s\.%N": "1700000000.000000000\n",
+        r"bump-time": "1700000042.000000\n",
+    })
+    c.setup_sessions(test, remote)
+    return test, log
+
+
+class TestClockNemesis:
+    def test_setup_compiles_tools(self):
+        test, log = dummy_test()
+        nem = nt.clock_nemesis().setup(test)
+        cmds = [cmd for _n, cmd in log]
+        assert any("cc -O2 -o bump-time" in cmd for cmd in cmds)
+        assert any("cc -O2 -o strobe-time" in cmd for cmd in cmds)
+        assert any("ntpdate" in cmd for cmd in cmds)
+        uploads = [cmd for cmd in cmds if "upload" in cmd]
+        assert len(uploads) >= 6  # 2 sources x 3 nodes
+
+    def test_bump_and_check_offsets(self):
+        test, log = dummy_test()
+        nem = nt.clock_nemesis().setup(test)
+        res = nem.invoke(test, {"type": "info", "f": "bump",
+                                "value": {"n1": 4000, "n2": -8000}})
+        assert set(res["clock-offsets"]) == {"n1", "n2"}
+        cmds = [cmd for n, cmd in log if "bump-time" in cmd and "cc" not in cmd]
+        assert any("4000" in cmd for cmd in cmds)
+        assert any("-8000" in cmd for cmd in cmds)
+        res = nem.invoke(test, {"type": "info", "f": "check-offsets"})
+        assert set(res["clock-offsets"]) == {"n1", "n2", "n3"}
+
+    def test_generators(self):
+        test, _ = dummy_test()
+        with fixed_rand(4):
+            op = nt.bump_gen(test, None)
+            assert op["f"] == "bump"
+            for node, delta in op["value"].items():
+                assert node in test["nodes"]
+                assert 4 <= abs(delta) <= 2 ** 18
+            op = nt.strobe_gen(test, None)
+            for spec in op["value"].values():
+                assert 4 <= spec["delta"] <= 2 ** 18
+                assert 1 <= spec["period"] <= 1024
+                assert 0 <= spec["duration"] <= 32
+
+
+class KillPauseDB(jdb.DB, jdb.Process, jdb.Pause):
+    def __init__(self):
+        self.events = []
+
+    def start(self, test, node):
+        self.events.append(("start", node))
+        return "started"
+
+    def kill(self, test, node):
+        self.events.append(("kill", node))
+        return "killed"
+
+    def pause(self, test, node):
+        self.events.append(("pause", node))
+        return "paused"
+
+    def resume(self, test, node):
+        self.events.append(("resume", node))
+        return "resumed"
+
+
+class TestCombined:
+    def test_db_nodes_specs(self):
+        test = {"nodes": ["a", "b", "c", "d", "e"]}
+        with fixed_rand(1):
+            assert nc.db_nodes(test, None, "all") == test["nodes"]
+            assert len(nc.db_nodes(test, None, "one")) == 1
+            assert len(nc.db_nodes(test, None, "majority")) == 3
+            assert len(nc.db_nodes(test, None, "minority")) == 2
+            assert nc.db_nodes(test, None, ["a", "b"]) == ["a", "b"]
+            sub = nc.db_nodes(test, None, None)
+            assert sub and set(sub) <= set(test["nodes"])
+
+    def test_db_nemesis_kills(self):
+        test, _log = dummy_test()
+        db = KillPauseDB()
+        nem = nc.db_nemesis(db)
+        with fixed_rand(2):
+            res = nem.invoke(test, {"type": "info", "f": "kill",
+                                    "value": "all"})
+        assert set(res["value"]) == set(test["nodes"])
+        assert {e[0] for e in db.events} == {"kill"}
+
+    def test_nemesis_package_composition(self):
+        db = KillPauseDB()
+        pkg = nc.nemesis_package({
+            "db": db,
+            "faults": ["partition", "kill", "pause"],
+            "interval": 1,
+        })
+        assert pkg["nemesis"] is not None
+        assert pkg["final-generator"]
+        fs = set(pkg["nemesis"].fs())
+        assert {"start-partition", "stop-partition", "start", "kill",
+                "pause", "resume"} <= fs
+        # The mixed generator produces ops of several fault families.
+        # Nemesis invocations carry type "info", so use the full op
+        # stream (sim.quick filters to type "invoke").
+        test = {"nodes": ["a", "b", "c"], "db": db}
+        with fixed_rand(7):
+            ops = sim.quick_ops(
+                gen.nemesis(gen.limit(30, pkg["generator"])),
+                sim.n_plus_nemesis_context(2), test)
+        seen = {o["f"] for o in ops if o["process"] == "nemesis"}
+        assert seen & {"start-partition", "stop-partition"}
+        assert seen & {"kill", "start", "pause", "resume"}
+
+    def test_partition_nemesis_spec_routing(self):
+        test, log = dummy_test()
+        nem = nc.PartitionNemesis(None).setup(test)
+        with fixed_rand(3):
+            res = nem.invoke(test, {"type": "info", "f": "start-partition",
+                                    "value": "majority"})
+        assert res["f"] == "start-partition"
+        assert res["value"][0] == "isolated"
+        assert any("DROP" in cmd for _n, cmd in log)
+        res = nem.invoke(test, {"type": "info", "f": "stop-partition"})
+        assert res["value"] == "network-healed"
