@@ -156,6 +156,44 @@ def log_results(test: dict) -> dict:
     return test
 
 
+def snarf_logs(test: dict) -> None:
+    """Download every node's DB log files into
+    store/<name>/<time>/<node>/ (core.clj:102-136) — BEFORE DB teardown,
+    which may destroy them (e.g. the tcpdump capture dir)."""
+    db = test.get("db")
+    if not isinstance(db, jdb.LogFiles):
+        return
+    if not (test.get("name") and test.get("start-time")) or test.get(
+        "no-store?"
+    ):
+        return
+    sessions = test.get("sessions")
+    if not sessions:
+        return
+    from . import control
+
+    def snarf(t, node):
+        files = list(db.log_files(t, node) or [])
+        if not files:
+            return 0
+        dest = store.path_mk(t, str(node), "x").parent
+        dest.mkdir(parents=True, exist_ok=True)
+        got = 0
+        for f in files:
+            try:
+                control.download(f, dest / str(f).rsplit("/", 1)[-1])
+                got += 1
+            except Exception:
+                LOG.warning("could not snarf %s from %s", f, node,
+                            exc_info=True)
+        return got
+
+    try:
+        control.on_nodes(test, snarf)
+    except Exception:
+        LOG.warning("log snarfing failed", exc_info=True)
+
+
 def prepare_test(test: dict) -> dict:
     """Fill computed defaults (core.clj:309-324)."""
     test = dict(test)
@@ -193,6 +231,7 @@ def run(test: dict) -> dict:
                 test = analyze(test)
                 return log_results(test)
             finally:
+                snarf_logs(test)
                 if not test.get("leave-db-running?"):
                     try:
                         jdb.teardown_all(test)

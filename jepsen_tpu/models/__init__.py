@@ -168,6 +168,7 @@ from .mutex import (  # noqa: E402,F401
     ReentrantMutex,
     OwnerAwareMutex,
     FencedMutex,
+    ReentrantFencedMutex,
     Semaphore,
 )
 from .queue import FIFOQueue, UnorderedQueue  # noqa: E402,F401

@@ -201,6 +201,106 @@ class FencedMutex(Model):
 
 
 @register_model
+class ReentrantFencedMutex(Model):
+    """Reentrant fenced mutex: up to two holds by one owner, fences
+    monotone over the highest observed fence (hazelcast.clj:590-626,
+    ReentrantFencedMutex; lock-acquire limit 2). State lanes:
+    [owner+1, lock-count, current-fence, highest-observed-fence]; fences
+    are raw ints with UNKNOWN for acquires whose token wasn't observed,
+    and highest-observed starts at -1 so any real fence exceeds it."""
+
+    name = "reentrant-fenced-mutex"
+    state_width = 4
+    n_opcodes = 2
+    LOCK_LIMIT = 2
+
+    def init_state(self, table: ValueTable) -> tuple[int, ...]:
+        return (0, 0, UNKNOWN, -1)
+
+    def encode_op(self, iv, table: ValueTable) -> Optional[tuple[int, int, int]]:
+        p = table.intern(("process", iv.process))
+        if iv.f == "acquire":
+            fence = iv.value_out if iv.type == OK else None
+            if fence is None:
+                return (ACQUIRE, p, UNKNOWN)
+            if not isinstance(fence, int) or isinstance(fence, bool) or fence < 0:
+                raise EncodeError(
+                    f"fence token must be a non-negative int, got {fence!r}")
+            return (ACQUIRE, p, fence)
+        if iv.f == "release":
+            return (RELEASE, p, 0)
+        raise EncodeError(f"reentrant-fenced-mutex: unknown f {iv.f!r}")
+
+    def step_scalar(self, state, opcode, a1, a2):
+        owner, count, cur, hof = state
+        client = a1 + 1
+        f = a2
+        if opcode == ACQUIRE:
+            if owner == 0:
+                ok = f == UNKNOWN or f > hof
+                hof2 = hof if f == UNKNOWN else max(f, hof)
+                return (ok, (client, 1, f, hof2))
+            if owner != client or count >= self.LOCK_LIMIT:
+                return (False, state)
+            if cur == UNKNOWN:
+                ok = f == UNKNOWN or f > hof
+                hof2 = hof if f == UNKNOWN else max(f, hof)
+                return (ok, (client, count + 1, f, hof2))
+            if f == UNKNOWN or f == cur:
+                return (True, (client, count + 1, cur, hof))
+            return (False, state)
+        # release
+        if owner == 0 or owner != client:
+            return (False, state)
+        if count == 1:
+            return (True, (0, 0, UNKNOWN, hof))
+        return (True, (owner, count - 1, cur, hof))
+
+    def step_jax(self, states, opcodes, a1s, a2s):
+        import jax.numpy as jnp
+
+        owner = states[..., 0]
+        count = states[..., 1]
+        cur = states[..., 2]
+        hof = states[..., 3]
+        client = a1s + 1
+        f = a2s
+        is_acq = opcodes == ACQUIRE
+        f_known = f != UNKNOWN
+        fresh_ok = ~f_known | (f > hof)
+
+        # Case 1: unheld acquire.
+        c1 = is_acq & (owner == 0)
+        # Case 2: reacquire with unfenced current hold.
+        c2 = is_acq & (owner == client) & (count < self.LOCK_LIMIT) & (
+            cur == UNKNOWN)
+        # Case 3: reacquire with fenced hold: same-or-unknown fence.
+        c3 = is_acq & (owner == client) & (count < self.LOCK_LIMIT) & (
+            cur != UNKNOWN) & (~f_known | (f == cur))
+        rel_ok = ~is_acq & (owner == client) & (owner != 0)
+
+        ok = (c1 & fresh_ok) | (c2 & fresh_ok) | c3 | rel_ok
+
+        hof2 = jnp.where((c1 | c2) & f_known, jnp.maximum(f, hof), hof)
+        owner2 = jnp.where(is_acq, client,
+                           jnp.where(count == 1, 0, owner))
+        count2 = jnp.where(c1, 1,
+                           jnp.where(c2 | c3, count + 1,
+                                     jnp.maximum(count - 1, 0)))
+        cur2 = jnp.where(c1 | c2, f,
+                         jnp.where(c3, cur,
+                                   jnp.where(count == 1,
+                                             jnp.int32(UNKNOWN), cur)))
+        return ok, jnp.stack([owner2, count2, cur2, hof2], axis=-1)
+
+    def describe_op(self, opcode, a1, a2, table):
+        if opcode == ACQUIRE:
+            fence = "?" if a2 == UNKNOWN else a2
+            return f"acquire (fence {fence}) by {table.lookup(a1)!r}"
+        return f"release by {table.lookup(a1)!r}"
+
+
+@register_model
 class Semaphore(Model):
     """Counting semaphore with ``capacity`` permits (hazelcast
     AcquiredPermitsModel, hazelcast.clj:630-649). Op value = permit count."""

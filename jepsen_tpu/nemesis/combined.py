@@ -279,7 +279,8 @@ def nemesis_packages(opts: dict) -> list:
     """combined.clj:276-284."""
     opts = dict(opts)
     opts["faults"] = set(
-        opts.get("faults") or ["partition", "kill", "pause", "clock"])
+        opts["faults"] if "faults" in opts
+        else ["partition", "kill", "pause", "clock"])
     return [p for p in (partition_package(opts), clock_package(opts),
                         db_package(opts)) if p]
 

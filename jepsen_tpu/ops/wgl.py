@@ -748,6 +748,7 @@ def check_encoded_device(
             # escalation floor... which transient spikes may re-trigger —
             # that's fine, escalation is lossless).
             count = int(np.asarray(fr[4]).sum())
+            attempt.setdefault("counts", []).append(count)
             F2 = pick_capacity(count)
             if F2 < F:
                 fr = tuple(

@@ -25,7 +25,8 @@ def installed(pkgs: Iterable[str]) -> dict:
 def install(pkgs: Iterable[str]) -> None:
     """centos.clj's yum install-if-missing."""
     pkgs = list(pkgs)
-    missing = [p for p in pkgs if p not in installed(pkgs)]
+    have = installed(pkgs)
+    missing = [p for p in pkgs if p not in have]
     if missing:
         with c.su():
             c.exec_star("yum install -y " +

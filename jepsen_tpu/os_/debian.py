@@ -44,7 +44,8 @@ def installed_version(pkg: str) -> Optional[str]:
 def install(pkgs: Iterable[str]) -> None:
     """Install apt packages if missing (debian.clj:80-90)."""
     pkgs = list(pkgs)
-    missing = [p for p in pkgs if p not in installed(pkgs)]
+    have = installed(pkgs)
+    missing = [p for p in pkgs if p not in have]
     if missing:
         with c.su():
             c.exec_star(

@@ -1,0 +1,33 @@
+"""Redirect human-readable reports to a file (jepsen.report,
+jepsen/src/jepsen/report.clj:7-16)."""
+
+from __future__ import annotations
+
+import contextlib
+import io
+from typing import Any
+
+
+@contextlib.contextmanager
+def to(path: Any):
+    """Capture prints in the body to ``path`` as well as stdout."""
+    import sys
+
+    buf = io.StringIO()
+    orig = sys.stdout
+
+    class _Tee(io.TextIOBase):
+        def write(self, s):
+            buf.write(s)
+            return orig.write(s)
+
+        def flush(self):
+            orig.flush()
+
+    sys.stdout = _Tee()
+    try:
+        yield
+    finally:
+        sys.stdout = orig
+        with open(path, "w") as f:
+            f.write(buf.getvalue())

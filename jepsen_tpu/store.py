@@ -221,7 +221,9 @@ def tests(name: Optional[str] = None, root=None) -> dict:
     out: dict = {}
     if not b.exists():
         return out
-    names = [name] if name else [p.name for p in b.iterdir() if p.is_dir()]
+    names = [name] if name else [
+        p.name for p in b.iterdir() if p.is_dir() and not p.is_symlink()
+    ]
     for n in names:
         d = b / n
         if not d.is_dir():

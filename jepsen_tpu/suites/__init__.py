@@ -1,0 +1,24 @@
+"""Per-database test suites.
+
+The reference is a monorepo of ~27 per-DB suites (consul/, zookeeper/,
+etcd-like raftis/, cockroachdb/, …), each a thin module: a DB lifecycle
+implementation, a client speaking the database's wire protocol, workload
+wiring, and a ``-main`` calling ``cli/run!`` with a test-fn (e.g.
+zookeeper/src/jepsen/zookeeper.clj:106-137). The suites here follow the
+same shape on this framework's protocols:
+
+- :mod:`jepsen_tpu.suites.consul` — HTTP KV cas-register over the
+  ``?cas=index`` API (ref consul/).
+- :mod:`jepsen_tpu.suites.etcd`   — etcd v3 JSON gateway: range/put +
+  txn-based CAS, keyed register + append workloads (ref raftis/ and the
+  etcd-style suites).
+- :mod:`jepsen_tpu.suites.postgres` — psql-over-control-session
+  list-append txn workload (ref stolon/).
+- :mod:`jepsen_tpu.suites.zookeeper` — zkCli-over-control-session CAS
+  register (ref zookeeper/).
+
+Each exposes ``test_fn(opts)`` and a ``main()`` wired through
+jepsen_tpu.cli; HTTP clients are exercised end-to-end in tests against
+in-process protocol stubs (no real cluster needed — the reference's
+suites have no unit tests at all, SURVEY §4).
+"""

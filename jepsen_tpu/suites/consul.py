@@ -1,0 +1,210 @@
+"""Consul KV cas-register suite.
+
+Mirrors the reference consul suite (consul/src/jepsen/consul.clj:23-84 +
+consul/register.clj:16-80): an HTTP client over ``/v1/kv/<k>`` with
+check-and-set via ``?cas=<ModifyIndex>``, a keyed register workload
+(independent concurrent generator, 200 ops/key, 10 threads/key), and the
+standard partition nemesis. Reads that fail are :fail (safe — reads
+don't change state); indeterminate writes are :info
+(register.clj:24-25 via with-errors).
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import urllib.error
+import urllib.request
+from typing import Any, Optional
+
+from .. import checker as jchecker
+from .. import cli, client as jclient, db as jdb, generator as gen
+from .. import independent, nemesis as jnemesis, net as jnet
+from ..checker.timeline import html as timeline_html
+from ..control import util as cu
+from ..models import CasRegister
+from .. import control as c
+
+PORT = 8500
+
+
+class ConsulClient(jclient.Client, jclient.Reusable):
+    """register.clj:16-57. Values are JSON ints stored under the key."""
+
+    def __init__(self, base: Optional[str] = None, timeout: float = 5.0):
+        self.base = base
+        self.timeout = timeout
+
+    def open(self, test, node):
+        return ConsulClient(f"http://{node}:{PORT}/v1/kv/", self.timeout)
+
+    # -- HTTP primitives ---------------------------------------------------
+    def _get(self, k):
+        req = urllib.request.Request(self.base + str(k))
+        with urllib.request.urlopen(req, timeout=self.timeout) as r:
+            body = json.loads(r.read().decode())
+        entry = body[0]
+        raw = entry.get("Value")
+        value = None if raw is None else json.loads(
+            base64.b64decode(raw).decode())
+        return value, entry.get("ModifyIndex", 0)
+
+    def _put(self, k, value, cas: Optional[int] = None) -> bool:
+        url = self.base + str(k)
+        if cas is not None:
+            url += f"?cas={cas}"
+        req = urllib.request.Request(
+            url, data=json.dumps(value).encode(), method="PUT")
+        with urllib.request.urlopen(req, timeout=self.timeout) as r:
+            return r.read().decode().strip() == "true"
+
+    def invoke(self, test, op):
+        kv = op["value"]
+        k, value = (kv.key, kv.value) if independent.is_tuple(kv) else (
+            "r", kv)
+        f = op["f"]
+        try:
+            if f == "read":
+                try:
+                    v, _idx = self._get(k)
+                except urllib.error.HTTPError as e:
+                    if e.code == 404:
+                        v = None
+                    else:
+                        raise
+                return {**op, "type": "ok",
+                        "value": independent.KV(k, v)}
+            if f == "write":
+                self._put(k, value)
+                return {**op, "type": "ok"}
+            if f == "cas":
+                old, new = value
+                try:
+                    cur, idx = self._get(k)
+                except urllib.error.HTTPError as e:
+                    if e.code == 404:
+                        return {**op, "type": "fail"}
+                    raise
+                if cur != old:
+                    return {**op, "type": "fail"}
+                ok = self._put(k, new, cas=idx)
+                return {**op, "type": "ok" if ok else "fail"}
+            raise ValueError(f"unknown f {f!r}")
+        except Exception:
+            # Reads can safely fail; writes may have taken effect.
+            if f == "read":
+                return {**op, "type": "fail", "error": "http"}
+            raise  # interpreter records :info (indeterminate)
+
+
+class ConsulDB(jdb.DB, jdb.Process, jdb.LogFiles):
+    """consul/db.clj: install the binary, run an agent cluster."""
+
+    DIR = "/opt/consul"
+    LOG = "/var/log/consul.log"
+    PID = "/var/run/consul.pid"
+
+    def __init__(self, version: str = "1.15.2"):
+        self.version = version
+
+    def setup(self, test, node):
+        url = (f"https://releases.hashicorp.com/consul/{self.version}/"
+               f"consul_{self.version}_linux_amd64.zip")
+        cu.install_archive(url, self.DIR)
+        self.start(test, node)
+
+    def start(self, test, node):
+        nodes = test["nodes"]
+        join = " ".join(f"-retry-join {n}" for n in nodes if n != node)
+        with c.su():
+            cu.start_daemon(
+                {"logfile": self.LOG, "pidfile": self.PID, "chdir": self.DIR},
+                f"{self.DIR}/consul",
+                "agent", "-server",
+                "-bootstrap-expect", len(nodes),
+                "-data-dir", "/var/lib/consul",
+                "-bind", node, "-client", "0.0.0.0",
+                *([cu.Lit(join)] if join else []),
+            )
+
+    def kill(self, test, node):
+        cu.grepkill("consul")
+
+    def teardown(self, test, node):
+        cu.grepkill("consul")
+        with c.su():
+            c.exec("rm", "-rf", "/var/lib/consul", self.PID)
+
+    def log_files(self, test, node):
+        return [self.LOG]
+
+
+def register_workload(opts: dict) -> dict:
+    """Keyed CAS register: 10 threads/key, ~200 ops/key
+    (consul.clj:77-84, register.clj:64-80)."""
+    import itertools
+
+    n_threads = int(opts.get("threads_per_key")
+                    or opts.get("threads-per-key") or 10)
+    per_key = int(opts.get("ops_per_key")
+                  or opts.get("ops-per-key") or 200)
+
+    def r(test=None, ctx=None):
+        return {"type": "invoke", "f": "read", "value": None}
+
+    def w(test=None, ctx=None):
+        return {"type": "invoke", "f": "write", "value": gen.rand_int(5)}
+
+    def cas(test=None, ctx=None):
+        return {"type": "invoke", "f": "cas",
+                "value": [gen.rand_int(5), gen.rand_int(5)]}
+
+    def fgen(k):
+        return gen.limit(per_key, gen.mix([r, w, cas]))
+
+    return {
+        "client": ConsulClient(),
+        "generator": independent.concurrent_generator(
+            n_threads, itertools.count(), fgen),
+        "checker": independent.checker(jchecker.compose({
+            "linear": jchecker.linearizable(model=CasRegister(init=None)),
+            "timeline": timeline_html(),
+        })),
+    }
+
+
+def test_fn(opts: dict) -> dict:
+    wl = register_workload(opts)
+    test = {
+        "name": "consul",
+        "os": None,
+        "db": ConsulDB(str(opts.get("version") or "1.15.2")),
+        "net": jnet.iptables(),
+        "nemesis": jnemesis.partition_random_halves(),
+        **wl,
+    }
+    # Partition cycle with a final heal + read phase (consul.clj:48-60).
+    test["generator"] = gen.phases(
+        gen.nemesis(
+            gen.repeat_([gen.sleep(5),
+                         {"type": "info", "f": "start"},
+                         gen.sleep(5),
+                         {"type": "info", "f": "stop"}]),
+            gen.time_limit(opts.get("time_limit", 60), wl["generator"]),
+        ),
+    )
+    return test
+
+
+def _add_opts(p):
+    p.add_argument("--version", default="1.15.2")
+    p.add_argument("--ops-per-key", default="200")
+    p.add_argument("--threads-per-key", default="10")
+
+
+def main(argv=None):
+    cli.main_exit(cli.single_test_cmd(test_fn, add_opts=_add_opts), argv)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,270 @@
+"""etcd suite: KV register + list-append over the v3 JSON gateway.
+
+The reference's etcd-shaped suites (raftis/, and etcd workloads embedded
+in other suites) drive a consensus KV store through CAS primitives. This
+suite speaks etcd's ``/v3/kv/{range,put,txn}`` JSON gateway (base64-coded
+keys/values): registers use txn compare-on-value CAS; list-append txns do
+read-modify-write guarded by ``mod_revision`` compares, giving a real
+elle list-append workload over an off-the-shelf store.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import urllib.request
+from typing import Any, Optional
+
+from .. import checker as jchecker
+from .. import cli, client as jclient, db as jdb, generator as gen
+from .. import independent, nemesis as jnemesis, net as jnet
+from .. import txn as jtxn
+from ..control import util as cu
+from ..models import CasRegister
+from ..workloads import append as wa
+from .. import control as c
+
+PORT = 2379
+
+
+def _b64(s: str) -> str:
+    return base64.b64encode(s.encode()).decode()
+
+
+def _unb64(s: str) -> str:
+    return base64.b64decode(s).decode()
+
+
+class EtcdKV:
+    """Minimal etcd v3 JSON gateway client."""
+
+    def __init__(self, base: str, timeout: float = 5.0):
+        self.base = base
+        self.timeout = timeout
+
+    def _post(self, path: str, body: dict) -> dict:
+        req = urllib.request.Request(
+            self.base + path, data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        with urllib.request.urlopen(req, timeout=self.timeout) as r:
+            return json.loads(r.read().decode())
+
+    def get(self, k: str):
+        """-> (value | None, mod_revision)."""
+        res = self._post("/v3/kv/range", {"key": _b64(k)})
+        kvs = res.get("kvs") or []
+        if not kvs:
+            return None, 0
+        return _unb64(kvs[0]["value"]), int(kvs[0].get("mod_revision", 0))
+
+    def put(self, k: str, v: str) -> None:
+        self._post("/v3/kv/put", {"key": _b64(k), "value": _b64(v)})
+
+    def cas_value(self, k: str, old: str, new: str) -> bool:
+        """Txn: compare VALUE equals old -> put new."""
+        res = self._post("/v3/kv/txn", {
+            "compare": [{"key": _b64(k), "target": "VALUE",
+                         "value": _b64(old), "result": "EQUAL"}],
+            "success": [{"requestPut": {"key": _b64(k), "value": _b64(new)}}],
+        })
+        return bool(res.get("succeeded"))
+
+    def cas_revision(self, k: str, mod_revision: int, new: str) -> bool:
+        """Txn: compare MOD revision -> put (0 = key must not exist)."""
+        return self.multi_txn({k: mod_revision}, {k: new})
+
+    def multi_txn(self, guards: dict, puts: dict) -> bool:
+        """One atomic txn: compare every key's mod_revision, then apply
+        every put (0 = key must not exist)."""
+        res = self._post("/v3/kv/txn", {
+            "compare": [
+                {"key": _b64(k), "target": "MOD",
+                 "mod_revision": str(rev), "result": "EQUAL"}
+                for k, rev in guards.items()
+            ],
+            "success": [
+                {"requestPut": {"key": _b64(k), "value": _b64(v)}}
+                for k, v in puts.items()
+            ],
+        })
+        return bool(res.get("succeeded"))
+
+
+class RegisterClient(jclient.Client, jclient.Reusable):
+    """Keyed CAS register via value-compare txns."""
+
+    def __init__(self, kv: Optional[EtcdKV] = None):
+        self.kv = kv
+
+    def open(self, test, node):
+        return RegisterClient(EtcdKV(f"http://{node}:{PORT}"))
+
+    def invoke(self, test, op):
+        kv = op["value"]
+        k, value = (kv.key, kv.value) if independent.is_tuple(kv) else (
+            "r", kv)
+        key = f"jepsen/{k}"
+        f = op["f"]
+        try:
+            if f == "read":
+                raw, _rev = self.kv.get(key)
+                v = None if raw is None else json.loads(raw)
+                return {**op, "type": "ok", "value": independent.KV(k, v)}
+            if f == "write":
+                self.kv.put(key, json.dumps(value))
+                return {**op, "type": "ok"}
+            if f == "cas":
+                old, new = value
+                ok = self.kv.cas_value(key, json.dumps(old), json.dumps(new))
+                return {**op, "type": "ok" if ok else "fail"}
+            raise ValueError(f"unknown f {f!r}")
+        except Exception:
+            if f == "read":
+                return {**op, "type": "fail", "error": "http"}
+            raise
+
+
+class AppendClient(jclient.Client, jclient.Reusable):
+    """List-append txns as optimistic STM over etcd: snapshot every
+    touched key (value + mod_revision), evaluate the whole txn locally,
+    then commit one atomic etcd txn guarding ALL touched keys' revisions
+    and writing every appended key. A failed guard retries from a fresh
+    snapshot; exhausted retries are a clean :fail (nothing committed)."""
+
+    RETRIES = 16
+
+    def __init__(self, kv: Optional[EtcdKV] = None):
+        self.kv = kv
+
+    def open(self, test, node):
+        return AppendClient(EtcdKV(f"http://{node}:{PORT}"))
+
+    def invoke(self, test, op):
+        keys = {f"jepsen/append/{k}" for _f, k, _v in op["value"]}
+        for _ in range(self.RETRIES):
+            snap = {}
+            for key in sorted(keys):
+                raw, rev = self.kv.get(key)
+                snap[key] = ([] if raw is None else json.loads(raw), rev)
+            local = {k: list(v) for k, (v, _r) in snap.items()}
+            done = []
+            dirty = set()
+            for f, k, v in op["value"]:
+                key = f"jepsen/append/{k}"
+                if f == "r":
+                    done.append([f, k, list(local[key])])
+                else:
+                    local[key].append(v)
+                    dirty.add(key)
+                    done.append([f, k, v])
+            guards = {k: rev for k, (_v, rev) in snap.items()}
+            puts = {k: json.dumps(local[k]) for k in dirty}
+            # Read-only txns still run the compare-only txn: the
+            # per-key range snapshots aren't atomic on their own.
+            if self.kv.multi_txn(guards, puts):
+                return {**op, "type": "ok", "value": done}
+        return {**op, "type": "fail", "error": "txn-contention"}
+
+
+class EtcdDB(jdb.DB, jdb.Process, jdb.LogFiles):
+    DIR = "/opt/etcd"
+    LOG = "/var/log/etcd.log"
+    PID = "/var/run/etcd.pid"
+
+    def __init__(self, version: str = "3.5.9"):
+        self.version = version
+
+    def setup(self, test, node):
+        url = (f"https://github.com/etcd-io/etcd/releases/download/"
+               f"v{self.version}/etcd-v{self.version}-linux-amd64.tar.gz")
+        cu.install_archive(url, self.DIR)
+        self.start(test, node)
+
+    def start(self, test, node):
+        nodes = test["nodes"]
+        cluster = ",".join(f"{n}=http://{n}:2380" for n in nodes)
+        with c.su():
+            cu.start_daemon(
+                {"logfile": self.LOG, "pidfile": self.PID, "chdir": self.DIR},
+                f"{self.DIR}/etcd",
+                "--name", node,
+                "--listen-client-urls", f"http://0.0.0.0:{PORT}",
+                "--advertise-client-urls", f"http://{node}:{PORT}",
+                "--listen-peer-urls", "http://0.0.0.0:2380",
+                "--initial-advertise-peer-urls", f"http://{node}:2380",
+                "--initial-cluster", cluster,
+                "--data-dir", "/var/lib/etcd",
+            )
+
+    def kill(self, test, node):
+        cu.grepkill("etcd")
+
+    def teardown(self, test, node):
+        cu.grepkill("etcd")
+        with c.su():
+            c.exec("rm", "-rf", "/var/lib/etcd", self.PID)
+
+    def log_files(self, test, node):
+        return [self.LOG]
+
+
+def register_workload(opts: dict) -> dict:
+    import itertools
+
+    def r(test=None, ctx=None):
+        return {"type": "invoke", "f": "read", "value": None}
+
+    def w(test=None, ctx=None):
+        return {"type": "invoke", "f": "write", "value": gen.rand_int(5)}
+
+    def cas(test=None, ctx=None):
+        return {"type": "invoke", "f": "cas",
+                "value": [gen.rand_int(5), gen.rand_int(5)]}
+
+    return {
+        "client": RegisterClient(),
+        "generator": independent.concurrent_generator(
+            2, itertools.count(),
+            lambda k: gen.limit(20, gen.mix([r, w, cas]))),
+        "checker": independent.checker(
+            jchecker.linearizable(model=CasRegister(init=None))),
+    }
+
+
+def append_workload(opts: dict) -> dict:
+    wl = wa.test({"key_count": 4})
+    return {"client": AppendClient(), "generator": wl["generator"],
+            "checker": wl["checker"]}
+
+
+WORKLOADS = {"register": register_workload, "append": append_workload}
+
+
+def test_fn(opts: dict) -> dict:
+    wl = WORKLOADS[opts.get("workload") or "register"](opts)
+    return {
+        "name": f"etcd-{opts.get('workload') or 'register'}",
+        "db": EtcdDB(str(opts.get("version") or "3.5.9")),
+        "net": jnet.iptables(),
+        "nemesis": jnemesis.partition_random_halves(),
+        **wl,
+        "generator": gen.nemesis(
+            gen.repeat_([gen.sleep(5), {"type": "info", "f": "start"},
+                         gen.sleep(5), {"type": "info", "f": "stop"}]),
+            gen.time_limit(opts.get("time_limit", 60), wl["generator"]),
+        ),
+    }
+
+
+def _add_opts(p):
+    p.add_argument("--workload", choices=sorted(WORKLOADS),
+                   default="register")
+    p.add_argument("--version", default="3.5.9")
+
+
+def main(argv=None):
+    cli.main_exit(cli.single_test_cmd(test_fn, add_opts=_add_opts), argv)
+
+
+if __name__ == "__main__":
+    main()

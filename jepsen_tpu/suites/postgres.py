@@ -1,0 +1,139 @@
+"""PostgreSQL (stolon-style) list-append suite.
+
+Mirrors the reference stolon suite's elle append test (stolon/src/...,
+SURVEY §2.6): transactions over rows of a table, driven through ``psql``
+on the node via the control session — no client driver dependency, the
+same trick the reference uses for CLI-driven databases. Each txn runs as
+one serializable SQL transaction; serialization failures map to :fail
+(definite) and connection errors to indeterminate.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Optional
+
+from .. import cli, client as jclient, db as jdb, generator as gen
+from .. import nemesis as jnemesis, net as jnet
+from ..control import util as cu
+from ..workloads import append as wa
+from .. import control as c
+
+TABLE = "jepsen_append"
+
+
+class PsqlClient(jclient.Client):
+    """Runs each txn as a single psql serializable transaction on the
+    node. Requires the test's sessions (control plane) — the client rides
+    the same transport as DB setup."""
+
+    def __init__(self, node: Any = None, user: str = "postgres"):
+        self.node = node
+        self.user = user
+
+    def open(self, test, node):
+        return PsqlClient(node, self.user)
+
+    def setup(self, test):
+        self._psql(test,
+                   f"CREATE TABLE IF NOT EXISTS {TABLE} "
+                   "(k text PRIMARY KEY, v jsonb NOT NULL)")
+
+    def _psql(self, test, sql: str) -> str:
+        # psql -c prints only the LAST command's result; feeding the
+        # script on stdin prints every statement's output.
+        def run(t, node):
+            return c.exec_star(
+                f"psql -U {c.escape(self.user)} -At <<'JEPSEN_SQL'\n"
+                f"{sql}\nJEPSEN_SQL")
+
+        return c.on_nodes(test, run, [self.node])[self.node]
+
+    def invoke(self, test, op):
+        stmts = ["BEGIN ISOLATION LEVEL SERIALIZABLE"]
+        reads = []
+        for i, (f, k, v) in enumerate(op["value"]):
+            if f == "r":
+                reads.append(i)
+                stmts.append(
+                    f"SELECT COALESCE((SELECT v FROM {TABLE} "
+                    f"WHERE k = '{k}'), '[]'::jsonb)")
+            else:
+                stmts.append(
+                    f"INSERT INTO {TABLE} (k, v) VALUES ('{k}', "
+                    f"'[{v}]'::jsonb) ON CONFLICT (k) DO UPDATE SET "
+                    f"v = {TABLE}.v || '{v}'::jsonb")
+        stmts.append("COMMIT")
+        sql = ";\n".join(stmts) + ";"
+        try:
+            out = self._psql(test, sql)
+        except c.RemoteError as e:
+            if "could not serialize" in str(e) or "deadlock" in str(e):
+                return {**op, "type": "fail", "error": "serialization"}
+            raise  # indeterminate
+        lines = [l for l in out.split("\n") if l.strip()]
+        done = []
+        ri = 0
+        for f, k, v in op["value"]:
+            if f == "r":
+                done.append([f, k, json.loads(lines[ri])])
+                ri += 1
+            else:
+                done.append([f, k, v])
+        return {**op, "type": "ok", "value": done}
+
+
+class PostgresDB(jdb.DB, jdb.Process, jdb.LogFiles):
+    LOG = "/var/log/postgresql-jepsen.log"
+
+    def setup(self, test, node):
+        from ..os_ import debian
+
+        debian.install(["postgresql"])
+        self.start(test, node)
+        with c.su():
+            c.exec_star(
+                "su postgres -c \"psql -c \\\"ALTER SYSTEM SET "
+                "listen_addresses = '*'\\\"\" || true")
+
+    def start(self, test, node):
+        with c.su():
+            c.exec_star("service postgresql start || pg_ctlcluster "
+                        "$(ls /var/lib/postgresql | head -1) main start")
+
+    def kill(self, test, node):
+        cu.grepkill("postgres")
+
+    def teardown(self, test, node):
+        with c.su():
+            c.exec_star(
+                f"su postgres -c \"psql -c 'DROP TABLE IF EXISTS {TABLE}'\""
+                " || true")
+
+    def log_files(self, test, node):
+        return [self.LOG]
+
+
+def test_fn(opts: dict) -> dict:
+    wl = wa.test({"key_count": 4})
+    return {
+        "name": "postgres-append",
+        "db": PostgresDB(),
+        "net": jnet.iptables(),
+        "nemesis": jnemesis.partition_random_halves(),
+        "client": PsqlClient(),
+        "checker": wl["checker"],
+        "generator": gen.nemesis(
+            gen.repeat_([gen.sleep(10), {"type": "info", "f": "start"},
+                         gen.sleep(10), {"type": "info", "f": "stop"}]),
+            gen.time_limit(opts.get("time_limit", 60), wl["generator"]),
+        ),
+    }
+
+
+def main(argv=None):
+    cli.main_exit(cli.single_test_cmd(test_fn), argv)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,169 @@
+"""ZooKeeper CAS-register suite.
+
+Mirrors the reference zookeeper suite (zookeeper/src/jepsen/
+zookeeper.clj:106-137): a single CAS register (the reference uses an
+avout zk-atom; here the znode's version-guarded setData gives CAS), with
+Debian install + myid/zoo.cfg provisioning. The client drives
+``zkCli.sh`` on the node through the control session.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Optional
+
+from .. import checker as jchecker
+from .. import cli, client as jclient, db as jdb, generator as gen
+from .. import nemesis as jnemesis, net as jnet
+from ..control import util as cu
+from ..models import CasRegister
+from .. import control as c
+
+ZNODE = "/jepsen"
+
+
+class ZkClient(jclient.Client):
+    """CAS via version-guarded set: get returns (value, version); set -v
+    guards on it."""
+
+    def __init__(self, node: Any = None):
+        self.node = node
+
+    def open(self, test, node):
+        return ZkClient(node)
+
+    def setup(self, test):
+        self._zk(test, f"create {ZNODE} 0", ignore_errors=True)
+
+    def _zk(self, test, cmd: str, ignore_errors: bool = False) -> str:
+        def run(t, node):
+            try:
+                return c.exec_star(
+                    f"/usr/share/zookeeper/bin/zkCli.sh -server "
+                    f"127.0.0.1:2181 {c.escape(cmd)} 2>&1")
+            except c.RemoteError:
+                if ignore_errors:
+                    return ""
+                raise
+
+        return c.on_nodes(test, run, [self.node])[self.node]
+
+    def _get(self, test):
+        out = self._zk(test, f"get -s {ZNODE}")
+        lines = [l for l in out.split("\n") if l.strip()]
+        # zkCli `get -s` prints the data first, then the stat block
+        # starting at cZxid; the register value is the data line.
+        version = None
+        data_end = None
+        for i, l in enumerate(lines):
+            if data_end is None and l.startswith("cZxid"):
+                data_end = i
+            m = re.match(r"dataVersion = (\d+)", l)
+            if m:
+                version = int(m.group(1))
+        if version is None or data_end is None:
+            raise RuntimeError(f"unparseable zk get: {out!r}")
+        data = [l for l in lines[:data_end] if re.fullmatch(r"-?\d+", l)]
+        value = int(data[-1]) if data else None
+        return value, version
+
+    def invoke(self, test, op):
+        f = op["f"]
+        try:
+            if f == "read":
+                v, _ver = self._get(test)
+                return {**op, "type": "ok", "value": v}
+            if f == "write":
+                self._zk(test, f"set {ZNODE} {op['value']}")
+                return {**op, "type": "ok"}
+            if f == "cas":
+                old, new = op["value"]
+                v, ver = self._get(test)
+                if v != old:
+                    return {**op, "type": "fail"}
+                try:
+                    self._zk(test, f"set -v {ver} {ZNODE} {new}")
+                    return {**op, "type": "ok"}
+                except c.RemoteError:
+                    return {**op, "type": "fail"}
+            raise ValueError(f"unknown f {f!r}")
+        except Exception:
+            if f == "read":
+                return {**op, "type": "fail", "error": "zk"}
+            raise
+
+
+class ZookeeperDB(jdb.DB, jdb.Process, jdb.LogFiles):
+    """zookeeper/src/jepsen/zookeeper.clj:30-70: apt install, myid,
+    zoo.cfg with one server line per node, restart."""
+
+    def setup(self, test, node):
+        from ..os_ import debian
+
+        debian.install(["zookeeper", "zookeeperd"])
+        myid = test["nodes"].index(node) + 1
+        with c.su():
+            c.exec_star(f"echo {myid} > /etc/zookeeper/conf/myid")
+            servers = "\n".join(
+                f"server.{i + 1}={n}:2888:3888"
+                for i, n in enumerate(test["nodes"]))
+            c.exec_star(
+                "cat > /etc/zookeeper/conf/zoo.cfg <<'JEPSEN_EOF'\n"
+                "tickTime=2000\ninitLimit=10\nsyncLimit=5\n"
+                "dataDir=/var/lib/zookeeper\nclientPort=2181\n"
+                f"{servers}\nJEPSEN_EOF")
+        self.start(test, node)
+
+    def start(self, test, node):
+        with c.su():
+            c.exec("service", "zookeeper", "restart")
+
+    def kill(self, test, node):
+        cu.grepkill("zookeeper")
+
+    def teardown(self, test, node):
+        with c.su():
+            c.exec_star("service zookeeper stop || true")
+            c.exec("rm", "-rf", "/var/lib/zookeeper/version-2")
+
+    def log_files(self, test, node):
+        return ["/var/log/zookeeper/zookeeper.log"]
+
+
+def test_fn(opts: dict) -> dict:
+    def w(test=None, ctx=None):
+        return {"type": "invoke", "f": "write", "value": gen.rand_int(5)}
+
+    def r(test=None, ctx=None):
+        return {"type": "invoke", "f": "read", "value": None}
+
+    def cas(test=None, ctx=None):
+        return {"type": "invoke", "f": "cas",
+                "value": [gen.rand_int(5), gen.rand_int(5)]}
+
+    return {
+        "name": "zookeeper",
+        "db": ZookeeperDB(),
+        "net": jnet.iptables(),
+        "nemesis": jnemesis.partition_random_halves(),
+        "client": ZkClient(),
+        "checker": jchecker.compose({
+            "linear": jchecker.linearizable(model=CasRegister(init=0)),
+            "stats": jchecker.stats(),
+        }),
+        "generator": gen.nemesis(
+            gen.repeat_([gen.sleep(5), {"type": "info", "f": "start"},
+                         gen.sleep(5), {"type": "info", "f": "stop"}]),
+            gen.time_limit(
+                opts.get("time_limit", 60),
+                gen.stagger(0.1, gen.mix([r, w, cas]))),
+        ),
+    }
+
+
+def main(argv=None):
+    cli.main_exit(cli.single_test_cmd(test_fn), argv)
+
+
+if __name__ == "__main__":
+    main()

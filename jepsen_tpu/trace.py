@@ -1,0 +1,140 @@
+"""Distributed tracing for client operations.
+
+The reference's dgraph suite exports OpenCensus spans to Jaeger and wraps
+client calls in ``with-trace`` (dgraph/src/jepsen/dgraph/trace.clj:9-74).
+This module provides the same capability framework-wide without external
+collectors: nested spans with wall-clock bounds recorded per thread, an
+in-memory collector, JSON-lines export into the store directory, and a
+client wrapper that spans every invoke.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from contextlib import contextmanager
+from typing import Any, Optional
+
+from . import client as jclient
+
+
+class Collector:
+    """Thread-safe span sink."""
+
+    def __init__(self):
+        self.spans: list[dict] = []
+        self._lock = threading.Lock()
+        self._local = threading.local()
+
+    def _stack(self) -> list:
+        st = getattr(self._local, "stack", None)
+        if st is None:
+            st = self._local.stack = []
+        return st
+
+    @contextmanager
+    def span(self, name: str, **attrs: Any):
+        """Record a span around the body (trace.clj:9-30's with-trace)."""
+        stack = self._stack()
+        sid = f"{threading.get_ident():x}-{len(self.spans)}-{len(stack)}"
+        parent = stack[-1] if stack else None
+        rec = {
+            "name": name,
+            "span_id": sid,
+            "parent_id": parent,
+            "thread": threading.current_thread().name,
+            "start_ns": time.monotonic_ns(),
+            **({"attrs": attrs} if attrs else {}),
+        }
+        stack.append(sid)
+        try:
+            yield rec
+        except Exception as e:
+            rec["error"] = f"{type(e).__name__}: {e}"
+            raise
+        finally:
+            stack.pop()
+            rec["end_ns"] = time.monotonic_ns()
+            rec["duration_us"] = (rec["end_ns"] - rec["start_ns"]) // 1000
+            with self._lock:
+                self.spans.append(rec)
+
+    def export_jsonl(self, path) -> int:
+        with self._lock:
+            spans = list(self.spans)
+        with open(path, "w") as f:
+            for s in spans:
+                f.write(json.dumps(s) + "\n")
+        return len(spans)
+
+
+_default = Collector()
+
+
+def default_collector() -> Collector:
+    return _default
+
+
+def span(name: str, **attrs):
+    return _default.span(name, **attrs)
+
+
+class TracingClient(jclient.Client):
+    """Wraps a client so every lifecycle call records a span (the dgraph
+    suite's with-trace around client bodies, trace.clj:32-74)."""
+
+    def __init__(self, client: jclient.Client,
+                 collector: Optional[Collector] = None):
+        self.client = client
+        self.collector = collector or _default
+
+    def open(self, test, node):
+        with self.collector.span("client.open", node=str(node)):
+            return TracingClient(self.client.open(test, node),
+                                 self.collector)
+
+    def setup(self, test):
+        with self.collector.span("client.setup"):
+            self.client.setup(test)
+
+    def invoke(self, test, op):
+        with self.collector.span(
+            "client.invoke", f=str(op.get("f")),
+            process=str(op.get("process")),
+        ) as rec:
+            res = self.client.invoke(test, op)
+            rec["type"] = res.get("type")
+            return res
+
+    def teardown(self, test):
+        with self.collector.span("client.teardown"):
+            self.client.teardown(test)
+
+    def close(self, test):
+        with self.collector.span("client.close"):
+            self.client.close(test)
+
+
+def tracing(client: jclient.Client,
+            collector: Optional[Collector] = None) -> jclient.Client:
+    out = TracingClient(client, collector)
+    if isinstance(client, jclient.Reusable):
+        class _R(TracingClient, jclient.Reusable):
+            pass
+
+        return _R(client, collector or _default)
+    return out
+
+
+def store_spans(test: dict, collector: Optional[Collector] = None) -> Optional[str]:
+    """Write spans.jsonl into the test's store directory."""
+    if not (test.get("name") and test.get("start-time")) or test.get(
+        "no-store?"
+    ):
+        return None
+    from . import store
+
+    path = store.path_mk(test, "spans.jsonl")
+    (collector or _default).export_jsonl(path)
+    return str(path)

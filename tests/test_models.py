@@ -149,3 +149,104 @@ def test_queue_models_host_only():
     assert ok and st == ()
     ok, _ = q.step_scalar((), 1, 4, 0)  # dequeue empty
     assert not ok
+
+
+class TestReentrantFencedMutex:
+    """hazelcast.clj:590-626 semantics: double holds by one owner, fence
+    monotone over the highest observed fence, reacquire with the same
+    fence."""
+
+    def mk(self):
+        from jepsen_tpu.models import ReentrantFencedMutex
+
+        return ReentrantFencedMutex()
+
+    def step(self, m, state, f, proc, fence=None):
+        from jepsen_tpu.models import UNKNOWN, ValueTable
+        from jepsen_tpu.history import Interval, Op
+
+        # build encode args directly via step_scalar: opcode 0=acquire
+        opcode = 0 if f == "acquire" else 1
+        a2 = UNKNOWN if fence is None else fence
+        return m.step_scalar(state, opcode, proc, a2)
+
+    def test_basic_reentrancy_and_fences(self):
+        m = self.mk()
+        st = m.init_state(__import__("jepsen_tpu.models", fromlist=["ValueTable"]).ValueTable())
+        ok, st = self.step(m, st, "acquire", 0, 5)
+        assert ok
+        ok, st = self.step(m, st, "acquire", 0, 5)  # reacquire same fence
+        assert ok
+        ok, _ = self.step(m, st, "acquire", 0, 5)  # third hold: limit 2
+        assert not ok
+        ok, st = self.step(m, st, "release", 0)
+        assert ok
+        ok, st = self.step(m, st, "release", 0)
+        assert ok
+        # Next owner's fence must exceed the highest observed (5).
+        ok, _ = self.step(m, st, "acquire", 1, 4)
+        assert not ok
+        ok, st = self.step(m, st, "acquire", 1, 6)
+        assert ok
+        # Another client can't acquire while held.
+        ok, _ = self.step(m, st, "acquire", 0, 9)
+        assert not ok
+        # Releasing someone else's lock is inconsistent.
+        ok, _ = self.step(m, st, "release", 0)
+        assert not ok
+
+    def test_unfenced_holds(self):
+        m = self.mk()
+        from jepsen_tpu.models import ValueTable
+
+        st = m.init_state(ValueTable())
+        ok, st = self.step(m, st, "acquire", 0)  # unknown fence
+        assert ok
+        ok, st = self.step(m, st, "acquire", 0, 7)  # fenced reacquire
+        assert ok
+        ok, st = self.step(m, st, "release", 0)
+        assert ok
+        ok, st = self.step(m, st, "release", 0)
+        assert ok
+        ok, _ = self.step(m, st, "acquire", 1, 7)  # must exceed 7
+        assert not ok
+
+    def test_device_agrees_with_scalar(self):
+        import numpy as np
+
+        from jepsen_tpu.models import UNKNOWN, ValueTable
+
+        m = self.mk()
+        rngstates = []
+        import itertools, random
+
+        rng = random.Random(3)
+        st = m.init_state(ValueTable())
+        states, opcodes, a1s, a2s, exp_ok, exp_st = [], [], [], [], [], []
+        for _ in range(300):
+            opcode = rng.randint(0, 1)
+            a1 = rng.randint(0, 2)
+            a2 = rng.choice([UNKNOWN, rng.randint(0, 9)])
+            ok, st2 = m.step_scalar(st, opcode, a1, a2)
+            states.append(st)
+            opcodes.append(opcode)
+            a1s.append(a1)
+            a2s.append(a2)
+            exp_ok.append(ok)
+            exp_st.append(st2 if ok else st)
+            if ok:
+                st = st2
+        import jax.numpy as jnp
+
+        ok_d, st_d = m.step_jax(
+            jnp.asarray(np.array(states, np.int32)),
+            jnp.asarray(np.array(opcodes, np.int32)),
+            jnp.asarray(np.array(a1s, np.int32)),
+            jnp.asarray(np.array(a2s, np.int32)),
+        )
+        ok_d = np.asarray(ok_d)
+        st_d = np.asarray(st_d)
+        assert ok_d.tolist() == exp_ok
+        for i, (okv, exp) in enumerate(zip(exp_ok, exp_st)):
+            if okv:
+                assert st_d[i].tolist() == list(exp), (i, st_d[i], exp)
