@@ -626,6 +626,16 @@ def plan_device(
     )
 
 
+# Histories at least this long get an optimistic greedy-beam phase
+# before the exhaustive search: large valid histories' frontiers spike to
+# tens of thousands of configs, while a width-OPTIMISTIC_BEAM_F beam that
+# keeps the most-advanced, fewest-opens-used configs finds the accepting
+# path ~3x faster (measured on the 10k-op north-star history). Accepts
+# under truncation are sound; anything else falls back to the full search.
+OPTIMISTIC_MIN_OPS = 1500
+OPTIMISTIC_BEAM_F = 8192
+
+
 def check_encoded_device(
     enc: EncodedHistory,
     f_schedule=F_SCHEDULE,
@@ -633,6 +643,7 @@ def check_encoded_device(
     window_cap: int = 1024,
     levels_per_call: Optional[int] = None,
     pad_to: Optional[tuple] = None,
+    optimistic: Optional[bool] = None,
 ) -> dict:
     """Decide linearizability of an encoded history on the default JAX
     backend (TPU when present). Result map mirrors the host oracle
@@ -644,7 +655,10 @@ def check_encoded_device(
     dynamic, so chunking costs no recompiles), then the host resumes from
     the returned frontier. Bounding single-program runtime keeps the TPU
     runtime's watchdog happy on long histories and gives the host a
-    progress heartbeat."""
+    progress heartbeat.
+
+    Long histories run an optimistic beam phase first (see
+    OPTIMISTIC_BEAM_F above); set ``optimistic`` to force it on/off."""
     t0 = _time.perf_counter()
     n = enc.n
     plan = plan_device(enc, max_open=max_open, window_cap=window_cap,
@@ -655,13 +669,47 @@ def check_encoded_device(
     if not plan.ok or not f_schedule:
         info = plan.reason or "empty frontier-capacity schedule"
         return {"valid": "unknown", "op_count": n, "device": True, "info": info}
+
+    schedule = sorted(set(f_schedule))
+    if optimistic is None:
+        optimistic = plan.nD >= OPTIMISTIC_MIN_OPS
+    # The beam phase needs a capacity strictly below the schedule's top so
+    # the exhaustive fallback has room to do more; with a small forced
+    # schedule, beam below its top capacity.
+    if schedule[-1] > OPTIMISTIC_BEAM_F:
+        beam_cap = OPTIMISTIC_BEAM_F
+    elif len(schedule) > 1:
+        beam_cap = schedule[-2]
+    else:
+        beam_cap = None
+    if optimistic and beam_cap is not None:
+        beam_sched = [f for f in schedule if f <= beam_cap] or [beam_cap]
+        res = _device_search(enc, plan, beam_sched, levels_per_call, t0)
+        if res["valid"] is True:
+            res["phase"] = "optimistic-beam"
+            return res
+        if res["valid"] is False and not res.get("beam"):
+            return res  # refuted without ever truncating: sound
+        # Beam exhausted under truncation: exhaustive phase.
+        full = _device_search(enc, plan, schedule, levels_per_call,
+                              _time.perf_counter())
+        full["wall_s"] = _time.perf_counter() - t0
+        full["optimistic_attempts"] = res.get("attempts")
+        return full
+    return _device_search(enc, plan, schedule, levels_per_call, t0)
+
+
+def _device_search(enc: EncodedHistory, plan: DevicePlan, schedule: list,
+                   levels_per_call: Optional[int], t0: float) -> dict:
+    """One escalating/de-escalating frontier search over ``schedule``;
+    the top capacity continues past overflow as a greedy beam."""
+    n = enc.n
     W, KO, S, ND, NO = plan.dims
     total_levels = int(plan.args[2])
 
     mk = _model_cache_key(enc.model)
     attempts = []
     fmax_all = 1
-    schedule = sorted(set(f_schedule))
 
     def result(valid, lvl, **extra):
         r = {

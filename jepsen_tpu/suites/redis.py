@@ -1,0 +1,195 @@
+"""Redis queue suite (the rabbitmq/disque analogue).
+
+The reference's queue suites (rabbitmq/ 340 LoC, disque/ 339 LoC) drive
+enqueue/dequeue workloads checked with ``checker/queue`` +
+``checker/total-queue`` (SURVEY §2.6). This suite speaks RESP (the redis
+serialization protocol) over a raw socket — no client library — using
+LPUSH/RPOP for the queue and a final drain phase so the total-queue
+checker can account for every element.
+"""
+
+from __future__ import annotations
+
+import socket
+from typing import Any, Optional
+
+from .. import checker as jchecker
+from .. import cli, client as jclient, db as jdb, generator as gen
+from .. import nemesis as jnemesis, net as jnet
+from ..control import util as cu
+from .. import control as c
+
+PORT = 6379
+QUEUE = "jepsen.queue"
+
+
+class Resp:
+    """Minimal RESP2 client over one socket."""
+
+    def __init__(self, host: str, port: int, timeout: float = 5.0):
+        self.sock = socket.create_connection((host, port), timeout=timeout)
+        self.buf = b""
+
+    def close(self):
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+    def _line(self) -> bytes:
+        while b"\r\n" not in self.buf:
+            chunk = self.sock.recv(4096)
+            if not chunk:
+                raise ConnectionError("redis closed connection")
+            self.buf += chunk
+        line, self.buf = self.buf.split(b"\r\n", 1)
+        return line
+
+    def _read_exact(self, n: int) -> bytes:
+        while len(self.buf) < n + 2:
+            chunk = self.sock.recv(4096)
+            if not chunk:
+                raise ConnectionError("redis closed connection")
+            self.buf += chunk
+        out, self.buf = self.buf[:n], self.buf[n + 2:]
+        return out
+
+    def _reply(self):
+        line = self._line()
+        t, rest = line[:1], line[1:]
+        if t == b"+":
+            return rest.decode()
+        if t == b"-":
+            raise RuntimeError(rest.decode())
+        if t == b":":
+            return int(rest)
+        if t == b"$":
+            n = int(rest)
+            return None if n == -1 else self._read_exact(n).decode()
+        if t == b"*":
+            n = int(rest)
+            return None if n == -1 else [self._reply() for _ in range(n)]
+        raise RuntimeError(f"bad RESP type {line!r}")
+
+    def cmd(self, *args: Any):
+        out = [f"*{len(args)}\r\n".encode()]
+        for a in args:
+            s = str(a).encode()
+            out.append(f"${len(s)}\r\n".encode() + s + b"\r\n")
+        self.sock.sendall(b"".join(out))
+        return self._reply()
+
+
+class QueueClient(jclient.Client):
+    """Enqueue via LPUSH, dequeue via RPOP; drain dequeues everything
+    left (rabbitmq-style op shapes: {:f :enqueue|:dequeue|:drain})."""
+
+    def __init__(self, conn: Optional[Resp] = None, node: Any = None):
+        self.conn = conn
+        self.node = node
+
+    def open(self, test, node):
+        return QueueClient(Resp(str(node), PORT), node)
+
+    def invoke(self, test, op):
+        f = op["f"]
+        if f == "enqueue":
+            self.conn.cmd("LPUSH", QUEUE, op["value"])
+            return {**op, "type": "ok"}
+        if f == "dequeue":
+            v = self.conn.cmd("RPOP", QUEUE)
+            if v is None:
+                return {**op, "type": "fail", "error": "empty"}
+            return {**op, "type": "ok", "value": int(v)}
+        if f == "drain":
+            drained = []
+            while True:
+                v = self.conn.cmd("RPOP", QUEUE)
+                if v is None:
+                    break
+                drained.append(int(v))
+            return {**op, "type": "ok", "value": drained}
+        raise ValueError(f"unknown f {f!r}")
+
+    def close(self, test):
+        if self.conn is not None:
+            self.conn.close()
+
+
+class RedisDB(jdb.DB, jdb.Process, jdb.LogFiles):
+    LOG = "/var/log/redis-jepsen.log"
+    PID = "/var/run/redis-jepsen.pid"
+
+    def setup(self, test, node):
+        from ..os_ import debian
+
+        debian.install(["redis-server"])
+        self.start(test, node)
+
+    def start(self, test, node):
+        with c.su():
+            cu.start_daemon(
+                {"logfile": self.LOG, "pidfile": self.PID, "chdir": "/tmp"},
+                "/usr/bin/redis-server",
+                "--port", PORT, "--bind", "0.0.0.0",
+                "--protected-mode", "no", "--appendonly", "yes",
+            )
+
+    def kill(self, test, node):
+        cu.grepkill("redis-server")
+
+    def teardown(self, test, node):
+        cu.grepkill("redis-server")
+        with c.su():
+            c.exec("rm", "-rf", "/tmp/appendonlydir", self.PID)
+
+    def log_files(self, test, node):
+        return [self.LOG]
+
+
+def queue_workload(opts: Optional[dict] = None) -> dict:
+    """Enqueue/dequeue mix, then a drain phase; checked with total-queue
+    (every enqueued element must be dequeued exactly once — multiset
+    semantics, checker.clj:625-684)."""
+    o = dict(opts or {})
+    counter = [0]
+
+    def enq(test=None, ctx=None):
+        counter[0] += 1
+        return {"type": "invoke", "f": "enqueue", "value": counter[0]}
+
+    def deq(test=None, ctx=None):
+        return {"type": "invoke", "f": "dequeue", "value": None}
+
+    return {
+        "client": QueueClient(),
+        "checker": jchecker.compose({
+            "total-queue": jchecker.total_queue(),
+            "stats": jchecker.stats(),
+        }),
+        "generator": gen.phases(
+            gen.clients(gen.limit(int(o.get("ops") or 200),
+                                  gen.mix([enq, deq]))),
+            gen.clients(gen.each_thread({"type": "invoke", "f": "drain",
+                                         "value": None})),
+        ),
+    }
+
+
+def test_fn(opts: dict) -> dict:
+    wl = queue_workload(opts)
+    return {
+        "name": "redis-queue",
+        "db": RedisDB(),
+        "net": jnet.iptables(),
+        "nemesis": jnemesis.partition_random_halves(),
+        **wl,
+    }
+
+
+def main(argv=None):
+    cli.main_exit(cli.single_test_cmd(test_fn), argv)
+
+
+if __name__ == "__main__":
+    main()

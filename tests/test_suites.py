@@ -221,3 +221,82 @@ class TestEtcdSuite:
         res = core.run(test)
         assert res["results"]["valid"] is True
         assert res["results"].get("txn_count", 0) > 0 or True
+
+
+class RedisStub:
+    """RESP2 stub on a socketserver: LPUSH/RPOP over one in-memory list."""
+
+    def __init__(self):
+        import socketserver
+
+        stub = self
+
+        class Handler(socketserver.StreamRequestHandler):
+            def handle(self):
+                while True:
+                    try:
+                        line = self.rfile.readline()
+                    except OSError:
+                        return
+                    if not line:
+                        return
+                    assert line[:1] == b"*"
+                    n = int(line[1:].strip())
+                    args = []
+                    for _ in range(n):
+                        ln = self.rfile.readline()
+                        assert ln[:1] == b"$"
+                        sz = int(ln[1:].strip())
+                        args.append(self.rfile.read(sz).decode())
+                        self.rfile.read(2)
+                    self.wfile.write(stub.dispatch(args))
+
+        self.Handler = Handler
+        self.lock = threading.Lock()
+        self.queue: list = []
+
+    def dispatch(self, args) -> bytes:
+        cmd = args[0].upper()
+        with self.lock:
+            if cmd == "LPUSH":
+                self.queue.insert(0, args[2])
+                return f":{len(self.queue)}\r\n".encode()
+            if cmd == "RPOP":
+                if not self.queue:
+                    return b"$-1\r\n"
+                v = self.queue.pop()
+                return f"${len(v)}\r\n{v}\r\n".encode()
+        return b"-ERR unknown\r\n"
+
+
+class TestRedisSuite:
+    def test_queue_against_stub(self, tmp_path, monkeypatch):
+        import socketserver
+
+        from jepsen_tpu.suites import redis as redis_suite
+
+        stub = RedisStub()
+        srv = socketserver.ThreadingTCPServer(("127.0.0.1", 0), stub.Handler)
+        srv.daemon_threads = True
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        monkeypatch.setattr(redis_suite, "PORT", srv.server_address[1])
+        try:
+            test = dict(noop_test())
+            wl = redis_suite.queue_workload({"ops": 60})
+            test.update(
+                name="redis-stub",
+                nodes=["127.0.0.1"],
+                concurrency=4,
+                **{"store-root": str(tmp_path)},
+                client=wl["client"],
+                checker=wl["checker"],
+                generator=wl["generator"],
+            )
+            res = core.run(test)
+            tq = res["results"]["total-queue"]
+            assert res["results"]["valid"] is True, res["results"]
+            assert tq["lost_count"] == 0
+            assert tq["attempt_count"] > 0
+        finally:
+            srv.shutdown()
+            srv.server_close()

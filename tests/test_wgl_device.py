@@ -118,3 +118,29 @@ class TestTwoStageCompaction:
                 assert dev["valid"] == host["valid"], (i, dev, host)
         finally:
             wgl._build_kernel.cache_clear()
+
+
+class TestOptimisticBeam:
+    def test_optimistic_agrees_with_host(self):
+        """Force the optimistic beam phase on small histories: accepts are
+        sound, refutations fall back to the exhaustive search, so verdicts
+        must match the host oracle exactly."""
+        import random
+
+        from jepsen_tpu.models import CasRegister
+        from jepsen_tpu.ops import wgl, wgl_host
+        from jepsen_tpu.ops.encode import encode_history
+        from jepsen_tpu.testing import perturb_history, random_register_history
+
+        model = CasRegister(init=0)
+        rng = random.Random(31)
+        for i in range(10):
+            h = random_register_history(
+                rng, n_ops=40, n_procs=5, cas=True, crash_p=0.08)
+            if i % 2:
+                h = perturb_history(rng, h)
+            dev = wgl.check_encoded_device(
+                encode_history(model, h), f_schedule=(16, 64, 256),
+                optimistic=True)
+            host = wgl_host.check_history_host(model, h)
+            assert dev["valid"] == host["valid"], (i, dev, host)

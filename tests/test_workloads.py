@@ -229,3 +229,61 @@ class TestLinearizableRegisterPackaging:
         assert res["results"]["valid"] is True
         assert res["results"]["results"]  # per-key result map
         assert len(res["results"]["results"]) >= 2
+
+
+class TestCausal:
+    def test_causal_register_model(self):
+        from jepsen_tpu.workloads import causal
+
+        def op(f, value, link=None, position=None, type="ok"):
+            return {"type": type, "process": 0, "f": f, "value": value,
+                    "link": link, "position": position, "time": 0}
+
+        chk = causal.check()
+        good = H([
+            op("read-init", 0, link="init", position=1),
+            op("write", 1, link=1, position=2),
+            op("read", 1, link=2, position=3),
+            op("write", 2, link=3, position=4),
+            op("read", 2, link=4, position=5),
+        ])
+        assert chk.check({}, good, {})["valid"] is True
+        # Broken link chain.
+        bad = H([
+            op("read-init", 0, link="init", position=1),
+            op("write", 1, link=99, position=2),
+        ])
+        res = chk.check({}, bad, {})
+        assert res["valid"] is False
+        assert "link" in res["error"]
+        # Reading an unwritten value.
+        bad2 = H([
+            op("read-init", 5, link="init", position=1),
+        ])
+        assert chk.check({}, bad2, {})["valid"] is False
+
+    def test_causal_reverse(self):
+        from jepsen_tpu.workloads import causal
+
+        def w(v, type):
+            return {"type": type, "process": 0, "f": "write", "value": v,
+                    "time": 0}
+
+        def r(seen):
+            return {"type": "ok", "process": 1, "f": "read", "value": seen,
+                    "time": 0}
+
+        # w0 acknowledged before w1 invoked; a read seeing w1 without w0
+        # violates strict serializability.
+        h = H([w(0, "invoke"), w(0, "ok"), w(1, "invoke"), w(1, "ok"),
+               r([1])])
+        res = causal.reverse_checker().check({}, h, {})
+        assert res["valid"] is False
+        assert res["errors"][0]["missing"] == [0]
+        h2 = H([w(0, "invoke"), w(0, "ok"), w(1, "invoke"), w(1, "ok"),
+                r([0, 1])])
+        assert causal.reverse_checker().check({}, h2, {})["valid"] is True
+        # Concurrent writes: either visibility order is fine.
+        h3 = H([w(0, "invoke"), w(1, "invoke"), w(0, "ok"), w(1, "ok"),
+                r([1])])
+        assert causal.reverse_checker().check({}, h3, {})["valid"] is True
