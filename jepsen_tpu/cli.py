@@ -117,6 +117,8 @@ def _apply_std_opts(test: dict, opts: dict) -> dict:
     test.setdefault("time-limit", opts["time_limit"])
     if opts.get("leave_db_running"):
         test["leave-db-running?"] = True
+    if opts.get("logging_json"):
+        test["logging-json"] = True
     if opts.get("store_root"):
         test["store-root"] = opts["store_root"]
     if opts.get("checker_backend") and opts["checker_backend"] != "auto":

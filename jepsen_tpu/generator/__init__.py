@@ -970,6 +970,46 @@ def sleep(dt):
     return {"type": SLEEP, "value": dt}
 
 
+class Cycle(Generator):
+    """Cycle through a sequence of generators forever: run element i to
+    exhaustion, then move to (i+1) mod n with a FRESH copy of the
+    element (the reference writes this as Clojure's lazy ``(cycle
+    [...])``; note ``repeat_`` is different — it re-emits from the same
+    un-advanced generator, so ``repeat_([a b])`` yields only ``a``s)."""
+
+    _FRESH = object()  # distinct from None (None = exhausted inner)
+
+    __slots__ = ("elements", "i", "inner")
+
+    def __init__(self, elements, i=0, inner=_FRESH):
+        self.elements = tuple(elements)
+        self.i = i
+        self.inner = self.elements[i] if inner is Cycle._FRESH else inner
+
+    def op(self, test, ctx):
+        i, inner = self.i, self.inner
+        for _ in range(len(self.elements) + 1):
+            res = op(inner, test, ctx)
+            if res is not None:
+                o, g2 = res
+                return (o, Cycle(self.elements, i, g2))
+            i = (i + 1) % len(self.elements)
+            inner = self.elements[i]
+        return None  # every element is empty
+
+    def update(self, test, ctx, event):
+        return Cycle(self.elements, self.i,
+                     update(self.inner, test, ctx, event))
+
+
+def cycle_(elements):
+    """An endless loop over a sequence of generators."""
+    elements = list(elements)
+    if not elements:
+        return None
+    return Cycle(elements)
+
+
 class Synchronize(Generator):
     """PENDING until every worker is free, then delegates
     (generator.clj:1354-1374)."""

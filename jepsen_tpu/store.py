@@ -19,6 +19,7 @@ dies), :func:`save_2` post-analysis (store.clj:372-397).
 
 from __future__ import annotations
 
+import json
 import logging
 import os
 import shutil
@@ -264,13 +265,34 @@ def delete(name: Optional[str] = None, root=None) -> None:
 _log_handlers: dict = {}
 
 
+class _JsonFormatter(logging.Formatter):
+    """Structured log lines (the reference's --logging-json / unilog JSON
+    appender, store.clj:399-439, cli.clj:89-90)."""
+
+    def format(self, record):
+        out = {
+            "ts": self.formatTime(record),
+            "level": record.levelname,
+            "logger": record.name,
+            "thread": record.threadName,
+            "message": record.getMessage(),
+        }
+        if record.exc_info:
+            out["exception"] = self.formatException(record.exc_info)
+        return json.dumps(out)
+
+
 def start_logging(test: dict) -> None:
-    """Attach a jepsen.log file handler for this run."""
+    """Attach a jepsen.log file handler for this run (JSON lines when the
+    test sets logging-json, cli --logging-json)."""
     f = path_mk(test, "jepsen.log")
     h = logging.FileHandler(f)
-    h.setFormatter(logging.Formatter(
-        "%(asctime)s{%(threadName)s} %(levelname)s %(name)s - %(message)s"
-    ))
+    if test.get("logging-json") or test.get("logging_json"):
+        h.setFormatter(_JsonFormatter())
+    else:
+        h.setFormatter(logging.Formatter(
+            "%(asctime)s{%(threadName)s} %(levelname)s %(name)s - %(message)s"
+        ))
     root = logging.getLogger()
     if root.level > logging.INFO or root.level == logging.NOTSET:
         root.setLevel(logging.INFO)

@@ -186,7 +186,7 @@ def test_fn(opts: dict) -> dict:
     # Partition cycle with a final heal + read phase (consul.clj:48-60).
     test["generator"] = gen.phases(
         gen.nemesis(
-            gen.repeat_([gen.sleep(5),
+            gen.cycle_([gen.sleep(5),
                          {"type": "info", "f": "start"},
                          gen.sleep(5),
                          {"type": "info", "f": "stop"}]),

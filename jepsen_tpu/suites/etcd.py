@@ -249,7 +249,7 @@ def test_fn(opts: dict) -> dict:
         "nemesis": jnemesis.partition_random_halves(),
         **wl,
         "generator": gen.nemesis(
-            gen.repeat_([gen.sleep(5), {"type": "info", "f": "start"},
+            gen.cycle_([gen.sleep(5), {"type": "info", "f": "start"},
                          gen.sleep(5), {"type": "info", "f": "stop"}]),
             gen.time_limit(opts.get("time_limit", 60), wl["generator"]),
         ),

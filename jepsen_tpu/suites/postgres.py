@@ -124,7 +124,7 @@ def test_fn(opts: dict) -> dict:
         "client": PsqlClient(),
         "checker": wl["checker"],
         "generator": gen.nemesis(
-            gen.repeat_([gen.sleep(10), {"type": "info", "f": "start"},
+            gen.cycle_([gen.sleep(10), {"type": "info", "f": "start"},
                          gen.sleep(10), {"type": "info", "f": "stop"}]),
             gen.time_limit(opts.get("time_limit", 60), wl["generator"]),
         ),

@@ -121,7 +121,7 @@ def test(opts: Optional[dict] = None) -> dict:
         "generator": gen.time_limit(
             o.get("time-limit", 60),
             gen.nemesis(
-                gen.repeat_([gen.sleep(10),
+                gen.cycle_([gen.sleep(10),
                              {"type": "info", "f": "start"},
                              gen.sleep(10),
                              {"type": "info", "f": "stop"}]),

@@ -1,0 +1,91 @@
+"""Distributed lock / semaphore workloads over the mutex model family.
+
+The reference's hazelcast suite drives the CP subsystem's locks and
+semaphores and checks them against five custom knossos models
+(hazelcast/src/jepsen/hazelcast.clj:515-733: ReentrantMutex,
+OwnerAwareMutex, FencedMutex, ReentrantFencedMutex,
+AcquiredPermitsModel). The models live in `jepsen_tpu.models.mutex`;
+this module packages the workloads: acquire/release generators per
+client, fence plumbing, and linearizability checking on the device
+kernel — BASELINE's "hazelcast CP lock/semaphore (mutex model, 5k ops)"
+configuration.
+
+Clients understand::
+
+    {"f": "acquire", "value": None}   -> ok value = fence token (or None)
+    {"f": "release", "value": None}
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from .. import checker as jchecker
+from .. import generator as gen
+from ..models import (
+    FencedMutex,
+    Mutex,
+    OwnerAwareMutex,
+    ReentrantFencedMutex,
+    ReentrantMutex,
+    Semaphore,
+)
+
+MODELS = {
+    "mutex": Mutex,
+    "owner-aware-mutex": OwnerAwareMutex,
+    "reentrant-mutex": ReentrantMutex,
+    "fenced-mutex": FencedMutex,
+    "reentrant-fenced-mutex": ReentrantFencedMutex,
+}
+
+
+def acquire(test=None, ctx=None):
+    return {"type": "invoke", "f": "acquire", "value": None}
+
+
+def release(test=None, ctx=None):
+    return {"type": "invoke", "f": "release", "value": None}
+
+
+def lock_generator():
+    """Each thread alternates acquire/release (the hazelcast workloads'
+    per-client discipline, hazelcast.clj:652-733); threads may still race
+    and double-release — that's what the model checks."""
+    return gen.each_thread(gen.flip_flop(acquire, release))
+
+
+def lock_test(opts: Optional[dict] = None) -> dict:
+    """A lock workload checked against one of the mutex-family models.
+    opts: model (name from MODELS), backend."""
+    o = dict(opts or {})
+    model_cls = MODELS[o.get("model") or "reentrant-mutex"]
+    return {
+        "checker": jchecker.compose({
+            "linear": jchecker.linearizable(
+                model=model_cls(), backend=o.get("backend", "auto")),
+            "stats": jchecker.stats(),
+        }),
+        "generator": lock_generator(),
+    }
+
+
+def semaphore_test(opts: Optional[dict] = None) -> dict:
+    """Counting-semaphore workload (AcquiredPermitsModel,
+    hazelcast.clj:630-649); op values carry permit counts."""
+    o = dict(opts or {})
+    capacity = int(o.get("capacity") or 2)
+
+    def acq(test=None, ctx=None):
+        return {"type": "invoke", "f": "acquire", "value": 1}
+
+    def rel(test=None, ctx=None):
+        return {"type": "invoke", "f": "release", "value": 1}
+
+    return {
+        "checker": jchecker.compose({
+            "linear": jchecker.linearizable(model=Semaphore(capacity)),
+            "stats": jchecker.stats(),
+        }),
+        "generator": gen.each_thread(gen.flip_flop(acq, rel)),
+    }

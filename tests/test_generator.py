@@ -362,3 +362,20 @@ def test_validate_rejects_busy_process():
 
     with pytest.raises(gen.InvalidOp):
         sim.quick(Bad())
+
+
+def test_cycle_combinator():
+    """cycle_ loops a sequence of generators with fresh copies each pass
+    (the reference writes these schedules as Clojure's (cycle [...]));
+    contrast repeat_, which re-emits the head only."""
+    from jepsen_tpu.generator import sim
+
+    g = gen.limit(7, gen.cycle_([{"f": "a"}, {"f": "b"}, {"f": "c"}]))
+    ops = sim.quick(g)
+    assert [o["f"] for o in ops] == ["a", "b", "c", "a", "b", "c", "a"]
+    # Nemesis-style: sleeps interleaved with fault ops must all fire.
+    g = gen.limit(6, gen.cycle_([gen.sleep(0), {"type": "info", "f": "start"},
+                                 gen.sleep(0), {"type": "info", "f": "stop"}]))
+    ops = sim.quick_ops(g)
+    fs = [o["f"] for o in ops if o.get("type") == "info" and "f" in o]
+    assert fs[:2] == ["start", "stop"]
