@@ -96,6 +96,79 @@ def main() -> int:
         # histories can absorb the mutated read); record the verdict but
         # don't fail the bench over it.
         out["invalid_valid"] = bad_res["valid"]
+        # --- BASELINE companion configs, each guarded ------------------
+        # Elle-style txn cycle search on-device (cockroachdb bank/txn
+        # config): a ~10k-mop serializable append history.
+        try:
+            from jepsen_tpu import txn as jtxn
+            from jepsen_tpu.elle import append as elle_append
+            from jepsen_tpu.generator import fixed_rand
+
+            store, h = {}, []
+            mops = 0
+            with fixed_rand(11):
+                stream = jtxn.append_txns(key_count=6, max_txn_length=5)
+                for op in jtxn.take(stream, 4000):
+                    done = []
+                    for f, k, v in op["value"]:
+                        if f == "append":
+                            store.setdefault(k, []).append(v)
+                            done.append([f, k, v])
+                        else:
+                            done.append([f, k, list(store.get(k, []))])
+                        mops += 1
+                    h.append({"type": "ok", "f": "txn", "value": done,
+                              "process": 0})
+            elle_append.check(h, device=True)  # warm/compile
+            t0 = time.perf_counter()
+            res = elle_append.check(h, device=True)
+            out["elle_txn"] = {
+                "mops": mops, "txns": len(h),
+                "value_s": round(time.perf_counter() - t0, 3),
+                "valid": res["valid"],
+            }
+        except Exception as e:  # noqa: BLE001
+            out["elle_txn"] = {"error": f"{type(e).__name__}: {e}"}
+
+        # Batch replay: 100 histories decided as one vmapped program
+        # (BASELINE config 5).
+        try:
+            from jepsen_tpu.parallel import check_batch
+
+            rng2 = random.Random(3)
+            hists = [
+                random_register_history(rng2, n_ops=100, n_procs=4,
+                                        cas=True, crash_p=0.01)
+                for _ in range(100)
+            ]
+            check_batch(model, hists, f=64)  # warm/compile
+            t0 = time.perf_counter()
+            rs = check_batch(model, hists, f=64)
+            out["batch_replay_100"] = {
+                "value_s": round(time.perf_counter() - t0, 3),
+                "valid_count": sum(1 for r in rs if r["valid"] is True),
+            }
+        except Exception as e:  # noqa: BLE001
+            out["batch_replay_100"] = {"error": f"{type(e).__name__}: {e}"}
+
+        # Mutex-model linearizability (hazelcast CP lock config): a 5k-op
+        # correct lock-service history.
+        try:
+            from jepsen_tpu.models import OwnerAwareMutex
+            from jepsen_tpu.testing import random_lock_history
+
+            lh = random_lock_history(random.Random(5), n_ops=5000,
+                                     n_procs=8)
+            menc = encode_history(OwnerAwareMutex(), lh)
+            wgl.check_encoded_device(menc)  # warm/compile
+            t0 = time.perf_counter()
+            mres = wgl.check_encoded_device(menc)
+            out["mutex_5k"] = {
+                "value_s": round(time.perf_counter() - t0, 3),
+                "valid": mres["valid"],
+            }
+        except Exception as e:  # noqa: BLE001
+            out["mutex_5k"] = {"error": f"{type(e).__name__}: {e}"}
     except Exception as e:  # noqa: BLE001 - always emit the JSON line
         out["error"] = f"{type(e).__name__}: {e}"
         rc = 1

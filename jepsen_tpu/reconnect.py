@@ -1,0 +1,145 @@
+"""Auto-reconnecting connection wrappers.
+
+Mirrors jepsen.reconnect (jepsen/src/jepsen/reconnect.clj): a stateful
+wrapper around an open/close lifecycle with a readers-writer lock —
+operations share the connection under the read lock; a failure takes the
+write lock, closes and reopens, and **rethrows** (reconnect.clj:16-31,
+92-129). The operation is NOT re-executed: DB operations are generally
+non-idempotent, and the caller (the interpreter's soundness rule) must
+see the failure to record the op as indeterminate. The control plane's
+sessions may retry because shell actions are request/response over a
+fresh channel; this generic wrapper must not.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Any, Callable, Optional
+
+LOG = logging.getLogger("jepsen.reconnect")
+
+
+class _RWLock:
+    """Writer-preferring readers-writer lock."""
+
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._readers = 0
+        self._writer = False
+        self._writers_waiting = 0
+
+    def acquire_read(self):
+        with self._cond:
+            while self._writer or self._writers_waiting:
+                self._cond.wait()
+            self._readers += 1
+
+    def release_read(self):
+        with self._cond:
+            self._readers -= 1
+            if self._readers == 0:
+                self._cond.notify_all()
+
+    def acquire_write(self):
+        with self._cond:
+            self._writers_waiting += 1
+            while self._writer or self._readers:
+                self._cond.wait()
+            self._writers_waiting -= 1
+            self._writer = True
+
+    def release_write(self):
+        with self._cond:
+            self._writer = False
+            self._cond.notify_all()
+
+
+class Wrapper:
+    """reconnect.clj:16-31. ``open`` builds a connection; ``close`` tears
+    one down; ``name``/``log`` control reopen logging."""
+
+    def __init__(self, open: Callable[[], Any],
+                 close: Optional[Callable[[Any], None]] = None,
+                 name: Any = None, log: bool = True):
+        self._open = open
+        self._close = close or (lambda conn: None)
+        self.name = name
+        self.log = log
+        self._rw = _RWLock()
+        self._conn: Any = None
+
+    def open(self) -> "Wrapper":
+        """reconnect.clj:56-66."""
+        self._rw.acquire_write()
+        try:
+            if self._conn is None:
+                self._conn = self._open()
+        finally:
+            self._rw.release_write()
+        return self
+
+    def reopen(self) -> None:
+        """Close and reopen under the write lock (reconnect.clj:68-80) —
+        waits for in-flight users, so nobody's connection is yanked
+        mid-operation."""
+        self._rw.acquire_write()
+        try:
+            if self._conn is not None:
+                try:
+                    self._close(self._conn)
+                except Exception:
+                    pass
+                self._conn = None
+            self._conn = self._open()
+        finally:
+            self._rw.release_write()
+
+    def close(self) -> None:
+        self._rw.acquire_write()
+        try:
+            if self._conn is not None:
+                try:
+                    self._close(self._conn)
+                finally:
+                    self._conn = None
+        finally:
+            self._rw.release_write()
+
+    def with_conn(self, f: Callable[[Any], Any]) -> Any:
+        """Run ``f(conn)`` under the read lock. On failure, reopen the
+        connection for FUTURE users and rethrow — the failed operation is
+        never silently re-executed: DB ops are non-idempotent, and the
+        caller must see the failure to record the op as indeterminate
+        (reconnect.clj:92-129)."""
+        self._rw.acquire_read()
+        holding = True
+        try:
+            conn = self._conn
+            if conn is None:
+                # Lazily open: switch to the write path, then re-enter.
+                self._rw.release_read()
+                holding = False
+                self.open()
+                self._rw.acquire_read()
+                holding = True
+                conn = self._conn
+            return f(conn)
+        except Exception:
+            if holding:
+                self._rw.release_read()
+                holding = False
+            if self.log:
+                LOG.warning("conn %r failed; reopening", self.name)
+            try:
+                self.reopen()
+            except Exception:
+                LOG.warning("could not reopen %r", self.name, exc_info=True)
+            raise
+        finally:
+            if holding:
+                self._rw.release_read()
+
+
+def wrapper(open: Callable[[], Any], **kw: Any) -> Wrapper:
+    return Wrapper(open, **kw)

@@ -1,0 +1,227 @@
+"""CockroachDB suite: bank + list-append txns over the pg wire via the
+node's ``cockroach sql`` shell.
+
+Mirrors the reference cockroachdb suite (cockroachdb/src/jepsen/
+cockroach/*.clj, 2515 LoC): register/bank/append workloads, a rich
+composed nemesis including its own clock-skew C tooling (here the shared
+jepsen_tpu.nemesis.time tools serve), and the serializable-SQL client
+discipline — serialization failures are definite :fail, connection drops
+indeterminate.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Optional
+
+from .. import checker as jchecker
+from .. import cli, client as jclient, db as jdb, generator as gen
+from .. import nemesis as jnemesis, net as jnet
+from ..control import util as cu
+from ..nemesis import combined as ncombined
+from ..workloads import append as wa
+from ..workloads import bank as wbank
+from .. import control as c
+
+BANK_TABLE = "jepsen_bank"
+APPEND_TABLE = "jepsen_append"
+
+
+class _SqlClient(jclient.Client):
+    """Runs SQL via `cockroach sql` on the node (the CLI analogue of the
+    reference's JDBC client)."""
+
+    def __init__(self, node: Any = None):
+        self.node = node
+
+    def open(self, test, node):
+        return type(self)(node)
+
+    def _sql(self, test, script: str) -> str:
+        def run(t, node):
+            return c.exec_star(
+                "/opt/cockroach/cockroach sql --insecure --format=tsv "
+                f"<<'JEPSEN_SQL'\n{script}\nJEPSEN_SQL")
+
+        return c.on_nodes(test, run, [self.node])[self.node]
+
+
+class BankClient(_SqlClient):
+    """Transfers inside one serializable txn; reads select all balances
+    (cockroach/bank.clj semantics)."""
+
+    def setup(self, test):
+        accounts = list(test["accounts"])
+        total = test["total-amount"]
+        base = total // len(accounts)
+        remainder = total - base * len(accounts)
+        balances = [base + (remainder if a == accounts[0] else 0)
+                    for a in accounts]
+        rows = ", ".join(f"({a}, {b})" for a, b in zip(accounts, balances))
+        self._sql(test,
+                  f"CREATE TABLE IF NOT EXISTS {BANK_TABLE} "
+                  "(id INT PRIMARY KEY, balance INT NOT NULL);\n"
+                  f"UPSERT INTO {BANK_TABLE} VALUES {rows};")
+
+    def invoke(self, test, op):
+        if op["f"] == "read":
+            out = self._sql(test, f"SELECT id, balance FROM {BANK_TABLE};")
+            lines = [l.split("\t") for l in out.strip().split("\n")[1:] if l]
+            value = {int(i): int(b) for i, b in lines}
+            return {**op, "type": "ok", "value": value}
+        v = op["value"]
+        try:
+            self._sql(test, "\n".join([
+                "BEGIN;",
+                f"UPDATE {BANK_TABLE} SET balance = balance - {v['amount']} "
+                f"WHERE id = {v['from']};",
+                f"UPDATE {BANK_TABLE} SET balance = balance + {v['amount']} "
+                f"WHERE id = {v['to']};",
+                "COMMIT;",
+            ]))
+            return {**op, "type": "ok"}
+        except c.RemoteError as e:
+            if "restart transaction" in str(e) or "retry" in str(e).lower():
+                return {**op, "type": "fail", "error": "serialization"}
+            raise
+
+
+class AppendClient(_SqlClient):
+    """List-append via jsonb rows in one serializable txn (the reference's
+    ysql/append pattern)."""
+
+    def setup(self, test):
+        self._sql(test,
+                  f"CREATE TABLE IF NOT EXISTS {APPEND_TABLE} "
+                  "(k STRING PRIMARY KEY, v JSONB NOT NULL);")
+
+    def invoke(self, test, op):
+        stmts = ["BEGIN;"]
+        for f, k, v in op["value"]:
+            if f == "r":
+                stmts.append(
+                    f"SELECT COALESCE((SELECT v FROM {APPEND_TABLE} "
+                    f"WHERE k = '{k}'), '[]'::JSONB);")
+            else:
+                stmts.append(
+                    f"INSERT INTO {APPEND_TABLE} VALUES ('{k}', "
+                    f"'[{v}]'::JSONB) ON CONFLICT (k) DO UPDATE SET "
+                    f"v = {APPEND_TABLE}.v || '{v}'::JSONB;")
+        stmts.append("COMMIT;")
+        try:
+            out = self._sql(test, "\n".join(stmts))
+        except c.RemoteError as e:
+            if "restart transaction" in str(e):
+                return {**op, "type": "fail", "error": "serialization"}
+            raise
+        lines = [l for l in out.strip().split("\n")
+                 if l and not l.startswith(("coalesce", "v"))]
+        done = []
+        ri = 0
+        for f, k, v in op["value"]:
+            if f == "r":
+                done.append([f, k, json.loads(lines[ri])])
+                ri += 1
+            else:
+                done.append([f, k, v])
+        return {**op, "type": "ok", "value": done}
+
+
+class CockroachDB(jdb.DB, jdb.Process, jdb.LogFiles):
+    DIR = "/opt/cockroach"
+    LOG = "/var/log/cockroach.log"
+    PID = "/var/run/cockroach.pid"
+
+    def __init__(self, version: str = "23.1.11"):
+        self.version = version
+
+    def setup(self, test, node):
+        url = (f"https://binaries.cockroachdb.com/"
+               f"cockroach-v{self.version}.linux-amd64.tgz")
+        cu.install_archive(url, self.DIR)
+        self.start(test, node)
+
+    def start(self, test, node):
+        joins = ",".join(test["nodes"])
+        with c.su():
+            cu.start_daemon(
+                {"logfile": self.LOG, "pidfile": self.PID, "chdir": self.DIR},
+                f"{self.DIR}/cockroach",
+                "start", "--insecure",
+                "--advertise-addr", node,
+                "--join", joins,
+                "--store", "/var/lib/cockroach",
+            )
+        if node == test["nodes"][0]:
+            try:
+                c.exec_star(
+                    f"{self.DIR}/cockroach init --insecure "
+                    f"--host={node} || true")
+            except c.RemoteError:
+                pass
+
+    def kill(self, test, node):
+        cu.grepkill("cockroach")
+
+    def teardown(self, test, node):
+        cu.grepkill("cockroach")
+        with c.su():
+            c.exec("rm", "-rf", "/var/lib/cockroach", self.PID)
+
+    def log_files(self, test, node):
+        return [self.LOG]
+
+
+def bank_workload(opts: dict) -> dict:
+    wl = wbank.test(opts)
+    return {**wl, "client": BankClient()}
+
+
+def append_workload(opts: dict) -> dict:
+    wl = wa.test({"key_count": 4})
+    return {"client": AppendClient(), "generator": wl["generator"],
+            "checker": wl["checker"]}
+
+
+WORKLOADS = {"bank": bank_workload, "append": append_workload}
+
+
+def test_fn(opts: dict) -> dict:
+    name = opts.get("workload") or "bank"
+    wl = WORKLOADS[name](opts)
+    db = CockroachDB(str(opts.get("version") or "23.1.11"))
+    pkg = ncombined.nemesis_package({
+        "db": db,
+        "interval": opts.get("nemesis_interval") or 10,
+        "faults": (opts.get("faults") or "partition,kill").split(","),
+    })
+    test = {
+        "name": f"cockroachdb-{name}",
+        "db": db,
+        "net": jnet.iptables(),
+        "nemesis": pkg["nemesis"],
+        **{k: v for k, v in wl.items() if k != "generator"},
+    }
+    test["generator"] = gen.phases(
+        gen.nemesis(
+            pkg["generator"],
+            gen.time_limit(opts.get("time_limit", 60), wl["generator"]),
+        ),
+        gen.nemesis(pkg["final-generator"]),
+    )
+    return test
+
+
+def _add_opts(p):
+    p.add_argument("--workload", choices=sorted(WORKLOADS), default="bank")
+    p.add_argument("--version", default="23.1.11")
+    p.add_argument("--faults", default="partition,kill")
+    p.add_argument("--nemesis-interval", type=int, default=10)
+
+
+def main(argv=None):
+    cli.main_exit(cli.single_test_cmd(test_fn, add_opts=_add_opts), argv)
+
+
+if __name__ == "__main__":
+    main()

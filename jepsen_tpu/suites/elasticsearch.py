@@ -1,0 +1,137 @@
+"""Elasticsearch set suite.
+
+Mirrors the reference elasticsearch suite (elasticsearch/ 929 LoC:
+set + dirty-read workloads): insert unique documents over the HTTP API,
+then a final refresh + search counts survivors — the `set` checker
+reports lost and never-acknowledged elements. Partitions are the classic
+way Elasticsearch loses inserts.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.request
+from typing import Any, Optional
+
+from .. import checker as jchecker
+from .. import cli, client as jclient, db as jdb, generator as gen
+from .. import nemesis as jnemesis, net as jnet
+from ..control import util as cu
+from .. import control as c
+
+PORT = 9200
+INDEX = "jepsen"
+
+
+class SetClient(jclient.Client, jclient.Reusable):
+    def __init__(self, base: Optional[str] = None, timeout: float = 10.0):
+        self.base = base
+        self.timeout = timeout
+
+    def open(self, test, node):
+        return SetClient(f"http://{node}:{PORT}", self.timeout)
+
+    def _req(self, method: str, path: str, body: Optional[dict] = None):
+        req = urllib.request.Request(
+            self.base + path,
+            data=None if body is None else json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"},
+            method=method)
+        with urllib.request.urlopen(req, timeout=self.timeout) as r:
+            return json.loads(r.read().decode() or "{}")
+
+    def invoke(self, test, op):
+        if op["f"] == "add":
+            # wait_for makes the write durable enough to be acknowledged.
+            self._req("PUT",
+                      f"/{INDEX}/_doc/{op['value']}?refresh=wait_for",
+                      {"v": op["value"]})
+            return {**op, "type": "ok"}
+        if op["f"] == "read":
+            try:
+                self._req("POST", f"/{INDEX}/_refresh")
+                res = self._req(
+                    "GET", f"/{INDEX}/_search?size=10000",
+                    {"query": {"match_all": {}}})
+                hits = res.get("hits", {}).get("hits", [])
+                vals = sorted(h["_source"]["v"] for h in hits)
+                return {**op, "type": "ok", "value": vals}
+            except Exception:
+                return {**op, "type": "fail", "error": "http"}
+        raise ValueError(f"unknown f {op['f']!r}")
+
+
+class ElasticsearchDB(jdb.DB, jdb.Process, jdb.LogFiles):
+    LOG = "/var/log/elasticsearch/jepsen.log"
+
+    def setup(self, test, node):
+        from ..os_ import debian
+
+        debian.install(["elasticsearch"])
+        hosts = json.dumps(test["nodes"])
+        with c.su():
+            c.exec_star(
+                "cat > /etc/elasticsearch/elasticsearch.yml <<'JEPSEN_EOF'\n"
+                "cluster.name: jepsen\n"
+                f"node.name: {node}\n"
+                "network.host: 0.0.0.0\n"
+                f"discovery.seed_hosts: {hosts}\n"
+                f"cluster.initial_master_nodes: {hosts}\n"
+                "xpack.security.enabled: false\n"
+                "JEPSEN_EOF")
+        self.start(test, node)
+
+    def start(self, test, node):
+        with c.su():
+            c.exec("service", "elasticsearch", "start")
+
+    def kill(self, test, node):
+        cu.grepkill("org.elasticsearch")
+
+    def teardown(self, test, node):
+        with c.su():
+            c.exec_star("service elasticsearch stop || true")
+            c.exec("rm", "-rf", "/var/lib/elasticsearch/nodes")
+
+    def log_files(self, test, node):
+        return [self.LOG]
+
+
+def test_fn(opts: dict) -> dict:
+    import itertools
+
+    ids = itertools.count()
+
+    def add(test=None, ctx=None):
+        return {"type": "invoke", "f": "add", "value": next(ids)}
+
+    return {
+        "name": "elasticsearch-set",
+        "db": ElasticsearchDB(),
+        "net": jnet.iptables(),
+        "nemesis": jnemesis.partition_random_halves(),
+        "client": SetClient(),
+        "checker": jchecker.compose({
+            "set": jchecker.set_checker(),
+            "stats": jchecker.stats(),
+        }),
+        "generator": gen.phases(
+            gen.nemesis(
+                gen.cycle_([gen.sleep(10), {"type": "info", "f": "start"},
+                            gen.sleep(10), {"type": "info", "f": "stop"}]),
+                gen.time_limit(opts.get("time_limit", 60),
+                               gen.clients(gen.stagger(0.05, add))),
+            ),
+            gen.nemesis([{"type": "info", "f": "stop"}]),
+            gen.clients(gen.once({"type": "invoke", "f": "read",
+                                  "value": None})),
+        ),
+    }
+
+
+def main(argv=None):
+    cli.main_exit(cli.single_test_cmd(test_fn), argv)
+
+
+if __name__ == "__main__":
+    main()

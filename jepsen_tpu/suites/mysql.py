@@ -1,0 +1,192 @@
+"""MySQL/Galera dirty-reads suite.
+
+Mirrors the reference galera/percona suites (galera/ 529 LoC, percona/
+509 LoC; SURVEY §2.6): concurrent single-row update transactions plus
+full-table reads, checked for *dirty reads* — a read observing a value
+no committed transaction wrote. The client drives the ``mysql`` CLI on
+the node (the reference uses JDBC; the CLI keeps us driver-free).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from .. import checker as jchecker
+from .. import cli, client as jclient, db as jdb, generator as gen
+from .. import nemesis as jnemesis, net as jnet
+from ..checker import Checker, checker_fn
+from ..control import util as cu
+from .. import control as c
+
+TABLE = "jepsen.dirty"
+
+
+class DirtyReadsClient(jclient.Client):
+    """galera/dirty_reads.clj semantics: writers set every row to their
+    (unique) write id in one txn; readers select all rows. A read
+    containing a MIX of write ids (or an unacknowledged id) saw
+    uncommitted state."""
+
+    def __init__(self, node: Any = None, user: str = "root"):
+        self.node = node
+        self.user = user
+
+    def open(self, test, node):
+        return DirtyReadsClient(node, self.user)
+
+    def setup(self, test):
+        n = int(test.get("row-count") or 10)
+        rows = ", ".join(f"({i}, 0)" for i in range(n))
+        self._sql(test,
+                  "CREATE DATABASE IF NOT EXISTS jepsen;\n"
+                  f"CREATE TABLE IF NOT EXISTS {TABLE} "
+                  "(id INT PRIMARY KEY, x BIGINT NOT NULL);\n"
+                  f"INSERT IGNORE INTO {TABLE} VALUES {rows};")
+
+    def _sql(self, test, script: str) -> str:
+        def run(t, node):
+            return c.exec_star(
+                f"mysql -u {c.escape(self.user)} --batch --silent "
+                f"<<'JEPSEN_SQL'\n{script}\nJEPSEN_SQL")
+
+        return c.on_nodes(test, run, [self.node])[self.node]
+
+    def invoke(self, test, op):
+        if op["f"] == "read":
+            out = self._sql(test, f"SELECT x FROM {TABLE};")
+            vals = [int(l) for l in out.strip().split("\n") if l.strip()]
+            return {**op, "type": "ok", "value": vals}
+        wid = op["value"]
+        try:
+            self._sql(test, "\n".join([
+                "SET SESSION TRANSACTION ISOLATION LEVEL SERIALIZABLE;",
+                "START TRANSACTION;",
+                f"UPDATE {TABLE} SET x = {wid};",
+                "COMMIT;",
+            ]))
+            return {**op, "type": "ok"}
+        except c.RemoteError as e:
+            if "Deadlock" in str(e) or "lock wait" in str(e).lower():
+                return {**op, "type": "fail", "error": "conflict"}
+            raise
+
+
+def dirty_reads_checker() -> Checker:
+    """A read must observe ONE write id across all rows (each writer sets
+    every row atomically), and that id must belong to an attempted write
+    (galera dirty-reads checker semantics)."""
+
+    def chk(test, history, opts):
+        attempted = {0}
+        acked = {0}
+        failed = set()
+        for op in history:
+            if op.f == "write":
+                if op.is_invoke:
+                    attempted.add(op.value)
+                elif op.is_ok:
+                    acked.add(op.value)
+                elif op.is_fail:
+                    failed.add(op.value)
+        dirty = []
+        torn = []
+        for op in history:
+            if op.f != "read" or not op.is_ok:
+                continue
+            vals = set(op.value or [])
+            if len(vals) > 1:
+                torn.append({"op": repr(op), "values": sorted(vals)})
+            for v in vals:
+                # Dirty: from a write that definitely did not commit
+                # (:fail), or from no write at all. Indeterminate (:info)
+                # writes are legitimate sources.
+                if v in failed or v not in attempted:
+                    dirty.append({"op": repr(op), "value": v})
+        return {
+            "valid": not dirty and not torn,
+            "dirty_reads": dirty,
+            "torn_reads": torn,
+            "acknowledged_writes": len(acked) - 1,
+        }
+
+    return checker_fn(chk, "dirty-reads")
+
+
+class MariaGaleraDB(jdb.DB, jdb.Process, jdb.LogFiles):
+    """Galera cluster over the distro's mariadb packages (galera/db.clj
+    pattern: package install + wsrep cluster address + bootstrap on the
+    first node)."""
+
+    LOG = "/var/log/mysql/error.log"
+
+    def setup(self, test, node):
+        from ..os_ import debian
+
+        debian.install(["mariadb-server", "galera-4"])
+        nodes = ",".join(test["nodes"])
+        with c.su():
+            c.exec_star(
+                "cat > /etc/mysql/conf.d/galera.cnf <<'JEPSEN_EOF'\n"
+                "[mysqld]\n"
+                "wsrep_on=ON\n"
+                "wsrep_provider=/usr/lib/galera/libgalera_smm.so\n"
+                f"wsrep_cluster_address=gcomm://{nodes}\n"
+                "binlog_format=row\n"
+                "bind-address=0.0.0.0\n"
+                "JEPSEN_EOF")
+        self.start(test, node)
+
+    def start(self, test, node):
+        with c.su():
+            if node == test["nodes"][0]:
+                c.exec_star("galera_new_cluster || service mysql start")
+            else:
+                c.exec("service", "mysql", "start")
+
+    def kill(self, test, node):
+        cu.grepkill("mariadbd")
+
+    def teardown(self, test, node):
+        with c.su():
+            c.exec_star("service mysql stop || true")
+
+    def log_files(self, test, node):
+        return [self.LOG]
+
+
+def test_fn(opts: dict) -> dict:
+    counter = [0]
+
+    def write(test=None, ctx=None):
+        counter[0] += 1
+        return {"type": "invoke", "f": "write", "value": counter[0]}
+
+    def read(test=None, ctx=None):
+        return {"type": "invoke", "f": "read", "value": None}
+
+    return {
+        "name": "galera-dirty-reads",
+        "row-count": int(opts.get("row_count") or 10),
+        "db": MariaGaleraDB(),
+        "net": jnet.iptables(),
+        "nemesis": jnemesis.partition_random_halves(),
+        "client": DirtyReadsClient(),
+        "checker": jchecker.compose({
+            "dirty-reads": dirty_reads_checker(),
+            "stats": jchecker.stats(),
+        }),
+        "generator": gen.nemesis(
+            gen.cycle_([gen.sleep(10), {"type": "info", "f": "start"},
+                        gen.sleep(10), {"type": "info", "f": "stop"}]),
+            gen.time_limit(opts.get("time_limit", 60),
+                           gen.mix([read, write])),
+        ),
+    }
+
+
+def main(argv=None):
+    cli.main_exit(cli.single_test_cmd(test_fn), argv)
+
+
+if __name__ == "__main__":
+    main()

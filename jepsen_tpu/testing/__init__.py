@@ -500,3 +500,51 @@ def perturb_history(rng: random.Random, history: History) -> History:
     op = ops[i]
     ops[i] = op.with_(value=(op.value if op.value is None else op.value + 17) or 23)
     return History(ops, reindex=False)
+
+
+def random_lock_history(
+    rng: random.Random,
+    n_ops: int = 200,
+    n_procs: int = 4,
+) -> History:
+    """Simulate concurrent processes against an atomic lock service
+    (owner-aware mutex semantics: acquire fails when held, release fails
+    unless you hold it). Linearizable by construction — each op takes
+    effect atomically inside its interval."""
+    owner: Optional[int] = None
+    ops: list[Op] = []
+    t = 0
+    pending: dict[int, Optional[tuple]] = {p: None for p in range(n_procs)}
+
+    def now() -> int:
+        nonlocal t
+        t += rng.randint(1, 5)
+        return t
+
+    emitted = 0
+    while emitted < n_ops or any(v is not None for v in pending.values()):
+        p = rng.randrange(n_procs)
+        slot = pending[p]
+        if slot is None:
+            if emitted >= n_ops:
+                continue
+            f = rng.choice(["acquire", "release"])
+            ops.append(Op("invoke", p, f, None, time=now()))
+            pending[p] = (f,)
+            emitted += 1
+        else:
+            (f,) = slot
+            pending[p] = None
+            if f == "acquire":
+                if owner is None:
+                    owner = p
+                    ops.append(Op("ok", p, f, None, time=now()))
+                else:
+                    ops.append(Op("fail", p, f, None, time=now()))
+            else:
+                if owner == p:
+                    owner = None
+                    ops.append(Op("ok", p, f, None, time=now()))
+                else:
+                    ops.append(Op("fail", p, f, None, time=now()))
+    return History(ops, reindex=True)

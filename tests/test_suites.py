@@ -300,3 +300,201 @@ class TestRedisSuite:
         finally:
             srv.shutdown()
             srv.server_close()
+
+
+class TestMysqlDirtyReads:
+    def test_checker(self):
+        from jepsen_tpu.history import History, Op
+        from jepsen_tpu.suites.mysql import dirty_reads_checker
+
+        def o(f, value, typ, p=0):
+            return Op.from_dict({"type": typ, "process": p, "f": f,
+                                 "value": value, "time": 0})
+
+        clean = History([
+            o("write", 1, "invoke"), o("write", 1, "ok"),
+            o("read", [1, 1, 1], "ok", p=1),
+        ], reindex=True)
+        assert dirty_reads_checker().check({}, clean, {})["valid"] is True
+        torn = History([
+            o("write", 1, "invoke"), o("write", 1, "ok"),
+            o("write", 2, "invoke"), o("write", 2, "ok"),
+            o("read", [1, 2, 2], "ok", p=1),
+        ], reindex=True)
+        res = dirty_reads_checker().check({}, torn, {})
+        assert res["valid"] is False and res["torn_reads"]
+        phantom = History([
+            o("read", [7, 7], "ok", p=1),
+        ], reindex=True)
+        res = dirty_reads_checker().check({}, phantom, {})
+        assert res["valid"] is False and res["dirty_reads"]
+        # A read observing a definitely-failed write is dirty.
+        failed_seen = History([
+            o("write", 3, "invoke"), o("write", 3, "fail"),
+            o("read", [3, 3], "ok", p=1),
+        ], reindex=True)
+        res = dirty_reads_checker().check({}, failed_seen, {})
+        assert res["valid"] is False and res["dirty_reads"]
+        # An indeterminate (:info) write is a legitimate source.
+        info_seen = History([
+            o("write", 4, "invoke"), o("write", 4, "info"),
+            o("read", [4, 4], "ok", p=1),
+        ], reindex=True)
+        assert dirty_reads_checker().check({}, info_seen, {})["valid"] is True
+
+
+class TestCockroachSuite:
+    def test_bank_sql_generation(self):
+        from jepsen_tpu.suites import cockroachdb as crdb
+
+        test = dict(noop_test())
+        test.update(nodes=["n1"], accounts=[0, 1], **{"total-amount": 20},
+                    **{"max-transfer": 5})
+        log: list = []
+        c.setup_sessions(test, c.dummy(log, responses={
+            r"SELECT id, balance": "id\tbalance\n0\t10\n1\t10\n"}))
+        client = crdb.BankClient().open(test, "n1")
+        client.setup(test)
+        res = client.invoke(test, {"type": "invoke", "f": "read",
+                                   "value": None, "process": 0})
+        assert res["type"] == "ok" and res["value"] == {0: 10, 1: 10}
+        client.invoke(test, {"type": "invoke", "f": "transfer", "process": 0,
+                             "value": {"from": 0, "to": 1, "amount": 3}})
+        cmds = [cmd for _n, cmd in log]
+        assert any("CREATE TABLE IF NOT EXISTS jepsen_bank" in cmd
+                   for cmd in cmds)
+        assert any("balance - 3" in cmd and "COMMIT" in cmd for cmd in cmds)
+
+
+class EsStub(BaseHTTPRequestHandler):
+    """Just enough of the ES HTTP API: PUT doc, POST refresh, GET search."""
+
+    store: dict = {}
+    lock = threading.Lock()
+
+    def log_message(self, *a):
+        pass
+
+    def _reply(self, obj, code=200):
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_PUT(self):
+        doc_id = self.path.split("/_doc/")[1].split("?")[0]
+        length = int(self.headers.get("Content-Length") or 0)
+        body = json.loads(self.rfile.read(length).decode())
+        with self.lock:
+            self.store[doc_id] = body
+        self._reply({"result": "created"})
+
+    def do_POST(self):
+        self._reply({})  # refresh
+
+    def do_GET(self):
+        with self.lock:
+            hits = [{"_source": v} for v in self.store.values()]
+        self._reply({"hits": {"hits": hits}})
+
+
+class TestElasticsearchSuite:
+    def test_set_workload_against_stub(self, http_stub, tmp_path):
+        from jepsen_tpu.suites import elasticsearch as es_suite
+
+        http_stub(EsStub, es_suite, "PORT")
+        test = es_suite.test_fn({"time_limit": 1})
+        from jepsen_tpu.workloads import AtomDB, AtomState
+
+        test.update(nodes=["127.0.0.1"], concurrency=3,
+                    db=AtomDB(AtomState()), net=None, nemesis=None,
+                    **{"store-root": str(tmp_path)})
+        # Strip the nemesis track (no net in the stub run).
+        import itertools
+
+        ids = itertools.count()
+
+        def add(t=None, ctx=None):
+            return {"type": "invoke", "f": "add", "value": next(ids)}
+
+        test["generator"] = gen.phases(
+            gen.clients(gen.limit(25, add)),
+            gen.clients(gen.once({"type": "invoke", "f": "read",
+                                  "value": None})),
+        )
+        res = core.run(test)
+        assert res["results"]["valid"] is True
+        assert res["results"]["set"]["ok_count"] == 25
+
+
+class TestReconnect:
+    def test_failure_rethrows_and_reopens(self):
+        """A failed op RETHROWS (never silently re-executed — ops are
+        non-idempotent); the connection is fresh for the next call."""
+        from jepsen_tpu import reconnect
+
+        opens = [0]
+        closes = [0]
+
+        class Conn:
+            def __init__(self):
+                self.dead = False
+
+        def open():
+            opens[0] += 1
+            return Conn()
+
+        w = reconnect.wrapper(open, close=lambda c_: closes.__setitem__(
+            0, closes[0] + 1))
+        conn1 = {}
+
+        def use(c_):
+            conn1["c"] = c_
+            return "ok"
+
+        assert w.with_conn(use) == "ok"
+        assert opens[0] == 1
+        conn1["c"].dead = True
+        calls = [0]
+
+        def use2(c_):
+            calls[0] += 1
+            if c_.dead:
+                raise RuntimeError("dead")
+            return "recovered"
+
+        import pytest as _pytest
+
+        with _pytest.raises(RuntimeError):
+            w.with_conn(use2)
+        assert calls[0] == 1  # NOT re-executed
+        assert opens[0] == 2  # but reopened for the next user
+        assert w.with_conn(use2) == "recovered"
+        w.close()
+        assert closes[0] >= 2
+
+
+class TestTrace:
+    def test_spans_and_export(self, tmp_path):
+        from jepsen_tpu import trace
+        from jepsen_tpu.workloads import atom_client, AtomState
+
+        col = trace.Collector()
+        client = trace.tracing(atom_client(AtomState()), col)
+        client = client.open({}, "n1")
+        client.invoke({}, {"f": "write", "value": 3, "process": 0,
+                           "type": "invoke"})
+        client.invoke({}, {"f": "read", "value": None, "process": 0,
+                           "type": "invoke"})
+        client.close({})
+        names = [s["name"] for s in col.spans]
+        assert names.count("client.invoke") == 2
+        assert "client.open" in names
+        inv = [s for s in col.spans if s["name"] == "client.invoke"]
+        assert inv[0]["type"] == "ok"
+        assert all(s["duration_us"] >= 0 for s in col.spans)
+        out = tmp_path / "spans.jsonl"
+        n = col.export_jsonl(out)
+        assert n == len(col.spans)
+        assert len(out.read_text().strip().split("\n")) == n
