@@ -218,7 +218,10 @@ def _build_kernel(model_key, F: int, W: int, KO: int, S: int, ND: int, NO: int):
             rows = p[:, None] + slots[None, :]  # [F, W]
             in_rng = rows < nD
             rc = jnp.minimum(rows, ND - 1)
-            win = tabD[rc]  # [F, W, 8] — the level's single dynamic gather
+            # The level's single dynamic gather; int16 tables (when every
+            # value fits) halve its bytes — the gather dominates level
+            # cost at large capacities.
+            win = tabD[rc].astype(jnp.int32)  # [F, W, 8]
             invw = jnp.where(in_rng, win[..., 0], INT32_MAX)
             retw = jnp.where(in_rng, win[..., 1], INT32_MAX)
             bits = (jnp.repeat(mD, 32, axis=1)[:, :W] >> bit_of_slot[None, :]) & u32(1)
@@ -470,6 +473,8 @@ def _build_batch_kernel(model_key, F: int, W: int, KO: int, S: int, ND: int, NO:
     mesh by placing the batch axis on the mesh's data axis."""
     import jax
 
+    # jit retraces per input dtype, so int16 vs int32 tables need no
+    # separate build.
     raw, _ = _build_kernel(model_key, F, W, KO, S, ND, NO)
     return jax.jit(jax.vmap(raw))
 
@@ -521,15 +526,18 @@ class DevicePlan:
     (jepsen_tpu.parallel) and the graft entry point.
     """
 
-    __slots__ = ("dims", "args", "nD", "nO", "init_state", "reason")
+    __slots__ = ("dims", "args", "nD", "nO", "init_state", "reason",
+                 "tab16")
 
-    def __init__(self, dims, args, nD, nO, init_state=None, reason=None):
+    def __init__(self, dims, args, nD, nO, init_state=None, reason=None,
+                 tab16=False):
         self.dims = dims
         self.args = args
         self.nD = nD
         self.nO = nO
         self.init_state = init_state
         self.reason = reason
+        self.tab16 = tab16
 
     @property
     def ok(self) -> bool:
@@ -601,14 +609,18 @@ def plan_device(
         sufret[:nD] = np.minimum.accumulate(retD[::-1])[::-1]
 
     # Pack the five determinate-op tables into one [ND, 8] array so each
-    # BFS level costs ONE dynamic gather (TPU gathers are latency-bound,
-    # ~0.3 ms regardless of payload width).
-    tabD = np.zeros((ND, 8), dtype=np.int32)
-    tabD[:, 0] = padD(invD)
-    tabD[:, 1] = padD(retD)
-    tabD[:, 2] = padD(opD)
-    tabD[:, 3] = padD(a1D)
-    tabD[:, 4] = padD(a2D)
+    # BFS level costs ONE dynamic gather; when every value fits int16 the
+    # table is stored as int16 (half the gather bytes — the gather
+    # dominates level cost at large capacities; the kernel widens to
+    # int32 after the gather).
+    cols = [padD(invD), padD(retD), padD(opD), padD(a1D), padD(a2D)]
+    tab16 = all(
+        c.size == 0 or (c.min() >= -32768 and c.max() <= 32767)
+        for c in cols
+    )
+    tabD = np.zeros((ND, 8), dtype=np.int16 if tab16 else np.int32)
+    for i, col in enumerate(cols):
+        tabD[:, i] = col
 
     args = (
         np.int32(nD),
@@ -622,7 +634,8 @@ def plan_device(
         padO(a2O),
     )
     return DevicePlan(
-        (W, KO, S, ND, NO), args, nD, nO, init_state=enc.init_state.astype(np.int32)
+        (W, KO, S, ND, NO), args, nD, nO,
+        init_state=enc.init_state.astype(np.int32), tab16=tab16
     )
 
 
@@ -684,15 +697,20 @@ def check_encoded_device(
         beam_cap = None
     if optimistic and beam_cap is not None:
         beam_sched = [f for f in schedule if f <= beam_cap] or [beam_cap]
-        res = _device_search(enc, plan, beam_sched, levels_per_call, t0)
+        checkpoint: dict = {}
+        res = _device_search(enc, plan, beam_sched, levels_per_call, t0,
+                             checkpoint=checkpoint)
         if res["valid"] is True:
             res["phase"] = "optimistic-beam"
             return res
         if res["valid"] is False and not res.get("beam"):
             return res  # refuted without ever truncating: sound
-        # Beam exhausted under truncation: exhaustive phase.
+        # Beam exhausted under truncation: exhaustive phase, resumed from
+        # the beam's last LOSSLESS frontier (everything before the first
+        # truncation is exact, so those levels need no re-search).
         full = _device_search(enc, plan, schedule, levels_per_call,
-                              _time.perf_counter())
+                              _time.perf_counter(),
+                              resume_from=checkpoint or None)
         full["wall_s"] = _time.perf_counter() - t0
         full["optimistic_attempts"] = res.get("attempts")
         return full
@@ -700,9 +718,15 @@ def check_encoded_device(
 
 
 def _device_search(enc: EncodedHistory, plan: DevicePlan, schedule: list,
-                   levels_per_call: Optional[int], t0: float) -> dict:
+                   levels_per_call: Optional[int], t0: float,
+                   checkpoint: Optional[dict] = None,
+                   resume_from: Optional[dict] = None) -> dict:
     """One escalating/de-escalating frontier search over ``schedule``;
-    the top capacity continues past overflow as a greedy beam."""
+    the top capacity continues past overflow as a greedy beam.
+
+    ``checkpoint`` (out): receives {"fr", "F"} — the entry frontier of
+    the first chunk that truncated (the last lossless state).
+    ``resume_from``: such a dict to start from instead of level 0."""
     n = enc.n
     W, KO, S, ND, NO = plan.dims
     total_levels = int(plan.args[2])
@@ -736,8 +760,16 @@ def _device_search(enc: EncodedHistory, plan: DevicePlan, schedule: list,
                 return F
         return schedule[-1]
 
-    F = schedule[0]
-    fr = initial_frontier(F, W, KO, S, plan.init_state)
+    if resume_from:
+        # Restart from a lossless checkpoint frontier (the optimistic
+        # beam's state just before its first truncation).
+        ck_fr = resume_from["fr"]
+        F = next((f for f in schedule if f >= ck_fr[0].shape[0]),
+                 schedule[-1])
+        fr = _pad_frontier(ck_fr, F) if ck_fr[0].shape[0] < F else ck_fr
+    else:
+        F = schedule[0]
+        fr = initial_frontier(F, W, KO, S, plan.init_state)
     # Beam (lossy) mode is active ONLY at the top capacity: there is no
     # lossless escalation left, so on overflow the kernel keeps the best F
     # configs and continues. `truncated` records whether any level actually
@@ -757,6 +789,7 @@ def _device_search(enc: EncodedHistory, plan: DevicePlan, schedule: list,
         lvl0 = int(fr[-1])
         budget = np.int32(min(total_levels, lvl0 + lpc))
         lossy = F == schedule[-1]
+        entry_fr = fr  # entry state: lossless while `truncated` is False
         call_args = plan.args[:2] + (budget,) + plan.args[3:]
         out = [np.asarray(x) for x in kern(*call_args, *fr, np.int32(lossy))]
         acc, ovf, nonempty, lvl, fmax = out[:5]
@@ -766,6 +799,9 @@ def _device_search(enc: EncodedHistory, plan: DevicePlan, schedule: list,
         attempt["calls"] += 1
         attempt["wall_s"] = round(attempt["wall_s"] + _time.perf_counter() - t_call, 3)
         if lossy and bool(ovf):
+            if not truncated and checkpoint is not None:
+                checkpoint["fr"] = entry_fr
+                checkpoint["F"] = F
             truncated = True
         if bool(acc):
             # Sound even after truncation: dropping configs only removes

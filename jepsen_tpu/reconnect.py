@@ -124,6 +124,9 @@ class Wrapper:
                 self._rw.acquire_read()
                 holding = True
                 conn = self._conn
+                if conn is None:
+                    raise RuntimeError(
+                        f"connection {self.name!r} closed while opening")
             return f(conn)
         except Exception:
             if holding:

@@ -12,11 +12,10 @@ indeterminate.
 from __future__ import annotations
 
 import json
-from typing import Any, Optional
+from typing import Any
 
-from .. import checker as jchecker
 from .. import cli, client as jclient, db as jdb, generator as gen
-from .. import nemesis as jnemesis, net as jnet
+from .. import net as jnet
 from ..control import util as cu
 from ..nemesis import combined as ncombined
 from ..workloads import append as wa
@@ -114,8 +113,11 @@ class AppendClient(_SqlClient):
             if "restart transaction" in str(e):
                 return {**op, "type": "fail", "error": "serialization"}
             raise
+        # Non-interactive `cockroach sql` prints statement tags (BEGIN,
+        # INSERT ...) and column headers; only JSON-array lines are read
+        # results.
         lines = [l for l in out.strip().split("\n")
-                 if l and not l.startswith(("coalesce", "v"))]
+                 if l.strip().startswith("[")]
         done = []
         ri = 0
         for f, k, v in op["value"]:
@@ -155,10 +157,15 @@ class CockroachDB(jdb.DB, jdb.Process, jdb.LogFiles):
         if node == test["nodes"][0]:
             try:
                 c.exec_star(
-                    f"{self.DIR}/cockroach init --insecure "
-                    f"--host={node} || true")
-            except c.RemoteError:
-                pass
+                    f"{self.DIR}/cockroach init --insecure --host={node}")
+            except c.RemoteError as e:
+                # Re-init of an initialized cluster is expected; anything
+                # else should be visible in the logs.
+                if "already" not in str(e):
+                    import logging
+
+                    logging.getLogger("jepsen.cockroachdb").warning(
+                        "cockroach init failed: %s", e)
 
     def kill(self, test, node):
         cu.grepkill("cockroach")

@@ -11,7 +11,7 @@ from __future__ import annotations
 
 import json
 import urllib.request
-from typing import Any, Optional
+from typing import Optional
 
 from .. import checker as jchecker
 from .. import cli, client as jclient, db as jdb, generator as gen
@@ -50,12 +50,23 @@ class SetClient(jclient.Client, jclient.Reusable):
         if op["f"] == "read":
             try:
                 self._req("POST", f"/{INDEX}/_refresh")
-                res = self._req(
-                    "GET", f"/{INDEX}/_search?size=10000",
-                    {"query": {"match_all": {}}})
-                hits = res.get("hits", {}).get("hits", [])
-                vals = sorted(h["_source"]["v"] for h in hits)
-                return {**op, "type": "ok", "value": vals}
+                vals = []
+                search_after = None
+                while True:
+                    body = {"query": {"match_all": {}},
+                            "sort": [{"v": "asc"}], "size": 10000}
+                    if search_after is not None:
+                        body["search_after"] = search_after
+                    res = self._req("GET", f"/{INDEX}/_search", body)
+                    hits = res.get("hits", {}).get("hits", [])
+                    if not hits:
+                        break
+                    vals.extend(h["_source"]["v"] for h in hits)
+                    sort_vals = hits[-1].get("sort")
+                    if len(hits) < 10000 or not sort_vals:
+                        break
+                    search_after = sort_vals
+                return {**op, "type": "ok", "value": sorted(vals)}
             except Exception:
                 return {**op, "type": "fail", "error": "http"}
         raise ValueError(f"unknown f {op['f']!r}")

@@ -9,7 +9,7 @@ the node (the reference uses JDBC; the CLI keeps us driver-free).
 
 from __future__ import annotations
 
-from typing import Any, Optional
+from typing import Any
 
 from .. import checker as jchecker
 from .. import cli, client as jclient, db as jdb, generator as gen
