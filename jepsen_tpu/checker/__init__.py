@@ -194,10 +194,11 @@ def linearizable(options: Optional[dict] = None, **kw) -> Checker:
     ``options`` / kwargs:
 
     - ``model``: a `jepsen_tpu.models.Model` (required).
-    - ``backend``: "auto" (default) | "device" | "host" — overridden by the
-      test map's ``checker_backend`` when present (the BASELINE
-      ``:checker-backend :tpu`` dispatch; "tpu" is accepted as an alias for
-      "device").
+    - ``backend``: "auto" (default) | "device" | "host" | "native" —
+      overridden by the test map's ``checker_backend`` when present (the
+      BASELINE ``:checker-backend :tpu`` dispatch; "tpu" is accepted as
+      an alias for "device"). "auto" prefers the native C search for
+      single histories and the device kernel for batches.
 
     Mirrors checker.clj:182-213 (including truncating bulky diagnostics).
     """

@@ -36,14 +36,34 @@ def _mpl():
     return plt
 
 
-def _shade_nemesis(ax, history: History) -> None:
-    """perf.clj:184-326 — translucent spans while the nemesis is active."""
+def _shade_nemesis(ax, history: History, test: Optional[dict] = None
+                   ) -> None:
+    """perf.clj:184-326 — translucent spans while the nemesis is active.
+
+    Honors the nemesis packages' perf specs (combined.clj perf entries:
+    {"name", "start": fs, "stop": fs, "color"}) via
+    ``test["plot"]["nemeses"]``; falls back to the default start/stop
+    pairing."""
     try:
         t_end = max((op.time for op in history if op.time >= 0), default=0)
-        for start, stop in nemesis_intervals(history):
-            t0 = start.time / 1e9
-            t1 = (stop.time if stop is not None else t_end) / 1e9
-            ax.axvspan(t0, t1, color="#f3c3c3", alpha=0.4, lw=0)
+        specs = ((test or {}).get("plot") or {}).get("nemeses")
+        if specs:
+            for spec in specs:
+                stop_set = frozenset(spec.get("stop", ("stop",)))
+                pairing = {start_f: stop_set
+                           for start_f in spec.get("start", ())}
+                if not pairing:
+                    continue
+                for start, stop in nemesis_intervals(history, pairing):
+                    t0 = start.time / 1e9
+                    t1 = (stop.time if stop is not None else t_end) / 1e9
+                    ax.axvspan(t0, t1, color=spec.get("color", "#f3c3c3"),
+                               alpha=0.35, lw=0)
+        else:
+            for start, stop in nemesis_intervals(history):
+                t0 = start.time / 1e9
+                t1 = (stop.time if stop is not None else t_end) / 1e9
+                ax.axvspan(t0, t1, color="#f3c3c3", alpha=0.4, lw=0)
     except Exception:
         LOG.debug("nemesis shading failed", exc_info=True)
 
@@ -53,7 +73,7 @@ def point_graph(test: dict, history: History, path) -> None:
     (perf.clj:485-513)."""
     plt = _mpl()
     fig, ax = plt.subplots(figsize=(10, 5))
-    _shade_nemesis(ax, history)
+    _shade_nemesis(ax, history, test)
     by = {}
     for iv in history.pairs():
         if not isinstance(iv.process, int) or iv.inv_time < 0:
@@ -81,7 +101,7 @@ def quantiles_graph(test: dict, history: History, path) -> None:
     """Bucketed latency quantiles per f (perf.clj:514-559)."""
     plt = _mpl()
     fig, ax = plt.subplots(figsize=(10, 5))
-    _shade_nemesis(ax, history)
+    _shade_nemesis(ax, history, test)
     by_f: dict = {}
     for iv in history.pairs():
         if not isinstance(iv.process, int) or iv.inv_time < 0:
@@ -117,7 +137,7 @@ def rate_graph(test: dict, history: History, path) -> None:
     """Throughput per (f, type) in DT_S buckets (perf.clj:560-600)."""
     plt = _mpl()
     fig, ax = plt.subplots(figsize=(10, 5))
-    _shade_nemesis(ax, history)
+    _shade_nemesis(ax, history, test)
     by: dict = {}
     tmax = 0.0
     for op in history:

@@ -1,0 +1,78 @@
+"""Native (C) runtime components.
+
+The reference's heavy compute lives in native-adjacent runtimes (knossos
+on the JVM with 32 GB heaps, C clock tools, C++ CharybdeFS). This package
+holds the C equivalents compiled on demand with the system compiler:
+
+- ``wgl_native.c`` — the host-side WGL linearizability search (the third
+  implementation alongside the python oracle and the XLA device kernel,
+  differentially tested against both; used as the fast host fallback).
+
+Build: ``cc -O2 -shared -fPIC`` into ``~/.cache/jepsen_tpu_native/``,
+keyed by a hash of the source, loaded via ctypes. No toolchain → the
+callers fall back to the pure-python paths.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import logging
+import os
+import subprocess
+from pathlib import Path
+from typing import Optional
+
+LOG = logging.getLogger("jepsen.native")
+
+_SRC = Path(__file__).resolve().parent / "wgl_native.c"
+_lib = None
+_lib_tried = False
+
+
+def _build() -> Optional[ctypes.CDLL]:
+    src = _SRC.read_text()
+    digest = hashlib.sha256(src.encode()).hexdigest()[:16]
+    cache = Path(os.path.expanduser("~")) / ".cache" / "jepsen_tpu_native"
+    cache.mkdir(parents=True, exist_ok=True)
+    so = cache / f"wgl_native-{digest}.so"
+    if not so.exists():
+        tmp = so.with_suffix(".so.tmp")
+        cmd = ["cc", "-O2", "-shared", "-fPIC", "-o", str(tmp), str(_SRC)]
+        proc = subprocess.run(cmd, capture_output=True)
+        if proc.returncode != 0:
+            LOG.warning("native build failed: %s",
+                        proc.stderr.decode(errors="replace"))
+            return None
+        tmp.replace(so)
+    lib = ctypes.CDLL(str(so))
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    lib.wgl_check.argtypes = [
+        ctypes.c_int32, ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+        i32p, i32p, i32p, i32p, i32p,  # det tables
+        i32p,  # sufret
+        i32p, i32p, i32p, i32p,  # open tables
+        i32p,  # init state
+        ctypes.c_int32, ctypes.c_int64,  # model id, param
+        ctypes.c_int64,  # max configs
+        ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_int32),
+    ]
+    lib.wgl_check.restype = ctypes.c_int
+    lib.wgl_check_dfs.argtypes = lib.wgl_check.argtypes
+    lib.wgl_check_dfs.restype = ctypes.c_int
+    return lib
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """The compiled library, building it on first use; None when no
+    compiler is available."""
+    global _lib, _lib_tried
+    if not _lib_tried:
+        _lib_tried = True
+        try:
+            _lib = _build()
+        except Exception:
+            LOG.warning("native build errored", exc_info=True)
+            _lib = None
+    return _lib

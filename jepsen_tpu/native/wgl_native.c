@@ -1,0 +1,636 @@
+/* Native WGL linearizability search.
+ *
+ * The reference's compute kernel is knossos (JVM) — this is the
+ * native-runtime equivalent for the host side: a Wing & Gong / Lowe
+ * breadth-first search over (prefix, window-bitset, open-set,
+ * model-state) configurations, sharing the device kernel's
+ * representation (jepsen_tpu/ops/wgl.py docstring): determinate ops
+ * sorted by invocation, a prefix pointer p with a 64-bit window bitset,
+ * a 64-bit open-op set, and a fixed-width int state vector. Model
+ * transition functions mirror jepsen_tpu/models/{register,mutex}.py
+ * step_scalar exactly; differential tests pin all three implementations
+ * (python host / XLA device / native C) together.
+ *
+ * Compiled on demand by jepsen_tpu/native/__init__.py with cc; the ABI
+ * is a single entry point:
+ *
+ *   int wgl_check(args...) -> 1 accepted | 0 not linearizable |
+ *                             -1 budget exhausted | -2 unsupported
+ */
+
+#include <stdint.h>
+#include <stdlib.h>
+#include <string.h>
+
+#define S_MAX 8
+#define OPEN_SENTINEL 2147483647
+#define UNKNOWN_VAL (-2147483647 - 1)
+
+typedef struct {
+    int32_t p;
+    uint64_t win;
+    uint64_t open;
+    int32_t st[S_MAX];
+} cfg_t;
+
+/* ------------------------------------------------------------------ */
+/* Models (mirror models/register.py + models/mutex.py step_scalar).   */
+
+enum {
+    MODEL_CAS_REGISTER = 1,   /* also plain register */
+    MODEL_MUTEX = 2,
+    MODEL_OWNER_MUTEX = 3,
+    MODEL_REENTRANT_MUTEX = 4,
+    MODEL_FENCED_MUTEX = 5,
+    MODEL_REENTRANT_FENCED = 6,
+    MODEL_SEMAPHORE = 7
+};
+
+/* opcode constants shared with the python encoders */
+#define OP_READ 0
+#define OP_WRITE 1
+#define OP_CAS 2
+#define OP_ACQUIRE 0
+#define OP_RELEASE 1
+
+static int step_model(int model_id, int64_t param, const int32_t *st,
+                      int32_t op, int32_t a1, int32_t a2, int32_t *out) {
+    switch (model_id) {
+    case MODEL_CAS_REGISTER: {
+        int32_t v = st[0];
+        if (op == OP_READ) {
+            out[0] = v;
+            return a1 == UNKNOWN_VAL || v == a1;
+        }
+        if (op == OP_WRITE) {
+            out[0] = a1;
+            return 1;
+        }
+        /* cas */
+        if (v == a1) {
+            out[0] = a2;
+            return 1;
+        }
+        out[0] = v;
+        return 0;
+    }
+    case MODEL_MUTEX: {
+        int32_t locked = st[0];
+        if (op == OP_ACQUIRE) {
+            out[0] = 1;
+            return locked == 0;
+        }
+        out[0] = 0;
+        return locked == 1;
+    }
+    case MODEL_OWNER_MUTEX: {
+        int32_t owner = st[0];
+        if (op == OP_ACQUIRE) {
+            out[0] = a1 + 1;
+            return owner == 0;
+        }
+        out[0] = 0;
+        return owner == a1 + 1;
+    }
+    case MODEL_REENTRANT_MUTEX: {
+        int32_t depth = st[0];
+        if (op == OP_ACQUIRE) {
+            out[0] = depth + 1;
+            return depth < (int32_t)param;
+        }
+        out[0] = depth > 0 ? depth - 1 : 0;
+        return depth > 0;
+    }
+    case MODEL_FENCED_MUTEX: {
+        int32_t owner = st[0], last = st[1];
+        if (op == OP_ACQUIRE) {
+            out[0] = a1 + 1;
+            out[1] = (a2 == UNKNOWN_VAL) ? last : a2;
+            return owner == 0 && (a2 == UNKNOWN_VAL || a2 > last);
+        }
+        out[0] = 0;
+        out[1] = last;
+        return owner == a1 + 1;
+    }
+    case MODEL_REENTRANT_FENCED: {
+        /* state: owner+1, count, current fence, highest observed */
+        int32_t owner = st[0], count = st[1], cur = st[2], hof = st[3];
+        int32_t client = a1 + 1, f = a2;
+        if (op == OP_ACQUIRE) {
+            if (owner == 0) {
+                out[0] = client;
+                out[1] = 1;
+                out[2] = f;
+                out[3] = (f != UNKNOWN_VAL && f > hof) ? f : hof;
+                return f == UNKNOWN_VAL || f > hof;
+            }
+            if (owner != client || count >= 2) {
+                memcpy(out, st, sizeof(int32_t) * 4);
+                return 0;
+            }
+            if (cur == UNKNOWN_VAL) {
+                out[0] = client;
+                out[1] = count + 1;
+                out[2] = f;
+                out[3] = (f != UNKNOWN_VAL && f > hof) ? f : hof;
+                return f == UNKNOWN_VAL || f > hof;
+            }
+            if (f == UNKNOWN_VAL || f == cur) {
+                out[0] = client;
+                out[1] = count + 1;
+                out[2] = cur;
+                out[3] = hof;
+                return 1;
+            }
+            memcpy(out, st, sizeof(int32_t) * 4);
+            return 0;
+        }
+        /* release */
+        if (owner == 0 || owner != client) {
+            memcpy(out, st, sizeof(int32_t) * 4);
+            return 0;
+        }
+        if (count == 1) {
+            out[0] = 0;
+            out[1] = 0;
+            out[2] = UNKNOWN_VAL;
+            out[3] = hof;
+            return 1;
+        }
+        out[0] = owner;
+        out[1] = count - 1;
+        out[2] = cur;
+        out[3] = hof;
+        return 1;
+    }
+    case MODEL_SEMAPHORE: {
+        int32_t acq = st[0];
+        if (op == OP_ACQUIRE) {
+            out[0] = acq + a1;
+            return acq + a1 <= (int32_t)param;
+        }
+        out[0] = acq >= a1 ? acq - a1 : 0;
+        return acq >= a1;
+    }
+    }
+    return 0;
+}
+
+/* ------------------------------------------------------------------ */
+/* Config hash set: open addressing, linear probing.                  */
+
+typedef struct {
+    cfg_t *slots;
+    uint8_t *used;
+    size_t cap; /* power of two */
+    size_t count;
+} set_t;
+
+static uint64_t cfg_hash(const cfg_t *c, int S) {
+    uint64_t h = 1469598103934665603ULL;
+    const uint8_t *b = (const uint8_t *)c;
+    size_t len = sizeof(int32_t) + sizeof(uint64_t) * 2 +
+                 sizeof(int32_t) * (size_t)S;
+    /* hash p, win, open, st[0..S) — the struct layout places them first */
+    (void)len;
+    h = (h ^ (uint64_t)(uint32_t)c->p) * 1099511628211ULL;
+    h = (h ^ c->win) * 1099511628211ULL;
+    h = (h ^ c->open) * 1099511628211ULL;
+    for (int i = 0; i < S; i++)
+        h = (h ^ (uint64_t)(uint32_t)c->st[i]) * 1099511628211ULL;
+    (void)b;
+    return h;
+}
+
+static int cfg_eq(const cfg_t *a, const cfg_t *b, int S) {
+    if (a->p != b->p || a->win != b->win || a->open != b->open)
+        return 0;
+    return memcmp(a->st, b->st, sizeof(int32_t) * (size_t)S) == 0;
+}
+
+static int set_init(set_t *s, size_t cap) {
+    s->cap = cap;
+    s->count = 0;
+    s->slots = (cfg_t *)malloc(sizeof(cfg_t) * cap);
+    s->used = (uint8_t *)calloc(cap, 1);
+    return s->slots && s->used;
+}
+
+static void set_free(set_t *s) {
+    free(s->slots);
+    free(s->used);
+}
+
+static int set_grow(set_t *s, int S);
+
+/* returns 1 if inserted (new), 0 if already present, -1 on OOM */
+static int set_insert(set_t *s, const cfg_t *c, int S) {
+    if (s->count * 4 >= s->cap * 3) {
+        if (!set_grow(s, S))
+            return -1;
+    }
+    uint64_t h = cfg_hash(c, S);
+    size_t i = (size_t)(h & (s->cap - 1));
+    while (s->used[i]) {
+        if (cfg_eq(&s->slots[i], c, S))
+            return 0;
+        i = (i + 1) & (s->cap - 1);
+    }
+    s->used[i] = 1;
+    s->slots[i] = *c;
+    s->count++;
+    return 1;
+}
+
+static int set_grow(set_t *s, int S) {
+    set_t bigger;
+    if (!set_init(&bigger, s->cap * 2))
+        return 0;
+    for (size_t i = 0; i < s->cap; i++) {
+        if (!s->used[i]) continue;
+        uint64_t h = cfg_hash(&s->slots[i], S);
+        size_t j = (size_t)(h & (bigger.cap - 1));
+        while (bigger.used[j])
+            j = (j + 1) & (bigger.cap - 1);
+        bigger.used[j] = 1;
+        bigger.slots[j] = s->slots[i];
+        bigger.count++;
+    }
+    set_free(s);
+    *s = bigger;
+    return 1;
+}
+
+/* ------------------------------------------------------------------ */
+/* Open-set dominance prune (mirrors the device kernel's): among
+ * configs with equal (p, win, state), one whose open-set is a superset
+ * of another's is subsumed — open ops are never required, so fewer
+ * consumed opens dominates. Sort groups together, then drop entries
+ * whose open-set contains the group minimum (or their predecessor). */
+
+static int g_sort_S;
+
+static int cfg_cmp(const void *pa, const void *pb) {
+    const cfg_t *a = (const cfg_t *)pa, *b = (const cfg_t *)pb;
+    if (a->p != b->p)
+        return a->p < b->p ? -1 : 1;
+    if (a->win != b->win)
+        return a->win < b->win ? -1 : 1;
+    int c = memcmp(a->st, b->st, sizeof(int32_t) * (size_t)g_sort_S);
+    if (c)
+        return c;
+    if (a->open != b->open)
+        return a->open < b->open ? -1 : 1;
+    return 0;
+}
+
+static size_t dominance_prune(cfg_t *items, size_t len, int S) {
+    if (len < 2)
+        return len;
+    g_sort_S = S;
+    qsort(items, len, sizeof(cfg_t), cfg_cmp);
+    size_t out = 0;
+    uint64_t head_open = 0;
+    const cfg_t *group = NULL;
+    uint64_t prev_open = 0;
+    for (size_t i = 0; i < len; i++) {
+        cfg_t *c = &items[i];
+        int same = group && c->p == group->p && c->win == group->win &&
+                   memcmp(c->st, group->st,
+                          sizeof(int32_t) * (size_t)S) == 0;
+        if (!same) {
+            group = c;
+            head_open = c->open;
+            prev_open = c->open;
+            items[out++] = *c;
+            continue;
+        }
+        /* drop exact dups, supersets of the group head, and supersets
+         * of the previous (kept-or-dropped) entry — sound by induction */
+        if ((c->open & head_open) == head_open ||
+            (c->open & prev_open) == prev_open) {
+            prev_open = c->open;
+            continue;
+        }
+        prev_open = c->open;
+        items[out++] = *c;
+    }
+    return out;
+}
+
+/* ------------------------------------------------------------------ */
+/* The search.                                                         */
+
+typedef struct {
+    cfg_t *items;
+    size_t len, cap;
+} vec_t;
+
+static int vec_push(vec_t *v, const cfg_t *c) {
+    if (v->len == v->cap) {
+        size_t nc = v->cap ? v->cap * 2 : 1024;
+        cfg_t *ni = (cfg_t *)realloc(v->items, sizeof(cfg_t) * nc);
+        if (!ni)
+            return 0;
+        v->items = ni;
+        v->cap = nc;
+    }
+    v->items[v->len++] = *c;
+    return 1;
+}
+
+/* ------------------------------------------------------------------ */
+/* Depth-first search with memoization (Lowe / knossos-"linear" style):
+ * follow one linearization, backtracking on dead ends; the memo set
+ * guarantees each configuration is expanded at most once, so valid
+ * histories are near-linear (real-time candidate order first) and
+ * invalid ones terminate after covering the reachable space. */
+
+typedef struct {
+    cfg_t cfg;
+    int32_t next_j; /* next candidate slot to try: 0..wlim+nO */
+    int32_t min_ret;
+    int32_t wlim;
+} frame_t;
+
+int wgl_check_dfs(
+    int32_t nD, int32_t nO, int32_t S, int32_t W,
+    const int32_t *invD, const int32_t *retD, const int32_t *opD,
+    const int32_t *a1D, const int32_t *a2D,
+    const int32_t *sufret,
+    const int32_t *invO, const int32_t *opO,
+    const int32_t *a1O, const int32_t *a2O,
+    const int32_t *init_state,
+    int32_t model_id, int64_t model_param,
+    int64_t max_configs,
+    int64_t *configs_explored, int32_t *frontier_max,
+    int32_t *max_linearized) {
+    if (W > 64 || nO > 64 || S > S_MAX)
+        return -2;
+    *configs_explored = 0;
+    *frontier_max = 0;
+    *max_linearized = 0;
+    if (nD == 0)
+        return 1;
+
+    set_t seen;
+    if (!set_init(&seen, 1 << 12))
+        return -1;
+
+    size_t depth_cap = (size_t)nD + (size_t)nO + 2;
+    frame_t *stack = (frame_t *)malloc(sizeof(frame_t) * depth_cap);
+    if (!stack) {
+        set_free(&seen);
+        return -1;
+    }
+    size_t sp = 0;
+
+    frame_t root;
+    memset(&root, 0, sizeof(root));
+    memcpy(root.cfg.st, init_state, sizeof(int32_t) * (size_t)S);
+    root.next_j = -1; /* compute bounds lazily on first visit */
+    stack[sp++] = root;
+    set_insert(&seen, &root.cfg, S);
+
+    int64_t explored = 0;
+    int verdict = 0;
+
+    while (sp) {
+        frame_t *fr = &stack[sp - 1];
+        cfg_t *c = &fr->cfg;
+        if (fr->next_j < 0) {
+            /* first visit: compute window limit + min completion */
+            explored++;
+            if (explored > max_configs) {
+                verdict = -1;
+                break;
+            }
+            fr->wlim = (nD - c->p < W) ? nD - c->p : W;
+            int32_t min_ret = sufret[(c->p + W < nD) ? c->p + W : nD];
+            for (int j = 0; j < fr->wlim; j++)
+                if (!((c->win >> j) & 1) && retD[c->p + j] < min_ret)
+                    min_ret = retD[c->p + j];
+            fr->min_ret = min_ret;
+            fr->next_j = 0;
+            {
+                int32_t d = c->p;
+                uint64_t w = c->win;
+                while (w) { d += (int32_t)(w & 1); w >>= 1; }
+                if (d > *max_linearized)
+                    *max_linearized = d;
+            }
+        }
+        int advanced = 0;
+        while (fr->next_j < fr->wlim + nO) {
+            int j = fr->next_j++;
+            cfg_t c2 = *c;
+            if (j < fr->wlim) {
+                if ((c->win >> j) & 1)
+                    continue;
+                int32_t row = c->p + j;
+                if (invD[row] >= fr->min_ret && retD[row] != fr->min_ret)
+                    continue;
+                if (!step_model(model_id, model_param, c->st, opD[row],
+                                a1D[row], a2D[row], c2.st))
+                    continue;
+                c2.win = c->win | (1ULL << j);
+                while (c2.win & 1) { c2.win >>= 1; c2.p++; }
+                if (c2.p >= nD) {
+                    verdict = 1;
+                    break;
+                }
+            } else {
+                int o = j - fr->wlim;
+                if ((c->open >> o) & 1)
+                    continue;
+                if (invO[o] >= fr->min_ret)
+                    continue;
+                if (!step_model(model_id, model_param, c->st, opO[o],
+                                a1O[o], a2O[o], c2.st))
+                    continue;
+                c2.open = c->open | (1ULL << o);
+            }
+            int ins = set_insert(&seen, &c2, S);
+            if (ins < 0) {
+                verdict = -1;
+                break;
+            }
+            if (!ins)
+                continue; /* already explored this configuration */
+            frame_t nf;
+            nf.cfg = c2;
+            nf.next_j = -1;
+            nf.min_ret = 0;
+            nf.wlim = 0;
+            stack[sp++] = nf;
+            advanced = 1;
+            break;
+        }
+        if (verdict)
+            break;
+        if (!advanced)
+            sp--; /* dead end: backtrack */
+        if ((int32_t)sp > *frontier_max)
+            *frontier_max = (int32_t)sp; /* stack depth as diagnostic */
+    }
+
+    *configs_explored = explored;
+    free(stack);
+    set_free(&seen);
+    return verdict;
+}
+
+int wgl_check(
+    int32_t nD, int32_t nO, int32_t S, int32_t W,
+    const int32_t *invD, const int32_t *retD, const int32_t *opD,
+    const int32_t *a1D, const int32_t *a2D,
+    const int32_t *sufret, /* [nD+1] suffix min of retD */
+    const int32_t *invO, const int32_t *opO,
+    const int32_t *a1O, const int32_t *a2O,
+    const int32_t *init_state,
+    int32_t model_id, int64_t model_param,
+    int64_t max_configs,
+    /* out */ int64_t *configs_explored, int32_t *frontier_max,
+    int32_t *max_linearized) {
+    if (W > 64 || nO > 64 || S > S_MAX)
+        return -2;
+
+    *configs_explored = 0;
+    *frontier_max = 1;
+    *max_linearized = 0;
+
+    cfg_t start;
+    memset(&start, 0, sizeof(start));
+    memcpy(start.st, init_state, sizeof(int32_t) * (size_t)S);
+
+    if (nD == 0)
+        return 1; /* empty required set: trivially accepted */
+
+    vec_t cur = {0}, nxt = {0};
+    set_t seen;
+    if (!set_init(&seen, 1 << 12))
+        return -1;
+    if (!vec_push(&cur, &start)) {
+        set_free(&seen);
+        return -1;
+    }
+    set_insert(&seen, &start, S);
+
+    int verdict = 0;
+    int64_t explored = 0;
+    int lvl = 0;
+
+    while (cur.len) {
+        nxt.len = 0;
+        int progressed = 0;
+        for (size_t ci = 0; ci < cur.len && !verdict; ci++) {
+            cfg_t *c = &cur.items[ci];
+            explored++;
+            if (explored > max_configs) {
+                verdict = -1;
+                break;
+            }
+            /* min completion among unlinearized determinate ops */
+            int32_t tail = sufret[(c->p + W < nD) ? c->p + W : nD];
+            int32_t min_ret = tail;
+            int wlim = (nD - c->p < W) ? nD - c->p : W;
+            for (int j = 0; j < wlim; j++) {
+                if (!((c->win >> j) & 1) && retD[c->p + j] < min_ret)
+                    min_ret = retD[c->p + j];
+            }
+            /* determinate candidates */
+            for (int j = 0; j < wlim; j++) {
+                if ((c->win >> j) & 1)
+                    continue;
+                int32_t row = c->p + j;
+                /* allowed iff inv < min_ret, or own ret IS the min
+                 * (event ranks are unique; inv[j] < ret[j] always) */
+                if (invD[row] >= min_ret && retD[row] != min_ret)
+                    continue;
+                cfg_t c2 = *c;
+                if (!step_model(model_id, model_param, c->st, opD[row],
+                                a1D[row], a2D[row], c2.st))
+                    continue;
+                c2.win = c->win | (1ULL << j);
+                /* renormalize prefix over trailing ones */
+                while (c2.win & 1) {
+                    c2.win >>= 1;
+                    c2.p++;
+                }
+                if (c2.p >= nD) {
+                    verdict = 1;
+                    break;
+                }
+                int ins = set_insert(&seen, &c2, S);
+                if (ins < 0) {
+                    verdict = -1;
+                    break;
+                }
+                if (ins && !vec_push(&nxt, &c2)) {
+                    verdict = -1;
+                    break;
+                }
+                if (ins)
+                    progressed = 1;
+            }
+            if (verdict)
+                break;
+            /* open-op candidates */
+            for (int o = 0; o < nO; o++) {
+                if ((c->open >> o) & 1)
+                    continue;
+                if (invO[o] >= min_ret)
+                    continue;
+                cfg_t c2 = *c;
+                if (!step_model(model_id, model_param, c->st, opO[o],
+                                a1O[o], a2O[o], c2.st))
+                    continue;
+                c2.open = c->open | (1ULL << o);
+                int ins = set_insert(&seen, &c2, S);
+                if (ins < 0) {
+                    verdict = -1;
+                    break;
+                }
+                if (ins && !vec_push(&nxt, &c2)) {
+                    verdict = -1;
+                    break;
+                }
+                if (ins)
+                    progressed = 1;
+            }
+        }
+        if (verdict)
+            break;
+        if (progressed)
+            lvl++;
+        nxt.len = dominance_prune(nxt.items, nxt.len, S);
+        if ((int32_t)nxt.len > *frontier_max)
+            *frontier_max = (int32_t)nxt.len;
+        /* swap */
+        vec_t tmp = cur;
+        cur = nxt;
+        nxt = tmp;
+        if (cur.len) {
+            /* deepest prefix reached (diagnostic) */
+            int32_t best = 0;
+            for (size_t i = 0; i < cur.len; i++) {
+                int32_t d = cur.items[i].p;
+                uint64_t w = cur.items[i].win;
+                while (w) {
+                    d += (int32_t)(w & 1);
+                    w >>= 1;
+                }
+                if (d > best)
+                    best = d;
+            }
+            if (best > *max_linearized)
+                *max_linearized = best;
+        }
+    }
+
+    *configs_explored = explored;
+    free(cur.items);
+    free(nxt.items);
+    set_free(&seen);
+    return verdict;
+}

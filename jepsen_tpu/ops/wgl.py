@@ -544,6 +544,37 @@ class DevicePlan:
         return self.reason is None
 
 
+def det_tables(enc: EncodedHistory) -> dict:
+    """Split an encoding into determinate/open tables and derive the
+    window width + suffix-min completion table — shared by the device
+    planner and the native C engine (jepsen_tpu/ops/wgl_c.py) so the two
+    can never disagree on the search geometry."""
+    det = ~enc.skippable
+    nD = int(det.sum())
+    nO = enc.n - nD
+    invD = enc.inv[det].astype(np.int32)
+    retD = enc.ret[det].astype(np.int32)
+    if nD:
+        cnt = np.searchsorted(invD, retD, side="left") - np.arange(nD)
+        W = max(int(cnt.max()), 1)
+    else:
+        W = 1
+    sufret = np.full(nD + 1, INT32_MAX, dtype=np.int32)
+    if nD:
+        sufret[:nD] = np.minimum.accumulate(retD[::-1])[::-1]
+    return {
+        "nD": nD, "nO": nO, "W": W, "sufret": sufret,
+        "invD": invD, "retD": retD,
+        "opD": enc.opcode[det].astype(np.int32),
+        "a1D": enc.a1[det].astype(np.int32),
+        "a2D": enc.a2[det].astype(np.int32),
+        "invO": enc.inv[~det].astype(np.int32),
+        "opO": enc.opcode[~det].astype(np.int32),
+        "a1O": enc.a1[~det].astype(np.int32),
+        "a2O": enc.a2[~det].astype(np.int32),
+    }
+
+
 def plan_device(
     enc: EncodedHistory,
     max_open: int = 128,
@@ -553,32 +584,19 @@ def plan_device(
     """Prepare kernel arrays. ``pad_to = (W, KO, ND, NO)`` forces the static
     dims (for batching many histories under one compiled program); they must
     dominate this history's own requirements."""
-    det = ~enc.skippable
-    nD = int(det.sum())
-    nO = enc.n - nD
+    t = det_tables(enc)
+    nD, nO, W = t["nD"], t["nO"], t["W"]
     if nO > max_open:
         return DevicePlan(
             None, None, nD, nO,
             reason=f"{nO} open (:info) ops exceeds device cap {max_open}",
         )
-
-    invD = enc.inv[det].astype(np.int32)
-    retD = enc.ret[det].astype(np.int32)
-    opD = enc.opcode[det].astype(np.int32)
-    a1D = enc.a1[det].astype(np.int32)
-    a2D = enc.a2[det].astype(np.int32)
-    invO = enc.inv[~det].astype(np.int32)
-    opO = enc.opcode[~det].astype(np.int32)
-    a1O = enc.a1[~det].astype(np.int32)
-    a2O = enc.a2[~det].astype(np.int32)
+    invD, retD = t["invD"], t["retD"]
+    opD, a1D, a2D = t["opD"], t["a1D"], t["a2D"]
+    invO, opO, a1O, a2O = t["invO"], t["opO"], t["a1O"], t["a2O"]
 
     # Exact window requirement: max_p |{j >= p : inv[j] < ret[p]}| over
-    # determinate rows (sorted by inv).
-    if nD:
-        cnt = np.searchsorted(invD, retD, side="left") - np.arange(nD)
-        W = max(int(cnt.max()), 1)
-    else:
-        W = 1
+    # determinate rows (sorted by inv) — computed in det_tables.
     if W > window_cap:
         return DevicePlan(
             None, None, nD, nO,
@@ -605,8 +623,7 @@ def plan_device(
     padD = lambda a: np.pad(a, (0, ND - nD))
     padO = lambda a: np.pad(a, (0, NO - nO))
     sufret = np.full(ND + 1, INT32_MAX, dtype=np.int32)
-    if nD:
-        sufret[:nD] = np.minimum.accumulate(retD[::-1])[::-1]
+    sufret[: nD + 1] = t["sufret"]
 
     # Pack the five determinate-op tables into one [ND, 8] array so each
     # BFS level costs ONE dynamic gather; when every value fits int16 the
@@ -724,8 +741,8 @@ def _device_search(enc: EncodedHistory, plan: DevicePlan, schedule: list,
     """One escalating/de-escalating frontier search over ``schedule``;
     the top capacity continues past overflow as a greedy beam.
 
-    ``checkpoint`` (out): receives {"fr", "F"} — the entry frontier of
-    the first chunk that truncated (the last lossless state).
+    ``checkpoint`` (out): receives {"fr"} — the entry frontier of the
+    first chunk that truncated (the last lossless state).
     ``resume_from``: such a dict to start from instead of level 0."""
     n = enc.n
     W, KO, S, ND, NO = plan.dims
@@ -762,7 +779,8 @@ def _device_search(enc: EncodedHistory, plan: DevicePlan, schedule: list,
 
     if resume_from:
         # Restart from a lossless checkpoint frontier (the optimistic
-        # beam's state just before its first truncation).
+        # beam's state just before its first truncation); the capacity is
+        # the smallest scheduled one that fits the checkpoint width.
         ck_fr = resume_from["fr"]
         F = next((f for f in schedule if f >= ck_fr[0].shape[0]),
                  schedule[-1])
@@ -801,7 +819,6 @@ def _device_search(enc: EncodedHistory, plan: DevicePlan, schedule: list,
         if lossy and bool(ovf):
             if not truncated and checkpoint is not None:
                 checkpoint["fr"] = entry_fr
-                checkpoint["F"] = F
             truncated = True
         if bool(acc):
             # Sound even after truncation: dropping configs only removes
@@ -852,26 +869,56 @@ def check_history(
     host_max_configs: int = 500_000,
     **kw,
 ) -> dict:
-    """Unified entry: dispatch to the device kernel or the host oracle.
+    """Unified entry: dispatch across the three engines.
 
-    ``backend``: "auto" (device for device-capable models, host fallback on
-    unknown), "device", or "host". This is the seam the Checker layer's
-    ``:checker-backend`` option rides (BASELINE dispatch story; reference
-    seam checker.clj:49-64).
+    - the **native C search** (memoized DFS — near-linear on valid
+      histories, exact refutations; jepsen_tpu/native/wgl_native.c): the
+      fastest engine for a SINGLE history, used first on "auto"/"host"
+      when the model/shape is supported;
+    - the **device kernel** (this module): the batch/scale engine — keyed
+      and archived histories go through jepsen_tpu.parallel as one
+      sharded program — and the single-history engine when the native
+      path can't run;
+    - the **python oracle** (wgl_host): the obviously-correct last
+      resort and differential reference.
+
+    ``backend``: "auto" (native → device → python oracle), "device",
+    "native" (python-oracle fallback on unsupported shapes), or "host"
+    (the pure-python oracle ONLY — the engine of last resort and the
+    differential reference, so it must stay forcible). This is the seam
+    the Checker layer's ``:checker-backend`` option rides (BASELINE
+    dispatch story; reference seam checker.clj:49-64).
     """
-    from . import wgl_host
+    from . import wgl_c, wgl_host
 
+    enc = encode_history(model, history)
+    if backend in ("auto", "native"):
+        # Memory-bounded budget: the C engine's memo set holds ~56 bytes
+        # per explored config.
+        budget = 1_000_000 + 2_000 * enc.n
+        nat = wgl_c.check_encoded_native(enc, max_configs=budget)
+        if nat is not None and nat["valid"] != "unknown":
+            nat["backend"] = "native"
+            return nat
+        if backend == "native":
+            if nat is not None:
+                nat["backend"] = "native"
+                return nat
+            res = wgl_host.check_encoded(enc, max_configs=host_max_configs)
+            res["backend"] = "host"
+            res["info"] = (res.get("info") or
+                           "native engine unavailable; ran python oracle")
+            return res
     if backend == "host" or not model.device_capable:
-        res = wgl_host.check_history_host(model, history, max_configs=host_max_configs)
+        res = wgl_host.check_encoded(enc, max_configs=host_max_configs)
         if backend == "device":
             # An explicit device request can't be honored for this model;
             # say so rather than silently running on host (ADVICE r1) —
             # without clobbering the host oracle's own diagnostics.
-            res["backend"] = "host"
             note = f"model {model.name} is not device-capable; ran on host oracle"
             res["info"] = f"{res['info']}; {note}" if res.get("info") else note
+        res["backend"] = "host"
         return res
-    enc = encode_history(model, history)
     res = check_encoded_device(enc, **kw)
     if backend == "auto" and res["valid"] == "unknown":
         host = wgl_host.check_encoded(enc, max_configs=host_max_configs)

@@ -198,23 +198,29 @@ def history_to_latencies(history) -> list[tuple]:
 
 def nemesis_intervals(history, fs: Optional[dict] = None) -> list[tuple]:
     """Pair nemesis start/stop ops into [start, stop] op intervals
-    (util.clj:656). ``fs`` maps start-f -> stop-f; default pairs :start
-    with :stop."""
+    (util.clj:656). ``fs`` maps start-f -> stop-f OR a set of stop-fs
+    (any of which closes the interval); default pairs :start with
+    :stop."""
     fs = fs or {"start": "stop"}
-    stops = set(fs.values())
+    norm = {
+        k: frozenset(v) if isinstance(v, (set, frozenset, list, tuple))
+        else frozenset([v])
+        for k, v in fs.items()
+    }
     out = []
-    open_: dict = {}
+    open_: list = []  # (start_op, stop-f set), in start order
     for op in history:
         if not getattr(op, "is_nemesis", False):
             continue
         f = op.f
-        if f in fs:
-            open_.setdefault(fs[f], []).append(op)
-        elif f in stops:
-            starts = open_.get(f)
-            if starts:
-                out.append((starts.pop(0), op))
-    for stop_f, starts in open_.items():
-        for s in starts:
-            out.append((s, None))
+        if f in norm:
+            open_.append((op, norm[f]))
+        else:
+            for i, (start, stops) in enumerate(open_):
+                if f in stops:
+                    out.append((start, op))
+                    del open_[i]
+                    break
+    for start, _stops in open_:
+        out.append((start, None))
     return out

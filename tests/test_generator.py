@@ -379,3 +379,59 @@ def test_cycle_combinator():
     ops = sim.quick_ops(g)
     fs = [o["f"] for o in ops if o.get("type") == "info" and "f" in o]
     assert fs[:2] == ["start", "stop"]
+
+
+def test_trace_logs_and_passes_through(caplog):
+    """trace wraps op/update transparently (generator.clj:738-760)."""
+    import logging
+
+    g = gen.Trace("t", gen.limit(2, gen.repeat_({"f": "read"})))
+    with caplog.at_level(logging.INFO, logger="jepsen.generator"):
+        ops = sim.quick(g)
+    assert [o["f"] for o in ops] == ["read", "read"]
+
+
+def test_friendly_exceptions_wraps_context():
+    """friendly_exceptions rethrows with generator context
+    (generator.clj:693-736)."""
+
+    def boom(test, ctx):
+        raise ValueError("inner")
+
+    g = gen.FriendlyExceptions(boom)
+    with pytest.raises(RuntimeError, match="generator threw") as ei:
+        gen.op(g, {}, sim.default_context())
+    assert isinstance(ei.value.__cause__, ValueError)
+
+
+def test_on_threads_restricts_context():
+    """on_threads only offers the wrapped generator the matching threads
+    (generator.clj:856-864)."""
+    seen = []
+
+    def probe(test, ctx):
+        seen.append(sorted(ctx.free_threads, key=str))
+        return None
+
+    g = gen.on_threads(lambda t: t == 1, probe)
+    gen.op(g, {}, sim.n_plus_nemesis_context(3))
+    assert seen == [[1]]
+    # updates for non-matching threads leave the generator untouched.
+    inner = gen.limit(1, gen.repeat_({"f": "x"}))
+    g2 = gen.on_threads(lambda t: t == 1, inner)
+    g3 = gen.update(g2, {}, sim.n_plus_nemesis_context(3),
+                    {"process": 0, "type": "ok", "f": "x"})
+    assert g3 is g2
+
+
+def test_delay_spaces_ops_under_completions():
+    """delay introduces dt between ops even as completions arrive
+    (generator.clj:1336-1346)."""
+    with gen.fixed_rand(sim.RAND_SEED):
+        ops = sim.perfect(gen.limit(4, gen.delay(
+            1e-6, gen.repeat_({"f": "tick"}))))
+    times = [o["time"] for o in ops]
+    assert times == sorted(times)
+    # Successive invocations are at least ~dt apart.
+    gaps = [b - a for a, b in zip(times, times[1:])]
+    assert all(g_ >= 900 for g_ in gaps), gaps  # 1 us = 1000 ns

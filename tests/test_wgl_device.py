@@ -62,7 +62,11 @@ def test_unified_dispatch():
     h = random_register_history(rng, n_ops=20, n_procs=3)
     model = CasRegister(init=0)
     assert wgl.check_history(model, h, backend="host")["valid"] is True
-    dev = wgl.check_history(model, h, backend="auto")
+    auto = wgl.check_history(model, h, backend="auto")
+    assert auto["valid"] is True
+    # auto prefers the native C engine when available, else the device.
+    assert auto.get("backend") == "native" or auto.get("device")
+    dev = wgl.check_history(model, h, backend="device")
     assert dev["valid"] is True and dev.get("device")
 
 

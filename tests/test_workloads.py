@@ -367,3 +367,35 @@ class TestLockWorkloads:
         wl = lock.lock_test({"model": "fenced-mutex"})
         res = self.run_lock(wl, self.make_lock_client(fenced=True))
         assert res["results"]["valid"] is True
+
+
+class TestNemesisPlotSpecs:
+    def test_package_perf_specs_shade(self, tmp_path):
+        """Nemesis-package perf specs flow into the plots via
+        test["plot"]["nemeses"] (combined.clj perf -> checker.perf
+        seam)."""
+        from jepsen_tpu.checker import perf as jperf
+        from jepsen_tpu.history import History, Op
+
+        ops = []
+        t = 0
+        for i in range(6):
+            t += 10**9
+            ops.append({"type": "invoke", "process": 0, "f": "read",
+                        "value": None, "time": t})
+            t += 10**7
+            ops.append({"type": "ok", "process": 0, "f": "read",
+                        "value": None, "time": t})
+        ops.insert(2, {"type": "info", "process": "nemesis",
+                       "f": "start-partition", "value": None, "time": 15 * 10**8})
+        ops.append({"type": "info", "process": "nemesis",
+                    "f": "stop-partition", "value": None, "time": t + 10**8})
+        h = History([Op.from_dict(o) for o in ops], reindex=True)
+        test = {"name": "plotspec", "start-time": "t0",
+                "store-root": str(tmp_path),
+                "plot": {"nemeses": [
+                    {"name": "partition", "start": {"start-partition"},
+                     "stop": {"stop-partition"}, "color": "#E9DCA0"},
+                ]}}
+        jperf.point_graph(test, h, tmp_path / "out.png")
+        assert (tmp_path / "out.png").stat().st_size > 1000
