@@ -48,10 +48,17 @@ def _shade_nemesis(ax, history: History, test: Optional[dict] = None
         t_end = max((op.time for op in history if op.time >= 0), default=0)
         specs = ((test or {}).get("plot") or {}).get("nemeses")
         if specs:
+            def _fset(v, default):
+                if v is None:
+                    v = default
+                if isinstance(v, str):
+                    v = (v,)
+                return frozenset(v)
+
             for spec in specs:
-                stop_set = frozenset(spec.get("stop", ("stop",)))
+                stop_set = _fset(spec.get("stop"), ("stop",))
                 pairing = {start_f: stop_set
-                           for start_f in spec.get("start", ())}
+                           for start_f in _fset(spec.get("start"), ())}
                 if not pairing:
                     continue
                 for start, stop in nemesis_intervals(history, pairing):

@@ -15,7 +15,8 @@
  * is a single entry point:
  *
  *   int wgl_check(args...) -> 1 accepted | 0 not linearizable |
- *                             -1 budget exhausted | -2 unsupported
+ *                             -1 budget exhausted | -2 unsupported |
+ *                             -3 out of memory
  */
 
 #include <stdint.h>
@@ -375,13 +376,13 @@ int wgl_check_dfs(
 
     set_t seen;
     if (!set_init(&seen, 1 << 12))
-        return -1;
+        return -3;
 
     size_t depth_cap = (size_t)nD + (size_t)nO + 2;
     frame_t *stack = (frame_t *)malloc(sizeof(frame_t) * depth_cap);
     if (!stack) {
         set_free(&seen);
-        return -1;
+        return -3;
     }
     size_t sp = 0;
 
@@ -452,7 +453,7 @@ int wgl_check_dfs(
             }
             int ins = set_insert(&seen, &c2, S);
             if (ins < 0) {
-                verdict = -1;
+                verdict = -3;
                 break;
             }
             if (!ins)
@@ -509,10 +510,10 @@ int wgl_check(
     vec_t cur = {0}, nxt = {0};
     set_t seen;
     if (!set_init(&seen, 1 << 12))
-        return -1;
+        return -3;
     if (!vec_push(&cur, &start)) {
         set_free(&seen);
-        return -1;
+        return -3;
     }
     set_insert(&seen, &start, S);
 
@@ -563,11 +564,11 @@ int wgl_check(
                 }
                 int ins = set_insert(&seen, &c2, S);
                 if (ins < 0) {
-                    verdict = -1;
+                    verdict = -3;
                     break;
                 }
                 if (ins && !vec_push(&nxt, &c2)) {
-                    verdict = -1;
+                    verdict = -3;
                     break;
                 }
                 if (ins)
@@ -588,11 +589,11 @@ int wgl_check(
                 c2.open = c->open | (1ULL << o);
                 int ins = set_insert(&seen, &c2, S);
                 if (ins < 0) {
-                    verdict = -1;
+                    verdict = -3;
                     break;
                 }
                 if (ins && !vec_push(&nxt, &c2)) {
-                    verdict = -1;
+                    verdict = -3;
                     break;
                 }
                 if (ins)

@@ -873,8 +873,8 @@ def check_history(
 
     - the **native C search** (memoized DFS — near-linear on valid
       histories, exact refutations; jepsen_tpu/native/wgl_native.c): the
-      fastest engine for a SINGLE history, used first on "auto"/"host"
-      when the model/shape is supported;
+      fastest engine for a SINGLE history, used first on "auto" (and
+      selectable as "native") when the model/shape is supported;
     - the **device kernel** (this module): the batch/scale engine — keyed
       and archived histories go through jepsen_tpu.parallel as one
       sharded program — and the single-history engine when the native
@@ -893,8 +893,10 @@ def check_history(
 
     enc = encode_history(model, history)
     if backend in ("auto", "native"):
-        # Memory-bounded budget: the C engine's memo set holds ~56 bytes
-        # per explored config.
+        # Budgeted: the C memo set costs ~57 B/slot at <=75% load plus a
+        # transient doubling during growth — peak memory is roughly
+        # 2.5 * 57 B * budget/0.75 at exhaustion (~3 GB at the 10k-op
+        # default), and the budget trips before further growth.
         budget = 1_000_000 + 2_000 * enc.n
         nat = wgl_c.check_encoded_native(enc, max_configs=budget)
         if nat is not None and nat["valid"] != "unknown":

@@ -111,6 +111,9 @@ def check_encoded_native(
     if verdict == -1:
         return {"valid": "unknown",
                 "info": f"config budget {max_configs} exhausted", **base}
+    if verdict == -3:
+        return {"valid": "unknown",
+                "info": "native engine out of memory", **base}
     return None  # unsupported shape
 
 

@@ -207,6 +207,7 @@ def test_fn(opts: dict) -> dict:
         "db": db,
         "net": jnet.iptables(),
         "nemesis": pkg["nemesis"],
+        "plot": {"nemeses": pkg["perf"]},
         **{k: v for k, v in wl.items() if k != "generator"},
     }
     test["generator"] = gen.phases(

@@ -40,10 +40,13 @@ class TestNativeDifferential:
                 fail_p=0.05)
             if i % 2:
                 h = perturb_history(rng, h)
-            nat = wgl_c.check_history_native(model, h)
             host = wgl_host.check_history_host(model, h)
-            assert nat is not None
-            assert nat["valid"] == host["valid"], (i, nat, host)
+            for strategy in ("dfs", "bfs"):
+                nat = wgl_c.check_history_native(model, h,
+                                                 strategy=strategy)
+                assert nat is not None
+                assert nat["valid"] == host["valid"], (
+                    i, strategy, nat, host)
 
     def test_lock_histories(self):
         rng = random.Random(9)
