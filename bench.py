@@ -107,6 +107,23 @@ def main() -> int:
         # histories can absorb the mutated read); record the verdict but
         # don't fail the bench over it.
         out["invalid_valid"] = bad_res["valid"]
+        # Headroom: a 10x longer history through the production dispatch
+        # (the native engine scales near-linearly on valid histories).
+        try:
+            big = random_register_history(
+                random.Random(2030), n_ops=10 * N_OPS, n_procs=10,
+                cas=True, crash_p=0.002, fail_p=0.02)
+            t0 = time.perf_counter()
+            bres = wgl.check_history(model, big)
+            out["headroom_10x"] = {
+                "n_ops": 10 * N_OPS,
+                "value_s": round(time.perf_counter() - t0, 3),
+                "valid": bres["valid"],
+                "backend": bres.get("backend", "device"),
+            }
+        except Exception as e:  # noqa: BLE001
+            out["headroom_10x"] = {"error": f"{type(e).__name__}: {e}"}
+
         # --- BASELINE companion configs, each guarded ------------------
         # Elle-style txn cycle search on-device (cockroachdb bank/txn
         # config): a ~10k-mop serializable append history.

@@ -27,12 +27,45 @@
 #define OPEN_SENTINEL 2147483647
 #define UNKNOWN_VAL (-2147483647 - 1)
 
+#define NO_WORDS 2 /* open-op set: up to 128 :info ops */
+
 typedef struct {
     int32_t p;
     uint64_t win;
-    uint64_t open;
+    uint64_t open[NO_WORDS];
     int32_t st[S_MAX];
 } cfg_t;
+
+static inline int open_test(const cfg_t *c, int o) {
+    return (int)((c->open[o >> 6] >> (o & 63)) & 1);
+}
+
+static inline void open_set_bit(cfg_t *c, int o) {
+    c->open[o >> 6] |= 1ULL << (o & 63);
+}
+
+/* a's open-set is a subset of b's */
+static inline int open_subset(const uint64_t *a, const uint64_t *b) {
+    for (int w = 0; w < NO_WORDS; w++)
+        if (a[w] & ~b[w])
+            return 0;
+    return 1;
+}
+
+static inline int open_eq(const uint64_t *a, const uint64_t *b) {
+    for (int w = 0; w < NO_WORDS; w++)
+        if (a[w] != b[w])
+            return 0;
+    return 1;
+}
+
+static inline int open_lt(const uint64_t *a, const uint64_t *b) {
+    for (int w = NO_WORDS - 1; w >= 0; w--) {
+        if (a[w] != b[w])
+            return a[w] < b[w];
+    }
+    return 0;
+}
 
 /* ------------------------------------------------------------------ */
 /* Models (mirror models/register.py + models/mutex.py step_scalar).   */
@@ -196,7 +229,8 @@ static uint64_t cfg_hash(const cfg_t *c, int S) {
     (void)len;
     h = (h ^ (uint64_t)(uint32_t)c->p) * 1099511628211ULL;
     h = (h ^ c->win) * 1099511628211ULL;
-    h = (h ^ c->open) * 1099511628211ULL;
+    for (int w = 0; w < NO_WORDS; w++)
+        h = (h ^ c->open[w]) * 1099511628211ULL;
     for (int i = 0; i < S; i++)
         h = (h ^ (uint64_t)(uint32_t)c->st[i]) * 1099511628211ULL;
     (void)b;
@@ -204,7 +238,7 @@ static uint64_t cfg_hash(const cfg_t *c, int S) {
 }
 
 static int cfg_eq(const cfg_t *a, const cfg_t *b, int S) {
-    if (a->p != b->p || a->win != b->win || a->open != b->open)
+    if (a->p != b->p || a->win != b->win || !open_eq(a->open, b->open))
         return 0;
     return memcmp(a->st, b->st, sizeof(int32_t) * (size_t)S) == 0;
 }
@@ -280,8 +314,8 @@ static int cfg_cmp(const void *pa, const void *pb) {
     int c = memcmp(a->st, b->st, sizeof(int32_t) * (size_t)g_sort_S);
     if (c)
         return c;
-    if (a->open != b->open)
-        return a->open < b->open ? -1 : 1;
+    if (!open_eq(a->open, b->open))
+        return open_lt(a->open, b->open) ? -1 : 1;
     return 0;
 }
 
@@ -291,9 +325,9 @@ static size_t dominance_prune(cfg_t *items, size_t len, int S) {
     g_sort_S = S;
     qsort(items, len, sizeof(cfg_t), cfg_cmp);
     size_t out = 0;
-    uint64_t head_open = 0;
+    uint64_t head_open[NO_WORDS] = {0};
     const cfg_t *group = NULL;
-    uint64_t prev_open = 0;
+    uint64_t prev_open[NO_WORDS] = {0};
     for (size_t i = 0; i < len; i++) {
         cfg_t *c = &items[i];
         int same = group && c->p == group->p && c->win == group->win &&
@@ -301,19 +335,19 @@ static size_t dominance_prune(cfg_t *items, size_t len, int S) {
                           sizeof(int32_t) * (size_t)S) == 0;
         if (!same) {
             group = c;
-            head_open = c->open;
-            prev_open = c->open;
+            memcpy(head_open, c->open, sizeof(head_open));
+            memcpy(prev_open, c->open, sizeof(prev_open));
             items[out++] = *c;
             continue;
         }
         /* drop exact dups, supersets of the group head, and supersets
          * of the previous (kept-or-dropped) entry — sound by induction */
-        if ((c->open & head_open) == head_open ||
-            (c->open & prev_open) == prev_open) {
-            prev_open = c->open;
+        if (open_subset(head_open, c->open) ||
+            open_subset(prev_open, c->open)) {
+            memcpy(prev_open, c->open, sizeof(prev_open));
             continue;
         }
-        prev_open = c->open;
+        memcpy(prev_open, c->open, sizeof(prev_open));
         items[out++] = *c;
     }
     return out;
@@ -366,7 +400,7 @@ int wgl_check_dfs(
     int64_t max_configs,
     int64_t *configs_explored, int32_t *frontier_max,
     int32_t *max_linearized) {
-    if (W > 64 || nO > 64 || S > S_MAX)
+    if (W > 64 || nO > 64 * NO_WORDS || S > S_MAX)
         return -2;
     *configs_explored = 0;
     *frontier_max = 0;
@@ -442,14 +476,14 @@ int wgl_check_dfs(
                 }
             } else {
                 int o = j - fr->wlim;
-                if ((c->open >> o) & 1)
+                if (open_test(c, o))
                     continue;
                 if (invO[o] >= fr->min_ret)
                     continue;
                 if (!step_model(model_id, model_param, c->st, opO[o],
                                 a1O[o], a2O[o], c2.st))
                     continue;
-                c2.open = c->open | (1ULL << o);
+                open_set_bit(&c2, o);
             }
             int ins = set_insert(&seen, &c2, S);
             if (ins < 0) {
@@ -493,7 +527,7 @@ int wgl_check(
     int64_t max_configs,
     /* out */ int64_t *configs_explored, int32_t *frontier_max,
     int32_t *max_linearized) {
-    if (W > 64 || nO > 64 || S > S_MAX)
+    if (W > 64 || nO > 64 * NO_WORDS || S > S_MAX)
         return -2;
 
     *configs_explored = 0;
@@ -578,7 +612,7 @@ int wgl_check(
                 break;
             /* open-op candidates */
             for (int o = 0; o < nO; o++) {
-                if ((c->open >> o) & 1)
+                if (open_test(c, o))
                     continue;
                 if (invO[o] >= min_ret)
                     continue;
@@ -586,7 +620,7 @@ int wgl_check(
                 if (!step_model(model_id, model_param, c->st, opO[o],
                                 a1O[o], a2O[o], c2.st))
                     continue;
-                c2.open = c->open | (1ULL << o);
+                open_set_bit(&c2, o);
                 int ins = set_insert(&seen, &c2, S);
                 if (ins < 0) {
                     verdict = -3;

@@ -71,7 +71,7 @@ def check_encoded_native(
 
     t = det_tables(enc)
     nD, nO, W = t["nD"], t["nO"], t["W"]
-    if nO > 64 or W > 64:
+    if nO > 128 or W > 64:
         return None
     ca = lambda a: np.ascontiguousarray(a, dtype=np.int32)
     invD, retD = ca(t["invD"]), ca(t["retD"])

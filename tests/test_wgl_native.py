@@ -81,3 +81,37 @@ class TestNativeDifferential:
         dt = time.perf_counter() - t0
         assert nat is not None and nat["valid"] in (True, False, "unknown")
         assert dt < 60, dt
+
+    def test_wide_open_sets(self):
+        """nO in (64, 128]: the two-word open set. Construction-valid
+        histories must accept; DFS and BFS (independent algorithms over
+        the same bit ops) must agree — the python oracle is too slow for
+        these crash-heavy shapes."""
+        import random
+
+        from jepsen_tpu.ops.encode import encode_history
+        from jepsen_tpu.ops.wgl import det_tables
+
+        model = CasRegister(init=0)
+        rng = random.Random(77)
+        widened = 0
+        for i in range(4):
+            h = random_register_history(rng, n_ops=300, n_procs=4,
+                                        cas=True, crash_p=0.35)
+            if i % 2:
+                h = perturb_history(rng, h)
+            t = det_tables(encode_history(model, h))
+            dfs = wgl_c.check_history_native(model, h, strategy="dfs",
+                                             max_configs=2_000_000)
+            bfs = wgl_c.check_history_native(model, h, strategy="bfs",
+                                             max_configs=1_500_000)
+            if dfs is None:
+                assert t["nO"] > 128
+                continue
+            if t["nO"] > 64:
+                widened += 1
+            if i % 2 == 0:
+                assert dfs["valid"] is True  # valid by construction
+            if bfs is not None and bfs["valid"] != "unknown":
+                assert dfs["valid"] == bfs["valid"], (i, dfs, bfs)
+        assert widened, "no history exercised the second open word"
