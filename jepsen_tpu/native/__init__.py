@@ -61,6 +61,8 @@ def _build() -> Optional[ctypes.CDLL]:
     lib.wgl_check.restype = ctypes.c_int
     lib.wgl_check_dfs.argtypes = lib.wgl_check.argtypes
     lib.wgl_check_dfs.restype = ctypes.c_int
+    lib.wgl_max_open.argtypes = []
+    lib.wgl_max_open.restype = ctypes.c_int
     return lib
 
 

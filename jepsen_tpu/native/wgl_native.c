@@ -6,7 +6,7 @@
  * model-state) configurations, sharing the device kernel's
  * representation (jepsen_tpu/ops/wgl.py docstring): determinate ops
  * sorted by invocation, a prefix pointer p with a 64-bit window bitset,
- * a 64-bit open-op set, and a fixed-width int state vector. Model
+ * a multi-word open-op set (64 * NO_WORDS ops), and a fixed-width int state vector. Model
  * transition functions mirror jepsen_tpu/models/{register,mutex}.py
  * step_scalar exactly; differential tests pin all three implementations
  * (python host / XLA device / native C) together.
@@ -27,7 +27,7 @@
 #define OPEN_SENTINEL 2147483647
 #define UNKNOWN_VAL (-2147483647 - 1)
 
-#define NO_WORDS 2 /* open-op set: up to 128 :info ops */
+#define NO_WORDS 4 /* open-op set: up to 256 :info ops */
 
 typedef struct {
     int32_t p;
@@ -86,6 +86,8 @@ enum {
 #define OP_CAS 2
 #define OP_ACQUIRE 0
 #define OP_RELEASE 1
+
+int wgl_max_open(void) { return 64 * NO_WORDS; }
 
 static int step_model(int model_id, int64_t param, const int32_t *st,
                       int32_t op, int32_t a1, int32_t a2, int32_t *out) {
@@ -303,15 +305,17 @@ static int set_grow(set_t *s, int S) {
  * consumed opens dominates. Sort groups together, then drop entries
  * whose open-set contains the group minimum (or their predecessor). */
 
-static int g_sort_S;
-
 static int cfg_cmp(const void *pa, const void *pb) {
+    /* No per-call state: lanes beyond the model's S are always zero
+     * (the root config is memset and transitions write only S lanes),
+     * so comparing the full S_MAX width is equivalent — and keeps the
+     * comparator safe under concurrent checks. */
     const cfg_t *a = (const cfg_t *)pa, *b = (const cfg_t *)pb;
     if (a->p != b->p)
         return a->p < b->p ? -1 : 1;
     if (a->win != b->win)
         return a->win < b->win ? -1 : 1;
-    int c = memcmp(a->st, b->st, sizeof(int32_t) * (size_t)g_sort_S);
+    int c = memcmp(a->st, b->st, sizeof(int32_t) * S_MAX);
     if (c)
         return c;
     if (!open_eq(a->open, b->open))
@@ -322,7 +326,7 @@ static int cfg_cmp(const void *pa, const void *pb) {
 static size_t dominance_prune(cfg_t *items, size_t len, int S) {
     if (len < 2)
         return len;
-    g_sort_S = S;
+    (void)S;
     qsort(items, len, sizeof(cfg_t), cfg_cmp);
     size_t out = 0;
     uint64_t head_open[NO_WORDS] = {0};

@@ -2,7 +2,8 @@
 
 Bridges :mod:`jepsen_tpu.native`'s compiled WGL search into the checker
 stack: same encoding as the device kernel (determinate ops sorted by
-invocation, ≤64-wide window bitset, ≤64 open ops, ≤8 state lanes), exact
+invocation, ≤64-wide window bitset, a multi-word open set whose capacity
+the library reports via wgl_max_open, ≤8 state lanes), exact
 verdicts, no frontier capacity limits beyond a config budget. Falls back
 (returns None) when the model family or shape is unsupported or no C
 compiler exists — callers then use the pure-python oracle.
@@ -71,7 +72,7 @@ def check_encoded_native(
 
     t = det_tables(enc)
     nD, nO, W = t["nD"], t["nO"], t["W"]
-    if nO > 128 or W > 64:
+    if nO > lib.wgl_max_open() or W > 64:
         return None
     ca = lambda a: np.ascontiguousarray(a, dtype=np.int32)
     invD, retD = ca(t["invD"]), ca(t["retD"])
