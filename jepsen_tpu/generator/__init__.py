@@ -541,10 +541,21 @@ class OnThreads(Generator):
         return self
 
 
+def rand_int_seq(seed: Optional[int] = None):
+    """A reproducible infinite stream of random ints for a seed
+    (generator.clj:445-452)."""
+    rng = _random.Random(seed if seed is not None else rand_int(2**31))
+    while True:
+        yield rng.getrandbits(63)
+
+
 def on_threads(pred, gen):
     return OnThreads(pred, gen)
 
 
+
+
+# `on` is the reference's short alias for on-threads (generator.clj:856).
 on = on_threads
 
 

@@ -326,7 +326,6 @@ static int cfg_cmp(const void *pa, const void *pb) {
 static size_t dominance_prune(cfg_t *items, size_t len, int S) {
     if (len < 2)
         return len;
-    (void)S;
     qsort(items, len, sizeof(cfg_t), cfg_cmp);
     size_t out = 0;
     uint64_t head_open[NO_WORDS] = {0};

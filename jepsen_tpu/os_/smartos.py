@@ -1,0 +1,34 @@
+"""SmartOS provisioning (jepsen.os.smartos, jepsen/src/jepsen/os/
+smartos.clj): pkgsrc package management over the control session."""
+
+from __future__ import annotations
+
+from typing import Iterable
+
+from .. import control as c
+from . import OS
+
+
+def install(pkgs: Iterable[str]) -> None:
+    """pkgin-based install-if-missing (smartos.clj's pkgin flow)."""
+    pkgs = list(pkgs)
+    if not pkgs:
+        return
+    with c.su():
+        c.exec_star("pkgin -y install " +
+                    " ".join(c.escape(p) for p in pkgs))
+
+
+class SmartOS(OS):
+    def setup(self, test, node):
+        install(["curl", "wget", "unzip", "gtar"])
+
+    def teardown(self, test, node):
+        pass
+
+    def __repr__(self):
+        return "<os.smartos>"
+
+
+def os() -> OS:
+    return SmartOS()

@@ -1,0 +1,33 @@
+"""Interactive exploration helpers (jepsen.repl, jepsen/src/jepsen/
+repl.clj): load stored runs and poke at histories from a python shell.
+
+    >>> from jepsen_tpu import repl
+    >>> t = repl.latest()
+    >>> h = t["history"]
+    >>> repl.recheck(t)
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from . import core, store
+
+
+def latest(root: Optional[Any] = None) -> Optional[dict]:
+    """The most recent stored test, with its history loaded."""
+    return store.latest(root=root)
+
+
+def load(name: str, start: str, root: Optional[Any] = None) -> dict:
+    return store.load_test(name, start, root=root)
+
+
+def recheck(test: dict, checker=None) -> dict:
+    """Re-run analysis on a loaded test (optionally with a different
+    checker) — the repl-sized version of the `analyze` command."""
+    t = dict(test)
+    t["no-store?"] = True
+    if checker is not None:
+        t["checker"] = checker
+    return core.analyze(t)["results"]
