@@ -80,6 +80,7 @@ def _add_opts(p) -> None:
 COMMANDS = {
     **cli.single_test_cmd(test_fn, add_opts=_add_opts),
     **cli.test_all_cmd({n: f for n, f in WORKLOADS.items()}),
+    **cli.replay_cmd(),
     **cli.serve_cmd(),
 }
 

@@ -209,6 +209,44 @@ def test_all_cmd(test_fns: dict, opt_fn: Optional[Callable] = None) -> dict:
                          "help": "Run every test in the suite."}}
 
 
+def replay_cmd() -> dict:
+    """Command `replay`: re-check every archived history in the store as
+    ONE batched, mesh-sharded device program (BASELINE batch-replay
+    config; the scale version of `analyze`)."""
+
+    def run_replay(opts) -> int:
+        import json as _json
+
+        from .parallel.replay import replay_store
+
+        summary = replay_store(
+            model_name=opts.get("model") or "cas-register",
+            root=opts.get("store_root"),
+            name=opts.get("test_name") or None,
+            limit=int(opts["limit"]) if opts.get("limit") else None,
+        )
+        LOG.info("replay summary: %s", _json.dumps(
+            {k: v for k, v in summary.items() if k != "runs"}))
+        for run, valid in (summary.get("runs") or {}).items():
+            LOG.info("  %s -> %s", run, valid)
+        if summary.get("invalid"):
+            return EXIT_INVALID
+        if summary.get("unknown"):
+            return EXIT_UNKNOWN
+        return EXIT_OK
+
+    def add_opts(p):
+        p.add_argument("--model", default="cas-register")
+        p.add_argument("--test-name", default=None,
+                       help="only replay runs of this test")
+        p.add_argument("--limit", default=None,
+                       help="replay at most N newest runs")
+
+    return {"replay": {"run": run_replay, "add_opts": add_opts,
+                       "help": "Batch-recheck every stored history on "
+                               "the device mesh."}}
+
+
 def serve_cmd() -> dict:
     """Command `serve`: the results web server (cli.clj:323-340)."""
 

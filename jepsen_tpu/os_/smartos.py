@@ -15,8 +15,7 @@ def install(pkgs: Iterable[str]) -> None:
     if not pkgs:
         return
     with c.su():
-        c.exec_star("pkgin -y install " +
-                    " ".join(c.escape(p) for p in pkgs))
+        c.exec("pkgin", "-y", "install", *pkgs)
 
 
 class SmartOS(OS):

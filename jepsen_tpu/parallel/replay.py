@@ -1,0 +1,124 @@
+"""Batch replay of archived histories (BASELINE config 5).
+
+Loads N stored ``history.edn`` files (this framework's or the
+reference's — same EDN format), encodes them into one shape bucket, and
+decides them all as a single vmapped, mesh-sharded device program
+(`jepsen_tpu.parallel.batch`), writing per-run ``rechecked.edn`` results
+back into the store. The CLI exposes it as the ``replay`` command.
+"""
+
+from __future__ import annotations
+
+import logging
+from pathlib import Path
+from typing import Any, Optional, Sequence
+
+from .. import store
+from ..history import History
+from ..models import Model, model_by_name
+from .batch import check_batch
+
+LOG = logging.getLogger("jepsen.replay")
+
+
+def find_histories(root: Any = None, name: Optional[str] = None,
+                   limit: Optional[int] = None) -> list[Path]:
+    """Every history.edn under the store tree, newest runs first across
+    ALL tests (start-times sort lexicographically as timestamps)."""
+    stamped: list[tuple[str, Path]] = []
+    tests = store.tests(name=name, root=root)
+    for tname in sorted(tests):
+        for start, d in tests[tname].items():
+            f = d / "history.edn"
+            if f.exists():
+                stamped.append((start, f))
+    stamped.sort(key=lambda sf: sf[0], reverse=True)
+    out = [f for _s, f in stamped]
+    if limit:
+        out = out[:limit]
+    return out
+
+
+def replay(model: Model, paths: Sequence[Path], mesh=None, f: int = 256,
+           write_results: bool = True) -> list[dict]:
+    """Decide every stored history in one batched device program; returns
+    one result map per path (order preserved)."""
+    histories = []
+    kept: list[Path] = []
+    for p in paths:
+        try:
+            histories.append(History.load(p))
+            kept.append(Path(p))
+        except Exception:
+            LOG.warning("could not load %s", p, exc_info=True)
+            histories.append(None)
+            kept.append(Path(p))
+    # Guard against model/workload mismatches: a history whose ops the
+    # model encoder drops entirely would be vacuously "valid".
+    from ..ops.encode import encode_history
+
+    results: list[Optional[dict]] = []
+    idx = []
+    for i, h in enumerate(histories):
+        if h is None:
+            results.append({"valid": "unknown",
+                            "info": "unreadable history"})
+            continue
+        client_ops = h.client_ops()
+        try:
+            enc_n = encode_history(model, client_ops).n
+        except Exception as e:  # model can't interpret these ops at all
+            results.append({"valid": "unknown",
+                            "info": f"not a {model.name} history: {e}"})
+            continue
+        if len(client_ops) and enc_n == 0:
+            results.append({
+                "valid": "unknown",
+                "info": f"no ops matched model {model.name}; wrong "
+                        "--model for this run?"})
+            continue
+        results.append(None)
+        idx.append(i)
+    if idx:
+        batch = check_batch(
+            model, [histories[i].client_ops() for i in idx], mesh=mesh, f=f)
+        for i, res in zip(idx, batch):
+            results[i] = res
+    if write_results:
+        from ..store import edn, to_edn_value
+
+        for p, res in zip(kept, results):
+            try:
+                out = p.parent / "rechecked.edn"
+                out.write_text(edn.write_string(to_edn_value(res)) + "\n")
+            except Exception:
+                LOG.warning("could not write results next to %s", p,
+                            exc_info=True)
+    return results  # type: ignore[return-value]
+
+
+def replay_store(model_name: str = "cas-register", root: Any = None,
+                 name: Optional[str] = None, limit: Optional[int] = None,
+                 mesh=None, model_args: Optional[dict] = None) -> dict:
+    """The CLI entry: replay every archived history in the store through
+    the batched checker. Returns a summary map."""
+    model = model_by_name(model_name, **(model_args or {}))
+    paths = find_histories(root=root, name=name, limit=limit)
+    if not paths:
+        return {"count": 0, "valid": 0, "invalid": 0, "unknown": 0}
+    if mesh is None:
+        import jax
+
+        if len(jax.devices()) > 1:
+            from . import make_mesh
+
+            mesh = make_mesh()
+    results = replay(model, paths, mesh=mesh)
+    summary = {
+        "count": len(results),
+        "valid": sum(1 for r in results if r["valid"] is True),
+        "invalid": sum(1 for r in results if r["valid"] is False),
+        "unknown": sum(1 for r in results if r["valid"] == "unknown"),
+        "runs": {str(p): r["valid"] for p, r in zip(paths, results)},
+    }
+    return summary

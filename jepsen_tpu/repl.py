@@ -4,7 +4,7 @@ repl.clj): load stored runs and poke at histories from a python shell.
     >>> from jepsen_tpu import repl
     >>> t = repl.latest()
     >>> h = t["history"]
-    >>> repl.recheck(t)
+    >>> repl.recheck(t, checker.linearizable(model=CasRegister(init=0)))
 """
 
 from __future__ import annotations
@@ -23,11 +23,17 @@ def load(name: str, start: str, root: Optional[Any] = None) -> dict:
     return store.load_test(name, start, root=root)
 
 
-def recheck(test: dict, checker=None) -> dict:
-    """Re-run analysis on a loaded test (optionally with a different
-    checker) — the repl-sized version of the `analyze` command."""
+def recheck(test: dict, checker) -> dict:
+    """Re-run analysis on a loaded test with the given checker — the
+    repl-sized version of the `analyze` command. A checker must be
+    supplied: live checkers are never persisted in the store
+    (store.serializable_test strips them), so there is nothing to
+    re-run without one."""
+    if checker is None:
+        raise ValueError(
+            "recheck needs a checker: stored tests carry no live checker "
+            "objects (e.g. pass checker=linearizable(model=...))")
     t = dict(test)
     t["no-store?"] = True
-    if checker is not None:
-        t["checker"] = checker
+    t["checker"] = checker
     return core.analyze(t)["results"]

@@ -35,11 +35,17 @@ LOG = logging.getLogger("jepsen.store")
 
 BASE_DIR = "store"
 
-_TIME_FORMAT = "%Y%m%dT%H%M%S.000Z"  # store.clj:118-124 (basic-date-time)
+_TIME_FORMAT = "%Y%m%dT%H%M%S"  # store.clj:118-124 (basic-date-time)
 
 
 def time_str(t: Optional[float] = None) -> str:
-    return _time.strftime(_TIME_FORMAT, _time.gmtime(t))
+    """Millisecond-resolution timestamp — the reference's basic-date-time
+    carries millis, and runs started within the same second must not
+    collide in the store tree."""
+    now = _time.time() if t is None else t
+    base = _time.strftime(_TIME_FORMAT, _time.gmtime(now))
+    millis = int((now % 1) * 1000)
+    return f"{base}.{millis:03d}Z"
 
 
 def base(test_or_root: Any = None) -> Path:

@@ -140,3 +140,35 @@ class TestCommands:
     def test_bad_args(self):
         cmds = cli.single_test_cmd(_suite(AtomClient))
         assert self.run_cli(cmds, ["bogus-command"]) == cli.EXIT_BAD_ARGS
+
+
+class TestReplay:
+    def test_batch_replay_of_stored_runs(self, tmp_path):
+        """BASELINE config 5 end to end: several stored runs re-checked
+        as one batched device program via the replay command."""
+        cmds = cli.single_test_cmd(_suite(AtomClient))
+        for _ in range(3):
+            assert cli.run(cmds, ["test", "--store-root", str(tmp_path),
+                                  "--concurrency", "4", "--nodes",
+                                  "n1,n2"]) == cli.EXIT_OK
+        # one invalid run in the mix
+        bad = cli.single_test_cmd(_suite(StaleClient))
+        assert cli.run(bad, ["test", "--store-root", str(tmp_path),
+                             "--concurrency", "4", "--nodes", "n1,n2"],
+                       ) == cli.EXIT_INVALID
+        code = cli.run(cli.replay_cmd(),
+                       ["replay", "--store-root", str(tmp_path)])
+        assert code == cli.EXIT_INVALID  # the bad run is re-detected
+        # --limit takes the newest runs globally
+        from jepsen_tpu.parallel.replay import find_histories as _fh
+
+        newest = _fh(root=str(tmp_path), limit=2)
+        assert len(newest) == 2
+        stamps = [p.parent.name for p in _fh(root=str(tmp_path))]
+        assert stamps == sorted(stamps, reverse=True)
+        # rechecked.edn written next to each history
+        from jepsen_tpu.parallel.replay import find_histories
+
+        hs = find_histories(root=str(tmp_path))
+        assert len(hs) == 4
+        assert all((p.parent / "rechecked.edn").exists() for p in hs)
