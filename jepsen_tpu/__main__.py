@@ -80,7 +80,9 @@ def _add_opts(p) -> None:
 COMMANDS = {
     **cli.single_test_cmd(test_fn, add_opts=_add_opts),
     **cli.test_all_cmd({n: f for n, f in WORKLOADS.items()}),
-    **cli.replay_cmd(),
+    # The demo DB resets the register to 0 in setup, so replay must
+    # check against an init=0 model (the generic default is nil-init).
+    **cli.replay_cmd(model_args={"init": 0}),
     **cli.serve_cmd(),
 }
 

@@ -209,21 +209,33 @@ def test_all_cmd(test_fns: dict, opt_fn: Optional[Callable] = None) -> dict:
                          "help": "Run every test in the suite."}}
 
 
-def replay_cmd() -> dict:
+def _positive_int(s: str) -> int:
+    v = int(s)
+    if v < 1:
+        raise argparse.ArgumentTypeError("must be a positive integer")
+    return v
+
+
+def replay_cmd(model_args: Optional[dict] = None) -> dict:
     """Command `replay`: re-check every archived history in the store as
     ONE batched, mesh-sharded device program (BASELINE batch-replay
-    config; the scale version of `analyze`)."""
+    config; the scale version of `analyze`).
+
+    `model_args` sets the suite's default model kwargs (e.g. a register
+    whose DB starts at 0 rather than nil); `--model-args` on the command
+    line overrides it."""
+    import json as _json
 
     def run_replay(opts) -> int:
-        import json as _json
-
         from .parallel.replay import replay_store
 
+        margs = opts.get("model_args")
         summary = replay_store(
             model_name=opts.get("model") or "cas-register",
             root=opts.get("store_root"),
             name=opts.get("test_name") or None,
-            limit=int(opts["limit"]) if opts.get("limit") else None,
+            limit=opts.get("limit"),
+            model_args=_json.loads(margs) if margs else None,
         )
         LOG.info("replay summary: %s", _json.dumps(
             {k: v for k, v in summary.items() if k != "runs"}))
@@ -237,9 +249,14 @@ def replay_cmd() -> dict:
 
     def add_opts(p):
         p.add_argument("--model", default="cas-register")
+        p.add_argument(
+            "--model-args",
+            default=_json.dumps(model_args) if model_args else None,
+            help="JSON kwargs for the model, e.g. '{\"init\": 0}' for "
+                 "a register whose DB starts at 0 rather than nil")
         p.add_argument("--test-name", default=None,
                        help="only replay runs of this test")
-        p.add_argument("--limit", default=None,
+        p.add_argument("--limit", type=_positive_int, default=None,
                        help="replay at most N newest runs")
 
     return {"replay": {"run": run_replay, "add_opts": add_opts,

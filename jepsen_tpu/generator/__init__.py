@@ -40,6 +40,7 @@ from __future__ import annotations
 
 import inspect
 import logging
+import functools
 import random as _random
 import threading
 from typing import Any, Callable, Iterable, Optional
@@ -112,12 +113,15 @@ class Context:
     (generator.clj:433-444).
     """
 
-    __slots__ = ("time", "free_threads", "workers")
+    __slots__ = ("time", "free_threads", "workers", "_flist", "_restrict")
 
     def __init__(self, time: int, free_threads: frozenset, workers: dict):
         self.time = time
         self.free_threads = free_threads
         self.workers = workers
+        # Lazy per-instance caches (sound: contexts are immutable).
+        self._flist = None
+        self._restrict = None
 
     def with_(self, time=None, free_threads=None, workers=None) -> "Context":
         return Context(
@@ -126,9 +130,13 @@ class Context:
             self.workers if workers is None else workers,
         )
 
-    def free_thread_list(self) -> list:
+    def free_thread_list(self) -> tuple:
         # Deterministic order: numeric threads sorted, nemesis last.
-        return sorted(self.free_threads, key=lambda t: (isinstance(t, str), t))
+        # Tuple, not list: the value is cached, so it must be immutable.
+        if self._flist is None:
+            self._flist = tuple(sorted(
+                self.free_threads, key=lambda t: (isinstance(t, str), t)))
+        return self._flist
 
     def __repr__(self) -> str:
         return (
@@ -509,11 +517,27 @@ on_update = OnUpdate
 
 
 def on_threads_context(pred: Callable[[Any], bool], ctx: Context) -> Context:
-    """Restrict a context to threads satisfying pred (generator.clj:826-843)."""
-    return ctx.with_(
-        free_threads=frozenset(t for t in ctx.free_threads if pred(t)),
-        workers={t: p for t, p in ctx.workers.items() if pred(t)},
-    )
+    """Restrict a context to threads satisfying pred (generator.clj:826-843).
+
+    Memoized per (ctx, pred): a deep generator stack restricts the same
+    immutable context several times per scheduler step, which dominated
+    interpreter throughput before caching."""
+    cache = ctx._restrict
+    if cache is None:
+        cache = ctx._restrict = {}
+    try:
+        hit = cache.get(pred)
+    except TypeError:  # unhashable pred: build uncached
+        hit = None
+        cache = None
+    if hit is None:
+        hit = ctx.with_(
+            free_threads=frozenset(t for t in ctx.free_threads if pred(t)),
+            workers={t: p for t, p in ctx.workers.items() if pred(t)},
+        )
+        if cache is not None:
+            cache[pred] = hit
+    return hit
 
 
 class OnThreads(Generator):
@@ -666,6 +690,20 @@ class EachThread(Generator):
 each_thread = EachThread
 
 
+@functools.lru_cache(maxsize=None)
+def _in_set_pred(s: frozenset):
+    """A stable membership predicate per thread set, so
+    on_threads_context's identity-keyed memo can hit (the sets are the
+    handful of reserve/group ranges a test declares, so the cache stays
+    tiny)."""
+    return lambda t: t in s
+
+
+@functools.lru_cache(maxsize=None)
+def _not_in_set_pred(s: frozenset):
+    return lambda t: t not in s
+
+
 class Reserve(Generator):
     """Dedicated thread ranges per generator + a default
     (generator.clj:990-1070)."""
@@ -680,14 +718,14 @@ class Reserve(Generator):
     def op(self, test, ctx):
         soonest = None
         for i, threads in enumerate(self.ranges):
-            rctx = on_threads_context(lambda t, s=threads: t in s, ctx)
+            rctx = on_threads_context(_in_set_pred(threads), ctx)
             res = op(self.gens[i], test, rctx)
             if res is not None:
                 soonest = soonest_op_map(
                     soonest,
                     {"op": res[0], "gen": res[1], "weight": len(threads), "i": i},
                 )
-        dctx = on_threads_context(lambda t: t not in self.all_ranges, ctx)
+        dctx = on_threads_context(_not_in_set_pred(self.all_ranges), ctx)
         res = op(self.gens[-1], test, dctx)
         if res is not None:
             soonest = soonest_op_map(

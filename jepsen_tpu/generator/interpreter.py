@@ -147,14 +147,17 @@ def make_worker(test: dict, thread_id: Any, nemesis: jnemesis.Nemesis) -> Worker
 
 
 class _WorkerThread:
-    """A worker plus its size-1 in/out queues and OS thread
-    (interpreter.clj:99-164)."""
+    """A worker plus its size-1 inbox and OS thread; completions land on
+    the scheduler's ONE shared queue (the reference's single out
+    ArrayBlockingQueue, interpreter.clj:99-164) so the scheduler blocks
+    on arrivals instead of polling per-worker outboxes."""
 
-    def __init__(self, test: dict, thread_id: Any, worker: Worker):
+    def __init__(self, test: dict, thread_id: Any, worker: Worker,
+                 done_q: "queue.Queue[tuple]"):
         self.thread_id = thread_id
         self.worker = worker
         self.inbox: "queue.Queue[dict]" = queue.Queue(maxsize=1)
-        self.outbox: "queue.Queue[dict]" = queue.Queue(maxsize=1)
+        self.done_q = done_q
         self.thread = threading.Thread(
             target=self._run, args=(test,),
             name=f"jepsen-worker-{thread_id}", daemon=True,
@@ -174,37 +177,31 @@ class _WorkerThread:
                 return
             if typ == "sleep":
                 _time.sleep(op.get("value") or 0)
-                self.outbox.put(dict(op))
+                self.done_q.put((self.thread_id, dict(op)))
                 continue
             if typ == "log":
                 LOG.info("%s", op.get("value"))
-                self.outbox.put(dict(op))
+                self.done_q.put((self.thread_id, dict(op)))
                 continue
             try:
                 res = self.worker.invoke(test, op)
                 log_op(res)
-                self.outbox.put(res)
+                self.done_q.put((self.thread_id, res))
             except Exception as e:  # noqa: BLE001 - soundness rule
                 # Coarse-grained failure: we don't know whether the op took
                 # effect. :info keeps its interval open to end-of-history
                 # (interpreter.clj:142-157).
                 LOG.warning("process %s %s indeterminate", op.get("process"),
                             op.get("f"), exc_info=True)
-                self.outbox.put({
+                self.done_q.put((self.thread_id, {
                     **op,
                     "type": INFO,
                     "error": f"indeterminate: {e}",
                     "exception": e,
-                })
+                }))
 
     def send(self, op: dict) -> None:
         self.inbox.put(op)
-
-    def poll(self) -> Optional[dict]:
-        try:
-            return self.outbox.get_nowait()
-        except queue.Empty:
-            return None
 
     def join(self, timeout: Optional[float] = None) -> None:
         self.thread.join(timeout)
@@ -220,48 +217,48 @@ def run(test: dict) -> list[dict]:
     ctx = make_context(test)
     nemesis = test.get("nemesis") or jnemesis.noop()
     threads = ctx.free_thread_list()
+    done_q: "queue.Queue[tuple]" = queue.Queue()
     workers: dict[Any, _WorkerThread] = {
-        t: _WorkerThread(test, t, make_worker(test, t, nemesis))
+        t: _WorkerThread(test, t, make_worker(test, t, nemesis), done_q)
         for t in threads
     }
     gen = Validate(FriendlyExceptions(test.get("generator")))
     history: list[dict] = []
     # Ops in flight: thread id -> invoke op.
     outstanding: dict[Any, dict] = {}
-    poll_timeout = 0.0
     exc: Optional[BaseException] = None
+
+    def take_completion(block: bool, timeout: Optional[float] = None):
+        """Apply one completion from the shared queue; returns whether
+        one was handled (interpreter.clj:215-241)."""
+        nonlocal ctx, gen
+        try:
+            thread, op2 = done_q.get(block=block, timeout=timeout)
+        except queue.Empty:
+            return False
+        outstanding.pop(thread, None)
+        op2 = dict(op2)
+        op2.pop("exception", None)
+        op2["time"] = relative_time_nanos()
+        ctx = ctx.with_(
+            time=max(ctx.time, op2["time"]),
+            free_threads=ctx.free_threads | {thread},
+        )
+        gen = gen_update(gen, test, ctx, op2)
+        # Client crash ⇒ fresh process id for this thread
+        # (interpreter.clj:233-236).
+        if thread != NEMESIS and op2.get("type") == INFO:
+            new_workers = dict(ctx.workers)
+            new_workers[thread] = next_process(ctx, thread)
+            ctx = ctx.with_(workers=new_workers)
+        if goes_in_history(op2):
+            history.append(op2)
+        return True
 
     try:
         while True:
-            # 1. Completions first (interpreter.clj:215-241).
-            completed = None
-            for t, w in list(workers.items()):
-                if t not in outstanding:
-                    continue
-                op2 = w.poll()
-                if op2 is None:
-                    continue
-                completed = True
-                outstanding.pop(t)
-                op2 = dict(op2)
-                op2.pop("exception", None)
-                op2["time"] = relative_time_nanos()
-                thread = t
-                ctx = ctx.with_(
-                    time=max(ctx.time, op2["time"]),
-                    free_threads=ctx.free_threads | {thread},
-                )
-                gen = gen_update(gen, test, ctx, op2)
-                # Client crash ⇒ fresh process id for this thread
-                # (interpreter.clj:233-236).
-                if thread != NEMESIS and op2.get("type") == INFO:
-                    new_workers = dict(ctx.workers)
-                    new_workers[thread] = next_process(ctx, thread)
-                    ctx = ctx.with_(workers=new_workers)
-                if goes_in_history(op2):
-                    history.append(op2)
-                poll_timeout = 0.0
-            if completed:
+            # 1. Completions first (drain whatever has arrived).
+            if take_completion(block=False):
                 continue
 
             # 2. Ask the generator (interpreter.clj:244-292).
@@ -269,20 +266,23 @@ def run(test: dict) -> list[dict]:
             if res is None:
                 # Exhausted: wait for stragglers, then shut workers down.
                 if outstanding:
-                    _time.sleep(poll_timeout or MAX_PENDING_INTERVAL_S)
-                    poll_timeout = MAX_PENDING_INTERVAL_S
+                    take_completion(block=True,
+                                    timeout=MAX_PENDING_INTERVAL_S)
                     continue
                 break
             op_, gen2 = res
             now = relative_time_nanos()
             if op_ is PENDING:
-                _time.sleep(MAX_PENDING_INTERVAL_S)
+                # Wake on the next completion rather than spinning.
+                take_completion(block=True, timeout=MAX_PENDING_INTERVAL_S)
                 continue
             if op_["time"] > now:
                 # Future op: sleep towards it, but wake early for
                 # completions (interpreter.clj:268-275).
-                _time.sleep(
-                    min((op_["time"] - now) / 1e9, MAX_PENDING_INTERVAL_S)
+                take_completion(
+                    block=True,
+                    timeout=min((op_["time"] - now) / 1e9,
+                                MAX_PENDING_INTERVAL_S),
                 )
                 continue
             # Dispatch. The op keeps its scheduled :time.
@@ -310,9 +310,6 @@ def run(test: dict) -> list[dict]:
         # stuck in a client call are daemon threads; exit ops queue behind
         # whatever they're doing.
         for t, w in workers.items():
-            if t in outstanding:
-                # Wait briefly for in-flight ops so exit can enqueue.
-                w.poll()
             try:
                 w.inbox.put({"type": "exit"}, timeout=1.0)
             except queue.Full:

@@ -167,7 +167,7 @@ class ConcurrentGenerator(gen.Generator):
                 if g is None:
                     break
                 gctx = gen.on_threads_context(
-                    lambda t, grp=gt[group]: t in grp, ctx
+                    gen._in_set_pred(frozenset(gt[group])), ctx
                 )
                 res = gen.op(g, test, gctx)
                 if res is None:

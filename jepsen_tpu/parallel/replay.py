@@ -34,7 +34,7 @@ def find_histories(root: Any = None, name: Optional[str] = None,
                 stamped.append((start, f))
     stamped.sort(key=lambda sf: sf[0], reverse=True)
     out = [f for _s, f in stamped]
-    if limit:
+    if limit is not None:
         out = out[:limit]
     return out
 
@@ -43,22 +43,22 @@ def replay(model: Model, paths: Sequence[Path], mesh=None, f: int = 256,
            write_results: bool = True) -> list[dict]:
     """Decide every stored history in one batched device program; returns
     one result map per path (order preserved)."""
+    paths = [Path(p) for p in paths]
     histories = []
-    kept: list[Path] = []
     for p in paths:
         try:
             histories.append(History.load(p))
-            kept.append(Path(p))
         except Exception:
             LOG.warning("could not load %s", p, exc_info=True)
             histories.append(None)
-            kept.append(Path(p))
     # Guard against model/workload mismatches: a history whose ops the
-    # model encoder drops entirely would be vacuously "valid".
+    # model encoder drops entirely would be vacuously "valid". Encode
+    # once here and hand the encodings straight to the batch checker.
     from ..ops.encode import encode_history
 
     results: list[Optional[dict]] = []
     idx = []
+    encs = []
     for i, h in enumerate(histories):
         if h is None:
             results.append({"valid": "unknown",
@@ -66,12 +66,12 @@ def replay(model: Model, paths: Sequence[Path], mesh=None, f: int = 256,
             continue
         client_ops = h.client_ops()
         try:
-            enc_n = encode_history(model, client_ops).n
+            enc = encode_history(model, client_ops)
         except Exception as e:  # model can't interpret these ops at all
             results.append({"valid": "unknown",
                             "info": f"not a {model.name} history: {e}"})
             continue
-        if len(client_ops) and enc_n == 0:
+        if len(client_ops) and enc.n == 0:
             results.append({
                 "valid": "unknown",
                 "info": f"no ops matched model {model.name}; wrong "
@@ -79,15 +79,17 @@ def replay(model: Model, paths: Sequence[Path], mesh=None, f: int = 256,
             continue
         results.append(None)
         idx.append(i)
+        encs.append(enc)
     if idx:
-        batch = check_batch(
-            model, [histories[i].client_ops() for i in idx], mesh=mesh, f=f)
+        from .batch import check_encoded_batch
+
+        batch = check_encoded_batch(encs, mesh=mesh, f=f)
         for i, res in zip(idx, batch):
             results[i] = res
     if write_results:
         from ..store import edn, to_edn_value
 
-        for p, res in zip(kept, results):
+        for p, res in zip(paths, results):
             try:
                 out = p.parent / "rechecked.edn"
                 out.write_text(edn.write_string(to_edn_value(res)) + "\n")

@@ -156,8 +156,11 @@ class TestReplay:
         assert cli.run(bad, ["test", "--store-root", str(tmp_path),
                              "--concurrency", "4", "--nodes", "n1,n2"],
                        ) == cli.EXIT_INVALID
+        # The suite's DB starts at 0, so the replay model must too —
+        # the default model is the nil-init register.
         code = cli.run(cli.replay_cmd(),
-                       ["replay", "--store-root", str(tmp_path)])
+                       ["replay", "--store-root", str(tmp_path),
+                        "--model-args", '{"init": 0}'])
         assert code == cli.EXIT_INVALID  # the bad run is re-detected
         # --limit takes the newest runs globally
         from jepsen_tpu.parallel.replay import find_histories as _fh
@@ -172,3 +175,48 @@ class TestReplay:
         hs = find_histories(root=str(tmp_path))
         assert len(hs) == 4
         assert all((p.parent / "rechecked.edn").exists() for p in hs)
+        # Every GOOD run must actually re-validate — a model/DB initial-
+        # state mismatch would flag them all invalid while the exit code
+        # above still read EXIT_INVALID from the one genuinely bad run.
+        verdicts = [(p.parent / "rechecked.edn").read_text() for p in hs]
+        assert sum(":valid? true" in v for v in verdicts) == 3
+        assert sum(":valid? false" in v for v in verdicts) == 1
+
+
+class TestReferenceFormatReplay:
+    def test_reference_style_history_edn(self, tmp_path):
+        """A history.edn written in the reference's textual style
+        (Clojure map printing, keyword fs, :nemesis process) replays
+        through the store + batch checker unmodified."""
+        d = tmp_path / "consul-register" / "20180501T120000.000Z"
+        d.mkdir(parents=True)
+        (d / "history.edn").write_text("""\
+{:type :invoke, :f :write, :value 3, :process 0, :time 10, :index 0}
+{:type :info, :f :start, :value nil, :process :nemesis, :time 12, :index 1}
+{:type :ok, :f :write, :value 3, :process 0, :time 20, :index 2}
+{:type :invoke, :f :read, :value nil, :process 1, :time 30, :index 3}
+{:type :ok, :f :read, :value 3, :process 1, :time 40, :index 4}
+{:type :invoke, :f :cas, :value [3 4], :process 0, :time 50, :index 5}
+{:type :ok, :f :cas, :value [3 4], :process 0, :time 60, :index 6}
+{:type :invoke, :f :read, :value nil, :process 1, :time 70, :index 7}
+{:type :ok, :f :read, :value 4, :process 1, :time 80, :index 8}
+""")
+        code = cli.run(cli.replay_cmd(),
+                       ["replay", "--store-root", str(tmp_path)])
+        assert code == cli.EXIT_OK
+        rechecked = (d / "rechecked.edn").read_text()
+        assert ":valid? true" in rechecked
+
+        # and a non-linearizable one is refuted
+        d2 = tmp_path / "consul-register" / "20180501T120001.000Z"
+        d2.mkdir(parents=True)
+        (d2 / "history.edn").write_text("""\
+{:type :invoke, :f :write, :value 3, :process 0, :time 10, :index 0}
+{:type :ok, :f :write, :value 3, :process 0, :time 20, :index 1}
+{:type :invoke, :f :read, :value nil, :process 1, :time 30, :index 2}
+{:type :ok, :f :read, :value 9, :process 1, :time 40, :index 3}
+""")
+        code = cli.run(cli.replay_cmd(),
+                       ["replay", "--store-root", str(tmp_path)])
+        assert code == cli.EXIT_INVALID
+        assert ":valid? false" in (d2 / "rechecked.edn").read_text()
