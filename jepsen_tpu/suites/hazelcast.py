@@ -1,0 +1,264 @@
+"""Hazelcast CP-subsystem suite: locks, semaphores, id generators.
+
+The reference's hazelcast suite (hazelcast/src/jepsen/hazelcast.clj, 970
+LoC) drives the CP subsystem's FencedLock / Semaphore / unique-id
+workloads through the Java client and checks them against five custom
+knossos models (hazelcast.clj:515-649) — the BASELINE "hazelcast CP
+lock/semaphore (mutex model, 5k ops)" configuration. Those models live
+TPU-side in :mod:`jepsen_tpu.models.mutex`; this suite supplies the
+cluster plumbing:
+
+- a line-protocol **CP bridge client** (the reference ships its own
+  server directory `hazelcast/server/` with a custom jar for the same
+  reason: the stock wire protocol isn't scriptable). The bridge speaks
+  newline-delimited commands over TCP:
+  ``LOCK name`` → ``OK <fence>``, ``UNLOCK name`` → ``OK``,
+  ``SEMACQ name n`` / ``SEMREL name n`` → ``OK``, ``ID name`` →
+  ``OK <id>``, errors → ``ERR <msg>``.
+- DB lifecycle installing a JDK + the server archive and running it as a
+  daemon (hazelcast.clj's install/start mirrored onto control.util).
+- workload packaging: the mutex-family lock workloads and the semaphore
+  workload come from :mod:`jepsen_tpu.workloads.lock`; the id-gen
+  workload is checked with ``checker.unique_ids`` (hazelcast.clj:652-733
+  workload map).
+"""
+
+from __future__ import annotations
+
+import socket
+from typing import Any, Optional
+
+from .. import checker as jchecker
+from .. import cli, client as jclient, db as jdb, generator as gen
+from .. import nemesis as jnemesis, net as jnet
+from ..control import util as cu
+from ..workloads import lock as wlock
+from .. import control as c
+
+PORT = 5701
+BRIDGE_PORT = 5801
+
+
+class Bridge:
+    """Newline-delimited CP bridge protocol over one socket."""
+
+    def __init__(self, host: str, port: Optional[int] = None,
+                 timeout: float = 10.0):
+        if port is None:
+            port = BRIDGE_PORT
+        self.sock = socket.create_connection((host, port), timeout=timeout)
+        self.buf = b""
+
+    def close(self):
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+    def cmd(self, *parts: Any) -> list:
+        self.sock.sendall((" ".join(str(p) for p in parts) + "\n").encode())
+        while b"\n" not in self.buf:
+            chunk = self.sock.recv(4096)
+            if not chunk:
+                raise ConnectionError("bridge closed connection")
+            self.buf += chunk
+        line, self.buf = self.buf.split(b"\n", 1)
+        words = line.decode().strip().split()
+        if not words or words[0] == "ERR":
+            raise RuntimeError(" ".join(words[1:]) or "bridge error")
+        return words[1:]
+
+
+class LockClient(jclient.Client):
+    """acquire/release a named FencedLock; ok acquire carries the fence
+    token as its value (what FencedMutex/ReentrantFencedMutex check)."""
+
+    def __init__(self, conn: Optional[Bridge] = None, name: str = "jepsen.lock"):
+        self.conn = conn
+        self.name = name
+
+    def open(self, test, node):
+        return LockClient(Bridge(str(node)), self.name)
+
+    def invoke(self, test, op):
+        if op["f"] == "acquire":
+            try:
+                out = self.conn.cmd("LOCK", self.name)
+            except RuntimeError as e:  # try-lock timeout: definite fail
+                if "timeout" in str(e):
+                    return {**op, "type": "fail", "error": "timeout"}
+                raise
+            fence = int(out[0]) if out else None
+            return {**op, "type": "ok", "value": fence}
+        if op["f"] == "release":
+            try:
+                self.conn.cmd("UNLOCK", self.name)
+            except RuntimeError as e:
+                if "not-owner" in str(e):
+                    return {**op, "type": "fail", "error": "not-owner"}
+                raise
+            return {**op, "type": "ok"}
+        raise ValueError(f"unknown f {op['f']!r}")
+
+    def close(self, test):
+        if self.conn is not None:
+            self.conn.close()
+
+
+class SemaphoreClient(jclient.Client):
+    """acquire/release n permits of a named CP semaphore
+    (AcquiredPermitsModel semantics, hazelcast.clj:630-649)."""
+
+    def __init__(self, conn: Optional[Bridge] = None,
+                 name: str = "jepsen.sem"):
+        self.conn = conn
+        self.name = name
+
+    def open(self, test, node):
+        return SemaphoreClient(Bridge(str(node)), self.name)
+
+    def invoke(self, test, op):
+        n = int(op.get("value") or 1)
+        if op["f"] == "acquire":
+            try:
+                self.conn.cmd("SEMACQ", self.name, n)
+            except RuntimeError as e:
+                if "timeout" in str(e):
+                    return {**op, "type": "fail", "error": "timeout"}
+                raise
+            return {**op, "type": "ok"}
+        if op["f"] == "release":
+            self.conn.cmd("SEMREL", self.name, n)
+            return {**op, "type": "ok"}
+        raise ValueError(f"unknown f {op['f']!r}")
+
+    def close(self, test):
+        if self.conn is not None:
+            self.conn.close()
+
+
+class IdGenClient(jclient.Client):
+    """generate → a cluster-wide unique id (FlakeIdGenerator shape,
+    hazelcast.clj's id-gen workloads)."""
+
+    def __init__(self, conn: Optional[Bridge] = None, name: str = "jepsen.id"):
+        self.conn = conn
+        self.name = name
+
+    def open(self, test, node):
+        return IdGenClient(Bridge(str(node)), self.name)
+
+    def invoke(self, test, op):
+        out = self.conn.cmd("ID", self.name)
+        return {**op, "type": "ok", "value": int(out[0])}
+
+    def close(self, test):
+        if self.conn is not None:
+            self.conn.close()
+
+
+class HazelcastDB(jdb.DB, jdb.Process, jdb.LogFiles):
+    """JDK + server archive + daemon start (hazelcast.clj's db fn)."""
+
+    URL = ("https://repo1.maven.org/maven2/com/hazelcast/hazelcast-distribution/"
+           "5.3.6/hazelcast-distribution-5.3.6.tar.gz")
+    DIR = "/opt/hazelcast"
+    LOG = "/var/log/hazelcast.log"
+    PID = "/var/run/hazelcast.pid"
+
+    def setup(self, test, node):
+        from ..os_ import debian
+
+        debian.install(["default-jre-headless"])
+        cu.install_archive(self.URL, self.DIR)
+        self.start(test, node)
+
+    def start(self, test, node):
+        with c.su():
+            cu.start_daemon(
+                {"logfile": self.LOG, "pidfile": self.PID, "chdir": self.DIR},
+                f"{self.DIR}/bin/hz-start",
+            )
+
+    def kill(self, test, node):
+        cu.grepkill("hazelcast")
+
+    def teardown(self, test, node):
+        cu.grepkill("hazelcast")
+        with c.su():
+            c.exec("rm", "-rf", self.PID)
+
+    def log_files(self, test, node):
+        return [self.LOG]
+
+
+def id_gen_workload(opts: Optional[dict] = None) -> dict:
+    """Every ok generate must return a distinct id (unique-ids checker,
+    checker.clj:686-731)."""
+    o = dict(opts or {})
+
+    def generate(test=None, ctx=None):
+        return {"type": "invoke", "f": "generate", "value": None}
+
+    return {
+        "client": IdGenClient(),
+        "checker": jchecker.compose({
+            "unique-ids": jchecker.unique_ids(),
+            "stats": jchecker.stats(),
+        }),
+        "generator": gen.clients(
+            gen.limit(int(o.get("ops") or 500), generate)),
+    }
+
+
+def lock_workload(opts: Optional[dict] = None) -> dict:
+    """Mutex-family lock workload on the device kernel (the wiring in
+    workloads/lock.py), plus the bridge client."""
+    wl = wlock.lock_test(opts)
+    o = dict(opts or {})
+    wl["client"] = LockClient()
+    wl["generator"] = gen.clients(
+        gen.limit(int(o.get("ops") or 500), wl["generator"]))
+    return wl
+
+
+def semaphore_workload(opts: Optional[dict] = None) -> dict:
+    wl = wlock.semaphore_test(opts)
+    o = dict(opts or {})
+    wl["client"] = SemaphoreClient()
+    wl["generator"] = gen.clients(
+        gen.limit(int(o.get("ops") or 500), wl["generator"]))
+    return wl
+
+
+WORKLOADS = {
+    "lock": lock_workload,
+    "semaphore": semaphore_workload,
+    "id-gen": id_gen_workload,
+}
+
+
+def test_fn(opts: dict) -> dict:
+    name = opts.get("workload") or "lock"
+    wl = WORKLOADS[name](opts)
+    return {
+        "name": f"hazelcast-{name}",
+        "db": HazelcastDB(),
+        "net": jnet.iptables(),
+        "nemesis": jnemesis.partition_majorities_ring(),
+        **wl,
+    }
+
+
+def _add_opts(p):
+    p.add_argument("--workload", choices=sorted(WORKLOADS), default="lock")
+    p.add_argument("--model", choices=sorted(wlock.MODELS),
+                   default="fenced-mutex")
+
+
+def main(argv=None):
+    cli.main_exit(cli.single_test_cmd(test_fn, add_opts=_add_opts), argv)
+
+
+if __name__ == "__main__":
+    main()

@@ -498,3 +498,153 @@ class TestTrace:
         n = col.export_jsonl(out)
         assert n == len(col.spans)
         assert len(out.read_text().strip().split("\n")) == n
+
+
+class BridgeStub:
+    """CP-bridge line-protocol stub: a linearizable lock/semaphore/id
+    server (what the hazelcast suite's node-side bridge implements)."""
+
+    def __init__(self, sem_capacity=2, lock_timeout=3.0):
+        import socketserver
+
+        stub = self
+
+        class Handler(socketserver.StreamRequestHandler):
+            def handle(self):
+                conn_id = object()
+                while True:
+                    try:
+                        line = self.rfile.readline()
+                    except OSError:
+                        return
+                    if not line:
+                        return
+                    try:
+                        reply = stub.dispatch(conn_id, line.decode().split())
+                    except Exception as e:  # noqa: BLE001
+                        reply = f"ERR {e}"
+                    try:
+                        self.wfile.write((reply + "\n").encode())
+                    except OSError:
+                        return
+
+        self.Handler = Handler
+        self.cond = threading.Condition()
+        self.locks: dict = {}       # name -> (conn_id, fence)
+        self.fence = [0]
+        self.sems: dict = {}        # name -> permits acquired
+        self.sem_capacity = sem_capacity
+        self.ids = [0]
+        self.lock_timeout = lock_timeout
+
+    def dispatch(self, conn_id, words) -> str:
+        cmd, name = words[0], words[1]
+        import time as _t
+
+        with self.cond:
+            if cmd == "LOCK":
+                deadline = _t.monotonic() + self.lock_timeout
+                while name in self.locks:
+                    left = deadline - _t.monotonic()
+                    if left <= 0:
+                        return "ERR timeout"
+                    self.cond.wait(left)
+                self.fence[0] += 1
+                self.locks[name] = (conn_id, self.fence[0])
+                return f"OK {self.fence[0]}"
+            if cmd == "UNLOCK":
+                held = self.locks.get(name)
+                if held is None or held[0] is not conn_id:
+                    return "ERR not-owner"
+                del self.locks[name]
+                self.cond.notify_all()
+                return "OK"
+            if cmd == "SEMACQ":
+                n = int(words[2])
+                deadline = _t.monotonic() + self.lock_timeout
+                while self.sems.get(name, 0) + n > self.sem_capacity:
+                    left = deadline - _t.monotonic()
+                    if left <= 0:
+                        return "ERR timeout"
+                    self.cond.wait(left)
+                self.sems[name] = self.sems.get(name, 0) + n
+                return "OK"
+            if cmd == "SEMREL":
+                n = int(words[2])
+                self.sems[name] = max(self.sems.get(name, 0) - n, 0)
+                self.cond.notify_all()
+                return "OK"
+            if cmd == "ID":
+                self.ids[0] += 1
+                return f"OK {self.ids[0]}"
+        return "ERR unknown"
+
+
+class TestHazelcastSuite:
+    @pytest.fixture()
+    def bridge(self, monkeypatch):
+        import socketserver
+
+        from jepsen_tpu.suites import hazelcast as hz
+
+        stub = BridgeStub()
+        srv = socketserver.ThreadingTCPServer(("127.0.0.1", 0), stub.Handler)
+        srv.daemon_threads = True
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        monkeypatch.setattr(hz, "BRIDGE_PORT", srv.server_address[1])
+        yield hz, stub
+        srv.shutdown()
+        srv.server_close()
+
+    def _run(self, hz, tmp_path, workload, opts=None):
+        test = dict(noop_test())
+        wl = hz.WORKLOADS[workload](dict(opts or {}))
+        test.update(
+            name=f"hazelcast-{workload}-stub",
+            nodes=["127.0.0.1"],
+            concurrency=4,
+            **{"store-root": str(tmp_path)},
+            client=wl["client"],
+            checker=wl["checker"],
+            generator=wl["generator"],
+        )
+        return core.run(test)
+
+    def test_fenced_lock_against_stub(self, bridge, tmp_path):
+        hz, _stub = bridge
+        res = self._run(hz, tmp_path, "lock",
+                        {"model": "fenced-mutex", "ops": 40})
+        assert res["results"]["valid"] is True, res["results"]
+        oks = [op for op in res["history"]
+               if op.type == "ok" and op.f == "acquire"]
+        assert oks and all(isinstance(op.value, int) for op in oks)
+        fences = [op.value for op in sorted(oks, key=lambda o: o.time)]
+        assert fences == sorted(fences)
+
+    def test_semaphore_against_stub(self, bridge, tmp_path):
+        hz, _stub = bridge
+        res = self._run(hz, tmp_path, "semaphore",
+                        {"capacity": 2, "ops": 40})
+        assert res["results"]["valid"] is True, res["results"]
+
+    def test_id_gen_against_stub(self, bridge, tmp_path):
+        hz, _stub = bridge
+        res = self._run(hz, tmp_path, "id-gen", {"ops": 60})
+        assert res["results"]["valid"] is True, res["results"]
+        assert res["results"]["unique-ids"]["acknowledged_count"] > 0
+        assert res["results"]["unique-ids"]["duplicated_count"] == 0
+
+    def test_db_commands(self):
+        from jepsen_tpu.suites import hazelcast as hz
+
+        test = dict(noop_test())
+        log: list = []
+        c.setup_sessions(test, c.dummy(log, responses={
+            r"mktemp": "/tmp/jepsen.x\n"}))
+        db = hz.HazelcastDB()
+        try:
+            c.on_nodes(test, lambda t, n: db.start(t, n), ["n1"])
+        except Exception:
+            pass
+        cmds = [cmd for _n, cmd in log]
+        assert any("hz-start" in cmd for cmd in cmds)
