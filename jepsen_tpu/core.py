@@ -62,8 +62,8 @@ def _with_sessions(test: dict):
     sessions map (may be empty when no remote is configured — the
     in-process fake-cluster path)."""
     remote = test.get("remote")
-    if remote is None:
-        return None
+    if remote is None and not test.get("ssh"):
+        return None  # in-process fake cluster: no control plane at all
     from . import control
 
     return control.setup_sessions(test, remote)
@@ -220,7 +220,7 @@ def run(test: dict) -> dict:
         osys: jos.OS = test.get("os") or jos.noop()
         nodes = test.get("nodes") or []
         try:
-            real_pmap(lambda n: osys.setup(test, n), nodes)
+            jdb._on_nodes(test, osys.setup, nodes)
             try:
                 jdb.cycle(test)
                 with with_relative_time():
@@ -239,7 +239,7 @@ def run(test: dict) -> dict:
                         LOG.warning("DB teardown failed", exc_info=True)
         finally:
             try:
-                real_pmap(lambda n: osys.teardown(test, n), nodes)
+                jdb._on_nodes(test, osys.teardown, nodes)
             except Exception:
                 LOG.warning("OS teardown failed", exc_info=True)
             if sessions is not None:

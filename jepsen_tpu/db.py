@@ -20,7 +20,18 @@ from __future__ import annotations
 import logging
 from typing import Any, Iterable, Optional
 
+from . import control as c
 from .util import real_pmap
+
+
+def _on_nodes(test: dict, f, nodes) -> None:
+    """Run f(test, node) per node with its control session bound; the
+    in-process fake-cluster path (no sessions) calls f directly."""
+    nodes = list(nodes)
+    if test.get("sessions"):
+        c.on_nodes(test, f, nodes)
+    else:
+        real_pmap(lambda n: f(test, n), nodes)
 
 LOG = logging.getLogger("jepsen.db")
 
@@ -96,20 +107,20 @@ def cycle(test: dict, retries: int = 3) -> None:
     while True:
         attempt += 1
         try:
-            real_pmap(lambda n: db.teardown(test, n), nodes)
-            real_pmap(lambda n: db.setup(test, n), nodes)
+            _on_nodes(test, db.teardown, nodes)
+            _on_nodes(test, db.setup, nodes)
             break
         except SetupFailed:
             if attempt > retries:
                 raise
             LOG.warning("DB setup failed; retrying (%d/%d)", attempt, retries)
     if isinstance(db, Primary) and nodes:
-        db.setup_primary(test, nodes[0])
+        _on_nodes(test, db.setup_primary, [nodes[0]])
 
 
 def teardown_all(test: dict) -> None:
     db: DB = test.get("db") or noop()
-    real_pmap(lambda n: db.teardown(test, n), test.get("nodes") or [])
+    _on_nodes(test, db.teardown, test.get("nodes") or [])
 
 
 class Tcpdump(DB, LogFiles):

@@ -1,0 +1,132 @@
+"""Node-side CP bridge daemon for the hazelcast suite.
+
+The reference hazelcast suite ships its own server directory
+(hazelcast/server/) because the stock wire protocol isn't scriptable;
+this is the same move for this framework: a tiny TCP daemon running ON
+THE DB NODE, translating the suite's newline-delimited commands into CP
+subsystem calls through the official hazelcast-python-client (installed
+on the node during DB setup, like the reference compiles its C helpers
+on nodes, nemesis/time.clj:14-52).
+
+Protocol (one request per line, one reply per line):
+
+    LOCK <name>        -> OK <fence>   | ERR timeout | ERR <msg>
+    UNLOCK <name>      -> OK           | ERR not-owner
+    SEMACQ <name> <n>  -> OK           | ERR timeout
+    SEMREL <name> <n>  -> OK
+    ID <name>          -> OK <id>
+
+Run: python3 hz_bridge.py [--port 5801] [--member 127.0.0.1:5701]
+"""
+
+from __future__ import annotations
+
+import argparse
+import socketserver
+import sys
+import threading
+
+try:
+    import hazelcast
+except ImportError:  # surfaced at startup, not per-request
+    hazelcast = None
+
+LOCK_TIMEOUT_S = 5.0
+
+
+class Bridge(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+    def __init__(self, addr, member: str):
+        super().__init__(addr, Handler)
+        self.client = hazelcast.HazelcastClient(
+            cluster_members=[member],
+            connection_timeout=10.0,
+        )
+        self.cp = self.client.cp_subsystem
+        self.guard = threading.Lock()
+        self.locks: dict = {}
+        self.sems: dict = {}
+        self.ids: dict = {}
+
+    def lock(self, name):
+        with self.guard:
+            if name not in self.locks:
+                self.locks[name] = self.cp.get_lock(name).blocking()
+            return self.locks[name]
+
+    def sem(self, name):
+        with self.guard:
+            if name not in self.sems:
+                self.sems[name] = self.cp.get_semaphore(name).blocking()
+            return self.sems[name]
+
+    def idgen(self, name):
+        with self.guard:
+            if name not in self.ids:
+                self.ids[name] = self.client.get_flake_id_generator(
+                    name).blocking()
+            return self.ids[name]
+
+
+class Handler(socketserver.StreamRequestHandler):
+    def handle(self):
+        srv: Bridge = self.server  # type: ignore[assignment]
+        while True:
+            line = self.rfile.readline()
+            if not line:
+                return
+            try:
+                reply = self.dispatch(srv, line.decode().split())
+            except Exception as e:  # noqa: BLE001 - per-request isolation
+                reply = f"ERR {type(e).__name__}: {e}"
+            try:
+                self.wfile.write((reply + "\n").encode())
+            except OSError:
+                return
+
+    def dispatch(self, srv: Bridge, words) -> str:
+        cmd, name = words[0].upper(), words[1]
+        if cmd == "LOCK":
+            # FencedLock.try_lock(timeout) returns the fence token, or
+            # INVALID_FENCE (0) on timeout.
+            fence = srv.lock(name).try_lock_and_get_fence(LOCK_TIMEOUT_S)
+            if not fence:
+                return "ERR timeout"
+            return f"OK {fence}"
+        if cmd == "UNLOCK":
+            try:
+                srv.lock(name).unlock()
+            except Exception:  # noqa: BLE001 - not the holder
+                return "ERR not-owner"
+            return "OK"
+        if cmd == "SEMACQ":
+            n = int(words[2])
+            if not srv.sem(name).try_acquire(n, LOCK_TIMEOUT_S):
+                return "ERR timeout"
+            return "OK"
+        if cmd == "SEMREL":
+            srv.sem(name).release(int(words[2]))
+            return "OK"
+        if cmd == "ID":
+            return f"OK {srv.idgen(name).new_id()}"
+        return "ERR unknown-command"
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--port", type=int, default=5801)
+    p.add_argument("--member", default="127.0.0.1:5701")
+    args = p.parse_args(argv)
+    if hazelcast is None:
+        print("hazelcast-python-client is not installed", file=sys.stderr)
+        return 1
+    srv = Bridge(("0.0.0.0", args.port), args.member)
+    print(f"hz_bridge listening on {args.port} -> {args.member}", flush=True)
+    srv.serve_forever()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

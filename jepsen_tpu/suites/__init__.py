@@ -22,3 +22,37 @@ jepsen_tpu.cli; HTTP clients are exercised end-to-end in tests against
 in-process protocol stubs (no real cluster needed — the reference's
 suites have no unit tests at all, SURVEY §4).
 """
+
+from typing import Any, Optional  # noqa: E402
+
+from .. import generator as gen  # noqa: E402
+
+
+def std_generator(opts: Optional[dict], client_gen,
+                  final_client_gen=None, dt: float = 5.0):
+    """The canonical suite generator shape (consul.clj:48-60): a
+    time-limited phase of client load with a sleep/start/sleep/stop
+    partition cycle riding the nemesis thread, a heal, then an optional
+    fault-free final client phase (drain / final read).
+
+    The time limit wraps the WHOLE nemesis+client composite: an infinite
+    ``cycle_`` otherwise keeps the phase alive forever after a bounded
+    client generator exhausts (the interpreter only exits when every
+    sub-generator is done).
+    """
+    o = dict(opts or {})
+    tl = float(o.get("time_limit") or o.get("time-limit") or 60)
+    phases = [
+        gen.time_limit(tl, gen.nemesis(
+            gen.cycle_([
+                gen.sleep(dt),
+                {"type": "info", "f": "start", "value": None},
+                gen.sleep(dt),
+                {"type": "info", "f": "stop", "value": None},
+            ]),
+            client_gen)),
+        gen.nemesis({"type": "info", "f": "stop", "value": None}),
+    ]
+    if final_client_gen is not None:
+        phases.append(final_client_gen)
+    return gen.phases(*phases)

@@ -1,0 +1,170 @@
+"""Aerospike suite: set workload over ``aql`` on the node.
+
+The reference's aerospike suite (aerospike/, 1286 LoC, SURVEY §2.6) runs
+cas-register/counter/set workloads through the Java client with a custom
+pause-capable nemesis. Aerospike's scriptable surface without a driver
+is ``aql`` (its SQL-ish CLI), which covers the **set** workload exactly:
+each add inserts one record keyed by the element, the final read scans
+the set back, and the set / set-full checkers decide lost or stale
+elements (checker.clj:237-288,458-589). The cas/counter workloads need
+generation-guarded operate() calls the CLI doesn't expose; they are
+covered framework-wide by the ignite/consul/etcd register suites.
+
+The DB implements kill+pause (jdb.Process/jdb.Pause) so the combined
+nemesis packages can exercise the crash-recovery behavior the reference
+suite was built to probe (its nemesis SIGSTOPs asd).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Optional
+
+from .. import checker as jchecker
+from .. import cli, client as jclient, db as jdb, generator as gen
+from .. import nemesis as jnemesis, net as jnet
+from ..control import util as cu
+from .. import control as c
+from . import std_generator
+
+NS = "test"
+SET = "jepsen"
+
+
+class AqlClient(jclient.Client):
+    """add → INSERT one record per element; read → scan the whole set.
+
+    aql output is parsed line-wise: SELECT prints one JSON-ish row per
+    record; we store the element in a single integer bin ``v``."""
+
+    def __init__(self, node: Any = None):
+        self.node = node
+
+    def open(self, test, node):
+        return AqlClient(node)
+
+    def _aql(self, test, stmt: str) -> str:
+        def run(t, node):
+            return c.exec_star(
+                f"aql -c {c.escape(stmt)} -o json")
+
+        return c.on_nodes(test, run, [self.node])[self.node]
+
+    def invoke(self, test, op):
+        if op["f"] == "add":
+            v = int(op["value"])
+            self._aql(test,
+                      f"INSERT INTO {NS}.{SET} (PK, v) VALUES ('e{v}', {v})")
+            return {**op, "type": "ok"}
+        if op["f"] == "read":
+            out = self._aql(test, f"SELECT v FROM {NS}.{SET}")
+            vals = set()
+            for group in _json_groups(out):
+                for row in group:
+                    if isinstance(row, dict) and "v" in row:
+                        vals.add(int(row["v"]))
+            return {**op, "type": "ok", "value": sorted(vals)}
+        raise ValueError(f"unknown f {op['f']!r}")
+
+    def close(self, test):
+        pass
+
+
+def _json_groups(out: str):
+    """aql -o json prints one JSON array per statement (possibly with
+    trailing status lines); yield each parsed array."""
+    depth, start = 0, None
+    for i, ch in enumerate(out):
+        if ch == "[":
+            if depth == 0:
+                start = i
+            depth += 1
+        elif ch == "]":
+            depth -= 1
+            if depth == 0 and start is not None:
+                try:
+                    yield json.loads(out[start:i + 1])
+                except json.JSONDecodeError:
+                    pass
+                start = None
+
+
+class AerospikeDB(jdb.DB, jdb.Process, jdb.Pause, jdb.LogFiles):
+    LOG = "/var/log/aerospike/aerospike.log"
+
+    def setup(self, test, node):
+        from ..os_ import debian
+
+        debian.install(["aerospike-server-community", "aerospike-tools"])
+        self.start(test, node)
+
+    def start(self, test, node):
+        with c.su():
+            c.exec("service", "aerospike", "start")
+
+    def kill(self, test, node):
+        cu.grepkill("asd")
+
+    def pause(self, test, node):
+        cu.grepkill("asd", signal="STOP")
+
+    def resume(self, test, node):
+        cu.grepkill("asd", signal="CONT")
+
+    def teardown(self, test, node):
+        with c.su():
+            c.exec("service", "aerospike", "stop")
+            c.exec_star("rm -rf /opt/aerospike/data/*")
+
+    def log_files(self, test, node):
+        return [self.LOG]
+
+
+def set_workload(opts: Optional[dict] = None) -> dict:
+    """Unique adds + a final read, checked with set-full (stale/lost
+    element timelines + latencies) and the basic set checker."""
+    o = dict(opts or {})
+    counter = [0]
+
+    def add(test=None, ctx=None):
+        counter[0] += 1
+        return {"type": "invoke", "f": "add", "value": counter[0]}
+
+    load = gen.clients(gen.limit(int(o.get("ops") or 200), add))
+    final_read = gen.clients(gen.once({"type": "invoke", "f": "read",
+                                       "value": None}))
+    return {
+        "client": AqlClient(),
+        "checker": jchecker.compose({
+            "set": jchecker.set_checker(),
+            "set-full": jchecker.set_full(),
+            "stats": jchecker.stats(),
+        }),
+        "generator": gen.phases(load, final_read),
+        "load-generator": load,
+        "final-generator": final_read,
+    }
+
+
+def test_fn(opts: dict) -> dict:
+    wl = set_workload(opts)
+    db = AerospikeDB()
+    return {
+        "name": "aerospike-set",
+        "db": db,
+        "net": jnet.iptables(),
+        "nemesis": jnemesis.hammer_time("asd"),
+        **{k: v for k, v in wl.items()
+           if k not in ("generator", "load-generator", "final-generator")},
+        "generator": std_generator(
+            opts, wl["load-generator"],
+            final_client_gen=wl["final-generator"]),
+    }
+
+
+def main(argv=None):
+    cli.main_exit(cli.single_test_cmd(test_fn), argv)
+
+
+if __name__ == "__main__":
+    main()

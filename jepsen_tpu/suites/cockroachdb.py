@@ -210,11 +210,13 @@ def test_fn(opts: dict) -> dict:
         "plot": {"nemeses": pkg["perf"]},
         **{k: v for k, v in wl.items() if k != "generator"},
     }
+    # Time-limit the WHOLE nemesis+client composite: nemesis-package
+    # generators repeat on an interval forever and would otherwise keep
+    # the phase alive after the client generator exhausts.
     test["generator"] = gen.phases(
-        gen.nemesis(
-            pkg["generator"],
-            gen.time_limit(opts.get("time_limit", 60), wl["generator"]),
-        ),
+        gen.time_limit(
+            opts.get("time_limit", 60),
+            gen.nemesis(pkg["generator"], wl["generator"])),
         gen.nemesis(pkg["final-generator"]),
     )
     return test

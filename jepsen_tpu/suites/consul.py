@@ -24,6 +24,7 @@ from ..checker.timeline import html as timeline_html
 from ..control import util as cu
 from ..models import CasRegister
 from .. import control as c
+from . import std_generator
 
 PORT = 8500
 
@@ -183,16 +184,8 @@ def test_fn(opts: dict) -> dict:
         "nemesis": jnemesis.partition_random_halves(),
         **wl,
     }
-    # Partition cycle with a final heal + read phase (consul.clj:48-60).
-    test["generator"] = gen.phases(
-        gen.nemesis(
-            gen.cycle_([gen.sleep(5),
-                         {"type": "info", "f": "start"},
-                         gen.sleep(5),
-                         {"type": "info", "f": "stop"}]),
-            gen.time_limit(opts.get("time_limit", 60), wl["generator"]),
-        ),
-    )
+    # Partition cycle with a final heal phase (consul.clj:48-60).
+    test["generator"] = std_generator(opts, wl["generator"])
     return test
 
 

@@ -18,6 +18,7 @@ from .. import cli, client as jclient, db as jdb, generator as gen
 from .. import nemesis as jnemesis, net as jnet
 from ..control import util as cu
 from .. import control as c
+from . import std_generator
 
 PORT = 9200
 INDEX = "jepsen"
@@ -126,17 +127,11 @@ def test_fn(opts: dict) -> dict:
             "set": jchecker.set_checker(),
             "stats": jchecker.stats(),
         }),
-        "generator": gen.phases(
-            gen.nemesis(
-                gen.cycle_([gen.sleep(10), {"type": "info", "f": "start"},
-                            gen.sleep(10), {"type": "info", "f": "stop"}]),
-                gen.time_limit(opts.get("time_limit", 60),
-                               gen.clients(gen.stagger(0.05, add))),
-            ),
-            gen.nemesis([{"type": "info", "f": "stop"}]),
-            gen.clients(gen.once({"type": "invoke", "f": "read",
-                                  "value": None})),
-        ),
+        "generator": std_generator(
+            opts, gen.clients(gen.stagger(0.05, add)),
+            final_client_gen=gen.clients(
+                gen.once({"type": "invoke", "f": "read", "value": None})),
+            dt=10),
     }
 
 

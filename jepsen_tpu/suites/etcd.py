@@ -23,6 +23,7 @@ from ..control import util as cu
 from ..models import CasRegister
 from ..workloads import append as wa
 from .. import control as c
+from . import std_generator
 
 PORT = 2379
 
@@ -248,11 +249,7 @@ def test_fn(opts: dict) -> dict:
         "net": jnet.iptables(),
         "nemesis": jnemesis.partition_random_halves(),
         **wl,
-        "generator": gen.nemesis(
-            gen.cycle_([gen.sleep(5), {"type": "info", "f": "start"},
-                         gen.sleep(5), {"type": "info", "f": "stop"}]),
-            gen.time_limit(opts.get("time_limit", 60), wl["generator"]),
-        ),
+        "generator": std_generator(opts, wl["generator"]),
     }
 
 

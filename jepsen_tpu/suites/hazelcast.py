@@ -34,6 +34,7 @@ from .. import nemesis as jnemesis, net as jnet
 from ..control import util as cu
 from ..workloads import lock as wlock
 from .. import control as c
+from . import std_generator
 
 PORT = 5701
 BRIDGE_PORT = 5801
@@ -113,6 +114,7 @@ class SemaphoreClient(jclient.Client):
                  name: str = "jepsen.sem"):
         self.conn = conn
         self.name = name
+        self.held = 0  # permits this client acquired and hasn't released
 
     def open(self, test, node):
         return SemaphoreClient(Bridge(str(node)), self.name)
@@ -126,9 +128,17 @@ class SemaphoreClient(jclient.Client):
                 if "timeout" in str(e):
                     return {**op, "type": "fail", "error": "timeout"}
                 raise
+            self.held += n
             return {**op, "type": "ok"}
         if op["f"] == "release":
+            # Releasing permits this client never acquired would be a
+            # *client* bug the Semaphore model rightly rejects (a
+            # timed-out acquire still flip-flops to release) — guard it
+            # as a definite fail without touching the server.
+            if self.held < n:
+                return {**op, "type": "fail", "error": "none-held"}
             self.conn.cmd("SEMREL", self.name, n)
+            self.held -= n
             return {**op, "type": "ok"}
         raise ValueError(f"unknown f {op['f']!r}")
 
@@ -158,19 +168,38 @@ class IdGenClient(jclient.Client):
 
 
 class HazelcastDB(jdb.DB, jdb.Process, jdb.LogFiles):
-    """JDK + server archive + daemon start (hazelcast.clj's db fn)."""
+    """JDK + server archive + daemon start, plus the node-side CP bridge
+    daemon the clients speak to (hazelcast.clj's db fn; the bridge plays
+    the role of the reference's custom hazelcast/server/ jar)."""
 
     URL = ("https://repo1.maven.org/maven2/com/hazelcast/hazelcast-distribution/"
            "5.3.6/hazelcast-distribution-5.3.6.tar.gz")
     DIR = "/opt/hazelcast"
     LOG = "/var/log/hazelcast.log"
     PID = "/var/run/hazelcast.pid"
+    BRIDGE = "/opt/hazelcast-bridge/hz_bridge.py"
+    BRIDGE_LOG = "/var/log/hz-bridge.log"
+    BRIDGE_PID = "/var/run/hz-bridge.pid"
 
     def setup(self, test, node):
+        import os
+
         from ..os_ import debian
 
-        debian.install(["default-jre-headless"])
+        debian.install(["default-jre-headless", "python3", "python3-pip"])
         cu.install_archive(self.URL, self.DIR)
+        # Node-side CP bridge: upload the daemon + install its client
+        # library on the node (like the reference compiling bump-time.c
+        # on nodes, nemesis/time.clj:14-52).
+        with c.su():
+            c.exec("mkdir", "-p", "/opt/hazelcast-bridge")
+            c.exec_star("pip3 install --break-system-packages "
+                        "hazelcast-python-client || "
+                        "pip3 install hazelcast-python-client")
+        c.upload(
+            os.path.join(os.path.dirname(__file__), "..", "resources",
+                         "hz_bridge.py"),
+            self.BRIDGE)
         self.start(test, node)
 
     def start(self, test, node):
@@ -179,17 +208,25 @@ class HazelcastDB(jdb.DB, jdb.Process, jdb.LogFiles):
                 {"logfile": self.LOG, "pidfile": self.PID, "chdir": self.DIR},
                 f"{self.DIR}/bin/hz-start",
             )
+            cu.start_daemon(
+                {"logfile": self.BRIDGE_LOG, "pidfile": self.BRIDGE_PID,
+                 "chdir": "/opt/hazelcast-bridge"},
+                "python3", self.BRIDGE,
+                "--port", BRIDGE_PORT, "--member", f"{node}:{PORT}",
+            )
 
     def kill(self, test, node):
         cu.grepkill("hazelcast")
+        cu.grepkill("hz_bridge")
 
     def teardown(self, test, node):
         cu.grepkill("hazelcast")
+        cu.grepkill("hz_bridge")
         with c.su():
-            c.exec("rm", "-rf", self.PID)
+            c.exec("rm", "-rf", self.PID, self.BRIDGE_PID)
 
     def log_files(self, test, node):
-        return [self.LOG]
+        return [self.LOG, self.BRIDGE_LOG]
 
 
 def id_gen_workload(opts: Optional[dict] = None) -> dict:
@@ -241,19 +278,29 @@ WORKLOADS = {
 def test_fn(opts: dict) -> dict:
     name = opts.get("workload") or "lock"
     wl = WORKLOADS[name](opts)
-    return {
+    test = {
         "name": f"hazelcast-{name}",
         "db": HazelcastDB(),
         "net": jnet.iptables(),
         "nemesis": jnemesis.partition_majorities_ring(),
-        **wl,
+        **{k: v for k, v in wl.items() if k != "generator"},
     }
+    # Partition cycle riding alongside the client load (the reference
+    # suite's sleep/start/sleep/stop discipline), with a final heal;
+    # time-limited as a whole so the infinite cycle can't outlive the
+    # bounded client generator.
+    interval = int(opts.get("nemesis_interval") or 10)
+    test["generator"] = std_generator(opts, wl["generator"], dt=interval)
+    return test
 
 
 def _add_opts(p):
     p.add_argument("--workload", choices=sorted(WORKLOADS), default="lock")
     p.add_argument("--model", choices=sorted(wlock.MODELS),
                    default="fenced-mutex")
+    p.add_argument("--ops", type=int, default=5000)
+    p.add_argument("--capacity", type=int, default=2)
+    p.add_argument("--nemesis-interval", type=int, default=10)
 
 
 def main(argv=None):

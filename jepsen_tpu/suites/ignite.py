@@ -1,0 +1,200 @@
+"""Apache Ignite suite over the REST connector (register + counter).
+
+The reference's ignite suite (ignite/, 589 LoC, SURVEY §2.6) runs
+register and bank workloads through the Java thin client. Ignite also
+ships an HTTP REST connector whose atomic cache commands map exactly onto
+the register/counter workloads — ``cmd=get/put/cas/incr`` against an
+ATOMIC (or TRANSACTIONAL) cache — so this suite drives those and checks:
+
+- **register**: keyed CAS register (``cas`` with key/val/val2), per-key
+  subhistories decided on the device kernel;
+- **counter**: ``incr`` deltas with concurrent reads, checked with the
+  O(n) counter-bounds checker (checker.clj:734-792).
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.parse
+import urllib.request
+from typing import Any, Optional
+
+from .. import checker as jchecker
+from .. import independent
+from .. import cli, client as jclient, db as jdb, generator as gen
+from .. import nemesis as jnemesis, net as jnet
+from ..control import util as cu
+from ..models import CasRegister
+from .. import control as c
+from . import std_generator
+
+PORT = 8080
+CACHE = "jepsen"
+
+
+class Rest:
+    """Minimal Ignite REST-connector client."""
+
+    def __init__(self, host: str, port: Optional[int] = None,
+                 timeout: float = 5.0):
+        if port is None:
+            port = PORT
+        self.base = f"http://{host}:{port}/ignite"
+        self.timeout = timeout
+
+    def cmd(self, **params) -> Any:
+        qs = urllib.parse.urlencode({"cacheName": CACHE, **params})
+        with urllib.request.urlopen(f"{self.base}?{qs}",
+                                    timeout=self.timeout) as r:
+            res = json.loads(r.read().decode())
+        if res.get("successStatus") not in (0, None):
+            raise RuntimeError(res.get("error") or "ignite error")
+        return res.get("response")
+
+
+class RegisterClient(jclient.Client):
+    """Keyed CAS register: get / put / cas (REST cmd names)."""
+
+    def __init__(self, conn: Optional[Rest] = None):
+        self.conn = conn
+
+    def open(self, test, node):
+        return RegisterClient(Rest(str(node)))
+
+    def invoke(self, test, op):
+        kv = op["value"]
+        k, v = (kv.key, kv.value) if independent.is_tuple(kv) else kv
+        key = f"r{k}"
+        if op["f"] == "read":
+            raw = self.conn.cmd(cmd="get", key=key)
+            val = None if raw is None else int(raw)
+            return {**op, "type": "ok", "value": independent.KV(k, val)}
+        if op["f"] == "write":
+            self.conn.cmd(cmd="put", key=key, val=str(v))
+            return {**op, "type": "ok"}
+        if op["f"] == "cas":
+            old, new = v
+            # REST cas: val = new value, val2 = expected old value.
+            ok = self.conn.cmd(cmd="cas", key=key, val=str(new),
+                               val2=str(old))
+            return {**op, "type": "ok" if ok else "fail",
+                    **({} if ok else {"error": "precondition"})}
+        raise ValueError(f"unknown f {op['f']!r}")
+
+    def close(self, test):
+        pass
+
+
+class CounterClient(jclient.Client):
+    """incr deltas + reads of an atomic long (REST ``incr`` command)."""
+
+    def __init__(self, conn: Optional[Rest] = None):
+        self.conn = conn
+
+    def open(self, test, node):
+        return CounterClient(Rest(str(node)))
+
+    def invoke(self, test, op):
+        if op["f"] == "add":
+            self.conn.cmd(cmd="incr", key="counter", delta=str(op["value"]))
+            return {**op, "type": "ok"}
+        if op["f"] == "read":
+            raw = self.conn.cmd(cmd="incr", key="counter", delta="0")
+            return {**op, "type": "ok", "value": int(raw or 0)}
+        raise ValueError(f"unknown f {op['f']!r}")
+
+    def close(self, test):
+        pass
+
+
+class IgniteDB(jdb.DB, jdb.Process, jdb.LogFiles):
+    URL = ("https://archive.apache.org/dist/ignite/2.16.0/"
+           "apache-ignite-2.16.0-bin.zip")
+    DIR = "/opt/ignite"
+    LOG = "/var/log/ignite.log"
+
+    def setup(self, test, node):
+        from ..os_ import debian
+
+        debian.install(["default-jre-headless", "unzip"])
+        cu.install_archive(self.URL, self.DIR)
+        self.start(test, node)
+
+    def start(self, test, node):
+        with c.su():
+            cu.start_daemon(
+                {"logfile": self.LOG, "pidfile": "/var/run/ignite.pid",
+                 "chdir": self.DIR,
+                 "env": {"IGNITE_HOME": self.DIR}},
+                f"{self.DIR}/bin/ignite.sh",
+            )
+
+    def kill(self, test, node):
+        cu.grepkill("ignite")
+
+    def teardown(self, test, node):
+        cu.grepkill("ignite")
+        with c.su():
+            c.exec("rm", "-rf", f"{self.DIR}/work")
+
+    def log_files(self, test, node):
+        return [self.LOG]
+
+
+def register_workload(opts: Optional[dict] = None) -> dict:
+    o = dict(opts or {})
+    from ..workloads import linearizable_register as lr
+
+    wl = lr.test(dict(o, model=CasRegister(init=None)))
+    wl["client"] = RegisterClient()
+    return wl
+
+
+def counter_workload(opts: Optional[dict] = None) -> dict:
+    o = dict(opts or {})
+
+    def add(test=None, ctx=None):
+        return {"type": "invoke", "f": "add", "value": gen.rand_int(5) + 1}
+
+    def read(test=None, ctx=None):
+        return {"type": "invoke", "f": "read", "value": None}
+
+    return {
+        "client": CounterClient(),
+        "checker": jchecker.compose({
+            "counter": jchecker.counter(),
+            "stats": jchecker.stats(),
+        }),
+        "generator": gen.clients(
+            gen.limit(int(o.get("ops") or 200), gen.mix([add, add, read]))),
+    }
+
+
+WORKLOADS = {"register": register_workload, "counter": counter_workload}
+
+
+def test_fn(opts: dict) -> dict:
+    name = opts.get("workload") or "register"
+    wl = WORKLOADS[name](opts)
+    return {
+        "name": f"ignite-{name}",
+        "db": IgniteDB(),
+        "net": jnet.iptables(),
+        "nemesis": jnemesis.partition_random_halves(),
+        **{k: v for k, v in wl.items() if k != "generator"},
+        "generator": std_generator(opts, wl["generator"]),
+    }
+
+
+def _add_opts(p):
+    p.add_argument("--workload", choices=sorted(WORKLOADS),
+                   default="register")
+    p.add_argument("--ops", type=int, default=200)
+
+
+def main(argv=None):
+    cli.main_exit(cli.single_test_cmd(test_fn, add_opts=_add_opts), argv)
+
+
+if __name__ == "__main__":
+    main()

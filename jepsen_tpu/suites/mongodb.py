@@ -1,0 +1,164 @@
+"""MongoDB document-CAS suite (the mongodb-smartos/mongodb-rocks shape).
+
+The reference's mongodb suites (mongodb-smartos/ 824 LoC, mongodb-rocks/
+187 LoC, SURVEY §2.6) run document-cas and transfer workloads against
+replica sets with majority write concern. This suite drives the same
+document-cas workload through ``mongosh --eval`` on the node via the
+control session (no driver dependency): reads are ``findOne``, writes
+``findOneAndReplace`` upserts, and cas a value-guarded
+``findOneAndUpdate`` — each a single atomic document operation, so the
+per-key history is checkable against the CAS-register model on the
+device kernel.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Optional
+
+from .. import checker as jchecker
+from .. import cli, client as jclient, db as jdb, generator as gen
+from .. import independent, nemesis as jnemesis, net as jnet
+from ..control import util as cu
+from ..models import CasRegister
+from .. import control as c
+from . import std_generator
+
+DB = "jepsen"
+COLL = "cas"
+# Majority read/write concerns: without them the reference found MongoDB
+# famously non-linearizable; with them the register should check clean.
+WC = "{w: 'majority', wtimeout: 5000}"
+
+
+class MongoClient(jclient.Client):
+    """Keyed CAS register over one document per key:
+    ``{_id: <key>, v: <int>}``."""
+
+    def __init__(self, node: Any = None):
+        self.node = node
+
+    def open(self, test, node):
+        return MongoClient(node)
+
+    def _eval(self, test, script: str) -> str:
+        def run(t, node):
+            return c.exec_star(
+                f"mongosh --quiet --eval {c.escape(script)} "
+                f"{c.escape(DB)}")
+
+        return c.on_nodes(test, run, [self.node])[self.node]
+
+    def invoke(self, test, op):
+        kv = op["value"]
+        k, v = (kv.key, kv.value) if independent.is_tuple(kv) else kv
+        coll = f"db.getCollection('{COLL}')"
+        if op["f"] == "read":
+            # findOne's second positional arg is a *projection*; the only
+            # way to issue a linearizable read from mongosh is the raw
+            # find command with an explicit readConcern level.
+            out = self._eval(
+                test,
+                f"r = db.runCommand({{find: '{COLL}', "
+                f"filter: {{_id: {json.dumps(k)}}}, limit: 1, "
+                f"singleBatch: true, "
+                f"readConcern: {{level: 'linearizable'}}}}); "
+                f"d = r.cursor.firstBatch[0]; "
+                f"print(JSON.stringify(d === undefined ? null : d.v))")
+            val = json.loads(out.strip().split("\n")[-1])
+            return {**op, "type": "ok", "value": independent.KV(k, val)}
+        if op["f"] == "write":
+            self._eval(
+                test,
+                f"{coll}.findOneAndReplace({{_id: {json.dumps(k)}}}, "
+                f"{{_id: {json.dumps(k)}, v: {v}}}, "
+                f"{{upsert: true, writeConcern: {WC}}})")
+            return {**op, "type": "ok"}
+        if op["f"] == "cas":
+            old, new = v
+            out = self._eval(
+                test,
+                f"d = {coll}.findOneAndUpdate("
+                f"{{_id: {json.dumps(k)}, v: {old}}}, "
+                f"{{$set: {{v: {new}}}}}, {{writeConcern: {WC}}}); "
+                f"print(JSON.stringify(d ? d.v : null))")
+            val = json.loads(out.strip().split("\n")[-1])
+            if val is None:
+                return {**op, "type": "fail", "error": "precondition"}
+            return {**op, "type": "ok"}
+        raise ValueError(f"unknown f {op['f']!r}")
+
+    def close(self, test):
+        pass
+
+
+class MongoDB(jdb.DB, jdb.Process, jdb.LogFiles):
+    """Replica-set member lifecycle (install + mongod daemon + rs.initiate
+    from the first node, mirroring the reference suite's db fn)."""
+
+    LOG = "/var/log/mongodb-jepsen.log"
+
+    def setup(self, test, node):
+        from ..os_ import debian
+
+        debian.install(["mongodb-org-server", "mongodb-mongosh"])
+        self.start(test, node)
+        if node == (test.get("nodes") or [node])[0]:
+            members = ", ".join(
+                f"{{_id: {i}, host: '{n}:27017'}}"
+                for i, n in enumerate(test.get("nodes") or [node]))
+            c.exec_star(
+                "mongosh --quiet --eval " + c.escape(
+                    f"rs.initiate({{_id: 'jepsen', members: [{members}]}})"))
+
+    def start(self, test, node):
+        with c.su():
+            cu.start_daemon(
+                {"logfile": self.LOG, "pidfile": "/var/run/mongod.pid",
+                 "chdir": "/tmp"},
+                "/usr/bin/mongod",
+                "--replSet", "jepsen", "--bind_ip_all",
+                "--dbpath", "/var/lib/mongodb",
+            )
+
+    def kill(self, test, node):
+        cu.grepkill("mongod")
+
+    def teardown(self, test, node):
+        cu.grepkill("mongod")
+        with c.su():
+            c.exec("rm", "-rf", "/var/lib/mongodb/*")
+
+    def log_files(self, test, node):
+        return [self.LOG]
+
+
+def register_workload(opts: Optional[dict] = None) -> dict:
+    """Keyed document-cas register checked per key on the device kernel
+    (independent lift, like the reference's document-cas tests)."""
+    o = dict(opts or {})
+    from ..workloads import linearizable_register as lr
+
+    wl = lr.test(dict(o, model=CasRegister(init=None)))
+    wl["client"] = MongoClient()
+    return wl
+
+
+def test_fn(opts: dict) -> dict:
+    wl = register_workload(opts)
+    return {
+        "name": "mongodb-document-cas",
+        "db": MongoDB(),
+        "net": jnet.iptables(),
+        "nemesis": jnemesis.partition_random_halves(),
+        **{k: v for k, v in wl.items() if k != "generator"},
+        "generator": std_generator(opts, wl["generator"]),
+    }
+
+
+def main(argv=None):
+    cli.main_exit(cli.single_test_cmd(test_fn), argv)
+
+
+if __name__ == "__main__":
+    main()

@@ -17,6 +17,7 @@ from .. import nemesis as jnemesis, net as jnet
 from ..checker import Checker, checker_fn
 from ..control import util as cu
 from .. import control as c
+from . import std_generator
 
 TABLE = "jepsen.dirty"
 
@@ -175,12 +176,7 @@ def test_fn(opts: dict) -> dict:
             "dirty-reads": dirty_reads_checker(),
             "stats": jchecker.stats(),
         }),
-        "generator": gen.nemesis(
-            gen.cycle_([gen.sleep(10), {"type": "info", "f": "start"},
-                        gen.sleep(10), {"type": "info", "f": "stop"}]),
-            gen.time_limit(opts.get("time_limit", 60),
-                           gen.mix([read, write])),
-        ),
+        "generator": std_generator(opts, gen.mix([read, write]), dt=10),
     }
 
 

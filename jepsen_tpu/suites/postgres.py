@@ -18,6 +18,7 @@ from .. import nemesis as jnemesis, net as jnet
 from ..control import util as cu
 from ..workloads import append as wa
 from .. import control as c
+from . import std_generator
 
 TABLE = "jepsen_append"
 
@@ -123,11 +124,7 @@ def test_fn(opts: dict) -> dict:
         "nemesis": jnemesis.partition_random_halves(),
         "client": PsqlClient(),
         "checker": wl["checker"],
-        "generator": gen.nemesis(
-            gen.cycle_([gen.sleep(10), {"type": "info", "f": "start"},
-                         gen.sleep(10), {"type": "info", "f": "stop"}]),
-            gen.time_limit(opts.get("time_limit", 60), wl["generator"]),
-        ),
+        "generator": std_generator(opts, wl["generator"], dt=10),
     }
 
 

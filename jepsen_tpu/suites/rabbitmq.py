@@ -1,0 +1,206 @@
+"""RabbitMQ queue suite over the management HTTP API.
+
+The reference's rabbitmq suite (rabbitmq/, 340 LoC) drives a durable
+queue with single-consumer dequeues and checks it with ``checker/queue``
++ ``checker/total-queue`` (SURVEY §2.6). This suite publishes and
+consumes through the management plugin's HTTP API — no AMQP client
+library — which exercises the same broker paths (publish to the default
+exchange with the queue name as routing key; basic-get with explicit
+ack mode):
+
+- ``PUT  /api/queues/%2f/<q>``                     declare durable queue
+- ``POST /api/exchanges/%2f/amq.default/publish``  enqueue
+- ``POST /api/queues/%2f/<q>/get``                 dequeue (ack mode)
+
+Dequeue uses ``ackmode=ack_requeue_false`` so a delivered message is
+consumed exactly once by the broker's accounting — the total-queue
+checker then decides whether every acknowledged enqueue was dequeued
+(lost/duplicated multiset semantics, checker.clj:625-684).
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import urllib.error
+import urllib.request
+from typing import Any, Optional
+
+from .. import checker as jchecker
+from .. import cli, client as jclient, db as jdb, generator as gen
+from .. import nemesis as jnemesis, net as jnet
+from ..control import util as cu
+from .. import control as c
+from . import std_generator
+
+PORT = 15672  # management API
+QUEUE = "jepsen.queue"
+USER = "guest"
+PASSWORD = "guest"
+
+
+class Mgmt:
+    """Minimal management-API client (basic-auth JSON over HTTP)."""
+
+    def __init__(self, host: str, port: Optional[int] = None,
+                 timeout: float = 10.0):
+        if port is None:
+            port = PORT
+        self.base = f"http://{host}:{port}"
+        self.timeout = timeout
+        tok = base64.b64encode(f"{USER}:{PASSWORD}".encode()).decode()
+        self.auth = f"Basic {tok}"
+
+    def req(self, method: str, path: str, body: Optional[dict] = None):
+        data = None if body is None else json.dumps(body).encode()
+        r = urllib.request.Request(
+            self.base + path, data=data, method=method,
+            headers={"Content-Type": "application/json",
+                     "Authorization": self.auth})
+        with urllib.request.urlopen(r, timeout=self.timeout) as resp:
+            raw = resp.read()
+            return json.loads(raw) if raw else None
+
+    def declare_queue(self, q: str = QUEUE) -> None:
+        self.req("PUT", f"/api/queues/%2f/{q}",
+                 {"durable": True, "auto_delete": False})
+
+    def publish(self, payload: str, q: str = QUEUE) -> bool:
+        res = self.req("POST", "/api/exchanges/%2f/amq.default/publish", {
+            "properties": {"delivery_mode": 2},
+            "routing_key": q,
+            "payload": payload,
+            "payload_encoding": "string",
+        })
+        return bool(res and res.get("routed"))
+
+    def get(self, q: str = QUEUE, count: int = 1) -> list:
+        res = self.req("POST", f"/api/queues/%2f/{q}/get", {
+            "count": count,
+            "ackmode": "ack_requeue_false",
+            "encoding": "auto",
+        })
+        return res or []
+
+
+class QueueClient(jclient.Client):
+    """enqueue/dequeue/drain over the management API; an unrouted publish
+    is a definite fail, an HTTP error on publish is indeterminate (the
+    broker may have enqueued before the connection died)."""
+
+    def __init__(self, conn: Optional[Mgmt] = None):
+        self.conn = conn
+
+    def open(self, test, node):
+        return QueueClient(Mgmt(str(node)))
+
+    def setup(self, test):
+        self.conn.declare_queue()
+
+    def invoke(self, test, op):
+        f = op["f"]
+        if f == "enqueue":
+            routed = self.conn.publish(str(op["value"]))
+            return {**op, "type": "ok" if routed else "fail"}
+        if f == "dequeue":
+            msgs = self.conn.get()
+            if not msgs:
+                return {**op, "type": "fail", "error": "empty"}
+            return {**op, "type": "ok", "value": int(msgs[0]["payload"])}
+        if f == "drain":
+            drained = []
+            while True:
+                msgs = self.conn.get(count=64)
+                if not msgs:
+                    break
+                drained.extend(int(m["payload"]) for m in msgs)
+            return {**op, "type": "ok", "value": drained}
+        raise ValueError(f"unknown f {f!r}")
+
+    def close(self, test):
+        pass
+
+
+class RabbitDB(jdb.DB, jdb.Process, jdb.LogFiles):
+    """apt install + management plugin + daemon lifecycle (the reference
+    suite's db fn shape)."""
+
+    LOG = "/var/log/rabbitmq/jepsen.log"
+
+    def setup(self, test, node):
+        from ..os_ import debian
+
+        debian.install(["rabbitmq-server"])
+        with c.su():
+            c.exec("rabbitmq-plugins", "enable", "rabbitmq_management")
+        self.start(test, node)
+
+    def start(self, test, node):
+        with c.su():
+            c.exec("service", "rabbitmq-server", "start")
+
+    def kill(self, test, node):
+        with c.su():
+            cu.grepkill("beam.smp")
+
+    def teardown(self, test, node):
+        with c.su():
+            c.exec("service", "rabbitmq-server", "stop")
+
+    def log_files(self, test, node):
+        return [self.LOG]
+
+
+def queue_workload(opts: Optional[dict] = None) -> dict:
+    """Enqueue/dequeue mix + final drain, checked with total-queue +
+    queue (duplicates allowed only when delivery is at-least-once; the
+    ack_requeue_false mode makes loss the interesting signal)."""
+    o = dict(opts or {})
+    counter = [0]
+
+    def enq(test=None, ctx=None):
+        counter[0] += 1
+        return {"type": "invoke", "f": "enqueue", "value": counter[0]}
+
+    def deq(test=None, ctx=None):
+        return {"type": "invoke", "f": "dequeue", "value": None}
+
+    load = gen.clients(gen.limit(int(o.get("ops") or 200),
+                                 gen.mix([enq, deq])))
+    drain = gen.clients(gen.each_thread({"type": "invoke", "f": "drain",
+                                         "value": None}))
+    return {
+        "client": QueueClient(),
+        "checker": jchecker.compose({
+            "total-queue": jchecker.total_queue(),
+            "stats": jchecker.stats(),
+        }),
+        "generator": gen.phases(load, drain),
+        # For test_fn: load and drain separately, so the nemesis cycle
+        # can ride the load phase and the drain runs healed.
+        "load-generator": load,
+        "final-generator": drain,
+    }
+
+
+def test_fn(opts: dict) -> dict:
+    wl = queue_workload(opts)
+    return {
+        "name": "rabbitmq-queue",
+        "db": RabbitDB(),
+        "net": jnet.iptables(),
+        "nemesis": jnemesis.partition_random_halves(),
+        **{k: v for k, v in wl.items()
+           if k not in ("generator", "load-generator", "final-generator")},
+        "generator": std_generator(
+            opts, wl["load-generator"],
+            final_client_gen=wl["final-generator"]),
+    }
+
+
+def main(argv=None):
+    cli.main_exit(cli.single_test_cmd(test_fn), argv)
+
+
+if __name__ == "__main__":
+    main()

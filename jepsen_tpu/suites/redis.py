@@ -18,6 +18,7 @@ from .. import cli, client as jclient, db as jdb, generator as gen
 from .. import nemesis as jnemesis, net as jnet
 from ..control import util as cu
 from .. import control as c
+from . import std_generator
 
 PORT = 6379
 QUEUE = "jepsen.queue"
@@ -161,18 +162,21 @@ def queue_workload(opts: Optional[dict] = None) -> dict:
     def deq(test=None, ctx=None):
         return {"type": "invoke", "f": "dequeue", "value": None}
 
+    load = gen.clients(gen.limit(int(o.get("ops") or 200),
+                                 gen.mix([enq, deq])))
+    drain = gen.clients(gen.each_thread({"type": "invoke", "f": "drain",
+                                         "value": None}))
     return {
         "client": QueueClient(),
         "checker": jchecker.compose({
             "total-queue": jchecker.total_queue(),
             "stats": jchecker.stats(),
         }),
-        "generator": gen.phases(
-            gen.clients(gen.limit(int(o.get("ops") or 200),
-                                  gen.mix([enq, deq]))),
-            gen.clients(gen.each_thread({"type": "invoke", "f": "drain",
-                                         "value": None})),
-        ),
+        "generator": gen.phases(load, drain),
+        # For test_fn: the load phase and drain phase separately, so the
+        # nemesis cycle can ride the load and the drain runs healed.
+        "load-generator": load,
+        "final-generator": drain,
     }
 
 
@@ -183,7 +187,11 @@ def test_fn(opts: dict) -> dict:
         "db": RedisDB(),
         "net": jnet.iptables(),
         "nemesis": jnemesis.partition_random_halves(),
-        **wl,
+        **{k: v for k, v in wl.items()
+           if k not in ("generator", "load-generator", "final-generator")},
+        "generator": std_generator(
+            opts, wl["load-generator"],
+            final_client_gen=wl["final-generator"]),
     }
 
 

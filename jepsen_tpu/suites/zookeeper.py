@@ -18,6 +18,7 @@ from .. import nemesis as jnemesis, net as jnet
 from ..control import util as cu
 from ..models import CasRegister
 from .. import control as c
+from . import std_generator
 
 ZNODE = "/jepsen"
 
@@ -151,13 +152,8 @@ def test_fn(opts: dict) -> dict:
             "linear": jchecker.linearizable(model=CasRegister(init=0)),
             "stats": jchecker.stats(),
         }),
-        "generator": gen.nemesis(
-            gen.cycle_([gen.sleep(5), {"type": "info", "f": "start"},
-                         gen.sleep(5), {"type": "info", "f": "stop"}]),
-            gen.time_limit(
-                opts.get("time_limit", 60),
-                gen.stagger(0.1, gen.mix([r, w, cas]))),
-        ),
+        "generator": std_generator(
+            opts, gen.stagger(0.1, gen.mix([r, w, cas]))),
     }
 
 

@@ -648,3 +648,303 @@ class TestHazelcastSuite:
             pass
         cmds = [cmd for _n, cmd in log]
         assert any("hz-start" in cmd for cmd in cmds)
+        assert any("hz_bridge.py" in cmd for cmd in cmds)
+
+
+class RabbitStub(BaseHTTPRequestHandler):
+    """Management-API stub: declare/publish/get over one in-memory
+    durable queue with basic-auth checked."""
+
+    queue: list = []
+    lock = threading.Lock()
+
+    def log_message(self, *a):
+        pass
+
+    def _reply(self, obj, code=200):
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_PUT(self):
+        self.rfile.read(int(self.headers.get("Content-Length") or 0))
+        self._reply({}, 201)
+
+    def do_POST(self):
+        assert self.headers.get("Authorization", "").startswith("Basic ")
+        req = json.loads(
+            self.rfile.read(int(self.headers.get("Content-Length") or 0)))
+        with self.lock:
+            if self.path.endswith("/publish"):
+                self.queue.append(req["payload"])
+                self._reply({"routed": True})
+                return
+            if self.path.endswith("/get"):
+                n = int(req.get("count") or 1)
+                out, self.queue[:] = self.queue[:n], self.queue[n:]
+                self._reply([{"payload": p, "payload_encoding": "string"}
+                             for p in out])
+                return
+        self._reply({"error": "not-found"}, 404)
+
+
+class TestRabbitSuite:
+    def test_queue_against_stub(self, http_stub, tmp_path):
+        from jepsen_tpu.suites import rabbitmq as rmq
+
+        RabbitStub.queue = []
+        http_stub(RabbitStub, rmq, "PORT")
+        test = dict(noop_test())
+        wl = rmq.queue_workload({"ops": 60})
+        test.update(
+            name="rabbitmq-stub",
+            nodes=["127.0.0.1"],
+            concurrency=4,
+            **{"store-root": str(tmp_path)},
+            client=wl["client"],
+            checker=wl["checker"],
+            generator=wl["generator"],
+        )
+        res = core.run(test)
+        tq = res["results"]["total-queue"]
+        assert res["results"]["valid"] is True, res["results"]
+        assert tq["lost_count"] == 0
+        assert tq["attempt_count"] > 0
+
+
+class IgniteStub(BaseHTTPRequestHandler):
+    """Ignite REST-connector stub: get/put/cas/incr over one cache."""
+
+    store: dict = {}
+    lock = threading.Lock()
+
+    def log_message(self, *a):
+        pass
+
+    def do_GET(self):
+        q = parse_qs(urlparse(self.path).query)
+        cmd = q["cmd"][0]
+        key = q.get("key", [None])[0]
+        with self.lock:
+            if cmd == "get":
+                resp = self.store.get(key)
+            elif cmd == "put":
+                self.store[key] = q["val"][0]
+                resp = True
+            elif cmd == "cas":
+                ok = self.store.get(key) == q["val2"][0]
+                if ok:
+                    self.store[key] = q["val"][0]
+                resp = ok
+            elif cmd == "incr":
+                cur = int(self.store.get(key) or 0) + int(q["delta"][0])
+                self.store[key] = str(cur)
+                resp = cur
+            else:
+                resp = None
+        body = json.dumps({"successStatus": 0, "response": resp}).encode()
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+class TestIgniteSuite:
+    def test_register_against_stub(self, http_stub, tmp_path):
+        from jepsen_tpu.suites import ignite as ig
+
+        http_stub(IgniteStub, ig, "PORT")
+        res = run_suite_register(ig, ig.RegisterClient(), tmp_path)
+        assert res["results"]["valid"] is True, res["results"]
+
+    def test_counter_against_stub(self, http_stub, tmp_path):
+        from jepsen_tpu.suites import ignite as ig
+
+        http_stub(IgniteStub, ig, "PORT")
+        test = dict(noop_test())
+        wl = ig.counter_workload({"ops": 60})
+        test.update(
+            name="ignite-counter-stub", nodes=["127.0.0.1"], concurrency=4,
+            **{"store-root": str(tmp_path)},
+            client=wl["client"], checker=wl["checker"],
+            generator=wl["generator"],
+        )
+        res = core.run(test)
+        assert res["results"]["valid"] is True, res["results"]
+        assert res["results"]["counter"]["reads"]
+        assert not res["results"]["counter"]["errors"]
+
+
+def _mongo_fake_responses():
+    """A linearizable in-memory document store behind the dummy remote,
+    answering the suite's three mongosh scripts."""
+    import re as _re
+
+    docs: dict = {}
+    lock = threading.Lock()
+
+    def respond(host, action):
+        cmd = action["cmd"]
+        m = _re.search(
+            r"runCommand\(\{find: 'cas', filter: \{_id: (\d+)\}", cmd)
+        if m:
+            assert "readConcern: {level: 'linearizable'}" in cmd
+            with lock:
+                v = docs.get(int(m.group(1)))
+            return json.dumps(v if v is not None else None) + "\n"
+        m = _re.search(
+            r"findOneAndReplace\(\{_id: (\d+)\}, \{_id: \d+, v: (\d+)\}", cmd)
+        if m:
+            with lock:
+                docs[int(m.group(1))] = int(m.group(2))
+            return "\n"
+        m = _re.search(
+            r"findOneAndUpdate\(\{_id: (\d+), v: (\d+)\}, "
+            r"\{\$set: \{v: (\d+)\}\}", cmd)
+        if m:
+            k, old, new = (int(g) for g in m.groups())
+            with lock:
+                if docs.get(k) == old:
+                    docs[k] = new
+                    return json.dumps(old) + "\n"
+            return "null\n"
+        return ""
+
+    return respond
+
+
+class TestMongoSuite:
+    def test_register_against_fake(self, tmp_path):
+        from jepsen_tpu.suites import mongodb as mg
+
+        test = dict(noop_test())
+        test.update(
+            name="mongodb-stub",
+            nodes=["n1", "n2"],
+            concurrency=4,
+            **{"store-root": str(tmp_path)},
+        )
+        c.setup_sessions(
+            test, c.dummy(responses={r"mongosh": _mongo_fake_responses()}))
+        wl = mg.register_workload({"threads-per-key": 2, "ops-per-key": 10})
+        test["checker"] = wl["checker"]
+        test["client"] = wl["client"]
+        test["generator"] = gen.clients(gen.limit(40, wl["generator"]))
+        res = core.run(test)
+        assert res["results"]["valid"] is True, res["results"]
+
+    def test_eval_command_shape(self):
+        from jepsen_tpu.suites import mongodb as mg
+
+        test = dict(noop_test())
+        log: list = []
+        c.setup_sessions(test, c.dummy(log, responses={
+            r"runCommand": "null\n"}))
+        client = mg.MongoClient().open(test, "n1")
+        client.invoke(test, {"type": "invoke", "f": "read",
+                             "value": [3, None], "process": 0})
+        cmds = [cmd for _n, cmd in log]
+        assert any("mongosh --quiet --eval" in cmd and
+                   "readConcern: {level: " in cmd for cmd in cmds)
+
+
+class TestAerospikeSuite:
+    def test_json_groups(self):
+        from jepsen_tpu.suites.aerospike import _json_groups
+
+        out = '[{"v": 1}, {"v": 2}]\n[ [1,2], {"v": 3} ]\nOK\n'
+        groups = list(_json_groups(out))
+        assert groups[0] == [{"v": 1}, {"v": 2}]
+        assert groups[1][1] == {"v": 3}
+
+    def test_set_against_fake(self, tmp_path):
+        import re as _re
+
+        from jepsen_tpu.suites import aerospike as aero
+
+        records: set = set()
+        lock = threading.Lock()
+
+        def respond(host, action):
+            cmd = action["cmd"]
+            m = _re.search(r"VALUES \('e(\d+)', (\d+)\)", cmd)
+            if m:
+                with lock:
+                    records.add(int(m.group(2)))
+                return ""
+            if "SELECT v FROM" in cmd:
+                with lock:
+                    rows = [{"v": v} for v in sorted(records)]
+                return json.dumps(rows) + "\nOK\n"
+            return ""
+
+        test = dict(noop_test())
+        test.update(
+            name="aerospike-stub", nodes=["n1"], concurrency=4,
+            **{"store-root": str(tmp_path)},
+        )
+        c.setup_sessions(test, c.dummy(responses={r"aql": respond}))
+        wl = aero.set_workload({"ops": 50})
+        test["checker"] = wl["checker"]
+        test["client"] = wl["client"]
+        test["generator"] = wl["generator"]
+        res = core.run(test)
+        assert res["results"]["valid"] is True, res["results"]
+        assert res["results"]["set"]["ok_count"] > 0
+
+
+class TestStdGenerator:
+    """Regression for the infinite-nemesis-cycle hang: the composite
+    test_fn generator shape must terminate at the time limit even though
+    the nemesis cycle itself never exhausts (code review r2)."""
+
+    def test_terminates_with_bounded_client_gen(self, tmp_path):
+        from jepsen_tpu.suites import std_generator
+        from jepsen_tpu.workloads import atom_client, AtomState
+
+        class NoopNemesis:
+            def setup(self, test):
+                return self
+
+            def invoke(self, test, op):
+                return {**op, "type": "info"}
+
+            def teardown(self, test):
+                pass
+
+        def w(test=None, ctx=None):
+            return {"type": "invoke", "f": "write", "value": 1}
+
+        test = dict(noop_test())
+        test.update(
+            name="stdgen-hang-regression",
+            nodes=["n1"],
+            concurrency=2,
+            **{"store-root": str(tmp_path)},
+            client=atom_client(AtomState()),
+            nemesis=NoopNemesis(),
+            generator=std_generator(
+                {"time_limit": 0.5},
+                gen.clients(gen.limit(5, w)),
+                final_client_gen=gen.clients(
+                    gen.once({"type": "invoke", "f": "write", "value": 9})),
+                dt=0.05),
+        )
+        import threading as _t
+
+        res_cell = []
+        th = _t.Thread(target=lambda: res_cell.append(core.run(test)),
+                       daemon=True)
+        th.start()
+        th.join(20)
+        assert not th.is_alive(), "std_generator run did not terminate"
+        res = res_cell[0]
+        writes = [op for op in res["history"]
+                  if op.f == "write" and op.type == "ok"]
+        assert writes, "client ops ran"
+        # The final fault-free phase ran after the heal.
+        assert any(op.value == 9 for op in writes)
+        # Nemesis ops made it into the history.
+        assert any(op.process == "nemesis" for op in res["history"])
