@@ -38,8 +38,9 @@ class Bridge(socketserver.ThreadingTCPServer):
     allow_reuse_address = True
     daemon_threads = True
 
-    def __init__(self, addr, member: str):
+    def __init__(self, addr, member: str, sem_capacity: int = 2):
         super().__init__(addr, Handler)
+        self.sem_capacity = sem_capacity
         self.client = hazelcast.HazelcastClient(
             cluster_members=[member],
             connection_timeout=10.0,
@@ -59,7 +60,11 @@ class Bridge(socketserver.ThreadingTCPServer):
     def sem(self, name):
         with self.guard:
             if name not in self.sems:
-                self.sems[name] = self.cp.get_semaphore(name).blocking()
+                s = self.cp.get_semaphore(name).blocking()
+                # CP semaphores start with 0 permits; init is a no-op
+                # (returns False) when already initialized.
+                s.init(self.sem_capacity)
+                self.sems[name] = s
             return self.sems[name]
 
     def idgen(self, name):
@@ -118,11 +123,13 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--port", type=int, default=5801)
     p.add_argument("--member", default="127.0.0.1:5701")
+    p.add_argument("--sem-capacity", type=int, default=2)
     args = p.parse_args(argv)
     if hazelcast is None:
         print("hazelcast-python-client is not installed", file=sys.stderr)
         return 1
-    srv = Bridge(("0.0.0.0", args.port), args.member)
+    srv = Bridge(("0.0.0.0", args.port), args.member,
+                 sem_capacity=args.sem_capacity)
     print(f"hz_bridge listening on {args.port} -> {args.member}", flush=True)
     srv.serve_forever()
     return 0

@@ -50,16 +50,11 @@ class BankClient(_SqlClient):
     (cockroach/bank.clj semantics)."""
 
     def setup(self, test):
-        accounts = list(test["accounts"])
-        total = test["total-amount"]
-        base = total // len(accounts)
-        remainder = total - base * len(accounts)
-        balances = [base + (remainder if a == accounts[0] else 0)
-                    for a in accounts]
-        rows = ", ".join(f"({a}, {b})" for a, b in zip(accounts, balances))
+        rows = ", ".join(
+            f"({a}, {b})" for a, b in wbank.initial_balances(test))
         self._sql(test,
                   f"CREATE TABLE IF NOT EXISTS {BANK_TABLE} "
-                  "(id INT PRIMARY KEY, balance INT NOT NULL);\n"
+                  "(id INT PRIMARY KEY, balance INT NOT NULL CHECK (balance >= 0));\n"
                   f"UPSERT INTO {BANK_TABLE} VALUES {rows};")
 
     def invoke(self, test, op):
@@ -80,7 +75,8 @@ class BankClient(_SqlClient):
             ]))
             return {**op, "type": "ok"}
         except c.RemoteError as e:
-            if "restart transaction" in str(e) or "retry" in str(e).lower():
+            s = str(e).lower()
+            if "restart transaction" in s or "retry" in s or "constraint" in s:
                 return {**op, "type": "fail", "error": "serialization"}
             raise
 

@@ -1,0 +1,302 @@
+"""Dgraph suite: upsert + set workloads over the HTTP API, with tracing.
+
+The reference's dgraph suite (dgraph/, 2599 LoC) runs
+bank/delete/long-fork/register/sequential/set/upsert/wr workloads and is
+the one suite with distributed tracing (OpenCensus → Jaeger,
+dgraph/src/jepsen/dgraph/trace.clj:1-74). This suite drives the alpha
+HTTP API directly:
+
+- **upsert**: the distinctive dgraph test — concurrent upserts of the
+  same ``email`` predicate must create at most ONE node per email
+  (dgraph/src/jepsen/dgraph/upsert.clj); checked by a final per-email
+  uid count.
+- **set**: unique integer inserts + final read-all, checked with the set
+  checker.
+
+Client ops ride :mod:`jepsen_tpu.trace` spans (the trace.clj analogue):
+pass ``trace=True`` in opts and every client call is recorded to a span
+collector exported into the store directory.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.request
+from typing import Any, Optional
+
+from .. import checker as jchecker
+from .. import cli, client as jclient, db as jdb, generator as gen
+from .. import nemesis as jnemesis, net as jnet, trace as jtrace
+from ..checker import Checker, checker_fn
+from ..control import util as cu
+from .. import control as c
+from . import std_generator
+
+PORT = 8080
+
+
+class Alpha:
+    """Minimal dgraph alpha HTTP client (mutate / query / alter)."""
+
+    def __init__(self, host: str, port: Optional[int] = None,
+                 timeout: float = 10.0):
+        if port is None:
+            port = PORT
+        self.base = f"http://{host}:{port}"
+        self.timeout = timeout
+
+    def _post(self, path: str, body: Any, ctype: str) -> dict:
+        req = urllib.request.Request(
+            self.base + path,
+            data=body if isinstance(body, bytes) else json.dumps(
+                body).encode(),
+            headers={"Content-Type": ctype}, method="POST")
+        with urllib.request.urlopen(req, timeout=self.timeout) as r:
+            res = json.loads(r.read().decode())
+        if res.get("errors"):
+            raise RuntimeError(json.dumps(res["errors"])[:500])
+        return res
+
+    def alter(self, schema: str) -> None:
+        self._post("/alter", schema.encode(), "application/dql")
+
+    def mutate_json(self, body: dict) -> dict:
+        return self._post("/mutate?commitNow=true", body,
+                          "application/json")
+
+    def query(self, q: str) -> dict:
+        return self._post("/query", q.encode(), "application/dql")
+
+
+class UpsertClient(jclient.Client):
+    """upsert(email) → at most one node may win; count(email) reads how
+    many exist (upsert.clj semantics via an upsert block)."""
+
+    def __init__(self, conn: Optional[Alpha] = None):
+        self.conn = conn
+
+    def open(self, test, node):
+        return UpsertClient(Alpha(str(node)))
+
+    def setup(self, test):
+        self.conn.alter("email: string @index(exact) @upsert .")
+
+    def invoke(self, test, op):
+        if op["f"] == "upsert":
+            email = f"{op['value']}@jepsen.io"
+            q = f'{{ q(func: eq(email, "{email}")) {{ u as uid }} }}'
+            try:
+                res = self.conn.mutate_json({
+                    "query": q,
+                    "cond": "@if(eq(len(u), 0))",
+                    "set": [{"email": email}],
+                })
+            except RuntimeError as e:
+                if "abort" in str(e).lower() or "conflict" in str(e).lower():
+                    return {**op, "type": "fail", "error": "aborted"}
+                raise
+            created = bool((res.get("data") or {}).get("uids"))
+            return {**op, "type": "ok" if created else "fail",
+                    **({} if created else {"error": "exists"})}
+        if op["f"] == "count":
+            email = f"{op['value']}@jepsen.io"
+            res = self.conn.query(
+                f'{{ q(func: eq(email, "{email}")) {{ uid }} }}')
+            n = len((res.get("data") or {}).get("q") or [])
+            return {**op, "type": "ok", "value": [op["value"], n]}
+        raise ValueError(f"unknown f {op['f']!r}")
+
+    def close(self, test):
+        pass
+
+
+class SetClient(jclient.Client):
+    def __init__(self, conn: Optional[Alpha] = None):
+        self.conn = conn
+
+    def open(self, test, node):
+        return SetClient(Alpha(str(node)))
+
+    def setup(self, test):
+        self.conn.alter("value: int @index(int) .")
+
+    def invoke(self, test, op):
+        if op["f"] == "add":
+            self.conn.mutate_json({"set": [{"value": int(op["value"])}]})
+            return {**op, "type": "ok"}
+        if op["f"] == "read":
+            try:
+                res = self.conn.query(
+                    "{ q(func: has(value)) { value } }")
+            except Exception:
+                return {**op, "type": "fail", "error": "http"}
+            vals = sorted(r["value"]
+                          for r in (res.get("data") or {}).get("q") or [])
+            return {**op, "type": "ok", "value": vals}
+        raise ValueError(f"unknown f {op['f']!r}")
+
+    def close(self, test):
+        pass
+
+
+class DgraphDB(jdb.DB, jdb.Process, jdb.LogFiles):
+    """zero + alpha daemons per node (dgraph/src/jepsen/dgraph/support.clj)."""
+
+    URL = "https://github.com/dgraph-io/dgraph/releases/download/v23.1.0/dgraph-linux-amd64.tar.gz"
+    DIR = "/opt/dgraph"
+    LOGS = ["/var/log/dgraph-zero.log", "/var/log/dgraph-alpha.log"]
+
+    def setup(self, test, node):
+        cu.install_archive(self.URL, self.DIR)
+        self.start(test, node)
+
+    def start(self, test, node):
+        nodes = test["nodes"]
+        i = nodes.index(node) if node in nodes else 0
+        peer = f"{nodes[0]}:5080"
+        with c.su():
+            cu.start_daemon(
+                {"logfile": self.LOGS[0],
+                 "pidfile": "/var/run/dgraph-zero.pid", "chdir": self.DIR},
+                f"{self.DIR}/dgraph", "zero",
+                "--my", f"{node}:5080",
+                *( [] if i == 0 else ["--peer", peer] ),
+                "--raft", f"idx={i + 1}",
+                "--wal", "/var/lib/dgraph/zw",
+            )
+            cu.start_daemon(
+                {"logfile": self.LOGS[1],
+                 "pidfile": "/var/run/dgraph-alpha.pid", "chdir": self.DIR},
+                f"{self.DIR}/dgraph", "alpha",
+                "--my", f"{node}:7080",
+                "--zero", peer,
+                "--postings", "/var/lib/dgraph/p",
+                "--wal", "/var/lib/dgraph/w",
+                "--security", "whitelist=0.0.0.0/0",
+            )
+
+    def kill(self, test, node):
+        cu.grepkill("dgraph")
+
+    def teardown(self, test, node):
+        cu.grepkill("dgraph")
+        with c.su():
+            c.exec("rm", "-rf", "/var/lib/dgraph")
+
+    def log_files(self, test, node):
+        return list(self.LOGS)
+
+
+def upsert_checker() -> Checker:
+    """Every final count must be ≤ 1 node per email; counts of 0 with an
+    acked upsert are lost inserts (upsert.clj checker semantics)."""
+
+    def chk(test, history, opts):
+        acked = set()
+        counts = {}
+        for op in history:
+            if op.f == "upsert" and op.is_ok:
+                acked.add(op.value)
+            elif op.f == "count" and op.is_ok:
+                k, n = op.value
+                counts[k] = max(counts.get(k, 0), n)
+        dups = {k: n for k, n in counts.items() if n > 1}
+        lost = sorted(k for k in acked if counts.get(k, 0) == 0 and counts)
+        return {
+            "valid": not dups and not lost,
+            "acked_count": len(acked),
+            "duplicates": dups,
+            "lost": lost,
+        }
+
+    return checker_fn(chk, "upsert")
+
+
+def upsert_workload(opts: Optional[dict] = None) -> dict:
+    o = dict(opts or {})
+    keys = int(o.get("keys") or 10)
+
+    def upsert(test=None, ctx=None):
+        return {"type": "invoke", "f": "upsert",
+                "value": gen.rand_int(keys)}
+
+    # A list is a generator running its elements in sequence; each
+    # thread reads every email's final count.
+    final = gen.clients(gen.each_thread(
+        [{"type": "invoke", "f": "count", "value": k}
+         for k in range(keys)]))
+    return {
+        "client": UpsertClient(),
+        "checker": jchecker.compose({
+            "upsert": upsert_checker(),
+            "stats": jchecker.stats(),
+        }),
+        "generator": gen.clients(
+            gen.limit(int(o.get("ops") or 200), upsert)),
+        "final-generator": final,
+    }
+
+
+def set_workload(opts: Optional[dict] = None) -> dict:
+    o = dict(opts or {})
+    counter = [0]
+
+    def add(test=None, ctx=None):
+        counter[0] += 1
+        return {"type": "invoke", "f": "add", "value": counter[0]}
+
+    return {
+        "client": SetClient(),
+        "checker": jchecker.compose({
+            "set": jchecker.set_checker(),
+            "stats": jchecker.stats(),
+        }),
+        "generator": gen.clients(
+            gen.limit(int(o.get("ops") or 200), add)),
+        "final-generator": gen.clients(
+            gen.once({"type": "invoke", "f": "read", "value": None})),
+    }
+
+
+WORKLOADS = {"upsert": upsert_workload, "set": set_workload}
+
+
+def test_fn(opts: dict) -> dict:
+    name = opts.get("workload") or "upsert"
+    wl = WORKLOADS[name](opts)
+    client = wl["client"]
+    collector = None
+    if opts.get("trace"):
+        collector = jtrace.Collector()
+        client = jtrace.tracing(client, collector)
+    test = {
+        "name": f"dgraph-{name}",
+        "db": DgraphDB(),
+        "net": jnet.iptables(),
+        "nemesis": jnemesis.partition_random_halves(),
+        **{k: v for k, v in wl.items()
+           if k not in ("generator", "final-generator", "client")},
+        "client": client,
+        "generator": std_generator(
+            opts, wl["generator"],
+            final_client_gen=wl.get("final-generator")),
+    }
+    if collector is not None:
+        test["trace-collector"] = collector
+    return test
+
+
+def _add_opts(p):
+    p.add_argument("--workload", choices=sorted(WORKLOADS),
+                   default="upsert")
+    p.add_argument("--ops", type=int, default=200)
+    p.add_argument("--keys", type=int, default=10)
+    p.add_argument("--trace", action="store_true")
+
+
+def main(argv=None):
+    cli.main_exit(cli.single_test_cmd(test_fn, add_opts=_add_opts), argv)
+
+
+if __name__ == "__main__":
+    main()

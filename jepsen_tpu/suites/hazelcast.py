@@ -188,6 +188,37 @@ class HazelcastDB(jdb.DB, jdb.Process, jdb.LogFiles):
 
         debian.install(["default-jre-headless", "python3", "python3-pip"])
         cu.install_archive(self.URL, self.DIR)
+        # Cluster + CP-subsystem config: explicit tcp-ip member list (no
+        # multicast surprises under partitions) and cp-member-count =
+        # cluster size — without it the CP subsystem is DISABLED and
+        # FencedLock/Semaphore silently run in unsafe non-Raft mode,
+        # which is exactly what this suite exists to rule out
+        # (hazelcast.clj's config does the same).
+        nodes = test.get("nodes") or [node]
+        members = "\n".join(
+            f"                    <member>{n}</member>" for n in nodes)
+        xml = f"""<?xml version="1.0" encoding="UTF-8"?>
+<hazelcast xmlns="http://www.hazelcast.com/schema/config">
+    <cluster-name>jepsen</cluster-name>
+    <network>
+        <port auto-increment="false">{PORT}</port>
+        <join>
+            <multicast enabled="false"/>
+            <tcp-ip enabled="true">
+{members}
+            </tcp-ip>
+        </join>
+    </network>
+    <cp-subsystem>
+        <cp-member-count>{len(nodes)}</cp-member-count>
+        <group-size>{min(len(nodes), 7) | 1}</group-size>
+    </cp-subsystem>
+</hazelcast>
+"""
+        with c.su():
+            c.exec_star(
+                f"cat > {self.DIR}/config/hazelcast.xml <<'JEPSEN_XML'\n"
+                f"{xml}\nJEPSEN_XML")
         # Node-side CP bridge: upload the daemon + install its client
         # library on the node (like the reference compiling bump-time.c
         # on nodes, nemesis/time.clj:14-52).

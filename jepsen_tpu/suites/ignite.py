@@ -61,6 +61,10 @@ class RegisterClient(jclient.Client):
     def open(self, test, node):
         return RegisterClient(Rest(str(node)))
 
+    def setup(self, test):
+        # The stock config defines no 'jepsen' cache; create it once.
+        self.conn.cmd(cmd="getorcreate")
+
     def invoke(self, test, op):
         kv = op["value"]
         k, v = (kv.key, kv.value) if independent.is_tuple(kv) else kv
@@ -74,8 +78,8 @@ class RegisterClient(jclient.Client):
             return {**op, "type": "ok"}
         if op["f"] == "cas":
             old, new = v
-            # REST cas: val = new value, val2 = expected old value.
-            ok = self.conn.cmd(cmd="cas", key=key, val=str(new),
+            # REST cas: val1 = new value, val2 = expected old value.
+            ok = self.conn.cmd(cmd="cas", key=key, val1=str(new),
                                val2=str(old))
             return {**op, "type": "ok" if ok else "fail",
                     **({} if ok else {"error": "precondition"})}
@@ -93,6 +97,9 @@ class CounterClient(jclient.Client):
 
     def open(self, test, node):
         return CounterClient(Rest(str(node)))
+
+    def setup(self, test):
+        self.conn.cmd(cmd="getorcreate")
 
     def invoke(self, test, op):
         if op["f"] == "add":

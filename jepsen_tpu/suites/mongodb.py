@@ -127,7 +127,7 @@ class MongoDB(jdb.DB, jdb.Process, jdb.LogFiles):
     def teardown(self, test, node):
         cu.grepkill("mongod")
         with c.su():
-            c.exec("rm", "-rf", "/var/lib/mongodb/*")
+            c.exec_star("rm -rf /var/lib/mongodb/*")
 
     def log_files(self, test, node):
         return [self.LOG]

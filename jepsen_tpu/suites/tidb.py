@@ -1,0 +1,238 @@
+"""TiDB suite: bank + list-append over the MySQL protocol.
+
+The reference's tidb suite (tidb/, 2611 LoC, SURVEY §2.6) runs
+register/bank/sets/long-fork/monotonic/sequential/txn workloads through
+JDBC. TiDB speaks the MySQL wire protocol, so this suite drives the
+``mysql`` CLI on the node (driver-free, like the galera suite):
+
+- **bank**: transfers inside pessimistic transactions with
+  ``SELECT ... FOR UPDATE`` guards; the total-balance invariant is the
+  snapshot-isolation probe (tests/bank.clj:41-121).
+- **append**: elle list-append over a JSON column using
+  ``JSON_ARRAY_APPEND`` in one transaction per txn-op — the dependency
+  graph is then cycle-checked on the TPU (elle/append.py).
+
+The DB lifecycle runs the three-binary topology (pd-server on every
+node, tikv-server on every node, tidb-server on every node) from the
+official tarball, mirroring tidb/src/jepsen/tidb/db.clj.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any
+
+from .. import cli, client as jclient, db as jdb, generator as gen
+from .. import nemesis as jnemesis, net as jnet
+from ..control import util as cu
+from ..workloads import append as wa
+from ..workloads import bank as wbank
+from .. import control as c
+from . import std_generator
+
+PORT = 4000
+BANK_TABLE = "jepsen.bank"
+APPEND_TABLE = "jepsen.append"
+
+
+class _SqlClient(jclient.Client):
+    """SQL via the mysql CLI against the node's tidb-server."""
+
+    def __init__(self, node: Any = None):
+        self.node = node
+
+    def open(self, test, node):
+        return type(self)(node)
+
+    def _sql(self, test, script: str) -> str:
+        def run(t, node):
+            return c.exec_star(
+                f"mysql -h 127.0.0.1 -P {PORT} -u root --batch --silent "
+                f"<<'JEPSEN_SQL'\n{script}\nJEPSEN_SQL")
+
+        return c.on_nodes(test, run, [self.node])[self.node]
+
+    @staticmethod
+    def _definite_fail(e: Exception) -> bool:
+        s = str(e).lower()
+        return ("deadlock" in s or "write conflict" in s
+                or "try again later" in s or "lock wait" in s
+                or "check constraint" in s or "constraint" in s)
+
+
+class BankClient(_SqlClient):
+    def setup(self, test):
+        rows = ", ".join(
+            f"({a}, {b})" for a, b in wbank.initial_balances(test))
+        self._sql(test,
+                  "CREATE DATABASE IF NOT EXISTS jepsen;\n"
+                  f"CREATE TABLE IF NOT EXISTS {BANK_TABLE} "
+                  "(id INT PRIMARY KEY, balance BIGINT NOT NULL CHECK (balance >= 0));\n"
+                  f"INSERT IGNORE INTO {BANK_TABLE} VALUES {rows};")
+
+    def invoke(self, test, op):
+        if op["f"] == "read":
+            out = self._sql(test, f"SELECT id, balance FROM {BANK_TABLE};")
+            lines = [l.split("\t") for l in out.strip().split("\n")
+                     if l.strip()]
+            value = {int(i): int(b) for i, b in lines}
+            return {**op, "type": "ok", "value": value}
+        v = op["value"]
+        try:
+            self._sql(test, "\n".join([
+                "BEGIN PESSIMISTIC;",
+                f"SELECT balance FROM {BANK_TABLE} "
+                f"WHERE id IN ({v['from']}, {v['to']}) FOR UPDATE;",
+                f"UPDATE {BANK_TABLE} SET balance = balance - {v['amount']} "
+                f"WHERE id = {v['from']};",
+                f"UPDATE {BANK_TABLE} SET balance = balance + {v['amount']} "
+                f"WHERE id = {v['to']};",
+                "COMMIT;",
+            ]))
+            return {**op, "type": "ok"}
+        except c.RemoteError as e:
+            if self._definite_fail(e):
+                return {**op, "type": "fail", "error": "conflict"}
+            raise
+
+
+class AppendClient(_SqlClient):
+    """List-append over a JSON column in one transaction."""
+
+    def setup(self, test):
+        self._sql(test,
+                  "CREATE DATABASE IF NOT EXISTS jepsen;\n"
+                  f"CREATE TABLE IF NOT EXISTS {APPEND_TABLE} "
+                  "(k VARCHAR(32) PRIMARY KEY, v JSON NOT NULL);")
+
+    def invoke(self, test, op):
+        stmts = ["BEGIN PESSIMISTIC;"]
+        for f, k, v in op["value"]:
+            if f == "r":
+                stmts.append(
+                    "SELECT COALESCE((SELECT v FROM "
+                    f"{APPEND_TABLE} WHERE k = '{k}'), JSON_ARRAY());")
+            else:
+                stmts.append(
+                    f"INSERT INTO {APPEND_TABLE} VALUES "
+                    f"('{k}', JSON_ARRAY({v})) ON DUPLICATE KEY UPDATE "
+                    f"v = JSON_ARRAY_APPEND(v, '$', {v});")
+        stmts.append("COMMIT;")
+        try:
+            out = self._sql(test, "\n".join(stmts))
+        except c.RemoteError as e:
+            if self._definite_fail(e):
+                return {**op, "type": "fail", "error": "conflict"}
+            raise
+        lines = [l for l in out.strip().split("\n")
+                 if l.strip().startswith("[")]
+        done = []
+        ri = 0
+        for f, k, v in op["value"]:
+            if f == "r":
+                done.append([f, k, json.loads(lines[ri])])
+                ri += 1
+            else:
+                done.append([f, k, v])
+        return {**op, "type": "ok", "value": done}
+
+
+class TidbDB(jdb.DB, jdb.Process, jdb.LogFiles):
+    """pd + tikv + tidb daemons per node (tidb/db.clj topology)."""
+
+    URL = ("https://download.pingcap.org/"
+           "tidb-community-server-v7.5.0-linux-amd64.tar.gz")
+    DIR = "/opt/tidb"
+    LOGS = ["/var/log/pd.log", "/var/log/tikv.log", "/var/log/tidb.log"]
+
+    def setup(self, test, node):
+        cu.install_archive(self.URL, self.DIR)
+        self.start(test, node)
+
+    def start(self, test, node):
+        nodes = test["nodes"]
+        initial = ",".join(f"pd{i}=http://{n}:2380"
+                           for i, n in enumerate(nodes))
+        pds = ",".join(f"http://{n}:2379" for n in nodes)
+        i = nodes.index(node) if node in nodes else 0
+        with c.su():
+            cu.start_daemon(
+                {"logfile": self.LOGS[0], "pidfile": "/var/run/pd.pid",
+                 "chdir": self.DIR},
+                f"{self.DIR}/pd-server",
+                "--name", f"pd{i}",
+                "--client-urls", "http://0.0.0.0:2379",
+                "--advertise-client-urls", f"http://{node}:2379",
+                "--peer-urls", "http://0.0.0.0:2380",
+                "--advertise-peer-urls", f"http://{node}:2380",
+                "--initial-cluster", initial,
+                "--data-dir", "/var/lib/pd",
+            )
+            cu.start_daemon(
+                {"logfile": self.LOGS[1], "pidfile": "/var/run/tikv.pid",
+                 "chdir": self.DIR},
+                f"{self.DIR}/tikv-server",
+                "--pd-endpoints", pds,
+                "--addr", "0.0.0.0:20160",
+                "--advertise-addr", f"{node}:20160",
+                "--data-dir", "/var/lib/tikv",
+            )
+            cu.start_daemon(
+                {"logfile": self.LOGS[2], "pidfile": "/var/run/tidb.pid",
+                 "chdir": self.DIR},
+                f"{self.DIR}/tidb-server",
+                "-P", PORT,
+                "--store", "tikv",
+                "--path", pds.replace("http://", ""),
+            )
+
+    def kill(self, test, node):
+        for p in ("tidb-server", "tikv-server", "pd-server"):
+            cu.grepkill(p)
+
+    def teardown(self, test, node):
+        self.kill(test, node)
+        with c.su():
+            c.exec("rm", "-rf", "/var/lib/pd", "/var/lib/tikv")
+
+    def log_files(self, test, node):
+        return list(self.LOGS)
+
+
+def bank_workload(opts: dict) -> dict:
+    wl = wbank.test(opts)
+    return {**wl, "client": BankClient()}
+
+
+def append_workload(opts: dict) -> dict:
+    wl = wa.test({"key_count": 4})
+    return {"client": AppendClient(), "generator": wl["generator"],
+            "checker": wl["checker"]}
+
+
+WORKLOADS = {"bank": bank_workload, "append": append_workload}
+
+
+def test_fn(opts: dict) -> dict:
+    name = opts.get("workload") or "bank"
+    wl = WORKLOADS[name](opts)
+    return {
+        "name": f"tidb-{name}",
+        "db": TidbDB(),
+        "net": jnet.iptables(),
+        "nemesis": jnemesis.partition_random_halves(),
+        **{k: v for k, v in wl.items() if k != "generator"},
+        "generator": std_generator(opts, wl["generator"]),
+    }
+
+
+def _add_opts(p):
+    p.add_argument("--workload", choices=sorted(WORKLOADS), default="bank")
+
+
+def main(argv=None):
+    cli.main_exit(cli.single_test_cmd(test_fn, add_opts=_add_opts), argv)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,324 @@
+"""YugabyteDB suite: a workload × nemesis matrix over ysqlsh.
+
+The reference's yugabyte suite (yugabyte/, 3567 LoC) is the most modern
+in the monorepo: namespaced workloads swept against combined nemeses
+(yugabyte/src/yugabyte/core.clj:73-161, `test-all` combinatorics
+:181-201). This suite mirrors that structure on this framework:
+
+- workloads: **append** (elle list-append over JSONB, the ysql/append
+  shape), **bank**, **set** (unique inserts + final read);
+- faults: any subset of partition/kill/pause/clock through the combined
+  nemesis-package algebra (nemesis/combined.py), exactly as the
+  reference composes master/tserver killers with partitions and skews;
+- `test-all` sweeps the workload × fault-set matrix from one CLI.
+
+Clients drive ``ysqlsh`` (YSQL is the PostgreSQL dialect) on the node;
+the DB runs master + tserver daemons per node
+(yugabyte/src/yugabyte/db.clj topology).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any
+
+from .. import checker as jchecker
+from .. import cli, client as jclient, db as jdb, generator as gen
+from ..nemesis import combined as ncombined
+from .. import net as jnet
+from ..control import util as cu
+from ..workloads import append as wa
+from ..workloads import bank as wbank
+from .. import control as c
+from . import std_generator
+
+YSQLSH = "/opt/yugabyte/bin/ysqlsh"
+BANK_TABLE = "jepsen_bank"
+APPEND_TABLE = "jepsen_append"
+SET_TABLE = "jepsen_set"
+
+
+class _YsqlClient(jclient.Client):
+    """SQL over ysqlsh on the node (yugabyte's JDBC analogue)."""
+
+    def __init__(self, node: Any = None):
+        self.node = node
+
+    def open(self, test, node):
+        return type(self)(node)
+
+    def _sql(self, test, script: str) -> str:
+        def run(t, node):
+            return c.exec_star(
+                f"{YSQLSH} -h 127.0.0.1 -U yugabyte -At <<'JEPSEN_SQL'\n"
+                f"{script}\nJEPSEN_SQL")
+
+        return c.on_nodes(test, run, [self.node])[self.node]
+
+    @staticmethod
+    def _definite_fail(e: Exception) -> bool:
+        s = str(e).lower()
+        return ("could not serialize" in s or "conflict" in s
+                or "restart read" in s or "deadlock" in s
+                or "constraint" in s)
+
+
+class BankClient(_YsqlClient):
+    def setup(self, test):
+        rows = ", ".join(
+            f"({a}, {b})" for a, b in wbank.initial_balances(test))
+        self._sql(test,
+                  f"CREATE TABLE IF NOT EXISTS {BANK_TABLE} "
+                  "(id INT PRIMARY KEY, balance BIGINT NOT NULL CHECK (balance >= 0));\n"
+                  f"INSERT INTO {BANK_TABLE} VALUES {rows} "
+                  "ON CONFLICT (id) DO NOTHING;")
+
+    def invoke(self, test, op):
+        if op["f"] == "read":
+            out = self._sql(test,
+                            f"SELECT id, balance FROM {BANK_TABLE};")
+            lines = [l.split("|") for l in out.strip().split("\n")
+                     if l.strip()]
+            value = {int(i): int(b) for i, b in lines}
+            return {**op, "type": "ok", "value": value}
+        v = op["value"]
+        try:
+            self._sql(test, "\n".join([
+                "BEGIN ISOLATION LEVEL SERIALIZABLE;",
+                f"UPDATE {BANK_TABLE} SET balance = balance - {v['amount']} "
+                f"WHERE id = {v['from']};",
+                f"UPDATE {BANK_TABLE} SET balance = balance + {v['amount']} "
+                f"WHERE id = {v['to']};",
+                "COMMIT;",
+            ]))
+            return {**op, "type": "ok"}
+        except c.RemoteError as e:
+            if self._definite_fail(e):
+                return {**op, "type": "fail", "error": "serialization"}
+            raise
+
+
+class AppendClient(_YsqlClient):
+    """ysql/append.clj: list-append over JSONB in serializable txns."""
+
+    def setup(self, test):
+        self._sql(test,
+                  f"CREATE TABLE IF NOT EXISTS {APPEND_TABLE} "
+                  "(k TEXT PRIMARY KEY, v JSONB NOT NULL);")
+
+    def invoke(self, test, op):
+        stmts = ["BEGIN ISOLATION LEVEL SERIALIZABLE;"]
+        for f, k, v in op["value"]:
+            if f == "r":
+                stmts.append(
+                    f"SELECT COALESCE((SELECT v FROM {APPEND_TABLE} "
+                    f"WHERE k = '{k}'), '[]'::jsonb);")
+            else:
+                stmts.append(
+                    f"INSERT INTO {APPEND_TABLE} VALUES ('{k}', "
+                    f"'[{v}]'::jsonb) ON CONFLICT (k) DO UPDATE SET "
+                    f"v = {APPEND_TABLE}.v || '{v}'::jsonb;")
+        stmts.append("COMMIT;")
+        try:
+            out = self._sql(test, "\n".join(stmts))
+        except c.RemoteError as e:
+            if self._definite_fail(e):
+                return {**op, "type": "fail", "error": "serialization"}
+            raise
+        lines = [l for l in out.strip().split("\n")
+                 if l.strip().startswith("[")]
+        done = []
+        ri = 0
+        for f, k, v in op["value"]:
+            if f == "r":
+                done.append([f, k, json.loads(lines[ri])])
+                ri += 1
+            else:
+                done.append([f, k, v])
+        return {**op, "type": "ok", "value": done}
+
+
+class SetClient(_YsqlClient):
+    def setup(self, test):
+        self._sql(test,
+                  f"CREATE TABLE IF NOT EXISTS {SET_TABLE} "
+                  "(v BIGINT PRIMARY KEY);")
+
+    def invoke(self, test, op):
+        if op["f"] == "add":
+            self._sql(test, f"INSERT INTO {SET_TABLE} VALUES "
+                            f"({op['value']});")
+            return {**op, "type": "ok"}
+        if op["f"] == "read":
+            try:
+                out = self._sql(test, f"SELECT v FROM {SET_TABLE};")
+            except c.RemoteError:
+                return {**op, "type": "fail", "error": "sql"}
+            vals = sorted(int(l) for l in out.strip().split("\n")
+                          if l.strip())
+            return {**op, "type": "ok", "value": vals}
+        raise ValueError(f"unknown f {op['f']!r}")
+
+
+class YugabyteDB(jdb.DB, jdb.Process, jdb.Pause, jdb.LogFiles):
+    """master + tserver daemons per node (yugabyte/db.clj)."""
+
+    URL = ("https://downloads.yugabyte.com/releases/2.20.1.3/"
+           "yugabyte-2.20.1.3-b3-linux-x86_64.tar.gz")
+    DIR = "/opt/yugabyte"
+    LOGS = ["/var/log/yb-master.log", "/var/log/yb-tserver.log"]
+
+    def setup(self, test, node):
+        cu.install_archive(self.URL, self.DIR)
+        with c.su():
+            c.exec_star(f"{self.DIR}/bin/post_install.sh || true")
+        self.start(test, node)
+
+    def start(self, test, node):
+        masters = ",".join(f"{n}:7100" for n in test["nodes"])
+        with c.su():
+            cu.start_daemon(
+                {"logfile": self.LOGS[0], "pidfile": "/var/run/yb-master.pid",
+                 "chdir": self.DIR},
+                f"{self.DIR}/bin/yb-master",
+                "--master_addresses", masters,
+                "--rpc_bind_addresses", f"{node}:7100",
+                "--fs_data_dirs", "/var/lib/yb-master",
+            )
+            cu.start_daemon(
+                {"logfile": self.LOGS[1],
+                 "pidfile": "/var/run/yb-tserver.pid", "chdir": self.DIR},
+                f"{self.DIR}/bin/yb-tserver",
+                "--tserver_master_addrs", masters,
+                "--rpc_bind_addresses", f"{node}:9100",
+                "--fs_data_dirs", "/var/lib/yb-tserver",
+                "--start_pgsql_proxy",
+                "--pgsql_proxy_bind_address", "0.0.0.0:5433",
+            )
+
+    def kill(self, test, node):
+        cu.grepkill("yb-tserver")
+        cu.grepkill("yb-master")
+
+    def pause(self, test, node):
+        cu.grepkill("yb-tserver", signal="STOP")
+
+    def resume(self, test, node):
+        cu.grepkill("yb-tserver", signal="CONT")
+
+    def teardown(self, test, node):
+        self.kill(test, node)
+        with c.su():
+            c.exec("rm", "-rf", "/var/lib/yb-master", "/var/lib/yb-tserver")
+
+    def log_files(self, test, node):
+        return list(self.LOGS)
+
+
+def bank_workload(opts: dict) -> dict:
+    wl = wbank.test(opts)
+    return {**wl, "client": BankClient()}
+
+
+def append_workload(opts: dict) -> dict:
+    wl = wa.test({"key_count": 4})
+    return {"client": AppendClient(), "generator": wl["generator"],
+            "checker": wl["checker"]}
+
+
+def set_workload(opts: dict) -> dict:
+    counter = [0]
+
+    def add(test=None, ctx=None):
+        counter[0] += 1
+        return {"type": "invoke", "f": "add", "value": counter[0]}
+
+    return {
+        "client": SetClient(),
+        "checker": jchecker.compose({
+            "set": jchecker.set_checker(),
+            "stats": jchecker.stats(),
+        }),
+        "generator": gen.clients(
+            gen.limit(int(opts.get("ops") or 200), add)),
+        "final-generator": gen.clients(
+            gen.once({"type": "invoke", "f": "read", "value": None})),
+    }
+
+
+WORKLOADS = {"bank": bank_workload, "append": append_workload,
+             "set": set_workload}
+
+
+def test_fn(opts: dict) -> dict:
+    """One cell of the workload × fault matrix (core.clj:73-161)."""
+    name = opts.get("workload") or "append"
+    wl = WORKLOADS[name](opts)
+    db = YugabyteDB()
+    raw_faults = opts.get("faults")
+    if raw_faults is None:
+        raw_faults = "partition,kill"
+    faults = [f for f in raw_faults.split(",") if f]
+    test = {
+        "name": f"yugabyte-{name}-{'+'.join(faults) or 'none'}",
+        "db": db,
+        "net": jnet.iptables(),
+    }
+    if faults:
+        pkg = ncombined.nemesis_package({
+            "db": db,
+            "interval": opts.get("nemesis_interval") or 10,
+            "faults": faults,
+        })
+        test["nemesis"] = pkg["nemesis"]
+        test["plot"] = {"nemeses": pkg["perf"]}
+        phases = [
+            gen.time_limit(
+                opts.get("time_limit", 60),
+                gen.nemesis(pkg["generator"], wl["generator"])),
+            gen.nemesis(pkg["final-generator"]),
+        ]
+        if wl.get("final-generator") is not None:
+            phases.append(wl["final-generator"])
+        test["generator"] = gen.phases(*phases)
+    else:
+        test["generator"] = std_generator(
+            opts, wl["generator"],
+            final_client_gen=wl.get("final-generator"))
+    test.update({k: v for k, v in wl.items()
+                 if k not in ("generator", "final-generator")})
+    return test
+
+
+def matrix_test_fns(opts_base: dict | None = None) -> dict:
+    """name -> test_fn closures for every workload × fault-set cell
+    (yugabyte/core.clj:181-201 `test-all` combinatorics)."""
+    fault_sets = ["partition", "kill", "partition,kill", ""]
+    fns = {}
+    for wname in WORKLOADS:
+        for faults in fault_sets:
+            label = f"{wname}-{faults.replace(',', '+') or 'none'}"
+
+            def fn(opts, _w=wname, _f=faults):
+                return test_fn({**opts, "workload": _w, "faults": _f})
+
+            fns[label] = fn
+    return fns
+
+
+def _add_opts(p):
+    p.add_argument("--workload", choices=sorted(WORKLOADS),
+                   default="append")
+    p.add_argument("--faults", default="partition,kill")
+    p.add_argument("--nemesis-interval", type=int, default=10)
+    p.add_argument("--ops", type=int, default=200)
+
+
+def main(argv=None):
+    cmds = dict(cli.single_test_cmd(test_fn, add_opts=_add_opts))
+    cmds.update(cli.test_all_cmd(matrix_test_fns()))
+    cli.main_exit(cmds, argv)
+
+
+if __name__ == "__main__":
+    main()

@@ -14,6 +14,18 @@ from .. import generator as gen
 from ..checker import Checker, checker_fn
 
 
+def initial_balances(test: dict) -> list:
+    """(account, balance) rows splitting test["total-amount"] across
+    test["accounts"], remainder on the first account — the shared setup
+    shape every SQL bank client renders into its INSERT."""
+    accounts = list(test["accounts"])
+    total = test["total-amount"]
+    base = total // len(accounts)
+    remainder = total - base * len(accounts)
+    return [(a, base + (remainder if a == accounts[0] else 0))
+            for a in accounts]
+
+
 def read_op(test=None, ctx=None):
     """bank.clj:20-23."""
     return {"type": "invoke", "f": "read"}

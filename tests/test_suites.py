@@ -948,3 +948,228 @@ class TestStdGenerator:
         assert any(op.value == 9 for op in writes)
         # Nemesis ops made it into the history.
         assert any(op.process == "nemesis" for op in res["history"])
+
+
+class DgraphStub(BaseHTTPRequestHandler):
+    """Alpha HTTP stub: upsert-block mutate + eq-query over one
+    predicate, linearizable under a lock."""
+
+    store: dict = {}      # email -> uid count (correct server: 1)
+    values: list = []
+    lock = threading.Lock()
+
+    def log_message(self, *a):
+        pass
+
+    def _reply(self, obj):
+        body = json.dumps(obj).encode()
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_POST(self):
+        raw = self.rfile.read(int(self.headers.get("Content-Length") or 0))
+        if self.path.startswith("/alter"):
+            self._reply({"data": {"code": "Success"}})
+            return
+        if self.path.startswith("/mutate"):
+            req = json.loads(raw)
+            with self.lock:
+                if "query" in req:  # upsert block
+                    import re as _re
+
+                    email = _re.search(r'eq\(email, "([^"]+)"\)',
+                                       req["query"]).group(1)
+                    if self.store.get(email):
+                        self._reply({"data": {"uids": {}}})
+                        return
+                    self.store[email] = 1
+                    self._reply({"data": {"uids": {"new": "0x1"}}})
+                    return
+                for obj in req.get("set", []):
+                    if "value" in obj:
+                        self.values.append(obj["value"])
+                self._reply({"data": {"uids": {}}})
+                return
+        if self.path.startswith("/query"):
+            q = raw.decode()
+            import re as _re
+
+            m = _re.search(r'eq\(email, "([^"]+)"\)', q)
+            with self.lock:
+                if m:
+                    n = self.store.get(m.group(1), 0)
+                    self._reply({"data": {
+                        "q": [{"uid": f"0x{i}"} for i in range(n)]}})
+                    return
+                self._reply({"data": {
+                    "q": [{"value": v} for v in self.values]}})
+                return
+        self.send_response(404)
+        self.end_headers()
+
+
+class TestDgraphSuite:
+    def test_upsert_against_stub(self, http_stub, tmp_path):
+        from jepsen_tpu.suites import dgraph as dg
+
+        DgraphStub.store = {}
+        DgraphStub.values = []
+        http_stub(DgraphStub, dg, "PORT")
+        test = dict(noop_test())
+        wl = dg.upsert_workload({"ops": 60, "keys": 5})
+        test.update(
+            name="dgraph-upsert-stub", nodes=["127.0.0.1"], concurrency=4,
+            **{"store-root": str(tmp_path)},
+            client=wl["client"], checker=wl["checker"],
+            generator=gen.phases(wl["generator"], wl["final-generator"]),
+        )
+        res = core.run(test)
+        assert res["results"]["valid"] is True, res["results"]
+        up = res["results"]["upsert"]
+        assert up["acked_count"] >= 1
+        assert not up["duplicates"]
+
+    def test_set_against_stub(self, http_stub, tmp_path):
+        from jepsen_tpu.suites import dgraph as dg
+
+        DgraphStub.store = {}
+        DgraphStub.values = []
+        http_stub(DgraphStub, dg, "PORT")
+        test = dict(noop_test())
+        wl = dg.set_workload({"ops": 40})
+        test.update(
+            name="dgraph-set-stub", nodes=["127.0.0.1"], concurrency=4,
+            **{"store-root": str(tmp_path)},
+            client=wl["client"], checker=wl["checker"],
+            generator=gen.phases(wl["generator"], wl["final-generator"]),
+        )
+        res = core.run(test)
+        assert res["results"]["valid"] is True, res["results"]
+
+    def test_traced_client(self, http_stub, tmp_path):
+        from jepsen_tpu import trace as jtrace
+        from jepsen_tpu.suites import dgraph as dg
+
+        DgraphStub.store = {}
+        http_stub(DgraphStub, dg, "PORT")
+        col = jtrace.Collector()
+        client = jtrace.tracing(dg.UpsertClient(), col)
+        client = client.open({}, "127.0.0.1")
+        client.invoke({}, {"type": "invoke", "f": "upsert", "value": 1,
+                           "process": 0})
+        assert any(s["name"] == "client.invoke" for s in col.spans)
+
+
+def _sql_fake(tables):
+    """A crude single-node SQL engine behind the dummy remote for the
+    tidb/yugabyte bank clients: understands the UPDATE balance +/- and
+    SELECT id, balance shapes."""
+    import re as _re
+
+    lock = threading.Lock()
+
+    def respond(host, action):
+        cmd = action["cmd"]
+        with lock:
+            if "SELECT id, balance" in cmd:
+                sep = "\t" if "mysql" in cmd else "|"
+                return "\n".join(f"{i}{sep}{b}"
+                                 for i, b in sorted(tables.items())) + "\n"
+            if "CREATE TABLE" in cmd or "INSERT" in cmd:
+                for m in _re.finditer(r"\((\d+), (\d+)\)", cmd):
+                    tables.setdefault(int(m.group(1)), int(m.group(2)))
+                return ""
+            moves = _re.findall(
+                r"SET balance = balance ([-+]) (\d+) WHERE id = (\d+)", cmd)
+            if moves:
+                # Enforce the table's CHECK (balance >= 0) like a real
+                # engine: abort the whole txn, apply nothing.
+                staged = dict(tables)
+                for sign, amt, acct in moves:
+                    delta = int(amt) if sign == "+" else -int(amt)
+                    staged[int(acct)] = staged.get(int(acct), 0) + delta
+                if any(b < 0 for b in staged.values()):
+                    raise c.RemoteError({
+                        "cmd": cmd, "host": host, "exit": 1, "out": "",
+                        "err": 'violates check constraint '
+                               '"bank_balance_check"'})
+                tables.update(staged)
+                return ""
+        return ""
+
+    return respond
+
+
+class TestTidbSuite:
+    def test_bank_against_fake(self, tmp_path):
+        from jepsen_tpu.suites import tidb as td
+
+        tables: dict = {}
+        test = dict(noop_test())
+        test.update(
+            name="tidb-bank-stub", nodes=["n1", "n2"], concurrency=4,
+            **{"store-root": str(tmp_path)},
+        )
+        c.setup_sessions(test, c.dummy(responses={r"mysql": _sql_fake(tables)}))
+        wl = td.bank_workload({})
+        test.update({k: v for k, v in wl.items()
+                     if k not in ("client", "checker", "generator")})
+        test["client"] = wl["client"]
+        test["checker"] = wl["checker"]
+        test["generator"] = gen.clients(gen.limit(60, wl["generator"]))
+        res = core.run(test)
+        assert res["results"]["valid"] is True, res["results"]
+
+    def test_append_sql_shape(self):
+        from jepsen_tpu.suites import tidb as td
+
+        test = dict(noop_test())
+        log: list = []
+        c.setup_sessions(test, c.dummy(log, responses={
+            r"SELECT COALESCE": "[1, 2]\n"}))
+        cl = td.AppendClient().open(test, "n1")
+        out = cl.invoke(test, {"type": "invoke", "f": "txn",
+                               "value": [["r", 1, None], ["append", 1, 3]],
+                               "process": 0})
+        assert out["type"] == "ok"
+        assert out["value"][0] == ["r", 1, [1, 2]]
+        cmds = [cmd for _n, cmd in log]
+        assert any("JSON_ARRAY_APPEND" in cmd and
+                   "BEGIN PESSIMISTIC" in cmd for cmd in cmds)
+
+
+class TestYugabyteSuite:
+    def test_bank_against_fake(self, tmp_path):
+        from jepsen_tpu.suites import yugabyte as yb
+
+        tables: dict = {}
+        test = dict(noop_test())
+        test.update(
+            name="yugabyte-bank-stub", nodes=["n1", "n2"], concurrency=4,
+            **{"store-root": str(tmp_path)},
+        )
+        c.setup_sessions(test, c.dummy(responses={r"ysqlsh": _sql_fake(tables)}))
+        wl = yb.bank_workload({})
+        test.update({k: v for k, v in wl.items()
+                     if k not in ("client", "checker", "generator")})
+        test["client"] = wl["client"]
+        test["checker"] = wl["checker"]
+        test["generator"] = gen.clients(gen.limit(60, wl["generator"]))
+        res = core.run(test)
+        assert res["results"]["valid"] is True, res["results"]
+
+    def test_matrix_shape(self):
+        from jepsen_tpu.suites import yugabyte as yb
+
+        fns = yb.matrix_test_fns()
+        assert "append-partition+kill" in fns
+        assert "bank-none" in fns
+        assert len(fns) == 3 * 4
+        t = fns["set-none"]({"time_limit": 1})
+        assert t["name"] == "yugabyte-set-none"
+        assert "nemesis" not in t
+        t2 = fns["append-partition"]({"time_limit": 1})
+        assert t2["nemesis"] is not None
+        assert "plot" in t2
