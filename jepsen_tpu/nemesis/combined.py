@@ -33,6 +33,7 @@ from .time import (
     clock_nemesis,
     random_nonempty_subset,
     reset_gen,
+    skew_gen,
     strobe_gen,
 )
 
@@ -240,17 +241,16 @@ def clock_package(opts: dict) -> Optional[dict]:
         (("reset-clock", "reset"),
          ("check-clock-offsets", "check-offsets"),
          ("strobe-clock", "strobe"),
-         ("bump-clock", "bump")): clock_nemesis(),
+         ("bump-clock", "bump"),
+         ("skew-clock", "skew")): clock_nemesis(),
     })
-    inner = gen.phases(
-        {"type": "info", "f": "check-offsets"},
-        gen.mix([reset_gen, bump_gen, strobe_gen]),
-    )
+    inner = clock_gen()
     g = gen.stagger(interval, gen.f_map({
         "reset": "reset-clock",
         "check-offsets": "check-clock-offsets",
         "strobe": "strobe-clock",
         "bump": "bump-clock",
+        "skew": "skew-clock",
     }, inner))
     return {
         "generator": g,

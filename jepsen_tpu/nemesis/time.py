@@ -38,9 +38,11 @@ def compile_c(source_path, bin_name: str) -> str:
 
 
 def compile_tools() -> None:
-    """time.clj:43-48."""
+    """time.clj:43-48 (+ the cockroach suite's adjtime slew tool,
+    cockroachdb/resources/adjtime.c)."""
     compile_c(RESOURCES / "bump_time.c", "bump-time")
     compile_c(RESOURCES / "strobe_time.c", "strobe-time")
+    compile_c(RESOURCES / "adjtime.c", "adjtime")
 
 
 def install() -> None:
@@ -95,6 +97,14 @@ def strobe_time(delta_ms: float, period_ms: float, duration_s: float) -> None:
         c.exec(f"{INSTALL_DIR}/strobe-time", delta_ms, period_ms, duration_s)
 
 
+def skew_time(delta_ms: float) -> float:
+    """Gradually slew the bound node's clock by delta ms via adjtime(3)
+    (the cockroach suite's skew fault, cockroach/nemesis.clj:101-140);
+    returns the PREVIOUS outstanding adjustment in seconds."""
+    with c.su():
+        return parse_time(c.exec(f"{INSTALL_DIR}/adjtime", delta_ms))
+
+
 class ClockNemesis(Nemesis, Reflection):
     """Clock manipulation (time.clj:98-139). Ops:
 
@@ -102,6 +112,7 @@ class ClockNemesis(Nemesis, Reflection):
     - {"f": "strobe", "value": {node: {"delta": ms, "period": ms,
                                         "duration": s}}}
     - {"f": "bump", "value": {node: delta-ms}}
+    - {"f": "skew", "value": {node: delta-ms}}   (gradual, adjtime slew)
     - {"f": "check-offsets"}
 
     Completions carry a ``clock-offsets`` {node: seconds} entry."""
@@ -144,6 +155,12 @@ class ClockNemesis(Nemesis, Reflection):
             m = op.get("value") or {}
             res = c.on_nodes(
                 test, lambda t, n: bump_time(m[n]), list(m.keys()))
+        elif f == "skew":
+            m = op.get("value") or {}
+            res = c.on_nodes(
+                test,
+                lambda t, n: (skew_time(m[n]), current_offset())[1],
+                list(m.keys()))
         else:
             raise ValueError(f"clock nemesis can't handle f={f!r}")
         return {**op, "clock-offsets": res}
@@ -155,7 +172,7 @@ class ClockNemesis(Nemesis, Reflection):
             pass
 
     def fs(self):
-        return ["reset", "strobe", "bump", "check-offsets"]
+        return ["reset", "strobe", "bump", "skew", "check-offsets"]
 
 
 def clock_nemesis() -> Nemesis:
@@ -203,10 +220,21 @@ def strobe_gen(test, ctx):
     }
 
 
+def skew_gen(test, ctx):
+    """Gradual adjtime slews, same exponential magnitudes as bump
+    (cockroach/nemesis.clj's skew schedule)."""
+    sign = [-1, 1][gen.rand_int(2)]
+    return {
+        "type": "info", "f": "skew",
+        "value": {n: sign * _exp_ms()
+                  for n in random_nonempty_subset(test["nodes"])},
+    }
+
+
 def clock_gen():
     """Random schedule of clock skews, starting with a check-offsets to
     establish a baseline (time.clj:192-198)."""
     return gen.phases(
         {"type": "info", "f": "check-offsets"},
-        gen.mix([reset_gen, bump_gen, strobe_gen]),
+        gen.mix([reset_gen, bump_gen, strobe_gen, skew_gen]),
     )

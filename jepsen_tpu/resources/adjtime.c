@@ -1,0 +1,46 @@
+/* adjtime: gradually skew the system wall clock by a signed delta given
+ * in milliseconds, using adjtime(3) so the kernel slews the clock
+ * instead of jumping it — the "skew" fault the cockroachdb suite drives
+ * alongside its bump tool (equivalent role to the reference's
+ * cockroachdb/resources/adjtime.c, consumed by
+ * cockroach/nemesis.clj:101-140). Prints the remaining outstanding
+ * adjustment (signed seconds, microsecond precision) from any previous
+ * call.
+ *
+ * usage: adjtime <delta-ms>      start slewing by delta
+ *        adjtime 0               report/cancel outstanding adjustment
+ */
+#include <stdio.h>
+#include <stdlib.h>
+#include <sys/time.h>
+
+int main(int argc, char **argv) {
+    if (argc < 2) {
+        fprintf(stderr, "usage: %s <delta-ms>\n", argv[0]);
+        return 1;
+    }
+
+    double delta_ms = atof(argv[1]);
+    long long delta_us = (long long)(delta_ms * 1000.0);
+
+    struct timeval delta, old;
+    delta.tv_sec = delta_us / 1000000LL;
+    delta.tv_usec = delta_us % 1000000LL;
+    if (delta.tv_usec < 0) {
+        delta.tv_sec -= 1;
+        delta.tv_usec += 1000000;
+    }
+
+    if (adjtime(&delta, &old) != 0) {
+        perror("adjtime");
+        return 2;
+    }
+
+    /* Normalize to one signed microsecond count so the sign prints
+     * correctly for negative outstanding adjustments. */
+    long long old_us = (long long)old.tv_sec * 1000000LL + old.tv_usec;
+    long long mag = old_us < 0 ? -old_us : old_us;
+    printf("%s%lld.%06lld\n", old_us < 0 ? "-" : "",
+           mag / 1000000LL, mag % 1000000LL);
+    return 0;
+}

@@ -1,0 +1,263 @@
+"""Chronos suite: job-scheduler run verification.
+
+The reference's chronos suite (chronos/, 847 LoC, SURVEY §2.6) is the
+one suite whose checker is about TIME, not data: jobs are submitted with
+(start, interval, count, epsilon, duration); each run appends a
+timestamp to a per-job file on the node it ran on; the checker computes
+every job's target windows ``[start + i*interval, +epsilon]`` and
+verifies a run landed in each window that closed while the cluster was
+obligated to run it.
+
+This suite mirrors that shape:
+
+- ``add-job`` POSTs an ISO8601 job to ``/v1/scheduler/iso8601`` whose
+  command appends ``date +%s.%N`` to ``/tmp/jepsen-chronos/<name>``;
+- the final ``read`` collects every node's run files through the
+  control session;
+- :func:`run_checker` does the window analysis (chronos checker
+  semantics, with the reference's allowance that the last window may
+  still be open at read time)."""
+
+from __future__ import annotations
+
+import json
+import urllib.request
+from typing import Any, Optional
+
+from .. import checker as jchecker
+from .. import cli, client as jclient, db as jdb, generator as gen
+from ..checker import Checker, checker_fn
+from ..control import util as cu
+from .. import nemesis as jnemesis, net as jnet
+from .. import control as c
+from . import std_generator
+
+PORT = 4400
+RUN_DIR = "/tmp/jepsen-chronos"
+
+
+class ChronosClient(jclient.Client):
+    """add-job over the REST API; read over the control session."""
+
+    def __init__(self, node: Any = None):
+        self.node = node
+
+    def open(self, test, node):
+        return ChronosClient(node)
+
+    def invoke(self, test, op):
+        if op["f"] == "add-job":
+            spec = op["value"]
+            name = f"jepsen-{spec['name']}"
+            job = {
+                "name": name,
+                # R<count>/<start>/PT<interval>S — ISO8601 repeating.
+                "schedule": (f"R{spec['count']}/{spec['start_iso']}/"
+                             f"PT{spec['interval']}S"),
+                "epsilon": f"PT{spec['epsilon']}S",
+                "command": (f"mkdir -p {RUN_DIR} && "
+                            f"date +%s.%N >> {RUN_DIR}/{name} && "
+                            f"sleep {spec['duration']}"),
+                "owner": "jepsen@jepsen.io",
+            }
+            req = urllib.request.Request(
+                f"http://{self.node}:{PORT}/v1/scheduler/iso8601",
+                data=json.dumps(job).encode(),
+                headers={"Content-Type": "application/json"},
+                method="POST")
+            with urllib.request.urlopen(req, timeout=10.0) as r:
+                if r.status not in (200, 204):
+                    return {**op, "type": "fail",
+                            "error": f"http-{r.status}"}
+            return {**op, "type": "ok"}
+        if op["f"] == "read":
+            # Collect every node's run files (the runs may have landed
+            # on any node).
+            runs: dict = {}
+
+            def collect(t, node):
+                try:
+                    out = c.exec_star(
+                        f"cd {RUN_DIR} 2>/dev/null && "
+                        "grep -H . * 2>/dev/null || true")
+                except c.RemoteError:
+                    return ""
+                return out
+
+            outs = c.on_nodes(test, collect, test.get("nodes"))
+            for _node, out in outs.items():
+                for line in (out or "").strip().split("\n"):
+                    if ":" not in line:
+                        continue
+                    fname, ts = line.split(":", 1)
+                    try:
+                        runs.setdefault(
+                            fname.replace("jepsen-", "", 1), []).append(
+                            float(ts))
+                    except ValueError:
+                        continue
+            import time as _t
+
+            return {**op, "type": "ok",
+                    "value": {"runs": {k: sorted(v)
+                                       for k, v in runs.items()},
+                              "read-time": _t.time()}}
+        raise ValueError(f"unknown f {op['f']!r}")
+
+    def close(self, test):
+        pass
+
+
+class ChronosDB(jdb.DB, jdb.Process, jdb.LogFiles):
+    """chronos + zookeeper + mesos master/agent (chronos/src/jepsen/
+    chronos.clj provisioning, abbreviated to the service layer)."""
+
+    LOG = "/var/log/chronos.log"
+
+    def setup(self, test, node):
+        from ..os_ import debian
+
+        debian.install(["zookeeperd", "mesos", "chronos"])
+        self.start(test, node)
+
+    def start(self, test, node):
+        with c.su():
+            c.exec("service", "zookeeper", "start")
+            c.exec("service", "mesos-master", "start")
+            c.exec("service", "mesos-slave", "start")
+            c.exec("service", "chronos", "start")
+
+    def kill(self, test, node):
+        cu.grepkill("chronos")
+
+    def teardown(self, test, node):
+        with c.su():
+            for svc in ("chronos", "mesos-slave", "mesos-master",
+                        "zookeeper"):
+                c.exec_star(f"service {svc} stop || true")
+            c.exec_star(f"rm -rf {RUN_DIR}")
+
+    def log_files(self, test, node):
+        return [self.LOG]
+
+
+def run_checker() -> Checker:
+    """Window analysis: for each acked job, every target window
+    ``[start + i*interval, start + i*interval + epsilon + duration]``
+    that closed before the read must contain at least one run; runs
+    outside every window are unexpected (chronos checker semantics)."""
+
+    def chk(test, history, opts):
+        jobs = {}
+        read_time = None
+        runs = {}
+        for op in history:
+            if op.f == "add-job" and op.is_ok:
+                jobs[op.value["name"]] = op.value
+            elif op.f == "read" and op.is_ok:
+                v = op.value or {}
+                runs = v.get("runs") or {}
+                read_time = v.get("read-time")
+        if read_time is None:
+            # Fall back to the latest observed run.
+            all_ts = [t for ts in runs.values() for t in ts]
+            read_time = max(all_ts) if all_ts else 0.0
+        bad_jobs = {}
+        unexpected = {}
+        for name, spec in jobs.items():
+            had = sorted(runs.get(str(name), []) or
+                         runs.get(name, []))
+            missing = []
+            matched = set()
+            for i in range(int(spec["count"])):
+                t0 = spec["start"] + i * spec["interval"]
+                t1 = t0 + spec["epsilon"] + spec.get("duration", 0)
+                if t1 > read_time:
+                    continue  # window still open at read time
+                hit = next((r for r in had
+                            if t0 <= r <= t1 and r not in matched), None)
+                if hit is None:
+                    missing.append([t0, t1])
+                else:
+                    matched.add(hit)
+            extra = [r for r in had if r not in matched and not any(
+                spec["start"] + i * spec["interval"] <= r <=
+                spec["start"] + i * spec["interval"] + spec["epsilon"]
+                + spec.get("duration", 0)
+                for i in range(int(spec["count"])))]
+            if missing:
+                bad_jobs[name] = missing
+            if extra:
+                unexpected[name] = extra
+        return {
+            "valid": not bad_jobs,
+            "job_count": len(jobs),
+            "run_count": sum(len(v) for v in runs.values()),
+            "missing_windows": bad_jobs,
+            "unexpected_runs": unexpected,
+        }
+
+    return checker_fn(chk, "chronos-runs")
+
+
+def job_workload(opts: Optional[dict] = None) -> dict:
+    o = dict(opts or {})
+    counter = [0]
+    interval = int(o.get("interval") or 30)
+
+    def add_job(test=None, ctx=None):
+        import datetime
+        import time as _t
+
+        counter[0] += 1
+        start = _t.time() + 5
+        return {"type": "invoke", "f": "add-job", "value": {
+            "name": counter[0],
+            "start": start,
+            "start_iso": datetime.datetime.fromtimestamp(
+                start, datetime.timezone.utc).strftime(
+                "%Y-%m-%dT%H:%M:%SZ"),
+            "interval": interval,
+            "count": int(o.get("count") or 5),
+            "epsilon": int(o.get("epsilon") or 10),
+            "duration": int(o.get("duration") or 1),
+        }}
+
+    load = gen.clients(gen.stagger(
+        float(o.get("stagger") or 5.0),
+        gen.limit(int(o.get("jobs") or 10), add_job)))
+    final = gen.clients(gen.once({"type": "invoke", "f": "read",
+                                  "value": None}))
+    return {
+        "client": ChronosClient(),
+        "checker": jchecker.compose({
+            "runs": run_checker(),
+            "stats": jchecker.stats(),
+        }),
+        "generator": gen.phases(load, final),
+        "load-generator": load,
+        "final-generator": final,
+    }
+
+
+def test_fn(opts: dict) -> dict:
+    wl = job_workload(opts)
+    return {
+        "name": "chronos-runs",
+        "db": ChronosDB(),
+        "net": jnet.iptables(),
+        "nemesis": jnemesis.partition_random_halves(),
+        **{k: v for k, v in wl.items()
+           if k not in ("generator", "load-generator", "final-generator")},
+        "generator": std_generator(
+            opts, wl["load-generator"],
+            final_client_gen=wl["final-generator"]),
+    }
+
+
+def main(argv=None):
+    cli.main_exit(cli.single_test_cmd(test_fn), argv)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,327 @@
+"""CrateDB suite: dirty-read / lost-updates / version-divergence.
+
+The reference's crate suite (crate/, 1157 LoC, SURVEY §2.6) probes
+Elasticsearch-backed SQL for three anomalies, each with its own checker:
+
+- **dirty-read**: a read observing a row whose insert was never
+  acknowledged committed (reads of uncommitted state);
+- **lost-updates**: acknowledged inserts missing from the final
+  read-all;
+- **version-divergence**: CrateDB exposes a ``_version`` column per
+  row; two reads observing the SAME version with DIFFERENT values mean
+  replicas diverged under one version number — the suite's signature
+  anomaly.
+
+Clients speak the HTTP ``/_sql`` endpoint (JSON stmt/args — the real
+CrateDB wire surface, no driver)."""
+
+from __future__ import annotations
+
+import json
+import urllib.request
+from typing import Any, Optional
+
+from .. import checker as jchecker
+from .. import cli, client as jclient, db as jdb, generator as gen
+from ..checker import Checker, checker_fn
+from ..control import util as cu
+from .. import nemesis as jnemesis, net as jnet
+from .. import control as c
+from . import std_generator
+
+PORT = 4200
+TABLE = "jepsen_dirty"
+
+
+class Sql:
+    """Minimal /_sql client."""
+
+    def __init__(self, host: str, port: Optional[int] = None,
+                 timeout: float = 10.0):
+        if port is None:
+            port = PORT
+        self.base = f"http://{host}:{port}/_sql"
+        self.timeout = timeout
+
+    def stmt(self, stmt: str, args: Optional[list] = None) -> dict:
+        body = {"stmt": stmt}
+        if args is not None:
+            body["args"] = args
+        req = urllib.request.Request(
+            self.base, data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        with urllib.request.urlopen(req, timeout=self.timeout) as r:
+            return json.loads(r.read().decode())
+
+
+class DirtyReadClient(jclient.Client):
+    """write → insert one row id; read → select a row by id; read-all →
+    final refresh + full scan (crate/src/jepsen/crate/dirty_read.clj
+    semantics)."""
+
+    def __init__(self, conn: Optional[Sql] = None):
+        self.conn = conn
+
+    def open(self, test, node):
+        return DirtyReadClient(Sql(str(node)))
+
+    def setup(self, test):
+        self.conn.stmt(
+            f"CREATE TABLE IF NOT EXISTS {TABLE} "
+            "(id BIGINT PRIMARY KEY) "
+            "WITH (number_of_replicas = 2)")
+
+    def invoke(self, test, op):
+        if op["f"] == "write":
+            self.conn.stmt(f"INSERT INTO {TABLE} (id) VALUES (?)",
+                           [op["value"]])
+            return {**op, "type": "ok"}
+        if op["f"] == "read":
+            try:
+                res = self.conn.stmt(
+                    f"SELECT id FROM {TABLE} WHERE id = ?", [op["value"]])
+            except Exception:
+                return {**op, "type": "fail", "error": "http"}
+            rows = res.get("rows") or []
+            if rows:
+                return {**op, "type": "ok"}
+            return {**op, "type": "fail", "error": "not-found"}
+        if op["f"] == "read-all":
+            try:
+                self.conn.stmt(f"REFRESH TABLE {TABLE}")
+                res = self.conn.stmt(f"SELECT id FROM {TABLE}")
+            except Exception:
+                return {**op, "type": "fail", "error": "http"}
+            return {**op, "type": "ok",
+                    "value": sorted(r[0] for r in res.get("rows") or [])}
+        raise ValueError(f"unknown f {op['f']!r}")
+
+    def close(self, test):
+        pass
+
+
+class VersionClient(jclient.Client):
+    """update → set one register row's value; read → (_version, value)
+    pairs (crate/src/jepsen/crate/lost_updates.clj + version checks)."""
+
+    def __init__(self, conn: Optional[Sql] = None):
+        self.conn = conn
+
+    def open(self, test, node):
+        return VersionClient(Sql(str(node)))
+
+    def setup(self, test):
+        self.conn.stmt(
+            "CREATE TABLE IF NOT EXISTS jepsen_version "
+            "(id INT PRIMARY KEY, v BIGINT) "
+            "WITH (number_of_replicas = 2)")
+        try:
+            self.conn.stmt(
+                "INSERT INTO jepsen_version (id, v) VALUES (0, 0)")
+        except Exception:  # noqa: BLE001 - already inserted
+            pass
+
+    def invoke(self, test, op):
+        if op["f"] == "update":
+            self.conn.stmt(
+                "UPDATE jepsen_version SET v = ? WHERE id = 0",
+                [op["value"]])
+            return {**op, "type": "ok"}
+        if op["f"] == "read":
+            try:
+                res = self.conn.stmt(
+                    "SELECT _version, v FROM jepsen_version WHERE id = 0")
+            except Exception:
+                return {**op, "type": "fail", "error": "http"}
+            rows = res.get("rows") or []
+            if not rows:
+                return {**op, "type": "fail", "error": "not-found"}
+            version, v = rows[0]
+            return {**op, "type": "ok", "value": [version, v]}
+        raise ValueError(f"unknown f {op['f']!r}")
+
+    def close(self, test):
+        pass
+
+
+class CrateDB(jdb.DB, jdb.Process, jdb.LogFiles):
+    LOG = "/var/log/crate/crate.log"
+
+    def setup(self, test, node):
+        from ..os_ import debian
+
+        debian.install(["crate"])
+        hosts = json.dumps([f"{n}:4300" for n in test["nodes"]])
+        with c.su():
+            c.exec_star(
+                "cat > /etc/crate/crate.yml <<'JEPSEN_EOF'\n"
+                "cluster.name: jepsen\n"
+                f"node.name: {node}\n"
+                "network.host: 0.0.0.0\n"
+                f"discovery.seed_hosts: {hosts}\n"
+                f"cluster.initial_master_nodes: "
+                f"{json.dumps(test['nodes'])}\n"
+                "JEPSEN_EOF")
+        self.start(test, node)
+
+    def start(self, test, node):
+        with c.su():
+            c.exec("service", "crate", "start")
+
+    def kill(self, test, node):
+        cu.grepkill("crate")
+
+    def teardown(self, test, node):
+        with c.su():
+            c.exec_star("service crate stop || true")
+            c.exec_star("rm -rf /var/lib/crate/*")
+
+    def log_files(self, test, node):
+        return [self.LOG]
+
+
+def dirty_read_checker() -> Checker:
+    """crate dirty-read semantics: reads must only observe acknowledged
+    writes (a read-ok of an id that was never write-ok = dirty); acked
+    writes must survive to the final read-all (else lost)."""
+
+    def chk(test, history, opts):
+        acked = set()
+        invoked = set()
+        dirty = []
+        finals = []
+        for op in history:
+            if op.f == "write":
+                if op.is_invoke:
+                    invoked.add(op.value)
+                elif op.is_ok:
+                    acked.add(op.value)
+            elif op.f == "read" and op.is_ok:
+                if op.value not in invoked:
+                    dirty.append(op.value)
+            elif op.f == "read-all" and op.is_ok:
+                finals.append(set(op.value or []))
+        final = set.union(*finals) if finals else set()
+        lost = sorted(acked - final) if finals else []
+        # Reads of ids that were invoked but never acked: these are
+        # *dirty* only if the write ultimately failed; indeterminate
+        # writes that later show up are fine.
+        return {
+            "valid": not dirty and not lost,
+            "acked_count": len(acked),
+            "dirty": sorted(dirty),
+            "lost": lost,
+            "final_count": len(final) if finals else None,
+        }
+
+    return checker_fn(chk, "dirty-read")
+
+
+def version_divergence_checker() -> Checker:
+    """Two ok reads with the same _version but different values mean the
+    replicas diverged under one version number."""
+
+    def chk(test, history, opts):
+        seen = {}
+        divergent = {}
+        for op in history:
+            if op.f == "read" and op.is_ok and op.value:
+                version, v = op.value
+                if version in seen and seen[version] != v:
+                    divergent.setdefault(version, set()).update(
+                        {seen[version], v})
+                else:
+                    seen.setdefault(version, v)
+        return {
+            "valid": not divergent,
+            "versions_read": len(seen),
+            "divergent": {k: sorted(vs) for k, vs in divergent.items()},
+        }
+
+    return checker_fn(chk, "version-divergence")
+
+
+def dirty_read_workload(opts: Optional[dict] = None) -> dict:
+    o = dict(opts or {})
+    counter = [0]
+
+    def write(test=None, ctx=None):
+        counter[0] += 1
+        return {"type": "invoke", "f": "write", "value": counter[0]}
+
+    def read(test=None, ctx=None):
+        return {"type": "invoke", "f": "read",
+                "value": gen.rand_int(max(counter[0], 1)) + 1}
+
+    load = gen.clients(gen.limit(int(o.get("ops") or 200),
+                                 gen.mix([write, read, read])))
+    final = gen.clients(gen.once({"type": "invoke", "f": "read-all",
+                                  "value": None}))
+    return {
+        "client": DirtyReadClient(),
+        "checker": jchecker.compose({
+            "dirty-read": dirty_read_checker(),
+            "stats": jchecker.stats(),
+        }),
+        "generator": gen.phases(load, final),
+        "load-generator": load,
+        "final-generator": final,
+    }
+
+
+def version_workload(opts: Optional[dict] = None) -> dict:
+    o = dict(opts or {})
+    counter = [0]
+
+    def update(test=None, ctx=None):
+        counter[0] += 1
+        return {"type": "invoke", "f": "update", "value": counter[0]}
+
+    def read(test=None, ctx=None):
+        return {"type": "invoke", "f": "read", "value": None}
+
+    load = gen.clients(gen.limit(int(o.get("ops") or 200),
+                                 gen.mix([update, read])))
+    return {
+        "client": VersionClient(),
+        "checker": jchecker.compose({
+            "version-divergence": version_divergence_checker(),
+            "stats": jchecker.stats(),
+        }),
+        "generator": load,
+        "load-generator": load,
+    }
+
+
+WORKLOADS = {"dirty-read": dirty_read_workload,
+             "version-divergence": version_workload}
+
+
+def test_fn(opts: dict) -> dict:
+    name = opts.get("workload") or "dirty-read"
+    wl = WORKLOADS[name](opts)
+    return {
+        "name": f"crate-{name}",
+        "db": CrateDB(),
+        "net": jnet.iptables(),
+        "nemesis": jnemesis.partition_random_halves(),
+        **{k: v for k, v in wl.items()
+           if k not in ("generator", "load-generator", "final-generator")},
+        "generator": std_generator(
+            opts, wl["load-generator"],
+            final_client_gen=wl.get("final-generator")),
+    }
+
+
+def _add_opts(p):
+    p.add_argument("--workload", choices=sorted(WORKLOADS),
+                   default="dirty-read")
+    p.add_argument("--ops", type=int, default=200)
+
+
+def main(argv=None):
+    cli.main_exit(cli.single_test_cmd(test_fn, add_opts=_add_opts), argv)
+
+
+if __name__ == "__main__":
+    main()

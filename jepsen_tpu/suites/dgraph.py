@@ -261,29 +261,44 @@ def set_workload(opts: Optional[dict] = None) -> dict:
 WORKLOADS = {"upsert": upsert_workload, "set": set_workload}
 
 
+def trace_export_checker(collector) -> Checker:
+    """Writes spans.jsonl into the store directory at analysis time (the
+    same store-side-effect seam timeline.html uses)."""
+
+    def chk(test, history, opts):
+        path = jtrace.store_spans(test, collector)
+        return {"valid": True, "spans": len(collector.spans),
+                "file": path}
+
+    return checker_fn(chk, "trace")
+
+
 def test_fn(opts: dict) -> dict:
     name = opts.get("workload") or "upsert"
     wl = WORKLOADS[name](opts)
     client = wl["client"]
-    collector = None
+    checker = wl["checker"]
     if opts.get("trace"):
         collector = jtrace.Collector()
         client = jtrace.tracing(client, collector)
-    test = {
+        checker = jchecker.compose({
+            "workload": checker,
+            "trace": trace_export_checker(collector),
+        })
+    return {
         "name": f"dgraph-{name}",
         "db": DgraphDB(),
         "net": jnet.iptables(),
         "nemesis": jnemesis.partition_random_halves(),
         **{k: v for k, v in wl.items()
-           if k not in ("generator", "final-generator", "client")},
+           if k not in ("generator", "final-generator", "client",
+                        "checker")},
         "client": client,
+        "checker": checker,
         "generator": std_generator(
             opts, wl["generator"],
             final_client_gen=wl.get("final-generator")),
     }
-    if collector is not None:
-        test["trace-collector"] = collector
-    return test
 
 
 def _add_opts(p):

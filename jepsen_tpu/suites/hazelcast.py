@@ -195,6 +195,14 @@ class HazelcastDB(jdb.DB, jdb.Process, jdb.LogFiles):
         # which is exactly what this suite exists to rule out
         # (hazelcast.clj's config does the same).
         nodes = test.get("nodes") or [node]
+        # CP needs >= 3 members; with a smaller cluster we still ask for
+        # 3 so the run fails VISIBLY (waiting for CP members) instead of
+        # silently serving unsafe non-Raft locks. Group size must be odd
+        # and <= member count, so round DOWN to odd.
+        cp_count = max(len(nodes), 3)
+        group = min(cp_count, 7)
+        if group % 2 == 0:
+            group -= 1
         members = "\n".join(
             f"                    <member>{n}</member>" for n in nodes)
         xml = f"""<?xml version="1.0" encoding="UTF-8"?>
@@ -210,8 +218,8 @@ class HazelcastDB(jdb.DB, jdb.Process, jdb.LogFiles):
         </join>
     </network>
     <cp-subsystem>
-        <cp-member-count>{len(nodes)}</cp-member-count>
-        <group-size>{min(len(nodes), 7) | 1}</group-size>
+        <cp-member-count>{cp_count}</cp-member-count>
+        <group-size>{group}</group-size>
     </cp-subsystem>
 </hazelcast>
 """

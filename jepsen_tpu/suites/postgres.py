@@ -45,7 +45,8 @@ class PsqlClient(jclient.Client):
         # script on stdin prints every statement's output.
         def run(t, node):
             return c.exec_star(
-                f"psql -U {c.escape(self.user)} -At <<'JEPSEN_SQL'\n"
+                f"psql -U {c.escape(self.user)} -At "
+                f"-v ON_ERROR_STOP=1 <<'JEPSEN_SQL'\n"
                 f"{sql}\nJEPSEN_SQL")
 
         return c.on_nodes(test, run, [self.node])[self.node]

@@ -50,7 +50,8 @@ class _YsqlClient(jclient.Client):
     def _sql(self, test, script: str) -> str:
         def run(t, node):
             return c.exec_star(
-                f"{YSQLSH} -h 127.0.0.1 -U yugabyte -At <<'JEPSEN_SQL'\n"
+                f"{YSQLSH} -h 127.0.0.1 -U yugabyte -At "
+                f"-v ON_ERROR_STOP=1 <<'JEPSEN_SQL'\n"
                 f"{script}\nJEPSEN_SQL")
 
         return c.on_nodes(test, run, [self.node])[self.node]

@@ -160,3 +160,30 @@ class TestCombined:
         assert any("DROP" in cmd for _n, cmd in log)
         res = nem.invoke(test, {"type": "info", "f": "stop-partition"})
         assert res["value"] == "network-healed"
+
+
+def test_skew_op_runs_adjtime():
+    test, log = dummy_test()
+    test["sessions"]["n1"].remote_proto.responses[r"adjtime"] = \
+        "0.000000\n"
+    nem = nt.ClockNemesis().setup(test)
+    out = nem.invoke(test, {"type": "info", "f": "skew",
+                            "value": {"n1": 250.0}})
+    assert "clock-offsets" in out
+    cmds = [cmd for _n, cmd in log]
+    assert any("/opt/jepsen/adjtime 250.0" in cmd for cmd in cmds)
+    # The tool itself was compiled on the node during setup.
+    assert any("cc -O2 -o adjtime adjtime.c" in cmd for cmd in cmds)
+
+
+def test_skew_gen_shape():
+    from jepsen_tpu import generator as gen
+
+    test, _log = dummy_test()
+    with gen.fixed_rand(7):
+        op = nt.skew_gen(test, None)
+    assert op["f"] == "skew"
+    assert op["value"]
+    for node, ms in op["value"].items():
+        assert node in test["nodes"]
+        assert abs(ms) >= 4

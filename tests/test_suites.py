@@ -1173,3 +1173,195 @@ class TestYugabyteSuite:
         t2 = fns["append-partition"]({"time_limit": 1})
         assert t2["nemesis"] is not None
         assert "plot" in t2
+
+
+class CrateStub(BaseHTTPRequestHandler):
+    """/_sql stub: a correct single-node SQL engine for the dirty-read
+    and version workloads (insert/select by id; versioned register)."""
+
+    store: dict = {}
+    reg = {"version": 1, "v": 0}
+    lock = threading.Lock()
+
+    def log_message(self, *a):
+        pass
+
+    def do_POST(self):
+        req = json.loads(
+            self.rfile.read(int(self.headers.get("Content-Length") or 0)))
+        stmt = req.get("stmt", "")
+        args = req.get("args") or []
+        with self.lock:
+            if stmt.startswith("CREATE TABLE") or stmt.startswith(
+                    "REFRESH"):
+                rows = []
+            elif "INSERT INTO jepsen_dirty" in stmt:
+                self.store[args[0]] = True
+                rows = []
+            elif "INSERT INTO jepsen_version" in stmt:
+                rows = []
+            elif "UPDATE jepsen_version" in stmt:
+                self.reg["version"] += 1
+                self.reg["v"] = args[0]
+                rows = []
+            elif "SELECT _version, v FROM jepsen_version" in stmt:
+                rows = [[self.reg["version"], self.reg["v"]]]
+            elif "WHERE id = ?" in stmt:
+                rows = [[args[0]]] if args[0] in self.store else []
+            elif "SELECT id FROM" in stmt:
+                rows = [[k] for k in sorted(self.store)]
+            else:
+                rows = []
+        body = json.dumps({"rows": rows}).encode()
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+class TestCrateSuite:
+    def test_dirty_read_against_stub(self, http_stub, tmp_path):
+        from jepsen_tpu.suites import crate as cr
+
+        CrateStub.store = {}
+        http_stub(CrateStub, cr, "PORT")
+        test = dict(noop_test())
+        wl = cr.dirty_read_workload({"ops": 60})
+        test.update(
+            name="crate-dirty-stub", nodes=["127.0.0.1"], concurrency=4,
+            **{"store-root": str(tmp_path)},
+            client=wl["client"], checker=wl["checker"],
+            generator=wl["generator"],
+        )
+        res = core.run(test)
+        assert res["results"]["valid"] is True, res["results"]
+        dr = res["results"]["dirty-read"]
+        assert dr["acked_count"] > 0 and not dr["dirty"] and not dr["lost"]
+
+    def test_version_divergence_against_stub(self, http_stub, tmp_path):
+        from jepsen_tpu.suites import crate as cr
+
+        CrateStub.reg = {"version": 1, "v": 0}
+        http_stub(CrateStub, cr, "PORT")
+        test = dict(noop_test())
+        wl = cr.version_workload({"ops": 60})
+        test.update(
+            name="crate-version-stub", nodes=["127.0.0.1"], concurrency=4,
+            **{"store-root": str(tmp_path)},
+            client=wl["client"], checker=wl["checker"],
+            generator=wl["generator"],
+        )
+        res = core.run(test)
+        assert res["results"]["valid"] is True, res["results"]
+
+    def test_version_divergence_detects(self):
+        from jepsen_tpu.history import History, Op
+        from jepsen_tpu.suites.crate import version_divergence_checker
+
+        h = History([
+            Op(type="invoke", f="read", value=None, process=0, time=0),
+            Op(type="ok", f="read", value=[7, 1], process=0, time=1),
+            Op(type="invoke", f="read", value=None, process=1, time=2),
+            Op(type="ok", f="read", value=[7, 2], process=1, time=3),
+        ])
+        res = version_divergence_checker().check({}, h, {})
+        assert res["valid"] is False
+        assert res["divergent"] == {7: [1, 2]}
+
+    def test_dirty_read_detects(self):
+        from jepsen_tpu.history import History, Op
+        from jepsen_tpu.suites.crate import dirty_read_checker
+
+        h = History([
+            # read-ok of id 9 that no write ever invoked = dirty.
+            Op(type="invoke", f="read", value=9, process=0, time=0),
+            Op(type="ok", f="read", value=9, process=0, time=1),
+            # acked write lost from the final read.
+            Op(type="invoke", f="write", value=1, process=1, time=2),
+            Op(type="ok", f="write", value=1, process=1, time=3),
+            Op(type="invoke", f="read-all", value=None, process=0, time=4),
+            Op(type="ok", f="read-all", value=[], process=0, time=5),
+        ])
+        res = dirty_read_checker().check({}, h, {})
+        assert res["valid"] is False
+        assert res["dirty"] == [9]
+        assert res["lost"] == [1]
+
+
+class TestChronosChecker:
+    def _spec(self, name, start, interval=10, count=3, epsilon=2,
+              duration=1):
+        return {"name": name, "start": start, "interval": interval,
+                "count": count, "epsilon": epsilon, "duration": duration}
+
+    def _history(self, specs, runs, read_time):
+        from jepsen_tpu.history import History, Op
+
+        ops = []
+        t = 0
+        for s in specs:
+            ops.append(Op(type="invoke", f="add-job", value=s, process=0,
+                          time=t)); t += 1
+            ops.append(Op(type="ok", f="add-job", value=s, process=0,
+                          time=t)); t += 1
+        ops.append(Op(type="invoke", f="read", value=None, process=1,
+                      time=t)); t += 1
+        ops.append(Op(type="ok", f="read",
+                      value={"runs": runs, "read-time": read_time},
+                      process=1, time=t))
+        return History(ops)
+
+    def test_all_windows_hit(self):
+        from jepsen_tpu.suites.chronos import run_checker
+
+        spec = self._spec(1, start=100.0)
+        h = self._history([spec], {"1": [100.5, 110.5, 120.5]}, 200.0)
+        res = run_checker().check({}, h, {})
+        assert res["valid"] is True, res
+        assert res["run_count"] == 3
+
+    def test_missing_window_detected(self):
+        from jepsen_tpu.suites.chronos import run_checker
+
+        spec = self._spec(1, start=100.0)
+        h = self._history([spec], {"1": [100.5, 120.5]}, 200.0)
+        res = run_checker().check({}, h, {})
+        assert res["valid"] is False
+        assert res["missing_windows"][1] == [[110.0, 113.0]]
+
+    def test_open_window_not_required(self):
+        from jepsen_tpu.suites.chronos import run_checker
+
+        spec = self._spec(1, start=100.0)
+        # Read happens before the third window closes: only two runs
+        # required.
+        h = self._history([spec], {"1": [100.5, 110.5]}, 115.0)
+        res = run_checker().check({}, h, {})
+        assert res["valid"] is True, res
+
+
+class TestDgraphTraceExport:
+    def test_spans_written_to_store(self, http_stub, tmp_path):
+        from jepsen_tpu.suites import dgraph as dg
+
+        DgraphStub.store = {}
+        DgraphStub.values = []
+        http_stub(DgraphStub, dg, "PORT")
+        t = dg.test_fn({"trace": True, "workload": "set", "ops": 10,
+                        "time_limit": 2})
+        wl = dg.set_workload({"ops": 10})
+        test = dict(noop_test())
+        test.update(
+            name="dgraph-trace-stub", nodes=["127.0.0.1"], concurrency=2,
+            **{"store-root": str(tmp_path)},
+            client=t["client"],     # the traced wrapper from test_fn
+            checker=t["checker"],   # composed with the trace exporter
+            generator=gen.phases(wl["generator"], wl["final-generator"]),
+        )
+        res = core.run(test)
+        tr = res["results"]["trace"]
+        assert tr["spans"] > 0
+        assert tr["file"] and tr["file"].endswith("spans.jsonl")
+        import pathlib
+
+        assert pathlib.Path(tr["file"]).exists()
