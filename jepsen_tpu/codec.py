@@ -1,0 +1,27 @@
+"""EDN ↔ bytes codec (jepsen/src/jepsen/codec.clj:9-29 equivalent).
+
+The reference uses this for queue payloads and anywhere an object must
+ride a byte channel: ``encode`` renders EDN text as UTF-8 bytes (nil →
+empty), ``decode`` parses bytes back (nil/empty → None). Built on the
+EDN reader/printer in :mod:`jepsen_tpu.edn`.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from . import edn
+
+
+def encode(o: Any) -> bytes:
+    """Serialize an object to bytes (codec.clj:9-15)."""
+    if o is None:
+        return b""
+    return edn.write_string(o).encode("utf-8")
+
+
+def decode(data: Optional[bytes]) -> Any:
+    """Deserialize bytes to an object (codec.clj:17-29)."""
+    if data is None or len(data) == 0:
+        return None
+    return edn.read_string(bytes(data).decode("utf-8"))

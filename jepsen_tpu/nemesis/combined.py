@@ -30,6 +30,7 @@ from . import (
 )
 from .time import (
     bump_gen,
+    clock_gen,
     clock_nemesis,
     random_nonempty_subset,
     reset_gen,

@@ -21,6 +21,7 @@ This suite mirrors that shape:
 from __future__ import annotations
 
 import json
+import urllib.error
 import urllib.request
 from typing import Any, Optional
 
@@ -65,10 +66,12 @@ class ChronosClient(jclient.Client):
                 data=json.dumps(job).encode(),
                 headers={"Content-Type": "application/json"},
                 method="POST")
-            with urllib.request.urlopen(req, timeout=10.0) as r:
-                if r.status not in (200, 204):
-                    return {**op, "type": "fail",
-                            "error": f"http-{r.status}"}
+            try:
+                with urllib.request.urlopen(req, timeout=10.0):
+                    pass
+            except urllib.error.HTTPError as e:
+                # The scheduler answered: a definite rejection.
+                return {**op, "type": "fail", "error": f"http-{e.code}"}
             return {**op, "type": "ok"}
         if op["f"] == "read":
             # Collect every node's run files (the runs may have landed

@@ -94,9 +94,14 @@ class MongoClient(jclient.Client):
 
 class MongoDB(jdb.DB, jdb.Process, jdb.LogFiles):
     """Replica-set member lifecycle (install + mongod daemon + rs.initiate
-    from the first node, mirroring the reference suite's db fn)."""
+    from the first node, mirroring the reference suite's db fn). The
+    ``storage_engine`` knob covers the mongodb-rocks suite's rocksdb
+    variant (mongodb-rocks/, 187 LoC)."""
 
     LOG = "/var/log/mongodb-jepsen.log"
+
+    def __init__(self, storage_engine: Optional[str] = None):
+        self.storage_engine = storage_engine
 
     def setup(self, test, node):
         from ..os_ import debian
@@ -119,6 +124,8 @@ class MongoDB(jdb.DB, jdb.Process, jdb.LogFiles):
                 "/usr/bin/mongod",
                 "--replSet", "jepsen", "--bind_ip_all",
                 "--dbpath", "/var/lib/mongodb",
+                *(["--storageEngine", self.storage_engine]
+                  if self.storage_engine else []),
             )
 
     def kill(self, test, node):
@@ -146,9 +153,11 @@ def register_workload(opts: Optional[dict] = None) -> dict:
 
 def test_fn(opts: dict) -> dict:
     wl = register_workload(opts)
+    engine = opts.get("storage_engine")
     return {
-        "name": "mongodb-document-cas",
-        "db": MongoDB(),
+        "name": ("mongodb-rocks-document-cas" if engine == "rocksdb"
+                 else "mongodb-document-cas"),
+        "db": MongoDB(engine),
         "net": jnet.iptables(),
         "nemesis": jnemesis.partition_random_halves(),
         **{k: v for k, v in wl.items() if k != "generator"},
@@ -156,8 +165,13 @@ def test_fn(opts: dict) -> dict:
     }
 
 
+def _add_opts(p):
+    p.add_argument("--storage-engine", default=None,
+                   help="e.g. rocksdb (the mongodb-rocks variant)")
+
+
 def main(argv=None):
-    cli.main_exit(cli.single_test_cmd(test_fn), argv)
+    cli.main_exit(cli.single_test_cmd(test_fn, add_opts=_add_opts), argv)
 
 
 if __name__ == "__main__":

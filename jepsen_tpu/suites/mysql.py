@@ -155,6 +155,103 @@ class MariaGaleraDB(jdb.DB, jdb.Process, jdb.LogFiles):
         return [self.LOG]
 
 
+class PerconaDB(MariaGaleraDB):
+    """Percona XtraDB Cluster (percona/, 509 LoC): same Galera wsrep
+    shape over Percona's packages."""
+
+    def setup(self, test, node):
+        from ..os_ import debian
+
+        debian.install(["percona-xtradb-cluster-server"])
+        nodes = ",".join(test["nodes"])
+        with c.su():
+            c.exec_star(
+                "cat > /etc/mysql/conf.d/wsrep.cnf <<'JEPSEN_EOF'\n"
+                "[mysqld]\n"
+                "wsrep_on=ON\n"
+                "wsrep_provider=/usr/lib/galera4/libgalera_smm.so\n"
+                f"wsrep_cluster_address=gcomm://{nodes}\n"
+                "binlog_format=row\n"
+                "pxc_strict_mode=ENFORCING\n"
+                "bind-address=0.0.0.0\n"
+                "JEPSEN_EOF")
+        self.start(test, node)
+
+    def start(self, test, node):
+        with c.su():
+            if node == test["nodes"][0]:
+                # PXC ships no galera_new_cluster; bootstrap the primary
+                # component explicitly.
+                c.exec_star(
+                    "systemctl start mysql@bootstrap.service || "
+                    "service mysql bootstrap-pxc || service mysql start")
+            else:
+                c.exec("service", "mysql", "start")
+
+    def kill(self, test, node):
+        cu.grepkill("mysqld")
+
+
+class MysqlClusterDB(jdb.DB, jdb.Process, jdb.LogFiles):
+    """MySQL Cluster / NDB (mysql-cluster/, 241 LoC): management node on
+    the first host, ndbd data nodes + mysqld SQL nodes everywhere."""
+
+    LOG = "/var/log/mysql/error.log"
+
+    def setup(self, test, node):
+        from ..os_ import debian
+
+        debian.install(["mysql-cluster-community-server"])
+        first = test["nodes"][0]
+        with c.su():
+            if node == first:
+                data_nodes = "\n".join(
+                    f"[ndbd]\nHostName={n}" for n in test["nodes"])
+                sql_nodes = "\n".join("[mysqld]" for _ in test["nodes"])
+                c.exec("mkdir", "-p", "/var/lib/mysql-cluster")
+                c.exec_star(
+                    "cat > /var/lib/mysql-cluster/config.ini "
+                    "<<'JEPSEN_EOF'\n"
+                    "[ndbd default]\nNoOfReplicas=2\n"
+                    f"[ndb_mgmd]\nHostName={first}\n"
+                    f"{data_nodes}\n{sql_nodes}\n"
+                    "JEPSEN_EOF")
+            c.exec_star(
+                "cat > /etc/my.cnf <<'JEPSEN_EOF'\n"
+                "[mysqld]\n"
+                "ndbcluster\n"
+                f"ndb-connectstring={first}\n"
+                "bind-address=0.0.0.0\n"
+                "[mysql_cluster]\n"
+                f"ndb-connectstring={first}\n"
+                "JEPSEN_EOF")
+        self.start(test, node)
+
+    def start(self, test, node):
+        with c.su():
+            if node == test["nodes"][0]:
+                c.exec_star("ndb_mgmd -f /var/lib/mysql-cluster/config.ini "
+                            "|| true")
+            c.exec_star("ndbd || true")
+            c.exec("service", "mysql", "start")
+
+    def kill(self, test, node):
+        cu.grepkill("mysqld")
+        cu.grepkill("ndbd")
+
+    def teardown(self, test, node):
+        with c.su():
+            c.exec_star("service mysql stop || true")
+            c.exec_star("pkill ndbd || true")
+
+    def log_files(self, test, node):
+        return [self.LOG]
+
+
+FLAVORS = {"galera": MariaGaleraDB, "percona": PerconaDB,
+           "ndb": MysqlClusterDB}
+
+
 def test_fn(opts: dict) -> dict:
     counter = [0]
 
@@ -166,9 +263,9 @@ def test_fn(opts: dict) -> dict:
         return {"type": "invoke", "f": "read", "value": None}
 
     return {
-        "name": "galera-dirty-reads",
+        "name": f"mysql-{opts.get('flavor') or 'galera'}-dirty-reads",
         "row-count": int(opts.get("row_count") or 10),
-        "db": MariaGaleraDB(),
+        "db": FLAVORS[opts.get("flavor") or "galera"](),
         "net": jnet.iptables(),
         "nemesis": jnemesis.partition_random_halves(),
         "client": DirtyReadsClient(),
@@ -180,8 +277,12 @@ def test_fn(opts: dict) -> dict:
     }
 
 
+def _add_opts(p):
+    p.add_argument("--flavor", choices=sorted(FLAVORS), default="galera")
+
+
 def main(argv=None):
-    cli.main_exit(cli.single_test_cmd(test_fn), argv)
+    cli.main_exit(cli.single_test_cmd(test_fn, add_opts=_add_opts), argv)
 
 
 if __name__ == "__main__":

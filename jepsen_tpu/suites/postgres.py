@@ -28,12 +28,17 @@ class PsqlClient(jclient.Client):
     node. Requires the test's sessions (control plane) — the client rides
     the same transport as DB setup."""
 
-    def __init__(self, node: Any = None, user: str = "postgres"):
+    def __init__(self, node: Any = None, user: str = "postgres",
+                 host: Optional[str] = None, port: Optional[int] = None):
+        # host/port target an in-node proxy (e.g. stolon-proxy); None =
+        # the local Unix socket (plain postgres).
         self.node = node
         self.user = user
+        self.host = host
+        self.port = port
 
     def open(self, test, node):
-        return PsqlClient(node, self.user)
+        return PsqlClient(node, self.user, self.host, self.port)
 
     def setup(self, test):
         self._psql(test,
@@ -46,7 +51,9 @@ class PsqlClient(jclient.Client):
         def run(t, node):
             return c.exec_star(
                 f"psql -U {c.escape(self.user)} -At "
-                f"-v ON_ERROR_STOP=1 <<'JEPSEN_SQL'\n"
+                + (f"-h {c.escape(self.host)} " if self.host else "")
+                + (f"-p {self.port} " if self.port else "")
+                + f"-v ON_ERROR_STOP=1 <<'JEPSEN_SQL'\n"
                 f"{sql}\nJEPSEN_SQL")
 
         return c.on_nodes(test, run, [self.node])[self.node]

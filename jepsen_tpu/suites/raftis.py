@@ -1,0 +1,117 @@
+"""Raftis suite: a Raft-replicated redis-protocol register.
+
+The reference's raftis suite (raftis/, 158 LoC — the smallest in the
+monorepo) drives a toy Raft KV store speaking RESP with a plain
+read/write register checked for linearizability. This suite reuses the
+RESP client from the redis suite (GET/SET only — raftis has no EVAL, so
+no CAS arm) against the device-checked register model.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from .. import checker as jchecker
+from .. import cli, client as jclient, db as jdb, generator as gen
+from .. import nemesis as jnemesis, net as jnet
+from ..control import util as cu
+from ..models import Register
+from .redis import Resp
+from .. import control as c
+from . import std_generator
+
+PORT = 6379
+KEY = "jepsen"
+
+
+class RegisterClient(jclient.Client):
+    def __init__(self, conn: Optional[Resp] = None):
+        self.conn = conn
+
+    def open(self, test, node):
+        return RegisterClient(Resp(str(node), PORT))
+
+    def invoke(self, test, op):
+        if op["f"] == "read":
+            raw = self.conn.cmd("GET", KEY)
+            return {**op, "type": "ok",
+                    "value": None if raw is None else int(raw)}
+        if op["f"] == "write":
+            self.conn.cmd("SET", KEY, op["value"])
+            return {**op, "type": "ok"}
+        raise ValueError(f"unknown f {op['f']!r}")
+
+    def close(self, test):
+        if self.conn is not None:
+            self.conn.close()
+
+
+class RaftisDB(jdb.DB, jdb.Process, jdb.LogFiles):
+    DIR = "/opt/raftis"
+    LOG = "/var/log/raftis.log"
+
+    def setup(self, test, node):
+        cu.install_archive(
+            "https://github.com/goraft/raftis/archive/master.tar.gz",
+            self.DIR)
+        self.start(test, node)
+
+    def start(self, test, node):
+        peers = ",".join(f"{n}:7000" for n in test["nodes"])
+        with c.su():
+            cu.start_daemon(
+                {"logfile": self.LOG, "pidfile": "/var/run/raftis.pid",
+                 "chdir": self.DIR},
+                f"{self.DIR}/raftis",
+                "-bind", f"{node}:7000",
+                "-peers", peers,
+                "-port", PORT,
+            )
+
+    def kill(self, test, node):
+        cu.grepkill("raftis")
+
+    def teardown(self, test, node):
+        cu.grepkill("raftis")
+        with c.su():
+            c.exec_star("rm -rf /var/lib/raftis")
+
+    def log_files(self, test, node):
+        return [self.LOG]
+
+
+def register_workload(opts: Optional[dict] = None) -> dict:
+    def r(test=None, ctx=None):
+        return {"type": "invoke", "f": "read", "value": None}
+
+    def w(test=None, ctx=None):
+        return {"type": "invoke", "f": "write", "value": gen.rand_int(5)}
+
+    return {
+        "client": RegisterClient(),
+        "checker": jchecker.compose({
+            "linear": jchecker.linearizable(model=Register(init=None)),
+            "stats": jchecker.stats(),
+        }),
+        "generator": gen.stagger(0.1, gen.mix([r, w])),
+    }
+
+
+def test_fn(opts: dict) -> dict:
+    wl = register_workload(opts)
+    return {
+        "name": "raftis",
+        "db": RaftisDB(),
+        "net": jnet.iptables(),
+        "nemesis": jnemesis.partition_random_halves(),
+        **{k: v for k, v in wl.items() if k != "generator"},
+        "generator": std_generator(opts, wl["generator"]),
+    }
+
+
+def main(argv=None):
+    cli.main_exit(cli.single_test_cmd(test_fn), argv)
+
+
+if __name__ == "__main__":
+    main()

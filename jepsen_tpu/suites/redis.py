@@ -180,23 +180,91 @@ def queue_workload(opts: Optional[dict] = None) -> dict:
     }
 
 
-def test_fn(opts: dict) -> dict:
-    wl = queue_workload(opts)
+CAS_LUA = ("if redis.call('GET', KEYS[1]) == ARGV[1] then "
+           "redis.call('SET', KEYS[1], ARGV[2]); return 1 "
+           "else return 0 end")
+
+
+class RegisterClient(jclient.Client):
+    """CAS register over GET/SET plus an EVAL compare-and-set script
+    (atomic server-side — redis runs scripts single-threaded)."""
+
+    KEY = "jepsen.reg"
+
+    def __init__(self, conn: Optional[Resp] = None):
+        self.conn = conn
+
+    def open(self, test, node):
+        return RegisterClient(Resp(str(node), PORT))
+
+    def invoke(self, test, op):
+        if op["f"] == "read":
+            raw = self.conn.cmd("GET", self.KEY)
+            return {**op, "type": "ok",
+                    "value": None if raw is None else int(raw)}
+        if op["f"] == "write":
+            self.conn.cmd("SET", self.KEY, op["value"])
+            return {**op, "type": "ok"}
+        if op["f"] == "cas":
+            old, new = op["value"]
+            ok = self.conn.cmd("EVAL", CAS_LUA, 1, self.KEY, old, new)
+            return {**op, "type": "ok" if ok == 1 else "fail"}
+        raise ValueError(f"unknown f {op['f']!r}")
+
+    def close(self, test):
+        if self.conn is not None:
+            self.conn.close()
+
+
+def register_workload(opts: Optional[dict] = None) -> dict:
+    from ..models import CasRegister
+
+    def r(test=None, ctx=None):
+        return {"type": "invoke", "f": "read", "value": None}
+
+    def w(test=None, ctx=None):
+        return {"type": "invoke", "f": "write", "value": gen.rand_int(5)}
+
+    def cas(test=None, ctx=None):
+        return {"type": "invoke", "f": "cas",
+                "value": [gen.rand_int(5), gen.rand_int(5)]}
+
     return {
-        "name": "redis-queue",
+        "client": RegisterClient(),
+        "checker": jchecker.compose({
+            "linear": jchecker.linearizable(model=CasRegister(init=None)),
+            "stats": jchecker.stats(),
+        }),
+        "generator": gen.stagger(0.05, gen.mix([r, w, cas])),
+    }
+
+
+WORKLOADS = {"queue": queue_workload, "register": register_workload}
+
+
+def test_fn(opts: dict) -> dict:
+    name = opts.get("workload") or "queue"
+    wl = WORKLOADS[name](opts)
+    return {
+        "name": f"redis-{name}",
         "db": RedisDB(),
         "net": jnet.iptables(),
         "nemesis": jnemesis.partition_random_halves(),
         **{k: v for k, v in wl.items()
            if k not in ("generator", "load-generator", "final-generator")},
         "generator": std_generator(
-            opts, wl["load-generator"],
-            final_client_gen=wl["final-generator"]),
+            opts, wl.get("load-generator") or wl["generator"],
+            final_client_gen=wl.get("final-generator")),
     }
 
 
+def _add_opts(p):
+    p.add_argument("--workload", choices=sorted(WORKLOADS),
+                   default="queue")
+
+
 def main(argv=None):
-    cli.main_exit(cli.single_test_cmd(test_fn), argv)
+    cli.main_exit(cli.single_test_cmd(test_fn, add_opts=_add_opts), argv)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,134 @@
+"""Stolon suite: HA PostgreSQL (keeper/sentinel/proxy) list-append.
+
+The reference's stolon suite (stolon/, 1062 LoC) runs elle append +
+ledger workloads against stolon-managed PostgreSQL. The SQL surface is
+plain Postgres, so the client is the postgres suite's psql list-append
+client pointed at the local stolon proxy; what's suite-specific is the
+DB lifecycle: an etcd store, then stolon-keeper / stolon-sentinel /
+stolon-proxy daemons per node (stolon/src/jepsen/stolon/db.clj shape).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from .. import cli, db as jdb, generator as gen
+from .. import nemesis as jnemesis, net as jnet
+from ..control import util as cu
+from .postgres import PsqlClient
+from ..workloads import append as wa
+from .. import control as c
+from . import std_generator
+
+CLUSTER = "jepsen"
+PROXY_PORT = 25432
+
+
+class StolonDB(jdb.DB, jdb.Process, jdb.LogFiles):
+    URL = ("https://github.com/sorintlab/stolon/releases/download/v0.17.0/"
+           "stolon-v0.17.0-linux-amd64.tar.gz")
+    ETCD_URL = ("https://github.com/etcd-io/etcd/releases/download/v3.5.9/"
+                "etcd-v3.5.9-linux-amd64.tar.gz")
+    DIR = "/opt/stolon"
+    ETCD = "/opt/stolon-etcd"
+    LOGS = ["/var/log/stolon-keeper.log", "/var/log/stolon-sentinel.log",
+            "/var/log/stolon-proxy.log"]
+
+    def setup(self, test, node):
+        from ..os_ import debian
+
+        debian.install(["postgresql"])
+        # The distro package auto-starts a default cluster on 5432; stop
+        # it — queries must go through stolon-proxy, not a stock local
+        # Postgres (silent wrong-target verification otherwise).
+        with c.su():
+            c.exec_star("service postgresql stop || true")
+        cu.install_archive(self.URL, self.DIR)
+        cu.install_archive(self.ETCD_URL, self.ETCD)
+        if node == test["nodes"][0]:
+            with c.su():
+                c.exec_star(
+                    f"{self.DIR}/bin/stolonctl --cluster-name {CLUSTER} "
+                    "--store-backend etcdv3 init -y || true")
+        self.start(test, node)
+
+    def start(self, test, node):
+        nodes = test["nodes"]
+        cluster = ",".join(f"{n}=http://{n}:2380" for n in nodes)
+        store = ",".join(f"http://{n}:2379" for n in nodes)
+        with c.su():
+            cu.start_daemon(
+                {"logfile": "/var/log/stolon-etcd.log",
+                 "pidfile": "/var/run/stolon-etcd.pid", "chdir": self.ETCD},
+                f"{self.ETCD}/etcd",
+                "--name", node,
+                "--listen-client-urls", "http://0.0.0.0:2379",
+                "--advertise-client-urls", f"http://{node}:2379",
+                "--listen-peer-urls", "http://0.0.0.0:2380",
+                "--initial-advertise-peer-urls", f"http://{node}:2380",
+                "--initial-cluster", cluster,
+                "--data-dir", "/var/lib/stolon-etcd",
+            )
+            common = ["--cluster-name", CLUSTER,
+                      "--store-backend", "etcdv3",
+                      "--store-endpoints", store]
+            cu.start_daemon(
+                {"logfile": self.LOGS[0],
+                 "pidfile": "/var/run/stolon-keeper.pid", "chdir": self.DIR},
+                f"{self.DIR}/bin/stolon-keeper",
+                "--uid", f"keeper_{test['nodes'].index(node)}",
+                "--data-dir", "/var/lib/stolon",
+                "--pg-listen-address", node,
+                "--pg-su-username", "postgres",
+                "--pg-repl-username", "repl",
+                "--pg-repl-password", "repl",
+                *common,
+            )
+            cu.start_daemon(
+                {"logfile": self.LOGS[1],
+                 "pidfile": "/var/run/stolon-sentinel.pid",
+                 "chdir": self.DIR},
+                f"{self.DIR}/bin/stolon-sentinel", *common,
+            )
+            cu.start_daemon(
+                {"logfile": self.LOGS[2],
+                 "pidfile": "/var/run/stolon-proxy.pid", "chdir": self.DIR},
+                f"{self.DIR}/bin/stolon-proxy",
+                "--listen-address", "0.0.0.0", "--port", PROXY_PORT,
+                *common,
+            )
+
+    def kill(self, test, node):
+        for p in ("stolon-proxy", "stolon-sentinel", "stolon-keeper",
+                  "postgres"):
+            cu.grepkill(p)
+
+    def teardown(self, test, node):
+        self.kill(test, node)
+        cu.grepkill("etcd")
+        with c.su():
+            c.exec_star("rm -rf /var/lib/stolon /var/lib/stolon-etcd")
+
+    def log_files(self, test, node):
+        return list(self.LOGS)
+
+
+def test_fn(opts: dict) -> dict:
+    wl = wa.test({"key_count": 4})
+    return {
+        "name": "stolon-append",
+        "db": StolonDB(),
+        "net": jnet.iptables(),
+        "nemesis": jnemesis.partition_random_halves(),
+        "client": PsqlClient(host="127.0.0.1", port=PROXY_PORT),
+        "checker": wl["checker"],
+        "generator": std_generator(opts, wl["generator"]),
+    }
+
+
+def main(argv=None):
+    cli.main_exit(cli.single_test_cmd(test_fn), argv)
+
+
+if __name__ == "__main__":
+    main()

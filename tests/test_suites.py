@@ -1365,3 +1365,107 @@ class TestDgraphTraceExport:
         import pathlib
 
         assert pathlib.Path(tr["file"]).exists()
+
+
+class TestLegacySuites:
+    def test_redis_register_against_stub(self, tmp_path):
+        import socketserver
+
+        from jepsen_tpu.suites import redis as rs
+
+        class RegStub(RedisStub):
+            def __init__(self):
+                super().__init__()
+                self.reg = {}
+
+            def dispatch(self, args):
+                cmd = args[0].upper()
+                with self.lock:
+                    if cmd == "GET":
+                        v = self.reg.get(args[1])
+                        if v is None:
+                            return b"$-1\r\n"
+                        return f"${len(v)}\r\n{v}\r\n".encode()
+                    if cmd == "SET":
+                        self.reg[args[1]] = args[2]
+                        return b"+OK\r\n"
+                    if cmd == "EVAL":
+                        # args: script, numkeys, key, old, new
+                        _s, _n, key, old, new = args[1:6]
+                        if self.reg.get(key) == old:
+                            self.reg[key] = new
+                            return b":1\r\n"
+                        return b":0\r\n"
+                return super().dispatch(args)
+
+        stub = RegStub()
+        srv = socketserver.ThreadingTCPServer(("127.0.0.1", 0),
+                                              stub.Handler)
+        srv.daemon_threads = True
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        old_port = rs.PORT
+        rs.PORT = srv.server_address[1]
+        try:
+            test = dict(noop_test())
+            wl = rs.register_workload({})
+            test.update(
+                name="redis-register-stub", nodes=["127.0.0.1"],
+                concurrency=4,
+                **{"store-root": str(tmp_path)},
+                client=wl["client"], checker=wl["checker"],
+                generator=gen.clients(gen.limit(40, wl["generator"])),
+            )
+            res = core.run(test)
+            assert res["results"]["valid"] is True, res["results"]
+        finally:
+            rs.PORT = old_port
+            srv.shutdown()
+            srv.server_close()
+
+    def test_mysql_flavors(self):
+        from jepsen_tpu.suites import mysql as ms
+
+        for flavor, cls in ms.FLAVORS.items():
+            t = ms.test_fn({"flavor": flavor})
+            assert type(t["db"]) is cls
+            assert flavor in t["name"]
+
+    def test_stolon_db_commands(self):
+        from jepsen_tpu.suites import stolon as st
+
+        test = dict(noop_test())
+        test["nodes"] = ["n1", "n2"]
+        log: list = []
+        c.setup_sessions(test, c.dummy(log))
+        db = st.StolonDB()
+        try:
+            c.on_nodes(test, lambda t, n: db.start(t, n), ["n1"])
+        except Exception:
+            pass
+        cmds = [cmd for _n, cmd in log]
+        assert any("stolon-keeper" in cmd for cmd in cmds)
+        assert any("stolon-sentinel" in cmd for cmd in cmds)
+        assert any("stolon-proxy" in cmd for cmd in cmds)
+
+    def test_raftis_db_commands(self):
+        from jepsen_tpu.suites import raftis as rf
+
+        test = dict(noop_test())
+        test["nodes"] = ["n1", "n2"]
+        log: list = []
+        c.setup_sessions(test, c.dummy(log))
+        db = rf.RaftisDB()
+        try:
+            c.on_nodes(test, lambda t, n: db.start(t, n), ["n1"])
+        except Exception:
+            pass
+        cmds = [cmd for _n, cmd in log]
+        assert any("-peers n1:7000,n2:7000" in cmd for cmd in cmds)
+
+    def test_codec_roundtrip(self):
+        from jepsen_tpu import codec, edn
+
+        assert codec.encode(None) == b""
+        assert codec.decode(b"") is None
+        v = {edn.K("type"): edn.K("ok"), edn.K("value"): [1, [2, 3]]}
+        assert codec.decode(codec.encode(v)) == v
