@@ -1,26 +1,53 @@
 """Per-database test suites.
 
 The reference is a monorepo of ~27 per-DB suites (consul/, zookeeper/,
-etcd-like raftis/, cockroachdb/, …), each a thin module: a DB lifecycle
-implementation, a client speaking the database's wire protocol, workload
-wiring, and a ``-main`` calling ``cli/run!`` with a test-fn (e.g.
+cockroachdb/, …), each a thin module: a DB lifecycle implementation, a
+client speaking the database's wire protocol, workload wiring, and a
+``-main`` calling ``cli/run!`` with a test-fn (e.g.
 zookeeper/src/jepsen/zookeeper.clj:106-137). The suites here follow the
-same shape on this framework's protocols:
+same shape on this framework's protocols. Roster (→ reference suite):
 
-- :mod:`jepsen_tpu.suites.consul` — HTTP KV cas-register over the
-  ``?cas=index`` API (ref consul/).
-- :mod:`jepsen_tpu.suites.etcd`   — etcd v3 JSON gateway: range/put +
-  txn-based CAS, keyed register + append workloads (ref raftis/ and the
-  etcd-style suites).
-- :mod:`jepsen_tpu.suites.postgres` — psql-over-control-session
-  list-append txn workload (ref stolon/).
-- :mod:`jepsen_tpu.suites.zookeeper` — zkCli-over-control-session CAS
-  register (ref zookeeper/).
+- ``consul``     — HTTP KV cas-register over ``?cas=index`` (consul/)
+- ``etcd``       — v3 JSON gateway register + elle append (etcd-style)
+- ``zookeeper``  — zkCli version-guarded CAS register (zookeeper/)
+- ``cockroachdb``— bank + append over `cockroach sql`, combined nemesis
+  incl. clock skew (cockroachdb/)
+- ``postgres``   — psql serializable list-append (single-node shape)
+- ``stolon``     — HA Postgres: keeper/sentinel/proxy + own etcd store,
+  append through the proxy (stolon/)
+- ``mysql``      — dirty-reads on --flavor galera | percona | ndb
+  (galera/, percona/, mysql-cluster/)
+- ``tidb``       — pessimistic bank + JSON-column elle append (tidb/)
+- ``yugabyte``   — workload × fault matrix over ysqlsh + test-all sweep
+  (yugabyte/)
+- ``mongodb``    — replica-set document-cas with linearizable reads;
+  --storage-engine rocksdb covers mongodb-rocks (mongodb-smartos/,
+  mongodb-rocks/; SmartOS provisioning lives in os_/smartos.py)
+- ``hazelcast``  — CP-subsystem fenced-lock/semaphore/id-gen through a
+  node-side bridge daemon, mutex-model checking on device (hazelcast/)
+- ``ignite``     — REST cas register + incr counter (ignite/)
+- ``aerospike``  — aql set workload, pause-capable DB (aerospike/)
+- ``elasticsearch`` — set inserts under partitions (elasticsearch/)
+- ``crate``      — dirty-read / lost-updates / _version divergence
+  (crate/)
+- ``dgraph``     — upsert uniqueness + set over the alpha HTTP API,
+  op-level tracing (dgraph/)
+- ``redis``      — --workload queue (rabbitmq/disque shape) | register
+  (EVAL compare-and-set)
+- ``rabbitmq``   — management-API queue + total-queue checker
+  (rabbitmq/; disque is the redis queue workload)
+- ``chronos``    — job-scheduler run-window verification (chronos/)
+- ``raftis``     — RESP read/write register on a Raft KV (raftis/)
+
+Not ported: faunadb/ (driver-only wire protocol with account secrets),
+rethinkdb/ (ReQL driver protocol), robustirc/ and logcabin/ (niche
+single-file suites whose capability axes — unique messages, CLI
+register — are covered by unique-ids and register workloads above).
 
 Each exposes ``test_fn(opts)`` and a ``main()`` wired through
-jepsen_tpu.cli; HTTP clients are exercised end-to-end in tests against
-in-process protocol stubs (no real cluster needed — the reference's
-suites have no unit tests at all, SURVEY §4).
+jepsen_tpu.cli; clients are exercised end-to-end in tests against
+in-process protocol stubs or dummy-remote fakes (no real cluster needed
+— the reference's suites have no unit tests at all, SURVEY §4).
 """
 
 from typing import Any, Optional  # noqa: E402
