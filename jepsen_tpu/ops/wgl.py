@@ -105,11 +105,24 @@ def _enable_compile_cache() -> None:
 
 
 @functools.lru_cache(maxsize=64)
-def _build_kernel(model_key, F: int, W: int, KO: int, S: int, ND: int, NO: int):
+def _build_kernel(model_key, F: int, W: int, KO: int, S: int, ND: int, NO: int,
+                  axis_name: Optional[str] = None, n_shards: int = 1):
     """Returns a jitted BFS driver with static shapes.
 
     model_key = (model-class, cache signature) — step_jax must be a pure
     function of the class + signature.
+
+    ``axis_name``/``n_shards``: frontier-sharded mode (the framework's
+    sequence-parallelism axis — SURVEY §5's "shard the frontier across
+    chips"). F becomes the PER-DEVICE capacity of a mesh axis named
+    ``axis_name`` with ``n_shards`` devices: each device expands and
+    locally compacts its frontier shard, an ``all_gather`` over ICI
+    exchanges the compacted candidates, the global dedup/dominance/
+    compaction runs replicated (identical inputs ⇒ identical results —
+    no divergence), and each device keeps its slice of the global
+    order. Verdict semantics are exactly the single-device kernel's at
+    capacity F×n_shards. Must be invoked under ``shard_map`` with the
+    frontier args sharded on axis 0 and everything else replicated.
 
     TPU shape notes (calibrated on-chip): in-loop gathers cost ~0.3 ms
     regardless of payload width (so the five window tables are packed into
@@ -128,6 +141,7 @@ def _build_kernel(model_key, F: int, W: int, KO: int, S: int, ND: int, NO: int):
     OB = KO * 32  # open candidate slots
     C = W + OB  # candidates per config
     M = F * C
+    FT = F * n_shards  # global frontier capacity (== F when unsharded)
 
     u32 = jnp.uint32
     slots = np.arange(W, dtype=np.int32)
@@ -283,6 +297,9 @@ def _build_kernel(model_key, F: int, W: int, KO: int, S: int, ND: int, NO: int):
             nvalid = cand.reshape(M)
 
             acc_now = jnp.any(nvalid & (np_ >= nD))
+            if axis_name is not None:
+                acc_now = lax.pmax(acc_now.astype(jnp.int32),
+                                   axis_name) > 0
 
             # --- dedup + dominance prune + compact ------------------------
             # Sort rows by (validity, group-hash, open-mask): rows with
@@ -318,7 +335,7 @@ def _build_kernel(model_key, F: int, W: int, KO: int, S: int, ND: int, NO: int):
                 gh1 = (gh1 ^ c) * u32(16777619)
                 gh2 = (gh2 ^ (c + u32(0x85EBCA6B))) * u32(0xC2B2AE35)
             key0 = (~nvalid).astype(u32)  # valid rows first
-            if M > BIG_M_THRESHOLD:
+            if axis_name is not None or M > BIG_M_THRESHOLD:
                 P = min(M, max(8 * F, 64))
                 n_cand = jnp.sum(nvalid.astype(jnp.int32))
                 pre_ovf = n_cand > P
@@ -346,6 +363,27 @@ def _build_kernel(model_key, F: int, W: int, KO: int, S: int, ND: int, NO: int):
                 nvalid = lax.iota(jnp.int32, P) < jnp.minimum(n_cand, P)
                 key0 = (~nvalid).astype(u32)
                 L = P
+                if axis_name is not None:
+                    # Frontier-parallel exchange: ship each shard's
+                    # compacted candidates to every device (ONE tiled
+                    # all_gather of a packed [P, NC+1] matrix); the
+                    # global dedup below then runs replicated.
+                    # pmat's columns are already (gh1, gh2, pcol, dcols,
+                    # scols, ocols) in order — prepend validity and ship.
+                    gmat = lax.all_gather(
+                        jnp.concatenate([key0[:, None], pmat], axis=1),
+                        axis_name, axis=0, tiled=True)  # [n_shards*P, .]
+                    key0 = gmat[:, 0]
+                    gh1 = gmat[:, 1]
+                    gh2 = gmat[:, 2]
+                    pcol = gmat[:, 3]
+                    dcols = [gmat[:, 4 + w] for w in range(KD)]
+                    scols = [gmat[:, 4 + KD + i] for i in range(S)]
+                    ocols = [gmat[:, 4 + KD + S + w]
+                             for w in range(len(ocols))]
+                    pre_ovf = lax.pmax(pre_ovf.astype(jnp.int32),
+                                       axis_name) > 0
+                    L = n_shards * P
             n_keys = 3 + len(ocols)
             sorted_ = lax.sort(
                 tuple([key0, gh1, gh2] + ocols + [pcol] + dcols + scols),
@@ -398,7 +436,7 @@ def _build_kernel(model_key, F: int, W: int, KO: int, S: int, ND: int, NO: int):
             # head[i] always comes from row i's own segment.)
             keep = svalid & ~(same_group & prev_sub) & ~head_sub
             count = jnp.sum(keep.astype(jnp.int32))
-            ovf_now = pre_ovf | (count > F)
+            ovf_now = pre_ovf | (count > FT)
 
             # Compaction: one stable sort brings kept rows to the front,
             # most-advanced (largest p) first and fewest-opens-used next —
@@ -417,8 +455,16 @@ def _build_kernel(model_key, F: int, W: int, KO: int, S: int, ND: int, NO: int):
                 num_keys=3,
                 is_stable=True,
             )
-            kvalid = lax.iota(jnp.int32, F) < jnp.minimum(count, F)
-            top = lambda c: lax.slice_in_dim(c, 0, F, axis=0)
+            if axis_name is not None:
+                # Each device keeps its slice of the global order.
+                shard0 = lax.axis_index(axis_name).astype(jnp.int32) * F
+                kvalid = (lax.iota(jnp.int32, F) + shard0) < jnp.minimum(
+                    count, FT)
+                top = lambda c: lax.dynamic_slice_in_dim(c, shard0, F,
+                                                         axis=0)
+            else:
+                kvalid = lax.iota(jnp.int32, F) < jnp.minimum(count, F)
+                top = lambda c: lax.slice_in_dim(c, 0, F, axis=0)
             kp = top(comp[3]).astype(jnp.int32) * kvalid
             kmD = jnp.stack(
                 [top(comp[4 + w]) * kvalid for w in range(KD)], axis=1
@@ -452,15 +498,20 @@ def _build_kernel(model_key, F: int, W: int, KO: int, S: int, ND: int, NO: int):
                 jnp.where((ovf_now & ~lossy_b) | (count == 0), lvl, lvl + 1),
                 acc | acc_now,
                 ovf | ovf_now,
-                jnp.maximum(fmax, jnp.minimum(count, F).astype(jnp.int32)),
+                jnp.maximum(fmax,
+                            jnp.minimum(count, FT).astype(jnp.int32)),
             )
 
         def cond(carry):
             _p, _mD, _mO, _st, valid, lvl, acc, ovf, _fm = carry
+            nonempty = jnp.any(valid)
+            if axis_name is not None:
+                nonempty = lax.pmax(nonempty.astype(jnp.int32),
+                                    axis_name) > 0
             return (
                 (~acc)
                 & ((lossy != 0) | (~ovf))
-                & jnp.any(valid)
+                & nonempty
                 & (lvl < max_levels)
             )
 
@@ -477,7 +528,14 @@ def _build_kernel(model_key, F: int, W: int, KO: int, S: int, ND: int, NO: int):
         )
         out = lax.while_loop(cond, level, init)
         p, mD, mO, st, valid, lvl, acc, ovf, fmax = out
-        return acc, ovf, jnp.any(valid), lvl, fmax, p, mD, mO, st, valid
+        nonempty = jnp.any(valid)
+        if axis_name is not None:
+            # The flag is consumed as a replicated output (out_specs P()),
+            # so it must actually BE replicated — a device whose slice of
+            # the global order is empty would otherwise report a locally
+            # empty frontier as a global refutation.
+            nonempty = lax.pmax(nonempty.astype(jnp.int32), axis_name) > 0
+        return acc, ovf, nonempty, lvl, fmax, p, mD, mO, st, valid
 
     return kernel, jax.jit(kernel)
 

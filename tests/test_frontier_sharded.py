@@ -1,0 +1,75 @@
+"""Frontier-sharded (sequence-parallel) search: differential tests on
+the 8-virtual-device CPU mesh (conftest pins the platform)."""
+
+import random
+
+import pytest
+
+from jepsen_tpu.models import CasRegister, OwnerAwareMutex
+from jepsen_tpu.ops import wgl_host
+from jepsen_tpu.parallel import make_mesh
+from jepsen_tpu.parallel.frontier import (
+    check_encoded_sharded,
+    check_history_sharded,
+)
+from jepsen_tpu.testing import (
+    perturb_history,
+    random_lock_history,
+    random_register_history,
+)
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_mesh(8, shape=(8, 1))
+
+
+class TestShardedDifferential:
+    def test_register_histories_agree_with_host(self, mesh):
+        model = CasRegister(init=0)
+        rng = random.Random(31)
+        checked = 0
+        for i in range(10):
+            h = random_register_history(rng, n_ops=60, n_procs=4,
+                                        crash_p=0.05, cas=True)
+            if i % 3 == 2:
+                h = perturb_history(rng, h)
+            want = wgl_host.check_history_host(model, h)["valid"]
+            got = check_history_sharded(model, h, mesh=mesh, f_total=128)
+            assert got["valid"] == want, (i, want, got)
+            assert got["sharded"] is True and got["n_shards"] == 8
+            checked += 1
+        assert checked == 10
+
+    def test_mutex_history(self, mesh):
+        model = OwnerAwareMutex()
+        h = random_lock_history(random.Random(5), n_ops=80, n_procs=4)
+        want = wgl_host.check_history_host(model, h)["valid"]
+        got = check_history_sharded(model, h, mesh=mesh, f_total=128)
+        assert got["valid"] == want
+
+    def test_escalation_resumes_losslessly(self, mesh):
+        """A tiny f_total forces the lossless overflow → ×4 escalation
+        path; the verdict must still match the host oracle."""
+        model = CasRegister(init=0)
+        rng = random.Random(77)
+        h = random_register_history(rng, n_ops=80, n_procs=6,
+                                    crash_p=0.1, cas=True)
+        want = wgl_host.check_history_host(model, h)["valid"]
+        got = check_history_sharded(model, h, mesh=mesh, f_total=16,
+                                    max_escalations=4)
+        assert got["valid"] == want
+        # The attempts trail is always present and records escalations
+        # with their diagnostics.
+        assert got["attempts"]
+        for a in got["attempts"][:-1]:
+            assert a["overflowed"] is True
+            assert a["calls"] >= 1
+
+    def test_empty_history(self, mesh):
+        from jepsen_tpu.history import History
+        from jepsen_tpu.ops.encode import encode_history
+
+        enc = encode_history(CasRegister(init=0), History([]))
+        got = check_encoded_sharded(enc, mesh=mesh)
+        assert got["valid"] is True
