@@ -194,11 +194,14 @@ def linearizable(options: Optional[dict] = None, **kw) -> Checker:
     ``options`` / kwargs:
 
     - ``model``: a `jepsen_tpu.models.Model` (required).
-    - ``backend``: "auto" (default) | "device" | "host" | "native" —
-      overridden by the test map's ``checker_backend`` when present (the
-      BASELINE ``:checker-backend :tpu`` dispatch; "tpu" is accepted as
-      an alias for "device"). "auto" prefers the native C search for
-      single histories and the device kernel for batches.
+    - ``backend``: "auto" (default) | "device" | "host" | "native" |
+      "sharded" — overridden by the test map's ``checker_backend`` when
+      present (the BASELINE ``:checker-backend :tpu`` dispatch; "tpu" is
+      accepted as an alias for "device"). "auto" prefers the native C
+      search for single histories and the device kernel for batches;
+      "sharded" runs the frontier-sharded multi-chip search
+      (jepsen_tpu.parallel.frontier) over the test's ``mesh`` (or the
+      default mesh).
 
     Mirrors checker.clj:182-213 (including truncating bulky diagnostics).
     """
@@ -217,7 +220,14 @@ def linearizable(options: Optional[dict] = None, **kw) -> Checker:
         backend = (test or {}).get("checker_backend", default_backend)
         if backend == "tpu":
             backend = "device"
-        res = wgl.check_history(model, history.client_ops(), backend=backend)
+        if backend == "sharded":
+            from ..parallel.frontier import check_history_sharded
+
+            res = check_history_sharded(
+                model, history.client_ops(), mesh=(test or {}).get("mesh"))
+        else:
+            res = wgl.check_history(model, history.client_ops(),
+                                    backend=backend)
         # Writing full search diagnostics "can take hours" in the reference
         # (checker.clj:210-213); keep attempts bounded likewise.
         if isinstance(res.get("attempts"), list):
@@ -254,9 +264,19 @@ def linearizable(options: Optional[dict] = None, **kw) -> Checker:
         # which includes the auto backend's host-oracle fallback.
         for k, r in out_map.items():
             if r.get("valid") == "unknown":
-                out_map[k] = wgl.check_history(
-                    model, keyed_histories[k].client_ops(), backend=backend
-                )
+                if backend == "sharded":
+                    # The explicitly-requested frontier-sharded engine —
+                    # wgl.check_history has no such branch and would
+                    # silently degrade to the single-device kernel.
+                    from ..parallel.frontier import check_history_sharded
+
+                    out_map[k] = check_history_sharded(
+                        model, keyed_histories[k].client_ops(),
+                        mesh=(test or {}).get("mesh"))
+                else:
+                    out_map[k] = wgl.check_history(
+                        model, keyed_histories[k].client_ops(),
+                        backend=backend)
         return out_map
 
     out.batch_check = batch_check

@@ -67,7 +67,8 @@ def add_test_opts(p: argparse.ArgumentParser) -> None:
     p.add_argument("--time-limit", type=int, default=60,
                    help="test duration in seconds, excl. setup/teardown")
     p.add_argument("--checker-backend",
-                   choices=["auto", "device", "tpu", "host", "native"],
+                   choices=["auto", "device", "tpu", "host", "native",
+                            "sharded"],
                    default="auto")
     p.add_argument("--store-root", default=None,
                    help="directory for the store/ tree")

@@ -107,12 +107,15 @@ def check_encoded_sharded(
     W, KO, S, ND, NO = plan.dims
     mk = wgl._model_cache_key(enc.model)
     total_levels = int(plan.args[2])
+    fmax_all = [1]  # aggregated across chunks AND escalations
 
-    def capacities(f_req: int):
-        """(per-device F, actual global FT) — the one place the rounding
-        happens, so frontier arrays and kernel shapes can't desync."""
-        F = max(f_req // D, 16)
-        return F, F * D
+    def capacities(f_req: int) -> int:
+        """Actual global capacity for a requested one: per-device F is
+        ceil(f_req / D) with a floor of 16, so the global capacity never
+        undershoots the request (the result's frontier_total reports
+        it)."""
+        F = max(-(-f_req // D), 16)
+        return F * D
 
     def run_capacity(FT: int, fr_global: tuple, attempt: dict) -> tuple:
         """Chunked search at one global capacity; returns (result|None,
@@ -130,6 +133,7 @@ def check_encoded_sharded(
                    for x in sharded(*call_args, *fr[:-1], np.int32(lvl0),
                                     np.int32(0))]
             acc, ovf, nonempty, lvl, fmax = out[:5]
+            fmax_all[0] = max(fmax_all[0], int(fmax))
             fr = tuple(out[5:]) + (np.int32(lvl),)
             attempt["levels"] = int(lvl)
             attempt["calls"] += 1
@@ -139,7 +143,7 @@ def check_encoded_sharded(
             def result(valid, **extra):
                 r = {"valid": valid, "op_count": n, "device": True,
                      "sharded": True, "n_shards": D, "levels": int(lvl),
-                     "frontier_total": FT, "frontier_max": int(fmax),
+                     "frontier_total": FT, "frontier_max": fmax_all[0],
                      "window": W,
                      "wall_s": _time.perf_counter() - t0}
                 r.update(extra)
@@ -155,7 +159,7 @@ def check_encoded_sharded(
                 return result("unknown",
                               info="level budget exhausted"), fr
 
-    F, FT = capacities(f_total)
+    FT = capacities(f_total)
     fr = wgl.initial_frontier(FT, W, KO, S, plan.init_state)
     attempts: list = []
     for _esc in range(max_escalations + 1):
@@ -166,7 +170,7 @@ def check_encoded_sharded(
             res["attempts"] = attempts
             return res
         attempt["overflowed"] = True
-        F, FT = capacities(FT * 4)
+        FT = capacities(FT * 4)
         fr = wgl._pad_frontier(fr, FT)
     return {"valid": "unknown", "op_count": n, "device": True,
             "sharded": True, "n_shards": D,

@@ -73,3 +73,21 @@ class TestShardedDifferential:
         enc = encode_history(CasRegister(init=0), History([]))
         got = check_encoded_sharded(enc, mesh=mesh)
         assert got["valid"] is True
+
+
+class TestCheckerBackendDispatch:
+    def test_sharded_backend_via_checker(self, mesh):
+        from jepsen_tpu import checker as jchecker
+        from jepsen_tpu.history import History, Op
+
+        model = CasRegister(init=0)
+        h = History([
+            Op(type="invoke", f="write", value=3, process=0, time=0),
+            Op(type="ok", f="write", value=3, process=0, time=1),
+            Op(type="invoke", f="read", value=None, process=1, time=2),
+            Op(type="ok", f="read", value=3, process=1, time=3),
+        ])
+        chk = jchecker.linearizable(model=model, backend="sharded")
+        res = chk.check({"mesh": mesh}, h, {})
+        assert res["valid"] is True
+        assert res["sharded"] is True and res["n_shards"] == 8
