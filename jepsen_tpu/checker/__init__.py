@@ -214,20 +214,26 @@ def linearizable(options: Optional[dict] = None, **kw) -> Checker:
         )
     default_backend = o.get("backend", "auto")
 
-    def chk(test, history, opts):
-        from ..ops import wgl
-
+    def _resolve_backend(test):
         backend = (test or {}).get("checker_backend", default_backend)
-        if backend == "tpu":
-            backend = "device"
+        return "device" if backend == "tpu" else backend
+
+    def _check_one(test, ops, backend):
+        """The single-history dispatch, shared by chk() and the keyed
+        batch's unknown-recheck path (so a backend added to one can't be
+        forgotten in the other)."""
         if backend == "sharded":
             from ..parallel.frontier import check_history_sharded
 
-            res = check_history_sharded(
-                model, history.client_ops(), mesh=(test or {}).get("mesh"))
-        else:
-            res = wgl.check_history(model, history.client_ops(),
-                                    backend=backend)
+            return check_history_sharded(
+                model, ops, mesh=(test or {}).get("mesh"))
+        from ..ops import wgl
+
+        return wgl.check_history(model, ops, backend=backend)
+
+    def chk(test, history, opts):
+        backend = _resolve_backend(test)
+        res = _check_one(test, history.client_ops(), backend)
         # Writing full search diagnostics "can take hours" in the reference
         # (checker.clj:210-213); keep attempts bounded likewise.
         if isinstance(res.get("attempts"), list):
@@ -241,9 +247,7 @@ def linearizable(options: Optional[dict] = None, **kw) -> Checker:
         program — jepsen_tpu.independent's device-batched check axis.
         Returns {key: result-map}. Raises if the device path is
         unavailable so the caller can fall back to per-key checking."""
-        backend = (test or {}).get("checker_backend", default_backend)
-        if backend == "tpu":
-            backend = "device"
+        backend = _resolve_backend(test)
         if backend == "host" or not model.device_capable:
             raise RuntimeError("batch check requires the device backend")
         import jax
@@ -264,19 +268,8 @@ def linearizable(options: Optional[dict] = None, **kw) -> Checker:
         # which includes the auto backend's host-oracle fallback.
         for k, r in out_map.items():
             if r.get("valid") == "unknown":
-                if backend == "sharded":
-                    # The explicitly-requested frontier-sharded engine —
-                    # wgl.check_history has no such branch and would
-                    # silently degrade to the single-device kernel.
-                    from ..parallel.frontier import check_history_sharded
-
-                    out_map[k] = check_history_sharded(
-                        model, keyed_histories[k].client_ops(),
-                        mesh=(test or {}).get("mesh"))
-                else:
-                    out_map[k] = wgl.check_history(
-                        model, keyed_histories[k].client_ops(),
-                        backend=backend)
+                out_map[k] = _check_one(
+                    test, keyed_histories[k].client_ops(), backend)
         return out_map
 
     out.batch_check = batch_check

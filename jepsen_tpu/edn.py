@@ -353,8 +353,30 @@ def _hashable(x: Any) -> Any:
     return x
 
 
+def _fast_reader():
+    """The native (C extension) reader, or None. Accelerator only: it
+    raises FastParseError on any grammar it doesn't cover (tagged
+    literals, chars, ratios, bignums) and the callers below fall back to
+    the full python reader — behavior is always the python reader's."""
+    from . import native
+
+    return native.load_edn_fast()
+
+
 def read_string(s: str) -> Any:
     """Parse a single EDN form from ``s``; trailing non-whitespace is an error."""
+    fast = _fast_reader()
+    if fast is not None:
+        try:
+            forms = fast.parse_all(s)
+        except fast.FastParseError:
+            pass
+        else:
+            if len(forms) != 1:
+                raise ValueError(
+                    "trailing content after form" if forms
+                    else "unexpected end of input")
+            return forms[0]
     r = _Reader(s)
     v = r.read()
     r.skip_ws()
@@ -364,14 +386,25 @@ def read_string(s: str) -> Any:
 
 
 def read_all(s: str) -> Iterator[Any]:
-    """Lazily parse every top-level form in ``s`` (e.g. a history.edn file,
-    one op map per line — store.clj:351-362 writes one form per line)."""
-    r = _Reader(s)
-    while True:
-        r.skip_ws()
-        if r.i >= r.n:
-            return
-        yield r.read()
+    """Parse every top-level form in ``s`` (e.g. a history.edn file, one
+    op map per line — store.clj:351-362 writes one form per line). Runs
+    on the native reader when the grammar allows, the python reader
+    otherwise."""
+    fast = _fast_reader()
+    if fast is not None:
+        try:
+            return iter(fast.parse_all(s))
+        except fast.FastParseError:
+            pass
+    def gen():
+        r = _Reader(s)
+        while True:
+            r.skip_ws()
+            if r.i >= r.n:
+                return
+            yield r.read()
+
+    return gen()
 
 
 # ---------------------------------------------------------------------------

@@ -30,21 +30,32 @@ _lib = None
 _lib_tried = False
 
 
-def _build() -> Optional[ctypes.CDLL]:
-    src = _SRC.read_text()
+def _compile(src_path: Path, stem: str, extra_args=()) -> Optional[Path]:
+    """Compile a C source into the shared cache (content-hashed name,
+    tmp-then-rename so concurrent builds can't serve a half-written .so);
+    returns the .so path or None when the toolchain is missing."""
+    src = src_path.read_text()
     digest = hashlib.sha256(src.encode()).hexdigest()[:16]
     cache = Path(os.path.expanduser("~")) / ".cache" / "jepsen_tpu_native"
     cache.mkdir(parents=True, exist_ok=True)
-    so = cache / f"wgl_native-{digest}.so"
+    so = cache / f"{stem}-{digest}.so"
     if not so.exists():
-        tmp = so.with_suffix(".so.tmp")
-        cmd = ["cc", "-O2", "-shared", "-fPIC", "-o", str(tmp), str(_SRC)]
+        tmp = so.with_suffix(f".{os.getpid()}.tmp")
+        cmd = ["cc", "-O2", "-shared", "-fPIC", *extra_args,
+               "-o", str(tmp), str(src_path)]
         proc = subprocess.run(cmd, capture_output=True)
         if proc.returncode != 0:
-            LOG.warning("native build failed: %s",
+            LOG.warning("native build of %s failed: %s", stem,
                         proc.stderr.decode(errors="replace"))
             return None
         tmp.replace(so)
+    return so
+
+
+def _build() -> Optional[ctypes.CDLL]:
+    so = _compile(_SRC, "wgl_native")
+    if so is None:
+        return None
     lib = ctypes.CDLL(str(so))
     i32p = ctypes.POINTER(ctypes.c_int32)
     lib.wgl_check.argtypes = [
@@ -78,3 +89,39 @@ def load() -> Optional[ctypes.CDLL]:
             LOG.warning("native build errored", exc_info=True)
             _lib = None
     return _lib
+
+
+# ---------------------------------------------------------------------------
+# edn_fast: the CPython-extension EDN reader (native data loader)
+
+_edn_mod = None
+_edn_tried = False
+
+
+def load_edn_fast():
+    """Build (once) + import the edn_fast extension; None when no
+    toolchain/headers. Callers fall back to the pure-python reader."""
+    global _edn_mod, _edn_tried
+    if _edn_tried:
+        return _edn_mod
+    _edn_tried = True
+    import importlib.util
+    import sysconfig
+
+    src_path = Path(__file__).resolve().parent / "edn_fast.c"
+    try:
+        inc = sysconfig.get_paths()["include"]
+        so = _compile(src_path, "edn_fast", (f"-I{inc}",))
+        if so is None:
+            return None
+        spec = importlib.util.spec_from_file_location("edn_fast", str(so))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        from .. import edn as _edn
+
+        mod.configure(_edn.K, _edn.Symbol, _edn.EdnList, _edn._hashable)
+        _edn_mod = mod
+        return mod
+    except Exception:  # pragma: no cover - defensive: always have a reader
+        LOG.warning("edn_fast unavailable", exc_info=True)
+        return None

@@ -1,3 +1,5 @@
+import pytest
+
 from jepsen_tpu import edn
 from jepsen_tpu.edn import K, Keyword, Symbol, Tagged, EdnList
 
@@ -106,3 +108,77 @@ def test_map_as_key_roundtrip():
     s = '{{:a 1} 2}'
     v = edn.read_string(s)
     assert edn.read_string(edn.write_string(v)) == v
+
+
+class TestFastReader:
+    """The native (C) reader must agree with the python reader on
+    everything it accepts, and transparently fall back on everything it
+    doesn't (tagged literals, chars, ratios)."""
+
+    def _fast(self):
+        from jepsen_tpu import native
+
+        fast = native.load_edn_fast()
+        if fast is None:
+            pytest.skip("no C toolchain for edn_fast")
+        return fast
+
+    def test_agrees_with_python_reader(self):
+        from jepsen_tpu.edn import _Reader
+
+        fast = self._fast()
+        cases = [
+            "nil", "true", "false", "0", "-17", "+4", "3.25", "-1e3",
+            '"hello"', '"esc \\"q\\" \\n\\t\\u0041"', ":kw", ":ns/kw",
+            "sym", "my.ns/sym", "[1 2 3]", "(1 2 3)", "[]", "()",
+            "{:a 1, :b [2 3]}", "#{1 2 3}", "{}", "#{}",
+            "{[1 2] 3}", "{(1 2) :v}",
+            '{:type :invoke, :f :cas, :value [0 3], :process 1, '
+            ':time 123, :index 0}',
+            "[{:a 1} {:b #{:x}} (1 [2 {:c 3}])]",
+            "; comment\n42", "#_ {:skipped 1} 7",
+        ]
+        for s in cases:
+            want = _Reader(s).read()
+            got = fast.parse(s)
+            assert got == want, (s, got, want)
+            assert type(got) is type(want), (s, type(got), type(want))
+
+    def test_falls_back_on_rich_grammar(self):
+        # read_string must still parse what the fast reader rejects.
+        from jepsen_tpu import edn
+
+        fast = self._fast()
+        for s in ["#inst \"2024-01-01T00:00:00Z\"", "\\a"]:
+            with pytest.raises(fast.FastParseError):
+                fast.parse(s)
+        # ...but the public entry point handles it via the python reader.
+        assert edn.read_string('#jepsen/tag {:a 1}') == Tagged(
+            "jepsen/tag", {K("a"): 1})
+
+    def test_parse_all_matches_read_all(self):
+        from jepsen_tpu import edn
+
+        fast = self._fast()
+        s = "{:a 1}\n{:b 2}\n42\n:kw\n"
+        assert fast.parse_all(s) == list(edn.read_all(s))
+
+    def test_history_roundtrip_via_fast_path(self):
+        import random
+
+        from jepsen_tpu.history import History
+        from jepsen_tpu.testing import random_register_history
+
+        self._fast()
+        h = random_register_history(random.Random(3), n_ops=500,
+                                    n_procs=4, cas=True, crash_p=0.05)
+        h2 = History.from_edn_string(h.to_edn_string())
+        assert [a.to_edn() for a in h.ops] == [b.to_edn() for b in h2.ops]
+
+    def test_int64_overflow_falls_back(self):
+        from jepsen_tpu import edn
+
+        # 2^70 overflows the C reader's int64; the python reader handles
+        # arbitrary precision, and read_string must return it correctly.
+        big = str(2**70)
+        assert edn.read_string(big) == 2**70
