@@ -740,6 +740,70 @@ OPTIMISTIC_MIN_OPS = 1500
 OPTIMISTIC_BEAM_F = 8192
 
 
+def _enc_fingerprint(enc: EncodedHistory, plan: DevicePlan) -> str:
+    """Content hash tying a search checkpoint to one (history, model,
+    shape-plan) so a stale file can never resume the wrong search."""
+    import hashlib
+
+    h = hashlib.sha256()
+    h.update(repr(_model_cache_key(enc.model)).encode())
+    h.update(repr(plan.dims).encode())
+    for a in plan.args:
+        h.update(np.asarray(a).tobytes())
+    return h.hexdigest()[:32]
+
+
+def _save_search_checkpoint(path, fingerprint: str, phase: str,
+                            truncated: bool, fr: tuple,
+                            lossless_fr: Optional[tuple] = None) -> None:
+    """Atomic npz snapshot of a resumable frontier (tmp + rename).
+    ``lossless_fr`` additionally persists the last LOSSLESS frontier of a
+    truncating beam, so an interrupted beam's exhaustive fallback can
+    still skip the already-exact prefix."""
+    import os
+
+    p, mD, mO, st, valid, lvl = fr
+    extra = {}
+    if lossless_fr is not None:
+        lp, lmD, lmO, lst, lvalid, llvl = lossless_fr
+        extra = {"ll_p": np.asarray(lp), "ll_mD": np.asarray(lmD),
+                 "ll_mO": np.asarray(lmO), "ll_st": np.asarray(lst),
+                 "ll_valid": np.asarray(lvalid),
+                 "ll_lvl": np.asarray(llvl)}
+    tmp = f"{path}.{os.getpid()}.tmp"
+    with open(tmp, "wb") as fh:
+        np.savez_compressed(
+            fh, fingerprint=fingerprint, phase=phase,
+            truncated=truncated, p=np.asarray(p), mD=np.asarray(mD),
+            mO=np.asarray(mO), st=np.asarray(st),
+            valid=np.asarray(valid), lvl=np.asarray(lvl), **extra)
+    os.replace(tmp, path)
+
+
+def _load_search_checkpoint(path, fingerprint: str) -> Optional[dict]:
+    import os
+
+    if not path or not os.path.exists(path):
+        return None
+    try:
+        z = np.load(path, allow_pickle=False)
+        if str(z["fingerprint"]) != fingerprint:
+            return None
+        out = {
+            "phase": str(z["phase"]),
+            "truncated": bool(z["truncated"]),
+            "fr": (z["p"], z["mD"], z["mO"], z["st"], z["valid"],
+                   np.int32(z["lvl"])),
+        }
+        if "ll_p" in z:
+            out["lossless_fr"] = (
+                z["ll_p"], z["ll_mD"], z["ll_mO"], z["ll_st"],
+                z["ll_valid"], np.int32(z["ll_lvl"]))
+        return out
+    except Exception:  # corrupt/foreign file: ignore, search from scratch
+        return None
+
+
 def check_encoded_device(
     enc: EncodedHistory,
     f_schedule=F_SCHEDULE,
@@ -748,6 +812,8 @@ def check_encoded_device(
     levels_per_call: Optional[int] = None,
     pad_to: Optional[tuple] = None,
     optimistic: Optional[bool] = None,
+    checkpoint_path: Optional[str] = None,
+    chunk_callback=None,
 ) -> dict:
     """Decide linearizability of an encoded history on the default JAX
     backend (TPU when present). Result map mirrors the host oracle
@@ -762,7 +828,16 @@ def check_encoded_device(
     progress heartbeat.
 
     Long histories run an optimistic beam phase first (see
-    OPTIMISTIC_BEAM_F above); set ``optimistic`` to force it on/off."""
+    OPTIMISTIC_BEAM_F above); set ``optimistic`` to force it on/off.
+
+    ``checkpoint_path``: persist the resumable frontier to disk after
+    every chunk (atomic npz) and resume from it on the next call with
+    the same history — mid-run checkpointing for searches that run for
+    hours, which the reference cannot do at all (its failed analyses
+    "can take hours", checker.clj:210-213, and restart from zero). The
+    file is deleted on a successful verdict. ``chunk_callback(info)`` is
+    invoked after every chunk (progress reporting; exceptions
+    propagate, which also makes interruption testable)."""
     t0 = _time.perf_counter()
     n = enc.n
     plan = plan_device(enc, max_open=max_open, window_cap=window_cap,
@@ -786,38 +861,98 @@ def check_encoded_device(
         beam_cap = schedule[-2]
     else:
         beam_cap = None
+    fingerprint = _enc_fingerprint(enc, plan) if checkpoint_path else None
+    disk = _load_search_checkpoint(checkpoint_path, fingerprint) \
+        if checkpoint_path else None
+    if disk is not None and disk["fr"][0].shape[0] > max(schedule):
+        # Checkpoint wider than this run's top capacity: slicing would
+        # drop configs (unsound refutations); start over instead.
+        disk = None
+
+    def dck(phase):
+        return ((checkpoint_path, fingerprint, phase)
+                if checkpoint_path else None)
+
+    def finish(res):
+        if checkpoint_path and res.get("valid") != "unknown":
+            import os
+
+            try:
+                os.remove(checkpoint_path)
+            except OSError:
+                pass
+        return res
+
+    if disk is not None and disk["phase"] == "full":
+        # A checkpointed exhaustive phase trumps restarting the beam.
+        res = _device_search(enc, plan, schedule, levels_per_call, t0,
+                             resume_from=disk,
+                             disk_checkpoint=dck("full"),
+                             chunk_callback=chunk_callback)
+        res["resumed_from_level"] = int(disk["fr"][-1])
+        return finish(res)
     if optimistic and beam_cap is not None:
         beam_sched = [f for f in schedule if f <= beam_cap] or [beam_cap]
         checkpoint: dict = {}
-        res = _device_search(enc, plan, beam_sched, levels_per_call, t0,
-                             checkpoint=checkpoint)
+        if disk is not None and disk.get("lossless_fr") is not None:
+            # Interrupted AFTER the beam first truncated: carry the
+            # persisted last-lossless frontier so the exhaustive fallback
+            # still skips the exact prefix.
+            checkpoint["fr"] = disk["lossless_fr"]
+        res = _device_search(
+            enc, plan, beam_sched, levels_per_call, t0,
+            checkpoint=checkpoint,
+            resume_from=disk if disk and disk["phase"] == "beam" else None,
+            disk_checkpoint=dck("beam"),
+            chunk_callback=chunk_callback)
         if res["valid"] is True:
             res["phase"] = "optimistic-beam"
-            return res
+            return finish(res)
         if res["valid"] is False and not res.get("beam"):
-            return res  # refuted without ever truncating: sound
+            return finish(res)  # refuted without ever truncating: sound
         # Beam exhausted under truncation: exhaustive phase, resumed from
         # the beam's last LOSSLESS frontier (everything before the first
         # truncation is exact, so those levels need no re-search).
-        full = _device_search(enc, plan, schedule, levels_per_call,
-                              _time.perf_counter(),
-                              resume_from=checkpoint or None)
+        full = _device_search(
+            enc, plan, schedule, levels_per_call,
+            _time.perf_counter(),
+            resume_from=checkpoint or None,
+            disk_checkpoint=dck("full"),
+            chunk_callback=chunk_callback)
         full["wall_s"] = _time.perf_counter() - t0
         full["optimistic_attempts"] = res.get("attempts")
-        return full
-    return _device_search(enc, plan, schedule, levels_per_call, t0)
+        return finish(full)
+    # Non-optimistic run: a truncated BEAM checkpoint must not seed the
+    # exhaustive search (its lossy frontier could never refute, and the
+    # file would repin that state forever); its lossless companion can.
+    resume = None
+    if disk is not None:
+        if disk["phase"] == "full" or not disk["truncated"]:
+            resume = disk
+        elif disk.get("lossless_fr") is not None:
+            resume = {"fr": disk["lossless_fr"]}
+    return finish(_device_search(
+        enc, plan, schedule, levels_per_call, t0,
+        resume_from=resume,
+        disk_checkpoint=dck("full"),
+        chunk_callback=chunk_callback))
 
 
 def _device_search(enc: EncodedHistory, plan: DevicePlan, schedule: list,
                    levels_per_call: Optional[int], t0: float,
                    checkpoint: Optional[dict] = None,
-                   resume_from: Optional[dict] = None) -> dict:
+                   resume_from: Optional[dict] = None,
+                   disk_checkpoint: Optional[tuple] = None,
+                   chunk_callback=None) -> dict:
     """One escalating/de-escalating frontier search over ``schedule``;
     the top capacity continues past overflow as a greedy beam.
 
     ``checkpoint`` (out): receives {"fr"} — the entry frontier of the
     first chunk that truncated (the last lossless state).
-    ``resume_from``: such a dict to start from instead of level 0."""
+    ``resume_from``: such a dict to start from instead of level 0.
+    ``disk_checkpoint``: (path, fingerprint, phase) — persist the
+    resumable frontier after every chunk. ``chunk_callback(info)``:
+    per-chunk progress hook."""
     n = enc.n
     W, KO, S, ND, NO = plan.dims
     total_levels = int(plan.args[2])
@@ -866,7 +1001,7 @@ def _device_search(enc: EncodedHistory, plan: DevicePlan, schedule: list,
     # lossless escalation left, so on overflow the kernel keeps the best F
     # configs and continues. `truncated` records whether any level actually
     # dropped configs — False verdicts are only sound when it never did.
-    truncated = False
+    truncated = bool(resume_from.get("truncated")) if resume_from else False
     while True:
         _, kern = _build_kernel(mk, F, W, KO, S, ND, NO)
         if fr[0].shape[0] < F:
@@ -894,6 +1029,16 @@ def _device_search(enc: EncodedHistory, plan: DevicePlan, schedule: list,
             if not truncated and checkpoint is not None:
                 checkpoint["fr"] = entry_fr
             truncated = True
+        if disk_checkpoint is not None:
+            path, fingerprint, phase = disk_checkpoint
+            _save_search_checkpoint(
+                path, fingerprint, phase, truncated, fr,
+                lossless_fr=checkpoint.get("fr")
+                if checkpoint is not None else None)
+        if chunk_callback is not None:
+            chunk_callback({"level": int(lvl), "F": F,
+                            "frontier_max": fmax_all,
+                            "wall_s": _time.perf_counter() - t0})
         if bool(acc):
             # Sound even after truncation: dropping configs only removes
             # accepting paths, never invents one.

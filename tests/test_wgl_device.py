@@ -148,3 +148,123 @@ class TestOptimisticBeam:
                 optimistic=True)
             host = wgl_host.check_history_host(model, h)
             assert dev["valid"] == host["valid"], (i, dev, host)
+
+
+class TestDiskCheckpoint:
+    """Mid-run checkpoint/resume of the device search (the reference
+    restarts failed multi-hour analyses from zero; checker.clj:210-213)."""
+
+    def _enc(self, seed=11, n_ops=120):
+        import random
+
+        from jepsen_tpu.models import CasRegister
+        from jepsen_tpu.ops.encode import encode_history
+        from jepsen_tpu.testing import random_register_history
+
+        model = CasRegister(init=0)
+        h = random_register_history(random.Random(seed), n_ops=n_ops,
+                                    n_procs=5, cas=True, crash_p=0.05)
+        return model, h, encode_history(model, h)
+
+    def test_checkpoint_written_and_cleaned(self, tmp_path):
+        from jepsen_tpu.ops import wgl, wgl_host
+
+        model, h, enc = self._enc()
+        ck = str(tmp_path / "search.npz")
+        chunks = []
+        res = wgl.check_encoded_device(
+            enc, levels_per_call=10, checkpoint_path=ck,
+            chunk_callback=chunks.append)
+        assert res["valid"] == wgl_host.check_history_host(model, h)["valid"]
+        assert len(chunks) >= 2  # really ran chunked
+        assert all(c["level"] >= 0 and "wall_s" in c for c in chunks)
+        import os
+
+        assert not os.path.exists(ck)  # deleted on a definite verdict
+
+    def test_interrupt_and_resume(self, tmp_path):
+        import os
+
+        import pytest
+
+        from jepsen_tpu.ops import wgl, wgl_host
+
+        model, h, enc = self._enc(seed=13)
+        ck = str(tmp_path / "search.npz")
+
+        calls = [0]
+
+        def bomb(info):
+            calls[0] += 1
+            if calls[0] == 2:
+                raise KeyboardInterrupt  # simulate an interrupted run
+
+        with pytest.raises(KeyboardInterrupt):
+            wgl.check_encoded_device(enc, levels_per_call=5,
+                                     checkpoint_path=ck,
+                                     chunk_callback=bomb)
+        assert os.path.exists(ck)  # partial state survived
+
+        res = wgl.check_encoded_device(enc, levels_per_call=5,
+                                       checkpoint_path=ck)
+        assert res.get("resumed_from_level", 0) > 0
+        assert res["valid"] == wgl_host.check_history_host(model, h)["valid"]
+        assert not os.path.exists(ck)
+
+    def test_stale_checkpoint_ignored(self, tmp_path):
+        import os
+
+        from jepsen_tpu.ops import wgl, wgl_host
+
+        model1, h1, enc1 = self._enc(seed=17)
+        ck = str(tmp_path / "search.npz")
+
+        def bomb(info):
+            raise KeyboardInterrupt
+
+        try:
+            wgl.check_encoded_device(enc1, levels_per_call=5,
+                                     checkpoint_path=ck,
+                                     chunk_callback=bomb)
+        except KeyboardInterrupt:
+            pass
+        assert os.path.exists(ck)
+        # A DIFFERENT history with the same path: fingerprint mismatch,
+        # search starts from scratch and is still correct.
+        model2, h2, enc2 = self._enc(seed=23)
+        res = wgl.check_encoded_device(enc2, checkpoint_path=ck)
+        assert "resumed_from_level" not in res
+        assert res["valid"] == wgl_host.check_history_host(
+            model2, h2)["valid"]
+
+    def test_truncated_beam_checkpoint_cannot_poison_full_search(
+            self, tmp_path):
+        """A lossy beam frontier must never seed the exhaustive search
+        (it could never refute); only its lossless companion may."""
+        import numpy as np
+
+        from jepsen_tpu.ops import wgl, wgl_host
+        from jepsen_tpu.testing import perturb_history
+        import random
+
+        model, h, _ = self._enc(seed=29)
+        h = perturb_history(random.Random(1), h)  # likely invalid
+        from jepsen_tpu.ops.encode import encode_history
+
+        enc = encode_history(model, h)
+        want = wgl_host.check_history_host(model, h)["valid"]
+        plan = wgl.plan_device(enc)
+        W, KO, S, _ND, _NO = plan.dims
+        ck = str(tmp_path / "search.npz")
+        fp = wgl._enc_fingerprint(enc, plan)
+        # Fabricate an interrupted TRUNCATED beam: a lossy current
+        # frontier (empty, mid-history) + the true lossless level-0
+        # frontier as companion.
+        lossless = wgl.initial_frontier(16, W, KO, S, plan.init_state)
+        lossy = tuple(np.asarray(a) for a in lossless[:-1]) + (
+            np.int32(max(enc.n // 2, 1)),)
+        wgl._save_search_checkpoint(ck, fp, "beam", True, lossy,
+                                    lossless_fr=lossless)
+        res = wgl.check_encoded_device(enc, checkpoint_path=ck,
+                                       optimistic=False)
+        assert res["valid"] == want  # not poisoned into 'unknown'
