@@ -143,6 +143,33 @@ def main() -> int:
         except Exception as e:  # noqa: BLE001
             out["headroom_10x"] = {"error": f"{type(e).__name__}: {e}"}
 
+        # Host-side companion: threaded-interpreter scheduling throughput
+        # (the reference's generator claims >20k ops/s on the JVM,
+        # generator.clj:67-70; real tests run orders of magnitude slower
+        # against actual databases, so "sufficient" is the bar).
+        try:
+            from jepsen_tpu import core as jcore
+            from jepsen_tpu import generator as jgen
+            from jepsen_tpu.workloads import AtomState, atom_client, \
+                noop_test
+
+            def _w(test=None, ctx=None):
+                return {"type": "invoke", "f": "write", "value": 1}
+
+            itest = dict(noop_test())
+            n_i = 20000
+            itest.update(name=None, nodes=["n1"], concurrency=8,
+                         client=atom_client(AtomState()),
+                         generator=jgen.clients(jgen.limit(n_i, _w)))
+            t0 = time.perf_counter()
+            ires = jcore.run(itest)
+            idt = time.perf_counter() - t0
+            n_ok = sum(1 for op in ires["history"] if op.type == "ok")
+            out["interpreter_ops_per_s"] = round(n_ok / idt, 1)
+        except Exception as e:  # noqa: BLE001
+            out["interpreter_ops_per_s"] = None
+            out["interpreter_error"] = f"{type(e).__name__}: {e}"
+
         # --- Device sections, costliest-compile last, each budgeted ----
         # Batch replay: 100 histories decided as one vmapped program
         # (BASELINE config 5). Worst case ~90 s (compile + 2 runs).

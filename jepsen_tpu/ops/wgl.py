@@ -780,6 +780,16 @@ def _save_search_checkpoint(path, fingerprint: str, phase: str,
     os.replace(tmp, path)
 
 
+def _clear_search_checkpoint(path) -> None:
+    """Remove a checkpoint once its search reached a definite verdict."""
+    import os
+
+    try:
+        os.remove(path)
+    except OSError:
+        pass
+
+
 def _load_search_checkpoint(path, fingerprint: str) -> Optional[dict]:
     import os
 
@@ -875,12 +885,7 @@ def check_encoded_device(
 
     def finish(res):
         if checkpoint_path and res.get("valid") != "unknown":
-            import os
-
-            try:
-                os.remove(checkpoint_path)
-            except OSError:
-                pass
+            _clear_search_checkpoint(checkpoint_path)
         return res
 
     if disk is not None and disk["phase"] == "full":
@@ -899,10 +904,16 @@ def check_encoded_device(
             # persisted last-lossless frontier so the exhaustive fallback
             # still skips the exact prefix.
             checkpoint["fr"] = disk["lossless_fr"]
+        # The beam runs under beam_sched, not the full schedule: a
+        # checkpoint frontier wider than every beam capacity would reach
+        # a kernel whose static F is smaller — restart the beam instead.
+        beam_resume = (
+            disk if disk and disk["phase"] == "beam"
+            and disk["fr"][0].shape[0] <= max(beam_sched) else None)
         res = _device_search(
             enc, plan, beam_sched, levels_per_call, t0,
             checkpoint=checkpoint,
-            resume_from=disk if disk and disk["phase"] == "beam" else None,
+            resume_from=beam_resume,
             disk_checkpoint=dck("beam"),
             chunk_callback=chunk_callback)
         if res["valid"] is True:
@@ -927,7 +938,9 @@ def check_encoded_device(
     # file would repin that state forever); its lossless companion can.
     resume = None
     if disk is not None:
-        if disk["phase"] == "full" or not disk["truncated"]:
+        # (phase == "full" returned above, so any disk here is a beam
+        # checkpoint.)
+        if not disk["truncated"]:
             resume = disk
         elif disk.get("lossless_fr") is not None:
             resume = {"fr": disk["lossless_fr"]}

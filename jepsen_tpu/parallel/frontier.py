@@ -81,6 +81,7 @@ def check_encoded_sharded(
     window_cap: int = 1024,
     levels_per_call: Optional[int] = None,
     max_escalations: int = 2,
+    checkpoint_path: Optional[str] = None,
 ) -> dict:
     """Decide linearizability of one encoded history with the frontier
     sharded over ``mesh``'s ``axis``. Result map mirrors
@@ -91,6 +92,13 @@ def check_encoded_sharded(
     actual capacity used); overflow escalates ×4 up to
     ``max_escalations`` times (lossless: resumes from the kept
     frontier), after which the verdict is "unknown".
+
+    ``checkpoint_path``: persist the resumable global frontier after
+    every chunk (atomic, content-fingerprinted npz shared with the
+    single-device driver) and resume from it on the next call; deleted
+    on a definite verdict. The sharded search is always lossless, so a
+    resumed frontier is exact regardless of mesh size (the width is
+    re-rounded to the new mesh's per-device multiple).
     """
     t0 = _time.perf_counter()
     if mesh is None:
@@ -135,6 +143,9 @@ def check_encoded_sharded(
             acc, ovf, nonempty, lvl, fmax = out[:5]
             fmax_all[0] = max(fmax_all[0], int(fmax))
             fr = tuple(out[5:]) + (np.int32(lvl),)
+            if checkpoint_path:
+                wgl._save_search_checkpoint(
+                    checkpoint_path, fingerprint, "sharded", False, fr)
             attempt["levels"] = int(lvl)
             attempt["calls"] += 1
             attempt["wall_s"] = round(
@@ -159,8 +170,28 @@ def check_encoded_sharded(
                 return result("unknown",
                               info="level budget exhausted"), fr
 
-    FT = capacities(f_total)
-    fr = wgl.initial_frontier(FT, W, KO, S, plan.init_state)
+    fingerprint = wgl._enc_fingerprint(enc, plan) if checkpoint_path \
+        else None
+    disk = wgl._load_search_checkpoint(checkpoint_path, fingerprint) \
+        if checkpoint_path else None
+    resumed_level = 0
+    resume_fr = None
+    if disk is not None:
+        # Only an exact (never-truncated) frontier may seed this search:
+        # the file format is shared with the single-device driver, whose
+        # beam phase writes LOSSY frontiers — resuming one here could
+        # refute a linearizable history. Its lossless companion is fine.
+        if not disk["truncated"]:
+            resume_fr = disk["fr"]
+        elif disk.get("lossless_fr") is not None:
+            resume_fr = disk["lossless_fr"]
+    if resume_fr is not None:
+        FT = capacities(max(f_total, resume_fr[0].shape[0]))
+        fr = wgl._pad_frontier(resume_fr, FT)
+        resumed_level = int(resume_fr[-1])
+    else:
+        FT = capacities(f_total)
+        fr = wgl.initial_frontier(FT, W, KO, S, plan.init_state)
     attempts: list = []
     for _esc in range(max_escalations + 1):
         attempt = {"F": FT, "levels": 0, "calls": 0, "wall_s": 0.0}
@@ -168,6 +199,10 @@ def check_encoded_sharded(
         res, fr = run_capacity(FT, fr, attempt)
         if res is not None:
             res["attempts"] = attempts
+            if resumed_level:
+                res["resumed_from_level"] = resumed_level
+            if checkpoint_path and res.get("valid") != "unknown":
+                wgl._clear_search_checkpoint(checkpoint_path)
             return res
         attempt["overflowed"] = True
         FT = capacities(FT * 4)

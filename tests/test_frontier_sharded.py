@@ -91,3 +91,64 @@ class TestCheckerBackendDispatch:
         res = chk.check({"mesh": mesh}, h, {})
         assert res["valid"] is True
         assert res["sharded"] is True and res["n_shards"] == 8
+
+
+class TestShardedCheckpoint:
+    def test_resume_roundtrip(self, mesh, tmp_path):
+        import os
+
+        from jepsen_tpu.ops import wgl, wgl_host
+        from jepsen_tpu.ops.encode import encode_history
+
+        model = CasRegister(init=0)
+        h = random_register_history(random.Random(41), n_ops=100,
+                                    n_procs=5, cas=True, crash_p=0.05)
+        enc = encode_history(model, h)
+        want = wgl_host.check_history_host(model, h)["valid"]
+        ck = str(tmp_path / "sharded.npz")
+        # Fabricate an interrupted run: save a real mid-search frontier
+        # by running once with a checkpoint, grabbing the file before the
+        # (successful) run deletes it is racy — instead run with a
+        # 1-level budget... simplest honest route: run fully once with
+        # checkpointing (file deleted), then write a level-0 checkpoint
+        # by hand and confirm resume replays to the same verdict.
+        plan = wgl.plan_device(enc)
+        W, KO, S, _ND, _NO = plan.dims
+        fp = wgl._enc_fingerprint(enc, plan)
+        fr0 = wgl.initial_frontier(16 * 8, W, KO, S, plan.init_state)
+        wgl._save_search_checkpoint(ck, fp, "sharded", False, fr0)
+        got = check_encoded_sharded(enc, mesh=mesh, f_total=128,
+                                    checkpoint_path=ck)
+        assert got["valid"] == want
+        if got["valid"] != "unknown":
+            assert not os.path.exists(ck)
+
+    def test_lossy_device_checkpoint_cannot_seed_sharded(self, mesh,
+                                                         tmp_path):
+        """A truncated single-device beam checkpoint must not resume the
+        (lossless) sharded search — it could falsely refute."""
+        import numpy as np
+
+        from jepsen_tpu.ops import wgl, wgl_host
+        from jepsen_tpu.ops.encode import encode_history
+
+        model = CasRegister(init=0)
+        h = random_register_history(random.Random(43), n_ops=80,
+                                    n_procs=4, cas=True)
+        enc = encode_history(model, h)
+        want = wgl_host.check_history_host(model, h)["valid"]
+        plan = wgl.plan_device(enc)
+        W, KO, S, _ND, _NO = plan.dims
+        ck = str(tmp_path / "lossy.npz")
+        fp = wgl._enc_fingerprint(enc, plan)
+        # A lossy mid-history frontier that would die out immediately.
+        dead = wgl.initial_frontier(16, W, KO, S, plan.init_state)
+        dead = tuple(np.asarray(a) for a in dead[:-1]) + (
+            np.int32(max(enc.n // 2, 1)),)
+        dead = (dead[0], dead[1], dead[2], dead[3],
+                np.zeros_like(np.asarray(dead[4])), dead[5])
+        wgl._save_search_checkpoint(ck, fp, "beam", True, dead)
+        got = check_encoded_sharded(enc, mesh=mesh, f_total=128,
+                                    checkpoint_path=ck)
+        assert got["valid"] == want  # resumed from scratch, not poisoned
+        assert "resumed_from_level" not in got
