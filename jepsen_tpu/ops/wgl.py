@@ -318,15 +318,16 @@ def _build_kernel(model_key, F: int, W: int, KO: int, S: int, ND: int, NO: int,
             # expansion dominates level cost once M is in the high
             # hundreds of thousands (bitonic passes scale ~log^2 and move
             # EVERY operand through every compare-exchange). Stage 1
-            # compacts with the cheapest possible M-sized sort — just
-            # (validity, hash, iota), 3 operands — then ONE row gather
-            # pulls the top-P candidate columns for the full multi-key
-            # stage-2 sort. >P survivors are treated as overflow
-            # (lossless: handled like any frontier overflow). An earlier
-            # cumsum+searchsorted formulation measured ~2x SLOWER than
-            # the direct 8-operand sort at M=786k on a v5e; this
-            # formulation measures faster (the M-sized sort carries 3
-            # operands instead of 8, and everything after runs on P).
+            # compacts with the cheapest possible M-sized sort — ONE
+            # fused key (validity in the hash's top bit) plus an iota
+            # payload, 2 operands — then ONE row gather pulls the top-P
+            # candidate columns for the full multi-key stage-2 sort.
+            # >P survivors are treated as overflow (lossless: handled
+            # like any frontier overflow). An earlier cumsum+searchsorted
+            # formulation measured ~2x SLOWER than the direct 8-operand
+            # sort at M=786k on a v5e; this formulation measures faster
+            # (2 operands through the M-sized sort, everything after on
+            # P rows).
             pre_ovf = jnp.asarray(False)
             L = M
             gh1 = jnp.full((M,), u32(2166136261))

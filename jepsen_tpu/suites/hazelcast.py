@@ -328,7 +328,7 @@ def test_fn(opts: dict) -> dict:
     # suite's sleep/start/sleep/stop discipline), with a final heal;
     # time-limited as a whole so the infinite cycle can't outlive the
     # bounded client generator.
-    interval = int(opts.get("nemesis_interval") or 10)
+    interval = float(opts.get("nemesis_interval") or 10)
     test["generator"] = std_generator(opts, wl["generator"], dt=interval)
     return test
 

@@ -1,0 +1,84 @@
+"""Suite contract tests: EVERY suite's test_fn must produce a
+well-formed test map whose composed generator terminates through the
+real threaded interpreter (the nemesis-cycle hang class of bug), with a
+universal ok-client and a no-op nemesis standing in for the cluster."""
+
+import importlib
+import threading
+
+import pytest
+
+from jepsen_tpu import client as jclient
+from jepsen_tpu import core
+from jepsen_tpu import nemesis as jnemesis
+from jepsen_tpu.util import with_relative_time
+from jepsen_tpu.workloads import noop_test
+
+SUITES = [
+    "aerospike", "chronos", "cockroachdb", "consul", "crate", "dgraph",
+    "elasticsearch", "etcd", "hazelcast", "ignite", "mongodb", "mysql",
+    "postgres", "rabbitmq", "raftis", "redis", "stolon", "tidb",
+    "yugabyte", "zookeeper",
+]
+
+
+@pytest.mark.parametrize("name", SUITES)
+def test_suite_test_fn_contract(name):
+    mod = importlib.import_module(f"jepsen_tpu.suites.{name}")
+    # Window must fit several staggered ops (suites schedule at
+    # ~10 Hz); too tight and a slow-start run finishes zero client ops.
+    t = mod.test_fn({"time_limit": 1.5, "ops": 8, "jobs": 2,
+                     "stagger": 0.01, "nemesis_interval": 0.1,
+                     "keys": 2, "count": 1,
+                     # keyed workloads must fit the harness concurrency
+                     "threads-per-key": 2, "ops-per-key": 4})
+    # Map shape every runner relies on.
+    assert t.get("name"), name
+    assert "generator" in t and t["generator"] is not None, name
+    assert "checker" in t and t["checker"] is not None, name
+    assert "client" in t and t["client"] is not None, name
+    assert "db" in t, name
+
+    # The composed generator must terminate through the REAL interpreter
+    # (universal fakes; no store, no checker run).
+    test = dict(noop_test())
+    # Workload parameters ride the test map (accounts/max-transfer/...);
+    # carry everything except the infrastructure we're faking out.
+    test.update({k: v for k, v in t.items()
+                 if k not in ("db", "client", "nemesis", "net", "checker",
+                              "generator", "name", "os", "plot")})
+    test.update(
+        name=None,  # no store
+        nodes=["n1", "n2"],
+        concurrency=4,
+        client=jclient.noop(),     # acks every op
+        nemesis=jnemesis.noop(),
+        generator=t["generator"],
+    )
+    test.pop("checker", None)
+    res_cell, err_cell = [], []
+
+    def run():
+        try:
+            res_cell.append(core.run_case(dict(test)))
+        except Exception as e:  # noqa: BLE001
+            err_cell.append(e)
+
+    th = threading.Thread(target=run, daemon=True)
+    # run_case must execute under the relative test clock (core.run does
+    # this); without it the generator context's time base (0) and the
+    # interpreter's (raw monotonic) mix and every time_limit cuts
+    # instantly. Entered on THIS thread so a timed-out worker abandoned
+    # past join() can't restore the process-global origin mid-way
+    # through a later parametrized case.
+    with with_relative_time():
+        th.start()
+        th.join(30)
+    assert not th.is_alive(), f"{name}: generator did not terminate"
+    assert not err_cell, f"{name}: {err_cell}"
+    history = res_cell[0]
+    assert history, f"{name}: empty history"
+    # run_case returns raw op dicts (History conversion happens in run);
+    # client ops actually flowed.
+    assert any(op["type"] == "ok" and op["process"] != "nemesis"
+               for op in history), name
