@@ -197,13 +197,21 @@ def _build_closures_kernel(n: int):
     import jax
     import jax.numpy as jnp
 
-    def close(a):  # [n, n] f32 0/1
+    def close(a):  # [n, n] 0/1
+        # bf16 is sound for boolean reachability: entries are
+        # non-negative path counts, so nonzero stays nonzero under
+        # rounding and min(.,1) re-binarizes each squaring. Halves HBM
+        # (the capacity ceiling on txn count) and runs the MXU at its
+        # bf16 rate.
+        a = a.astype(jnp.bfloat16)
+
         def step(a, _):
-            return jnp.minimum(a + a @ a, 1.0), None
+            return jnp.minimum(a + a @ a, jnp.bfloat16(1.0)), None
+
         steps = max(1, int(np.ceil(np.log2(max(n, 2)))))
         from jax import lax
         a, _ = lax.scan(step, a, None, length=steps)
-        return a
+        return a.astype(jnp.float32)
 
     def kernel(ww, wwr, full):
         cw, cwr, cf = close(ww), close(wwr), close(full)
