@@ -1,0 +1,62 @@
+"""Throughput-floor tests — run with --run-perf (the reference's :perf
+selector tier, excluded by default). Floors are deliberately loose (CI
+machines vary); they exist to catch order-of-magnitude regressions."""
+
+import random
+import time
+
+import pytest
+
+
+@pytest.mark.perf
+def test_interpreter_throughput_floor():
+    from jepsen_tpu import client as jclient
+    from jepsen_tpu import core, generator as gen
+    from jepsen_tpu.workloads import noop_test
+
+    def w(test=None, ctx=None):
+        return {"type": "invoke", "f": "write", "value": 1}
+
+    test = dict(noop_test())
+    test.update(name=None, nodes=["n1"], concurrency=8,
+                client=jclient.noop(),
+                generator=gen.clients(gen.limit(20000, w)))
+    t0 = time.perf_counter()
+    res = core.run(test)
+    dt = time.perf_counter() - t0
+    ok = sum(1 for op in res["history"] if op.type == "ok")
+    assert ok / dt > 1000, f"{ok / dt:.0f} ops/s"
+
+
+@pytest.mark.perf
+def test_edn_parse_throughput_floor():
+    from jepsen_tpu.history import History
+    from jepsen_tpu.testing import random_register_history
+
+    h = random_register_history(random.Random(1), n_ops=20000,
+                                n_procs=10, cas=True)
+    s = h.to_edn_string()
+    t0 = time.perf_counter()
+    History.from_edn_string(s)
+    rate = len(s) / 1e6 / (time.perf_counter() - t0)
+    assert rate > 1.0, f"{rate:.1f} MB/s"
+
+
+@pytest.mark.perf
+def test_native_engine_throughput_floor():
+    from jepsen_tpu import native
+    from jepsen_tpu.models import CasRegister
+    from jepsen_tpu.ops import wgl
+    from jepsen_tpu.testing import random_register_history
+
+    if native.load() is None:
+        pytest.skip("no C toolchain")
+    model = CasRegister(init=0)
+    h = random_register_history(random.Random(2), n_ops=10000,
+                                n_procs=10, cas=True, crash_p=0.002)
+    wgl.check_history(model, h)  # warm
+    t0 = time.perf_counter()
+    res = wgl.check_history(model, h)
+    dt = time.perf_counter() - t0
+    assert res["valid"] is True
+    assert dt < 5.0, f"{dt:.2f}s for 10k ops"
