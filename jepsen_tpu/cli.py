@@ -185,9 +185,12 @@ def single_test_cmd(test_fn: Callable[[dict], dict],
     }
 
 
-def test_all_cmd(test_fns: dict, opt_fn: Optional[Callable] = None) -> dict:
+def test_all_cmd(test_fns: dict, opt_fn: Optional[Callable] = None,
+                 add_opts: Optional[Callable] = None) -> dict:
     """Command `test-all`: sweep a map of name -> test_fn
-    (cli.clj:420-502); exit code is the worst across the sweep."""
+    (cli.clj:420-502); exit code is the worst across the sweep.
+    ``add_opts`` installs the same suite flags the single `test`
+    command takes (so a soak can raise --ops etc.)."""
 
     def run_all(opts) -> int:
         worst = EXIT_OK
@@ -207,6 +210,7 @@ def test_all_cmd(test_fns: dict, opt_fn: Optional[Callable] = None) -> dict:
         return worst
 
     return {"test-all": {"run": run_all, "opt_fn": opt_fn,
+                         "add_opts": add_opts,
                          "help": "Run every test in the suite."}}
 
 

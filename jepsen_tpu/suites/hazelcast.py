@@ -342,8 +342,29 @@ def _add_opts(p):
     p.add_argument("--nemesis-interval", type=int, default=10)
 
 
+def cp_soak_test_fns() -> dict:
+    """Every CP workload × mutex model — the repeat_all_cp_tests.sh
+    sweep (hazelcast/repeat_all_cp_tests.sh:1-40) as a `test-all`
+    command."""
+    fns = {}
+    for model in sorted(wlock.MODELS):
+        def lock_fn(opts, _m=model):
+            return test_fn({**opts, "workload": "lock", "model": _m})
+
+        fns[f"lock-{model}"] = lock_fn
+    for wname in ("semaphore", "id-gen"):
+        def other_fn(opts, _w=wname):
+            return test_fn({**opts, "workload": _w})
+
+        fns[wname] = other_fn
+    return fns
+
+
 def main(argv=None):
-    cli.main_exit(cli.single_test_cmd(test_fn, add_opts=_add_opts), argv)
+    cmds = dict(cli.single_test_cmd(test_fn, add_opts=_add_opts))
+    cmds.update(cli.test_all_cmd(cp_soak_test_fns(),
+                                 add_opts=_add_opts))
+    cli.main_exit(cmds, argv)
 
 
 if __name__ == "__main__":

@@ -317,7 +317,8 @@ def _add_opts(p):
 
 def main(argv=None):
     cmds = dict(cli.single_test_cmd(test_fn, add_opts=_add_opts))
-    cmds.update(cli.test_all_cmd(matrix_test_fns()))
+    cmds.update(cli.test_all_cmd(matrix_test_fns(),
+                                 add_opts=_add_opts))
     cli.main_exit(cmds, argv)
 
 

@@ -1469,3 +1469,17 @@ class TestLegacySuites:
         assert codec.decode(b"") is None
         v = {edn.K("type"): edn.K("ok"), edn.K("value"): [1, [2, 3]]}
         assert codec.decode(codec.encode(v)) == v
+
+
+class TestHazelcastSoak:
+    def test_cp_soak_matrix(self):
+        from jepsen_tpu.suites import hazelcast as hz
+        from jepsen_tpu.workloads import lock as wlock
+
+        fns = hz.cp_soak_test_fns()
+        assert set(fns) == (
+            {f"lock-{m}" for m in wlock.MODELS} | {"semaphore", "id-gen"})
+        t = fns["lock-fenced-mutex"]({"time_limit": 1})
+        assert t["name"] == "hazelcast-lock"
+        t2 = fns["id-gen"]({"time_limit": 1})
+        assert t2["name"] == "hazelcast-id-gen"
