@@ -389,7 +389,14 @@ def read_all(s: str) -> Iterator[Any]:
     """Parse every top-level form in ``s`` (e.g. a history.edn file, one
     op map per line — store.clj:351-362 writes one form per line). Runs
     on the native reader when the grammar allows, the python reader
-    otherwise."""
+    otherwise.
+
+    Laziness: the native fast path materializes EVERY form before the
+    first is yielded (one C call parses the whole buffer); only the
+    python fallback streams form-by-form. Batch consumers (the history
+    loader, replay) read everything anyway, so peak memory is the same
+    — but callers that want to stop early on multi-GB files should chunk
+    the input per line themselves before calling."""
     fast = _fast_reader()
     if fast is not None:
         try:

@@ -886,6 +886,12 @@ def check_encoded_device(
         # Checkpoint wider than this run's top capacity: slicing would
         # drop configs (unsound refutations); start over instead.
         disk = None
+    if (disk is not None and disk.get("lossless_fr") is not None
+            and disk["lossless_fr"][0].shape[0] > max(schedule)):
+        # The lossless companion can be WIDER than fr (beam de-escalated
+        # after its first truncation); one too wide for this run's top
+        # capacity cannot seed any kernel — drop just the companion.
+        disk = {k: v for k, v in disk.items() if k != "lossless_fr"}
 
     def dck(phase):
         return ((checkpoint_path, fingerprint, phase)
@@ -896,8 +902,23 @@ def check_encoded_device(
             _clear_search_checkpoint(checkpoint_path)
         return res
 
-    if disk is not None and disk["phase"] == "full":
-        # A checkpointed exhaustive phase trumps restarting the beam.
+    # Beam capacities the optimistic phase would run under (needed now to
+    # route checkpoints): a frontier wider than every beam capacity would
+    # reach a kernel whose static F is smaller.
+    beam_sched = ([f for f in schedule if f <= beam_cap] or [beam_cap]) \
+        if beam_cap is not None else []
+    sharded_disk = (disk is not None and disk["phase"] == "sharded"
+                    and not disk["truncated"])
+    if disk is not None and (
+            disk["phase"] == "full"
+            or (sharded_disk
+                and (not optimistic or beam_cap is None
+                     or disk["fr"][0].shape[0] > max(beam_sched)))):
+        # A checkpointed exhaustive phase trumps restarting the beam; a
+        # lossless sharded-driver frontier that cannot seed the beam
+        # (beam off, or frontier wider than every beam capacity) resumes
+        # the exhaustive phase directly rather than re-searching the
+        # already-exact prefix from level 0.
         res = _device_search(enc, plan, schedule, levels_per_call, t0,
                              resume_from=disk,
                              disk_checkpoint=dck("full"),
@@ -905,18 +926,28 @@ def check_encoded_device(
         res["resumed_from_level"] = int(disk["fr"][-1])
         return finish(res)
     if optimistic and beam_cap is not None:
-        beam_sched = [f for f in schedule if f <= beam_cap] or [beam_cap]
         checkpoint: dict = {}
         if disk is not None and disk.get("lossless_fr") is not None:
             # Interrupted AFTER the beam first truncated: carry the
             # persisted last-lossless frontier so the exhaustive fallback
             # still skips the exact prefix.
             checkpoint["fr"] = disk["lossless_fr"]
-        # The beam runs under beam_sched, not the full schedule: a
-        # checkpoint frontier wider than every beam capacity would reach
-        # a kernel whose static F is smaller — restart the beam instead.
+        elif sharded_disk:
+            # Sharded-driver checkpoints are lossless (defensively
+            # checked, mirroring the non-optimistic path; one claiming
+            # truncation was never written by the sharded driver and is
+            # not trusted). The progress survives the engine switch:
+            # the exhaustive fallback resumes from it even if the beam
+            # truncates immediately (_device_search keeps the DEEPEST
+            # lossless frontier, so a restarted beam's early truncation
+            # cannot clobber this seed).
+            checkpoint["fr"] = disk["fr"]
+        # Beam checkpoints may resume truncated (_device_search restores
+        # the flag); sharded ones only when lossless. Width is known to
+        # fit beam_sched here — wider sharded frontiers returned above.
         beam_resume = (
-            disk if disk and disk["phase"] == "beam"
+            disk if disk
+            and (disk["phase"] == "beam" or sharded_disk)
             and disk["fr"][0].shape[0] <= max(beam_sched) else None)
         res = _device_search(
             enc, plan, beam_sched, levels_per_call, t0,
@@ -946,8 +977,9 @@ def check_encoded_device(
     # file would repin that state forever); its lossless companion can.
     resume = None
     if disk is not None:
-        # (phase == "full" returned above, so any disk here is a beam
-        # checkpoint.)
+        # (phase == "full" and lossless sharded checkpoints returned
+        # above, so any disk here is a beam checkpoint — or a malformed
+        # truncated "sharded" one, which the same guards reject.)
         if not disk["truncated"]:
             resume = disk
         elif disk.get("lossless_fr") is not None:
@@ -1047,7 +1079,14 @@ def _device_search(enc: EncodedHistory, plan: DevicePlan, schedule: list,
         attempt["calls"] += 1
         attempt["wall_s"] = round(attempt["wall_s"] + _time.perf_counter() - t_call, 3)
         if lossy and bool(ovf):
-            if not truncated and checkpoint is not None:
+            # Record the last LOSSLESS frontier for the exhaustive
+            # fallback — but never shallower than one already seeded
+            # (e.g. a deep sharded/beam disk checkpoint whose width kept
+            # this beam from resuming it directly): a deeper lossless
+            # frontier stays exact regardless of what this beam dropped.
+            if not truncated and checkpoint is not None and (
+                    checkpoint.get("fr") is None
+                    or int(entry_fr[-1]) > int(checkpoint["fr"][-1])):
                 checkpoint["fr"] = entry_fr
             truncated = True
         if disk_checkpoint is not None:

@@ -252,6 +252,12 @@ class HazelcastDB(jdb.DB, jdb.Process, jdb.LogFiles):
                  "chdir": "/opt/hazelcast-bridge"},
                 "python3", self.BRIDGE,
                 "--port", BRIDGE_PORT, "--member", f"{node}:{PORT}",
+                # The semaphore the bridge initializes MUST hold the same
+                # permit count the checker's Semaphore(capacity) model
+                # assumes, or a correct cluster looks faulty (capacity<2)
+                # / a faulty one vacuously passes (capacity>2).
+                "--sem-capacity",
+                int(test.get("capacity") or wlock.DEFAULT_CAPACITY),
             )
 
     def kill(self, test, node):
@@ -319,6 +325,7 @@ def test_fn(opts: dict) -> dict:
     wl = WORKLOADS[name](opts)
     test = {
         "name": f"hazelcast-{name}",
+        "capacity": int(opts.get("capacity") or wlock.DEFAULT_CAPACITY),
         "db": HazelcastDB(),
         "net": jnet.iptables(),
         "nemesis": jnemesis.partition_majorities_ring(),
@@ -338,7 +345,7 @@ def _add_opts(p):
     p.add_argument("--model", choices=sorted(wlock.MODELS),
                    default="fenced-mutex")
     p.add_argument("--ops", type=int, default=5000)
-    p.add_argument("--capacity", type=int, default=2)
+    p.add_argument("--capacity", type=int, default=wlock.DEFAULT_CAPACITY)
     p.add_argument("--nemesis-interval", type=int, default=10)
 
 

@@ -70,11 +70,18 @@ def lock_test(opts: Optional[dict] = None) -> dict:
     }
 
 
+# Single source of truth for the semaphore permit count: the checker's
+# Semaphore(capacity) model AND the node-side bridge's CP-semaphore init
+# (suites/hazelcast.py) both derive from it — they must agree or a
+# correct cluster looks faulty / a faulty one passes vacuously.
+DEFAULT_CAPACITY = 2
+
+
 def semaphore_test(opts: Optional[dict] = None) -> dict:
     """Counting-semaphore workload (AcquiredPermitsModel,
     hazelcast.clj:630-649); op values carry permit counts."""
     o = dict(opts or {})
-    capacity = int(o.get("capacity") or 2)
+    capacity = int(o.get("capacity") or DEFAULT_CAPACITY)
 
     def acq(test=None, ctx=None):
         return {"type": "invoke", "f": "acquire", "value": 1}

@@ -650,6 +650,26 @@ class TestHazelcastSuite:
         assert any("hz-start" in cmd for cmd in cmds)
         assert any("hz_bridge.py" in cmd for cmd in cmds)
 
+    def test_capacity_forwarded_to_bridge(self):
+        # The checker's Semaphore(capacity) model and the node-side
+        # bridge's CP semaphore init must agree, or correct clusters
+        # look faulty / faulty ones pass vacuously.
+        from jepsen_tpu.suites import hazelcast as hz
+
+        test = hz.test_fn({"workload": "semaphore", "capacity": 3})
+        assert test["capacity"] == 3
+        test["nodes"] = ["n1"]
+        log: list = []
+        c.setup_sessions(test, c.dummy(log, responses={
+            r"mktemp": "/tmp/jepsen.x\n"}))
+        try:
+            c.on_nodes(test, lambda t, n: test["db"].start(t, n), ["n1"])
+        except Exception:
+            pass
+        bridge_cmds = [cmd for _n, cmd in log if "hz_bridge.py" in cmd]
+        assert bridge_cmds and all(
+            "--sem-capacity 3" in cmd for cmd in bridge_cmds), bridge_cmds
+
 
 class RabbitStub(BaseHTTPRequestHandler):
     """Management-API stub: declare/publish/get over one in-memory

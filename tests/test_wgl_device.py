@@ -268,3 +268,75 @@ class TestDiskCheckpoint:
         res = wgl.check_encoded_device(enc, checkpoint_path=ck,
                                        optimistic=False)
         assert res["valid"] == want  # not poisoned into 'unknown'
+
+    def test_wide_lossless_companion_dropped_not_crashed(self, tmp_path):
+        """A lossless_fr WIDER than the resuming run's top capacity (the
+        beam de-escalated after truncating at a larger F) cannot seed any
+        kernel — it must be dropped, not fed to a smaller static-F
+        kernel."""
+        import numpy as np
+
+        from jepsen_tpu.ops import wgl, wgl_host
+
+        model, h, enc = self._enc(seed=41)
+        want = wgl_host.check_history_host(model, h)["valid"]
+        plan = wgl.plan_device(enc)
+        W, KO, S, _ND, _NO = plan.dims
+        ck = str(tmp_path / "search.npz")
+        fp = wgl._enc_fingerprint(enc, plan)
+        sched = [16, 32]
+        # fr fits the schedule; the lossless companion is wider than its
+        # top capacity (as after a 64-wide truncation + de-escalation).
+        narrow = wgl.initial_frontier(16, W, KO, S, plan.init_state)
+        lossy = tuple(np.asarray(a) for a in narrow[:-1]) + (
+            np.int32(max(enc.n // 2, 1)),)
+        wide = wgl.initial_frontier(64, W, KO, S, plan.init_state)
+        wgl._save_search_checkpoint(ck, fp, "beam", True, lossy,
+                                    lossless_fr=wide)
+        res = wgl.check_encoded_device(enc, f_schedule=sched,
+                                       checkpoint_path=ck,
+                                       optimistic=False)
+        assert res["valid"] == want
+
+    def test_sharded_checkpoint_resumes_in_optimistic_mode(self, tmp_path):
+        """A checkpoint written by the sharded driver (phase 'sharded',
+        always lossless) must survive the engine switch: an optimistic
+        single-chip run resumes from it instead of restarting at 0."""
+        import os
+
+        import pytest
+
+        from jepsen_tpu.ops import wgl, wgl_host
+
+        model, h, enc = self._enc(seed=37)
+        ck = str(tmp_path / "search.npz")
+
+        calls = [0]
+
+        def bomb(info):
+            calls[0] += 1
+            if calls[0] == 2:
+                raise KeyboardInterrupt
+
+        with pytest.raises(KeyboardInterrupt):
+            wgl.check_encoded_device(enc, levels_per_call=5,
+                                     checkpoint_path=ck, optimistic=False,
+                                     chunk_callback=bomb)
+        assert os.path.exists(ck)
+        # Rewrite the genuine interrupted frontier as the sharded
+        # driver would have saved it.
+        plan = wgl.plan_device(enc)
+        fp = wgl._enc_fingerprint(enc, plan)
+        disk = wgl._load_search_checkpoint(ck, fp)
+        assert disk is not None
+        resumed_level = int(disk["fr"][-1])
+        assert resumed_level > 0
+        wgl._save_search_checkpoint(ck, fp, "sharded", False, disk["fr"])
+
+        chunks = []
+        res = wgl.check_encoded_device(enc, levels_per_call=5,
+                                       checkpoint_path=ck, optimistic=True,
+                                       chunk_callback=chunks.append)
+        assert res["valid"] == wgl_host.check_history_host(model, h)["valid"]
+        # The search never revisited the already-exact prefix.
+        assert chunks and min(c["level"] for c in chunks) >= resumed_level
