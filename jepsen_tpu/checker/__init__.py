@@ -233,11 +233,36 @@ def linearizable(options: Optional[dict] = None, **kw) -> Checker:
 
     def chk(test, history, opts):
         backend = _resolve_backend(test)
-        res = _check_one(test, history.client_ops(), backend)
+        ops = history.client_ops()
+        res = _check_one(test, ops, backend)
         # Writing full search diagnostics "can take hours" in the reference
         # (checker.clj:210-213); keep attempts bounded likewise.
         if isinstance(res.get("attempts"), list):
             res["attempts"] = res["attempts"][:10]
+        if (res.get("valid") is False and test.get("name")
+                and test.get("start-time") and not test.get("no-store?")):
+            # Render the refutation witness into the store — the
+            # reference's linear.svg of the search's final configs
+            # (checker.clj:202-209); linear.txt carries the per-op
+            # reasons.
+            try:
+                from .. import store
+                from .linear_viz import failure_report, render_linear_svg
+
+                sub = (opts or {}).get("subdirectory")
+                parts = ([str(sub)] if sub else [])
+                with open(store.path_mk(
+                        test, *parts, "linear.txt"), "w") as f:
+                    f.write(failure_report(model, ops, res))
+                if res.get("stuck_configs"):
+                    render_linear_svg(
+                        model, ops, res,
+                        store.path_mk(test, *parts, "linear.svg"))
+                    res["witness_files"] = ["linear.txt", "linear.svg"]
+                else:
+                    res["witness_files"] = ["linear.txt"]
+            except Exception as e:  # diagnostics never mask the verdict
+                res["witness_error"] = f"{type(e).__name__}: {e}"
         return res
 
     out = checker_fn(chk, "linearizable")

@@ -258,11 +258,25 @@ def op(gen, test: dict, ctx: Context):
             x = _call_gen_fn(gen, test, ctx)
             if x is None:
                 return None
+            if type(x) is dict:
+                # Fast path for the overwhelmingly common fn->op-map
+                # case: skip the [x, gen] list round trip (the list
+                # branch would return (filled, [None, gen]), which the
+                # next call walks back to plain ``gen`` anyway).
+                filled = fill_in_op(x, ctx)
+                if filled is PENDING:
+                    return (PENDING, [x, gen])
+                return (filled, gen)
             return op([x, gen], test, ctx)
         raise TypeError(f"not a generator: {gen!r}")
 
 
 def update(gen, test: dict, ctx: Context, event: dict):
+    # Identity convention (throughput-critical): every combinator's
+    # update returns ``self``/``gen`` UNCHANGED when the wrapped
+    # generator came back identical, so a no-op update of a deep stack
+    # allocates nothing. Two updates run per completed op; the wrapper
+    # churn dominated interpreter throughput before this.
     if gen is None:
         return None
     if isinstance(gen, Generator):
@@ -270,10 +284,12 @@ def update(gen, test: dict, ctx: Context, event: dict):
     if isinstance(gen, dict):
         return gen
     if isinstance(gen, (list, tuple)):
-        seq = list(gen)
-        if not seq:
+        if not gen:
             return None
-        return [update(seq[0], test, ctx, event)] + seq[1:]
+        g2 = update(gen[0], test, ctx, event)
+        if g2 is gen[0]:
+            return gen
+        return [g2, *gen[1:]]
     if callable(gen):
         return gen
     raise TypeError(f"not a generator: {gen!r}")
@@ -359,7 +375,8 @@ class Validate(Generator):
         return (o, Validate(g))
 
     def update(self, test, ctx, event):
-        return Validate(update(self.gen, test, ctx, event))
+        g2 = update(self.gen, test, ctx, event)
+        return self if g2 is self.gen else Validate(g2)
 
 
 validate = Validate
@@ -388,7 +405,8 @@ class FriendlyExceptions(Generator):
 
     def update(self, test, ctx, event):
         try:
-            return FriendlyExceptions(update(self.gen, test, ctx, event))
+            g2 = update(self.gen, test, ctx, event)
+            return self if g2 is self.gen else FriendlyExceptions(g2)
         except Exception as e:
             raise RuntimeError(
                 f"generator threw {type(e).__name__} when updated with {event!r}"
@@ -441,7 +459,8 @@ class Map(Generator):
         return (o if o is PENDING else self.f(o), Map(self.f, g))
 
     def update(self, test, ctx, event):
-        return Map(self.f, update(self.gen, test, ctx, event))
+        g2 = update(self.gen, test, ctx, event)
+        return self if g2 is self.gen else Map(self.f, g2)
 
 
 def map_(f, gen):
@@ -480,7 +499,8 @@ class Filter(Generator):
             gen = g
 
     def update(self, test, ctx, event):
-        return Filter(self.f, update(self.gen, test, ctx, event))
+        g2 = update(self.gen, test, ctx, event)
+        return self if g2 is self.gen else Filter(self.f, g2)
 
 
 def filter_(f, gen):
@@ -558,10 +578,9 @@ class OnThreads(Generator):
 
     def update(self, test, ctx, event):
         if self.pred(process_to_thread(ctx, event.get("process"))):
-            return OnThreads(
-                self.pred,
-                update(self.gen, test, on_threads_context(self.pred, ctx), event),
-            )
+            g2 = update(self.gen, test,
+                        on_threads_context(self.pred, ctx), event)
+            return self if g2 is self.gen else OnThreads(self.pred, g2)
         return self
 
 
@@ -629,7 +648,10 @@ class Any(Generator):
         return (soonest["op"], Any(gens))
 
     def update(self, test, ctx, event):
-        return Any([update(g, test, ctx, event) for g in self.gens])
+        gens = [update(g, test, ctx, event) for g in self.gens]
+        if all(g2 is g for g2, g in zip(gens, self.gens)):
+            return self
+        return Any(gens)
 
 
 def any_(*gens):
@@ -682,8 +704,11 @@ class EachThread(Generator):
             free_threads=frozenset(t for t in ctx.free_threads if t == thread),
             workers={thread: event.get("process")},
         )
+        g2 = update(g, test, tctx, event)
+        if g2 is g and thread in self.gens:
+            return self
         gens = dict(self.gens)
-        gens[thread] = update(g, test, tctx, event)
+        gens[thread] = g2
         return EachThread(self.fresh, gens)
 
 
@@ -750,8 +775,11 @@ class Reserve(Generator):
             if thread in r:
                 i = j
                 break
+        g2 = update(self.gens[i], test, ctx, event)
+        if g2 is self.gens[i]:
+            return self
         gens = list(self.gens)
-        gens[i] = update(gens[i], test, ctx, event)
+        gens[i] = g2
         return Reserve(self.ranges, self.all_ranges, gens)
 
 
@@ -840,7 +868,8 @@ class Limit(Generator):
         return (res[0], Limit(self.remaining - 1, res[1]))
 
     def update(self, test, ctx, event):
-        return Limit(self.remaining, update(self.gen, test, ctx, event))
+        g2 = update(self.gen, test, ctx, event)
+        return self if g2 is self.gen else Limit(self.remaining, g2)
 
 
 def limit(n, gen):
@@ -877,7 +906,8 @@ class Repeat(Generator):
         return (res[0], Repeat(self.remaining - 1, self.gen))
 
     def update(self, test, ctx, event):
-        return Repeat(self.remaining, update(self.gen, test, ctx, event))
+        g2 = update(self.gen, test, ctx, event)
+        return self if g2 is self.gen else Repeat(self.remaining, g2)
 
 
 def repeat_(*args):
@@ -911,7 +941,8 @@ class ProcessLimit(Generator):
         return (o, ProcessLimit(self.n, procs, g))
 
     def update(self, test, ctx, event):
-        return ProcessLimit(self.n, self.procs, update(self.gen, test, ctx, event))
+        g2 = update(self.gen, test, ctx, event)
+        return self if g2 is self.gen else ProcessLimit(self.n, self.procs, g2)
 
 
 def process_limit(n, gen):
@@ -941,7 +972,8 @@ class TimeLimit(Generator):
         return (o, TimeLimit(self.limit, cutoff, g))
 
     def update(self, test, ctx, event):
-        return TimeLimit(self.limit, self.cutoff, update(self.gen, test, ctx, event))
+        g2 = update(self.gen, test, ctx, event)
+        return self if g2 is self.gen else TimeLimit(self.limit, self.cutoff, g2)
 
 
 def time_limit(dt, gen):
@@ -975,7 +1007,8 @@ class Stagger(Generator):
         return (o, Stagger(self.dt, nt2, g))
 
     def update(self, test, ctx, event):
-        return Stagger(self.dt, self.next_time, update(self.gen, test, ctx, event))
+        g2 = update(self.gen, test, ctx, event)
+        return self if g2 is self.gen else Stagger(self.dt, self.next_time, g2)
 
 
 def stagger(dt, gen):
@@ -1006,7 +1039,8 @@ class Delay(Generator):
         return (o, Delay(self.dt, nt + self.dt, g))
 
     def update(self, test, ctx, event):
-        return Delay(self.dt, self.next_time, update(self.gen, test, ctx, event))
+        g2 = update(self.gen, test, ctx, event)
+        return self if g2 is self.gen else Delay(self.dt, self.next_time, g2)
 
 
 def delay(dt, gen):
@@ -1047,8 +1081,8 @@ class Cycle(Generator):
         return None  # every element is empty
 
     def update(self, test, ctx, event):
-        return Cycle(self.elements, self.i,
-                     update(self.inner, test, ctx, event))
+        g2 = update(self.inner, test, ctx, event)
+        return self if g2 is self.inner else Cycle(self.elements, self.i, g2)
 
 
 def cycle_(elements):
@@ -1074,7 +1108,8 @@ class Synchronize(Generator):
         return (PENDING, self)
 
     def update(self, test, ctx, event):
-        return Synchronize(update(self.gen, test, ctx, event))
+        g2 = update(self.gen, test, ctx, event)
+        return self if g2 is self.gen else Synchronize(g2)
 
 
 synchronize = Synchronize
@@ -1111,8 +1146,9 @@ class UntilOk(Generator):
 
     def update(self, test, ctx, event):
         if event.get("type") == OK:
-            return UntilOk(self.gen, True)
-        return UntilOk(update(self.gen, test, ctx, event), self.done)
+            return self if self.done else UntilOk(self.gen, True)
+        g2 = update(self.gen, test, ctx, event)
+        return self if g2 is self.gen else UntilOk(g2, self.done)
 
 
 def until_ok(gen):

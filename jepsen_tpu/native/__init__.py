@@ -70,8 +70,14 @@ def _build() -> Optional[ctypes.CDLL]:
         ctypes.POINTER(ctypes.c_int32),
     ]
     lib.wgl_check.restype = ctypes.c_int
-    lib.wgl_check_dfs.argtypes = lib.wgl_check.argtypes
+    # The DFS additionally captures the deepest configs reached (the
+    # refutation witness): wit_buf, wit_cap (entries), wit_len out.
+    lib.wgl_check_dfs.argtypes = lib.wgl_check.argtypes + [
+        i32p, ctypes.c_int32, ctypes.POINTER(ctypes.c_int32),
+    ]
     lib.wgl_check_dfs.restype = ctypes.c_int
+    lib.wgl_witness_stride.argtypes = []
+    lib.wgl_witness_stride.restype = ctypes.c_int
     lib.wgl_max_open.argtypes = []
     lib.wgl_max_open.restype = ctypes.c_int
     return lib

@@ -357,6 +357,190 @@ static size_t dominance_prune(cfg_t *items, size_t len, int S) {
 }
 
 /* ------------------------------------------------------------------ */
+/* Dominance-aware memo for the DFS: a hash map keyed by
+ * (p, win, state) whose value is an ANTICHAIN of open-masks, kept
+ * sorted by popcount. A new config whose open-set is a superset of any
+ * stored mask for its key is subsumed (open ops are never required and
+ * never bound others: every future reachable from the superset is
+ * reachable from the subset with identical state) — this collapses the
+ * open-subset powerset that dominates refutation cost, where the
+ * exact-equality memo had to visit every subset combination.
+ * Stored masks that are supersets of a new mask are removed: the new
+ * (dominating) entry prunes everything they would have pruned. */
+
+typedef struct {
+    int32_t p;
+    uint64_t win;
+    int32_t st[S_MAX];
+    int32_t n;        /* stored masks */
+    int32_t mcap;
+    uint64_t *masks;  /* n * NO_WORDS, popcount-ascending */
+    uint8_t *pc;      /* popcount per mask */
+} dom_slot_t;
+
+typedef struct {
+    dom_slot_t *slots;
+    uint8_t *used;
+    size_t cap;   /* power of two */
+    size_t count; /* distinct keys */
+} domset_t;
+
+static uint64_t dom_key_hash(int32_t p, uint64_t win, const int32_t *st) {
+    /* Always hashes S_MAX state lanes: lanes beyond the model's S are
+     * zero everywhere (the root is memset and transitions write only S
+     * lanes), so this is S-independent — the table can rehash without
+     * knowing S. */
+    uint64_t h = 1469598103934665603ULL;
+    h = (h ^ (uint64_t)(uint32_t)p) * 1099511628211ULL;
+    h = (h ^ win) * 1099511628211ULL;
+    for (int i = 0; i < S_MAX; i++)
+        h = (h ^ (uint64_t)(uint32_t)st[i]) * 1099511628211ULL;
+    return h;
+}
+
+static int dom_init(domset_t *s, size_t cap) {
+    s->cap = cap;
+    s->count = 0;
+    s->slots = (dom_slot_t *)malloc(sizeof(dom_slot_t) * cap);
+    s->used = (uint8_t *)calloc(cap, 1);
+    return s->slots && s->used;
+}
+
+static void dom_free(domset_t *s) {
+    if (s->slots)
+        for (size_t i = 0; i < s->cap; i++)
+            if (s->used[i])
+                free(s->slots[i].masks); /* pc rides the same block */
+    free(s->slots);
+    free(s->used);
+}
+
+static int open_popcount(const uint64_t *m) {
+    int n = 0;
+    for (int w = 0; w < NO_WORDS; w++)
+        n += __builtin_popcountll(m[w]);
+    /* Clamped to fit the uint8_t pc lanes: 256 set bits (every open op
+     * of a full 4-word set) would wrap to 0 and skip the whole subset
+     * scan. The clamp only coarsens the scan bound — subset checks run
+     * on the real masks. */
+    return n > 255 ? 255 : n;
+}
+
+static int dom_slot_grow(dom_slot_t *d) {
+    int nc = d->mcap ? d->mcap * 2 : 4;
+    /* one allocation: masks block then pc block */
+    uint64_t *nm = (uint64_t *)malloc(
+        (sizeof(uint64_t) * NO_WORDS + 1) * (size_t)nc);
+    if (!nm)
+        return 0;
+    uint8_t *npc = (uint8_t *)(nm + (size_t)nc * NO_WORDS);
+    if (d->n) {
+        memcpy(nm, d->masks, sizeof(uint64_t) * NO_WORDS * (size_t)d->n);
+        memcpy(npc, d->pc, (size_t)d->n);
+    }
+    free(d->masks);
+    d->masks = nm;
+    d->pc = npc;
+    d->mcap = nc;
+    return 1;
+}
+
+static int dom_grow(domset_t *s);
+
+/* 1 = inserted (explore), 0 = dominated (prune), -1 = OOM */
+static int dom_insert(domset_t *s, const cfg_t *c) {
+    if (s->count * 4 >= s->cap * 3) {
+        if (!dom_grow(s))
+            return -1;
+    }
+    uint64_t h = dom_key_hash(c->p, c->win, c->st);
+    size_t i = (size_t)(h & (s->cap - 1));
+    dom_slot_t *d = NULL;
+    while (s->used[i]) {
+        d = &s->slots[i];
+        if (d->p == c->p && d->win == c->win &&
+            memcmp(d->st, c->st, sizeof(d->st)) == 0)
+            break;
+        d = NULL;
+        i = (i + 1) & (s->cap - 1);
+    }
+    int pc_new = open_popcount(c->open);
+    if (d == NULL) {
+        /* fresh key */
+        s->used[i] = 1;
+        d = &s->slots[i];
+        d->p = c->p;
+        d->win = c->win;
+        memcpy(d->st, c->st, sizeof(d->st));
+        d->n = 0;
+        d->mcap = 0;
+        d->masks = NULL;
+        d->pc = NULL;
+        if (!dom_slot_grow(d))
+            return -1;
+        memcpy(d->masks, c->open, sizeof(uint64_t) * NO_WORDS);
+        d->pc[0] = (uint8_t)pc_new;
+        d->n = 1;
+        s->count++;
+        return 1;
+    }
+    /* popcount-sorted scan: only masks with pc <= pc_new can be
+     * subsets of the new mask */
+    int32_t k = 0;
+    for (; k < d->n && d->pc[k] <= pc_new; k++)
+        if (open_subset(d->masks + (size_t)k * NO_WORDS, c->open))
+            return 0; /* dominated */
+    /* remove stored supersets (they are now redundant pruners) */
+    int32_t w = k;
+    for (int32_t j = k; j < d->n; j++) {
+        if (open_subset(c->open, d->masks + (size_t)j * NO_WORDS))
+            continue; /* superset of new: drop */
+        if (w != j) {
+            memcpy(d->masks + (size_t)w * NO_WORDS,
+                   d->masks + (size_t)j * NO_WORDS,
+                   sizeof(uint64_t) * NO_WORDS);
+            d->pc[w] = d->pc[j];
+        }
+        w++;
+    }
+    d->n = w;
+    if (d->n == d->mcap && !dom_slot_grow(d))
+        return -1;
+    /* insert at position k (popcount order preserved) */
+    memmove(d->masks + (size_t)(k + 1) * NO_WORDS,
+            d->masks + (size_t)k * NO_WORDS,
+            sizeof(uint64_t) * NO_WORDS * (size_t)(d->n - k));
+    memmove(d->pc + k + 1, d->pc + k, (size_t)(d->n - k));
+    memcpy(d->masks + (size_t)k * NO_WORDS, c->open,
+           sizeof(uint64_t) * NO_WORDS);
+    d->pc[k] = (uint8_t)pc_new;
+    d->n++;
+    return 1;
+}
+
+static int dom_grow(domset_t *s) {
+    domset_t bigger;
+    if (!dom_init(&bigger, s->cap * 2))
+        return 0;
+    for (size_t i = 0; i < s->cap; i++) {
+        if (!s->used[i])
+            continue;
+        dom_slot_t *d = &s->slots[i];
+        uint64_t h = dom_key_hash(d->p, d->win, d->st);
+        size_t j = (size_t)(h & (bigger.cap - 1));
+        while (bigger.used[j])
+            j = (j + 1) & (bigger.cap - 1);
+        bigger.used[j] = 1;
+        bigger.slots[j] = *d; /* masks pointer moves with the slot */
+        bigger.count++;
+    }
+    free(s->slots);
+    free(s->used);
+    *s = bigger;
+    return 1;
+}
+
+/* ------------------------------------------------------------------ */
 /* The search.                                                         */
 
 typedef struct {
@@ -391,6 +575,32 @@ typedef struct {
     int32_t wlim;
 } frame_t;
 
+/* Witness buffer entry stride, in int32 lanes:
+ * [p, win_lo, win_hi, open x 2*NO_WORDS, st x S_MAX] */
+int wgl_witness_stride(void) { return 3 + 2 * NO_WORDS + S_MAX; }
+
+static void wit_record(int32_t *buf, int32_t cap, int32_t *len,
+                       int32_t *depth_seen, int32_t d, const cfg_t *c) {
+    if (!buf || cap <= 0)
+        return;
+    if (d > *depth_seen) {
+        *depth_seen = d;
+        *len = 0; /* deeper configs supersede shallower witnesses */
+    } else if (d < *depth_seen || *len >= cap) {
+        return;
+    }
+    int32_t *e = buf + (size_t)(*len) * (size_t)wgl_witness_stride();
+    e[0] = c->p;
+    e[1] = (int32_t)(uint32_t)(c->win & 0xFFFFFFFFULL);
+    e[2] = (int32_t)(uint32_t)(c->win >> 32);
+    for (int w = 0; w < NO_WORDS; w++) {
+        e[3 + 2 * w] = (int32_t)(uint32_t)(c->open[w] & 0xFFFFFFFFULL);
+        e[4 + 2 * w] = (int32_t)(uint32_t)(c->open[w] >> 32);
+    }
+    memcpy(e + 3 + 2 * NO_WORDS, c->st, sizeof(int32_t) * S_MAX);
+    (*len)++;
+}
+
 int wgl_check_dfs(
     int32_t nD, int32_t nO, int32_t S, int32_t W,
     const int32_t *invD, const int32_t *retD, const int32_t *opD,
@@ -402,23 +612,30 @@ int wgl_check_dfs(
     int32_t model_id, int64_t model_param,
     int64_t max_configs,
     int64_t *configs_explored, int32_t *frontier_max,
-    int32_t *max_linearized) {
+    int32_t *max_linearized,
+    /* optional deepest-config capture (the refutation witness the
+     * reference renders as linear.svg, checker.clj:202-209): up to
+     * wit_cap entries of wgl_witness_stride() lanes each; NULL/0 to
+     * disable */
+    int32_t *wit_buf, int32_t wit_cap, int32_t *wit_len) {
     if (W > 64 || nO > 64 * NO_WORDS || S > S_MAX)
         return -2;
     *configs_explored = 0;
     *frontier_max = 0;
     *max_linearized = 0;
+    if (wit_len)
+        *wit_len = 0;
     if (nD == 0)
         return 1;
 
-    set_t seen;
-    if (!set_init(&seen, 1 << 12))
+    domset_t seen;
+    if (!dom_init(&seen, 1 << 12))
         return -3;
 
     size_t depth_cap = (size_t)nD + (size_t)nO + 2;
     frame_t *stack = (frame_t *)malloc(sizeof(frame_t) * depth_cap);
     if (!stack) {
-        set_free(&seen);
+        dom_free(&seen);
         return -3;
     }
     size_t sp = 0;
@@ -428,7 +645,7 @@ int wgl_check_dfs(
     memcpy(root.cfg.st, init_state, sizeof(int32_t) * (size_t)S);
     root.next_j = -1; /* compute bounds lazily on first visit */
     stack[sp++] = root;
-    set_insert(&seen, &root.cfg, S);
+    dom_insert(&seen, &root.cfg);
 
     int64_t explored = 0;
     int verdict = 0;
@@ -454,6 +671,7 @@ int wgl_check_dfs(
                 int32_t d = c->p;
                 uint64_t w = c->win;
                 while (w) { d += (int32_t)(w & 1); w >>= 1; }
+                wit_record(wit_buf, wit_cap, wit_len, max_linearized, d, c);
                 if (d > *max_linearized)
                     *max_linearized = d;
             }
@@ -488,13 +706,15 @@ int wgl_check_dfs(
                     continue;
                 open_set_bit(&c2, o);
             }
-            int ins = set_insert(&seen, &c2, S);
+            int ins = dom_insert(&seen, &c2);
             if (ins < 0) {
                 verdict = -3;
                 break;
             }
             if (!ins)
-                continue; /* already explored this configuration */
+                continue; /* dominated: an explored config with equal
+                             (p, win, state) and open-subset covers
+                             every future of this one */
             frame_t nf;
             nf.cfg = c2;
             nf.next_j = -1;
@@ -514,7 +734,7 @@ int wgl_check_dfs(
 
     *configs_explored = explored;
     free(stack);
-    set_free(&seen);
+    dom_free(&seen);
     return verdict;
 }
 

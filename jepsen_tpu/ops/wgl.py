@@ -71,10 +71,14 @@ INT32_MAX = np.int32(2**31 - 1)
 F_SCHEDULE = (16, 128, 1024, 2048, 4096, 8192, 32768)
 
 # Expansions larger than this use the two-stage compaction: a fused
-# (validity|hash, iota) single-key sort over the full expansion, then one
-# row-gather into an 8F buffer for the multi-key dedup sort. Patchable
-# for tests.
+# (validity, iota) single-key sort over the full expansion, then one
+# row-gather into a STAGE1_P_MULT*F buffer for the multi-key dedup sort.
+# Patchable for tests.
 BIG_M_THRESHOLD = 1 << 19
+# Stage-1 survivor buffer, as a multiple of F. Survivor counts beyond it
+# read as overflow (lossless), so it trades stage-2 sort size against
+# escalation churn.
+STAGE1_P_MULT = 8
 
 
 def _next_pow2(x: int, lo: int = 32) -> int:
@@ -110,7 +114,8 @@ def _enable_compile_cache() -> None:
 
 @functools.lru_cache(maxsize=64)
 def _build_kernel(model_key, F: int, W: int, KO: int, S: int, ND: int, NO: int,
-                  axis_name: Optional[str] = None, n_shards: int = 1):
+                  axis_name: Optional[str] = None, n_shards: int = 1,
+                  B: Optional[int] = None):
     """Returns a jitted BFS driver with static shapes.
 
     model_key = (model-class, cache signature) — step_jax must be a pure
@@ -128,6 +133,17 @@ def _build_kernel(model_key, F: int, W: int, KO: int, S: int, ND: int, NO: int,
     capacity F×n_shards. Must be invoked under ``shard_map`` with the
     frontier args sharded on axis 0 and everything else replicated.
 
+    ``B``: per-config candidate cap (static). A config's determinate
+    candidates are pairwise concurrent — for candidates j≠k,
+    ``inv[j] < minret_excl(j) <= ret[k]`` and symmetrically — so they
+    form a clique of the op-interval graph, whose size is bounded by the
+    history's max point-overlap; opens add at most nO more. When
+    ``B < C``, a cheap row-wise sort selects each config's (at most B)
+    candidate slots FIRST, and every M-sized stage downstream (model
+    step, mask build, compaction sort) runs on F*B rows instead of F*C.
+    A config with more than B candidates raises the overflow flag (the
+    planner's bound makes that unreachable; the flag keeps it sound).
+
     TPU shape notes (calibrated on-chip): in-loop gathers cost ~0.3 ms
     regardless of payload width (so the five window tables are packed into
     ONE [ND, 8] gather), multi-operand `lax.sort` costs ~30-70 µs at 64k
@@ -143,8 +159,10 @@ def _build_kernel(model_key, F: int, W: int, KO: int, S: int, ND: int, NO: int,
     model = model_cls._from_cache_key(model_args)
     KD = W // 32
     OB = KO * 32  # open candidate slots
-    C = W + OB  # candidates per config
-    M = F * C
+    C = W + OB  # candidate slots per config
+    SEL = B is not None and B < C  # row-wise candidate pre-selection on?
+    CC = B if SEL else C  # expansion width per config
+    M = F * CC
     FT = F * n_shards  # global frontier capacity (== F when unsharded)
 
     u32 = jnp.uint32
@@ -238,12 +256,19 @@ def _build_kernel(model_key, F: int, W: int, KO: int, S: int, ND: int, NO: int,
             rows = p[:, None] + slots[None, :]  # [F, W]
             in_rng = rows < nD
             rc = jnp.minimum(rows, ND - 1)
-            # The level's single dynamic gather; int16 tables (when every
-            # value fits) halve its bytes — the gather dominates level
-            # cost at large capacities.
-            win = tabD[rc].astype(jnp.int32)  # [F, W, 8]
-            invw = jnp.where(in_rng, win[..., 0], INT32_MAX)
-            retw = jnp.where(in_rng, win[..., 1], INT32_MAX)
+            # The level's single dynamic gather; int16 tables (when
+            # every value fits) halve its bytes, and columns are widened
+            # to int32 LAZILY per consumer so the converts fuse into the
+            # consuming wheres (a whole-block astype materialized a
+            # ~0.6 ms/level conversion at F=8192). NOTE: a slice-gather
+            # formulation (slice_sizes=(W, 8), one start per config)
+            # measured CATASTROPHICALLY worse — XLA lowered it to a
+            # serial per-config dynamic-slice loop (~12 ms/level).
+            win = tabD[rc]  # [F, W, 8] int16|int32
+            invw = jnp.where(in_rng, win[..., 0].astype(jnp.int32),
+                             INT32_MAX)
+            retw = jnp.where(in_rng, win[..., 1].astype(jnp.int32),
+                             INT32_MAX)
             bits = (jnp.repeat(mD, 32, axis=1)[:, :W] >> bit_of_slot[None, :]) & u32(1)
             linz = bits == u32(1)
             unlin = in_rng & ~linz
@@ -270,33 +295,72 @@ def _build_kernel(model_key, F: int, W: int, KO: int, S: int, ND: int, NO: int,
                 cand_O = jnp.zeros((F, 0), dtype=bool)
 
             # --- model transition over all F*C candidate pairs -------------
-            opw = jnp.where(in_rng, win[..., 2], 0)
-            a1w = jnp.where(in_rng, win[..., 3], 0)
-            a2w = jnp.where(in_rng, win[..., 4], 0)
+            opw = jnp.where(in_rng, win[..., 2].astype(jnp.int32), 0)
+            a1w = jnp.where(in_rng, win[..., 3].astype(jnp.int32), 0)
+            a2w = jnp.where(in_rng, win[..., 4].astype(jnp.int32), 0)
             if KO:
                 opc = jnp.concatenate([opw, opO_row], axis=1)
                 a1c = jnp.concatenate([a1w, a1O_row], axis=1)
                 a2c = jnp.concatenate([a2w, a2O_row], axis=1)
-                cand = jnp.concatenate([cand_D, cand_O], axis=1)
+                candv = jnp.concatenate([cand_D, cand_O], axis=1)
             else:
-                opc, a1c, a2c, cand = opw, a1w, a2w, cand_D
+                opc, a1c, a2c, candv = opw, a1w, a2w, cand_D
+            candv = candv & valid[:, None]  # [F, C] availability
+            row_ovf = jnp.asarray(False)
+            if SEL:
+                # Row-wise candidate pre-selection: one axis-1 sort pulls
+                # each config's (at most B, by the planner's clique
+                # bound) candidate slots to the front, carrying the op
+                # tuple as payload; everything downstream — model step,
+                # mask build, compaction sorts — runs on F*B rows
+                # instead of F*C. Selected-slot one-hot masks are
+                # computed arithmetically (the bitD/bitO tables are
+                # per-static-position; selected slots are dynamic).
+                row_ovf = jnp.any(
+                    jnp.sum(candv.astype(jnp.int32), axis=1) > B)
+                slot_row = jnp.broadcast_to(
+                    jnp.arange(C, dtype=jnp.int32)[None, :], (F, C))
+                sel = lax.sort(
+                    ((~candv).astype(u32), slot_row, opc, a1c, a2c),
+                    dimension=1, num_keys=1)
+                cand = sel[0][:, :B] == u32(0)  # [F, B]
+                selslot = sel[1][:, :B]
+                opc, a1c, a2c = (x[:, :B] for x in sel[2:])
+                nmD = jnp.stack(
+                    [mD[:, w][:, None] | jnp.where(
+                        selslot // 32 == w,
+                        u32(1) << (selslot % 32).astype(u32), u32(0))
+                     for w in range(KD)],
+                    axis=2).reshape(M, KD)
+                if KO:
+                    oslot = selslot - W
+                    nmO = jnp.stack(
+                        [mO[:, w][:, None] | jnp.where(
+                            (oslot >= 0) & (oslot // 32 == w),
+                            u32(1) << (oslot % 32).astype(u32), u32(0))
+                         for w in range(KO)],
+                        axis=2).reshape(M, KO)
+                else:
+                    nmO = jnp.zeros((M, 1), dtype=jnp.uint32)
+            else:
+                cand = candv
+                nmD = (mD[:, None, :] | bitD[None, :, :]).reshape(M, KD)
+                if KO:
+                    nmO = (mO[:, None, :] | bitO[None, :, :]).reshape(
+                        M, max(KO, 1))
+                else:
+                    nmO = jnp.zeros((M, 1), dtype=jnp.uint32)
 
-            st_rep = jnp.broadcast_to(st[:, None, :], (F, C, S)).reshape(M, S)
+            st_rep = jnp.broadcast_to(st[:, None, :], (F, CC, S)).reshape(M, S)
             ok, st2 = model.step_jax(
                 st_rep, opc.reshape(M), a1c.reshape(M), a2c.reshape(M)
             )
             st2 = st2.reshape(M, S).astype(jnp.int32)
-            cand = cand & ok.reshape(F, C) & valid[:, None]  # [F, C]
+            cand = cand & ok.reshape(F, CC)  # [F, CC]
 
             # --- build new configs -----------------------------------------
-            nmD = mD[:, None, :] | bitD[None, :, :]  # [F, C, KD]
-            nmD = nmD.reshape(M, KD)
-            if KO:
-                nmO = (mO[:, None, :] | bitO[None, :, :]).reshape(M, max(KO, 1))
-            else:
-                nmO = jnp.zeros((M, 1), dtype=jnp.uint32)
             s = trailing_ones(nmD)
-            np_ = jnp.broadcast_to(p[:, None], (F, C)).reshape(M) + s
+            np_ = jnp.broadcast_to(p[:, None], (F, CC)).reshape(M) + s
             nmD = shift_words_right(nmD, s)
             nvalid = cand.reshape(M)
 
@@ -321,77 +385,75 @@ def _build_kernel(model_key, F: int, W: int, KO: int, S: int, ND: int, NO: int,
             # Two-stage at large M: a multi-operand sort over the whole
             # expansion dominates level cost once M is in the high
             # hundreds of thousands (bitonic passes scale ~log^2 and move
-            # EVERY operand through every compare-exchange). Stage 1
-            # compacts with the cheapest possible M-sized sort — ONE
-            # fused key (validity in the hash's top bit) plus an iota
-            # payload, 2 operands — then ONE row gather pulls the top-P
-            # candidate columns for the full multi-key stage-2 sort.
-            # >P survivors are treated as overflow (lossless: handled
-            # like any frontier overflow). An earlier cumsum+searchsorted
-            # formulation measured ~2x SLOWER than the direct 8-operand
-            # sort at M=786k on a v5e; this formulation measures faster
-            # (2 operands through the M-sized sort, everything after on
-            # P rows).
-            pre_ovf = jnp.asarray(False)
+            # EVERY operand through every compare-exchange). Stage 1 only
+            # needs the valid rows FIRST — their order is irrelevant,
+            # stage 2 re-sorts the P survivors by the full key set — so
+            # it fuses the validity bit over an iota payload into ONE
+            # u32 operand, the cheapest possible M-sized compaction; ONE
+            # row gather then pulls the top-P candidate columns for the
+            # multi-key stage-2 sort, and the group hashes are computed
+            # on those P rows rather than all M. >P survivors are
+            # treated as overflow (lossless: handled like any frontier
+            # overflow). Earlier formulations measured on a v5e:
+            # cumsum+searchsorted ~2x slower than a direct 8-operand
+            # sort at M=786k; lax.top_k no faster than the fused sort.
+            pre_ovf = row_ovf
             L = M
-            gh1 = jnp.full((M,), u32(2166136261))
-            gh2 = jnp.full((M,), u32(0x9E3779B9))
-            for c in [pcol] + dcols + scols:
-                gh1 = (gh1 ^ c) * u32(16777619)
-                gh2 = (gh2 ^ (c + u32(0x85EBCA6B))) * u32(0xC2B2AE35)
-            key0 = (~nvalid).astype(u32)  # valid rows first
             if axis_name is not None or M > BIG_M_THRESHOLD:
-                P = min(M, max(8 * F, 64))
+                P = min(M, max(STAGE1_P_MULT * F, 64))
                 n_cand = jnp.sum(nvalid.astype(jnp.int32))
-                pre_ovf = n_cand > P
-                # Fuse validity into the hash's top bit: ONE key + iota
-                # payload is the cheapest possible M-sized sort. The lost
-                # hash bit only affects prune adjacency, never soundness
-                # (all dedup compares run on the real columns).
-                fused = jnp.where(nvalid, gh1 >> 1,
-                                  (gh1 >> 1) | u32(0x80000000))
-                # (Measured on-chip: lax.top_k(~fused, P) is NOT faster
-                # than this 2-operand sort at M=786k/P=64k — both ~8 ms/
-                # level — so keep the sort, whose binaries are cached.)
-                s3 = lax.sort(
-                    (fused, lax.iota(jnp.int32, M)),
-                    dimension=0, num_keys=1,
-                )
-                vidx = s3[1][:P]
+                pre_ovf = pre_ovf | (n_cand > P)
+                fused = jnp.where(
+                    nvalid, lax.iota(u32, M),
+                    lax.iota(u32, M) | u32(0x80000000))
+                (s3,) = lax.sort((fused,), dimension=0, num_keys=1)
+                # (deterministic: the embedded iota makes keys unique)
+                vidx = (s3[:P] & u32(0x7FFFFFFF)).astype(jnp.int32)
                 colmat = jnp.stack(
-                    [gh1, gh2, pcol] + dcols + scols + ocols, axis=1
+                    [pcol] + dcols + scols + ocols, axis=1
                 )  # [M, NC]
                 pmat = colmat[vidx]  # ONE gather
-                gh1 = pmat[:, 0]
-                gh2 = pmat[:, 1]
-                pcol = pmat[:, 2]
-                dcols = [pmat[:, 3 + w] for w in range(KD)]
-                scols = [pmat[:, 3 + KD + i] for i in range(S)]
-                ocols = [pmat[:, 3 + KD + S + w] for w in range(len(ocols))]
+                pcol = pmat[:, 0]
+                dcols = [pmat[:, 1 + w] for w in range(KD)]
+                scols = [pmat[:, 1 + KD + i] for i in range(S)]
+                ocols = [pmat[:, 1 + KD + S + w] for w in range(len(ocols))]
                 nvalid = lax.iota(jnp.int32, P) < jnp.minimum(n_cand, P)
-                key0 = (~nvalid).astype(u32)
                 L = P
                 if axis_name is not None:
                     # Frontier-parallel exchange: ship each shard's
                     # compacted candidates to every device (ONE tiled
                     # all_gather of a packed [P, NC+1] matrix); the
                     # global dedup below then runs replicated.
-                    # pmat's columns are already (gh1, gh2, pcol, dcols,
-                    # scols, ocols) in order — prepend validity and ship.
+                    # pmat's columns are already (pcol, dcols, scols,
+                    # ocols) in order — prepend validity and ship.
                     gmat = lax.all_gather(
-                        jnp.concatenate([key0[:, None], pmat], axis=1),
+                        jnp.concatenate(
+                            [(~nvalid).astype(u32)[:, None], pmat], axis=1),
                         axis_name, axis=0, tiled=True)  # [n_shards*P, .]
-                    key0 = gmat[:, 0]
-                    gh1 = gmat[:, 1]
-                    gh2 = gmat[:, 2]
-                    pcol = gmat[:, 3]
-                    dcols = [gmat[:, 4 + w] for w in range(KD)]
-                    scols = [gmat[:, 4 + KD + i] for i in range(S)]
-                    ocols = [gmat[:, 4 + KD + S + w]
+                    kvalid0 = gmat[:, 0]
+                    pcol = gmat[:, 1]
+                    dcols = [gmat[:, 2 + w] for w in range(KD)]
+                    scols = [gmat[:, 2 + KD + i] for i in range(S)]
+                    ocols = [gmat[:, 2 + KD + S + w]
                              for w in range(len(ocols))]
+                    nvalid = kvalid0 == u32(0)
                     pre_ovf = lax.pmax(pre_ovf.astype(jnp.int32),
                                        axis_name) > 0
                     L = n_shards * P
+            # Group hashes on the L compacted rows (not the M-row
+            # expansion); on the sharded path this runs replicated
+            # post-exchange, so every device computes identical hashes.
+            gh1 = jnp.full((L,), u32(2166136261))
+            gh2 = jnp.full((L,), u32(0x9E3779B9))
+            for c in [pcol] + dcols + scols:
+                gh1 = (gh1 ^ c) * u32(16777619)
+                gh2 = (gh2 ^ (c + u32(0x85EBCA6B))) * u32(0xC2B2AE35)
+            # Full multi-operand dedup sort. (A slimmer 3-operand
+            # fused-key sort + post-sort row gather of the identity
+            # columns measured ~2.5 ms/level WORSE at L=65536 on a v5e:
+            # 65k-row gathers cost more than the extra sort operands;
+            # only the F-row top-slice gather below is cheap.)
+            key0 = (~nvalid).astype(u32)  # valid rows first
             n_keys = 3 + len(ocols)
             sorted_ = lax.sort(
                 tuple([key0, gh1, gh2] + ocols + [pcol] + dcols + scols),
@@ -446,45 +508,57 @@ def _build_kernel(model_key, F: int, W: int, KO: int, S: int, ND: int, NO: int,
             count = jnp.sum(keep.astype(jnp.int32))
             ovf_now = pre_ovf | (count > FT)
 
-            # Compaction: one stable sort brings kept rows to the front,
-            # most-advanced (largest p) first and fewest-opens-used next —
-            # so beam-mode truncation keeps the configs closest to
-            # acceptance with the most flexibility left (a config using
-            # fewer opens subsumes more futures). A static slice takes the
-            # first F.
+            # Compaction: bring kept rows to the front, most-advanced
+            # (largest p) first and fewest-opens-used next — so beam-mode
+            # truncation keeps the configs closest to acceptance with
+            # the most flexibility left (a config using fewer opens
+            # subsumes more futures). The priority fits ONE fused u32
+            # key — (dropped | inverted-p | clamped open-count) — so
+            # this is a 2-operand (key, iota) sort plus one top-F row
+            # gather instead of the profiled-dominant 10-operand sort
+            # (multi-operand sorts cost per-operand per compare-exchange
+            # pass; the clamp only coarsens beam preference, never
+            # soundness). The iota tiebreak keeps it deterministic.
+            PB = max(int(ND).bit_length(), 1)
+            assert PB + 7 <= 32, "ND too large for fused compaction key"
+            MAXP = u32((1 << PB) - 1)
             ck = (~keep).astype(u32)
             opc_used = socols[0] * u32(0)
             for c in socols:
                 opc_used = opc_used + lax.population_count(c)
-            comp = lax.sort(
-                tuple([ck, ~spcol, opc_used, spcol] + sdcols + socols
-                      + sscols),
-                dimension=0,
-                num_keys=3,
-                is_stable=True,
+            fprio = (
+                (ck << (PB + 6))
+                | ((MAXP - spcol) << 6)
+                | jnp.minimum(opc_used, u32(63))
             )
+            comp = lax.sort(
+                # iota as second KEY, not payload: deterministic ties.
+                (fprio, lax.iota(u32, L)), dimension=0, num_keys=2)
+            order = comp[1]
+            rowmat = jnp.stack(
+                [spcol] + sdcols + socols + sscols, axis=1)  # [L, NC]
             if axis_name is not None:
                 # Each device keeps its slice of the global order.
                 shard0 = lax.axis_index(axis_name).astype(jnp.int32) * F
                 kvalid = (lax.iota(jnp.int32, F) + shard0) < jnp.minimum(
                     count, FT)
-                top = lambda c: lax.dynamic_slice_in_dim(c, shard0, F,
-                                                         axis=0)
+                ordF = lax.dynamic_slice_in_dim(order, shard0, F, axis=0)
             else:
                 kvalid = lax.iota(jnp.int32, F) < jnp.minimum(count, F)
-                top = lambda c: lax.slice_in_dim(c, 0, F, axis=0)
-            kp = top(comp[3]).astype(jnp.int32) * kvalid
+                ordF = lax.slice_in_dim(order, 0, F, axis=0)
+            g = rowmat[ordF.astype(jnp.int32)]  # ONE [F, NC] gather
+            kp = g[:, 0].astype(jnp.int32) * kvalid
             kmD = jnp.stack(
-                [top(comp[4 + w]) * kvalid for w in range(KD)], axis=1
+                [g[:, 1 + w] * kvalid for w in range(KD)], axis=1
             )
             kmO = jnp.stack(
-                [top(comp[4 + KD + w]) * kvalid for w in range(max(KO, 1))],
+                [g[:, 1 + KD + w] * kvalid for w in range(max(KO, 1))],
                 axis=1,
             )
             kst = jnp.stack(
                 [
                     lax.bitcast_convert_type(
-                        top(comp[4 + KD + max(KO, 1) + i]), jnp.int32
+                        g[:, 1 + KD + max(KO, 1) + i], jnp.int32
                     )
                     * kvalid
                     for i in range(S)
@@ -537,27 +611,39 @@ def _build_kernel(model_key, F: int, W: int, KO: int, S: int, ND: int, NO: int,
         out = lax.while_loop(cond, level, init)
         p, mD, mO, st, valid, lvl, acc, ovf, fmax = out
         nonempty = jnp.any(valid)
+        count = jnp.sum(valid.astype(jnp.int32))
         if axis_name is not None:
-            # The flag is consumed as a replicated output (out_specs P()),
-            # so it must actually BE replicated — a device whose slice of
-            # the global order is empty would otherwise report a locally
-            # empty frontier as a global refutation.
+            # These flags are consumed as replicated outputs (out_specs
+            # P()), so they must actually BE replicated — a device whose
+            # slice of the global order is empty would otherwise report a
+            # locally empty frontier as a global refutation.
             nonempty = lax.pmax(nonempty.astype(jnp.int32), axis_name) > 0
-        return acc, ovf, nonempty, lvl, fmax, p, mD, mO, st, valid
+            count = lax.psum(count, axis_name)
+        # ONE packed scalar vector: the host driver fetches this single
+        # array per chunk (each separate device->host read pays a full
+        # relay round trip — unpacked flags cost ~1 s/chunk on a
+        # tunneled TPU, more than the chunk's compute).
+        flags = jnp.stack([
+            acc.astype(jnp.int32), ovf.astype(jnp.int32),
+            nonempty.astype(jnp.int32), lvl, fmax, count,
+        ])
+        return flags, p, mD, mO, st, valid
 
     return kernel, jax.jit(kernel)
 
 
 @functools.lru_cache(maxsize=32)
-def _build_batch_kernel(model_key, F: int, W: int, KO: int, S: int, ND: int, NO: int):
+def _build_batch_kernel(model_key, F: int, W: int, KO: int, S: int, ND: int,
+                        NO: int, B: Optional[int] = None):
     """vmapped kernel over a leading batch axis on every argument — the
     batch-replay path (jepsen_tpu.parallel.batch); shardable over a device
-    mesh by placing the batch axis on the mesh's data axis."""
+    mesh by placing the batch axis on the mesh's data axis. ``B`` must
+    dominate every batched history's own candidate cap."""
     import jax
 
     # jit retraces per input dtype, so int16 vs int32 tables need no
     # separate build.
-    raw, _ = _build_kernel(model_key, F, W, KO, S, ND, NO)
+    raw, _ = _build_kernel(model_key, F, W, KO, S, ND, NO, B=B)
     return jax.jit(jax.vmap(raw))
 
 
@@ -609,10 +695,10 @@ class DevicePlan:
     """
 
     __slots__ = ("dims", "args", "nD", "nO", "init_state", "reason",
-                 "tab16")
+                 "tab16", "B")
 
     def __init__(self, dims, args, nD, nO, init_state=None, reason=None,
-                 tab16=False):
+                 tab16=False, B=None):
         self.dims = dims
         self.args = args
         self.nD = nD
@@ -620,6 +706,9 @@ class DevicePlan:
         self.init_state = init_state
         self.reason = reason
         self.tab16 = tab16
+        # Per-config candidate cap (see _build_kernel's ``B``): None
+        # disables row-wise pre-selection.
+        self.B = B
 
     @property
     def ok(self) -> bool:
@@ -732,9 +821,25 @@ def plan_device(
         padO(a1O),
         padO(a2O),
     )
+    # Per-config candidate cap: a config's determinate candidates are a
+    # clique of the op-interval overlap graph (see _build_kernel), so
+    # their count is bounded by the max point-overlap of determinate
+    # intervals; opens add at most nO. Conservative tie handling
+    # (ends strictly before a start count as closed) can only OVERcount,
+    # and the kernel's row-overflow flag keeps even an undercount sound.
+    if nD:
+        ends = np.sort(retD)
+        active = np.arange(1, nD + 1) - np.searchsorted(
+            ends, invD, side="left")
+        Dmax = int(active.max())
+    else:
+        Dmax = 0
+    B = ((Dmax + nO + 7) // 8) * 8
+    C = W + KO * 32
     return DevicePlan(
         (W, KO, S, ND, NO), args, nD, nO,
-        init_state=enc.init_state.astype(np.int32), tab16=tab16
+        init_state=enc.init_state.astype(np.int32), tab16=tab16,
+        B=B if B < C else None,
     )
 
 
@@ -1055,8 +1160,14 @@ def _device_search(enc: EncodedHistory, plan: DevicePlan, schedule: list,
     # configs and continues. `truncated` records whether any level actually
     # dropped configs — False verdicts are only sound when it never did.
     truncated = bool(resume_from.get("truncated")) if resume_from else False
+    # The static tables ride along to EVERY chunk: upload them to the
+    # device once per search instead of re-shipping host arrays each call
+    # (each upload is a relay round trip; there are nine tables).
+    import jax as _jax
+
+    dev_args = tuple(_jax.device_put(a) for a in plan.args)
     while True:
-        _, kern = _build_kernel(mk, F, W, KO, S, ND, NO)
+        _, kern = _build_kernel(mk, F, W, KO, S, ND, NO, B=plan.B)
         if fr[0].shape[0] < F:
             fr = _pad_frontier(fr, F)
         attempt = {"F": F, "levels": 0, "calls": 0, "wall_s": 0.0}
@@ -1065,17 +1176,21 @@ def _device_search(enc: EncodedHistory, plan: DevicePlan, schedule: list,
         else:
             attempts.append(attempt)
         t_call = _time.perf_counter()
-        lpc = levels_per_call or _levels_per_call(F * (W + KO * 32))
+        lpc = levels_per_call or _levels_per_call(
+            F * (plan.B or (W + KO * 32)))
         lvl0 = int(fr[-1])
         budget = np.int32(min(total_levels, lvl0 + lpc))
         lossy = F == schedule[-1]
         entry_fr = fr  # entry state: lossless while `truncated` is False
-        call_args = plan.args[:2] + (budget,) + plan.args[3:]
-        out = [np.asarray(x) for x in kern(*call_args, *fr, np.int32(lossy))]
-        acc, ovf, nonempty, lvl, fmax = out[:5]
-        fr = tuple(out[5:]) + (lvl,)  # resume point (next chunk / capacity)
-        fmax_all = max(fmax_all, int(fmax))
-        attempt["levels"] = int(lvl)
+        call_args = dev_args[:2] + (budget,) + dev_args[3:]
+        # The frontier stays device-resident across chunks; the single
+        # packed flags vector is the only per-chunk device->host read.
+        out = kern(*call_args, *fr[:-1], np.int32(lvl0), np.int32(lossy))
+        acc, ovf, nonempty, lvl, fmax, count = (
+            int(x) for x in np.asarray(out[0]))
+        fr = tuple(out[1:]) + (np.int32(lvl),)
+        fmax_all = max(fmax_all, fmax)
+        attempt["levels"] = lvl
         attempt["calls"] += 1
         attempt["wall_s"] = round(attempt["wall_s"] + _time.perf_counter() - t_call, 3)
         if lossy and bool(ovf):
@@ -1096,14 +1211,14 @@ def _device_search(enc: EncodedHistory, plan: DevicePlan, schedule: list,
                 lossless_fr=checkpoint.get("fr")
                 if checkpoint is not None else None)
         if chunk_callback is not None:
-            chunk_callback({"level": int(lvl), "F": F,
+            chunk_callback({"level": lvl, "F": F,
                             "frontier_max": fmax_all,
                             "wall_s": _time.perf_counter() - t0})
-        if bool(acc):
+        if acc:
             # Sound even after truncation: dropping configs only removes
             # accepting paths, never invents one.
             return result(True, lvl, **({"beam": True} if truncated else {}))
-        if not bool(nonempty):
+        if not nonempty:
             if truncated:
                 # A beam exhaustion is NOT a refutation — configs were
                 # dropped along the way.
@@ -1112,12 +1227,18 @@ def _device_search(enc: EncodedHistory, plan: DevicePlan, schedule: list,
                     info=f"beam (lossy frontier, capacity {F}) exhausted",
                     beam=True,
                 )
-            return result(False, lvl, max_linearized=int(lvl))
-        if int(lvl) >= total_levels:
+            # Refutation witness: the search's final configurations —
+            # what the reference renders as linear.svg
+            # (checker.clj:202-209).
+            return result(False, lvl, max_linearized=lvl,
+                          stuck_configs=capture_stuck(
+                              kern, dev_args, entry_fr, lvl, lvl0, enc,
+                              plan))
+        if lvl >= total_levels:
             return result(
                 "unknown", lvl, info="level budget exhausted without verdict"
             )
-        if bool(ovf) and not lossy:
+        if ovf and not lossy:
             # Escalate, resuming losslessly from the kept frontier. (At the
             # top capacity the kernel already continued past the overflow
             # as a greedy beam.)
@@ -1126,15 +1247,107 @@ def _device_search(enc: EncodedHistory, plan: DevicePlan, schedule: list,
             # De-escalate when the frontier has shrunk: resume at the
             # smallest adequate capacity (never below the last overflow's
             # escalation floor... which transient spikes may re-trigger —
-            # that's fine, escalation is lossless).
-            count = int(np.asarray(fr[4]).sum())
+            # that's fine, escalation is lossless). The count rides the
+            # packed flags vector — no extra device read. Kept rows are
+            # compacted to the front, so the slice is lossless.
             attempt.setdefault("counts", []).append(count)
             F2 = pick_capacity(count)
             if F2 < F:
                 fr = tuple(
-                    np.asarray(a)[:F2] if np.ndim(a) >= 1 else a for a in fr[:-1]
+                    a[:F2] if np.ndim(a) >= 1 else a for a in fr[:-1]
                 ) + (fr[-1],)
                 F = F2
+
+
+# Open-set word count of the native engine's witness encoding (must
+# match wgl_native.c's NO_WORDS).
+NO_WORDS_OPEN = 4
+
+
+def decode_stuck_config(enc: EncodedHistory, det_rows, open_rows,
+                        p: int, win: int, open_words: list,
+                        st: tuple) -> dict:
+    """Decode one (p, window-bitset, open-set, state) search config into
+    the host oracle's ``stuck_configs`` entry shape — original history
+    row indices, model state, and the first pending ops annotated with
+    WHY each cannot extend the linearization (the explanation the
+    reference renders as linear.svg final configs,
+    checker.clj:202-209)."""
+    nD = len(det_rows)
+    linearized = [int(det_rows[i]) for i in range(min(p, nD))]
+    for b in range(int(win).bit_length()):
+        if (win >> b) & 1 and p + b < nD:
+            linearized.append(int(det_rows[p + b]))
+    for w, word in enumerate(open_words):
+        for b in range(64):
+            if (word >> b) & 1 and 64 * w + b < len(open_rows):
+                linearized.append(int(open_rows[64 * w + b]))
+    lin_set = set(linearized)
+    model = enc.model
+
+    # min completion among unlinearized determinate ops (the real-time
+    # bound every candidate must beat).
+    unlin = [int(r) for r in det_rows if int(r) not in lin_set]
+    min_ret = min((int(enc.ret[r]) for r in unlin), default=None)
+    pending = []
+    for r in unlin[:10]:
+        if min_ret is not None and int(enc.inv[r]) >= min_ret \
+                and int(enc.ret[r]) != min_ret:
+            why = ("real-time-blocked: an earlier op completed "
+                   "before this one was invoked")
+        else:
+            ok, _st2 = model.step_scalar(
+                tuple(st), int(enc.opcode[r]), int(enc.a1[r]),
+                int(enc.a2[r]))
+            why = ("every continuation already explored" if ok
+                   else f"model rejects from state {tuple(st)}")
+        pending.append({"row": r, "op": enc.describe(r), "why": why})
+    return {
+        "linearized": sorted(lin_set),
+        "state": tuple(st),
+        "pending": pending,
+    }
+
+
+def capture_stuck(kern, dev_args: tuple, entry_fr: tuple, lvl: int,
+                  lvl0: int, enc: EncodedHistory,
+                  plan: DevicePlan) -> list:
+    """Refutation witness, shared by the single-device and sharded
+    drivers: re-run one chunk from its entry frontier stopping AT the
+    stuck level ``lvl`` (the kernel does not advance past the level that
+    empties the frontier, so the re-run reproduces the last non-empty
+    one), then decode the surviving rows. Diagnostics must never mask
+    the verdict — any failure returns an empty witness."""
+    try:
+        out = kern(*dev_args[:2], np.int32(lvl), *dev_args[3:],
+                   *entry_fr[:-1], np.int32(lvl0), np.int32(0))
+        return _frontier_stuck_configs(
+            enc, plan, tuple(np.asarray(x) for x in out[1:]))
+    except Exception:
+        return []
+
+
+def _frontier_stuck_configs(enc: EncodedHistory, plan: DevicePlan,
+                            fr: tuple, limit: int = 5) -> list:
+    """Decode the (host-fetched) device frontier's valid rows into
+    stuck-config entries."""
+    p_, mD, mO, _st, valid = (np.asarray(a) for a in fr[:5])
+    det_rows = np.flatnonzero(~enc.skippable)
+    open_rows = np.flatnonzero(enc.skippable)
+    out = []
+    for i in np.flatnonzero(valid)[:limit]:
+        win = 0
+        for w in range(mD.shape[1]):
+            win |= int(mD[i, w]) << (32 * w)
+        open_words = []
+        for w in range(0, max(mO.shape[1], 1), 2):
+            lo = int(mO[i, w]) if w < mO.shape[1] else 0
+            hi = int(mO[i, w + 1]) if w + 1 < mO.shape[1] else 0
+            open_words.append(lo | (hi << 32))
+        st = tuple(int(x) for x in _st[i])
+        out.append(decode_stuck_config(
+            enc, det_rows, open_rows, int(p_[i]), win, open_words, st))
+    return out
 
 
 def check_history_device(model: Model, history: History, **kw) -> dict:

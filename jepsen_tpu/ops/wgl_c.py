@@ -87,8 +87,7 @@ def check_encoded_native(
     fmax = ctypes.c_int32(0)
     maxlin = ctypes.c_int32(0)
     t0 = _time.perf_counter()
-    entry = lib.wgl_check_dfs if strategy == "dfs" else lib.wgl_check
-    verdict = entry(
+    common = (
         nD, nO, S, W,
         p(invD), p(retD), p(opD), p(a1D), p(a2D),
         p(sufret),
@@ -97,6 +96,18 @@ def check_encoded_native(
         mid, param, max_configs,
         ctypes.byref(explored), ctypes.byref(fmax), ctypes.byref(maxlin),
     )
+    if strategy == "dfs":
+        # Deepest-config capture: the refutation witness (reference
+        # renders these as linear.svg, checker.clj:202-209).
+        stride = int(lib.wgl_witness_stride())
+        wit_cap = 5
+        wit_buf = np.zeros(wit_cap * stride, dtype=np.int32)
+        wit_len = ctypes.c_int32(0)
+        verdict = lib.wgl_check_dfs(
+            *common, p(wit_buf), wit_cap, ctypes.byref(wit_len))
+    else:
+        wit_buf = None
+        verdict = lib.wgl_check(*common)
     wall = _time.perf_counter() - t0
     base = {
         "op_count": enc.n,
@@ -108,7 +119,11 @@ def check_encoded_native(
     if verdict == 1:
         return {"valid": True, **base}
     if verdict == 0:
-        return {"valid": False, "max_linearized": int(maxlin.value), **base}
+        res = {"valid": False, "max_linearized": int(maxlin.value), **base}
+        if wit_buf is not None and wit_len.value:
+            res["stuck_configs"] = _decode_witness(
+                enc, wit_buf, int(wit_len.value), stride, S)
+        return res
     if verdict == -1:
         return {"valid": "unknown",
                 "info": f"config budget {max_configs} exhausted", **base}
@@ -116,6 +131,38 @@ def check_encoded_native(
         return {"valid": "unknown",
                 "info": "native engine out of memory", **base}
     return None  # unsupported shape
+
+
+def _decode_witness(enc: EncodedHistory, buf: np.ndarray, n_entries: int,
+                    stride: int, S: int) -> list:
+    """Decode the C engine's deepest-config capture into the host
+    oracle's ``stuck_configs`` shape (wgl_host.check_encoded): original
+    history row indices for the linearized set, model state, and the
+    first few pending ops with the reason each cannot linearize."""
+    from .wgl import NO_WORDS_OPEN, decode_stuck_config
+
+    # The layout below assumes the C library's NO_WORDS and S_MAX; the
+    # exported stride pins them (a C-side change fails loudly here
+    # instead of decoding open-mask words as model state).
+    assert stride == 3 + 2 * NO_WORDS_OPEN + 8, (
+        f"witness stride {stride} does not match the python decoder")
+    det_rows = np.flatnonzero(~enc.skippable)
+    open_rows = np.flatnonzero(enc.skippable)
+    out = []
+    for e in range(min(n_entries, buf.size // stride)):
+        ent = buf[e * stride:(e + 1) * stride]
+        p = int(ent[0])
+        win = (int(ent[1]) & 0xFFFFFFFF) | ((int(ent[2]) & 0xFFFFFFFF) << 32)
+        open_words = [
+            (int(ent[3 + 2 * w]) & 0xFFFFFFFF)
+            | ((int(ent[4 + 2 * w]) & 0xFFFFFFFF) << 32)
+            for w in range(NO_WORDS_OPEN)
+        ]
+        st = tuple(int(x) for x in ent[3 + 2 * NO_WORDS_OPEN:
+                                       3 + 2 * NO_WORDS_OPEN + S])
+        out.append(decode_stuck_config(
+            enc, det_rows, open_rows, p, win, open_words, st))
+    return out
 
 
 def check_history_native(model: Model, history: History,

@@ -96,14 +96,20 @@ def check_encoded_batch(
             for i in idx
         ]
         # Round the batch up to the mesh's dp extent for even sharding.
-        B = len(padded)
         if mesh is not None:
             dp = int(np.prod([mesh.shape[a] for a in mesh.axis_names if a == batch_axis]))
             while len(padded) % max(dp, 1):
                 padded.append(padded[0])
-        kern = wgl._build_batch_kernel(mk, f, W, KO, S, ND, NO)
+        # The shared candidate cap must dominate every member (None if
+        # any member's own cap already reaches its C).
+        Bs = [p.B for p in padded]
+        B = None if any(b is None for b in Bs) else max(Bs)
+        kern = wgl._build_batch_kernel(mk, f, W, KO, S, ND, NO, B=B)
         out = kern(*_stack(padded, f, (W, KO, S, ND, NO), mesh, batch_axis))
-        acc, ovf, nonempty, lvl, fmax = [np.asarray(x) for x in out[:5]]
+        # out[0] is the packed per-history flags matrix [B, 6] — one
+        # device->host read for the whole batch.
+        flags = np.asarray(out[0])
+        acc, ovf, nonempty, lvl, fmax = (flags[:, c] for c in range(5))
         for b, i in enumerate(idx):
             if acc[b]:
                 results[i] = {

@@ -38,7 +38,7 @@ from . import make_mesh
 
 @functools.lru_cache(maxsize=32)
 def _sharded_kernel(mk, F: int, W: int, KO: int, S: int, ND: int, NO: int,
-                    axis: str, mesh):
+                    axis: str, mesh, B=None):
     """jit(shard_map(raw kernel)) cached per (model, shapes, mesh) —
     without this every check would re-trace and re-lower the whole BFS
     program (15-90 s per bucket on TPU)."""
@@ -52,7 +52,7 @@ def _sharded_kernel(mk, F: int, W: int, KO: int, S: int, ND: int, NO: int,
 
     D = int(mesh.shape[axis])
     raw, _ = wgl._build_kernel(mk, F, W, KO, S, ND, NO,
-                               axis_name=axis, n_shards=D)
+                               axis_name=axis, n_shards=D, B=B)
     repl = P()
     shard1 = P(axis)
     in_specs = (
@@ -61,7 +61,7 @@ def _sharded_kernel(mk, F: int, W: int, KO: int, S: int, ND: int, NO: int,
         shard1, shard1, shard1, shard1, shard1,  # frontier
         repl, repl,  # lvl0, lossy
     )
-    out_specs = (repl, repl, repl, repl, repl,
+    out_specs = (repl,  # packed flags vector (pmax/psum-replicated)
                  shard1, shard1, shard1, shard1, shard1)
     try:  # jax >= 0.8 renamed check_rep -> check_vma
         smapped = shard_map(raw, mesh=mesh, in_specs=in_specs,
@@ -129,20 +129,29 @@ def check_encoded_sharded(
         """Chunked search at one global capacity; returns (result|None,
         frontier) — None result means lossless overflow (escalate)."""
         F = FT // D
-        sharded = _sharded_kernel(mk, F, W, KO, S, ND, NO, axis, mesh)
+        sharded = _sharded_kernel(mk, F, W, KO, S, ND, NO, axis, mesh,
+                                  B=plan.B)
         fr = fr_global
-        lpc = levels_per_call or wgl._levels_per_call(F * (W + KO * 32))
+        lpc = levels_per_call or wgl._levels_per_call(
+            F * (plan.B or (W + KO * 32)))
+        # Upload the static tables once per capacity, not per chunk
+        # (each host->device transfer pays a relay round trip).
+        import jax as _jax
+
+        dev_args = tuple(_jax.device_put(a) for a in plan.args)
         while True:
             t_call = _time.perf_counter()
             lvl0 = int(fr[-1])
+            entry_fr = fr  # chunk entry (for the refutation witness)
             budget = np.int32(min(total_levels, lvl0 + lpc))
-            call_args = plan.args[:2] + (budget,) + plan.args[3:]
-            out = [np.asarray(x)
-                   for x in sharded(*call_args, *fr[:-1], np.int32(lvl0),
-                                    np.int32(0))]
-            acc, ovf, nonempty, lvl, fmax = out[:5]
-            fmax_all[0] = max(fmax_all[0], int(fmax))
-            fr = tuple(out[5:]) + (np.int32(lvl),)
+            call_args = dev_args[:2] + (budget,) + dev_args[3:]
+            out = sharded(*call_args, *fr[:-1], np.int32(lvl0),
+                          np.int32(0))
+            # ONE packed device->host read per chunk (see wgl kernel).
+            acc, ovf, nonempty, lvl, fmax, _cnt = (
+                int(x) for x in np.asarray(out[0]))
+            fmax_all[0] = max(fmax_all[0], fmax)
+            fr = tuple(out[1:]) + (np.int32(lvl),)
             if checkpoint_path:
                 wgl._save_search_checkpoint(
                     checkpoint_path, fingerprint, "sharded", False, fr)
@@ -165,7 +174,11 @@ def check_encoded_sharded(
             if bool(ovf):
                 return None, fr  # lossless overflow: escalate
             if not bool(nonempty):
-                return result(False, max_linearized=int(lvl)), fr
+                return result(
+                    False, max_linearized=int(lvl),
+                    stuck_configs=wgl.capture_stuck(
+                        sharded, dev_args, entry_fr, lvl, lvl0, enc,
+                        plan)), fr
             if int(lvl) >= total_levels:
                 return result("unknown",
                               info="level budget exhausted"), fr

@@ -38,10 +38,12 @@ same shape on this framework's protocols. Roster (→ reference suite):
   (rabbitmq/; disque is the redis queue workload)
 - ``chronos``    — job-scheduler run-window verification (chronos/)
 - ``raftis``     — RESP read/write register on a Raft KV (raftis/)
+- ``faunadb``    — temporal-database workloads (pages, monotonic,
+  multimonotonic, bank, set) over a FaunaQL-shaped wire client, with a
+  replica-topology-aware nemesis (faunadb/)
 
-Not ported: faunadb/ (driver-only wire protocol with account secrets),
-rethinkdb/ (ReQL driver protocol), robustirc/ and logcabin/ (niche
-single-file suites whose capability axes — unique messages, CLI
+Not ported: rethinkdb/ (ReQL driver protocol), robustirc/ and logcabin/
+(niche single-file suites whose capability axes — unique messages, CLI
 register — are covered by unique-ids and register workloads above).
 
 Each exposes ``test_fn(opts)`` and a ``main()`` wired through
@@ -56,11 +58,17 @@ from .. import generator as gen  # noqa: E402
 
 
 def std_generator(opts: Optional[dict], client_gen,
-                  final_client_gen=None, dt: float = 5.0):
+                  final_client_gen=None, dt: float = 5.0,
+                  nemesis_gen=None, final_nemesis_op=None):
     """The canonical suite generator shape (consul.clj:48-60): a
     time-limited phase of client load with a sleep/start/sleep/stop
     partition cycle riding the nemesis thread, a heal, then an optional
     fault-free final client phase (drain / final read).
+
+    ``nemesis_gen`` replaces the default start/stop cycle for nemeses
+    with richer fault vocabularies (e.g. the faunadb topology
+    partitioner); ``final_nemesis_op`` correspondingly replaces the
+    closing stop/heal op.
 
     The time limit wraps the WHOLE nemesis+client composite: an infinite
     ``cycle_`` otherwise keeps the phase alive forever after a bounded
@@ -69,16 +77,18 @@ def std_generator(opts: Optional[dict], client_gen,
     """
     o = dict(opts or {})
     tl = float(o.get("time_limit") or o.get("time-limit") or 60)
+    if nemesis_gen is None:
+        nemesis_gen = gen.cycle_([
+            gen.sleep(dt),
+            {"type": "info", "f": "start", "value": None},
+            gen.sleep(dt),
+            {"type": "info", "f": "stop", "value": None},
+        ])
+    if final_nemesis_op is None:
+        final_nemesis_op = {"type": "info", "f": "stop", "value": None}
     phases = [
-        gen.time_limit(tl, gen.nemesis(
-            gen.cycle_([
-                gen.sleep(dt),
-                {"type": "info", "f": "start", "value": None},
-                gen.sleep(dt),
-                {"type": "info", "f": "stop", "value": None},
-            ]),
-            client_gen)),
-        gen.nemesis({"type": "info", "f": "stop", "value": None}),
+        gen.time_limit(tl, gen.nemesis(nemesis_gen, client_gen)),
+        gen.nemesis(final_nemesis_op),
     ]
     if final_client_gen is not None:
         phases.append(final_client_gen)

@@ -1,0 +1,889 @@
+"""FaunaDB suite: temporal-database workloads over a FaunaQL-shaped
+wire client.
+
+The reference's faunadb suite is its largest
+(faunadb/src/jepsen/faunadb/, 3,605 LoC): a Calvin-style temporal
+database driven through a query-expression client, with workloads that
+exist in no other suite — transactional **pagination** (pages.clj),
+**monotonic** timestamp/value reads incl. snapshot reads at past
+timestamps (monotonic.clj), and **multimonotonic** blind-write registers
+(multimonotonic.clj) — plus bank/set variants and a topology-aware
+nemesis that partitions within and between replicas
+(topology.clj, nemesis.clj).
+
+This port keeps the same layering TPU-side:
+
+- a tiny FaunaQL-shaped JSON expression DSL (query.clj's `q/*` builders
+  — ``create``/``get``/``update``/``exists``/``at``/``time``/``match``/
+  ``do`` — as plain dicts posted over HTTP, the shape of Fauna's wire
+  protocol);
+- `Fauna`, the wire client (client.clj's f/query: POST one expression,
+  get ``{"resource": ...}`` or ``{"errors": [...]}``);
+- the five distinctive workloads: **bank** (bank.clj, on the shared
+  jepsen_tpu.workloads.bank invariant machinery), **set** (set.clj with
+  the strong-read read-write trick), **pages** (pages.clj with its
+  union-of-groups checker), **monotonic** (monotonic.clj: inc/read/
+  read-at with per-process and timestamp-value checkers), and
+  **multimonotonic** (multimonotonic.clj: owner-thread blind writes,
+  map-partial-order read checker);
+- a replica **topology** model + topology-aware nemesis
+  (topology.clj:12-28, nemesis.clj:20-55): single-node, intra-replica
+  and inter-replica partitions over the grudge algebra.
+
+Checkers run host-side (they are O(n) scans and partial-order checks,
+not searches); the linearizable register variant rides the standard
+device dispatch like every other suite.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.request
+from typing import Any, Optional
+
+from .. import checker as jchecker
+from .. import cli, client as jclient, db as jdb, generator as gen
+from .. import independent as jind
+from .. import nemesis as jnemesis, net as jnet
+from ..checker import Checker, checker_fn
+from ..control import util as cu
+from ..workloads import bank as wbank
+from .. import control as c
+from . import std_generator
+
+PORT = 8444
+
+
+# ---------------------------------------------------------------------------
+# FaunaQL-shaped expression builders (query.clj's q/* namespace)
+
+
+def ref(cls: str, k: Any) -> dict:
+    return {"ref": {"class": cls, "id": k}}
+
+
+def create(r: dict, data: dict) -> dict:
+    return {"create": r, "params": {"data": data}}
+
+
+def get(r: dict) -> dict:
+    return {"get": r}
+
+
+def update(r: dict, data: dict) -> dict:
+    return {"update": r, "params": {"data": data}}
+
+
+def upsert(r: dict, data: dict) -> dict:
+    """client.clj's f/upsert-by-ref: blind create-or-update."""
+    return {"upsert": r, "params": {"data": data}}
+
+
+def exists(r: dict) -> dict:
+    return {"exists": r}
+
+
+def do_(*exprs) -> dict:
+    return {"do": list(exprs)}
+
+
+def time_now() -> dict:
+    return {"time": "now"}
+
+
+def at(ts: Any, expr: dict) -> dict:
+    """Snapshot read at a past timestamp (the temporal-database seam)."""
+    return {"at": ts, "expr": expr}
+
+
+def match(cls: str, term: Any = None) -> dict:
+    """Index read: all instances of cls (optionally with data.key=term),
+    paginated server-side (q/match + paginate)."""
+    m: dict = {"match": cls}
+    if term is not None:
+        m["term"] = term
+    return m
+
+
+def guarded_transfer(cls: str, frm: Any, to: Any, amount: int) -> dict:
+    """bank.clj's transfer txn: abort if the source would go negative."""
+    return {"transfer": {"class": cls, "from": frm, "to": to,
+                         "amount": amount}}
+
+
+# ---------------------------------------------------------------------------
+# Wire client (client.clj's f/query)
+
+
+class Fauna:
+    """POST one expression; ``{"resource": ...}`` back, or
+    ``{"errors": [...]}`` raised as FaunaError."""
+
+    def __init__(self, host: str, port: Optional[int] = None,
+                 secret: str = "secret", timeout: float = 10.0):
+        if port is None:
+            port = PORT
+        self.base = f"http://{host}:{port}"
+        self.secret = secret
+        self.timeout = timeout
+
+    def query(self, expr: dict) -> Any:
+        req = urllib.request.Request(
+            self.base + "/", data=json.dumps(expr).encode(),
+            headers={"Content-Type": "application/json",
+                     "Authorization": f"Basic {self.secret}"},
+            method="POST")
+        with urllib.request.urlopen(req, timeout=self.timeout) as r:
+            res = json.loads(r.read().decode())
+        if res.get("errors"):
+            raise FaunaError(res["errors"])
+        return res.get("resource")
+
+    def close(self):
+        pass
+
+
+class FaunaError(RuntimeError):
+    def __init__(self, errors):
+        super().__init__(json.dumps(errors)[:500])
+        self.errors = errors
+
+    @property
+    def code(self) -> str:
+        return (self.errors[0] or {}).get("code", "") if self.errors else ""
+
+
+def _with_errors(op: dict, idempotent: bool, fn):
+    """client.clj's f/with-errors: timeouts/unavailable are :fail for
+    idempotent (read-only) ops and :info otherwise."""
+    try:
+        return fn()
+    except FaunaError as e:
+        if e.code in ("unavailable", "timeout"):
+            return {**op, "type": "fail" if idempotent else "info",
+                    "error": e.code}
+        raise
+    except OSError as e:
+        return {**op, "type": "fail" if idempotent else "info",
+                "error": f"net: {e}"}
+
+
+# ---------------------------------------------------------------------------
+# Clients
+
+
+class BankClient(jclient.Client):
+    """bank.clj: guarded transfer txns + one-snapshot read of every
+    account."""
+
+    CLS = "accounts"
+
+    def __init__(self, conn: Optional[Fauna] = None):
+        self.conn = conn
+
+    def open(self, test, node):
+        return BankClient(Fauna(str(node)))
+
+    def setup(self, test):
+        for a, bal in wbank.initial_balances(test):
+            self.conn.query(upsert(ref(self.CLS, a), {"balance": bal}))
+
+    def invoke(self, test, op):
+        if op["f"] == "transfer":
+            v = op["value"]
+
+            def go():
+                try:
+                    self.conn.query(guarded_transfer(
+                        self.CLS, v["from"], v["to"], v["amount"]))
+                except FaunaError as e:
+                    if e.code == "transaction aborted":
+                        return {**op, "type": "fail", "error": "negative"}
+                    raise
+                return {**op, "type": "ok"}
+
+            return _with_errors(op, False, go)
+        if op["f"] == "read":
+            def go():
+                res = self.conn.query(do_(*[
+                    {"if": exists(ref(self.CLS, a)),
+                     "then": {"select": ["data", "balance"],
+                              "from": get(ref(self.CLS, a))},
+                     "else": None}
+                    for a in test["accounts"]]))
+                return {**op, "type": "ok",
+                        "value": dict(zip(test["accounts"], res))}
+
+            return _with_errors(op, True, go)
+        raise ValueError(f"unknown f {op['f']!r}")
+
+    def close(self, test):
+        self.conn.close()
+
+
+class SetClient(jclient.Client):
+    """set.clj: unique adds + index reads; ``strong_read`` sneaks a write
+    into the read txn to force strict serializability."""
+
+    CLS = "elements"
+
+    def __init__(self, conn: Optional[Fauna] = None,
+                 strong_read: bool = False):
+        self.conn = conn
+        self.strong_read = strong_read
+
+    def open(self, test, node):
+        return SetClient(Fauna(str(node)), self.strong_read)
+
+    def invoke(self, test, op):
+        if op["f"] == "add":
+            def go():
+                self.conn.query(create(ref(self.CLS, op["value"]),
+                                       {"value": op["value"]}))
+                return {**op, "type": "ok"}
+
+            return _with_errors(op, False, go)
+        if op["f"] == "read":
+            def go():
+                expr: dict = match(self.CLS)
+                if self.strong_read:
+                    expr = do_({"create": {"ref": {"class": "side-effects",
+                                                   "id": "auto"}},
+                                "params": {"data": {}}},
+                               expr)
+                vals = self.conn.query(expr)
+                if self.strong_read:
+                    vals = vals[-1]
+                return {**op, "type": "ok",
+                        "value": sorted(v["value"] for v in vals)}
+
+            return _with_errors(op, True, go)
+        raise ValueError(f"unknown f {op['f']!r}")
+
+    def close(self, test):
+        self.conn.close()
+
+
+class PagesClient(jclient.Client):
+    """pages.clj: insert element GROUPS in one txn; concurrent reads of
+    every element under a key must see unions of whole groups."""
+
+    CLS = "pages"
+
+    def __init__(self, conn: Optional[Fauna] = None):
+        self.conn = conn
+
+    def open(self, test, node):
+        return PagesClient(Fauna(str(node)))
+
+    def invoke(self, test, op):
+        k, v = op["value"]
+        if op["f"] == "add":
+            def go():
+                self.conn.query(do_(*[
+                    create({"ref": {"class": self.CLS,
+                                    "id": f"{k}:{e}"}},
+                           {"key": k, "value": e})
+                    for e in v]))
+                return {**op, "type": "ok"}
+
+            return _with_errors(op, False, go)
+        if op["f"] == "read":
+            def go():
+                vals = self.conn.query(match(self.CLS, k))
+                return {**op, "type": "ok",
+                        "value": jind.tuple_(k, [x["value"] for x in vals])}
+
+            return _with_errors(op, True, go)
+        raise ValueError(f"unknown f {op['f']!r}")
+
+    def close(self, test):
+        self.conn.close()
+
+
+class MonotonicClient(jclient.Client):
+    """monotonic.clj: one register incremented via read-modify-write;
+    every query also returns the txn timestamp, and read-at reads a PAST
+    snapshot via q/at."""
+
+    CLS = "registers"
+    K = 0
+
+    def __init__(self, conn: Optional[Fauna] = None):
+        self.conn = conn
+
+    def open(self, test, node):
+        return MonotonicClient(Fauna(str(node)))
+
+    def invoke(self, test, op):
+        r = ref(self.CLS, self.K)
+        if op["f"] == "inc":
+            def go():
+                ts, v = self.conn.query(
+                    {"inc": r, "with_time": True})
+                return {**op, "type": "ok", "value": [ts, v]}
+
+            return _with_errors(op, False, go)
+        if op["f"] == "read":
+            def go():
+                ts, v = self.conn.query(do_(
+                    time_now(),
+                    {"if": exists(r),
+                     "then": {"select": ["data", "value"], "from": get(r)},
+                     "else": 0}))
+                return {**op, "type": "ok", "value": [ts, v]}
+
+            return _with_errors(op, True, go)
+        if op["f"] == "read-at":
+            def go():
+                ts = (op.get("value") or [None])[0]
+                if ts is None:
+                    ts = self.conn.query(time_now())
+                v = self.conn.query(at(ts, {
+                    "if": exists(r),
+                    "then": {"select": ["data", "value"], "from": get(r)},
+                    "else": 0}))
+                return {**op, "type": "ok", "value": [ts, v]}
+
+            return _with_errors(op, True, go)
+        raise ValueError(f"unknown f {op['f']!r}")
+
+    def close(self, test):
+        self.conn.close()
+
+
+class MultiMonotonicClient(jclient.Client):
+    """multimonotonic.clj: blind writes (no OCC read locks) of
+    per-register increasing values; reads return the txn time plus a map
+    of every register."""
+
+    CLS = "registers"
+
+    def __init__(self, conn: Optional[Fauna] = None):
+        self.conn = conn
+
+    def open(self, test, node):
+        return MultiMonotonicClient(Fauna(str(node)))
+
+    def invoke(self, test, op):
+        if op["f"] == "write":
+            def go():
+                self.conn.query(do_(*[
+                    upsert(ref(self.CLS, k), {"value": v})
+                    for k, v in op["value"].items()]))
+                return {**op, "type": "ok"}
+
+            return _with_errors(op, False, go)
+        if op["f"] == "read":
+            def go():
+                ks = op["value"]
+                ts, vals = self.conn.query(do_(
+                    time_now(),
+                    [{"if": exists(ref(self.CLS, k)),
+                      "then": {"select": ["data", "value"],
+                               "from": get(ref(self.CLS, k))},
+                      "else": None} for k in ks]))
+                regs = {k: v for k, v in zip(ks, vals) if v is not None}
+                return {**op, "type": "ok",
+                        "value": {"ts": ts, "registers": regs}}
+
+            return _with_errors(op, True, go)
+        raise ValueError(f"unknown f {op['f']!r}")
+
+    def close(self, test):
+        self.conn.close()
+
+
+# ---------------------------------------------------------------------------
+# Checkers
+
+
+def pages_checker() -> Checker:
+    """pages.clj read-errs: every ok read must be a union of whole add
+    groups (and duplicate-free)."""
+
+    def chk(test, history, opts):
+        # Values may arrive bare (under independent.checker, which
+        # strips the key) or as KV tuples (raw histories).
+        unkv = lambda v: v[1] if jind.is_tuple(v) else v
+        idx: dict = {}
+        failed = set()
+        for op in history:
+            if op.f != "add":
+                continue
+            if op.is_fail:
+                failed.add(frozenset(unkv(op.value)))
+        for op in history:
+            if op.f == "add" and op.is_invoke:
+                g = frozenset(unkv(op.value))
+                if g in failed:
+                    continue
+                for e in g:
+                    idx[e] = g
+        errs = []
+        ok_reads = 0
+        for op in history:
+            if op.f != "read" or not op.is_ok:
+                continue
+            ok_reads += 1
+            read_list = unkv(op.value)
+            read = set(read_list)
+            if len(read) != len(read_list):
+                errs.append({"op_index": op.index,
+                             "errors": ["duplicate-items"]})
+                continue
+            op_errs = []
+            while read:
+                e = next(iter(read))
+                group = idx.get(e, frozenset([e]))
+                if not group <= read:
+                    op_errs.append({
+                        "expected": sorted(group),
+                        "found": sorted(read & group)})
+                read -= group
+            if op_errs:
+                errs.append({"op_index": op.index, "errors": op_errs})
+        return {"valid": not errs,
+                "ok_read_count": ok_reads,
+                "error_count": len(errs),
+                "first_error": errs[0] if errs else None}
+
+    return checker_fn(chk, "pages")
+
+
+def _non_monotonic_pairs_by_process(extract, history):
+    """monotonic.clj:non-monotonic-pairs-by-process."""
+    last: dict = {}
+    errs = []
+    for op in history:
+        if not op.is_ok:
+            continue
+        v = extract(op)
+        if v is None:
+            continue
+        p = op.process
+        if p in last and not (last[p][1] <= v):
+            errs.append([last[p][0], op.index])
+        last[p] = (op.index, v)
+    return errs
+
+
+def monotonic_checker() -> Checker:
+    """Per-process monotonic values AND timestamps over inc/read ops
+    (monotonic.clj:checker)."""
+
+    def chk(test, history, opts):
+        ops = [op for op in history if op.f in ("inc", "read")]
+        value_errs = _non_monotonic_pairs_by_process(
+            lambda op: op.value[1] if op.value else None, ops)
+        ts_errs = _non_monotonic_pairs_by_process(
+            lambda op: op.value[0] if op.value else None, ops)
+        return {"valid": not value_errs and not ts_errs,
+                "value_errors": value_errs, "ts_errors": ts_errs}
+
+    return checker_fn(chk, "monotonic")
+
+
+def ts_value_checker() -> Checker:
+    """Globally: sorting inc/read-at ops by timestamp, values must be
+    monotonic (monotonic.clj:timestamp-value-checker)."""
+
+    def chk(test, history, opts):
+        rows = sorted(
+            ((op.value[0], op.value[1], op.index)
+             for op in history
+             if op.is_ok and op.f in ("inc", "read-at") and op.value),
+            key=lambda r: r[0])
+        errs = [[a[2], b[2]] for a, b in zip(rows, rows[1:])
+                if not (a[1] <= b[1])]
+        return {"valid": not errs, "errors": errs}
+
+    return checker_fn(chk, "timestamp-value")
+
+
+def _map_le(m1: dict, m2: dict):
+    """multimonotonic.clj:map-compare as a partial order: m1 <= m2 iff
+    no common key decreases. Returns (comparable?, le?)."""
+    up = down = False
+    for k in m1.keys() & m2.keys():
+        if m1[k] < m2[k]:
+            up = True
+        elif m1[k] > m2[k]:
+            down = True
+    if up and down:
+        return False, False
+    return True, not down
+
+
+def multimonotonic_checker() -> Checker:
+    """Per-process reads must advance in the registers-map partial order
+    (multimonotonic.clj:checker): a later read may not observe any
+    register EARLIER than a previous read did."""
+
+    def chk(test, history, opts):
+        last: dict = {}
+        errs = []
+        incomparable = []
+        for op in history:
+            if op.f != "read" or not op.is_ok:
+                continue
+            regs = (op.value or {}).get("registers") or {}
+            p = op.process
+            if p in last:
+                comparable, le = _map_le(last[p][1], regs)
+                if not comparable:
+                    incomparable.append([last[p][0], op.index])
+                elif not le:
+                    errs.append([last[p][0], op.index])
+            last[p] = (op.index, regs)
+        return {"valid": not errs and not incomparable,
+                "errors": errs, "incomparable": incomparable}
+
+    return checker_fn(chk, "multimonotonic")
+
+
+# ---------------------------------------------------------------------------
+# Topology + nemesis (topology.clj + nemesis.clj)
+
+
+def initial_topology(test: dict) -> dict:
+    """Round-robin node→replica assignment (topology.clj:12-28)."""
+    replicas = int(test.get("replicas") or 3)
+    nodes = test["nodes"]
+    return {
+        "replica-count": replicas,
+        "nodes": [{"node": n, "state": "active",
+                   "replica": f"replica-{i % replicas}"}
+                  for i, n in enumerate(nodes)],
+    }
+
+
+def _by_replica(topo: dict) -> dict:
+    by: dict = {}
+    for n in topo["nodes"]:
+        by.setdefault(n["replica"], []).append(n["node"])
+    return by
+
+
+def intra_replica_grudge(topo: dict) -> dict:
+    """Split one replica's nodes from each other
+    (nemesis.clj:intra-replica-partition-start)."""
+    by = _by_replica(topo)
+    replica = sorted(by)[gen.rand_int(len(by))]
+    members = by[replica]
+    if len(members) < 2:
+        return {}
+    lonely = members[gen.rand_int(len(members))]
+    return jnemesis.complete_grudge([[lonely],
+                                     [m for m in members if m != lonely]])
+
+
+def inter_replica_grudge(topo: dict) -> dict:
+    """Isolate one whole replica from the others
+    (nemesis.clj:inter-replica-partition-start)."""
+    by = _by_replica(topo)
+    replica = sorted(by)[gen.rand_int(len(by))]
+    inside = by[replica]
+    outside = [n["node"] for n in topo["nodes"]
+               if n["node"] not in inside]
+    if not inside or not outside:
+        return {}
+    return jnemesis.complete_grudge([inside, outside])
+
+
+def single_node_grudge(topo: dict) -> dict:
+    """Cut one node off entirely (nemesis.clj:single-node-partition)."""
+    nodes = [n["node"] for n in topo["nodes"]]
+    lonely = nodes[gen.rand_int(len(nodes))]
+    return jnemesis.complete_grudge([[lonely],
+                                     [m for m in nodes if m != lonely]])
+
+
+GRUDGES = {
+    "partition-single-node": single_node_grudge,
+    "partition-intra-replica": intra_replica_grudge,
+    "partition-inter-replica": inter_replica_grudge,
+}
+
+
+class TopologyNemesis(jnemesis.Nemesis):
+    """Topology-aware partitioner: f selects the grudge family; value
+    carries the computed grudge into the history (nemesis.clj:20-76)."""
+
+    def setup(self, test):
+        test.setdefault("topology", initial_topology(test))
+        return self
+
+    def invoke(self, test, op):
+        f = op.get("f")
+        if f == "start":  # std start/stop vocabulary: random family
+            f = sorted(GRUDGES)[gen.rand_int(len(GRUDGES))]
+        if f in GRUDGES:
+            grudge = GRUDGES[f](test["topology"])
+            test["net"].drop_all(test, grudge)
+            return {**op, "f": f, "type": "info",
+                    "value": {k: sorted(v) for k, v in grudge.items()}}
+        if f in ("heal", "stop"):
+            test["net"].heal(test)
+            return {**op, "type": "info", "value": "healed"}
+        raise ValueError(f"unknown nemesis f {f!r}")
+
+    def teardown(self, test):
+        try:
+            test["net"].heal(test)
+        except Exception:
+            pass
+
+
+def topology_nemesis_gen(interval: float):
+    """start/heal cycle over a random grudge family
+    (nemesis.clj:full-generator)."""
+    fams = sorted(GRUDGES)
+
+    def start(test=None, ctx=None):
+        return {"type": "info", "f": fams[gen.rand_int(len(fams))]}
+
+    heal = {"type": "info", "f": "heal"}
+    return gen.cycle_([gen.sleep(interval), start,
+                       gen.sleep(interval), heal])
+
+
+FINAL_HEAL = {"type": "info", "f": "heal", "value": None}
+
+
+# ---------------------------------------------------------------------------
+# DB lifecycle (auto.clj: enterprise deb + faunadb.yml + init service)
+
+
+class FaunaDB(jdb.DB, jdb.Process, jdb.LogFiles):
+    VERSION = "2.5.5"
+    LOG = "/var/log/faunadb/core.log"
+    YML = "/etc/faunadb.yml"
+
+    def setup(self, test, node):
+        from ..os_ import debian
+
+        debian.install(["openjdk-8-jre-headless", "faunadb"])
+        topo = test.setdefault("topology", initial_topology(test))
+        entry = next(n for n in topo["nodes"] if n["node"] == node)
+        peers = "\n".join(f"  - {n['node']}" for n in topo["nodes"][:3])
+        yml = (
+            f"auth_root_key: secret\n"
+            f"network_broadcast_address: {node}\n"
+            f"network_coordinator_http_address: {node}\n"
+            f"network_datalink_address: {node}\n"
+            f"network_listen_address: {node}\n"
+            f"replica_name: {entry['replica']}\n"
+            f"join:\n{peers}\n"
+        )
+        with c.su():
+            c.exec_star(f"cat > {self.YML} <<'JEPSEN_YML'\n{yml}\nJEPSEN_YML")
+        self.start(test, node)
+        if node == test["nodes"][0]:
+            c.exec_star("faunadb-admin init || true")
+
+    def start(self, test, node):
+        with c.su():
+            c.exec_star("service faunadb start")
+
+    def kill(self, test, node):
+        cu.grepkill("faunadb")
+
+    def teardown(self, test, node):
+        cu.grepkill("faunadb")
+        with c.su():
+            c.exec("rm", "-rf", "/var/lib/faunadb")
+
+    def log_files(self, test, node):
+        return [self.LOG]
+
+
+# ---------------------------------------------------------------------------
+# Workloads
+
+
+def bank_workload(opts: dict) -> dict:
+    wl = wbank.test(opts)
+    return {**wl, "client": BankClient()}
+
+
+def set_workload(opts: dict) -> dict:
+    o = dict(opts or {})
+    counter = [0]
+
+    def add(test=None, ctx=None):
+        counter[0] += 1
+        return {"type": "invoke", "f": "add", "value": counter[0]}
+
+    def read(test=None, ctx=None):
+        return {"type": "invoke", "f": "read", "value": None}
+
+    strong = bool(o.get("strong_read"))
+    return {
+        "client": SetClient(strong_read=strong),
+        "checker": jchecker.compose({
+            "set": jchecker.set_full(
+                {"linearizable": strong and bool(
+                    o.get("serialized_indices"))}),
+            "stats": jchecker.stats(),
+        }),
+        "generator": gen.clients(gen.limit(
+            int(o.get("ops") or 400), gen.mix([add, read]))),
+        "final-generator": gen.clients(
+            gen.once({"type": "invoke", "f": "read", "value": None})),
+    }
+
+
+def pages_workload(opts: dict) -> dict:
+    """Keyed concurrent pagination probe (pages.clj:workload)."""
+    o = dict(opts or {})
+    group_size = int(o.get("group_size") or 4)
+    per_key = int(o.get("ops_per_key") or 64)
+    n_keys = int(o.get("keys") or 4)
+
+    def fgen(k):
+        counter = [0]
+
+        def add(test=None, ctx=None):
+            base = counter[0]
+            counter[0] += group_size
+            return {"type": "invoke", "f": "add",
+                    "value": list(range(base, base + group_size))}
+
+        def read(test=None, ctx=None):
+            return {"type": "invoke", "f": "read", "value": None}
+
+        return gen.limit(per_key, gen.mix([add, add, add, add, read]))
+
+    return {
+        "client": PagesClient(),
+        "checker": jchecker.compose({
+            "pages": jind.checker(pages_checker()),
+            "stats": jchecker.stats(),
+        }),
+        "generator": gen.clients(jind.concurrent_generator(
+            2, range(n_keys), fgen)),
+    }
+
+
+def monotonic_workload(opts: dict) -> dict:
+    o = dict(opts or {})
+
+    def inc(test=None, ctx=None):
+        return {"type": "invoke", "f": "inc", "value": None}
+
+    def read(test=None, ctx=None):
+        return {"type": "invoke", "f": "read", "value": None}
+
+    def read_at(test=None, ctx=None):
+        return {"type": "invoke", "f": "read-at", "value": [None, None]}
+
+    return {
+        "client": MonotonicClient(),
+        "checker": jchecker.compose({
+            "monotonic": monotonic_checker(),
+            "timestamp-value": ts_value_checker(),
+            "stats": jchecker.stats(),
+        }),
+        "generator": gen.clients(gen.limit(
+            int(o.get("ops") or 400), gen.mix([inc, inc, read, read_at]))),
+    }
+
+
+def multimonotonic_workload(opts: dict) -> dict:
+    """Each register is written by ONE owner thread with monotonically
+    increasing blind writes (no OCC read locks — the reference's
+    throughput trick, multimonotonic.clj:1-9); the remaining threads
+    read every register. gen.reserve pins the ownership."""
+    o = dict(opts or {})
+    n_regs = int(o.get("registers") or 2)
+    counters: dict = {}
+
+    def writer(test, ctx):
+        # Under each_thread the context is restricted to ONE thread:
+        # that thread owns register (thread % n_regs).
+        thread = next(iter(ctx.workers))
+        k = int(thread) % n_regs
+        counters[k] = counters.get(k, 0) + 1
+        return {"type": "invoke", "f": "write",
+                "value": {k: counters[k]}}
+
+    def read(test=None, ctx=None):
+        return {"type": "invoke", "f": "read",
+                "value": list(range(n_regs))}
+
+    return {
+        "client": MultiMonotonicClient(),
+        "checker": jchecker.compose({
+            "multimonotonic": multimonotonic_checker(),
+            "stats": jchecker.stats(),
+        }),
+        "generator": gen.clients(gen.limit(
+            int(o.get("ops") or 400),
+            gen.reserve(n_regs, gen.each_thread(writer), read))),
+    }
+
+
+WORKLOADS = {
+    "bank": bank_workload,
+    "set": set_workload,
+    "pages": pages_workload,
+    "monotonic": monotonic_workload,
+    "multimonotonic": multimonotonic_workload,
+}
+
+
+def test_fn(opts: dict) -> dict:
+    name = opts.get("workload") or "bank"
+    wl = WORKLOADS[name](opts)
+    interval = float(opts.get("nemesis_interval") or 10)
+    test = {
+        "name": f"faunadb-{name}",
+        "replicas": int(opts.get("replicas") or 3),
+        "db": FaunaDB(),
+        "net": jnet.iptables(),
+        "nemesis": TopologyNemesis(),
+        **{k: v for k, v in wl.items()
+           if k not in ("generator", "final-generator")},
+    }
+    # topology is derived from the real node list by Nemesis.setup /
+    # DB.setup at run time.
+    test["generator"] = std_generator(
+        opts, wl["generator"],
+        nemesis_gen=topology_nemesis_gen(interval),
+        final_nemesis_op=FINAL_HEAL,
+        final_client_gen=wl.get("final-generator"))
+    return test
+
+
+def _add_opts(p):
+    p.add_argument("--workload", choices=sorted(WORKLOADS), default="bank")
+    p.add_argument("--ops", type=int, default=400)
+    p.add_argument("--replicas", type=int, default=3)
+    p.add_argument("--keys", type=int, default=4)
+    p.add_argument("--group-size", type=int, default=4)
+    p.add_argument("--registers", type=int, default=4)
+    p.add_argument("--strong-read", action="store_true")
+    p.add_argument("--serialized-indices", action="store_true")
+    p.add_argument("--nemesis-interval", type=int, default=10)
+
+
+def test_all_fns() -> dict:
+    """Every workload (runner.clj's workloads map) as a test-all sweep."""
+    fns = {}
+    for wname in sorted(WORKLOADS):
+        def fn(opts, _w=wname):
+            return test_fn({**opts, "workload": _w})
+
+        fns[wname] = fn
+    return fns
+
+
+def main(argv=None):
+    cmds = dict(cli.single_test_cmd(test_fn, add_opts=_add_opts))
+    cmds.update(cli.test_all_cmd(test_all_fns(), add_opts=_add_opts))
+    cli.main_exit(cmds, argv)
+
+
+if __name__ == "__main__":
+    main()

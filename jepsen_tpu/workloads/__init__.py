@@ -79,9 +79,11 @@ class AtomClient(jclient.Client):
     lifecycle calls so integration tests can assert open/setup/close counts
     (core_test.clj:100-109)."""
 
-    def __init__(self, state: AtomState, meta_log: Optional[list] = None):
+    def __init__(self, state: AtomState, meta_log: Optional[list] = None,
+                 latency: float = 0.001):
         self.state = state
         self.meta_log = meta_log if meta_log is not None else []
+        self.latency = latency
 
     def open(self, test, node):
         self.meta_log.append("open")
@@ -98,8 +100,10 @@ class AtomClient(jclient.Client):
 
     def invoke(self, test, op):
         # Sleep to make sure we actually get some concurrency
-        # (tests.clj:50-51).
-        _time.sleep(0.001)
+        # (tests.clj:50-51); latency=0 for scheduler throughput
+        # benchmarks, where the default 1 ms IS the measured ceiling.
+        if self.latency:
+            _time.sleep(self.latency)
         f = op.get("f")
         if f == "write":
             self.state.reset(op.get("value"))

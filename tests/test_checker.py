@@ -514,3 +514,38 @@ def test_linearizable_checker_device_backend():
 def test_linearizable_requires_model():
     with pytest.raises(ValueError):
         C.linearizable()
+
+
+def test_refutation_writes_linear_witness(tmp_path):
+    """valid=false renders linear.txt + linear.svg into the store from
+    the PRODUCTION dispatch (the reference's linear.svg of the search's
+    final configs, checker.clj:202-209)."""
+    from jepsen_tpu.models import CasRegister
+
+    chk = C.linearizable(model=CasRegister(init=0))
+    bad = h(
+        [
+            inv(0, "write", 1), ok(0, "write", 1),
+            inv(1, "read", None), ok(1, "read", 2),
+            inv(0, "read", None), ok(0, "read", 1),
+        ]
+    )
+    test = {"name": "witness-test", "start-time": "20260730T000000.000Z",
+            "store-root": str(tmp_path)}
+    res = chk.check(test, bad, {})
+    assert res["valid"] is False
+    assert "witness_error" not in res, res
+    assert "linear.txt" in res.get("witness_files", []), res
+    d = tmp_path / "witness-test" / "20260730T000000.000Z"
+    txt = (d / "linear.txt").read_text()
+    assert "Linearizability refuted" in txt
+    assert "because:" in txt  # per-op reasons present
+    if "linear.svg" in res["witness_files"]:
+        svg = (d / "linear.svg").read_text()
+        assert svg.startswith("<svg") and "not linearizable" in svg
+
+    # Backend variants also carry the witness through the same seam.
+    for backend in ("device", "host"):
+        res_b = chk.check({**test, "checker_backend": backend,
+                           "no-store?": True}, bad, {})
+        assert res_b["valid"] is False

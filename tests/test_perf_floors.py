@@ -10,22 +10,34 @@ import pytest
 
 @pytest.mark.perf
 def test_interpreter_throughput_floor():
-    from jepsen_tpu import client as jclient
-    from jepsen_tpu import core, generator as gen
-    from jepsen_tpu.workloads import noop_test
+    """Scheduler throughput with a zero-latency client (the measured
+    quantity in bench.py); the floor is half the reference's >20k ops/s
+    claim (generator.clj:67-70) to absorb CI-machine variance — the
+    steady-state number on a quiet machine is ~23k."""
+    from jepsen_tpu import generator as gen
+    from jepsen_tpu import nemesis as jnem
+    from jepsen_tpu.generator import interpreter as jinterp
+    from jepsen_tpu.util import with_relative_time
+    from jepsen_tpu.workloads import AtomClient, AtomState, noop_test
 
     def w(test=None, ctx=None):
         return {"type": "invoke", "f": "write", "value": 1}
 
     test = dict(noop_test())
     test.update(name=None, nodes=["n1"], concurrency=8,
-                client=jclient.noop(),
+                client=AtomClient(AtomState(), latency=0),
+                nemesis=jnem.noop(),
                 generator=gen.clients(gen.limit(20000, w)))
-    t0 = time.perf_counter()
-    res = core.run(test)
-    dt = time.perf_counter() - t0
-    ok = sum(1 for op in res["history"] if op.type == "ok")
-    assert ok / dt > 1000, f"{ok / dt:.0f} ops/s"
+    best = 0.0
+    for _rep in range(3):
+        test["client"] = AtomClient(AtomState(), latency=0)
+        with with_relative_time():
+            t0 = time.perf_counter()
+            h = jinterp.run(test)
+            dt = time.perf_counter() - t0
+        ok = sum(1 for op in h if op.get("type") == "ok")
+        best = max(best, ok / dt)
+    assert best > 10000, f"{best:.0f} ops/s"
 
 
 @pytest.mark.perf

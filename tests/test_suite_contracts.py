@@ -16,8 +16,8 @@ from jepsen_tpu.workloads import noop_test
 
 SUITES = [
     "aerospike", "chronos", "cockroachdb", "consul", "crate", "dgraph",
-    "elasticsearch", "etcd", "hazelcast", "ignite", "mongodb", "mysql",
-    "postgres", "rabbitmq", "raftis", "redis", "stolon", "tidb",
+    "elasticsearch", "etcd", "faunadb", "hazelcast", "ignite", "mongodb",
+    "mysql", "postgres", "rabbitmq", "raftis", "redis", "stolon", "tidb",
     "yugabyte", "zookeeper",
 ]
 

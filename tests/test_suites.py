@@ -1503,3 +1503,288 @@ class TestHazelcastSoak:
         assert t["name"] == "hazelcast-lock"
         t2 = fns["id-gen"]({"time_limit": 1})
         assert t2["name"] == "hazelcast-id-gen"
+
+
+class FaunaStub(BaseHTTPRequestHandler):
+    """In-process temporal-database stub for the FaunaQL-shaped wire
+    protocol: versioned instances under a global logical clock, snapshot
+    reads via ``at``, atomic multi-op txns — enough semantics to drive
+    every faunadb workload honestly (a correct DB must pass; the
+    monotonic/pages invariants hold by construction)."""
+
+    lock = threading.Lock()
+    clock = [0]
+    instances: dict = {}  # (cls, id) -> [(ts, data), ...]
+    auto = [0]
+
+    @classmethod
+    def reset(cls):
+        with cls.lock:
+            cls.clock[0] = 0
+            cls.instances = {}
+            cls.auto[0] = 0
+
+    def log_message(self, *a):
+        pass
+
+    @classmethod
+    def _ts(cls):
+        cls.clock[0] += 1
+        return f"t{cls.clock[0]:012d}"
+
+    @classmethod
+    def _visible(cls, key, snap):
+        versions = cls.instances.get(key) or []
+        if snap is None:
+            return versions[-1][1] if versions else None
+        best = None
+        for ts, data in versions:
+            if ts <= snap:
+                best = data
+        return best
+
+    @classmethod
+    def _eval(cls, x, now, snap):
+        ev = lambda e: cls._eval(e, now, snap)
+        if x is None or isinstance(x, (int, float, str, bool)):
+            return x
+        if isinstance(x, list):
+            return [ev(e) for e in x]
+        assert isinstance(x, dict), x
+        if "ref" in x and len(x) == 1:
+            return x
+        if "do" in x:
+            return [ev(e) for e in x["do"]]
+        if "time" in x:
+            return now
+        if "at" in x:
+            return cls._eval(x["expr"], now, x["at"])
+        if "if" in x:
+            return ev(x["then"]) if ev(x["if"]) else ev(x["else"])
+        if "exists" in x:
+            r = x["exists"]["ref"]
+            return cls._visible((r["class"], r["id"]), snap) is not None
+        if "get" in x:
+            r = x["get"]["ref"]
+            data = cls._visible((r["class"], r["id"]), snap)
+            if data is None:
+                raise _FaunaErr("instance not found")
+            return {"data": data}
+        if "select" in x:
+            v = ev(x["from"])
+            for part in x["select"]:
+                v = v[part]
+            return v
+        if "create" in x:
+            r = x["create"]["ref"]
+            rid = r["id"]
+            if rid == "auto":
+                cls.auto[0] += 1
+                rid = f"auto-{cls.auto[0]}"
+            key = (r["class"], rid)
+            if cls._visible(key, None) is not None:
+                raise _FaunaErr("instance already exists")
+            cls.instances.setdefault(key, []).append(
+                (now, dict(x["params"]["data"])))
+            return {"ref": {"class": r["class"], "id": rid}}
+        if "update" in x:
+            r = x["update"]["ref"]
+            key = (r["class"], r["id"])
+            cur = cls._visible(key, None)
+            if cur is None:
+                raise _FaunaErr("instance not found")
+            cls.instances[key].append((now, {**cur,
+                                             **x["params"]["data"]}))
+            return x["update"]
+        if "upsert" in x:
+            r = x["upsert"]["ref"]
+            key = (r["class"], r["id"])
+            cls.instances.setdefault(key, []).append(
+                (now, dict(x["params"]["data"])))
+            return x["upsert"]
+        if "match" in x:
+            out = []
+            for (kcls, _rid), _versions in sorted(cls.instances.items()):
+                if kcls != x["match"]:
+                    continue
+                data = cls._visible((kcls, _rid), snap)
+                if data is None:
+                    continue
+                if "term" in x and data.get("key") != x["term"]:
+                    continue
+                out.append({"value": data.get("value")})
+            return out
+        if "inc" in x:
+            r = x["inc"]["ref"]
+            key = (r["class"], r["id"])
+            cur = cls._visible(key, None)
+            if cur is None:
+                cls.instances.setdefault(key, []).append(
+                    (now, {"value": 1}))
+                return [now, 0]
+            v = cur["value"]
+            cls.instances[key].append((now, {**cur, "value": v + 1}))
+            return [now, v]
+        if "transfer" in x:
+            t = x["transfer"]
+            src = (t["class"], t["from"])
+            dst = (t["class"], t["to"])
+            a, b = cls._visible(src, None), cls._visible(dst, None)
+            if a is None or b is None:
+                raise _FaunaErr("instance not found")
+            if a["balance"] - t["amount"] < 0:
+                raise _FaunaErr("transaction aborted")
+            cls.instances[src].append(
+                (now, {**a, "balance": a["balance"] - t["amount"]}))
+            cls.instances[dst].append(
+                (now, {**b, "balance": b["balance"] + t["amount"]}))
+            return None
+        raise _FaunaErr(f"unsupported expression {list(x)[:3]}")
+
+    def do_POST(self):
+        body = json.loads(
+            self.rfile.read(int(self.headers.get("Content-Length") or 0)))
+        with self.lock:
+            now = self._ts()
+            try:
+                res = {"resource": self._eval(body, now, None)}
+            except _FaunaErr as e:
+                res = {"errors": [{"code": e.code,
+                                   "description": str(e)}]}
+        out = json.dumps(res).encode()
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(out)))
+        self.end_headers()
+        self.wfile.write(out)
+
+
+class _FaunaErr(Exception):
+    @property
+    def code(self):
+        return str(self)
+
+
+class TestFaunaSuite:
+    @pytest.fixture()
+    def fauna(self, monkeypatch):
+        from jepsen_tpu.suites import faunadb as fdb
+
+        FaunaStub.reset()
+        srv = ThreadingHTTPServer(("127.0.0.1", 0), FaunaStub)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        monkeypatch.setattr(fdb, "PORT", srv.server_address[1])
+        yield fdb
+        srv.shutdown()
+        srv.server_close()
+
+    def _run(self, fdb, tmp_path, workload, opts=None, concurrency=4):
+        test = dict(noop_test())
+        wl = fdb.WORKLOADS[workload](dict(opts or {}))
+        test.update(
+            name=f"faunadb-{workload}-stub",
+            nodes=["127.0.0.1"],
+            concurrency=concurrency,
+            **{"store-root": str(tmp_path)},
+            **{k: v for k, v in wl.items()
+               if k not in ("generator", "final-generator")},
+        )
+        g = wl["generator"]
+        if workload == "bank":
+            # wbank.test's generator is unbounded (the suite's
+            # std_generator time-limits it in test_fn).
+            g = gen.clients(gen.limit(int((opts or {}).get("ops") or 40),
+                                      g))
+        if wl.get("final-generator") is not None:
+            g = gen.phases(g, wl["final-generator"])
+        test["generator"] = g
+        return core.run(test)
+
+    def test_bank_against_stub(self, fauna, tmp_path):
+        res = self._run(fauna, tmp_path, "bank", {"ops": 60})
+        assert res["results"]["valid"] is True, res["results"]
+        reads = [op for op in res["history"]
+                 if op.f == "read" and op.type == "ok"]
+        assert reads and all(
+            sum(v for v in op.value.values() if v is not None) == 100
+            for op in reads)
+
+    def test_set_against_stub(self, fauna, tmp_path):
+        res = self._run(fauna, tmp_path, "set",
+                        {"ops": 60, "strong_read": True,
+                         "serialized_indices": True})
+        assert res["results"]["valid"] is True, res["results"]
+
+    def test_pages_against_stub(self, fauna, tmp_path):
+        res = self._run(fauna, tmp_path, "pages",
+                        {"keys": 2, "ops_per_key": 16})
+        assert res["results"]["valid"] is True, res["results"]
+        assert res["results"]["pages"]["results"], "no keys checked"
+
+    def test_monotonic_against_stub(self, fauna, tmp_path):
+        res = self._run(fauna, tmp_path, "monotonic", {"ops": 80})
+        assert res["results"]["valid"] is True, res["results"]
+        ra = [op for op in res["history"]
+              if op.f == "read-at" and op.type == "ok"]
+        assert ra, "no snapshot reads executed"
+
+    def test_multimonotonic_against_stub(self, fauna, tmp_path):
+        res = self._run(fauna, tmp_path, "multimonotonic",
+                        {"ops": 60, "registers": 2}, concurrency=4)
+        assert res["results"]["valid"] is True, res["results"]
+
+    def test_pages_checker_catches_torn_groups(self):
+        """A read observing part of a group must fail (pages.clj
+        read-errs semantics)."""
+        from jepsen_tpu.history import History, Op
+        from jepsen_tpu.independent import KV
+        from jepsen_tpu.suites.faunadb import pages_checker
+
+        def o(typ, p, f, value):
+            return Op.from_dict({"type": typ, "process": p, "f": f,
+                                 "value": value, "time": 0})
+
+        rows = History([
+            o("invoke", 0, "add", KV(0, [1, 2])),
+            o("ok", 0, "add", KV(0, [1, 2])),
+            o("invoke", 1, "read", None),
+            o("ok", 1, "read", KV(0, [1])),
+        ], reindex=True)
+        res = pages_checker().check({}, rows, {})
+        assert res["valid"] is False
+        assert res["error_count"] == 1
+
+    def test_topology_nemesis_grudges(self):
+        from jepsen_tpu.suites import faunadb as fdb
+
+        test = {"nodes": [f"n{i}" for i in range(1, 7)], "replicas": 3}
+        topo = fdb.initial_topology(test)
+        assert topo["replica-count"] == 3
+        by = fdb._by_replica(topo)
+        assert len(by) == 3 and all(len(v) == 2 for v in by.values())
+        g = fdb.inter_replica_grudge(topo)
+        # one replica (2 nodes) cut from the other 4
+        sizes = sorted(len(v) for v in g.values())
+        assert sizes == [2, 2, 2, 2, 4, 4], g
+        g2 = fdb.intra_replica_grudge(topo)
+        assert g2, "intra-replica grudge empty"
+        g3 = fdb.single_node_grudge(topo)
+        lonely = [n for n, cut in g3.items() if len(cut) == 5]
+        assert len(lonely) == 1
+
+    def test_db_commands(self):
+        from jepsen_tpu.suites import faunadb as fdb
+
+        test = dict(noop_test())
+        test["nodes"] = ["n1", "n2", "n3"]
+        test["replicas"] = 3
+        log: list = []
+        c.setup_sessions(test, c.dummy(log, responses={
+            r"mktemp": "/tmp/jepsen.x\n"}))
+        db = fdb.FaunaDB()
+        try:
+            c.on_nodes(test, lambda t, n: db.setup(t, n), ["n1"])
+        except Exception:
+            pass
+        cmds = [cmd for _n, cmd in log]
+        assert any("faunadb.yml" in cmd for cmd in cmds), cmds[:5]
+        assert any("service faunadb start" in cmd for cmd in cmds)

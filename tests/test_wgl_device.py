@@ -269,6 +269,41 @@ class TestDiskCheckpoint:
                                        optimistic=False)
         assert res["valid"] == want  # not poisoned into 'unknown'
 
+    def test_device_refutation_carries_stuck_configs(self):
+        """A device-kernel False verdict includes the final frontier's
+        configurations with per-op reasons (the linear.svg seam)."""
+        import random
+
+        from jepsen_tpu.models import CasRegister
+        from jepsen_tpu.ops import wgl
+        from jepsen_tpu.ops.encode import encode_history
+        from jepsen_tpu.testing import (perturb_history,
+                                        random_register_history)
+
+        model = CasRegister(init=0)
+        rng = random.Random(3)
+        seen = 0
+        for _ in range(30):
+            h = perturb_history(rng, random_register_history(
+                rng, n_ops=40, n_procs=4, cas=True, crash_p=0.08))
+            enc = encode_history(model, h)
+            res = wgl.check_encoded_device(enc, optimistic=False)
+            if res["valid"] is not False:
+                continue
+            seen += 1
+            stuck = res.get("stuck_configs")
+            assert stuck, res
+            for cfg in stuck:
+                # Device BFS levels count BOTH determinate and open
+                # linearizations, one per level.
+                assert len(cfg["linearized"]) == res["max_linearized"], (
+                    cfg, res)
+                assert cfg["pending"] and all(
+                    p.get("why") for p in cfg["pending"])
+            if seen >= 3:
+                break
+        assert seen >= 2
+
     def test_wide_lossless_companion_dropped_not_crashed(self, tmp_path):
         """A lossless_fr WIDER than the resuming run's top capacity (the
         beam de-escalated after truncating at a larger F) cannot seed any

@@ -82,6 +82,74 @@ class TestNativeDifferential:
         assert nat is not None and nat["valid"] in (True, False, "unknown")
         assert dt < 60, dt
 
+    def test_dominance_memo_crash_heavy(self):
+        """The DFS memo prunes by open-subset dominance (a config whose
+        open-set contains an explored config's with equal (p, win,
+        state) is subsumed). Crash-heavy histories exercise the
+        antichain paths hard — verdicts must still match the oracle,
+        and refutations must not blow up in explored-config count."""
+        model = CasRegister(init=0)
+        rng = random.Random(31)
+        invalids = 0
+        for i in range(30):
+            h = random_register_history(
+                rng, n_ops=60, n_procs=5, cas=True,
+                crash_p=rng.choice([0.2, 0.35]))
+            if i % 2:
+                h = perturb_history(rng, h)
+            host = wgl_host.check_history_host(
+                model, h, max_configs=3_000_000)
+            if host["valid"] == "unknown":
+                continue
+            nat = wgl_c.check_history_native(model, h)
+            assert nat is not None
+            assert nat["valid"] == host["valid"], (i, nat, host)
+            if host["valid"] is False:
+                invalids += 1
+                # The whole point: refutation must not enumerate the
+                # open-subset powerset the exact memo had to.
+                assert nat["configs_explored"] < 2_000_000, nat
+        assert invalids >= 3
+
+    def test_refutation_witness(self):
+        """A False verdict carries stuck_configs: the deepest
+        configurations with per-op reasons — consistent with the host
+        oracle's refutation shape (the linear.svg seam,
+        checker.clj:202-209)."""
+        model = CasRegister(init=0)
+        rng = random.Random(12)
+        seen = 0
+        for _ in range(40):
+            h = perturb_history(rng, random_register_history(
+                rng, n_ops=50, n_procs=4, cas=True, crash_p=0.1))
+            nat = wgl_c.check_history_native(model, h)
+            if nat is None or nat["valid"] is not False:
+                continue
+            seen += 1
+            host = wgl_host.check_history_host(model, h)
+            assert host["valid"] is False
+            stuck = nat.get("stuck_configs")
+            assert stuck, nat
+            from jepsen_tpu.ops.encode import encode_history
+
+            enc = encode_history(model, h)
+            for cfg in stuck:
+                # The witness depth matches the engine's own max
+                # (max_linearized counts DETERMINATE ops; the witness
+                # set additionally lists linearized opens).
+                det_lin = [r for r in cfg["linearized"]
+                           if not enc.skippable[r]]
+                assert len(det_lin) == nat["max_linearized"], (cfg, nat)
+                assert cfg["pending"], cfg
+                # Every pending op carries a reason it cannot extend
+                # the linearization.
+                assert all(
+                    "real-time" in p["why"] or "model rejects" in p["why"]
+                    or "explored" in p["why"] for p in cfg["pending"])
+            if seen >= 5:
+                break
+        assert seen >= 3
+
     def test_wide_open_sets(self):
         """nO past one word: the multi-word open set. Construction-valid
         histories must accept; DFS and BFS (independent algorithms over
