@@ -30,18 +30,17 @@ from __future__ import annotations
 
 from typing import Iterable, Optional
 
-import numpy as np
 
 from .graph import (
     DepGraph,
     RW,
     WR,
     WW,
-    closure_host,
-    closures_device,
-    find_cycle_host,
-    find_cycle_with_edge_host,
-    sccs_host,
+    SccReach,
+    find_cycle_lists,
+    find_cycle_with_edge_lists,
+    sccs_lists,
+    succ_lists,
 )
 
 # Umbrella expansion (cycle/wr.clj:44-45).
@@ -55,7 +54,7 @@ _EXPANSION = {
 DEFAULT_ANOMALIES = ("G1", "G2", "internal")
 
 # Device closures pay off once the matmul amortizes dispatch; below this
-# txn count the numpy closure wins.
+# SCC size the host BFS wins.
 DEVICE_MIN_TXNS = 512
 
 
@@ -69,51 +68,72 @@ def expand_anomalies(anomalies: Iterable[str]) -> set:
 def cycle_anomalies(g: DepGraph, device: Optional[bool] = None) -> dict:
     """Classify cycles in a typed dependency graph. Returns
     {anomaly-type: [witness]} where a witness is {"cycle": [txn indices],
-    "kinds": [edge kinds along it]}."""
+    "kinds": [edge kinds along it]}.
+
+    SCC-condensed design (replaces the r2 dense n×n closure, whose
+    O(n²) memory capped histories near 8k txns): the taxonomy's closure
+    consumers are all EDGE-ENDPOINT reachability queries, and any
+    qualifying path + its closing edge is a cycle — so it lies within
+    one strongly connected component. Tarjan (O(V+E)) finds the
+    components per mask; valid histories short-circuit with no cycles
+    at all; queries inside large components run as ONE dense bf16 MXU
+    closure of the component-induced subgraph (memory bounded by the
+    largest SCC, not the history). ``device``: None = auto (MXU for
+    components ≥ DEVICE_MIN_TXNS), False = host BFS only."""
     n = g.n
     if n == 0 or not g.edges:
         return {}
-    adj = g.adjacency()
-    if device is None:
-        device = n >= DEVICE_MIN_TXNS
-    if device:
-        has_ww, has_wwr, has_full, c_wwr, c_full = closures_device(adj)
-    else:
-        c_ww = closure_host(adj, WW)
-        c_wwr = closure_host(adj, WW | WR)
-        c_full = closure_host(adj, 0xFF)
-        has_ww = bool(np.diag(c_ww).any())
-        has_wwr = bool(np.diag(c_wwr).any())
-        has_full = bool(np.diag(c_full).any())
+    use_device = device if device is not None else True
+    succ_ww = succ_lists(g.edges, n, WW)
+    succ_wwr = succ_lists(g.edges, n, WW | WR)
+    succ_full = succ_lists(g.edges, n, 0xFF)
+    ww_sccs = sccs_lists(succ_ww)
+    wwr_sccs = sccs_lists(succ_wwr)
+    full_sccs = sccs_lists(succ_full)
 
     out: dict = {}
+    if ww_sccs:
+        cyc = find_cycle_lists(succ_ww, ww_sccs[0])
+        if cyc:
+            out.setdefault("G0", []).append(_witness(g, cyc))
 
-    if has_ww:
-        for scc in sccs_host(adj, WW):
-            cyc = find_cycle_host(adj, WW, scc)
+    # G1c: a wr edge (a,b) on a ww|wr cycle <=> a,b in one wwr-SCC (the
+    # edge itself closes the loop).
+    wwr_comp: dict = {}
+    for ci, comp in enumerate(wwr_sccs):
+        for v in comp:
+            wwr_comp[v] = ci
+    for (a, b), kind in sorted(g.edges.items()):
+        if kind & WR and wwr_comp.get(a) is not None \
+                and wwr_comp.get(a) == wwr_comp.get(b):
+            cyc = find_cycle_with_edge_lists(succ_wwr, a, b)
             if cyc:
-                out.setdefault("G0", []).append(_witness(g, cyc))
+                out.setdefault("G1c", []).append(_witness(g, cyc))
                 break
-    if has_wwr:
-        # A G1c witness must use >= 1 wr edge.
-        srcs, dsts = np.nonzero((adj & WR) > 0)
-        for a, b in zip(srcs.tolist(), dsts.tolist()):
-            if c_wwr[b, a]:
-                cyc = find_cycle_with_edge_host(adj, WW | WR, a, b)
-                if cyc:
-                    out.setdefault("G1c", []).append(_witness(g, cyc))
-                    break
-    # rw-closing cycles.
-    srcs, dsts = np.nonzero((adj & RW) > 0)
+
+    # rw-closing cycles. An rw edge (a,b) is:
+    # - G-single when b reaches a via ww|wr edges (that path + the rw
+    #   edge is a cycle, so it lies inside ONE full-graph SCC — the
+    #   query runs within the component);
+    # - G2 when b reaches a only with further rw edges (same full-SCC
+    #   membership, not wwr-reachable).
+    reach = SccReach(succ_wwr, full_sccs, use_device,
+                     device_min=DEVICE_MIN_TXNS)
     g_single = None
     g2 = None
-    for a, b in zip(srcs.tolist(), dsts.tolist()):
-        if g_single is None and c_wwr[b, a]:
-            cyc = find_cycle_with_edge_host(adj, WW | WR, a, b)
+    for (a, b), kind in sorted(g.edges.items()):
+        if not kind & RW:
+            continue
+        same, comp_id = reach.same_comp(a, b)
+        if not same:
+            continue
+        wwr_back = reach.query(comp_id, b, a)
+        if g_single is None and wwr_back:
+            cyc = find_cycle_with_edge_lists(succ_wwr, a, b)
             if cyc:
                 g_single = _witness(g, cyc)
-        if g2 is None and has_full and c_full[b, a] and not c_wwr[b, a]:
-            cyc = find_cycle_with_edge_host(adj, 0xFF, a, b)
+        if g2 is None and not wwr_back:
+            cyc = find_cycle_with_edge_lists(succ_full, a, b)
             if cyc:
                 g2 = _witness(g, cyc)
         if g_single is not None and g2 is not None:

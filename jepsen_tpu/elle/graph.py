@@ -58,11 +58,33 @@ class DepGraph:
 # Host oracle: Tarjan SCC + witness cycles
 
 
+def succ_lists(edges: dict, n: int, mask: int) -> list[list[int]]:
+    """Adjacency lists of the masked subgraph straight from the edge
+    dict — O(V+E), no dense n x n materialization (the memory wall on
+    long histories)."""
+    succ: list[list[int]] = [[] for _ in range(n)]
+    for (s, d), kind in edges.items():
+        if kind & mask:
+            succ[s].append(d)
+    return succ
+
+
+def sccs_lists(succ: list[list[int]]) -> list[list[int]]:
+    """Nontrivial strongly connected components over adjacency lists —
+    iterative Tarjan, O(V+E)."""
+    return _tarjan(succ)
+
+
 def sccs_host(adj: np.ndarray, mask: int = 0xFF) -> list[list[int]]:
     """Strongly connected components (size > 1, or self-loop) of the
     subgraph with edge kinds in ``mask``. Iterative Tarjan."""
     n = adj.shape[0]
     succ = [np.flatnonzero(adj[i] & mask).tolist() for i in range(n)]
+    return _tarjan(succ)
+
+
+def _tarjan(succ: list[list[int]]) -> list[list[int]]:
+    n = len(succ)
     index = [-1] * n
     low = [0] * n
     on_stack = [False] * n
@@ -109,34 +131,18 @@ def sccs_host(adj: np.ndarray, mask: int = 0xFF) -> list[list[int]]:
     return out
 
 
+def _succ_from_dense(adj: np.ndarray, mask: int) -> list[list[int]]:
+    return [np.flatnonzero(adj[i] & mask).tolist()
+            for i in range(adj.shape[0])]
+
+
 def find_cycle_host(adj: np.ndarray, mask: int, scc: Iterable[int]
                     ) -> Optional[list[int]]:
     """A concrete cycle within ``scc`` using only ``mask`` edges (BFS from
     each node back to itself); None if none exists. Returns node list
-    ``[a, b, …, a]``."""
-    nodes = set(int(x) for x in scc)
-    for start in sorted(nodes):
-        prev = {start: None}
-        frontier = [start]
-        while frontier:
-            nxt = []
-            for v in frontier:
-                for w in np.flatnonzero(adj[v] & mask):
-                    w = int(w)
-                    if w == start:
-                        # Reconstruct start → … → v → start.
-                        path = []
-                        node = v
-                        while node is not None:
-                            path.append(node)
-                            node = prev[node]
-                        path.reverse()  # [start, ..., v]
-                        return _normalize_cycle(path)
-                    if w in nodes and w not in prev:
-                        prev[w] = v
-                        nxt.append(w)
-            frontier = nxt
-    return None
+    ``[a, b, …, a]``. Dense-adjacency front end of
+    :func:`find_cycle_lists`."""
+    return find_cycle_lists(_succ_from_dense(adj, mask), scc)
 
 
 def _normalize_cycle(path: list[int]) -> list[int]:
@@ -148,30 +154,179 @@ def _normalize_cycle(path: list[int]) -> list[int]:
 def find_cycle_with_edge_host(adj: np.ndarray, back_mask: int,
                               rw_src: int, rw_dst: int) -> Optional[list[int]]:
     """A cycle that takes the single edge rw_src→rw_dst then returns to
-    rw_src via ``back_mask`` edges only (G-single witness)."""
-    n = adj.shape[0]
+    rw_src via ``back_mask`` edges only (G-single witness). Dense front
+    end of :func:`find_cycle_with_edge_lists`."""
+    return find_cycle_with_edge_lists(
+        _succ_from_dense(adj, back_mask), rw_src, rw_dst)
+
+
+def find_cycle_lists(succ: list[list[int]], scc: Iterable[int]
+                     ) -> Optional[list[int]]:
+    """List-based twin of :func:`find_cycle_host` (BFS within scc)."""
+    nodes = set(int(x) for x in scc)
+    for start in sorted(nodes):
+        prev = {start: None}
+        frontier = [start]
+        while frontier:
+            nxt = []
+            for v in frontier:
+                for w in succ[v]:
+                    if w == start:
+                        path = []
+                        node = v
+                        while node is not None:
+                            path.append(node)
+                            node = prev[node]
+                        path.reverse()
+                        return _normalize_cycle(path)
+                    if w in nodes and w not in prev:
+                        prev[w] = v
+                        nxt.append(w)
+            frontier = nxt
+    return None
+
+
+def find_cycle_with_edge_lists(succ: list[list[int]], rw_src: int,
+                               rw_dst: int) -> Optional[list[int]]:
+    """List-based twin of :func:`find_cycle_with_edge_host`: a cycle
+    taking rw_src→rw_dst once, returning via ``succ`` edges."""
     prev = {rw_dst: None}
     frontier = [rw_dst]
     while frontier:
         nxt = []
         for v in frontier:
-            for w in np.flatnonzero(adj[v] & back_mask):
-                w = int(w)
+            for w in succ[v]:
                 if w == rw_src:
-                    # Reconstruct rw_dst → … → v, then close the loop
-                    # rw_src → rw_dst … v → rw_src.
                     path = []
                     node = v
                     while node is not None:
                         path.append(node)
                         node = prev[node]
-                    path.reverse()  # [rw_dst, ..., v]
+                    path.reverse()
                     return _normalize_cycle([rw_src, *path])
                 if w not in prev:
                     prev[w] = v
                     nxt.append(w)
         frontier = nxt
     return None
+
+
+class SccReach:
+    """Reachability queries within the strongly connected components of
+    the FULL graph, over a (sub-)mask's edges — the only closure
+    consumers in the anomaly taxonomy are edge-endpoint queries, and any
+    qualifying path lies inside one full-graph SCC (the closing edge
+    makes it a cycle). Memory is bounded by the LARGEST SCC, never n².
+
+    Small components — and the first few queries of any component —
+    answer by cached host BFS (O(E) each); once a component of at least
+    ``device_min`` nodes has absorbed several distinct-source queries,
+    it computes ONE dense bf16 MXU closure of the induced subgraph.
+    The dense matrix is BUILT ON DEVICE from the (tiny) edge arrays and
+    the closure stays device-resident with per-query scalar reads — on
+    a tunneled TPU, shipping a 4096² matrix each way costs ~5 s while
+    the matmuls cost milliseconds."""
+
+    # Distinct BFS sources a big component absorbs before the closure
+    # pays for itself (each BFS is O(E); the closure answers all later
+    # queries in one scalar read).
+    BFS_BEFORE_CLOSURE = 8
+
+    def __init__(self, succ: list[list[int]], sccs: list[list[int]],
+                 device: bool, device_min: int = 512):
+        self.succ = succ
+        self.sccs = sccs
+        self.device = device
+        self.device_min = device_min
+        self.node_comp: dict = {}
+        for ci, comp in enumerate(sccs):
+            for v in comp:
+                self.node_comp[v] = ci
+        self._bfs_cache: dict = {}
+        self._bfs_sources: dict = {}  # comp_id -> distinct-source count
+        self._closures: dict = {}
+
+    def same_comp(self, a: int, b: int):
+        ca = self.node_comp.get(a)
+        return ca is not None and ca == self.node_comp.get(b), ca
+
+    def query(self, comp_id: int, src: int, dst: int) -> bool:
+        """Is there a ``succ``-path src→dst inside component comp_id?"""
+        comp = self.sccs[comp_id]
+        if comp_id in self._closures or (
+                self.device and len(comp) >= self.device_min
+                and self._bfs_sources.get(comp_id, 0)
+                >= self.BFS_BEFORE_CLOSURE):
+            cl, local = self._closure(comp_id)
+            return bool(np.asarray(cl[local[src], local[dst]]))
+        key = (comp_id, src)
+        reach = self._bfs_cache.get(key)
+        if reach is None:
+            nodes = set(comp)
+            reach = set()
+            frontier = [src]
+            while frontier:
+                nxt = []
+                for v in frontier:
+                    for w in self.succ[v]:
+                        if w in nodes and w not in reach:
+                            reach.add(w)
+                            nxt.append(w)
+                frontier = nxt
+            self._bfs_cache[key] = reach
+            self._bfs_sources[comp_id] = \
+                self._bfs_sources.get(comp_id, 0) + 1
+        return dst in reach
+
+    def _closure(self, comp_id: int):
+        hit = self._closures.get(comp_id)
+        if hit is not None:
+            return hit
+        comp = sorted(self.sccs[comp_id])
+        local = {v: i for i, v in enumerate(comp)}
+        s = len(comp)
+        pad = max(128, 1 << (s - 1).bit_length())
+        srcs, dsts = [], []
+        for i, v in enumerate(comp):
+            for w in self.succ[v]:
+                j = local.get(w)
+                if j is not None:
+                    srcs.append(i)
+                    dsts.append(j)
+        ne = max(len(srcs), 1)
+        epad = 1 << (ne - 1).bit_length()
+        # Padding edges write to the sacrificial row/col `pad` (sliced
+        # off inside the kernel), so edge-count buckets share programs.
+        srcs = np.asarray(srcs + [pad] * (epad - len(srcs)), np.int32)
+        dsts = np.asarray(dsts + [pad] * (epad - len(dsts)), np.int32)
+        cl = _closure_from_edges_kernel(pad, epad)(srcs, dsts)
+        self._closures[comp_id] = (cl, local)
+        return cl, local
+
+
+@functools.lru_cache(maxsize=16)
+def _closure_from_edges_kernel(n: int, epad: int):
+    """Transitive closure on the MXU from edge-index arrays (bf16
+    squaring — see the note on _build_closures_kernel for why bf16 is
+    sound). Input: [epad] src/dst arrays padded with ``n``; output: the
+    [n, n] bool closure, LEFT ON DEVICE (callers read single entries —
+    the matrices never cross the relay)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    def close(src, dst):
+        a = jnp.zeros((n + 1, n + 1), jnp.bfloat16)
+        a = a.at[src, dst].set(jnp.bfloat16(1.0))[:n, :n]
+
+        def step(a, _):
+            return jnp.minimum(a + a @ a, jnp.bfloat16(1.0)), None
+
+        steps = max(1, int(np.ceil(np.log2(max(n, 2)))))
+        a, _ = lax.scan(step, a, None, length=steps)
+        return a > 0
+
+    return jax.jit(close)
 
 
 def closure_host(adj: np.ndarray, mask: int) -> np.ndarray:

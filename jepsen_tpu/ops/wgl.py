@@ -847,10 +847,13 @@ def plan_device(
 # before the exhaustive search: large valid histories' frontiers spike to
 # tens of thousands of configs, while a width-OPTIMISTIC_BEAM_F beam that
 # keeps the most-advanced, fewest-opens-used configs finds the accepting
-# path ~3x faster (measured on the 10k-op north-star history). Accepts
-# under truncation are sound; anything else falls back to the full search.
+# path much faster. Accepts under truncation are sound; anything else
+# falls back to the full search. Width sweep on the 10k-op north-star
+# history (steady, v5e): 8192 -> 23.2s, 4096 -> 13.2s (beam still
+# accepts), 2048 -> beam fails and the exhaustive fallback pays ~200s —
+# 4096 is the sweet spot.
 OPTIMISTIC_MIN_OPS = 1500
-OPTIMISTIC_BEAM_F = 8192
+OPTIMISTIC_BEAM_F = 4096
 
 
 def _enc_fingerprint(enc: EncodedHistory, plan: DevicePlan) -> str:
