@@ -157,11 +157,16 @@ def stop_daemon(pidfile: str, bin: Optional[str] = None) -> None:
 
 
 def grepkill(pattern: str, signal: Any = 9) -> None:
-    """Kill processes matching a pattern (control/util.clj:235-241)."""
+    """Kill processes matching a pattern (control/util.clj:235-241).
+
+    ``ww`` is load-bearing: with a narrow COLUMNS exported in the
+    executing environment, procps truncates each line EVEN WHEN PIPED,
+    silently hiding matches past the cut — grepkill then no-ops while
+    reporting success (caught by the ssh-subprocess integration tier)."""
     with su():
         try:
             exec_star(
-                f"ps aux | grep {escape(pattern)} | grep -v grep | "
+                f"ps auxww | grep {escape(pattern)} | grep -v grep | "
                 f"awk '{{print $2}}' | xargs -r kill -{signal}"
             )
         except RemoteError:

@@ -41,10 +41,12 @@ same shape on this framework's protocols. Roster (→ reference suite):
 - ``faunadb``    — temporal-database workloads (pages, monotonic,
   multimonotonic, bank, set) over a FaunaQL-shaped wire client, with a
   replica-topology-aware nemesis (faunadb/)
+- ``rethinkdb``  — document-level CAS over a ReQL-shaped term client,
+  with the replica/primary reconfigure nemesis (rethinkdb/)
 
-Not ported: rethinkdb/ (ReQL driver protocol), robustirc/ and logcabin/
-(niche single-file suites whose capability axes — unique messages, CLI
-register — are covered by unique-ids and register workloads above).
+Not ported: robustirc/ and logcabin/ (niche single-file suites whose
+capability axes — unique messages, CLI register — are covered by
+unique-ids and register workloads above).
 
 Each exposes ``test_fn(opts)`` and a ``main()`` wired through
 jepsen_tpu.cli; clients are exercised end-to-end in tests against

@@ -1,0 +1,343 @@
+"""RethinkDB suite: document-level compare-and-set over a ReQL-shaped
+wire client.
+
+The reference (rethinkdb/src/jepsen/rethinkdb.clj + document_cas.clj,
+529 LoC) drives a replicated document store through the ReQL term AST:
+``r.db(d).table(t, read_mode).get(id)`` rows updated with
+``branch(eq(row.val, v), {val: v'}, error("abort"))`` for CAS, insert
+with ``conflict=update`` for blind writes, and a *reconfigure* nemesis
+that reshuffles the table's replicas/primary mid-run
+(rethinkdb.clj:180-233). Checked as a keyed linearizable register —
+here on the framework's standard device/native dispatch.
+
+This port mirrors that layering:
+
+- ReQL-shaped term builders (``term(GET, [...])`` JSON arrays — the
+  shape of RethinkDB's wire AST) posted over a newline-JSON TCP
+  protocol;
+- ``DocumentCasClient`` with the reference's exact op semantics,
+  including ``write_acks``/``read_mode`` table options and the
+  "{errors: 0, replaced: 1} or :fail" CAS contract;
+- ``ReconfigureNemesis`` (rethinkdb.clj:196-233): random replica set +
+  primary, applied through the same wire protocol, composed with the
+  partitioner under distinct fs;
+- DB lifecycle: apt install + join-configured daemon
+  (rethinkdb.clj:52-96).
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+from typing import Any, Optional
+
+from .. import checker as jchecker
+from .. import cli, client as jclient, db as jdb, generator as gen
+from .. import independent as jind
+from .. import models as jmodels
+from .. import nemesis as jnemesis, net as jnet
+from ..control import util as cu
+from .. import control as c
+from . import std_generator
+
+PORT = 28015
+DB = "jepsen"
+TABLE = "cas"
+
+# Term opcodes (the wire AST's numeric tags, rethinkdb protocol shape).
+T_DB, T_TABLE, T_GET, T_GET_FIELD, T_INSERT, T_UPDATE, T_BRANCH, T_EQ, \
+    T_ERROR, T_DEFAULT, T_RECONFIGURE = range(1, 12)
+
+
+def t_db(name: str) -> list:
+    return [T_DB, [name]]
+
+
+def t_table(db: list, name: str, read_mode: str = "single",
+            write_acks: Optional[str] = None) -> list:
+    opts: dict = {"read_mode": read_mode}
+    if write_acks is not None:
+        opts["write_acks"] = write_acks
+    return [T_TABLE, [db, name], opts]
+
+
+def t_get(table: list, key: Any) -> list:
+    return [T_GET, [table, key]]
+
+
+def t_get_field(row: list, field: str) -> list:
+    return [T_GET_FIELD, [row, field]]
+
+
+def t_default(expr: list, dflt: Any) -> list:
+    return [T_DEFAULT, [expr, dflt]]
+
+
+def t_insert(table: list, doc: dict, conflict: str = "error") -> list:
+    return [T_INSERT, [table, doc], {"conflict": conflict}]
+
+
+def t_cas_update(row: list, expect: Any, new: Any) -> list:
+    """update(row, branch(eq(row.val, expect), {val: new},
+    error("abort"))) — document_cas.clj:96-106."""
+    return [T_UPDATE, [row, [T_BRANCH, [
+        [T_EQ, [[T_GET_FIELD, [None, "val"]], expect]],
+        {"val": new},
+        [T_ERROR, ["abort"]],
+    ]]]]
+
+
+def t_reconfigure(table: list, replicas: list, primary: str) -> list:
+    return [T_RECONFIGURE, [table],
+            {"replicas": replicas, "primary": primary}]
+
+
+class Reql:
+    """Newline-JSON wire client: {"term": ast} -> {"r": result} |
+    {"e": message} (the f/query seam of rethinkdb.clj:109-115)."""
+
+    def __init__(self, host: str, port: Optional[int] = None,
+                 timeout: float = 10.0):
+        if port is None:
+            port = PORT
+        self.sock = socket.create_connection((host, port), timeout=timeout)
+        self.buf = b""
+
+    def run(self, term: list) -> Any:
+        self.sock.sendall(json.dumps({"term": term}).encode() + b"\n")
+        while b"\n" not in self.buf:
+            chunk = self.sock.recv(65536)
+            if not chunk:
+                raise ConnectionError("reql connection closed")
+            self.buf += chunk
+        line, self.buf = self.buf.split(b"\n", 1)
+        res = json.loads(line.decode())
+        if "e" in res:
+            raise ReqlError(res["e"])
+        return res.get("r")
+
+    def close(self):
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+class ReqlError(RuntimeError):
+    pass
+
+
+class DocumentCasClient(jclient.Client):
+    """Register on top of an entire document (document_cas.clj:53-107);
+    keyed op values are independent.KV tuples."""
+
+    def __init__(self, conn: Optional[Reql] = None,
+                 write_acks: str = "majority", read_mode: str = "majority"):
+        self.conn = conn
+        self.write_acks = write_acks
+        self.read_mode = read_mode
+
+    def open(self, test, node):
+        return DocumentCasClient(Reql(str(node)), self.write_acks,
+                                 self.read_mode)
+
+    def _table(self):
+        return t_table(t_db(DB), TABLE, self.read_mode, self.write_acks)
+
+    def _row(self, k):
+        return t_get(self._table(), k)
+
+    def invoke(self, test, op):
+        k, v = op["value"]
+        try:
+            if op["f"] == "read":
+                val = self.conn.run(
+                    t_default(t_get_field(self._row(k), "val"), None))
+                return {**op, "type": "ok", "value": jind.tuple_(k, val)}
+            if op["f"] == "write":
+                self.conn.run(t_insert(
+                    self._table(),
+                    {"id": k, "val": v}, conflict="update"))
+                return {**op, "type": "ok"}
+            if op["f"] == "cas":
+                expect, new = v
+                res = self.conn.run(t_cas_update(self._row(k), expect, new))
+                ok = (res or {}).get("errors") == 0 and \
+                    (res or {}).get("replaced") == 1
+                return {**op, "type": "ok" if ok else "fail"}
+            raise ValueError(f"unknown f {op['f']!r}")
+        except ReqlError as e:
+            # Determinate server-side rejection; reads are idempotent
+            # (with-errors op #{:read} — rethinkdb.clj:137-163).
+            if op["f"] == "read":
+                return {**op, "type": "fail", "error": str(e)[:80]}
+            return {**op, "type": "info", "error": str(e)[:80]}
+
+    def close(self, test):
+        if self.conn is not None:
+            self.conn.close()
+
+
+class ReconfigureNemesis(jnemesis.Nemesis):
+    """Randomly reshuffles the table's replica set and primary through
+    the wire protocol (rethinkdb.clj:196-233); f=reconfigure."""
+
+    def invoke(self, test, op):
+        nodes = list(test["nodes"])
+        size = 1 + gen.rand_int(len(nodes))
+        replicas = sorted(nodes, key=lambda _: gen.rand_int(1 << 30))[:size]
+        primary = replicas[gen.rand_int(len(replicas))]
+        last_err = None
+        for target in [primary] + [n for n in nodes if n != primary]:
+            try:
+                conn = Reql(str(target))
+                try:
+                    conn.run(t_reconfigure(
+                        t_table(t_db(DB), TABLE), replicas, primary))
+                finally:
+                    conn.close()
+                return {**op, "type": "info",
+                        "value": {"replicas": replicas,
+                                  "primary": primary}}
+            except (OSError, ReqlError) as e:
+                last_err = e
+        return {**op, "type": "info", "value": f"failed: {last_err}"}
+
+
+def nemesis_and_gen(opts: dict):
+    """Partitioner + reconfigure under distinct fs, with the reference's
+    start/stop/reconfigure interleave (document_cas.clj:147-176)."""
+    interval = float(opts.get("nemesis_interval") or 5)
+    composed = jnemesis.compose({
+        frozenset(["start", "stop"]): jnemesis.partition_random_halves(),
+        frozenset(["reconfigure"]): ReconfigureNemesis(),
+    })
+    cyc = gen.cycle_([
+        gen.sleep(interval),
+        {"type": "info", "f": "start", "value": None},
+        {"type": "info", "f": "reconfigure", "value": None},
+        gen.sleep(interval),
+        {"type": "info", "f": "stop", "value": None},
+        {"type": "info", "f": "reconfigure", "value": None},
+    ])
+    return composed, cyc
+
+
+class RethinkDB(jdb.DB, jdb.Process, jdb.LogFiles):
+    """apt package + join-configured daemon (rethinkdb.clj:52-96)."""
+
+    LOG = "/var/log/rethinkdb"
+    PID = "/var/run/rethinkdb.pid"
+    CONF = "/etc/rethinkdb/instances.d/jepsen.conf"
+
+    def setup(self, test, node):
+        from ..os_ import debian
+
+        debian.install(["rethinkdb"])
+        joins = "\n".join(f"join={n}:29015" for n in test["nodes"]
+                          if n != node)
+        conf = (
+            f"bind=all\n"
+            f"server-name={str(node).replace('.', '_')}\n"
+            f"directory=/var/lib/rethinkdb/jepsen\n"
+            f"{joins}\n"
+        )
+        with c.su():
+            c.exec("mkdir", "-p", "/etc/rethinkdb/instances.d")
+            c.exec_star(
+                f"cat > {self.CONF} <<'JEPSEN_CONF'\n{conf}\nJEPSEN_CONF")
+        self.start(test, node)
+
+    def start(self, test, node):
+        with c.su():
+            cu.start_daemon(
+                {"logfile": self.LOG, "pidfile": self.PID,
+                 "chdir": "/var/lib/rethinkdb"},
+                "rethinkdb", "--config-file", self.CONF,
+            )
+
+    def kill(self, test, node):
+        cu.grepkill("rethinkdb")
+
+    def teardown(self, test, node):
+        cu.grepkill("rethinkdb")
+        with c.su():
+            c.exec("rm", "-rf", "/var/lib/rethinkdb/jepsen", self.PID)
+
+    def log_files(self, test, node):
+        return [self.LOG]
+
+
+def document_cas_workload(opts: Optional[dict] = None) -> dict:
+    """Keyed CAS register: sequential keys, 5 writer/cas threads
+    reserved, the rest read (document_cas.clj:139-156)."""
+    o = dict(opts or {})
+    per_key = int(o.get("ops_per_key") or 60)
+    n_keys = int(o.get("keys") or 4)
+    write_acks = str(o.get("write_acks") or "majority")
+    read_mode = str(o.get("read_mode") or "majority")
+
+    def w(test=None, ctx=None):
+        return {"type": "invoke", "f": "write", "value": gen.rand_int(5)}
+
+    def r(test=None, ctx=None):
+        return {"type": "invoke", "f": "read", "value": None}
+
+    def cas(test=None, ctx=None):
+        return {"type": "invoke", "f": "cas",
+                "value": [gen.rand_int(5), gen.rand_int(5)]}
+
+    def fgen(k):
+        return gen.limit(per_key,
+                         gen.reserve(5, gen.mix([w, cas]), r))
+
+    return {
+        "client": DocumentCasClient(write_acks=write_acks,
+                                    read_mode=read_mode),
+        "checker": jchecker.compose({
+            "linear": jind.checker(jchecker.linearizable(
+                model=jmodels.CasRegister(init=None))),
+            "stats": jchecker.stats(),
+        }),
+        "generator": gen.clients(jind.sequential_generator(
+            range(n_keys), fgen)),
+    }
+
+
+WORKLOADS = {"document-cas": document_cas_workload}
+
+
+def test_fn(opts: dict) -> dict:
+    name = opts.get("workload") or "document-cas"
+    wl = WORKLOADS[name](opts)
+    nem, nem_gen = nemesis_and_gen(opts)
+    test = {
+        "name": f"rethinkdb-{name}",
+        "db": RethinkDB(),
+        "net": jnet.iptables(),
+        "nemesis": nem,
+        **{k: v for k, v in wl.items() if k != "generator"},
+    }
+    test["generator"] = std_generator(
+        opts, wl["generator"], nemesis_gen=nem_gen)
+    return test
+
+
+def _add_opts(p):
+    p.add_argument("--workload", choices=sorted(WORKLOADS),
+                   default="document-cas")
+    p.add_argument("--keys", type=int, default=4)
+    p.add_argument("--ops-per-key", type=int, default=60)
+    p.add_argument("--write-acks", default="majority",
+                   choices=["single", "majority"])
+    p.add_argument("--read-mode", default="majority",
+                   choices=["single", "majority", "outdated"])
+    p.add_argument("--nemesis-interval", type=int, default=5)
+
+
+def main(argv=None):
+    cli.main_exit(cli.single_test_cmd(test_fn, add_opts=_add_opts), argv)
+
+
+if __name__ == "__main__":
+    main()

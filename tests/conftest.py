@@ -25,20 +25,30 @@ except Exception:  # pragma: no cover - jax-less environments
 
 
 def pytest_configure(config):
-    """Test-tier selector (the reference excludes :perf by default,
+    """Test-tier selectors (the reference excludes :perf by default,
     jepsen/project.clj:35-40): perf tests assert throughput floors and
-    only run with --run-perf."""
+    only run with --run-perf; integration tests need real external
+    processes (an sshd, a docker daemon) and only run with
+    --run-integration."""
     config.addinivalue_line("markers", "perf: throughput-floor tests")
+    config.addinivalue_line(
+        "markers", "integration: tests driving real external processes")
 
 
 def pytest_addoption(parser):
     parser.addoption("--run-perf", action="store_true", default=False)
+    parser.addoption("--run-integration", action="store_true",
+                     default=False)
 
 
 def pytest_collection_modifyitems(config, items):
     import pytest as _pytest
 
     skip_perf = _pytest.mark.skip(reason="needs --run-perf")
+    skip_int = _pytest.mark.skip(reason="needs --run-integration")
     for item in items:
         if "perf" in item.keywords and not config.getoption("--run-perf"):
             item.add_marker(skip_perf)
+        if "integration" in item.keywords and not config.getoption(
+                "--run-integration"):
+            item.add_marker(skip_int)

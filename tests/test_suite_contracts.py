@@ -17,8 +17,8 @@ from jepsen_tpu.workloads import noop_test
 SUITES = [
     "aerospike", "chronos", "cockroachdb", "consul", "crate", "dgraph",
     "elasticsearch", "etcd", "faunadb", "hazelcast", "ignite", "mongodb",
-    "mysql", "postgres", "rabbitmq", "raftis", "redis", "stolon", "tidb",
-    "yugabyte", "zookeeper",
+    "mysql", "postgres", "rabbitmq", "raftis", "redis", "rethinkdb",
+    "stolon", "tidb", "yugabyte", "zookeeper",
 ]
 
 

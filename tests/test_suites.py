@@ -1788,3 +1788,156 @@ class TestFaunaSuite:
         cmds = [cmd for _n, cmd in log]
         assert any("faunadb.yml" in cmd for cmd in cmds), cmds[:5]
         assert any("service faunadb start" in cmd for cmd in cmds)
+
+
+class ReqlStub:
+    """In-process document-store stub for the ReQL-shaped term protocol:
+    atomic per-document ops under one lock — a correct (linearizable)
+    store, so the keyed register checker must accept."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.tables: dict = {}  # (db, tbl) -> {id: doc}
+        self.config: dict = {}
+
+    def eval(self, t):
+        from jepsen_tpu.suites import rethinkdb as rdb
+
+        if not isinstance(t, list):
+            return t
+        op, args = t[0], t[1]
+        opts = t[2] if len(t) > 2 else {}
+        if op == rdb.T_DB:
+            return ("db", args[0])
+        if op == rdb.T_TABLE:
+            db = self.eval(args[0])
+            return ("table", db[1], args[1])
+        if op == rdb.T_GET:
+            table = self.eval(args[0])
+            docs = self.tables.setdefault(table[1:], {})
+            return ("row", table[1:], args[1])
+        if op == rdb.T_GET_FIELD:
+            row = self.eval(args[0])
+            doc = self.tables.get(row[1], {}).get(row[2])
+            if doc is None:
+                raise KeyError("missing")
+            return doc[args[1]]
+        if op == rdb.T_DEFAULT:
+            try:
+                return self.eval(args[0])
+            except KeyError:
+                return args[1]
+        if op == rdb.T_INSERT:
+            table = self.eval(args[0])
+            doc = dict(args[1])
+            docs = self.tables.setdefault(table[1:], {})
+            if doc["id"] in docs and opts.get("conflict") != "update":
+                raise RuntimeError("duplicate primary key")
+            docs[doc["id"]] = {**docs.get(doc["id"], {}), **doc}
+            return {"inserted": 1, "errors": 0}
+        if op == rdb.T_UPDATE:
+            row = self.eval(args[0])
+            doc = self.tables.get(row[1], {}).get(row[2])
+            branch = args[1]
+            # branch(eq(row.val, expect), {val new}, error)
+            _, (eq_t, new_doc, _err) = branch[0], branch[1]
+            expect = eq_t[1][1]
+            if doc is not None and doc.get("val") == expect:
+                doc.update(new_doc)
+                return {"errors": 0, "replaced": 1}
+            return {"errors": 0 if doc is not None else 1,
+                    "replaced": 0, "unchanged": 1}
+        if op == rdb.T_RECONFIGURE:
+            table = self.eval(args[0])
+            self.config[table[1:]] = dict(opts)
+            return {"reconfigured": 1}
+        raise RuntimeError(f"unsupported term {op}")
+
+    def serve(self, sock):
+        buf = b""
+        while True:
+            while b"\n" not in buf:
+                chunk = sock.recv(65536)
+                if not chunk:
+                    return
+                buf += chunk
+            line, buf = buf.split(b"\n", 1)
+            req = json.loads(line.decode())
+            with self.lock:
+                try:
+                    out = {"r": self.eval(req["term"])}
+                except Exception as e:  # noqa: BLE001
+                    out = {"e": f"{type(e).__name__}: {e}"}
+            sock.sendall(json.dumps(out).encode() + b"\n")
+
+
+class TestRethinkSuite:
+    @pytest.fixture()
+    def reql(self, monkeypatch):
+        import socketserver
+
+        from jepsen_tpu.suites import rethinkdb as rdb
+
+        stub = ReqlStub()
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                stub.serve(self.request)
+
+        srv = socketserver.ThreadingTCPServer(("127.0.0.1", 0), Handler)
+        srv.daemon_threads = True
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        monkeypatch.setattr(rdb, "PORT", srv.server_address[1])
+        yield rdb, stub
+        srv.shutdown()
+        srv.server_close()
+
+    def test_document_cas_against_stub(self, reql, tmp_path):
+        rdb, _stub = reql
+        test = dict(noop_test())
+        wl = rdb.WORKLOADS["document-cas"](
+            {"keys": 2, "ops_per_key": 24})
+        test.update(
+            name="rethinkdb-stub",
+            nodes=["127.0.0.1"],
+            concurrency=6,
+            **{"store-root": str(tmp_path)},
+            **{k: v for k, v in wl.items() if k != "generator"},
+        )
+        test["generator"] = wl["generator"]
+        res = core.run(test)
+        assert res["results"]["valid"] is True, res["results"]
+        # CAS ops actually succeeded sometimes (the wire contract
+        # {errors: 0, replaced: 1} decodes ok).
+        cas_ok = [op for op in res["history"]
+                  if op.f == "cas" and op.type == "ok"]
+        assert cas_ok, "no successful cas through the stub"
+
+    def test_reconfigure_nemesis_against_stub(self, reql):
+        rdb, stub = reql
+        nem = rdb.ReconfigureNemesis()
+        test = {"nodes": ["127.0.0.1"]}
+        op = {"type": "info", "f": "reconfigure", "process": "nemesis"}
+        out = nem.invoke(test, op)
+        assert out["type"] == "info"
+        assert out["value"]["primary"] == "127.0.0.1"
+        assert stub.config, "reconfigure never reached the server"
+
+    def test_db_commands(self):
+        from jepsen_tpu.suites import rethinkdb as rdb
+
+        test = dict(noop_test())
+        test["nodes"] = ["n1", "n2", "n3"]
+        log: list = []
+        c.setup_sessions(test, c.dummy(log, responses={
+            r"mktemp": "/tmp/jepsen.x\n"}))
+        db = rdb.RethinkDB()
+        try:
+            c.on_nodes(test, lambda t, n: db.setup(t, n), ["n1"])
+        except Exception:
+            pass
+        cmds = [cmd for _n, cmd in log]
+        assert any("instances.d/jepsen.conf" in cmd for cmd in cmds)
+        assert any("join=n2:29015" in cmd for cmd in cmds)
+        assert any("rethinkdb" in cmd and "--config-file" in cmd
+                   for cmd in cmds)
