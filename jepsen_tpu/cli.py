@@ -68,7 +68,7 @@ def add_test_opts(p: argparse.ArgumentParser) -> None:
                    help="test duration in seconds, excl. setup/teardown")
     p.add_argument("--checker-backend",
                    choices=["auto", "device", "tpu", "host", "native",
-                            "sharded"],
+                            "sharded", "competition"],
                    default="auto")
     p.add_argument("--store-root", default=None,
                    help="directory for the store/ tree")

@@ -1357,6 +1357,81 @@ def check_history_device(model: Model, history: History, **kw) -> dict:
     return check_encoded_device(encode_history(model, history), **kw)
 
 
+def check_encoded_competition(enc: EncodedHistory,
+                              native_max_configs: Optional[int] = None,
+                              **kw) -> dict:
+    """Race the native C DFS against the device BFS; first DEFINITE
+    verdict wins (knossos's ``:competition`` analysis strategy, the
+    seam at checker.clj:196-200). The C search releases the GIL inside
+    the library call, so both engines genuinely run concurrently; the
+    loser is cancelled (the device driver aborts between chunks, the
+    native side's verdict is simply discarded — its budget bounds it).
+
+    Sound by construction: both engines are individually sound and
+    differentially tested; racing them only selects WHICH sound verdict
+    is returned. Covers each engine's weak case: the device kernel
+    cannot refute past its capacity schedule, the DFS can hit its
+    config budget where the beam accepts quickly."""
+    import threading
+
+    from . import wgl_c
+
+    if native_max_configs is None:
+        native_max_configs = 1_000_000 + 2_000 * enc.n
+    done = threading.Event()
+    native_res: dict = {}
+
+    def native_side():
+        try:
+            nat = wgl_c.check_encoded_native(
+                enc, max_configs=native_max_configs)
+        except Exception:  # noqa: BLE001 - the race must survive a loser
+            nat = None
+        if nat is not None:
+            native_res.update(nat)
+        if nat is not None and nat["valid"] != "unknown":
+            done.set()
+
+    t = threading.Thread(target=native_side, daemon=True)
+    t.start()
+
+    class _Lost(Exception):
+        pass
+
+    outer_cb = kw.pop("chunk_callback", None)
+
+    def cb(info):
+        if done.is_set():
+            raise _Lost()
+        if outer_cb is not None:
+            outer_cb(info)
+
+    dev: Optional[dict] = None
+    try:
+        dev = check_encoded_device(enc, chunk_callback=cb, **kw)
+    except _Lost:
+        pass
+    except Exception:  # noqa: BLE001 - the race must survive a loser:
+        pass  # a device-side failure must not discard a native verdict
+    if dev is not None and dev["valid"] != "unknown":
+        done.set()  # device crossed the line; don't wait on the DFS
+        dev["backend"] = "competition"
+        dev["engine"] = "device"
+        return dev
+    # Device lost, aborted, or unknown: take the native verdict (waiting
+    # for it if it is still searching).
+    t.join()
+    if native_res and native_res["valid"] != "unknown":
+        native_res["backend"] = "competition"
+        native_res["engine"] = "native"
+        return native_res
+    # Neither engine decided.
+    out = dev or native_res or {"valid": "unknown", "op_count": enc.n}
+    out["backend"] = "competition"
+    out.setdefault("info", "neither engine reached a definite verdict")
+    return out
+
+
 def check_history(
     model: Model,
     history: History,
@@ -1378,15 +1453,31 @@ def check_history(
       resort and differential reference.
 
     ``backend``: "auto" (native → device → python oracle), "device",
-    "native" (python-oracle fallback on unsupported shapes), or "host"
-    (the pure-python oracle ONLY — the engine of last resort and the
-    differential reference, so it must stay forcible). This is the seam
-    the Checker layer's ``:checker-backend`` option rides (BASELINE
-    dispatch story; reference seam checker.clj:49-64).
+    "native" (python-oracle fallback on unsupported shapes),
+    "competition" (native DFS raced against the device BFS, first
+    definite verdict wins — knossos's :competition strategy,
+    checker.clj:196-200), or "host" (the pure-python oracle ONLY — the
+    engine of last resort and the differential reference, so it must
+    stay forcible). This is the seam the Checker layer's
+    ``:checker-backend`` option rides (BASELINE dispatch story;
+    reference seam checker.clj:49-64).
     """
     from . import wgl_c, wgl_host
 
     enc = encode_history(model, history)
+    if backend == "competition" and model.device_capable:
+        res = check_encoded_competition(enc, **kw)
+        if res["valid"] != "unknown":
+            return res
+        host = wgl_host.check_encoded(enc, max_configs=host_max_configs)
+        if host["valid"] != "unknown":
+            host["backend"] = "host"
+            host["competition_attempt"] = {
+                k: res.get(k) for k in ("valid", "info")}
+            return host
+        return res
+    if backend == "competition":
+        backend = "auto"  # device-incapable model: same fallback chain
     if backend in ("auto", "native"):
         # Budgeted: the C memo set costs ~57 B/slot at <=75% load plus a
         # transient doubling during growth — peak memory is roughly

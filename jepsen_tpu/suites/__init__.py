@@ -43,10 +43,12 @@ same shape on this framework's protocols. Roster (→ reference suite):
   replica-topology-aware nemesis (faunadb/)
 - ``rethinkdb``  — document-level CAS over a ReQL-shaped term client,
   with the replica/primary reconfigure nemesis (rethinkdb/)
+- ``robustirc``  — unique channel-topic messages over the HTTP session
+  bridge, set-checked (robustirc/)
+- ``logcabin``   — CAS register through the TreeOps CLI over control —
+  the one suite whose client transport IS the control layer (logcabin/)
 
-Not ported: robustirc/ and logcabin/ (niche single-file suites whose
-capability axes — unique messages, CLI register — are covered by
-unique-ids and register workloads above).
+Every per-DB suite repo in the reference monorepo is now represented.
 
 Each exposes ``test_fn(opts)`` and a ``main()`` wired through
 jepsen_tpu.cli; clients are exercised end-to-end in tests against

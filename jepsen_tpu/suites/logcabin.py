@@ -1,0 +1,229 @@
+"""LogCabin suite: CAS register through the TreeOps CLI over control.
+
+The reference (logcabin/src/jepsen/logcabin.clj, 300 LoC) is the one
+suite whose client is a REMOTE CLI, not a wire protocol: every
+read/write/cas runs the ``TreeOps`` binary on a node through the
+control layer, with CAS failure detected by matching LogCabin's
+exception text (logcabin.clj:140-209). The DB builds LogCabin from
+source with scons, bootstraps the Raft cluster on the first node, and
+grows it with the ``Reconfigure`` tool (logcabin.clj:23-160).
+
+This port keeps that exact shape: the client invokes TreeOps via
+``control.exec`` on its session's node (so the whole control/session
+machinery is the transport), values ride JSON like the reference, and
+the verdict comes from the standard linearizable register dispatch.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from typing import Any, Optional
+
+from .. import checker as jchecker
+from .. import cli, client as jclient, db as jdb, generator as gen
+from .. import models as jmodels
+from .. import nemesis as jnemesis, net as jnet
+from ..control import util as cu
+from .. import control as c
+from . import std_generator
+
+CONFIG = "/root/logcabin.conf"
+LOG = "/root/logcabin.log"
+PID = "/root/logcabin.pid"
+STORE = "/root/storage"
+BIN = "/root/LogCabin"
+RECONFIGURE = "/root/Reconfigure"
+TREEOPS = "/root/TreeOps"
+KEY = "/jepsen"
+OP_TIMEOUT = 3
+
+CAS_MSG = re.compile(
+    r"Exiting due to LogCabin::Client::Exception: Path '.*' has value "
+    r"'.*', not '.*' as required")
+TIMEOUT_MSG = re.compile(
+    r"Exiting due to LogCabin::Client::Exception: Client-specified "
+    r"timeout elapsed")
+
+
+def server_addrs(test: dict) -> str:
+    return ",".join(f"{n}:5254" for n in test["nodes"])
+
+
+class CasClient(jclient.Client):
+    """read/write/cas on one tree path via TreeOps
+    (logcabin.clj:163-243). Like the reference's ``(c/on node …)``,
+    each call binds the node's control session — the interpreter's
+    worker threads have no ambient binding."""
+
+    def __init__(self, node: Any = None):
+        self.node = node
+
+    def open(self, test, node):
+        return CasClient(node)
+
+    def _bound(self, test):
+        session = (test.get("sessions") or {}).get(self.node)
+        if session is None:
+            raise RuntimeError(f"no control session for {self.node!r}")
+        return c.with_session(self.node, session)
+
+    def setup(self, test):
+        with self._bound(test):
+            c.exec_star(
+                f"echo -n {c.escape(json.dumps(None))} | {TREEOPS} "
+                f"-c {server_addrs(test)} -q -t {OP_TIMEOUT} write {KEY}")
+
+    def invoke(self, test, op):
+        with self._bound(test):
+            return self._invoke(test, op)
+
+    def _invoke(self, test, op):
+        addrs = server_addrs(test)
+        try:
+            if op["f"] == "read":
+                out = c.exec_star(
+                    f"{TREEOPS} -c {addrs} -q -t {OP_TIMEOUT} read {KEY}")
+                return {**op, "type": "ok", "value": json.loads(out)}
+            if op["f"] == "write":
+                v = json.dumps(op["value"])
+                c.exec_star(
+                    f"echo -n {c.escape(v)} | {TREEOPS} -c {addrs} -q "
+                    f"-t {OP_TIMEOUT} write {KEY}")
+                return {**op, "type": "ok"}
+            if op["f"] == "cas":
+                old, new = op["value"]
+                o, n = json.dumps(old), json.dumps(new)
+                try:
+                    c.exec_star(
+                        f"echo -n {c.escape(n)} | {TREEOPS} -c {addrs} "
+                        f"-q -p {c.escape(KEY + ':' + o)} "
+                        f"-t {OP_TIMEOUT} write {KEY}")
+                except c.RemoteError as e:
+                    if CAS_MSG.search(str(e)):
+                        return {**op, "type": "fail"}
+                    raise
+                return {**op, "type": "ok"}
+            raise ValueError(f"unknown f {op['f']!r}")
+        except c.RemoteError as e:
+            if TIMEOUT_MSG.search(str(e)):
+                # Reads are idempotent; mutations may have landed.
+                t = "fail" if op["f"] == "read" else "info"
+                return {**op, "type": t, "error": "timed-out"}
+            raise
+
+    def close(self, test):
+        pass
+
+
+class LogCabinDB(jdb.DB, jdb.Process, jdb.Primary, jdb.LogFiles):
+    """scons build + bootstrap-on-n1 + Reconfigure grow
+    (logcabin.clj:23-160). The cluster-grow runs via the Primary hook
+    — AFTER every node's setup completes (db.cycle runs setups in
+    parallel; the reference synchronizes before reconfiguring,
+    logcabin.clj:140-146)."""
+
+    def setup(self, test, node):
+        from ..os_ import debian
+
+        debian.install(["git-core", "protobuf-compiler",
+                        "libprotobuf-dev", "libcrypto++-dev", "g++",
+                        "scons"])
+        with c.su():
+            c.exec_star(
+                "[ -d /logcabin ] || git clone --depth 1 "
+                "https://github.com/logcabin/logcabin.git /logcabin")
+            c.exec_star("cd /logcabin && git submodule update --init "
+                        "&& scons")
+            for src, dst in (("build/LogCabin", BIN),
+                             ("build/Examples/Reconfigure", RECONFIGURE),
+                             ("build/Examples/TreeOps", TREEOPS)):
+                c.exec("cp", "-f", f"/logcabin/{src}", dst)
+        # Positional server ids: hostname-derived ids collide for
+        # digit-free or same-numbered names ("db1.east"/"db1.west").
+        sid = test["nodes"].index(node) + 1
+        conf = f"serverId = {sid}\nlistenAddresses = {node}:5254\n"
+        with c.su():
+            c.exec_star(f"echo {c.escape(conf)} > {CONFIG}")
+            c.exec("rm", "-rf", LOG)
+            if node == test["nodes"][0]:
+                c.exec_star(f"cd /root && {BIN} -c {CONFIG} -l {LOG} "
+                            f"--bootstrap")
+        self.start(test, node)
+
+    def setup_primary(self, test, node):
+        with c.su():
+            addrs = " ".join(f"{n}:5254" for n in test["nodes"])
+            c.exec_star(
+                f"cd /root && {RECONFIGURE} -c "
+                f"{server_addrs(test)} set {addrs}")
+
+    def start(self, test, node):
+        with c.su():
+            c.exec_star(f"cd /root && {BIN} -c {CONFIG} -d -l {LOG} "
+                        f"-p {PID}")
+
+    def kill(self, test, node):
+        cu.grepkill("LogCabin")
+
+    def teardown(self, test, node):
+        cu.grepkill("LogCabin")
+        with c.su():
+            c.exec("rm", "-rf", STORE, PID)
+
+    def log_files(self, test, node):
+        return [LOG]
+
+
+def cas_workload(opts: Optional[dict] = None) -> dict:
+    o = dict(opts or {})
+
+    def w(test=None, ctx=None):
+        return {"type": "invoke", "f": "write",
+                "value": gen.rand_int(5)}
+
+    def r(test=None, ctx=None):
+        return {"type": "invoke", "f": "read", "value": None}
+
+    def cas(test=None, ctx=None):
+        return {"type": "invoke", "f": "cas",
+                "value": [gen.rand_int(5), gen.rand_int(5)]}
+
+    return {
+        "client": CasClient(),
+        "checker": jchecker.compose({
+            "linear": jchecker.linearizable(
+                model=jmodels.CasRegister(init=None)),
+            "stats": jchecker.stats(),
+        }),
+        "generator": gen.clients(gen.limit(
+            int(o.get("ops") or 200), gen.mix([w, r, cas]))),
+    }
+
+
+WORKLOADS = {"cas": cas_workload}
+
+
+def test_fn(opts: dict) -> dict:
+    wl = cas_workload(opts)
+    test = {
+        "name": "logcabin-cas",
+        "db": LogCabinDB(),
+        "net": jnet.iptables(),
+        "nemesis": jnemesis.partition_random_halves(),
+        **{k: v for k, v in wl.items() if k != "generator"},
+    }
+    test["generator"] = std_generator(opts, wl["generator"])
+    return test
+
+
+def _add_opts(p):
+    p.add_argument("--ops", type=int, default=200)
+
+
+def main(argv=None):
+    cli.main_exit(cli.single_test_cmd(test_fn, add_opts=_add_opts), argv)
+
+
+if __name__ == "__main__":
+    main()

@@ -166,9 +166,11 @@ class DocumentCasClient(jclient.Client):
                     (res or {}).get("replaced") == 1
                 return {**op, "type": "ok" if ok else "fail"}
             raise ValueError(f"unknown f {op['f']!r}")
-        except ReqlError as e:
-            # Determinate server-side rejection; reads are idempotent
-            # (with-errors op #{:read} — rethinkdb.clj:137-163).
+        except (ReqlError, OSError) as e:
+            # Server-side rejections AND the network faults our own
+            # partitioner induces: reads are idempotent -> :fail,
+            # mutations may have landed -> :info (the with-errors
+            # contract, rethinkdb.clj:137-163).
             if op["f"] == "read":
                 return {**op, "type": "fail", "error": str(e)[:80]}
             return {**op, "type": "info", "error": str(e)[:80]}

@@ -1,0 +1,224 @@
+"""RobustIRC suite: unique channel-topic messages as a set test.
+
+The reference (robustirc/src/jepsen/robustirc.clj, 239 LoC) drives a
+Raft-replicated IRC network over its HTTP bridge: create a session,
+send NICK/USER/JOIN, ``add`` posts ``TOPIC #jepsen :<n>`` (with a
+client-message id for dedup), ``read`` streams the message log and
+extracts the topic integers; checked with the set checker under
+partition-random-halves.
+
+Same layering here: a session client over the
+``/robustirc/v1/session`` + ``/<sid>/message`` + ``/<sid>/messages``
+wire shape (the reference talks TLS with a self-signed cert; the
+protocol shape is identical over plain HTTP — the suite takes a
+``scheme`` option), a go-get + start-stop-daemon DB lifecycle with the
+reference's primary-first singlenode bootstrap then join
+(robustirc.clj:44-80), and the set workload with a final read.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import urllib.request
+from typing import Any, Optional
+
+from .. import checker as jchecker
+from .. import cli, client as jclient, db as jdb, generator as gen
+from .. import nemesis as jnemesis, net as jnet
+from ..control import util as cu
+from .. import control as c
+from . import std_generator
+
+PORT = 13001
+SCHEME = "http"
+CHANNEL = "#jepsen"
+
+
+class RobustSession:
+    """One bridge session (robustirc.clj:103-136)."""
+
+    def __init__(self, host: str, port: Optional[int] = None,
+                 timeout: float = 10.0):
+        if port is None:
+            port = PORT
+        self.base = f"{SCHEME}://{host}:{port}/robustirc/v1"
+        self.timeout = timeout
+        res = self._post("/session", {}, auth=None)
+        self.sid = res["Sessionid"]
+        self.auth = res["Sessionauth"]
+
+    def _post(self, path: str, body: dict, auth: Optional[str]) -> dict:
+        req = urllib.request.Request(
+            self.base + path, data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json",
+                     **({"X-Session-Auth": auth} if auth else {})},
+            method="POST")
+        with urllib.request.urlopen(req, timeout=self.timeout) as r:
+            out = r.read().decode()
+        return json.loads(out) if out else {}
+
+    def post_message(self, ircmessage: str) -> None:
+        # Client-message id: random-ish but content-derived, the
+        # server's dedup key (robustirc.clj:112-120).
+        msgid = int(hashlib.md5(ircmessage.encode()).hexdigest()[17:][:15],
+                    16) & 0x7FFFFFFF
+        self._post(f"/{self.sid}/message",
+                   {"Data": ircmessage, "ClientMessageId": msgid},
+                   auth=self.auth)
+
+    def read_messages(self) -> list:
+        req = urllib.request.Request(
+            f"{self.base}/{self.sid}/messages?lastseen=0.0",
+            headers={"X-Session-Auth": self.auth})
+        with urllib.request.urlopen(req, timeout=self.timeout) as r:
+            body = r.read().decode()
+        # Stream of newline-separated JSON message objects.
+        return [json.loads(line) for line in body.splitlines() if line]
+
+
+def filter_topic(msg: dict) -> bool:
+    parts = (msg.get("Data") or "").split(" ")
+    return len(parts) > 1 and parts[1] == "TOPIC"
+
+
+def extract_topic(msg: dict) -> int:
+    return int((msg.get("Data") or "").rsplit(":", 1)[-1])
+
+
+class SetClient(jclient.Client):
+    """add -> TOPIC #jepsen :<n>; read -> all topic ints seen
+    (robustirc.clj:150-180)."""
+
+    def __init__(self, session: Optional[RobustSession] = None):
+        self.session = session
+
+    def open(self, test, node):
+        s = RobustSession(str(node))
+        s.post_message(f"NICK n{abs(hash(str(node))) % 1000}")
+        s.post_message("USER j j j j")
+        s.post_message(f"JOIN {CHANNEL}")
+        return SetClient(s)
+
+    def invoke(self, test, op):
+        if op["f"] == "add":
+            try:
+                self.session.post_message(
+                    f"TOPIC {CHANNEL} :{op['value']}")
+            except OSError:
+                return {**op, "type": "fail", "error": "node-failure"}
+            return {**op, "type": "ok"}
+        if op["f"] == "read":
+            try:
+                msgs = self.session.read_messages()
+            except OSError:
+                return {**op, "type": "fail", "error": "node-failure"}
+            vals = sorted({extract_topic(m) for m in msgs
+                           if filter_topic(m)})
+            return {**op, "type": "ok", "value": vals}
+        raise ValueError(f"unknown f {op['f']!r}")
+
+    def close(self, test):
+        pass
+
+
+class RobustIrcDB(jdb.DB, jdb.Process, jdb.LogFiles):
+    """go get + primary-first singlenode bootstrap, then joins
+    (robustirc.clj:23-83)."""
+
+    BIN = "/root/gocode/bin/robustirc"
+    LOG = "/var/log/robustirc.log"
+    PID = "/var/run/robustirc.pid"
+
+    def setup(self, test, node):
+        from ..os_ import debian
+
+        debian.install(["golang-go", "mercurial"])
+        c.exec_star("env GOPATH=/root/gocode go get -u "
+                    "github.com/robustirc/robustirc || true")
+        with c.su():
+            c.exec("rm", "-rf", "/var/lib/robustirc")
+            c.exec("mkdir", "-p", "/var/lib/robustirc")
+        primary = test["nodes"][0]
+        common = [
+            "-listen", f"{node}:{PORT}",
+            "-network_password", "secret",
+            "-network_name", "jepsen",
+        ]
+        with c.su():
+            if node == primary:
+                cu.start_daemon(
+                    {"logfile": self.LOG, "pidfile": self.PID,
+                     "chdir": "/var/lib/robustirc"},
+                    self.BIN, *common, "-singlenode")
+            else:
+                cu.start_daemon(
+                    {"logfile": self.LOG, "pidfile": self.PID,
+                     "chdir": "/var/lib/robustirc"},
+                    self.BIN, *common, "-join", f"{primary}:{PORT}")
+
+    def start(self, test, node):
+        self.setup(test, node)
+
+    def kill(self, test, node):
+        cu.grepkill("robustirc")
+
+    def teardown(self, test, node):
+        cu.grepkill("robustirc")
+        with c.su():
+            c.exec("rm", "-rf", "/var/lib/robustirc", self.PID)
+
+    def log_files(self, test, node):
+        return [self.LOG]
+
+
+def set_workload(opts: Optional[dict] = None) -> dict:
+    o = dict(opts or {})
+    counter = [0]
+
+    def add(test=None, ctx=None):
+        counter[0] += 1
+        return {"type": "invoke", "f": "add", "value": counter[0]}
+
+    return {
+        "client": SetClient(),
+        "checker": jchecker.compose({
+            "set": jchecker.set_checker(),
+            "stats": jchecker.stats(),
+        }),
+        "generator": gen.clients(
+            gen.limit(int(o.get("ops") or 200), add)),
+        "final-generator": gen.clients(
+            gen.once({"type": "invoke", "f": "read", "value": None})),
+    }
+
+
+WORKLOADS = {"set": set_workload}
+
+
+def test_fn(opts: dict) -> dict:
+    wl = set_workload(opts)
+    test = {
+        "name": "robustirc-set",
+        "db": RobustIrcDB(),
+        "net": jnet.iptables(),
+        "nemesis": jnemesis.partition_random_halves(),
+        **{k: v for k, v in wl.items()
+           if k not in ("generator", "final-generator")},
+    }
+    test["generator"] = std_generator(
+        opts, wl["generator"],
+        final_client_gen=wl.get("final-generator"))
+    return test
+
+
+def _add_opts(p):
+    p.add_argument("--ops", type=int, default=200)
+
+
+def main(argv=None):
+    cli.main_exit(cli.single_test_cmd(test_fn, add_opts=_add_opts), argv)
+
+
+if __name__ == "__main__":
+    main()

@@ -16,9 +16,10 @@ from jepsen_tpu.workloads import noop_test
 
 SUITES = [
     "aerospike", "chronos", "cockroachdb", "consul", "crate", "dgraph",
-    "elasticsearch", "etcd", "faunadb", "hazelcast", "ignite", "mongodb",
-    "mysql", "postgres", "rabbitmq", "raftis", "redis", "rethinkdb",
-    "stolon", "tidb", "yugabyte", "zookeeper",
+    "elasticsearch", "etcd", "faunadb", "hazelcast", "ignite",
+    "logcabin", "mongodb", "mysql", "postgres", "rabbitmq", "raftis",
+    "redis", "rethinkdb", "robustirc", "stolon", "tidb", "yugabyte",
+    "zookeeper",
 ]
 
 

@@ -1941,3 +1941,233 @@ class TestRethinkSuite:
         assert any("join=n2:29015" in cmd for cmd in cmds)
         assert any("rethinkdb" in cmd and "--config-file" in cmd
                    for cmd in cmds)
+
+
+class RobustIrcStub(BaseHTTPRequestHandler):
+    """Session bridge stub: Raft log of IRC messages with
+    ClientMessageId dedup — a correct network must pass the set
+    checker."""
+
+    lock = threading.Lock()
+    sessions: dict = {}
+    log: list = []  # (ClientMessageId, Data)
+    seen_ids: set = set()
+    next_sid = [0]
+
+    @classmethod
+    def reset(cls):
+        with cls.lock:
+            cls.sessions = {}
+            cls.log = []
+            cls.seen_ids = set()
+            cls.next_sid[0] = 0
+
+    def log_message(self, *a):
+        pass
+
+    def _reply(self, obj, code=200):
+        body = (json.dumps(obj) if not isinstance(obj, (bytes, str))
+                else obj)
+        body = body.encode() if isinstance(body, str) else body
+        self.send_response(code)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_POST(self):
+        n = int(self.headers.get("Content-Length") or 0)
+        body = json.loads(self.rfile.read(n) or b"{}") if n else {}
+        with self.lock:
+            if self.path.endswith("/session"):
+                self.next_sid[0] += 1
+                sid = f"s{self.next_sid[0]}"
+                auth = f"auth-{sid}"
+                self.sessions[sid] = auth
+                self._reply({"Sessionid": sid, "Sessionauth": auth})
+                return
+            sid = self.path.split("/")[-2]
+            assert self.headers.get("X-Session-Auth") == \
+                self.sessions.get(sid), "bad session auth"
+            mid = body.get("ClientMessageId")
+            if mid not in self.seen_ids:  # Raft-level dedup
+                self.seen_ids.add(mid)
+                # The real server echoes messages with a sender prefix
+                # ("<sid> TOPIC #jepsen :n") — the parser depends on it.
+                self.log.append((mid, f"{sid} {body.get('Data')}"))
+            self._reply({})
+
+    def do_GET(self):
+        sid = self.path.split("/")[-2]
+        assert self.headers.get("X-Session-Auth") == \
+            self.sessions.get(sid)
+        with self.lock:
+            lines = "\n".join(json.dumps({"Data": d})
+                              for _m, d in self.log)
+        self._reply(lines)
+
+
+class TestRobustIrcSuite:
+    @pytest.fixture()
+    def irc(self, monkeypatch):
+        from jepsen_tpu.suites import robustirc as ri
+
+        RobustIrcStub.reset()
+        srv = ThreadingHTTPServer(("127.0.0.1", 0), RobustIrcStub)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        monkeypatch.setattr(ri, "PORT", srv.server_address[1])
+        yield ri
+        srv.shutdown()
+        srv.server_close()
+
+    def test_set_against_stub(self, irc, tmp_path):
+        test = dict(noop_test())
+        wl = irc.WORKLOADS["set"]({"ops": 40})
+        test.update(
+            name="robustirc-stub",
+            nodes=["127.0.0.1"],
+            concurrency=4,
+            **{"store-root": str(tmp_path)},
+            **{k: v for k, v in wl.items()
+               if k not in ("generator", "final-generator")},
+        )
+        test["generator"] = gen.phases(wl["generator"],
+                                       wl["final-generator"])
+        res = core.run(test)
+        assert res["results"]["valid"] is True, res["results"]
+        assert res["results"]["set"]["ok_count"] > 0
+
+    def test_topic_parsing(self):
+        from jepsen_tpu.suites import robustirc as ri
+
+        assert ri.filter_topic({"Data": "sid TOPIC #jepsen :42"})
+        assert not ri.filter_topic({"Data": "PING"})
+        assert ri.extract_topic({"Data": "sid TOPIC #jepsen :42"}) == 42
+
+    def test_db_commands(self):
+        from jepsen_tpu.suites import robustirc as ri
+
+        test = dict(noop_test())
+        test["nodes"] = ["n1", "n2"]
+        log: list = []
+        c.setup_sessions(test, c.dummy(log, responses={
+            r"mktemp": "/tmp/jepsen.x\n"}))
+        db = ri.RobustIrcDB()
+        for node in ("n1", "n2"):
+            try:
+                c.on_nodes(test, lambda t, n: db.setup(t, n), [node])
+            except Exception:
+                pass
+        cmds = [cmd for _n, cmd in log]
+        assert any("-singlenode" in cmd for cmd in cmds)
+        assert any("-join n1:13001" in cmd for cmd in cmds)
+
+
+class TreeOpsRemote(c.DummyRemote):
+    """Stateful control remote implementing the TreeOps CLI semantics —
+    logcabin's client transport IS the control layer, so its stub is a
+    remote, not a socket server."""
+
+    store_lock = threading.Lock()
+    store: dict = {}
+
+    @classmethod
+    def reset(cls):
+        with cls.store_lock:
+            cls.store = {}
+
+    def connect(self, host):
+        return TreeOpsRemote(self.log, self.responses, host)
+
+    def execute(self, action):
+        import re as _re
+
+        cmd = action["cmd"]
+        if "TreeOps" not in cmd:
+            return super().execute(action)
+        stdin_m = _re.search(r"echo -n (\"[^\"]*\"|\S+) \|", cmd)
+        raw = stdin_m.group(1).strip('"') if stdin_m else None
+        cas_m = _re.search(r"-p \"?(/\S*?):(.+?)\"? -t", cmd)
+        with self.store_lock:
+            if " read " in cmd:
+                path = cmd.rsplit(" ", 1)[-1]
+                return {"out": self.store.get(path, "null"),
+                        "err": "", "exit": 0}
+            path = cmd.rsplit(" ", 1)[-1]
+            if cas_m:
+                want = cas_m.group(2).strip('"')
+                cur = self.store.get(cas_m.group(1), "null")
+                if cur != want:
+                    return {"out": "", "err": (
+                        "Exiting due to LogCabin::Client::Exception: "
+                        f"Path '{path}' has value '{cur}', not "
+                        f"'{want}' as required"), "exit": 1}
+            self.store[path] = raw
+            return {"out": "", "err": "", "exit": 0}
+
+
+class TestLogCabinSuite:
+    def test_cas_register_against_fake_remote(self, tmp_path):
+        from jepsen_tpu.suites import logcabin as lc
+
+        TreeOpsRemote.reset()
+        test = dict(noop_test())
+        wl = lc.WORKLOADS["cas"]({"ops": 60})
+        test.update(
+            name="logcabin-stub",
+            nodes=["n1", "n2"],
+            concurrency=4,
+            **{"store-root": str(tmp_path)},
+            **{k: v for k, v in wl.items() if k != "generator"},
+        )
+        test["generator"] = wl["generator"]
+        c.setup_sessions(test, TreeOpsRemote())
+        res = core.run(test)
+        assert res["results"]["valid"] is True, res["results"]
+        oks = [op for op in res["history"]
+               if op.type == "ok" and op.f == "cas"]
+        assert oks, "no successful cas against the fake remote"
+
+    def test_cas_failure_detected(self):
+        from jepsen_tpu.suites import logcabin as lc
+
+        TreeOpsRemote.reset()
+        test = dict(noop_test())
+        test["nodes"] = ["n1"]
+        c.setup_sessions(test, TreeOpsRemote())
+        client = lc.CasClient()
+
+        def drive(t, n):
+            cl = client.open(t, n)
+            cl.setup(t)
+            assert cl.invoke(t, {"f": "write", "value": 3,
+                                 "type": "invoke"})["type"] == "ok"
+            assert cl.invoke(t, {"f": "cas", "value": [3, 4],
+                                 "type": "invoke"})["type"] == "ok"
+            assert cl.invoke(t, {"f": "cas", "value": [3, 5],
+                                 "type": "invoke"})["type"] == "fail"
+            assert cl.invoke(t, {"f": "read", "value": None,
+                                 "type": "invoke"})["value"] == 4
+            return None
+
+        c.on_nodes(test, drive, ["n1"])
+
+    def test_db_commands(self):
+        from jepsen_tpu.suites import logcabin as lc
+
+        test = dict(noop_test())
+        test["nodes"] = ["n1", "n2", "n3"]
+        log: list = []
+        c.setup_sessions(test, c.dummy(log, responses={
+            r"mktemp": "/tmp/jepsen.x\n"}))
+        db = lc.LogCabinDB()
+        try:
+            c.on_nodes(test, lambda t, n: db.setup(t, n), ["n1"])
+            # Cluster-grow runs via the Primary hook AFTER all setups.
+            c.on_nodes(test, lambda t, n: db.setup_primary(t, n), ["n1"])
+        except Exception:
+            pass
+        cmds = [cmd for _n, cmd in log]
+        assert any("scons" in cmd for cmd in cmds)
+        assert any("--bootstrap" in cmd for cmd in cmds)
+        assert any("Reconfigure" in cmd and "set" in cmd
+                   for cmd in cmds)

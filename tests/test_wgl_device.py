@@ -375,3 +375,80 @@ class TestDiskCheckpoint:
         assert res["valid"] == wgl_host.check_history_host(model, h)["valid"]
         # The search never revisited the already-exact prefix.
         assert chunks and min(c["level"] for c in chunks) >= resumed_level
+
+
+class TestCompetition:
+    """The :competition analysis strategy (checker.clj:196-200): native
+    DFS raced against the device BFS, first definite verdict wins."""
+
+    def _hist(self, seed, n_ops=150, perturb=False):
+        import random
+
+        from jepsen_tpu.models import CasRegister
+        from jepsen_tpu.testing import (perturb_history,
+                                        random_register_history)
+
+        rng = random.Random(seed)
+        h = random_register_history(rng, n_ops=n_ops, n_procs=5,
+                                    cas=True, crash_p=0.05)
+        if perturb:
+            h = perturb_history(rng, h)
+        return CasRegister(init=0), h
+
+    def test_verdicts_match_oracle(self):
+        from jepsen_tpu.ops import wgl, wgl_host
+
+        seen_engines = set()
+        for seed in range(8):
+            model, h = self._hist(seed, perturb=seed % 2 == 1)
+            want = wgl_host.check_history_host(model, h)["valid"]
+            got = wgl.check_history(model, h, backend="competition")
+            assert got["valid"] == want, (seed, got)
+            assert got["backend"] in ("competition", "host")
+            if got["backend"] == "competition":
+                seen_engines.add(got["engine"])
+        assert seen_engines, "competition never decided anything"
+
+    def test_device_wins_when_native_unavailable(self, monkeypatch):
+        """With the native engine knocked out, the device side still
+        crosses the line."""
+        from jepsen_tpu.ops import wgl, wgl_c, wgl_host
+
+        monkeypatch.setattr(wgl_c, "check_encoded_native",
+                            lambda enc, **kw: None)
+        model, h = self._hist(3)
+        want = wgl_host.check_history_host(model, h)["valid"]
+        got = wgl.check_history(model, h, backend="competition")
+        assert got["valid"] == want
+        assert got.get("engine") == "device"
+
+    def test_native_wins_when_device_stalls(self, monkeypatch):
+        """With the device side forced to 'unknown' (empty capacity
+        schedule), the native verdict is taken."""
+        from jepsen_tpu.ops import wgl, wgl_host
+
+        model, h = self._hist(5)
+        want = wgl_host.check_history_host(model, h)["valid"]
+        got = wgl.check_history(model, h, backend="competition",
+                                f_schedule=())
+        assert got["valid"] == want
+        assert got.get("engine") == "native"
+
+    def test_checker_dispatch(self):
+        """checker_backend=competition rides the test map into the
+        linearizable checker."""
+        from jepsen_tpu import checker as C
+        from jepsen_tpu.history import History, Op
+        from jepsen_tpu.models import CasRegister
+
+        def o(typ, p, f, value, t):
+            return Op.from_dict({"type": typ, "process": p, "f": f,
+                                 "value": value, "time": t})
+
+        h = History([
+            o("invoke", 0, "write", 1, 0), o("ok", 0, "write", 1, 1),
+            o("invoke", 1, "read", None, 2), o("ok", 1, "read", 1, 3),
+        ], reindex=True)
+        chk = C.linearizable(model=CasRegister(init=0))
+        res = chk.check({"checker_backend": "competition"}, h, {})
+        assert res["valid"] is True
