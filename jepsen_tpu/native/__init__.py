@@ -71,9 +71,11 @@ def _build() -> Optional[ctypes.CDLL]:
     ]
     lib.wgl_check.restype = ctypes.c_int
     # The DFS additionally captures the deepest configs reached (the
-    # refutation witness): wit_buf, wit_cap (entries), wit_len out.
+    # refutation witness): wit_buf, wit_cap (entries), wit_len out —
+    # plus an optional cooperative-cancel flag (competition mode).
     lib.wgl_check_dfs.argtypes = lib.wgl_check.argtypes + [
         i32p, ctypes.c_int32, ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_int32),
     ]
     lib.wgl_check_dfs.restype = ctypes.c_int
     lib.wgl_witness_stride.argtypes = []

@@ -617,7 +617,12 @@ int wgl_check_dfs(
      * reference renders as linear.svg, checker.clj:202-209): up to
      * wit_cap entries of wgl_witness_stride() lanes each; NULL/0 to
      * disable */
-    int32_t *wit_buf, int32_t wit_cap, int32_t *wit_len) {
+    int32_t *wit_buf, int32_t wit_cap, int32_t *wit_len,
+    /* optional cooperative cancellation: when *cancel becomes nonzero
+     * the search returns -1 (budget semantics) at the next poll — the
+     * competition race uses this so a losing DFS stops promptly
+     * instead of grinding to its full config budget. NULL = never. */
+    const volatile int32_t *cancel) {
     if (W > 64 || nO > 64 * NO_WORDS || S > S_MAX)
         return -2;
     *configs_explored = 0;
@@ -656,7 +661,8 @@ int wgl_check_dfs(
         if (fr->next_j < 0) {
             /* first visit: compute window limit + min completion */
             explored++;
-            if (explored > max_configs) {
+            if (explored > max_configs ||
+                ((explored & 0x3FF) == 0 && cancel && *cancel)) {
                 verdict = -1;
                 break;
             }

@@ -1372,6 +1372,7 @@ def check_encoded_competition(enc: EncodedHistory,
     is returned. Covers each engine's weak case: the device kernel
     cannot refute past its capacity schedule, the DFS can hit its
     config budget where the beam accepts quickly."""
+    import ctypes
     import threading
 
     from . import wgl_c
@@ -1380,11 +1381,12 @@ def check_encoded_competition(enc: EncodedHistory,
         native_max_configs = 1_000_000 + 2_000 * enc.n
     done = threading.Event()
     native_res: dict = {}
+    cancel = ctypes.c_int32(0)
 
     def native_side():
         try:
             nat = wgl_c.check_encoded_native(
-                enc, max_configs=native_max_configs)
+                enc, max_configs=native_max_configs, cancel=cancel)
         except Exception:  # noqa: BLE001 - the race must survive a loser
             nat = None
         if nat is not None:
@@ -1414,7 +1416,13 @@ def check_encoded_competition(enc: EncodedHistory,
     except Exception:  # noqa: BLE001 - the race must survive a loser:
         pass  # a device-side failure must not discard a native verdict
     if dev is not None and dev["valid"] != "unknown":
-        done.set()  # device crossed the line; don't wait on the DFS
+        # Device crossed the line: cancel the losing DFS (it polls the
+        # flag and stops promptly — an orphaned search would otherwise
+        # grind to its full multi-GB config budget, and keyed workloads
+        # can run many competitions in sequence).
+        done.set()
+        cancel.value = 1
+        t.join(timeout=30)
         dev["backend"] = "competition"
         dev["engine"] = "device"
         return dev

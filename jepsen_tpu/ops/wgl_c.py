@@ -53,11 +53,14 @@ def _model_id(model: Model):
 
 def check_encoded_native(
     enc: EncodedHistory, max_configs: int = 50_000_000,
-    strategy: str = "dfs",
+    strategy: str = "dfs", cancel: Optional["ctypes.c_int32"] = None,
 ) -> Optional[dict]:
     """Decide linearizability in the C engine; None when unsupported.
     ``strategy``: "dfs" (memoized depth-first — near-linear on valid
-    histories) or "bfs" (level-synchronous, the device kernel's shape)."""
+    histories) or "bfs" (level-synchronous, the device kernel's shape).
+    ``cancel``: a ctypes.c_int32 the DFS polls — setting it nonzero
+    from another thread makes the search return "unknown" promptly
+    (the competition race's loser cancellation)."""
     lib = native.load()
     if lib is None:
         return None
@@ -104,7 +107,8 @@ def check_encoded_native(
         wit_buf = np.zeros(wit_cap * stride, dtype=np.int32)
         wit_len = ctypes.c_int32(0)
         verdict = lib.wgl_check_dfs(
-            *common, p(wit_buf), wit_cap, ctypes.byref(wit_len))
+            *common, p(wit_buf), wit_cap, ctypes.byref(wit_len),
+            ctypes.byref(cancel) if cancel is not None else None)
     else:
         wit_buf = None
         verdict = lib.wgl_check(*common)

@@ -19,13 +19,17 @@ This port keeps the same layering TPU-side:
   protocol);
 - `Fauna`, the wire client (client.clj's f/query: POST one expression,
   get ``{"resource": ...}`` or ``{"errors": [...]}``);
-- the five distinctive workloads: **bank** (bank.clj, on the shared
+- eight of runner.clj's workloads: **bank** (bank.clj, on the shared
   jepsen_tpu.workloads.bank invariant machinery), **set** (set.clj with
   the strong-read read-write trick), **pages** (pages.clj with its
   union-of-groups checker), **monotonic** (monotonic.clj: inc/read/
-  read-at with per-process and timestamp-value checkers), and
+  read-at with per-process and timestamp-value checkers),
   **multimonotonic** (multimonotonic.clj: owner-thread blind writes,
-  map-partial-order read checker);
+  map-partial-order read checker), **g2** (g2.clj: predicate write-skew
+  on the shared adya machinery), **register** (register.clj: keyed
+  linearizable register on the device dispatch), and **internal**
+  (internal.clj: within-txn mutability order — the second read of one
+  txn must observe the txn's own write);
 - a replica **topology** model + topology-aware nemesis
   (topology.clj:12-28, nemesis.clj:20-55): single-node, intra-replica
   and inter-replica partitions over the grudge algebra.
@@ -44,6 +48,7 @@ from typing import Any, Optional
 from .. import checker as jchecker
 from .. import cli, client as jclient, db as jdb, generator as gen
 from .. import independent as jind
+from .. import models as jmodels
 from .. import nemesis as jnemesis, net as jnet
 from ..checker import Checker, checker_fn
 from ..control import util as cu
@@ -109,6 +114,30 @@ def guarded_transfer(cls: str, frm: Any, to: Any, amount: int) -> dict:
     """bank.clj's transfer txn: abort if the source would go negative."""
     return {"transfer": {"class": cls, "from": frm, "to": to,
                          "amount": amount}}
+
+
+def exists_match(cls: str, term: Any) -> dict:
+    """Predicate existence over an index (g2.clj's conflict probe)."""
+    return {"exists_match": {"class": cls, "term": term}}
+
+
+def not_(expr: Any) -> dict:
+    return {"not": expr}
+
+
+def select_field(r: dict, field: str, default: Any = None) -> dict:
+    return {"if": exists(r),
+            "then": {"select": ["data", field], "from": get(r)},
+            "else": default}
+
+
+def guarded_cas(r: dict, field: str, expect: Any, new: Any) -> dict:
+    """register.clj's cas txn: update iff the field equals expect, else
+    abort."""
+    return {"if": {"eq": [{"select": ["data", field], "from": get(r)},
+                          expect]},
+            "then": update(r, {field: new}),
+            "else": {"abort": "transaction aborted"}}
 
 
 # ---------------------------------------------------------------------------
@@ -394,8 +423,145 @@ class MultiMonotonicClient(jclient.Client):
         self.conn.close()
 
 
+class G2Client(jclient.Client):
+    """g2.clj: insert to class a (or b) guarded by the OTHER class's
+    index being empty for the key — the predicate write-skew probe the
+    adya G2 checker flags (at most one insert per key may succeed under
+    serializability)."""
+
+    def __init__(self, conn: Optional[Fauna] = None):
+        self.conn = conn
+
+    def open(self, test, node):
+        return G2Client(Fauna(str(node)))
+
+    def invoke(self, test, op):
+        k, ids = op["value"]
+        a_id, b_id = ids
+
+        def go():
+            cls_, other = ("g2a", "g2b") if a_id is not None \
+                else ("g2b", "g2a")
+            rid = a_id if a_id is not None else b_id
+            res = self.conn.query({
+                "if": not_(exists_match(other, k)),
+                "then": create({"ref": {"class": cls_,
+                                        "id": f"{k}:{rid}"}},
+                               {"key": k, "value": rid}),
+                "else": None,
+            })
+            return {**op, "type": "ok" if res is not None else "fail"}
+
+        return _with_errors(op, False, go)
+
+    def close(self, test):
+        self.conn.close()
+
+
+class RegisterClient(jclient.Client):
+    """register.clj: keyed read/write/cas on an instance field; cas
+    aborts server-side unless the field matches."""
+
+    CLS = "registers"
+
+    def __init__(self, conn: Optional[Fauna] = None):
+        self.conn = conn
+
+    def open(self, test, node):
+        return RegisterClient(Fauna(str(node)))
+
+    def invoke(self, test, op):
+        k, v = op["value"]
+        r = ref(self.CLS, f"reg-{k}")
+        if op["f"] == "read":
+            def go():
+                val = self.conn.query(select_field(r, "register"))
+                return {**op, "type": "ok", "value": jind.tuple_(k, val)}
+
+            return _with_errors(op, True, go)
+        if op["f"] == "write":
+            def go():
+                self.conn.query(upsert(r, {"register": v}))
+                return {**op, "type": "ok"}
+
+            return _with_errors(op, False, go)
+        if op["f"] == "cas":
+            def go():
+                expect, new = v
+                try:
+                    self.conn.query(guarded_cas(r, "register",
+                                                expect, new))
+                except FaunaError as e:
+                    if e.code == "transaction aborted":
+                        return {**op, "type": "fail"}
+                    raise
+                return {**op, "type": "ok"}
+
+            return _with_errors(op, False, go)
+        raise ValueError(f"unknown f {op['f']!r}")
+
+    def close(self, test):
+        self.conn.close()
+
+
+class InternalClient(jclient.Client):
+    """internal.clj: within ONE txn, [match, create, match] — the second
+    read must observe the txn's own write, the first must not (internal
+    transaction mutability in evaluation order). The reference probes
+    the same property through let/object/array forms; this port's
+    ``do`` IS the array form."""
+
+    CLS = "cats"
+
+    def __init__(self, conn: Optional[Fauna] = None):
+        self.conn = conn
+
+    def open(self, test, node):
+        return InternalClient(Fauna(str(node)))
+
+    def invoke(self, test, op):
+        if op["f"] == "create-cat":
+            def go():
+                t0, _cat, t1 = self.conn.query(do_(
+                    match(self.CLS, "tabby"),
+                    create({"ref": {"class": self.CLS, "id": "auto"}},
+                           {"key": "tabby", "value": op["value"]}),
+                    match(self.CLS, "tabby")))
+                return {**op, "type": "ok",
+                        "value": {"name": op["value"],
+                                  "before": sorted(x["value"] for x in t0),
+                                  "after": sorted(x["value"] for x in t1)}}
+
+            return _with_errors(op, False, go)
+        raise ValueError(f"unknown f {op['f']!r}")
+
+    def close(self, test):
+        self.conn.close()
+
+
 # ---------------------------------------------------------------------------
 # Checkers
+
+
+def internal_checker() -> Checker:
+    """Each txn's second read must equal its first read plus its own
+    write — internal.clj's op-errors condition."""
+
+    def chk(test, history, opts):
+        errs = []
+        for op in history:
+            if op.f != "create-cat" or not op.is_ok:
+                continue
+            v = op.value or {}
+            want = sorted(list(v.get("before") or []) + [v.get("name")])
+            if v.get("after") != want:
+                errs.append({"op_index": op.index,
+                             "expected": want,
+                             "observed": v.get("after")})
+        return {"valid": not errs, "errors": errs[:5],
+                "error_count": len(errs)}
+
+    return checker_fn(chk, "internal")
 
 
 def pages_checker() -> Checker:
@@ -824,12 +990,84 @@ def multimonotonic_workload(opts: dict) -> dict:
     }
 
 
+def g2_workload(opts: dict) -> dict:
+    """Predicate write-skew probe on the shared adya machinery
+    (g2.clj:72-77)."""
+    from ..workloads import adya
+
+    wl = adya.g2(opts)
+    return {
+        "client": G2Client(),
+        "checker": jchecker.compose({
+            "adya-g2": wl["checker"],
+            "stats": jchecker.stats(),
+        }),
+        "generator": gen.clients(gen.limit(
+            int((opts or {}).get("ops") or 200), wl["generator"])),
+    }
+
+
+def register_workload(opts: dict) -> dict:
+    """Keyed linearizable register on the standard device dispatch
+    (register.clj:53-78)."""
+    o = dict(opts or {})
+    per_key = int(o.get("ops_per_key") or 40)
+    n_keys = int(o.get("keys") or 4)
+
+    def r(test=None, ctx=None):
+        return {"type": "invoke", "f": "read", "value": None}
+
+    def w(test=None, ctx=None):
+        return {"type": "invoke", "f": "write", "value": gen.rand_int(5)}
+
+    def cas(test=None, ctx=None):
+        return {"type": "invoke", "f": "cas",
+                "value": [gen.rand_int(5), gen.rand_int(5)]}
+
+    def fgen(k):
+        return gen.limit(per_key, gen.mix([r, w, cas]))
+
+    return {
+        "client": RegisterClient(),
+        "checker": jchecker.compose({
+            "linear": jind.checker(jchecker.linearizable(
+                model=jmodels.CasRegister(init=None))),
+            "stats": jchecker.stats(),
+        }),
+        "generator": gen.clients(jind.concurrent_generator(
+            2, range(n_keys), fgen)),
+    }
+
+
+def internal_workload(opts: dict) -> dict:
+    o = dict(opts or {})
+    counter = [0]
+
+    def create(test=None, ctx=None):
+        counter[0] += 1
+        return {"type": "invoke", "f": "create-cat",
+                "value": f"cat-{counter[0]}"}
+
+    return {
+        "client": InternalClient(),
+        "checker": jchecker.compose({
+            "internal": internal_checker(),
+            "stats": jchecker.stats(),
+        }),
+        "generator": gen.clients(gen.limit(
+            int(o.get("ops") or 200), create)),
+    }
+
+
 WORKLOADS = {
     "bank": bank_workload,
     "set": set_workload,
     "pages": pages_workload,
     "monotonic": monotonic_workload,
     "multimonotonic": multimonotonic_workload,
+    "g2": g2_workload,
+    "register": register_workload,
+    "internal": internal_workload,
 }
 
 

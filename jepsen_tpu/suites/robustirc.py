@@ -19,7 +19,10 @@ reference's primary-first singlenode bootstrap then join
 from __future__ import annotations
 
 import hashlib
+import itertools
 import json
+import os
+import ssl
 import urllib.request
 from typing import Any, Optional
 
@@ -31,22 +34,40 @@ from .. import control as c
 from . import std_generator
 
 PORT = 13001
-SCHEME = "http"
+# The real network talks TLS with a self-signed cert (the reference
+# posts with :insecure? true); stubs speak the same protocol over
+# plain http. --scheme selects.
+SCHEME = "https"
 CHANNEL = "#jepsen"
+
+# Unique nick per session: nicks are global IRC state, so two clients
+# on one node (concurrency > nodes, or re-open after a process crash)
+# must never collide — a NICK rejection silently voids every later
+# TOPIC post.
+_NICKS = itertools.count(1)
 
 
 class RobustSession:
     """One bridge session (robustirc.clj:103-136)."""
 
     def __init__(self, host: str, port: Optional[int] = None,
-                 timeout: float = 10.0):
+                 timeout: float = 10.0, scheme: Optional[str] = None):
         if port is None:
             port = PORT
-        self.base = f"{SCHEME}://{host}:{port}/robustirc/v1"
+        scheme = scheme or SCHEME
+        self.base = f"{scheme}://{host}:{port}/robustirc/v1"
         self.timeout = timeout
+        # Self-signed cert: verification off, like the reference's
+        # :insecure? true (robustirc.clj:105-110).
+        self.ctx = ssl._create_unverified_context() \
+            if scheme == "https" else None
         res = self._post("/session", {}, auth=None)
         self.sid = res["Sessionid"]
         self.auth = res["Sessionauth"]
+
+    def _open(self, req):
+        return urllib.request.urlopen(req, timeout=self.timeout,
+                                      context=self.ctx)
 
     def _post(self, path: str, body: dict, auth: Optional[str]) -> dict:
         req = urllib.request.Request(
@@ -54,7 +75,7 @@ class RobustSession:
             headers={"Content-Type": "application/json",
                      **({"X-Session-Auth": auth} if auth else {})},
             method="POST")
-        with urllib.request.urlopen(req, timeout=self.timeout) as r:
+        with self._open(req) as r:
             out = r.read().decode()
         return json.loads(out) if out else {}
 
@@ -71,7 +92,7 @@ class RobustSession:
         req = urllib.request.Request(
             f"{self.base}/{self.sid}/messages?lastseen=0.0",
             headers={"X-Session-Auth": self.auth})
-        with urllib.request.urlopen(req, timeout=self.timeout) as r:
+        with self._open(req) as r:
             body = r.read().decode()
         # Stream of newline-separated JSON message objects.
         return [json.loads(line) for line in body.splitlines() if line]
@@ -90,15 +111,17 @@ class SetClient(jclient.Client):
     """add -> TOPIC #jepsen :<n>; read -> all topic ints seen
     (robustirc.clj:150-180)."""
 
-    def __init__(self, session: Optional[RobustSession] = None):
+    def __init__(self, session: Optional[RobustSession] = None,
+                 scheme: Optional[str] = None):
         self.session = session
+        self.scheme = scheme
 
     def open(self, test, node):
-        s = RobustSession(str(node))
-        s.post_message(f"NICK n{abs(hash(str(node))) % 1000}")
+        s = RobustSession(str(node), scheme=self.scheme)
+        s.post_message(f"NICK j{os.getpid() % 100000}x{next(_NICKS)}")
         s.post_message("USER j j j j")
         s.post_message(f"JOIN {CHANNEL}")
-        return SetClient(s)
+        return SetClient(s, self.scheme)
 
     def invoke(self, test, op):
         if op["f"] == "add":
@@ -139,26 +162,30 @@ class RobustIrcDB(jdb.DB, jdb.Process, jdb.LogFiles):
         with c.su():
             c.exec("rm", "-rf", "/var/lib/robustirc")
             c.exec("mkdir", "-p", "/var/lib/robustirc")
+        self.start(test, node, bootstrap=True)
+
+    def start(self, test, node, bootstrap: bool = False):
+        """Relaunch the daemon only — a restart after a nemesis kill
+        must keep the node's Raft state and rejoin, never re-wipe or
+        re-bootstrap (-singlenode is for the FIRST primary start only,
+        robustirc.clj:44-80)."""
         primary = test["nodes"][0]
         common = [
             "-listen", f"{node}:{PORT}",
             "-network_password", "secret",
             "-network_name", "jepsen",
         ]
+        if bootstrap and node == primary:
+            extra = ["-singlenode"]
+        else:
+            join_to = primary if node != primary else \
+                next((n for n in test["nodes"] if n != node), primary)
+            extra = ["-join", f"{join_to}:{PORT}"]
         with c.su():
-            if node == primary:
-                cu.start_daemon(
-                    {"logfile": self.LOG, "pidfile": self.PID,
-                     "chdir": "/var/lib/robustirc"},
-                    self.BIN, *common, "-singlenode")
-            else:
-                cu.start_daemon(
-                    {"logfile": self.LOG, "pidfile": self.PID,
-                     "chdir": "/var/lib/robustirc"},
-                    self.BIN, *common, "-join", f"{primary}:{PORT}")
-
-    def start(self, test, node):
-        self.setup(test, node)
+            cu.start_daemon(
+                {"logfile": self.LOG, "pidfile": self.PID,
+                 "chdir": "/var/lib/robustirc"},
+                self.BIN, *common, *extra)
 
     def kill(self, test, node):
         cu.grepkill("robustirc")
@@ -181,7 +208,7 @@ def set_workload(opts: Optional[dict] = None) -> dict:
         return {"type": "invoke", "f": "add", "value": counter[0]}
 
     return {
-        "client": SetClient(),
+        "client": SetClient(scheme=o.get("scheme")),
         "checker": jchecker.compose({
             "set": jchecker.set_checker(),
             "stats": jchecker.stats(),
@@ -214,6 +241,9 @@ def test_fn(opts: dict) -> dict:
 
 def _add_opts(p):
     p.add_argument("--ops", type=int, default=200)
+    p.add_argument("--scheme", choices=["http", "https"], default=None,
+                   help="bridge scheme (default https, the real "
+                        "network's self-signed TLS)")
 
 
 def main(argv=None):

@@ -1614,6 +1614,22 @@ class FaunaStub(BaseHTTPRequestHandler):
                     continue
                 out.append({"value": data.get("value")})
             return out
+        if "not" in x:
+            return not cls._eval(x["not"], now, snap)
+        if "eq" in x:
+            a, b = (cls._eval(e, now, snap) for e in x["eq"])
+            return a == b
+        if "abort" in x:
+            raise _FaunaErr(x["abort"])
+        if "exists_match" in x:
+            m = x["exists_match"]
+            for (kcls, rid), _v in cls.instances.items():
+                if kcls != m["class"]:
+                    continue
+                data = cls._visible((kcls, rid), snap)
+                if data is not None and data.get("key") == m["term"]:
+                    return True
+            return False
         if "inc" in x:
             r = x["inc"]["ref"]
             key = (r["class"], r["id"])
@@ -1664,40 +1680,44 @@ class _FaunaErr(Exception):
         return str(self)
 
 
+@pytest.fixture()
+def fauna(monkeypatch):
+    from jepsen_tpu.suites import faunadb as fdb
+
+    FaunaStub.reset()
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), FaunaStub)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    monkeypatch.setattr(fdb, "PORT", srv.server_address[1])
+    yield fdb
+    srv.shutdown()
+    srv.server_close()
+
+
+def _run_fauna(fdb, tmp_path, workload, opts=None, concurrency=4):
+    test = dict(noop_test())
+    wl = fdb.WORKLOADS[workload](dict(opts or {}))
+    test.update(
+        name=f"faunadb-{workload}-stub",
+        nodes=["127.0.0.1"],
+        concurrency=concurrency,
+        **{"store-root": str(tmp_path)},
+        **{k: v for k, v in wl.items()
+           if k not in ("generator", "final-generator")},
+    )
+    g = wl["generator"]
+    if workload == "bank":
+        # wbank.test's generator is unbounded (the suite's
+        # std_generator time-limits it in test_fn).
+        g = gen.clients(gen.limit(int((opts or {}).get("ops") or 40), g))
+    if wl.get("final-generator") is not None:
+        g = gen.phases(g, wl["final-generator"])
+    test["generator"] = g
+    return core.run(test)
+
+
 class TestFaunaSuite:
-    @pytest.fixture()
-    def fauna(self, monkeypatch):
-        from jepsen_tpu.suites import faunadb as fdb
-
-        FaunaStub.reset()
-        srv = ThreadingHTTPServer(("127.0.0.1", 0), FaunaStub)
-        threading.Thread(target=srv.serve_forever, daemon=True).start()
-        monkeypatch.setattr(fdb, "PORT", srv.server_address[1])
-        yield fdb
-        srv.shutdown()
-        srv.server_close()
-
     def _run(self, fdb, tmp_path, workload, opts=None, concurrency=4):
-        test = dict(noop_test())
-        wl = fdb.WORKLOADS[workload](dict(opts or {}))
-        test.update(
-            name=f"faunadb-{workload}-stub",
-            nodes=["127.0.0.1"],
-            concurrency=concurrency,
-            **{"store-root": str(tmp_path)},
-            **{k: v for k, v in wl.items()
-               if k not in ("generator", "final-generator")},
-        )
-        g = wl["generator"]
-        if workload == "bank":
-            # wbank.test's generator is unbounded (the suite's
-            # std_generator time-limits it in test_fn).
-            g = gen.clients(gen.limit(int((opts or {}).get("ops") or 40),
-                                      g))
-        if wl.get("final-generator") is not None:
-            g = gen.phases(g, wl["final-generator"])
-        test["generator"] = g
-        return core.run(test)
+        return _run_fauna(fdb, tmp_path, workload, opts, concurrency)
 
     def test_bank_against_stub(self, fauna, tmp_path):
         res = self._run(fauna, tmp_path, "bank", {"ops": 60})
@@ -2021,7 +2041,7 @@ class TestRobustIrcSuite:
 
     def test_set_against_stub(self, irc, tmp_path):
         test = dict(noop_test())
-        wl = irc.WORKLOADS["set"]({"ops": 40})
+        wl = irc.WORKLOADS["set"]({"ops": 40, "scheme": "http"})
         test.update(
             name="robustirc-stub",
             nodes=["127.0.0.1"],
@@ -2171,3 +2191,37 @@ class TestLogCabinSuite:
         assert any("--bootstrap" in cmd for cmd in cmds)
         assert any("Reconfigure" in cmd and "set" in cmd
                    for cmd in cmds)
+
+
+class TestFaunaExtraWorkloads:
+    """g2 / register / internal (the rest of runner.clj's workload
+    map); shares the module-level fauna fixture/runner."""
+
+    def _run(self, fdb, tmp_path, workload, opts=None):
+        return _run_fauna(fdb, tmp_path, workload, opts)
+
+    def test_g2_against_stub(self, fauna, tmp_path):
+        res = self._run(fauna, tmp_path, "g2", {"ops": 40})
+        assert res["results"]["valid"] is True, res["results"]
+        # The serializable stub must admit at most one insert per key,
+        # and at least one key saw a successful insert.
+        assert res["results"]["adya-g2"]["legal_count"] > 0
+        assert res["results"]["adya-g2"]["illegal_count"] == 0
+
+    def test_register_against_stub(self, fauna, tmp_path):
+        res = self._run(fauna, tmp_path, "register",
+                        {"keys": 2, "ops_per_key": 20})
+        assert res["results"]["valid"] is True, res["results"]
+        cas_ok = [op for op in res["history"]
+                  if op.f == "cas" and op.type == "ok"]
+        cas_fail = [op for op in res["history"]
+                    if op.f == "cas" and op.type == "fail"]
+        assert cas_ok or cas_fail, "no cas decisions at all"
+
+    def test_internal_against_stub(self, fauna, tmp_path):
+        res = self._run(fauna, tmp_path, "internal", {"ops": 30})
+        assert res["results"]["valid"] is True, res["results"]
+        ok = [op for op in res["history"]
+              if op.f == "create-cat" and op.type == "ok"]
+        assert ok and all(op.value["name"] in op.value["after"]
+                          for op in ok)

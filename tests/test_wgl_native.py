@@ -183,3 +183,26 @@ class TestNativeDifferential:
             if bfs is not None and bfs["valid"] != "unknown":
                 assert dfs["valid"] == bfs["valid"], (i, dfs, bfs)
         assert widened, "no history exercised the second open word"
+
+
+def test_dfs_cooperative_cancel():
+    """The competition race's loser cancellation: a cancel flag set
+    before the search makes the DFS return 'unknown' promptly instead
+    of grinding to its config budget."""
+    import ctypes
+    import time
+
+    model = CasRegister(init=0)
+    h = perturb_history(random.Random(7), random_register_history(
+        random.Random(2026), n_ops=4000, n_procs=10, cas=True,
+        crash_p=0.002, fail_p=0.02))
+    enc = encode_history(model, h)
+    flag = ctypes.c_int32(1)  # pre-cancelled
+    t0 = time.perf_counter()
+    res = wgl_c.check_encoded_native(enc, cancel=flag)
+    dt = time.perf_counter() - t0
+    assert res is not None and res["valid"] == "unknown"
+    assert dt < 2.0, f"cancelled search still ran {dt:.1f}s"
+    # And without the flag the same search decides definitively.
+    res2 = wgl_c.check_encoded_native(enc)
+    assert res2["valid"] in (True, False)
