@@ -132,11 +132,15 @@ def select_field(r: dict, field: str, default: Any = None) -> dict:
 
 
 def guarded_cas(r: dict, field: str, expect: Any, new: Any) -> dict:
-    """register.clj's cas txn: update iff the field equals expect, else
-    abort."""
-    return {"if": {"eq": [{"select": ["data", field], "from": get(r)},
-                          expect]},
-            "then": update(r, {field: new}),
+    """register.clj's cas txn: update iff the instance exists AND the
+    field equals expect, else abort — a cas against a missing register
+    is a DETERMINATE failure, not an indeterminate error."""
+    return {"if": exists(r),
+            "then": {
+                "if": {"eq": [{"select": ["data", field],
+                               "from": get(r)}, expect]},
+                "then": update(r, {field: new}),
+                "else": {"abort": "transaction aborted"}},
             "else": {"abort": "transaction aborted"}}
 
 

@@ -152,3 +152,34 @@ class TestShardedCheckpoint:
                                     checkpoint_path=ck)
         assert got["valid"] == want  # resumed from scratch, not poisoned
         assert "resumed_from_level" not in got
+
+
+def test_sharded_refutation_carries_stuck_configs():
+    """A sharded-driver refutation includes the final frontier's
+    configurations with per-op reasons, like the single-device path."""
+    import random
+
+    from jepsen_tpu.models import CasRegister
+    from jepsen_tpu.parallel import make_mesh
+    from jepsen_tpu.parallel.frontier import check_history_sharded
+    from jepsen_tpu.testing import perturb_history, random_register_history
+
+    mesh = make_mesh()
+    model = CasRegister(init=0)
+    rng = random.Random(8)
+    seen = 0
+    for _ in range(20):
+        h = perturb_history(rng, random_register_history(
+            rng, n_ops=40, n_procs=4, cas=True, crash_p=0.08))
+        res = check_history_sharded(model, h, mesh=mesh, f_total=256)
+        if res["valid"] is not False:
+            continue
+        seen += 1
+        stuck = res.get("stuck_configs")
+        assert stuck, res
+        assert all(cfg["pending"] and all(p.get("why")
+                                          for p in cfg["pending"])
+                   for cfg in stuck)
+        if seen >= 2:
+            break
+    assert seen >= 1

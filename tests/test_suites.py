@@ -1436,7 +1436,11 @@ class TestLegacySuites:
                 generator=gen.clients(gen.limit(40, wl["generator"])),
             )
             res = core.run(test)
-            assert res["results"]["valid"] is True, res["results"]
+            # Assert on the linearizability sub-result: the composed
+            # stats checker requires >=1 ok per f, and with random cas
+            # values in 0..4 a 40-op run occasionally never matches.
+            assert res["results"]["linear"]["valid"] is True, \
+                res["results"]
         finally:
             rs.PORT = old_port
             srv.shutdown()
@@ -2211,12 +2215,17 @@ class TestFaunaExtraWorkloads:
     def test_register_against_stub(self, fauna, tmp_path):
         res = self._run(fauna, tmp_path, "register",
                         {"keys": 2, "ops_per_key": 20})
-        assert res["results"]["valid"] is True, res["results"]
-        cas_ok = [op for op in res["history"]
-                  if op.f == "cas" and op.type == "ok"]
-        cas_fail = [op for op in res["history"]
-                    if op.f == "cas" and op.type == "fail"]
-        assert cas_ok or cas_fail, "no cas decisions at all"
+        # The linearizability verdict is the point; the composed stats
+        # checker can legitimately flag a run where no cas happened to
+        # match (values are random in 0..4), so assert on `linear`.
+        assert res["results"]["linear"]["valid"] is True, res["results"]
+        cas_decided = [op for op in res["history"]
+                       if op.f == "cas" and op.type in ("ok", "fail")]
+        assert cas_decided, "no cas decisions at all"
+        # Every cas reached a DETERMINATE verdict (a cas against a
+        # missing register must abort cleanly, never :info).
+        assert not [op for op in res["history"]
+                    if op.f == "cas" and op.type == "info"]
 
     def test_internal_against_stub(self, fauna, tmp_path):
         res = self._run(fauna, tmp_path, "internal", {"ops": 30})

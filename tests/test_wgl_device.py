@@ -452,3 +452,45 @@ class TestCompetition:
         chk = C.linearizable(model=CasRegister(init=0))
         res = chk.check({"checker_backend": "competition"}, h, {})
         assert res["valid"] is True
+
+
+def test_multiword_open_sets_device_vs_native():
+    """KO >= 2 (open-slot space past one 32-bit word): the candidate
+    pre-selection's arithmetic one-hot masks must place open bits in
+    the right word. Small histories PADDED to a KO=2 shape keep the
+    compile cheap; differential against the native engine."""
+    import random
+
+    from jepsen_tpu.models import CasRegister
+    from jepsen_tpu.ops import wgl, wgl_c
+    from jepsen_tpu.ops.encode import encode_history
+    from jepsen_tpu.testing import perturb_history, random_register_history
+
+    model = CasRegister(init=0)
+    rng = random.Random(91)
+    exercised = word1 = 0
+    for i in range(4):
+        # Dense crashes so nO exceeds 32: open bits must actually LAND
+        # in the second word, not just pad it with zeros. Histories are
+        # valid by construction — crash-heavy REFUTATIONS explode the
+        # open powerset and take minutes on the CPU backend, while a
+        # misplaced word-1 bit corrupts accepts just as surely.
+        h = random_register_history(rng, n_ops=80, n_procs=4,
+                                    cas=True, crash_p=0.8)
+        enc = encode_history(model, h)
+        n_open = int(enc.skippable.sum())
+        word1 += n_open > 32
+        nat = wgl_c.check_encoded_native(enc)
+        if nat is None or nat["valid"] == "unknown":
+            continue
+        assert nat["valid"] is True  # valid by construction
+        # ONE shared shape bucket with a two-word open set.
+        # Few capacity rungs: each rung is a separate CPU compile.
+        dev = wgl.check_encoded_device(enc, pad_to=(64, 2, 128, 64),
+                                       f_schedule=(64, 1024, 8192))
+        if dev["valid"] == "unknown":
+            continue
+        assert dev["valid"] == nat["valid"], (i, dev, nat)
+        exercised += 1
+    assert exercised >= 3, "too few KO=2 decisions reached"
+    assert word1 >= 3, "open bits never reached the second word"
