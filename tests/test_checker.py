@@ -117,13 +117,28 @@ def test_stats():
         ),
         {},
     )
-    assert res["valid"] is False
+    # An :f with zero oks is indeterminate, never False — fail/info are
+    # legitimate outcomes and a short run may simply not have succeeded
+    # yet (checker.clj:163-166's documented ":unknown" semantics).
+    assert res["valid"] == "unknown"
     assert res["count"] == 5
     assert (res["ok_count"], res["fail_count"], res["info_count"]) == (1, 3, 1)
     assert res["by_f"]["foo"] == {
         "valid": True, "count": 2, "ok_count": 1, "fail_count": 1, "info_count": 0,
     }
-    assert res["by_f"]["bar"]["valid"] is False
+    assert res["by_f"]["bar"]["valid"] == "unknown"
+
+
+def test_stats_never_false():
+    # merge of [True, "unknown"] is "unknown", and an all-ok history is
+    # True; stats alone can never flip a composed verdict to False.
+    all_ok = C.stats().check({}, h([ok(0, "foo", None), ok(1, "bar", None)]), {})
+    assert all_ok["valid"] is True
+    composed = C.compose({"stats": C.stats()}).check(
+        {}, h([ok(0, "foo", None), fail(0, "bar", None)]), {}
+    )
+    assert composed["valid"] == "unknown"
+    assert composed["valid"] is not False
 
 
 # -- queue (checker_test.clj:65-85) ------------------------------------------
