@@ -33,6 +33,8 @@ from typing import Iterable, Optional
 
 from .graph import (
     DepGraph,
+    PROC,
+    RT,
     RW,
     WR,
     WW,
@@ -53,6 +55,16 @@ _EXPANSION = {
 
 DEFAULT_ANOMALIES = ("G1", "G2", "internal")
 
+# The cycle-class anomalies that acquire "-realtime"/"-process" suffixed
+# variants when additional graphs are composed (append.clj:49-50).
+CYCLE_CLASSES = frozenset({"G0", "G1c", "G-single", "G2"})
+
+# Dependency-only edges; additional-graph bits are excluded from the
+# pure (plain-serializability) passes.
+DEP_MASK = WW | WR | RW
+
+EXTRA_BITS = {"realtime": RT, "process": PROC}
+
 # Device closures pay off once the matmul amortizes dispatch; below this
 # SCC size the host BFS wins.
 DEVICE_MIN_TXNS = 512
@@ -65,7 +77,9 @@ def expand_anomalies(anomalies: Iterable[str]) -> set:
     return out
 
 
-def cycle_anomalies(g: DepGraph, device: Optional[bool] = None) -> dict:
+def cycle_anomalies(g: DepGraph, device: Optional[bool] = None,
+                    extra: Iterable[str] = (),
+                    n_txns: Optional[int] = None) -> dict:
     """Classify cycles in a typed dependency graph. Returns
     {anomaly-type: [witness]} where a witness is {"cycle": [txn indices],
     "kinds": [edge kinds along it]}.
@@ -79,73 +93,230 @@ def cycle_anomalies(g: DepGraph, device: Optional[bool] = None) -> dict:
     at all; queries inside large components run as ONE dense bf16 MXU
     closure of the component-induced subgraph (memory bounded by the
     largest SCC, not the history). ``device``: None = auto (MXU for
-    components ≥ DEVICE_MIN_TXNS), False = host BFS only."""
+    components ≥ DEVICE_MIN_TXNS), False = host BFS only.
+
+    ``extra`` composes additional precedence graphs already present as
+    RT/PROC edges in ``g`` (append.clj:49-50): for each name in
+    ("realtime", "process"), a second pass searches cycles over
+    dependency∪extra edges and reports them as the suffixed anomaly
+    ("G-single-realtime", …) — strict-serializability violations that
+    plain serializability cannot see. A suffixed pass for a class runs
+    only when the pure class was not found, which guarantees every
+    suffixed witness genuinely uses an extra edge. ``n_txns`` marks the
+    boundary between txn nodes and the realtime timeline's aux chain
+    nodes; witnesses splice aux nodes back out."""
     n = g.n
     if n == 0 or not g.edges:
         return {}
     use_device = device if device is not None else True
-    succ_ww = succ_lists(g.edges, n, WW)
-    succ_wwr = succ_lists(g.edges, n, WW | WR)
-    succ_full = succ_lists(g.edges, n, 0xFF)
-    ww_sccs = sccs_lists(succ_ww)
-    wwr_sccs = sccs_lists(succ_wwr)
-    full_sccs = sccs_lists(succ_full)
-
+    nt = n_txns if n_txns is not None else n
     out: dict = {}
-    if ww_sccs:
-        cyc = find_cycle_lists(succ_ww, ww_sccs[0])
-        if cyc:
-            out.setdefault("G0", []).append(_witness(g, cyc))
+    _taxonomy_pass(g, out, 0, "", use_device, nt)
+    for name in extra:
+        bit = EXTRA_BITS[name]
+        if any(k & bit for k in g.edges.values()):
+            _taxonomy_pass(g, out, bit, name, use_device, nt)
+    return out
 
-    # G1c: a wr edge (a,b) on a ww|wr cycle <=> a,b in one wwr-SCC (the
-    # edge itself closes the loop).
-    wwr_comp: dict = {}
-    for ci, comp in enumerate(wwr_sccs):
-        for v in comp:
-            wwr_comp[v] = ci
-    for (a, b), kind in sorted(g.edges.items()):
-        if kind & WR and wwr_comp.get(a) is not None \
-                and wwr_comp.get(a) == wwr_comp.get(b):
-            cyc = find_cycle_with_edge_lists(succ_wwr, a, b)
+
+def _taxonomy_pass(g: DepGraph, out: dict, bit: int, name: str,
+                   use_device: bool, nt: int) -> None:
+    """One taxonomy pass over dependency∪``bit`` edges. ``bit=0`` /
+    ``name=""`` is the pure (plain-serializability) pass; otherwise
+    anomalies report suffixed ("<class>-<name>") and each class runs
+    only when its pure counterpart is absent — then any qualifying
+    cycle necessarily uses a ``bit`` edge (a bit-free cycle would have
+    satisfied the pure pass), so the suffix is honest.
+
+    G0 is the one structural divergence between the passes: pure G0 is
+    any WW SCC; a suffixed G0 must pivot on a ``bit`` edge inside a
+    WW|bit SCC, since the SCC-exists criterion alone cannot show the
+    cycle uses an extra edge."""
+    sfx = f"-{name}" if name else ""
+    n, edges = g.n, g.edges
+
+    succ_ww = succ_lists(edges, n, WW | bit)
+    if not name:
+        ww_sccs = sccs_lists(succ_ww)
+        if ww_sccs:
+            cyc = find_cycle_lists(succ_ww, ww_sccs[0])
             if cyc:
-                out.setdefault("G1c", []).append(_witness(g, cyc))
-                break
+                out.setdefault("G0", []).append(_witness(g, cyc, nt))
+    elif "G0" not in out:
+        comp = _comp_index(sccs_lists(succ_ww))
+        for (a, b), k in sorted(edges.items()):
+            if k & bit and comp.get(a) is not None \
+                    and comp.get(a) == comp.get(b):
+                cyc = find_cycle_with_edge_lists(succ_ww, a, b)
+                if cyc:
+                    out.setdefault(f"G0{sfx}", []).append(
+                        _witness(g, cyc, nt))
+                    break
+
+    # G1c: a wr edge (a,b) on a ww|wr(|bit) cycle <=> a,b in one SCC of
+    # that mask (the edge itself closes the loop).
+    succ_wwr = succ_lists(edges, n, WW | WR | bit)
+    if not name or "G1c" not in out:
+        comp = _comp_index(sccs_lists(succ_wwr))
+        for (a, b), k in sorted(edges.items()):
+            if k & WR and comp.get(a) is not None \
+                    and comp.get(a) == comp.get(b):
+                cyc = find_cycle_with_edge_lists(succ_wwr, a, b)
+                if cyc:
+                    out.setdefault(f"G1c{sfx}", []).append(
+                        _witness(g, cyc, nt))
+                    break
 
     # rw-closing cycles. An rw edge (a,b) is:
-    # - G-single when b reaches a via ww|wr edges (that path + the rw
-    #   edge is a cycle, so it lies inside ONE full-graph SCC — the
+    # - G-single when b reaches a via ww|wr(|bit) edges (that path + the
+    #   rw edge is a cycle, so it lies inside ONE full-graph SCC — the
     #   query runs within the component);
     # - G2 when b reaches a only with further rw edges (same full-SCC
     #   membership, not wwr-reachable).
-    reach = SccReach(succ_wwr, full_sccs, use_device,
+    want_single = not name or "G-single" not in out
+    want_g2 = not name or "G2" not in out
+    if not (want_single or want_g2):
+        return
+    succ_full = succ_lists(edges, n, DEP_MASK | bit)
+    reach = SccReach(succ_wwr, sccs_lists(succ_full), use_device,
                      device_min=DEVICE_MIN_TXNS)
     g_single = None
     g2 = None
-    for (a, b), kind in sorted(g.edges.items()):
+    for (a, b), kind in sorted(edges.items()):
         if not kind & RW:
             continue
         same, comp_id = reach.same_comp(a, b)
         if not same:
             continue
         wwr_back = reach.query(comp_id, b, a)
-        if g_single is None and wwr_back:
+        if want_single and g_single is None and wwr_back:
             cyc = find_cycle_with_edge_lists(succ_wwr, a, b)
             if cyc:
-                g_single = _witness(g, cyc)
-        if g2 is None and not wwr_back:
+                g_single = _witness(g, cyc, nt)
+        if want_g2 and g2 is None and not wwr_back:
             cyc = find_cycle_with_edge_lists(succ_full, a, b)
             if cyc:
-                g2 = _witness(g, cyc)
-        if g_single is not None and g2 is not None:
+                g2 = _witness(g, cyc, nt)
+        if (g_single is not None or not want_single) \
+                and (g2 is not None or not want_g2):
             break
     if g_single is not None:
-        out.setdefault("G-single", []).append(g_single)
+        out.setdefault(f"G-single{sfx}", []).append(g_single)
     if g2 is not None:
-        out.setdefault("G2", []).append(g2)
-    return out
+        out.setdefault(f"G2{sfx}", []).append(g2)
 
 
-KIND_LOOKUP = {WW: "ww", WR: "wr", RW: "rw"}
+def _comp_index(sccs: list[list[int]]) -> dict:
+    comp: dict = {}
+    for ci, c in enumerate(sccs):
+        for v in c:
+            comp[v] = ci
+    return comp
+
+
+def _check_extra(additional_graphs) -> tuple:
+    """Validate an additional-graphs option up front — a typo'd name (or
+    a bare string, which iterates as characters) must fail loudly at the
+    check() front door, not as a KeyError deep in the cycle search."""
+    extra = tuple(additional_graphs)
+    for name in extra:
+        if name not in EXTRA_BITS:
+            raise ValueError(
+                f"unknown additional graph {name!r}; expected a list of "
+                f"{sorted(EXTRA_BITS)}")
+    return extra
+
+
+def _order_fn(history, intervals: Optional[dict]):
+    """Per-process program-order key for process-graph edges: paired
+    invoke indexes when available, else the op's position in the
+    original history (one process's ops complete sequentially, so
+    history position preserves its program order — node ids do NOT,
+    since info nodes are renumbered after all ok nodes)."""
+    if intervals is not None:
+        def order_of(op, node):
+            iv = intervals.get(id(op))
+            return iv[0] if iv is not None else node
+    else:
+        pos = {id(op): i for i, op in enumerate(history)}
+
+        def order_of(op, node):
+            return pos.get(id(op), node)
+    return order_of
+
+
+def paired_intervals(history) -> Optional[dict]:
+    """Map id(completion) -> (invoke_index, completion_index) from a
+    paired History; None for bare completion lists (realtime edges are
+    then underivable — the reference's realtime-graph likewise needs
+    full histories)."""
+    try:
+        from ..history import History
+
+        if not isinstance(history, History):
+            return None
+        return {
+            id(iv.completion): (iv.invoke.index, iv.completion.index)
+            for iv in history.pairs()
+            if iv.completion is not None
+        }
+    except Exception:
+        return None
+
+
+def add_realtime_edges(g: DepGraph, intervals) -> None:
+    """Compose realtime precedence into ``g`` as RT edges.
+
+    ``intervals``: (node, invoke_index, ret_index|None) per committed
+    txn. ret None = indeterminate (:info): such a txn may take effect
+    arbitrarily late, so it realtime-precedes nothing (but can still be
+    preceded via its invocation).
+
+    Timeline-chain construction, O(n) edges where the naive precedence
+    relation is O(n²): walking events in index order, consecutive
+    completions coalesce into one aux chain node c (txn→c), chain nodes
+    link forward (c→c'), and each invocation hangs off the latest chain
+    node (c→txn). A txn path a→…→b exists iff ret(a) < inv(b) — exactly
+    the realtime order. Aux nodes live past the txn range; witnesses
+    splice them out."""
+    events = []
+    for node, inv, ret in intervals:
+        events.append((inv, 0, node))
+        if ret is not None:
+            events.append((ret, 1, node))
+    events.sort()
+    chain = None
+    chain_open = False
+    for _idx, is_ret, node in events:
+        if is_ret:
+            if not chain_open:
+                new = g.n
+                g.n += 1
+                if chain is not None:
+                    g.add(chain, new, RT)
+                chain, chain_open = new, True
+            g.add(node, chain, RT)
+        else:
+            if chain is not None:
+                g.add(chain, node, RT)
+            chain_open = False
+
+
+def add_process_edges(g: DepGraph, items) -> None:
+    """Compose per-process program order into ``g`` as PROC edges.
+    ``items``: (node, process, order_index) per committed txn; each
+    process's txns chain in order_index order."""
+    by_proc: dict = {}
+    for node, proc, idx in items:
+        by_proc.setdefault(proc, []).append((idx, node))
+    for seq in by_proc.values():
+        seq.sort()
+        for (_, a), (_, b) in zip(seq, seq[1:]):
+            g.add(a, b, PROC)
+
+
+KIND_LOOKUP = {WW: "ww", WR: "wr", RW: "rw", RT: "realtime",
+               PROC: "process"}
 
 
 # Shared op accessors: checker layers accept both Op records and plain
@@ -166,13 +337,37 @@ def op_proc(op):
     return op.process if hasattr(op, "process") else op.get("process")
 
 
-def _witness(g: DepGraph, cycle: list[int]) -> dict:
+def _witness(g: DepGraph, cycle: list[int],
+             n_txns: Optional[int] = None) -> dict:
     if cycle[0] != cycle[-1]:
         cycle = cycle + [cycle[0]]
+    limit = n_txns if n_txns is not None else g.n
+    if any(v >= limit for v in cycle):
+        # Splice the realtime timeline's aux chain nodes out: a run of
+        # chain hops between two txns collapses to one "realtime" step.
+        # Cycle searches start from a dependency-edge endpoint, so
+        # cycle[0] is always a txn.
+        out_nodes = [cycle[0]]
+        kinds: list[list[str]] = []
+        prev = cycle[0]
+        through_aux = False
+        for v in cycle[1:]:
+            if v >= limit:
+                through_aux = True
+                continue
+            if through_aux:
+                kinds.append(["realtime"])
+            else:
+                k = g.edges.get((prev, v), 0)
+                kinds.append([KIND_LOOKUP[b] for b in KIND_LOOKUP if k & b])
+            out_nodes.append(v)
+            prev = v
+            through_aux = False
+        return {"cycle": out_nodes, "kinds": kinds}
     kinds = []
     for i in range(len(cycle) - 1):
         k = g.edges.get((cycle[i], cycle[i + 1]), 0)
-        kinds.append([KIND_LOOKUP[b] for b in (WW, WR, RW) if k & b])
+        kinds.append([KIND_LOOKUP[b] for b in KIND_LOOKUP if k & b])
     return {"cycle": cycle, "kinds": kinds}
 
 

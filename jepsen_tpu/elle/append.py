@@ -28,9 +28,10 @@ from __future__ import annotations
 
 from typing import Any, Iterable, Optional
 
-from . import DEFAULT_ANOMALIES, DepGraph, RW, WR, WW, cycle_anomalies, \
-    expand_anomalies, op_f as _f, op_type as _type, op_value as _value, \
-    result_map
+from . import CYCLE_CLASSES, DEFAULT_ANOMALIES, DepGraph, RW, WR, WW, \
+    _check_extra, _order_fn, add_process_edges, add_realtime_edges, \
+    cycle_anomalies, expand_anomalies, op_f as _f, op_proc as _proc, \
+    op_type as _type, op_value as _value, paired_intervals, result_map
 from ..history import FAIL, INFO, OK
 
 
@@ -39,10 +40,22 @@ def _mops(op):
 
 
 def check(history, anomalies: Iterable[str] = DEFAULT_ANOMALIES,
-          device: Optional[bool] = None) -> dict:
+          device: Optional[bool] = None,
+          additional_graphs: Iterable[str] = ()) -> dict:
     """Check a list-append history. Mirrors elle.list-append/check's
-    result shape: {"valid", "anomaly_types", "anomalies"}."""
+    result shape: {"valid", "anomaly_types", "anomalies"}.
+
+    ``additional_graphs`` composes extra precedence orders into the
+    cycle search (append.clj:49-50's :additional-graphs): "realtime"
+    upgrades the verdict to strict serializability (needs a full paired
+    history — bare completion lists set "realtime_unavailable"),
+    "process" to strong session serializability. Violations visible
+    only with the extra edges report as suffixed anomalies
+    ("G-single-realtime", …)."""
     requested = expand_anomalies(anomalies)
+    extra = _check_extra(additional_graphs)
+    for name in extra:
+        requested |= {f"{a}-{name}" for a in requested & CYCLE_CLASSES}
     # Pair completions with their invocations' txn shape: we only need
     # completions (observed values live there).
     oks = [op for op in history if _type(op) == OK and _f(op) == "txn"]
@@ -185,7 +198,30 @@ def check(history, anomalies: Iterable[str] = DEFAULT_ANOMALIES,
                     if u != ri:
                         g.add(ri, u, RW)
 
-    problems.update(cycle_anomalies(g, device=device))
+    rt_unavailable = False
+    if extra:
+        intervals = paired_intervals(history)
+        order_of = _order_fn(history, intervals)
+        nodes = [(node_of_ok[i], oks[i], True) for i in range(len(oks))] \
+            + [(node_of_info[i], infos[i], False) for i in observed_info]
+        if "process" in extra:
+            add_process_edges(g, [
+                (node, _proc(op), order_of(op, node))
+                for node, op, _has_ret in nodes
+            ])
+        if "realtime" in extra:
+            if intervals is None:
+                rt_unavailable = True
+            else:
+                add_realtime_edges(g, [
+                    (node, intervals[id(op)][0],
+                     intervals[id(op)][1] if has_ret else None)
+                    for node, op, has_ret in nodes
+                    if id(op) in intervals
+                ])
+
+    problems.update(cycle_anomalies(g, device=device, extra=extra,
+                                    n_txns=n))
 
     def txn_of(i):
         if i < len(oks):
@@ -195,6 +231,8 @@ def check(history, anomalies: Iterable[str] = DEFAULT_ANOMALIES,
     res = result_map(problems, requested | {
         "duplicate-appends", "incompatible-order", "unknown-value"}, txn_of)
     res["txn_count"] = n
+    if rt_unavailable:
+        res["realtime_unavailable"] = True
     return res
 
 

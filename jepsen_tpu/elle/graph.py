@@ -29,7 +29,14 @@ WW = 1  # write -> write (version order)
 WR = 2  # write -> read  (reader observed writer)
 RW = 4  # read -> write  (anti-dependency: reader missed the next version)
 
-KIND_NAMES = {WW: "ww", WR: "wr", RW: "rw"}
+# Additional precedence graphs (append.clj:49-50's :additional-graphs):
+# composing these with the dependency edges upgrades the verdict from
+# serializability to strict serializability (realtime) / strong session
+# serializability (process).
+RT = 8     # realtime: a's completion strictly before b's invocation
+PROC = 16  # process: consecutive txns of one process, program order
+
+KIND_NAMES = {WW: "ww", WR: "wr", RW: "rw", RT: "realtime", PROC: "process"}
 
 
 class DepGraph:

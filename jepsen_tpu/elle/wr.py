@@ -20,9 +20,10 @@ from __future__ import annotations
 
 from typing import Iterable, Optional
 
-from . import DEFAULT_ANOMALIES, DepGraph, RW, WR, WW, cycle_anomalies, \
-    expand_anomalies, op_f as _f, op_proc as _proc, op_type as _type, \
-    op_value as _value, result_map
+from . import CYCLE_CLASSES, DEFAULT_ANOMALIES, DepGraph, RW, WR, WW, \
+    _check_extra, _order_fn, add_process_edges, add_realtime_edges, \
+    cycle_anomalies, expand_anomalies, op_f as _f, op_proc as _proc, \
+    op_type as _type, op_value as _value, paired_intervals, result_map
 from ..history import FAIL, INFO, OK
 from ..txn import ext_reads, ext_writes
 
@@ -32,28 +33,23 @@ def _ret_index(op):
     return idx if idx is not None else -1
 
 
-def _invocation_indexes(history, oks):
-    """Map id(completion-op) -> invocation index, when the history is a
-    full paired History; None for bare completion lists (then only
-    program-order ww edges are derivable)."""
-    try:
-        from ..history import History
-
-        if not isinstance(history, History):
-            return None
-        return {
-            id(iv.completion): iv.invoke.index
-            for iv in history.pairs()
-            if iv.completion is not None
-        }
-    except Exception:
-        return None
-
-
 def check(history, anomalies: Iterable[str] = DEFAULT_ANOMALIES,
           linearizable_keys: bool = False, sequential_keys: bool = False,
-          device: Optional[bool] = None) -> dict:
+          device: Optional[bool] = None,
+          additional_graphs: Iterable[str] = ()) -> dict:
+    """Check a read/write-register history.
+
+    ``additional_graphs`` composes extra precedence orders into the
+    cycle search (cycle/wr.clj:17-19's :additional-graphs): "realtime"
+    upgrades the verdict to strict serializability (needs a full paired
+    history — bare completion lists set "realtime_unavailable"),
+    "process" to strong session serializability. Violations visible
+    only with the extra edges report as suffixed anomalies
+    ("G-single-realtime", …)."""
     requested = expand_anomalies(anomalies)
+    extra = _check_extra(additional_graphs)
+    for name in extra:
+        requested |= {f"{a}-{name}" for a in requested & CYCLE_CLASSES}
     oks = [op for op in history if _type(op) == OK and _f(op) == "txn"]
     fails = [op for op in history if _type(op) == FAIL and _f(op) == "txn"]
     problems: dict = {}
@@ -105,6 +101,11 @@ def check(history, anomalies: Iterable[str] = DEFAULT_ANOMALIES,
             if w is not None and w != ri:
                 g.add(w, ri, WR)
 
+    intervals = (
+        paired_intervals(history)
+        if extra or linearizable_keys or sequential_keys else None
+    )
+
     if linearizable_keys or sequential_keys:
         # Version order per key. Ordering two writes by raw ok-completion
         # order is UNSOUND for concurrent txns (either order is legal), so
@@ -113,7 +114,6 @@ def check(history, anomalies: Iterable[str] = DEFAULT_ANOMALIES,
         # - linearizable_keys: true realtime precedence — w1's completion
         #   strictly before w2's invocation, when invocation indexes are
         #   recoverable from a full (paired) history.
-        inv_index = _invocation_indexes(history, oks)
         writes_by_key: dict = {}
         for i, op in enumerate(oks):
             for k, v in ext_writes(_value(op) or []).items():
@@ -130,9 +130,9 @@ def check(history, anomalies: Iterable[str] = DEFAULT_ANOMALIES,
                         chains.append((i1, i2))
                     elif (
                         linearizable_keys
-                        and inv_index is not None
-                        and _ret_index(oks[i1]) < inv_index.get(id(oks[i2]),
-                                                               -1)
+                        and intervals is not None
+                        and _ret_index(oks[i1])
+                        < intervals.get(id(oks[i2]), (-1, -1))[0]
                     ):
                         chains.append((i1, i2))
             for i1, i2 in chains:
@@ -153,9 +153,30 @@ def check(history, anomalies: Iterable[str] = DEFAULT_ANOMALIES,
                     if i2 != ri:
                         g.add(ri, i2, RW)
 
-    problems.update(cycle_anomalies(g, device=device))
+    n_txns = len(oks)
+    rt_unavailable = False
+    if extra:
+        order_of = _order_fn(history, intervals)
+        if "process" in extra:
+            add_process_edges(g, [
+                (i, _proc(op), order_of(op, i)) for i, op in enumerate(oks)
+            ])
+        if "realtime" in extra:
+            if intervals is None:
+                rt_unavailable = True
+            else:
+                add_realtime_edges(g, [
+                    (i, intervals[id(op)][0], intervals[id(op)][1])
+                    for i, op in enumerate(oks)
+                    if id(op) in intervals
+                ])
+
+    problems.update(cycle_anomalies(g, device=device, extra=extra,
+                                    n_txns=n_txns))
     res = result_map(
         problems, requested | {"duplicate-writes"}, lambda i: repr(oks[i])
     )
-    res["txn_count"] = len(oks)
+    res["txn_count"] = n_txns
+    if rt_unavailable:
+        res["realtime_unavailable"] = True
     return res

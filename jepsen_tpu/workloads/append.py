@@ -25,6 +25,7 @@ def checker(opts: Optional[dict] = None) -> Checker:
         return elle_append.check(
             history, anomalies=anomalies,
             device=o.get("device"),
+            additional_graphs=o.get("additional_graphs", ()),
         )
 
     return checker_fn(chk, "append")

@@ -25,6 +25,7 @@ def checker(opts: Optional[dict] = None) -> Checker:
             linearizable_keys=o.get("linearizable_keys", False),
             sequential_keys=o.get("sequential_keys", False),
             device=o.get("device"),
+            additional_graphs=o.get("additional_graphs", ()),
         )
 
     return checker_fn(chk, "wr")

@@ -213,6 +213,148 @@ class TestWrAnomalies:
         assert "G2" in res["anomaly_types"] or "G-single" in res["anomaly_types"]
 
 
+class TestAdditionalGraphs:
+    """Realtime/process precedence composed into the cycle search
+    (append.clj:49-50's :additional-graphs): histories that are
+    serializable but NOT strict-serializable must be flagged only with
+    the extra edges on, as suffixed anomalies."""
+
+    @staticmethod
+    def _hist(rows):
+        from jepsen_tpu.history import History, Op
+
+        return History([
+            Op(typ, proc, "txn", value, time=i * 1_000_000)
+            for i, (typ, proc, value) in enumerate(rows)
+        ])
+
+    def _stale_append_hist(self, p1=0, p2=1):
+        # T1 appends 1 to x and COMPLETES; T2 then reads x = [] — legal
+        # serializable (T2 before T1), illegal strict-serializable.
+        return self._hist([
+            ("invoke", p1, [["append", "x", 1]]),
+            ("ok", p1, [["append", "x", 1]]),
+            ("invoke", p2, [["r", "x", None]]),
+            ("ok", p2, [["r", "x", []]]),
+        ])
+
+    def test_append_stale_read_strict_ser_only(self):
+        h = self._stale_append_hist()
+        assert ea.check(h)["valid"] is True
+        res = ea.check(h, additional_graphs=["realtime"])
+        assert res["valid"] is False
+        assert res["anomaly_types"] == ["G-single-realtime"]
+        w = res["anomalies"]["G-single-realtime"][0]
+        assert ["realtime"] in w["kinds"]
+        # Aux timeline nodes are spliced out: only txn indices remain.
+        assert all(v < res["txn_count"] for v in w["cycle"])
+
+    def test_append_stale_read_process_graph(self):
+        # Same two txns on ONE process: a session-order violation,
+        # caught by the process graph (no realtime needed).
+        h = self._stale_append_hist(p1=0, p2=0)
+        assert ea.check(h)["valid"] is True
+        res = ea.check(h, additional_graphs=["process"])
+        assert res["valid"] is False
+        assert res["anomaly_types"] == ["G-single-process"]
+
+    def test_append_fresh_read_clean_under_realtime(self):
+        h = self._hist([
+            ("invoke", 0, [["append", "x", 1]]),
+            ("ok", 0, [["append", "x", 1]]),
+            ("invoke", 1, [["r", "x", None]]),
+            ("ok", 1, [["r", "x", [1]]]),
+        ])
+        res = ea.check(h, additional_graphs=["realtime", "process"])
+        assert res["valid"] is True, res
+
+    def test_append_concurrent_stale_read_stays_valid(self):
+        # T2's invocation OVERLAPS T1 — no realtime precedence, so the
+        # stale read is fine even in strict mode.
+        h = self._hist([
+            ("invoke", 0, [["append", "x", 1]]),
+            ("invoke", 1, [["r", "x", None]]),
+            ("ok", 0, [["append", "x", 1]]),
+            ("ok", 1, [["r", "x", []]]),
+        ])
+        res = ea.check(h, additional_graphs=["realtime"])
+        assert res["valid"] is True, res
+
+    def test_bare_completions_realtime_unavailable(self):
+        h = [T([["append", "x", 1]]), T([["r", "x", []]])]
+        res = ea.check(h, additional_graphs=["realtime"])
+        assert res["valid"] is True
+        assert res["realtime_unavailable"] is True
+
+    def test_pure_anomaly_not_double_reported(self):
+        # A genuine G1c (no extra edges needed) reports as plain G1c
+        # even with additional graphs on — never the suffixed variant.
+        h = self._hist([
+            ("invoke", 0, [["append", "x", 1], ["r", "y", None]]),
+            ("ok", 0, [["append", "x", 1], ["r", "y", [1]]]),
+            ("invoke", 1, [["append", "y", 1], ["r", "x", None]]),
+            ("ok", 1, [["append", "y", 1], ["r", "x", [1]]]),
+        ])
+        res = ea.check(h, additional_graphs=["realtime", "process"])
+        assert "G1c" in res["anomaly_types"]
+        assert not any(a.startswith("G1c-") for a in res["anomaly_types"])
+
+    def test_bare_observed_info_process_order(self):
+        # Regression: on a bare completion list, an observed :info txn's
+        # process-order key must come from its HISTORY position, not its
+        # graph node id (info nodes are renumbered after all ok nodes,
+        # which fabricated reversed process edges and a spurious cycle).
+        h = [
+            T([["append", "x", 1]], type="info", process=0),
+            T([["r", "x", [1]]], process=0),
+        ]
+        res = ea.check(h, additional_graphs=["process"])
+        assert res["valid"] is True, res
+
+    def test_unknown_graph_name_rejected(self):
+        h = [T([["append", "x", 1]])]
+        with pytest.raises(ValueError, match="additional graph"):
+            ea.check(h, additional_graphs=["real-time"])
+        with pytest.raises(ValueError, match="additional graph"):
+            ew.check(h, additional_graphs="realtime")  # bare string
+
+    def test_wr_stale_read_strict_ser_only(self):
+        h = self._hist([
+            ("invoke", 0, [["w", "x", 1]]),
+            ("ok", 0, [["w", "x", 1]]),
+            ("invoke", 1, [["w", "x", 2]]),
+            ("ok", 1, [["w", "x", 2]]),
+            ("invoke", 2, [["r", "x", None]]),
+            ("ok", 2, [["r", "x", 1]]),
+        ])
+        assert ew.check(h, linearizable_keys=True)["valid"] is True
+        res = ew.check(h, linearizable_keys=True,
+                       additional_graphs=["realtime"])
+        assert res["valid"] is False
+        assert "G-single-realtime" in res["anomaly_types"]
+
+    def test_extra_pass_device_host_agreement(self):
+        """A realtime-closed cycle through a DEVICE_MIN-sized component:
+        the MXU-closure path and the host Tarjan/BFS oracle must agree
+        on the suffixed verdict."""
+        import jepsen_tpu.elle as elle
+
+        n = elle.DEVICE_MIN_TXNS + 90
+        results = {}
+        for device in (False, True):
+            g = DepGraph(n)
+            # Sequential realtime intervals: txn i fully before txn i+1.
+            elle.add_realtime_edges(
+                g, [(i, 2 * i, 2 * i + 1) for i in range(n)])
+            # rw edges far-future -> past; the only way back is realtime.
+            for j in range(10):
+                g.add(n - 1 - j, j, RW)
+            got = cycle_anomalies(g, device=device, extra=("realtime",),
+                                  n_txns=n)
+            results[device] = set(got)
+        assert results[False] == results[True] == {"G-single-realtime"}
+
+
 class TestGeneratedHistories:
     def test_serializable_simulation_clean(self):
         """Apply random append txns against an in-memory serial store —
