@@ -245,6 +245,42 @@ def _order_fn(history, intervals: Optional[dict]):
     return order_of
 
 
+def suffixed_requests(requested: set, extra) -> set:
+    """Requested anomalies plus the suffixed variants each additional
+    graph unlocks (G2 + realtime -> G2-realtime, ...)."""
+    out = set(requested)
+    for name in extra:
+        out |= {f"{a}-{name}" for a in requested & CYCLE_CLASSES}
+    return out
+
+
+def compose_additional_graphs(g: DepGraph, extra, history, nodes,
+                              intervals: Optional[dict]) -> bool:
+    """Add the requested extra precedence edges to ``g``. ``nodes``:
+    (node_id, completion_op, has_ret) per committed txn — has_ret False
+    for :info txns, which may take effect arbitrarily late and so
+    realtime-precede nothing. Returns True when realtime was requested
+    but the history is a bare completion list (no invocation indexes)."""
+    order_of = _order_fn(history, intervals)
+    rt_unavailable = False
+    if "process" in extra:
+        add_process_edges(g, [
+            (node, op_proc(op), order_of(op, node))
+            for node, op, _has_ret in nodes
+        ])
+    if "realtime" in extra:
+        if intervals is None:
+            rt_unavailable = True
+        else:
+            add_realtime_edges(g, [
+                (node, intervals[id(op)][0],
+                 intervals[id(op)][1] if has_ret else None)
+                for node, op, has_ret in nodes
+                if id(op) in intervals
+            ])
+    return rt_unavailable
+
+
 def paired_intervals(history) -> Optional[dict]:
     """Map id(completion) -> (invoke_index, completion_index) from a
     paired History; None for bare completion lists (realtime edges are
@@ -317,6 +353,57 @@ def add_process_edges(g: DepGraph, items) -> None:
 
 KIND_LOOKUP = {WW: "ww", WR: "wr", RW: "rw", RT: "realtime",
                PROC: "process"}
+
+
+def monotonic_key_check(history, realtime: bool = True) -> dict:
+    """elle.core's monotonic-key analyzer composed with the realtime
+    graph (the reference consumes it via jepsen.tests.cycle/checker +
+    cycle/combine, e.g. tidb/monotonic.clj:104-110).
+
+    Ok ops carry ``{key: observed-value}`` maps; for each key, an op
+    observing value v precedes every op observing the next larger value
+    — values must never decrease. A cycle in that order (composed with
+    realtime precedence when the history is paired) is a monotonicity
+    violation; the witness cycle is returned."""
+    oks = [op for op in history
+           if op_type(op) == "ok" and isinstance(op_value(op), dict)]
+    n = len(oks)
+    g = DepGraph(n)
+    by_key: dict = {}
+    for i, op in enumerate(oks):
+        for k, v in (op_value(op) or {}).items():
+            if v is not None:
+                by_key.setdefault(k, {}).setdefault(v, []).append(i)
+    for groups in by_key.values():
+        vals = sorted(groups)
+        for a, b in zip(vals, vals[1:]):
+            for i in groups[a]:
+                for j in groups[b]:
+                    if i != j:
+                        g.add(i, j, WW)
+    rt_unavailable = False
+    if realtime:
+        intervals = paired_intervals(history)
+        if intervals is None:
+            rt_unavailable = True
+        else:
+            add_realtime_edges(g, [
+                (i, intervals[id(op)][0], intervals[id(op)][1])
+                for i, op in enumerate(oks) if id(op) in intervals
+            ])
+    succ = succ_lists(g.edges, g.n, 0xFF)
+    sccs = sccs_lists(succ)
+    cycles = []
+    if sccs:
+        cyc = find_cycle_lists(succ, sccs[0])
+        if cyc:
+            w = _witness(g, cyc, n)
+            w["ops"] = [repr(oks[i]) for i in w["cycle"]]
+            cycles.append(w)
+    out = {"valid": not sccs, "cycles": cycles}
+    if rt_unavailable:
+        out["realtime_unavailable"] = True
+    return out
 
 
 # Shared op accessors: checker layers accept both Op records and plain

@@ -28,10 +28,10 @@ from __future__ import annotations
 
 from typing import Any, Iterable, Optional
 
-from . import CYCLE_CLASSES, DEFAULT_ANOMALIES, DepGraph, RW, WR, WW, \
-    _check_extra, _order_fn, add_process_edges, add_realtime_edges, \
-    cycle_anomalies, expand_anomalies, op_f as _f, op_proc as _proc, \
-    op_type as _type, op_value as _value, paired_intervals, result_map
+from . import DEFAULT_ANOMALIES, DepGraph, RW, WR, WW, _check_extra, \
+    compose_additional_graphs, cycle_anomalies, expand_anomalies, \
+    op_f as _f, op_type as _type, op_value as _value, paired_intervals, \
+    result_map, suffixed_requests
 from ..history import FAIL, INFO, OK
 
 
@@ -54,8 +54,7 @@ def check(history, anomalies: Iterable[str] = DEFAULT_ANOMALIES,
     ("G-single-realtime", …)."""
     requested = expand_anomalies(anomalies)
     extra = _check_extra(additional_graphs)
-    for name in extra:
-        requested |= {f"{a}-{name}" for a in requested & CYCLE_CLASSES}
+    requested = suffixed_requests(requested, extra)
     # Pair completions with their invocations' txn shape: we only need
     # completions (observed values live there).
     oks = [op for op in history if _type(op) == OK and _f(op) == "txn"]
@@ -200,25 +199,10 @@ def check(history, anomalies: Iterable[str] = DEFAULT_ANOMALIES,
 
     rt_unavailable = False
     if extra:
-        intervals = paired_intervals(history)
-        order_of = _order_fn(history, intervals)
         nodes = [(node_of_ok[i], oks[i], True) for i in range(len(oks))] \
             + [(node_of_info[i], infos[i], False) for i in observed_info]
-        if "process" in extra:
-            add_process_edges(g, [
-                (node, _proc(op), order_of(op, node))
-                for node, op, _has_ret in nodes
-            ])
-        if "realtime" in extra:
-            if intervals is None:
-                rt_unavailable = True
-            else:
-                add_realtime_edges(g, [
-                    (node, intervals[id(op)][0],
-                     intervals[id(op)][1] if has_ret else None)
-                    for node, op, has_ret in nodes
-                    if id(op) in intervals
-                ])
+        rt_unavailable = compose_additional_graphs(
+            g, extra, history, nodes, paired_intervals(history))
 
     problems.update(cycle_anomalies(g, device=device, extra=extra,
                                     n_txns=n))

@@ -20,10 +20,10 @@ from __future__ import annotations
 
 from typing import Iterable, Optional
 
-from . import CYCLE_CLASSES, DEFAULT_ANOMALIES, DepGraph, RW, WR, WW, \
-    _check_extra, _order_fn, add_process_edges, add_realtime_edges, \
-    cycle_anomalies, expand_anomalies, op_f as _f, op_proc as _proc, \
-    op_type as _type, op_value as _value, paired_intervals, result_map
+from . import DEFAULT_ANOMALIES, DepGraph, RW, WR, WW, _check_extra, \
+    compose_additional_graphs, cycle_anomalies, expand_anomalies, \
+    op_f as _f, op_proc as _proc, op_type as _type, op_value as _value, \
+    paired_intervals, result_map, suffixed_requests
 from ..history import FAIL, INFO, OK
 from ..txn import ext_reads, ext_writes
 
@@ -48,8 +48,7 @@ def check(history, anomalies: Iterable[str] = DEFAULT_ANOMALIES,
     ("G-single-realtime", …)."""
     requested = expand_anomalies(anomalies)
     extra = _check_extra(additional_graphs)
-    for name in extra:
-        requested |= {f"{a}-{name}" for a in requested & CYCLE_CLASSES}
+    requested = suffixed_requests(requested, extra)
     oks = [op for op in history if _type(op) == OK and _f(op) == "txn"]
     fails = [op for op in history if _type(op) == FAIL and _f(op) == "txn"]
     problems: dict = {}
@@ -156,20 +155,9 @@ def check(history, anomalies: Iterable[str] = DEFAULT_ANOMALIES,
     n_txns = len(oks)
     rt_unavailable = False
     if extra:
-        order_of = _order_fn(history, intervals)
-        if "process" in extra:
-            add_process_edges(g, [
-                (i, _proc(op), order_of(op, i)) for i, op in enumerate(oks)
-            ])
-        if "realtime" in extra:
-            if intervals is None:
-                rt_unavailable = True
-            else:
-                add_realtime_edges(g, [
-                    (i, intervals[id(op)][0], intervals[id(op)][1])
-                    for i, op in enumerate(oks)
-                    if id(op) in intervals
-                ])
+        rt_unavailable = compose_additional_graphs(
+            g, extra, history,
+            [(i, op, True) for i, op in enumerate(oks)], intervals)
 
     problems.update(cycle_anomalies(g, device=device, extra=extra,
                                     n_txns=n_txns))

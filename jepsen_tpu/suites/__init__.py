@@ -10,16 +10,20 @@ same shape on this framework's protocols. Roster (→ reference suite):
 - ``consul``     — HTTP KV cas-register over ``?cas=index`` (consul/)
 - ``etcd``       — v3 JSON gateway register + elle append (etcd-style)
 - ``zookeeper``  — zkCli version-guarded CAS register (zookeeper/)
-- ``cockroachdb``— bank + append over `cockroach sql`, combined nemesis
+- ``cockroachdb``— full workload roster (register/bank/sets/monotonic/
+  sequential/comments/g2/append) over `cockroach sql`, combined nemesis
   incl. clock skew (cockroachdb/)
 - ``postgres``   — psql serializable list-append (single-node shape)
 - ``stolon``     — HA Postgres: keeper/sentinel/proxy + own etcd store,
   append through the proxy (stolon/)
 - ``mysql``      — dirty-reads on --flavor galera | percona | ndb
   (galera/, percona/, mysql-cluster/)
-- ``tidb``       — pessimistic bank + JSON-column elle append (tidb/)
-- ``yugabyte``   — workload × fault matrix over ysqlsh + test-all sweep
-  (yugabyte/)
+- ``tidb``       — full workload roster (bank/append/register/set/
+  long-fork/monotonic/sequential/txn) over the mysql CLI; monotonic
+  uses the elle monotonic-key + realtime cycle analyzer (tidb/)
+- ``yugabyte``   — the dual-API matrix: 7 ycql workloads over ycqlsh +
+  10 ysql workloads over ysqlsh × fault sets + test-all sweep
+  (yugabyte/core.clj:73-103)
 - ``mongodb``    — replica-set document-cas with linearizable reads;
   --storage-engine rocksdb covers mongodb-rocks (mongodb-smartos/,
   mongodb-rocks/; SmartOS provisioning lives in os_/smartos.py)
@@ -30,8 +34,9 @@ same shape on this framework's protocols. Roster (→ reference suite):
 - ``elasticsearch`` — set inserts under partitions (elasticsearch/)
 - ``crate``      — dirty-read / lost-updates / _version divergence
   (crate/)
-- ``dgraph``     — upsert uniqueness + set over the alpha HTTP API,
-  op-level tracing (dgraph/)
+- ``dgraph``     — full workload roster (upsert/set/bank/delete/
+  long-fork/linearizable-register/sequential/wr) over alpha upsert
+  blocks, op-level tracing; wr composes the realtime graph (dgraph/)
 - ``redis``      — --workload queue (rabbitmq/disque shape) | register
   (EVAL compare-and-set)
 - ``rabbitmq``   — management-API queue + total-queue checker

@@ -1,29 +1,53 @@
-"""CockroachDB suite: bank + list-append txns over the pg wire via the
-node's ``cockroach sql`` shell.
+"""CockroachDB suite over the pg wire via the node's ``cockroach sql``
+shell.
 
 Mirrors the reference cockroachdb suite (cockroachdb/src/jepsen/
-cockroach/*.clj, 2515 LoC): register/bank/append workloads, a rich
-composed nemesis including its own clock-skew C tooling (here the shared
-jepsen_tpu.nemesis.time tools serve), and the serializable-SQL client
-discipline — serialization failures are definite :fail, connection drops
-indeterminate.
+cockroach/*.clj, 2515 LoC) with its full workload roster — register
+(register.clj), bank (bank.clj), sets (sets.clj), monotonic
+(monotonic.clj), sequential (sequential.clj), comments (comments.clj),
+g2/adya (adya.clj), append — a rich composed nemesis including its own
+clock-skew C tooling (here the shared jepsen_tpu.nemesis.time tools
+serve), and the serializable-SQL client discipline — serialization
+failures are definite :fail, connection drops indeterminate.
+
+Where the reference's clients branch on mid-transaction query results
+(monotonic's max+1 insert, adya's read-then-insert), these clients
+collapse the logic into single INSERT…SELECT / WHERE NOT EXISTS
+statements — atomic under serializable isolation and shippable through
+a one-shot SQL shell.
 """
 
 from __future__ import annotations
 
+import itertools
 import json
-from typing import Any
+import threading
+import zlib
+from collections import Counter, deque
+from decimal import Decimal
+from typing import Any, Optional
 
+from .. import checker as jchecker
 from .. import cli, client as jclient, db as jdb, generator as gen
+from .. import independent
 from .. import net as jnet
 from ..control import util as cu
 from ..nemesis import combined as ncombined
+from ..workloads import adya as wadya
 from ..workloads import append as wa
 from ..workloads import bank as wbank
+from ..workloads import linearizable_register as wreg
 from .. import control as c
 
 BANK_TABLE = "jepsen_bank"
 APPEND_TABLE = "jepsen_append"
+REGISTER_TABLE = "jepsen_register"
+SET_TABLE = "jepsen_set"
+SEQ_TABLES = 10
+SEQ_PREFIX = "jepsen_seq_"
+COMMENT_TABLES = 10
+COMMENT_PREFIX = "jepsen_comment_"
+G2_PREFIX = "jepsen_g2_"
 
 
 class _SqlClient(jclient.Client):
@@ -125,6 +149,431 @@ class AppendClient(_SqlClient):
         return {**op, "type": "ok", "value": done}
 
 
+def _tsv_rows(out: str, fields: Optional[int] = None) -> list[list[str]]:
+    """Data rows of `cockroach sql --format=tsv` output: tab-split lines
+    with ``fields`` columns (any width if None) whose first column isn't
+    a statement tag / header word."""
+    rows = []
+    for line in out.strip().split("\n"):
+        cells = line.rstrip("\n").split("\t")
+        if fields is not None and len(cells) != fields:
+            continue
+        head = cells[0].strip()
+        if not head or not (head.lstrip("-").replace(".", "", 1).isdigit()):
+            continue
+        rows.append([cell.strip() for cell in cells])
+    return rows
+
+
+def _is_serialization_error(e: Exception) -> bool:
+    # Match cockroach's retryable-txn error text only; the RemoteError
+    # message embeds the whole command + stdout/stderr, so a looser
+    # match (e.g. bare "retry") could turn an indeterminate outcome
+    # into a false definite :fail.
+    return "restart transaction" in str(e).lower()
+
+
+class RegisterClient(_SqlClient):
+    """Keyed cas-register, one row per independent key
+    (cockroach/register.clj:18-77). cas decides by RETURNING-row
+    presence — no rowcount parsing needed through the SQL shell."""
+
+    def setup(self, test):
+        self._sql(test,
+                  f"CREATE TABLE IF NOT EXISTS {REGISTER_TABLE} "
+                  "(id INT PRIMARY KEY, val INT);")
+
+    def invoke(self, test, op):
+        k, v = op["value"]
+        try:
+            if op["f"] == "read":
+                out = self._sql(
+                    test,
+                    f"SELECT val FROM {REGISTER_TABLE} WHERE id = {k};")
+                rows = _tsv_rows(out, 1)
+                val = int(rows[0][0]) if rows else None
+                return {**op, "type": "ok",
+                        "value": independent.tuple_(k, val)}
+            if op["f"] == "write":
+                self._sql(test,
+                          f"UPSERT INTO {REGISTER_TABLE} VALUES ({k}, {v});")
+                return {**op, "type": "ok"}
+            old, new = v
+            out = self._sql(
+                test,
+                f"UPDATE {REGISTER_TABLE} SET val = {new} "
+                f"WHERE id = {k} AND val = {old} RETURNING id;")
+            return {**op, "type": "ok" if _tsv_rows(out, 1) else "fail"}
+        except c.RemoteError as e:
+            if _is_serialization_error(e):
+                return {**op, "type": "fail", "error": "serialization"}
+            raise
+
+
+class SetsClient(_SqlClient):
+    """Blind unique-int inserts + full reads (cockroach/sets.clj)."""
+
+    def setup(self, test):
+        self._sql(test,
+                  f"CREATE TABLE IF NOT EXISTS {SET_TABLE} (val INT);")
+
+    def invoke(self, test, op):
+        try:
+            if op["f"] == "read":
+                out = self._sql(test, f"SELECT val FROM {SET_TABLE};")
+                return {**op, "type": "ok",
+                        "value": [int(r[0]) for r in _tsv_rows(out, 1)]}
+            self._sql(
+                test, f"INSERT INTO {SET_TABLE} VALUES ({op['value']});")
+            return {**op, "type": "ok"}
+        except c.RemoteError as e:
+            if _is_serialization_error(e):
+                return {**op, "type": "fail", "error": "serialization"}
+            raise
+
+
+# --- monotonic (cockroach/monotonic.clj) -----------------------------------
+
+
+def _mono_table(k, i: int) -> str:
+    return f"jepsen_mono_k{k}i{i}"
+
+
+class MonotonicClient(_SqlClient):
+    """Monotonic inserts over two tables per independent key
+    (monotonic.clj:30-140). The reference reads max(val) then inserts
+    max+1 inside a txn; here one INSERT…SELECT GREATEST(…)+1 does both
+    atomically, with sts = cluster_logical_timestamp()."""
+
+    TABLES = 2
+
+    def __init__(self, node: Any = None, keys=(0, 1)):
+        super().__init__(node)
+        self.keys = tuple(keys)
+
+    def open(self, test, node):
+        return type(self)(node, self.keys)
+
+    def setup(self, test):
+        self._sql(test, "\n".join(
+            f"CREATE TABLE IF NOT EXISTS {_mono_table(k, i)} "
+            "(val INT, sts STRING, node INT, process INT, tb INT);"
+            for k in self.keys for i in range(self.TABLES)))
+
+    def invoke(self, test, op):
+        k, _v = op["value"]
+        tables = [_mono_table(k, i) for i in range(self.TABLES)]
+        maxes = ", ".join(
+            f"(SELECT COALESCE(MAX(val), 0) FROM {t})" for t in tables)
+        try:
+            if op["f"] == "add":
+                tb = gen.rand_int(self.TABLES)
+                nodes = list(test.get("nodes") or [])
+                node_num = nodes.index(self.node) if self.node in nodes else 0
+                proc = op.get("process")
+                proc = proc if isinstance(proc, int) else 0
+                out = self._sql(
+                    test,
+                    f"INSERT INTO {tables[tb]} (val, sts, node, process, tb) "
+                    f"SELECT GREATEST({maxes}) + 1, "
+                    "cluster_logical_timestamp()::STRING, "
+                    f"{node_num}, {proc}, {tb} RETURNING val, sts;")
+                rows = _tsv_rows(out, 2)
+                row = {"val": int(rows[0][0]), "sts": rows[0][1],
+                       "node": node_num, "process": proc, "tb": tb}
+                return {**op, "type": "ok",
+                        "value": independent.tuple_(k, row)}
+            out = self._sql(test, "\n".join(
+                f"SELECT val, sts, node, process, tb FROM {t};"
+                for t in tables))
+            rows = [
+                {"val": int(r[0]), "sts": r[1], "node": int(r[2]),
+                 "process": int(r[3]), "tb": int(r[4])}
+                for r in _tsv_rows(out, 5)
+            ]
+            rows.sort(key=lambda r: Decimal(r["sts"]))
+            return {**op, "type": "ok", "value": independent.tuple_(k, rows)}
+        except c.RemoteError as e:
+            if _is_serialization_error(e):
+                return {**op, "type": "fail", "error": "serialization"}
+            raise
+
+
+def _non_monotonic(ok, key, rows):
+    """Successive pairs where ``ok(prev, cur)`` does NOT hold
+    (monotonic.clj:150-158)."""
+    return [
+        [a, b] for a, b in zip(rows, rows[1:]) if not ok(key(a), key(b))
+    ]
+
+
+def check_monotonic(global_: bool = True) -> jchecker.Checker:
+    """Timestamps and values proceed monotonically; lost / duplicate /
+    revived elements are failures (monotonic.clj:160-233). Runs per-key
+    under independent.checker. The reference's extra :linearizable flag
+    only re-enables the global value-order check when global? is false
+    (the multitable configuration, monotonic.clj:236-268); with
+    global_=True it is subsumed, so it isn't reproduced here."""
+
+    def chk(test, history, opts):
+        adds = [op.value for op in history if op.is_ok and op.f == "add"]
+        final = None
+        for op in history:
+            if op.is_ok and op.f == "read":
+                final = op.value
+        if final is None:
+            return {"valid": "unknown", "error": "Set was never read"}
+        # The client returns rows sorted by the decimal HLC timestamp,
+        # so the interesting invariant is val-vs-sts agreement: in sts
+        # order, vals must be strictly increasing (a later max+1 insert
+        # must carry a later timestamp). The reference's separate
+        # off-order-stss check is vacuous there too (its client also
+        # sorts by sts, monotonic.clj:127-130) and isn't reproduced.
+        off_vals = _non_monotonic(
+            lambda a, b: a < b, lambda r: r["val"], final)
+        by_proc: dict = {}
+        for r in final:
+            by_proc.setdefault(r["process"], []).append(r)
+        off_per_proc = {
+            p: _non_monotonic(lambda a, b: a < b, lambda r: r["val"], rs)
+            for p, rs in by_proc.items()
+        }
+        add_vals = {r["val"] for r in adds}
+        read_vals = [r["val"] for r in final]
+        dups = sorted(v for v, n in Counter(read_vals).items() if n > 1)
+        lost = sorted(add_vals - set(read_vals))
+        return {
+            "valid": not (lost or dups
+                          or (global_ and off_vals)
+                          or any(off_per_proc.values())),
+            "lost": lost,
+            "duplicates": dups,
+            "value-reorders": off_vals,
+            "value-reorders-per-process": {
+                p: v for p, v in off_per_proc.items() if v},
+        }
+
+    return jchecker.checker_fn(chk, "monotonic")
+
+
+# --- sequential (cockroach/sequential.clj) ---------------------------------
+
+
+def _seq_table(subkey: str) -> str:
+    return f"{SEQ_PREFIX}{zlib.crc32(subkey.encode()) % SEQ_TABLES}"
+
+
+def _subkeys(key_count: int, k) -> list[str]:
+    return [f"{k}_{i}" for i in range(key_count)]
+
+
+class SequentialClient(_SqlClient):
+    """Per-process key chains across sharded tables
+    (sequential.clj:34-107): writes insert subkeys in order, each its
+    own transaction; reads probe them in reverse."""
+
+    def __init__(self, node: Any = None, key_count: int = 5):
+        super().__init__(node)
+        self.key_count = key_count
+
+    def open(self, test, node):
+        return type(self)(node, self.key_count)
+
+    def setup(self, test):
+        self._sql(test, "\n".join(
+            f"CREATE TABLE IF NOT EXISTS {SEQ_PREFIX}{i} "
+            "(key STRING PRIMARY KEY);" for i in range(SEQ_TABLES)))
+
+    def invoke(self, test, op):
+        ks = _subkeys(self.key_count, op["value"])
+        try:
+            if op["f"] == "write":
+                # One round-trip; each INSERT is still its own implicit
+                # transaction, executed in subkey order.
+                self._sql(test, "\n".join(
+                    f"INSERT INTO {_seq_table(k)} (key) VALUES ('{k}');"
+                    for k in ks))
+                return {**op, "type": "ok"}
+            seen = []
+            for k in reversed(ks):
+                out = self._sql(
+                    test,
+                    f"SELECT key FROM {_seq_table(k)} WHERE key = '{k}';")
+                rows = [line for line in out.strip().split("\n")
+                        if line.strip() == k]
+                seen.append(k if rows else None)
+            return {**op, "type": "ok", "value": [op["value"], seen]}
+        except c.RemoteError as e:
+            if _is_serialization_error(e):
+                return {**op, "type": "fail", "error": "serialization"}
+            raise
+
+
+def sequential_gen(n_writers: int = 3):
+    """Sequential integer write keys; reads sample the last 2n written
+    (sequential.clj:109-133)."""
+    last = deque(maxlen=2 * n_writers)
+    lock = threading.Lock()
+    ctr = itertools.count()
+
+    def write(t=None, ctx=None):
+        k = next(ctr)
+        with lock:
+            last.append(k)
+        return {"type": "invoke", "f": "write", "value": k}
+
+    def read(t=None, ctx=None):
+        with lock:
+            pool = list(last)
+        # Nothing written yet: probe key 0 (an all-None read is legal).
+        k = pool[gen.rand_int(len(pool))] if pool else 0
+        return {"type": "invoke", "f": "read", "value": k}
+
+    return gen.reserve(n_writers, write, read)
+
+
+def _trailing_nil(seen) -> bool:
+    return any(v is None for v in
+               itertools.dropwhile(lambda v: v is None, seen))
+
+
+def sequential_checker() -> jchecker.Checker:
+    """A read [k, [newest … oldest]] must never observe a later subkey
+    without every earlier one: a None after a non-None is a sequential
+    violation (sequential.clj:135-154)."""
+
+    def chk(test, history, opts):
+        bad, counts = [], Counter()
+        for op in history:
+            if not (op.is_ok and op.f == "read"):
+                continue
+            k, seen = op.value
+            if all(v is None for v in seen):
+                counts["none"] += 1
+            elif any(v is None for v in seen):
+                counts["some"] += 1
+            else:
+                counts["all"] += 1
+            if _trailing_nil(seen):
+                bad.append({"key": k, "reads": seen})
+        return {
+            "valid": not bad,
+            "bad-count": len(bad),
+            "all-count": counts["all"],
+            "some-count": counts["some"],
+            "none-count": counts["none"],
+            "bad": bad,
+        }
+
+    return jchecker.checker_fn(chk, "sequential")
+
+
+# --- comments (cockroach/comments.clj) -------------------------------------
+
+
+def _comment_table(id_: int) -> str:
+    return f"{COMMENT_PREFIX}{zlib.crc32(str(id_).encode()) % COMMENT_TABLES}"
+
+
+class CommentsClient(_SqlClient):
+    """Blind sharded inserts + cross-table txn reads
+    (comments.clj:42-90): finds T1 < T2 where T2 is visible without T1
+    — the strict-serializability "comment ordering" anomaly."""
+
+    def setup(self, test):
+        self._sql(test, "\n".join(
+            f"CREATE TABLE IF NOT EXISTS {COMMENT_PREFIX}{i} "
+            "(id INT PRIMARY KEY, key INT);"
+            for i in range(COMMENT_TABLES)))
+
+    def invoke(self, test, op):
+        k, v = op["value"]
+        try:
+            if op["f"] == "write":
+                self._sql(test,
+                          f"INSERT INTO {_comment_table(v)} (id, key) "
+                          f"VALUES ({v}, {k});")
+                return {**op, "type": "ok"}
+            stmts = ["BEGIN;"] + [
+                f"SELECT id FROM {COMMENT_PREFIX}{i} WHERE key = {k};"
+                for i in range(COMMENT_TABLES)
+            ] + ["COMMIT;"]
+            out = self._sql(test, "\n".join(stmts))
+            ids = sorted(int(r[0]) for r in _tsv_rows(out, 1))
+            return {**op, "type": "ok", "value": independent.tuple_(k, ids)}
+        except c.RemoteError as e:
+            if _is_serialization_error(e):
+                return {**op, "type": "fail", "error": "serialization"}
+            raise
+
+
+def comments_checker() -> jchecker.Checker:
+    """Replay: expected[w] = writes completed before w's invocation; a
+    read seeing w but missing some of expected[w] violates strict
+    serializability (comments.clj:92-141). Per-key under
+    independent.checker."""
+
+    def chk(test, history, opts):
+        completed: set = set()
+        expected: dict = {}
+        for op in history:
+            if op.f != "write":
+                continue
+            if op.is_invoke:
+                expected[op.value] = frozenset(completed)
+            elif op.is_ok:
+                completed.add(op.value)
+        errors = []
+        for op in history:
+            if not (op.is_ok and op.f == "read"):
+                continue
+            seen = set(op.value or [])
+            want: set = set()
+            for v in seen:
+                want |= expected.get(v, frozenset())
+            missing = want - seen
+            if missing:
+                errors.append({"op": repr(op),
+                               "missing": sorted(missing),
+                               "expected-count": len(want)})
+        return {"valid": not errors, "errors": errors}
+
+    return jchecker.checker_fn(chk, "comments")
+
+
+class G2Client(_SqlClient):
+    """Adya G2 predicate pairs (cockroach/adya.clj:24-87): the
+    reference's read-then-insert collapses to one
+    INSERT…WHERE NOT EXISTS over both tables; no returned row means the
+    other transaction already committed (:fail :too-late)."""
+
+    def setup(self, test):
+        self._sql(test, "\n".join(
+            f"CREATE TABLE IF NOT EXISTS {G2_PREFIX}{t} "
+            "(id INT PRIMARY KEY, key INT, value INT);" for t in ("a", "b")))
+
+    def invoke(self, test, op):
+        k, ids = op["value"]
+        a_id, b_id = ids
+        table = "a" if a_id is not None else "b"
+        id_ = a_id if a_id is not None else b_id
+        guard = " AND ".join(
+            f"NOT EXISTS (SELECT 1 FROM {G2_PREFIX}{t} "
+            f"WHERE key = {k} AND value % 3 = 0)" for t in ("a", "b"))
+        try:
+            out = self._sql(
+                test,
+                f"INSERT INTO {G2_PREFIX}{table} (id, key, value) "
+                f"SELECT {id_}, {k}, 30 WHERE {guard} RETURNING id;")
+            if _tsv_rows(out, 1):
+                return {**op, "type": "ok"}
+            return {**op, "type": "fail", "error": "too-late"}
+        except c.RemoteError as e:
+            if _is_serialization_error(e):
+                return {**op, "type": "fail", "error": "serialization"}
+            raise
+
+
 class CockroachDB(jdb.DB, jdb.Process, jdb.LogFiles):
     DIR = "/opt/cockroach"
     LOG = "/var/log/cockroach.log"
@@ -186,7 +635,112 @@ def append_workload(opts: dict) -> dict:
             "checker": wl["checker"]}
 
 
-WORKLOADS = {"bank": bank_workload, "append": append_workload}
+def register_workload(opts: dict) -> dict:
+    wl = wreg.test(opts)
+    return {**wl, "client": RegisterClient()}
+
+
+def sets_workload(opts: dict) -> dict:
+    ids = itertools.count()
+
+    def add(t=None, ctx=None):
+        return {"type": "invoke", "f": "add", "value": next(ids)}
+
+    return {
+        "client": SetsClient(),
+        "generator": gen.stagger(0.05, add),
+        "final-generator": gen.once(
+            {"type": "invoke", "f": "read", "value": None}),
+        "checker": jchecker.compose({
+            "set": jchecker.set_full(),
+            "stats": jchecker.stats(),
+        }),
+    }
+
+
+def monotonic_workload(opts: dict) -> dict:
+    keys = list(range(int(opts.get("keys") or 2)))
+
+    def fgen(k):
+        return gen.stagger(
+            0.05, lambda t=None, ctx=None:
+            {"type": "invoke", "f": "add", "value": None})
+
+    def fgen_final(k):
+        return gen.limit(1, lambda t=None, ctx=None:
+                         {"type": "invoke", "f": "read", "value": None})
+
+    return {
+        "client": MonotonicClient(keys=keys),
+        "generator": independent.concurrent_generator(2, list(keys), fgen),
+        "final-generator": independent.concurrent_generator(
+            2, list(keys), fgen_final),
+        "checker": independent.checker(jchecker.compose({
+            "monotonic": check_monotonic(),
+            "stats": jchecker.stats(),
+        })),
+    }
+
+
+def sequential_workload(opts: dict) -> dict:
+    key_count = int(opts.get("key-count") or 5)
+    return {
+        "client": SequentialClient(key_count=key_count),
+        "generator": gen.stagger(0.02, sequential_gen()),
+        "checker": jchecker.compose({
+            "sequential": sequential_checker(),
+            "stats": jchecker.stats(),
+        }),
+    }
+
+
+def comments_workload(opts: dict) -> dict:
+    ids = itertools.count()
+    lock = threading.Lock()
+
+    def write(t=None, ctx=None):
+        with lock:
+            v = next(ids)
+        return {"type": "invoke", "f": "write", "value": v}
+
+    def read(t=None, ctx=None):
+        return {"type": "invoke", "f": "read", "value": None}
+
+    def fgen(k):
+        return gen.stagger(0.02, gen.mix([read, write]))
+
+    return {
+        "client": CommentsClient(),
+        "generator": independent.concurrent_generator(
+            2, itertools.count(), fgen),
+        "checker": independent.checker(jchecker.compose({
+            "comments": comments_checker(),
+            "stats": jchecker.stats(),
+        })),
+    }
+
+
+def g2_workload(opts: dict) -> dict:
+    return {
+        "client": G2Client(),
+        "generator": wadya.g2_gen(),
+        "checker": jchecker.compose({
+            "g2": wadya.g2_checker(),
+            "stats": jchecker.stats(),
+        }),
+    }
+
+
+WORKLOADS = {
+    "bank": bank_workload,
+    "append": append_workload,
+    "register": register_workload,
+    "sets": sets_workload,
+    "monotonic": monotonic_workload,
+    "sequential": sequential_workload,
+    "comments": comments_workload,
+    "g2": g2_workload,
+}
 
 
 def test_fn(opts: dict) -> dict:
@@ -204,17 +758,23 @@ def test_fn(opts: dict) -> dict:
         "net": jnet.iptables(),
         "nemesis": pkg["nemesis"],
         "plot": {"nemeses": pkg["perf"]},
-        **{k: v for k, v in wl.items() if k != "generator"},
+        **{k: v for k, v in wl.items()
+           if k not in ("generator", "final-generator")},
     }
     # Time-limit the WHOLE nemesis+client composite: nemesis-package
     # generators repeat on an interval forever and would otherwise keep
-    # the phase alive after the client generator exhausts.
-    test["generator"] = gen.phases(
+    # the phase alive after the client generator exhausts. Workloads
+    # with a final read (sets/monotonic) get a fault-free phase after
+    # the heal.
+    phases = [
         gen.time_limit(
             opts.get("time_limit", 60),
             gen.nemesis(pkg["generator"], wl["generator"])),
         gen.nemesis(pkg["final-generator"]),
-    )
+    ]
+    if wl.get("final-generator") is not None:
+        phases.append(gen.clients(wl["final-generator"]))
+    test["generator"] = gen.phases(*phases)
     return test
 
 

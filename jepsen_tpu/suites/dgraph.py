@@ -1,17 +1,31 @@
-"""Dgraph suite: upsert + set workloads over the HTTP API, with tracing.
+"""Dgraph suite: the reference's full workload roster over the alpha
+HTTP API, with tracing.
 
 The reference's dgraph suite (dgraph/, 2599 LoC) runs
-bank/delete/long-fork/register/sequential/set/upsert/wr workloads and is
-the one suite with distributed tracing (OpenCensus → Jaeger,
-dgraph/src/jepsen/dgraph/trace.clj:1-74). This suite drives the alpha
-HTTP API directly:
+bank/delete/long-fork/linearizable-register/sequential/set/upsert/wr
+workloads and is the one suite with distributed tracing (OpenCensus →
+Jaeger, dgraph/src/jepsen/dgraph/trace.clj:1-74). This suite drives the
+alpha HTTP API directly:
 
 - **upsert**: the distinctive dgraph test — concurrent upserts of the
   same ``email`` predicate must create at most ONE node per email
   (dgraph/src/jepsen/dgraph/upsert.clj); checked by a final per-email
   uid count.
-- **set**: unique integer inserts + final read-all, checked with the set
-  checker.
+- **set**: unique integer inserts + final read-all (set.clj).
+- **bank**: transfers with on-the-fly account create/delete
+  (bank.clj:60-199; the 7-way predicate striping there is a sharding
+  detail, collapsed to one predicate family here).
+- **delete**: per-key upsert/delete/read index-consistency (delete.clj).
+- **long-fork** / **wr**: micro-op txn client (client.clj txn-client
+  analogue) under the long-fork and elle wr checkers — wr composes the
+  realtime graph exactly like the reference (wr.clj:20-31).
+- **linearizable-register** / **sequential**: keyed register CAS and the
+  monotonic read/inc probe (linearizable_register.clj, sequential.clj).
+
+Where the reference's JVM client wraps multi-step gRPC transactions,
+every txn here is ONE upsert-block request (query blocks + conditional
+mutations + commitNow) — atomic server-side, so the HTTP client needs
+no txn-context plumbing.
 
 Client ops ride :mod:`jepsen_tpu.trace` spans (the trace.clj analogue):
 pass ``trace=True`` in opts and every client call is recorded to a span
@@ -20,15 +34,21 @@ collector exported into the store directory.
 
 from __future__ import annotations
 
+import itertools
 import json
 import urllib.request
 from typing import Any, Optional
 
 from .. import checker as jchecker
 from .. import cli, client as jclient, db as jdb, generator as gen
+from .. import independent
 from .. import nemesis as jnemesis, net as jnet, trace as jtrace
 from ..checker import Checker, checker_fn
 from ..control import util as cu
+from ..workloads import bank as wbank
+from ..workloads import linearizable_register as wreg
+from ..workloads import long_fork as wlf
+from ..workloads import wr as wwr
 from .. import control as c
 from . import std_generator
 
@@ -137,6 +157,291 @@ class SetClient(jclient.Client):
 
     def close(self, test):
         pass
+
+
+def _is_conflict(e: Exception) -> bool:
+    s = str(e).lower()
+    return "abort" in s or "conflict" in s
+
+
+def _kv_rows(res: dict, block: str = "q") -> list:
+    """Query-block results: /query responses carry them directly under
+    data; /mutate upsert-block responses nest them under
+    data["queries"] (only "uids" sits at data's top level)."""
+    data = res.get("data") or {}
+    queries = data.get("queries")
+    if isinstance(queries, dict):
+        return queries.get(block) or []
+    return data.get(block) or []
+
+
+class _AlphaClient(jclient.Client):
+    """Shared alpha-client shape: per-node connection and the
+    conflict-as-definite-fail discipline (client.clj's
+    with-conflict-as-fail — an aborted txn definitely did not commit).
+    Subclasses implement ``_invoke``."""
+
+    def __init__(self, conn: Optional[Alpha] = None):
+        self.conn = conn
+
+    def open(self, test, node):
+        return type(self)(Alpha(str(node)))
+
+    def invoke(self, test, op):
+        try:
+            return self._invoke(test, op)
+        except RuntimeError as e:
+            if _is_conflict(e):
+                return {**op, "type": "fail", "error": "conflict"}
+            raise
+
+    def close(self, test):
+        pass
+
+
+class TxnClient(_AlphaClient):
+    """Generic micro-op txn client (the reference's
+    dgraph.client/txn-client, client.clj:430-471): value is
+    ``[["r", k, v?], ["w", k, v], …]``. Reads become named query blocks,
+    writes insert-or-update mutation pairs — the whole txn is one
+    commitNow upsert block. ``blind_insert`` skips the update arm for
+    workloads whose keys are written once (long-fork's
+    blind-insert-on-write?, long_fork.clj:5-8)."""
+
+    def __init__(self, conn: Optional[Alpha] = None,
+                 blind_insert: bool = False):
+        super().__init__(conn)
+        self.blind_insert = blind_insert
+
+    def open(self, test, node):
+        return type(self)(Alpha(str(node)), self.blind_insert)
+
+    def setup(self, test):
+        self.conn.alter("key: int @index(int) @upsert .\nvalue: int .")
+
+    def _invoke(self, test, op):
+        mops = op["value"]
+        # The upsert block's query and conditions all evaluate at the
+        # txn snapshot, so intra-txn effects are resolved client-side:
+        # reads after an own write return that write (read-your-writes),
+        # and only the LAST write per key is sent (earlier ones could
+        # otherwise each satisfy the len==0 insert arm and duplicate
+        # the record).
+        written: dict = {}
+        local_reads: dict = {}
+        qparts = []
+        last_write: dict = {}
+        for i, (f, k, v) in enumerate(mops):
+            if f == "w":
+                written[k] = v
+                last_write[k] = i
+            elif f == "r":
+                if k in written:
+                    local_reads[i] = written[k]
+                else:
+                    qparts.append(f"q{i}(func: eq(key, {k})) {{ value }}")
+        muts = []
+        for i, (f, k, v) in enumerate(mops):
+            if f != "w" or last_write[k] != i:
+                continue
+            if self.blind_insert:
+                muts.append({"set": [{"key": k, "value": v}]})
+            else:
+                qparts.append(f"u{i} as var(func: eq(key, {k}))")
+                muts.append({"cond": f"@if(eq(len(u{i}), 0))",
+                             "set": [{"key": k, "value": v}]})
+                muts.append({"cond": f"@if(eq(len(u{i}), 1))",
+                             "set": [{"uid": f"uid(u{i})", "value": v}]})
+        q = "{ " + " ".join(qparts) + " }" if qparts else None
+        if muts:
+            body = {"mutations": muts}
+            if q:
+                body["query"] = q
+            res = self.conn.mutate_json(body)
+        else:
+            res = self.conn.query(q)
+        done = []
+        for i, (f, k, v) in enumerate(mops):
+            if f == "r":
+                if i in local_reads:
+                    done.append(["r", k, local_reads[i]])
+                else:
+                    rows = _kv_rows(res, f"q{i}")
+                    done.append(
+                        ["r", k, rows[0].get("value") if rows else None])
+            else:
+                done.append([f, k, v])
+        return {**op, "type": "ok", "value": done}
+
+
+class LinRegisterClient(_AlphaClient):
+    """Keyed linearizable register (linearizable_register.clj:33-67):
+    read/write/cas, each one upsert block. Read timeouts convert to
+    :fail (reads are idempotent, linearizable_register.clj:24-31)."""
+
+    def setup(self, test):
+        self.conn.alter("key: int @index(int) @upsert .\nvalue: int .")
+
+    def invoke(self, test, op):
+        try:
+            return super().invoke(test, op)
+        except Exception:
+            # Reads are idempotent: ANY error is safely a definite fail
+            # (read-info->fail, linearizable_register.clj:24-31).
+            if op["f"] == "read":
+                return {**op, "type": "fail", "error": "read-error"}
+            raise
+
+    def _invoke(self, test, op):
+        k, v = op["value"]
+        if op["f"] == "read":
+            res = self.conn.query(
+                f"{{ q(func: eq(key, {k})) {{ uid value }} }}")
+            rows = _kv_rows(res)
+            val = rows[0].get("value") if rows else None
+            return {**op, "type": "ok",
+                    "value": independent.tuple_(k, val)}
+        if op["f"] == "write":
+            self.conn.mutate_json({
+                "query": f"{{ u as var(func: eq(key, {k})) }}",
+                "mutations": [
+                    {"cond": "@if(eq(len(u), 0))",
+                     "set": [{"key": k, "value": v}]},
+                    {"cond": "@if(eq(len(u), 1))",
+                     "set": [{"uid": "uid(u)", "value": v}]},
+                ]})
+            return {**op, "type": "ok"}
+        old, new = v
+        res = self.conn.mutate_json({
+            "query": f"{{ q(func: eq(key, {k})) "
+                     f"@filter(eq(value, {old})) {{ u as uid }} }}",
+            "mutations": [
+                {"cond": "@if(eq(len(u), 1))",
+                 "set": [{"uid": "uid(u)", "value": new}]},
+            ]})
+        if _kv_rows(res):
+            return {**op, "type": "ok"}
+        return {**op, "type": "fail", "error": "value-mismatch"}
+
+
+class DeleteClient(_AlphaClient):
+    """Keyed upsert/delete/read probing index freshness
+    (delete.clj:23-62)."""
+
+    def setup(self, test):
+        self.conn.alter("key: int @index(int) @upsert .")
+
+    def _invoke(self, test, op):
+        k, _v = op["value"]
+        if op["f"] == "read":
+            res = self.conn.query(
+                f"{{ q(func: eq(key, {k})) {{ uid key }} }}")
+            return {**op, "type": "ok",
+                    "value": independent.tuple_(k, _kv_rows(res))}
+        if op["f"] == "upsert":
+            res = self.conn.mutate_json({
+                "query": f"{{ u as var(func: eq(key, {k})) }}",
+                "mutations": [{"cond": "@if(eq(len(u), 0))",
+                               "set": [{"key": k}]}]})
+            created = bool((res.get("data") or {}).get("uids"))
+            return {**op, "type": "ok" if created else "fail",
+                    **({} if created else {"error": "present"})}
+        res = self.conn.mutate_json({
+            "query": f"{{ q(func: eq(key, {k})) {{ u as uid }} }}",
+            "mutations": [{"cond": "@if(eq(len(u), 1))",
+                           "delete": [{"uid": "uid(u)",
+                                       "key": None}]}]})
+        if _kv_rows(res):
+            return {**op, "type": "ok"}
+        return {**op, "type": "fail", "error": "not-found"}
+
+
+class BankClient(_AlphaClient):
+    """Bank transfers with on-the-fly account create/delete
+    (bank.clj:60-199): the reference's find/write/abort dance is one
+    upsert block whose condition blocks encode sufficient funds
+    (a filtered query block's len) and the create/delete cases."""
+
+    def setup(self, test):
+        self.conn.alter("key: int @index(int) @upsert .\n"
+                        "type: string @index(exact) .\namount: int .")
+        for acct, amt in wbank.initial_balances(test):
+            self.conn.mutate_json({
+                "query": f"{{ u as var(func: eq(key, {acct})) }}",
+                "mutations": [{"cond": "@if(eq(len(u), 0))",
+                               "set": [{"key": acct, "type": "account",
+                                        "amount": amt}]}]})
+
+    def _invoke(self, test, op):
+        if op["f"] == "read":
+            res = self.conn.query(
+                '{ q(func: eq(type, "account")) { key amount } }')
+            return {**op, "type": "ok",
+                    "value": {r["key"]: r["amount"]
+                              for r in _kv_rows(res)}}
+        v = op["value"]
+        f_, t_, amt = v["from"], v["to"], v["amount"]
+        res = self.conn.mutate_json({
+            "query": (
+                # fa: the from-account, only if it can afford amt.
+                f"{{ fa(func: eq(key, {f_})) "
+                f"@filter(ge(amount, {amt})) "
+                f"{{ fu as uid fv as amount nf as math(fv - {amt}) }} "
+                # fz: from-account that lands exactly on zero.
+                f"fz(func: eq(key, {f_})) "
+                f"@filter(eq(amount, {amt})) {{ fzu as uid }} "
+                f"tb(func: eq(key, {t_})) "
+                f"{{ tu as uid tv as amount nt as math(tv + {amt}) }} }}"
+            ),
+            "mutations": [
+                {"cond": "@if(eq(len(fu), 1) AND eq(len(fzu), 0))",
+                 "set": [{"uid": "uid(fu)", "amount": "val(nf)"}]},
+                # Zero balance: delete the account record entirely
+                # (bank.clj:88-99).
+                {"cond": "@if(eq(len(fzu), 1))",
+                 "delete": [{"uid": "uid(fzu)", "key": None,
+                             "type": None, "amount": None}]},
+                {"cond": "@if(eq(len(fu), 1) AND eq(len(tu), 1))",
+                 "set": [{"uid": "uid(tu)", "amount": "val(nt)"}]},
+                # Destination doesn't exist yet: create it.
+                {"cond": "@if(eq(len(fu), 1) AND eq(len(tu), 0))",
+                 "set": [{"key": t_, "type": "account",
+                          "amount": amt}]},
+            ]})
+        if _kv_rows(res, "fa"):
+            return {**op, "type": "ok"}
+        return {**op, "type": "fail", "error": "insufficient-funds"}
+
+
+class SequentialRegClient(_AlphaClient):
+    """Keyed inc/read register for the monotonic-state probe
+    (sequential.clj:63-105): inc reads value in the upsert block's query
+    and writes val(math(v+1)) server-side."""
+
+    def setup(self, test):
+        self.conn.alter("key: int @index(int) @upsert .\nvalue: int .")
+
+    def _invoke(self, test, op):
+        k, _v = op["value"]
+        if op["f"] == "read":
+            res = self.conn.query(
+                f"{{ q(func: eq(key, {k})) {{ value }} }}")
+            rows = _kv_rows(res)
+            val = rows[0].get("value", 0) if rows else 0
+            return {**op, "type": "ok",
+                    "value": independent.tuple_(k, val)}
+        res = self.conn.mutate_json({
+            "query": f"{{ q(func: eq(key, {k})) "
+                     f"{{ u as uid v as value nv as math(v + 1) }} }}",
+            "mutations": [
+                {"cond": "@if(eq(len(u), 0))",
+                 "set": [{"key": k, "value": 1}]},
+                {"cond": "@if(eq(len(u), 1))",
+                 "set": [{"uid": "uid(u)", "value": "val(nv)"}]},
+            ]})
+        rows = _kv_rows(res)
+        new = (rows[0].get("value", 0) + 1) if rows else 1
+        return {**op, "type": "ok", "value": independent.tuple_(k, new)}
 
 
 class DgraphDB(jdb.DB, jdb.Process, jdb.LogFiles):
@@ -258,7 +563,155 @@ def set_workload(opts: Optional[dict] = None) -> dict:
     }
 
 
-WORKLOADS = {"upsert": upsert_workload, "set": set_workload}
+def delete_checker() -> Checker:
+    """Every ok read sees nothing or exactly one {uid, key} record, all
+    reads agreeing on one key value (delete.clj:64-87). Runs per-key
+    under independent.checker."""
+
+    def chk(test, history, opts):
+        bad = []
+        keys_seen = set()
+        for op in history:
+            if not (op.is_ok and op.f == "read"):
+                continue
+            rows = op.value or []
+            if len(rows) > 1:
+                bad.append({"op": repr(op), "error": "multiple-records"})
+                continue
+            for r in rows:
+                if set(r) != {"uid", "key"}:
+                    bad.append({"op": repr(op), "error": "bad-record",
+                                "record": r})
+                else:
+                    keys_seen.add(r["key"])
+        if len(keys_seen) > 1:
+            bad.append({"error": "cross-key-leak",
+                        "keys": sorted(keys_seen)})
+        return {"valid": not bad, "bad-reads": bad}
+
+    return checker_fn(chk, "deletes")
+
+
+def sequential_reg_checker() -> Checker:
+    """Each process's observed register values must be monotonic
+    (sequential.clj:107-140). Runs per-key under independent.checker."""
+
+    def chk(test, history, opts):
+        last: dict = {}
+        errs = []
+        for op in history:
+            if not op.is_ok:
+                continue
+            v = op.value
+            if not isinstance(v, int):
+                continue
+            p = op.process
+            if v < last.get(p, 0):
+                errs.append({"process": p, "from": last[p], "to": v})
+            last[p] = v
+        return {"valid": not errs, "non-monotonic": errs}
+
+    return checker_fn(chk, "sequential")
+
+
+def bank_workload(opts: Optional[dict] = None) -> dict:
+    wl = wbank.test(dict(opts or {}))
+    return {**wl, "client": BankClient(),
+            "generator": gen.clients(wl["generator"])}
+
+
+def delete_workload(opts: Optional[dict] = None) -> dict:
+    o = dict(opts or {})
+
+    def mop(f):
+        return lambda test=None, ctx=None: {
+            "type": "invoke", "f": f, "value": None}
+
+    def fgen(k):
+        return gen.stagger(0.01, gen.limit(
+            int(o.get("ops-per-key") or 50),
+            gen.mix([mop("read"), mop("upsert"), mop("delete")])))
+
+    return {
+        "client": DeleteClient(),
+        "generator": gen.clients(independent.concurrent_generator(
+            2, itertools.count(), fgen)),
+        "checker": independent.checker(jchecker.compose({
+            "deletes": delete_checker(),
+            "stats": jchecker.stats(),
+        })),
+    }
+
+
+def long_fork_workload(opts: Optional[dict] = None) -> dict:
+    wl = wlf.workload(3)
+    return {**wl, "client": TxnClient(blind_insert=True),
+            "generator": gen.clients(wl["generator"])}
+
+
+def register_workload(opts: Optional[dict] = None) -> dict:
+    wl = wreg.test(dict(opts or {}))
+    return {**wl, "client": LinRegisterClient(),
+            "generator": gen.clients(
+                gen.stagger(0.01, wl["generator"]))}
+
+
+def sequential_workload(opts: Optional[dict] = None) -> dict:
+    o = dict(opts or {})
+    keys = list(range(int(o.get("keys") or 2)))
+
+    def mop(f):
+        return lambda test=None, ctx=None: {
+            "type": "invoke", "f": f, "value": None}
+
+    def fgen(k):
+        return gen.stagger(0.01, gen.mix([mop("inc"), mop("read")]))
+
+    return {
+        "client": SequentialRegClient(),
+        "generator": gen.clients(independent.concurrent_generator(
+            2, keys, fgen)),
+        "checker": independent.checker(jchecker.compose({
+            "sequential": sequential_reg_checker(),
+            "stats": jchecker.stats(),
+        })),
+    }
+
+
+def wr_workload(opts: Optional[dict] = None) -> dict:
+    o = dict(opts or {})
+    wl = wwr.test({
+        "key_count": 4,
+        "min_txn_length": 2,
+        "max_txn_length": 4,
+        "max_writes_per_key": 16,
+        # wr.clj:22-31: sequential version orders + the realtime graph
+        # (dgraph claims linearizability) — strict serializability.
+        "sequential_keys": True,
+        "additional_graphs": ["realtime"],
+        "anomalies": ["G0", "G1c", "G-single", "G1a", "G1b", "internal"],
+    })
+    return {
+        "client": TxnClient(),
+        "generator": gen.clients(
+            gen.limit(int(o.get("ops") or 200), wl["generator"])),
+        "checker": jchecker.compose({
+            "wr": wl["checker"],
+            "stats": jchecker.stats(),
+        }),
+    }
+
+
+WORKLOADS = {
+    "upsert": upsert_workload,
+    "set": set_workload,
+    "bank": bank_workload,
+    "delete": delete_workload,
+    "long-fork": long_fork_workload,
+    "linearizable-register": register_workload,
+    "sequential": sequential_workload,
+    "wr": wr_workload,
+}
 
 
 def trace_export_checker(collector) -> Checker:

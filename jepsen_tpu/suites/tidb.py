@@ -1,16 +1,29 @@
-"""TiDB suite: bank + list-append over the MySQL protocol.
+"""TiDB suite: the reference's full workload roster over the MySQL
+protocol.
 
 The reference's tidb suite (tidb/, 2611 LoC, SURVEY §2.6) runs
-register/bank/sets/long-fork/monotonic/sequential/txn workloads through
-JDBC. TiDB speaks the MySQL wire protocol, so this suite drives the
-``mysql`` CLI on the node (driver-free, like the galera suite):
+register/bank/set/long-fork/monotonic/sequential/txn/append workloads
+through JDBC (tidb/src/tidb/core.clj:32-45's workload map). TiDB speaks
+the MySQL wire protocol, so this suite drives the ``mysql`` CLI on the
+node (driver-free, like the galera suite):
 
 - **bank**: transfers inside pessimistic transactions with
-  ``SELECT ... FOR UPDATE`` guards; the total-balance invariant is the
-  snapshot-isolation probe (tests/bank.clj:41-121).
+  ``SELECT ... FOR UPDATE`` guards (tests/bank.clj:41-121).
 - **append**: elle list-append over a JSON column using
-  ``JSON_ARRAY_APPEND`` in one transaction per txn-op — the dependency
-  graph is then cycle-checked on the TPU (elle/append.py).
+  ``JSON_ARRAY_APPEND``; the dependency graph is cycle-checked on the
+  TPU (elle/append.py).
+- **register**: keyed linearizable register (register.clj:17-78).
+- **set**: blind inserts + reads under set-full (sets.clj:11-36).
+- **long-fork** / **txn**: a generic kv txn client (one BEGIN
+  PESSIMISTIC script per txn) under the long-fork and elle wr checkers
+  (long_fork.clj, txn.clj + monotonic.clj's txn workload).
+- **monotonic**: per-key increments + group reads, checked by the
+  monotonic-key cycle analyzer composed with the realtime graph
+  (monotonic.clj:36-110 — cycle/combine monotonic-key-graph
+  realtime-graph).
+- **sequential**: the cross-table subkey-chain probe — the reference
+  copied cockroach's test verbatim (sequential.clj:1-16), so the
+  generator/checker are shared from the cockroachdb suite here.
 
 The DB lifecycle runs the three-binary topology (pd-server on every
 node, tikv-server on every node, tidb-server on every node) from the
@@ -20,19 +33,32 @@ official tarball, mirroring tidb/src/jepsen/tidb/db.clj.
 from __future__ import annotations
 
 import json
-from typing import Any
+from typing import Any, Optional
 
+from .. import checker as jchecker
 from .. import cli, client as jclient, db as jdb, generator as gen
+from .. import elle as jelle
+from .. import independent
 from .. import nemesis as jnemesis, net as jnet
+from ..checker import checker_fn
 from ..control import util as cu
 from ..workloads import append as wa
 from ..workloads import bank as wbank
+from ..workloads import linearizable_register as wreg
+from ..workloads import long_fork as wlf
+from ..workloads import wr as wwr
 from .. import control as c
 from . import std_generator
+from .cockroachdb import sequential_checker, sequential_gen, _subkeys
 
 PORT = 4000
 BANK_TABLE = "jepsen.bank"
 APPEND_TABLE = "jepsen.append"
+REGISTER_TABLE = "jepsen.test"
+SET_TABLE = "jepsen.sets"
+KV_TABLE = "jepsen.kv"
+CYCLE_TABLE = "jepsen.cycle"
+SEQ_TABLES = 10
 
 
 class _SqlClient(jclient.Client):
@@ -137,6 +163,220 @@ class AppendClient(_SqlClient):
         return {**op, "type": "ok", "value": done}
 
 
+NULL_SENTINEL = "JEPSEN_NULL"
+
+
+def _lines(out: str) -> list[str]:
+    return [line for line in out.strip().split("\n") if line.strip()]
+
+
+class RegisterClient(_SqlClient):
+    """Keyed cas-register (tidb/register.clj:29-70): cas inside one
+    pessimistic txn, deciding via ROW_COUNT() of the guarded UPDATE."""
+
+    def setup(self, test):
+        self._sql(test,
+                  "CREATE DATABASE IF NOT EXISTS jepsen;\n"
+                  f"CREATE TABLE IF NOT EXISTS {REGISTER_TABLE} "
+                  "(id INT PRIMARY KEY, sk INT, val INT);")
+
+    def invoke(self, test, op):
+        k, v = op["value"]
+        try:
+            if op["f"] == "read":
+                out = self._sql(
+                    test,
+                    f"SELECT COALESCE((SELECT val FROM {REGISTER_TABLE} "
+                    f"WHERE id = {k}), '{NULL_SENTINEL}');")
+                line = _lines(out)[0]
+                val = None if line == NULL_SENTINEL else int(line)
+                return {**op, "type": "ok",
+                        "value": independent.tuple_(k, val)}
+            if op["f"] == "write":
+                self._sql(test,
+                          f"INSERT INTO {REGISTER_TABLE} (id, sk, val) "
+                          f"VALUES ({k}, {k}, {v}) ON DUPLICATE KEY "
+                          f"UPDATE val = {v};")
+                return {**op, "type": "ok"}
+            old, new = v
+            out = self._sql(test, "\n".join([
+                "BEGIN PESSIMISTIC;",
+                f"UPDATE {REGISTER_TABLE} SET val = {new} "
+                f"WHERE id = {k} AND val = {old};",
+                "SELECT ROW_COUNT();",
+                "COMMIT;",
+            ]))
+            hit = _lines(out)[-1] == "1"
+            return {**op, "type": "ok" if hit else "fail",
+                    **({} if hit else {"error": "precondition-failed"})}
+        except c.RemoteError as e:
+            if self._definite_fail(e):
+                return {**op, "type": "fail", "error": "conflict"}
+            raise
+
+
+class SetClient(_SqlClient):
+    """Blind inserts + full reads (tidb/sets.clj:11-36)."""
+
+    def setup(self, test):
+        self._sql(test,
+                  "CREATE DATABASE IF NOT EXISTS jepsen;\n"
+                  f"CREATE TABLE IF NOT EXISTS {SET_TABLE} "
+                  "(id INT NOT NULL PRIMARY KEY AUTO_INCREMENT, "
+                  "value BIGINT NOT NULL);")
+
+    def invoke(self, test, op):
+        try:
+            if op["f"] == "read":
+                out = self._sql(test, f"SELECT value FROM {SET_TABLE};")
+                return {**op, "type": "ok",
+                        "value": [int(x) for x in _lines(out)]}
+            self._sql(test, f"INSERT INTO {SET_TABLE} (value) "
+                            f"VALUES ({op['value']});")
+            return {**op, "type": "ok"}
+        except c.RemoteError as e:
+            if self._definite_fail(e):
+                return {**op, "type": "fail", "error": "conflict"}
+            raise
+
+
+class KvTxnClient(_SqlClient):
+    """Generic micro-op txn client over an (id, val) table — one
+    BEGIN PESSIMISTIC script per txn, reads COALESCE-sentineled so
+    output lines stay positional (long_fork.clj's txn client and
+    txn.clj's wr client share this shape)."""
+
+    def setup(self, test):
+        self._sql(test,
+                  "CREATE DATABASE IF NOT EXISTS jepsen;\n"
+                  f"CREATE TABLE IF NOT EXISTS {KV_TABLE} "
+                  "(id INT PRIMARY KEY, val INT);")
+
+    def invoke(self, test, op):
+        mops = op["value"]
+        stmts = ["BEGIN PESSIMISTIC;"]
+        for f, k, v in mops:
+            if f == "r":
+                stmts.append(
+                    f"SELECT COALESCE((SELECT val FROM {KV_TABLE} "
+                    f"WHERE id = {k}), '{NULL_SENTINEL}');")
+            else:
+                stmts.append(
+                    f"INSERT INTO {KV_TABLE} VALUES ({k}, {v}) "
+                    f"ON DUPLICATE KEY UPDATE val = {v};")
+        stmts.append("COMMIT;")
+        try:
+            out = self._sql(test, "\n".join(stmts))
+        except c.RemoteError as e:
+            if self._definite_fail(e):
+                return {**op, "type": "fail", "error": "conflict"}
+            raise
+        lines = _lines(out)
+        done = []
+        ri = 0
+        for f, k, v in mops:
+            if f == "r":
+                line = lines[ri]
+                ri += 1
+                done.append(
+                    ["r", k, None if line == NULL_SENTINEL else int(line)])
+            else:
+                done.append([f, k, v])
+        return {**op, "type": "ok", "value": done}
+
+
+class IncrementClient(_SqlClient):
+    """Per-key increments + group reads (tidb/monotonic.clj:36-85):
+    the read-then-update collapses to INSERT…ON DUPLICATE KEY UPDATE
+    val = val + 1 followed by an in-txn read of the written value."""
+
+    def setup(self, test):
+        self._sql(test,
+                  "CREATE DATABASE IF NOT EXISTS jepsen;\n"
+                  f"CREATE TABLE IF NOT EXISTS {CYCLE_TABLE} "
+                  "(pk INT NOT NULL PRIMARY KEY, sk INT NOT NULL, "
+                  "val INT);")
+
+    def invoke(self, test, op):
+        try:
+            if op["f"] == "read":
+                ks = sorted(op["value"])
+                stmts = ["BEGIN PESSIMISTIC;"] + [
+                    f"SELECT COALESCE((SELECT val FROM {CYCLE_TABLE} "
+                    f"WHERE pk = {k}), -1);" for k in ks
+                ] + ["COMMIT;"]
+                out = self._sql(test, "\n".join(stmts))
+                vals = [int(x) for x in _lines(out)]
+                return {**op, "type": "ok", "value": dict(zip(ks, vals))}
+            k = op["value"]
+            # First insert lands val=0, later ones increment — exactly
+            # the reference's missing=-1 → insert 0 behavior.
+            out = self._sql(test, "\n".join([
+                "BEGIN PESSIMISTIC;",
+                f"INSERT INTO {CYCLE_TABLE} VALUES ({k}, {k}, 0) "
+                "ON DUPLICATE KEY UPDATE val = val + 1;",
+                f"SELECT val FROM {CYCLE_TABLE} WHERE pk = {k};",
+                "COMMIT;",
+            ]))
+            val = int(_lines(out)[-1])
+            return {**op, "type": "ok", "value": {k: val}}
+        except c.RemoteError as e:
+            if self._definite_fail(e):
+                return {**op, "type": "fail", "error": "conflict"}
+            raise
+
+
+class SequentialClient(_SqlClient):
+    """Cross-table subkey chains (tidb/sequential.clj:49-86) — writes
+    insert subkeys in order, reads probe them in reverse."""
+
+    def setup(self, test):
+        stmts = ["CREATE DATABASE IF NOT EXISTS jepsen;"] + [
+            f"CREATE TABLE IF NOT EXISTS jepsen.seq_{i} "
+            "(tkey VARCHAR(255) PRIMARY KEY);" for i in range(SEQ_TABLES)
+        ]
+        self._sql(test, "\n".join(stmts))
+
+    @staticmethod
+    def _table(subkey: str) -> str:
+        import zlib
+
+        return f"jepsen.seq_{zlib.crc32(subkey.encode()) % SEQ_TABLES}"
+
+    def invoke(self, test, op):
+        key_count = int(test.get("key-count") or 5)
+        ks = _subkeys(key_count, op["value"])
+        try:
+            if op["f"] == "write":
+                self._sql(test, "\n".join(
+                    f"INSERT IGNORE INTO {self._table(k)} VALUES ('{k}');"
+                    for k in ks))
+                return {**op, "type": "ok"}
+            stmts = [
+                f"SELECT COALESCE((SELECT tkey FROM {self._table(k)} "
+                f"WHERE tkey = '{k}'), '{NULL_SENTINEL}');"
+                for k in reversed(ks)
+            ]
+            out = self._sql(test, "\n".join(stmts))
+            seen = [None if line == NULL_SENTINEL else line
+                    for line in _lines(out)]
+            return {**op, "type": "ok", "value": [op["value"], seen]}
+        except c.RemoteError as e:
+            if self._definite_fail(e):
+                return {**op, "type": "fail", "error": "conflict"}
+            raise
+
+
+def monotonic_checker() -> jchecker.Checker:
+    """cycle/combine(monotonic-key-graph, realtime-graph) via the elle
+    package's analyzer (tidb/monotonic.clj:104-110)."""
+
+    def chk(test, history, opts):
+        return jelle.monotonic_key_check(history, realtime=True)
+
+    return checker_fn(chk, "monotonic-cycle")
+
+
 class TidbDB(jdb.DB, jdb.Process, jdb.LogFiles):
     """pd + tikv + tidb daemons per node (tidb/db.clj topology)."""
 
@@ -210,7 +450,106 @@ def append_workload(opts: dict) -> dict:
             "checker": wl["checker"]}
 
 
-WORKLOADS = {"bank": bank_workload, "append": append_workload}
+def register_workload(opts: dict) -> dict:
+    wl = wreg.test(dict(opts or {}))
+    return {**wl, "client": RegisterClient(),
+            "generator": gen.stagger(0.01, wl["generator"])}
+
+
+def set_workload(opts: dict) -> dict:
+    import itertools
+
+    ids = itertools.count()
+
+    def add(t=None, ctx=None):
+        return {"type": "invoke", "f": "add", "value": next(ids)}
+
+    def read(t=None, ctx=None):
+        return {"type": "invoke", "f": "read", "value": None}
+
+    return {
+        "client": SetClient(),
+        "generator": gen.stagger(0.05, gen.reserve(2, add, read)),
+        # clients() matters: a bare final phase could hand the one
+        # final read to the nemesis thread and lose it.
+        "final-generator": gen.clients(gen.once(
+            {"type": "invoke", "f": "read", "value": None})),
+        "checker": jchecker.compose({
+            "set": jchecker.set_full(),
+            "stats": jchecker.stats(),
+        }),
+    }
+
+
+def long_fork_workload(opts: dict) -> dict:
+    wl = wlf.workload(3)
+    return {**wl, "client": KvTxnClient()}
+
+
+def monotonic_workload(opts: dict) -> dict:
+    key_count = int(opts.get("keys") or 8)
+
+    def inc(t=None, ctx=None):
+        return {"type": "invoke", "f": "inc",
+                "value": gen.rand_int(key_count)}
+
+    def read(t=None, ctx=None):
+        return {"type": "invoke", "f": "read",
+                "value": {k: None for k in range(key_count)}}
+
+    return {
+        "client": IncrementClient(),
+        "generator": gen.stagger(0.02, gen.mix([inc, read])),
+        "checker": jchecker.compose({
+            "cycle": monotonic_checker(),
+            "stats": jchecker.stats(),
+        }),
+    }
+
+
+def sequential_workload(opts: dict) -> dict:
+    return {
+        "client": SequentialClient(),
+        "key-count": int(opts.get("key-count") or 5),
+        "generator": gen.stagger(0.02, sequential_gen()),
+        "checker": jchecker.compose({
+            "sequential": sequential_checker(),
+            "stats": jchecker.stats(),
+        }),
+    }
+
+
+def txn_workload(opts: dict) -> dict:
+    wl = wwr.test({
+        "key_count": 5,
+        "min_txn_length": 1,
+        "max_txn_length": 4,
+        "max_writes_per_key": 16,
+        "sequential_keys": True,
+        "additional_graphs": ["realtime"],
+        "anomalies": ["G0", "G1c", "G-single", "G1a", "G1b", "internal"],
+    })
+    return {
+        "client": KvTxnClient(),
+        "generator": gen.limit(int(opts.get("ops") or 200),
+                               wl["generator"]),
+        "checker": jchecker.compose({
+            "wr": wl["checker"],
+            "stats": jchecker.stats(),
+        }),
+    }
+
+
+WORKLOADS = {
+    "bank": bank_workload,
+    "append": append_workload,
+    "register": register_workload,
+    "set": set_workload,
+    "long-fork": long_fork_workload,
+    "monotonic": monotonic_workload,
+    "sequential": sequential_workload,
+    "txn": txn_workload,
+}
 
 
 def test_fn(opts: dict) -> dict:
@@ -221,8 +560,11 @@ def test_fn(opts: dict) -> dict:
         "db": TidbDB(),
         "net": jnet.iptables(),
         "nemesis": jnemesis.partition_random_halves(),
-        **{k: v for k, v in wl.items() if k != "generator"},
-        "generator": std_generator(opts, wl["generator"]),
+        **{k: v for k, v in wl.items()
+           if k not in ("generator", "final-generator")},
+        "generator": std_generator(
+            opts, wl["generator"],
+            final_client_gen=wl.get("final-generator")),
     }
 
 

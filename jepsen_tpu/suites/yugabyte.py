@@ -1,41 +1,60 @@
-"""YugabyteDB suite: a workload × nemesis matrix over ysqlsh.
+"""YugabyteDB suite: the dual-API (ycql/ysql) workload × nemesis matrix.
 
 The reference's yugabyte suite (yugabyte/, 3567 LoC) is the most modern
-in the monorepo: namespaced workloads swept against combined nemeses
-(yugabyte/src/yugabyte/core.clj:73-161, `test-all` combinatorics
-:181-201). This suite mirrors that structure on this framework:
+in the monorepo: NAMESPACED workloads — every test exists for both the
+Cassandra-dialect YCQL API and the PostgreSQL-dialect YSQL API — swept
+against combined nemeses (yugabyte/src/yugabyte/core.clj:73-103's
+workloads-ycql/workloads-ysql maps, `test-all` combinatorics :181-201).
+This suite mirrors that structure on this framework:
 
-- workloads: **append** (elle list-append over JSONB, the ysql/append
-  shape), **bank**, **set** (unique inserts + final read);
+- ycql workloads (over ``ycqlsh``): counter, set, set-index, bank,
+  long-fork, single-key-acid, multi-key-acid;
+- ysql workloads (over ``ysqlsh``): counter, set, bank,
+  bank-multitable, long-fork, single-key-acid, multi-key-acid, append,
+  append-table, default-value;
 - faults: any subset of partition/kill/pause/clock through the combined
   nemesis-package algebra (nemesis/combined.py), exactly as the
   reference composes master/tserver killers with partitions and skews;
 - `test-all` sweeps the workload × fault-set matrix from one CLI.
 
-Clients drive ``ysqlsh`` (YSQL is the PostgreSQL dialect) on the node;
-the DB runs master + tserver daemons per node
-(yugabyte/src/yugabyte/db.clj topology).
+Workload names are namespaced like the reference's ("ycql/bank",
+"ysql/append"); bare legacy names resolve to the ysql variants. The DB
+runs master + tserver daemons per node (yugabyte/src/yugabyte/db.clj
+topology).
 """
 
 from __future__ import annotations
 
 import json
-from typing import Any
+import zlib
+from typing import Any, Optional
 
 from .. import checker as jchecker
 from .. import cli, client as jclient, db as jdb, generator as gen
+from .. import independent
+from ..models import CasRegister, MultiRegister
 from ..nemesis import combined as ncombined
 from .. import net as jnet
+from ..checker import checker_fn
 from ..control import util as cu
 from ..workloads import append as wa
 from ..workloads import bank as wbank
+from ..workloads import linearizable_register as wreg
+from ..workloads import long_fork as wlf
 from .. import control as c
 from . import std_generator
 
 YSQLSH = "/opt/yugabyte/bin/ysqlsh"
+YCQLSH = "/opt/yugabyte/bin/ycqlsh"
 BANK_TABLE = "jepsen_bank"
 APPEND_TABLE = "jepsen_append"
 SET_TABLE = "jepsen_set"
+KV_TABLE = "jepsen_kv"
+COUNTER_TABLE = "jepsen_counter"
+MULTI_TABLE = "jepsen_multi"
+DV_TABLE = "jepsen_dv"
+NULL_SENTINEL = "JEPSEN_NULL"
+KEYSPACE = "jepsen"
 
 
 class _YsqlClient(jclient.Client):
@@ -161,6 +180,570 @@ class SetClient(_YsqlClient):
         raise ValueError(f"unknown f {op['f']!r}")
 
 
+def _psql_lines(out: str) -> list[str]:
+    return [line for line in out.strip().split("\n") if line.strip()]
+
+
+class YsqlCounterClient(_YsqlClient):
+    """Single-row counter increments (ysql/counter.clj)."""
+
+    def setup(self, test):
+        self._sql(test,
+                  f"CREATE TABLE IF NOT EXISTS {COUNTER_TABLE} "
+                  "(id INT PRIMARY KEY, count BIGINT);\n"
+                  f"INSERT INTO {COUNTER_TABLE} VALUES (0, 0) "
+                  "ON CONFLICT (id) DO NOTHING;")
+
+    def invoke(self, test, op):
+        try:
+            if op["f"] == "read":
+                out = self._sql(
+                    test, f"SELECT count FROM {COUNTER_TABLE} WHERE id = 0;")
+                return {**op, "type": "ok",
+                        "value": int(_psql_lines(out)[0])}
+            self._sql(test,
+                      f"UPDATE {COUNTER_TABLE} SET count = count + "
+                      f"{op['value']} WHERE id = 0;")
+            return {**op, "type": "ok"}
+        except c.RemoteError as e:
+            if self._definite_fail(e) or op["f"] == "read":
+                return {**op, "type": "fail", "error": "sql"}
+            raise
+
+
+class YsqlKvTxnClient(_YsqlClient):
+    """Generic micro-op txn client over (id, val) — one serializable
+    script per txn, reads COALESCE-sentineled (long-fork's client
+    shape, ysql/long_fork.clj)."""
+
+    def setup(self, test):
+        self._sql(test,
+                  f"CREATE TABLE IF NOT EXISTS {KV_TABLE} "
+                  "(id INT PRIMARY KEY, val INT);")
+
+    def invoke(self, test, op):
+        mops = op["value"]
+        stmts = ["BEGIN ISOLATION LEVEL SERIALIZABLE;"]
+        for f, k, v in mops:
+            if f == "r":
+                stmts.append(
+                    f"SELECT COALESCE((SELECT val::TEXT FROM {KV_TABLE} "
+                    f"WHERE id = {k}), '{NULL_SENTINEL}');")
+            else:
+                stmts.append(
+                    f"INSERT INTO {KV_TABLE} VALUES ({k}, {v}) "
+                    f"ON CONFLICT (id) DO UPDATE SET val = {v};")
+        stmts.append("COMMIT;")
+        try:
+            out = self._sql(test, "\n".join(stmts))
+        except c.RemoteError as e:
+            if self._definite_fail(e):
+                return {**op, "type": "fail", "error": "serialization"}
+            raise
+        lines = _psql_lines(out)
+        done = []
+        ri = 0
+        for f, k, v in mops:
+            if f == "r":
+                line = lines[ri]
+                ri += 1
+                done.append(
+                    ["r", k, None if line == NULL_SENTINEL else int(line)])
+            else:
+                done.append([f, k, v])
+        return {**op, "type": "ok", "value": done}
+
+
+class YsqlSingleKeyClient(_YsqlClient):
+    """Keyed linearizable register (ysql/single_key_acid.clj): cas via
+    a guarded UPDATE … RETURNING."""
+
+    def setup(self, test):
+        self._sql(test,
+                  f"CREATE TABLE IF NOT EXISTS {KV_TABLE}_acid "
+                  "(id INT PRIMARY KEY, val INT);")
+
+    def invoke(self, test, op):
+        k, v = op["value"]
+        t = f"{KV_TABLE}_acid"
+        try:
+            if op["f"] == "read":
+                out = self._sql(
+                    test,
+                    f"SELECT COALESCE((SELECT val::TEXT FROM {t} "
+                    f"WHERE id = {k}), '{NULL_SENTINEL}');")
+                line = _psql_lines(out)[0]
+                val = None if line == NULL_SENTINEL else int(line)
+                return {**op, "type": "ok",
+                        "value": independent.tuple_(k, val)}
+            if op["f"] == "write":
+                self._sql(test,
+                          f"INSERT INTO {t} VALUES ({k}, {v}) "
+                          f"ON CONFLICT (id) DO UPDATE SET val = {v};")
+                return {**op, "type": "ok"}
+            old, new = v
+            out = self._sql(test,
+                            f"UPDATE {t} SET val = {new} "
+                            f"WHERE id = {k} AND val = {old} RETURNING id;")
+            hit = any(line.strip() == str(k) for line in _psql_lines(out))
+            return {**op, "type": "ok" if hit else "fail",
+                    **({} if hit else {"error": "precondition-failed"})}
+        except c.RemoteError as e:
+            if self._definite_fail(e):
+                return {**op, "type": "fail", "error": "serialization"}
+            raise
+
+
+class YsqlMultiKeyClient(_YsqlClient):
+    """Transactional multi-register batches (ysql/multi_key_acid.clj):
+    keyed rows (ik, k) written in one serializable txn; ops carry
+    {reg: value} maps for the multi-register model."""
+
+    def setup(self, test):
+        self._sql(test,
+                  f"CREATE TABLE IF NOT EXISTS {MULTI_TABLE} "
+                  "(ik INT, k INT, val INT, PRIMARY KEY (ik, k));")
+
+    def invoke(self, test, op):
+        ik, regs = op["value"]
+        try:
+            if op["f"] == "read":
+                ks = sorted(regs)
+                stmts = ["BEGIN ISOLATION LEVEL SERIALIZABLE;"] + [
+                    f"SELECT COALESCE((SELECT val::TEXT FROM {MULTI_TABLE}"
+                    f" WHERE ik = {ik} AND k = {k}), '{NULL_SENTINEL}');"
+                    for k in ks
+                ] + ["COMMIT;"]
+                out = self._sql(test, "\n".join(stmts))
+                vals = [None if line == NULL_SENTINEL else int(line)
+                        for line in _psql_lines(out)]
+                return {**op, "type": "ok", "value": independent.tuple_(
+                    ik, dict(zip(ks, vals)))}
+            stmts = ["BEGIN ISOLATION LEVEL SERIALIZABLE;"] + [
+                f"INSERT INTO {MULTI_TABLE} VALUES ({ik}, {k}, {v}) "
+                f"ON CONFLICT (ik, k) DO UPDATE SET val = {v};"
+                for k, v in sorted(regs.items())
+            ] + ["COMMIT;"]
+            self._sql(test, "\n".join(stmts))
+            return {**op, "type": "ok"}
+        except c.RemoteError as e:
+            if self._definite_fail(e):
+                return {**op, "type": "fail", "error": "serialization"}
+            raise
+
+
+class BankMultitableClient(_YsqlClient):
+    """Bank with one table per account (ysql/bank.clj's
+    YSQLMultiBankClient): transfers touch two tables in one txn."""
+
+    @staticmethod
+    def _table(acct) -> str:
+        return f"{BANK_TABLE}_{acct}"
+
+    def setup(self, test):
+        stmts = []
+        for a, b in wbank.initial_balances(test):
+            stmts.append(
+                f"CREATE TABLE IF NOT EXISTS {self._table(a)} "
+                "(id INT PRIMARY KEY, balance BIGINT NOT NULL);")
+            stmts.append(
+                f"INSERT INTO {self._table(a)} VALUES ({a}, {b}) "
+                "ON CONFLICT (id) DO NOTHING;")
+        self._sql(test, "\n".join(stmts))
+
+    def invoke(self, test, op):
+        accounts = list(test.get("accounts") or [])
+        try:
+            if op["f"] == "read":
+                stmts = ["BEGIN ISOLATION LEVEL SERIALIZABLE;"] + [
+                    f"SELECT id, balance FROM {self._table(a)};"
+                    for a in accounts
+                ] + ["COMMIT;"]
+                out = self._sql(test, "\n".join(stmts))
+                value = {}
+                for line in _psql_lines(out):
+                    if "|" in line:
+                        i, b = line.split("|")[:2]
+                        value[int(i)] = int(b)
+                return {**op, "type": "ok", "value": value}
+            v = op["value"]
+            self._sql(test, "\n".join([
+                "BEGIN ISOLATION LEVEL SERIALIZABLE;",
+                f"UPDATE {self._table(v['from'])} SET balance = balance - "
+                f"{v['amount']} WHERE id = {v['from']};",
+                f"UPDATE {self._table(v['to'])} SET balance = balance + "
+                f"{v['amount']} WHERE id = {v['to']};",
+                "COMMIT;",
+            ]))
+            return {**op, "type": "ok"}
+        except c.RemoteError as e:
+            if self._definite_fail(e):
+                return {**op, "type": "fail", "error": "serialization"}
+            raise
+
+
+class AppendTableClient(_YsqlClient):
+    """List-append with table-sharded lists (ysql/append_table.clj):
+    appends are ordered rows, reads select them back in insertion
+    order. The generator's key space is unbounded, so keys hash into a
+    fixed table pool, each row carrying its key (two keys sharing a
+    table can't contaminate each other's lists)."""
+
+    TABLES = 8
+
+    @classmethod
+    def _table(cls, k) -> str:
+        return f"{APPEND_TABLE}_k{zlib.crc32(str(k).encode()) % cls.TABLES}"
+
+    def setup(self, test):
+        stmts = [
+            f"CREATE TABLE IF NOT EXISTS {APPEND_TABLE}_k{i} "
+            "(id BIGSERIAL PRIMARY KEY, k INT, v INT);"
+            for i in range(self.TABLES)
+        ]
+        self._sql(test, "\n".join(stmts))
+
+    def invoke(self, test, op):
+        stmts = ["BEGIN ISOLATION LEVEL SERIALIZABLE;"]
+        for f, k, v in op["value"]:
+            if f == "r":
+                stmts.append(
+                    f"SELECT COALESCE((SELECT json_agg(v ORDER BY id)::TEXT "
+                    f"FROM {self._table(k)} WHERE k = {k}), '[]');")
+            else:
+                stmts.append(
+                    f"INSERT INTO {self._table(k)} (k, v) "
+                    f"VALUES ({k}, {v});")
+        stmts.append("COMMIT;")
+        try:
+            out = self._sql(test, "\n".join(stmts))
+        except c.RemoteError as e:
+            if self._definite_fail(e):
+                return {**op, "type": "fail", "error": "serialization"}
+            raise
+        lines = [line for line in _psql_lines(out)
+                 if line.strip().startswith("[")]
+        done = []
+        ri = 0
+        for f, k, v in op["value"]:
+            if f == "r":
+                done.append([f, k, json.loads(lines[ri])])
+                ri += 1
+            else:
+                done.append([f, k, v])
+        return {**op, "type": "ok", "value": done}
+
+
+class DefaultValueClient(_YsqlClient):
+    """Concurrent DDL vs DML (default_value.clj): create/drop the table
+    while inserting and reading; reads must never observe null column
+    values."""
+
+    def invoke(self, test, op):
+        f = op["f"]
+        try:
+            if f == "create-table":
+                self._sql(test,
+                          f"CREATE TABLE IF NOT EXISTS {DV_TABLE} "
+                          "(id BIGSERIAL PRIMARY KEY, v INT NOT NULL "
+                          "DEFAULT 0);")
+                return {**op, "type": "ok"}
+            if f == "drop-table":
+                self._sql(test, f"DROP TABLE IF EXISTS {DV_TABLE};")
+                return {**op, "type": "ok"}
+            if f == "insert":
+                self._sql(test,
+                          f"INSERT INTO {DV_TABLE} (v) VALUES (0);")
+                return {**op, "type": "ok"}
+            out = self._sql(
+                test,
+                f"SELECT id, COALESCE(v::TEXT, '{NULL_SENTINEL}') "
+                f"FROM {DV_TABLE};")
+            rows = []
+            for line in _psql_lines(out):
+                if "|" in line:
+                    i, v = line.split("|")[:2]
+                    rows.append({"id": int(i),
+                                 "v": None if v.strip() == NULL_SENTINEL
+                                 else int(v)})
+            return {**op, "type": "ok", "value": rows}
+        except c.RemoteError as e:
+            # DDL races produce transient "does not exist" errors —
+            # definite fails for every op class here.
+            return {**op, "type": "fail", "error": "sql"}
+
+
+# --- YCQL (Cassandra dialect over ycqlsh) ----------------------------------
+
+
+class _YcqlClient(jclient.Client):
+    """CQL over ycqlsh on the node (the cassaforte-driver analogue,
+    ycql/client.clj)."""
+
+    def __init__(self, node: Any = None):
+        self.node = node
+
+    def open(self, test, node):
+        return type(self)(node)
+
+    def _cql(self, test, script: str) -> str:
+        def run(t, node):
+            return c.exec_star(
+                f"{YCQLSH} 127.0.0.1 9042 <<'JEPSEN_CQL'\n"
+                f"{script}\nJEPSEN_CQL")
+
+        return c.on_nodes(test, run, [self.node])[self.node]
+
+    def setup_keyspace(self, test):
+        self._cql(test,
+                  f"CREATE KEYSPACE IF NOT EXISTS {KEYSPACE} "
+                  "WITH replication = {'class': 'SimpleStrategy'};")
+
+    @staticmethod
+    def _definite_fail(e: Exception) -> bool:
+        s = str(e).lower()
+        return ("conflict" in s or "aborted" in s or "expired" in s
+                or "condition" in s)
+
+    @staticmethod
+    def _rows(out: str) -> list[list[str]]:
+        """ycqlsh prints ` a | b ` rows plus headers/rules/"(n rows)";
+        data rows are those whose cells are all numeric (or null) —
+        single-column results have no ``|`` separator at all."""
+        rows = []
+        for line in out.strip().split("\n"):
+            stripped = line.strip()
+            if not stripped or "rows)" in stripped \
+                    or set(stripped) <= {"-", "+"}:
+                continue
+            cells = ([x.strip() for x in line.split("|")]
+                     if "|" in line else [stripped])
+            vals = [x for x in cells if x != ""]
+            if vals and all(x == "null" or x.lstrip("-").isdigit()
+                            for x in vals):
+                rows.append(cells)
+        return rows
+
+
+class CqlCounterClient(_YcqlClient):
+    """Distributed counter column (ycql/counter.clj)."""
+
+    def setup(self, test):
+        self.setup_keyspace(test)
+        self._cql(test,
+                  f"CREATE TABLE IF NOT EXISTS {KEYSPACE}.counter "
+                  "(id INT PRIMARY KEY, count COUNTER);\n"
+                  f"UPDATE {KEYSPACE}.counter SET count = count + 0 "
+                  "WHERE id = 0;")
+
+    def invoke(self, test, op):
+        try:
+            if op["f"] == "read":
+                out = self._cql(
+                    test, f"SELECT count FROM {KEYSPACE}.counter "
+                          "WHERE id = 0;")
+                rows = self._rows(out)
+                val = int(rows[0][0]) if rows else 0
+                return {**op, "type": "ok", "value": val}
+            self._cql(test,
+                      f"UPDATE {KEYSPACE}.counter SET count = count + "
+                      f"{op['value']} WHERE id = 0;")
+            return {**op, "type": "ok"}
+        except c.RemoteError as e:
+            if self._definite_fail(e) or op["f"] == "read":
+                return {**op, "type": "fail", "error": "cql"}
+            raise
+
+
+class CqlSetClient(_YcqlClient):
+    """Unique inserts + full reads (ycql/set.clj); ``use_index`` reads
+    through a secondary index the way CQLSetIndexClient does."""
+
+    def __init__(self, node: Any = None, use_index: bool = False):
+        super().__init__(node)
+        self.use_index = use_index
+
+    def open(self, test, node):
+        return type(self)(node, self.use_index)
+
+    def setup(self, test):
+        self.setup_keyspace(test)
+        stmts = [f"CREATE TABLE IF NOT EXISTS {KEYSPACE}.elements "
+                 "(val BIGINT PRIMARY KEY, present BOOLEAN) "
+                 "WITH transactions = {'enabled': true};"]
+        if self.use_index:
+            stmts.append(
+                f"CREATE INDEX IF NOT EXISTS elements_present "
+                f"ON {KEYSPACE}.elements (present);")
+        self._cql(test, "\n".join(stmts))
+
+    def invoke(self, test, op):
+        try:
+            if op["f"] == "add":
+                self._cql(test,
+                          f"INSERT INTO {KEYSPACE}.elements "
+                          f"(val, present) VALUES ({op['value']}, true);")
+                return {**op, "type": "ok"}
+            where = " WHERE present = true" if self.use_index else ""
+            out = self._cql(
+                test, f"SELECT val FROM {KEYSPACE}.elements{where};")
+            vals = sorted(int(r[0]) for r in self._rows(out))
+            return {**op, "type": "ok", "value": vals}
+        except c.RemoteError as e:
+            if self._definite_fail(e) or op["f"] == "read":
+                return {**op, "type": "fail", "error": "cql"}
+            raise
+
+
+class CqlBankClient(_YcqlClient):
+    """Transfers in one YCQL transaction block (ycql/bank.clj) —
+    negative balances allowed (workload-allow-neg, core.clj:84)."""
+
+    def setup(self, test):
+        self.setup_keyspace(test)
+        rows = "\n".join(
+            f"INSERT INTO {KEYSPACE}.bank (id, balance) "
+            f"VALUES ({a}, {b}) IF NOT EXISTS;"
+            for a, b in wbank.initial_balances(test))
+        self._cql(test,
+                  f"CREATE TABLE IF NOT EXISTS {KEYSPACE}.bank "
+                  "(id INT PRIMARY KEY, balance BIGINT) "
+                  "WITH transactions = {'enabled': true};\n" + rows)
+
+    def invoke(self, test, op):
+        try:
+            if op["f"] == "read":
+                out = self._cql(
+                    test, f"SELECT id, balance FROM {KEYSPACE}.bank;")
+                value = {int(r[0]): int(r[1]) for r in self._rows(out)}
+                return {**op, "type": "ok", "value": value}
+            v = op["value"]
+            self._cql(test, "\n".join([
+                "BEGIN TRANSACTION",
+                f"UPDATE {KEYSPACE}.bank SET balance = balance - "
+                f"{v['amount']} WHERE id = {v['from']};",
+                f"UPDATE {KEYSPACE}.bank SET balance = balance + "
+                f"{v['amount']} WHERE id = {v['to']};",
+                "END TRANSACTION;",
+            ]))
+            return {**op, "type": "ok"}
+        except c.RemoteError as e:
+            if self._definite_fail(e) or op["f"] == "read":
+                return {**op, "type": "fail", "error": "cql"}
+            raise
+
+
+class CqlLongForkClient(_YcqlClient):
+    """kv writes + IN-predicate multi-key reads
+    (ycql/long_fork.clj)."""
+
+    def setup(self, test):
+        self.setup_keyspace(test)
+        self._cql(test,
+                  f"CREATE TABLE IF NOT EXISTS {KEYSPACE}.kv "
+                  "(id INT PRIMARY KEY, val INT) "
+                  "WITH transactions = {'enabled': true};")
+
+    def invoke(self, test, op):
+        mops = op["value"]
+        try:
+            writes = [(k, v) for f, k, v in mops if f == "w"]
+            if writes:
+                stmts = [f"INSERT INTO {KEYSPACE}.kv (id, val) "
+                         f"VALUES ({k}, {v});" for k, v in writes]
+                self._cql(test, "\n".join(stmts))
+                return {**op, "type": "ok", "value": mops}
+            ks = [k for f, k, _v in mops]
+            out = self._cql(
+                test,
+                f"SELECT id, val FROM {KEYSPACE}.kv WHERE id IN "
+                f"({', '.join(str(k) for k in ks)});")
+            got = {int(r[0]): int(r[1]) for r in self._rows(out)}
+            done = [["r", k, got.get(k)] for k in ks]
+            return {**op, "type": "ok", "value": done}
+        except c.RemoteError as e:
+            if self._definite_fail(e):
+                return {**op, "type": "fail", "error": "cql"}
+            raise
+
+
+class CqlSingleKeyClient(_YcqlClient):
+    """Keyed register with LWT cas (ycql/single_key_acid.clj): UPDATE
+    … IF val = old, decided by the [applied] row."""
+
+    def setup(self, test):
+        self.setup_keyspace(test)
+        self._cql(test,
+                  f"CREATE TABLE IF NOT EXISTS {KEYSPACE}.acid "
+                  "(id INT PRIMARY KEY, val INT);")
+
+    def invoke(self, test, op):
+        k, v = op["value"]
+        try:
+            if op["f"] == "read":
+                out = self._cql(
+                    test,
+                    f"SELECT val FROM {KEYSPACE}.acid WHERE id = {k};")
+                rows = self._rows(out)
+                val = int(rows[0][0]) if rows else None
+                return {**op, "type": "ok",
+                        "value": independent.tuple_(k, val)}
+            if op["f"] == "write":
+                self._cql(test,
+                          f"INSERT INTO {KEYSPACE}.acid (id, val) "
+                          f"VALUES ({k}, {v});")
+                return {**op, "type": "ok"}
+            old, new = v
+            out = self._cql(test,
+                            f"UPDATE {KEYSPACE}.acid SET val = {new} "
+                            f"WHERE id = {k} IF val = {old};")
+            applied = "true" in out.lower()
+            return {**op, "type": "ok" if applied else "fail",
+                    **({} if applied else {"error": "precondition-failed"})}
+        except c.RemoteError as e:
+            if self._definite_fail(e) or op["f"] == "read":
+                return {**op, "type": "fail",
+                        "error": "precondition-failed"
+                        if op["f"] == "cas" else "cql"}
+            raise
+
+
+class CqlMultiKeyClient(_YcqlClient):
+    """Transactional multi-register batches over (ik, k)
+    (ycql/multi_key_acid.clj)."""
+
+    def setup(self, test):
+        self.setup_keyspace(test)
+        self._cql(test,
+                  f"CREATE TABLE IF NOT EXISTS {KEYSPACE}.multi "
+                  "(ik INT, k INT, val INT, PRIMARY KEY ((ik), k)) "
+                  "WITH transactions = {'enabled': true};")
+
+    def invoke(self, test, op):
+        ik, regs = op["value"]
+        try:
+            if op["f"] == "read":
+                ks = sorted(regs)
+                out = self._cql(
+                    test,
+                    f"SELECT k, val FROM {KEYSPACE}.multi "
+                    f"WHERE ik = {ik};")
+                got = {int(r[0]): int(r[1]) for r in self._rows(out)}
+                return {**op, "type": "ok", "value": independent.tuple_(
+                    ik, {k: got.get(k) for k in ks})}
+            stmts = ["BEGIN TRANSACTION"] + [
+                f"INSERT INTO {KEYSPACE}.multi (ik, k, val) "
+                f"VALUES ({ik}, {k}, {v});"
+                for k, v in sorted(regs.items())
+            ] + ["END TRANSACTION;"]
+            self._cql(test, "\n".join(stmts))
+            return {**op, "type": "ok"}
+        except c.RemoteError as e:
+            if self._definite_fail(e) or op["f"] == "read":
+                return {**op, "type": "fail", "error": "cql"}
+            raise
+
+
 class YugabyteDB(jdb.DB, jdb.Process, jdb.Pause, jdb.LogFiles):
     """master + tserver daemons per node (yugabyte/db.clj)."""
 
@@ -247,13 +830,184 @@ def set_workload(opts: dict) -> dict:
     }
 
 
-WORKLOADS = {"bank": bank_workload, "append": append_workload,
-             "set": set_workload}
+def _with_client(wl_fn, client_cls, **ckw):
+    """core.clj:71-75's with-client: same workload, different API
+    client."""
+
+    def fn(opts):
+        wl = wl_fn(opts)
+        return {**wl, "client": client_cls(**ckw)}
+
+    return fn
+
+
+def _bank_allow_neg(client_cls):
+    """bank/workload-allow-neg (core.clj:84,95): negative balances are
+    legal — reproducing errors is easier without the CHECK."""
+
+    def fn(opts):
+        wl = wbank.test({**opts, "negative-balances?": True})
+        return {**wl, "client": client_cls()}
+
+    return fn
+
+
+def counter_workload(client_cls):
+    """counter.clj:9-22: mostly increments, occasional reads."""
+
+    def fn(opts):
+        def add(t=None, ctx=None):
+            return {"type": "invoke", "f": "add", "value": 1}
+
+        def read(t=None, ctx=None):
+            return {"type": "invoke", "f": "read", "value": None}
+
+        return {
+            "client": client_cls(),
+            "generator": gen.delay(0.1, gen.mix([read, add, add, add])),
+            "checker": jchecker.compose({
+                "counter": jchecker.counter(),
+                "stats": jchecker.stats(),
+            }),
+        }
+
+    return fn
+
+
+def single_key_acid_workload(client_cls):
+    """single_key_acid.clj:30-46: keyed linearizable cas register."""
+
+    def fn(opts):
+        wl = wreg.test({**(opts or {}), "model": CasRegister(init=None)})
+        return {**wl, "client": client_cls(),
+                "generator": gen.stagger(0.01, wl["generator"])}
+
+    return fn
+
+
+def _rand_nonempty_subset(pool):
+    out = [k for k in pool if gen.rand_int(2)]
+    return out or [pool[gen.rand_int(len(pool))]]
+
+
+def multi_key_acid_workload(client_cls):
+    """multi_key_acid.clj:40-72: keyed transactional multi-register
+    batches, checked against the multi-register model."""
+
+    KEY_RANGE = (0, 1, 2)
+
+    def fn(opts):
+        import itertools
+
+        def read(t=None, ctx=None):
+            ks = _rand_nonempty_subset(KEY_RANGE)
+            return {"type": "invoke", "f": "read",
+                    "value": {k: None for k in ks}}
+
+        def write(t=None, ctx=None):
+            ks = _rand_nonempty_subset(KEY_RANGE)
+            return {"type": "invoke", "f": "write",
+                    "value": {k: gen.rand_int(5) for k in ks}}
+
+        def fgen(k):
+            return gen.process_limit(
+                20, gen.stagger(0.05, gen.reserve(2, read, write)))
+
+        return {
+            "client": client_cls(),
+            "generator": independent.concurrent_generator(
+                4, itertools.count(), fgen),
+            "checker": independent.checker(jchecker.compose({
+                "linear": jchecker.linearizable(
+                    model=MultiRegister(init={k: None for k in KEY_RANGE})),
+                "stats": jchecker.stats(),
+            })),
+        }
+
+    return fn
+
+
+def long_fork_workload(client_cls):
+    def fn(opts):
+        wl = wlf.workload(3)
+        return {**wl, "client": client_cls()}
+
+    return fn
+
+
+def dv_checker() -> jchecker.Checker:
+    """No read may observe a row with a null column value
+    (default_value.clj:28-61)."""
+
+    def chk(test, history, opts):
+        bad = []
+        reads = 0
+        for op in history:
+            if not (op.is_ok and op.f == "read"):
+                continue
+            reads += 1
+            rows = [r for r in (op.value or [])
+                    if any(v is None for v in r.values())]
+            if rows:
+                bad.append({"op": repr(op), "bad-rows": rows})
+        return {"valid": not bad, "read-count": reads,
+                "bad-read-count": len(bad), "bad-reads": bad}
+
+    return checker_fn(chk, "default-value")
+
+
+def default_value_workload(opts):
+    """default_value.clj:13-26: concurrent DDL (create/drop table) vs
+    inserts and reads."""
+
+    def mk(f):
+        return lambda t=None, ctx=None: {
+            "type": "invoke", "f": f, "value": None}
+
+    return {
+        "client": DefaultValueClient(),
+        "generator": gen.stagger(0.01, gen.mix(
+            [mk("create-table"), mk("drop-table")]
+            + [mk("read"), mk("insert")] * 5)),
+        "checker": jchecker.compose({
+            "default-value": dv_checker(),
+            "stats": jchecker.stats(),
+        }),
+    }
+
+
+WORKLOADS = {
+    # ycql (core.clj:76-88)
+    "ycql/counter": counter_workload(CqlCounterClient),
+    "ycql/set": _with_client(set_workload, CqlSetClient),
+    "ycql/set-index": _with_client(set_workload, CqlSetClient,
+                                   use_index=True),
+    "ycql/bank": _bank_allow_neg(CqlBankClient),
+    "ycql/long-fork": long_fork_workload(CqlLongForkClient),
+    "ycql/single-key-acid": single_key_acid_workload(CqlSingleKeyClient),
+    "ycql/multi-key-acid": multi_key_acid_workload(CqlMultiKeyClient),
+    # ysql (core.clj:89-103)
+    "ysql/counter": counter_workload(YsqlCounterClient),
+    "ysql/set": set_workload,
+    "ysql/bank": bank_workload,
+    "ysql/bank-multitable": _bank_allow_neg(BankMultitableClient),
+    "ysql/long-fork": long_fork_workload(YsqlKvTxnClient),
+    "ysql/single-key-acid": single_key_acid_workload(YsqlSingleKeyClient),
+    "ysql/multi-key-acid": multi_key_acid_workload(YsqlMultiKeyClient),
+    "ysql/append": append_workload,
+    "ysql/append-table": _with_client(append_workload, AppendTableClient),
+    "ysql/default-value": default_value_workload,
+}
+
+# Bare names keep working (they pick the ysql variant).
+ALIASES = {"bank": "ysql/bank", "append": "ysql/append",
+           "set": "ysql/set"}
 
 
 def test_fn(opts: dict) -> dict:
     """One cell of the workload × fault matrix (core.clj:73-161)."""
-    name = opts.get("workload") or "append"
+    name = opts.get("workload") or "ysql/append"
+    name = ALIASES.get(name, name)
     wl = WORKLOADS[name](opts)
     db = YugabyteDB()
     raw_faults = opts.get("faults")
@@ -261,7 +1015,9 @@ def test_fn(opts: dict) -> dict:
         raw_faults = "partition,kill"
     faults = [f for f in raw_faults.split(",") if f]
     test = {
-        "name": f"yugabyte-{name}-{'+'.join(faults) or 'none'}",
+        # "/" nests store directories; names use the dashed form.
+        "name": f"yugabyte-{name.replace('/', '-')}-"
+                f"{'+'.join(faults) or 'none'}",
         "db": db,
         "net": jnet.iptables(),
     }
@@ -298,7 +1054,8 @@ def matrix_test_fns(opts_base: dict | None = None) -> dict:
     fns = {}
     for wname in WORKLOADS:
         for faults in fault_sets:
-            label = f"{wname}-{faults.replace(',', '+') or 'none'}"
+            label = (f"{wname.replace('/', '-')}-"
+                     f"{faults.replace(',', '+') or 'none'}")
 
             def fn(opts, _w=wname, _f=faults):
                 return test_fn({**opts, "workload": _w, "faults": _f})
@@ -308,8 +1065,9 @@ def matrix_test_fns(opts_base: dict | None = None) -> dict:
 
 
 def _add_opts(p):
-    p.add_argument("--workload", choices=sorted(WORKLOADS),
-                   default="append")
+    p.add_argument("--workload",
+                   choices=sorted(WORKLOADS) + sorted(ALIASES),
+                   default="ysql/append")
     p.add_argument("--faults", default="partition,kill")
     p.add_argument("--nemesis-interval", type=int, default=10)
     p.add_argument("--ops", type=int, default=200)

@@ -355,6 +355,64 @@ class TestAdditionalGraphs:
         assert results[False] == results[True] == {"G-single-realtime"}
 
 
+class TestMonotonicKeyCheck:
+    """elle.core's monotonic-key analyzer + realtime composition
+    (consumed by the tidb monotonic workload)."""
+
+    @staticmethod
+    def _hist(rows):
+        from jepsen_tpu.history import History, Op
+
+        return History([
+            Op(typ, proc, "read", value, time=i * 1_000_000)
+            for i, (typ, proc, value) in enumerate(rows)
+        ])
+
+    def test_monotonic_clean(self):
+        from jepsen_tpu.elle import monotonic_key_check
+
+        h = self._hist([
+            ("invoke", 0, None), ("ok", 0, {"x": 1}),
+            ("invoke", 1, None), ("ok", 1, {"x": 2, "y": 1}),
+            ("invoke", 0, None), ("ok", 0, {"x": 2, "y": 1}),
+        ])
+        assert monotonic_key_check(h)["valid"] is True
+
+    def test_monotonic_regression_caught_via_realtime(self):
+        from jepsen_tpu.elle import monotonic_key_check
+
+        # x observed at 2, then STRICTLY LATER at 1: the value-order
+        # edge (1 -> 2) and the realtime edge (2-reader -> 1-reader)
+        # close a cycle.
+        h = self._hist([
+            ("invoke", 0, None), ("ok", 0, {"x": 2}),
+            ("invoke", 1, None), ("ok", 1, {"x": 1}),
+        ])
+        res = monotonic_key_check(h)
+        assert res["valid"] is False
+        assert res["cycles"] and "ops" in res["cycles"][0]
+
+    def test_concurrent_disagreement_legal(self):
+        from jepsen_tpu.elle import monotonic_key_check
+
+        # The two reads overlap — either serialization order is fine.
+        h = self._hist([
+            ("invoke", 0, None), ("invoke", 1, None),
+            ("ok", 0, {"x": 2}), ("ok", 1, {"x": 1}),
+        ])
+        assert monotonic_key_check(h)["valid"] is True
+
+    def test_bare_history_flagged_unavailable(self):
+        from jepsen_tpu.elle import monotonic_key_check
+
+        res = monotonic_key_check([
+            {"type": "ok", "process": 0, "f": "read", "value": {"x": 2}},
+            {"type": "ok", "process": 1, "f": "read", "value": {"x": 1}},
+        ])
+        assert res["valid"] is True
+        assert res["realtime_unavailable"] is True
+
+
 class TestGeneratedHistories:
     def test_serializable_simulation_clean(self):
         """Apply random append txns against an in-memory serial store —

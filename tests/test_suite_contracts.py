@@ -23,16 +23,43 @@ SUITES = [
 ]
 
 
+def _contract_opts(**extra):
+    # Window must fit several staggered ops (suites schedule at
+    # ~10 Hz); too tight and a slow-start run finishes zero client ops.
+    return {"time_limit": 1.5, "ops": 8, "jobs": 2,
+            "stagger": 0.01, "nemesis_interval": 0.1,
+            "keys": 2, "count": 1,
+            # keyed workloads must fit the harness concurrency
+            "threads-per-key": 2, "ops-per-key": 4, **extra}
+
+
 @pytest.mark.parametrize("name", SUITES)
 def test_suite_test_fn_contract(name):
     mod = importlib.import_module(f"jepsen_tpu.suites.{name}")
-    # Window must fit several staggered ops (suites schedule at
-    # ~10 Hz); too tight and a slow-start run finishes zero client ops.
-    t = mod.test_fn({"time_limit": 1.5, "ops": 8, "jobs": 2,
-                     "stagger": 0.01, "nemesis_interval": 0.1,
-                     "keys": 2, "count": 1,
-                     # keyed workloads must fit the harness concurrency
-                     "threads-per-key": 2, "ops-per-key": 4})
+    t = mod.test_fn(_contract_opts())
+    _assert_contract(name, t)
+
+
+def _workload_cases():
+    """Every (suite, workload) pair of the suites exposing a WORKLOADS
+    map — the reference's big suites are big because of workload
+    breadth, so each entry must satisfy the interpreter contract."""
+    cases = []
+    for name in ("cockroachdb", "dgraph", "tidb", "yugabyte", "faunadb"):
+        mod = importlib.import_module(f"jepsen_tpu.suites.{name}")
+        for wl in sorted(getattr(mod, "WORKLOADS", {})):
+            cases.append((name, wl))
+    return cases
+
+
+@pytest.mark.parametrize("name,workload", _workload_cases())
+def test_workload_contract(name, workload):
+    mod = importlib.import_module(f"jepsen_tpu.suites.{name}")
+    t = mod.test_fn(_contract_opts(workload=workload))
+    _assert_contract(f"{name}:{workload}", t)
+
+
+def _assert_contract(name, t):
     # Map shape every runner relies on.
     assert t.get("name"), name
     assert "generator" in t and t["generator"] is not None, name

@@ -5,6 +5,7 @@ command generation is asserted against the dummy remote."""
 
 import base64
 import json
+import re
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
@@ -364,6 +365,181 @@ class TestCockroachSuite:
         assert any("CREATE TABLE IF NOT EXISTS jepsen_bank" in cmd
                    for cmd in cmds)
         assert any("balance - 3" in cmd and "COMMIT" in cmd for cmd in cmds)
+
+    def _client(self, cls, responses, **kw):
+        from jepsen_tpu.suites import cockroachdb as crdb
+
+        test = dict(noop_test())
+        test.update(nodes=["n1"])
+        log: list = []
+        c.setup_sessions(test, c.dummy(log, responses=responses))
+        client = cls(**kw).open(test, "n1")
+        client.setup(test)
+        return crdb, test, client, log
+
+    def test_register_sql(self):
+        from jepsen_tpu.suites import cockroachdb as crdb
+
+        crdb_, test, client, log = self._client(
+            crdb.RegisterClient,
+            {r"SELECT val FROM jepsen_register": "val\n3\n",
+             r"UPDATE jepsen_register SET val = 4 "
+             r"WHERE id = 0 AND val = 3": "id\n0\n"})
+        res = client.invoke(test, {"type": "invoke", "f": "read",
+                                   "value": (0, None), "process": 0})
+        assert res["type"] == "ok" and tuple(res["value"]) == (0, 3)
+        res = client.invoke(test, {"type": "invoke", "f": "cas",
+                                   "value": (0, [3, 4]), "process": 0})
+        assert res["type"] == "ok"
+        # A cas whose predicate misses returns no row: definite fail.
+        res = client.invoke(test, {"type": "invoke", "f": "cas",
+                                   "value": (0, [1, 2]), "process": 0})
+        assert res["type"] == "fail"
+        client.invoke(test, {"type": "invoke", "f": "write",
+                             "value": (0, 2), "process": 0})
+        cmds = [cmd for _n, cmd in log]
+        assert any("UPSERT INTO jepsen_register VALUES (0, 2)" in cmd
+                   for cmd in cmds)
+
+    def test_sets_sql(self):
+        from jepsen_tpu.suites import cockroachdb as crdb
+
+        _, test, client, log = self._client(
+            crdb.SetsClient,
+            {r"SELECT val FROM jepsen_set": "val\n1\n2\n5\n"})
+        res = client.invoke(test, {"type": "invoke", "f": "add",
+                                   "value": 7, "process": 0})
+        assert res["type"] == "ok"
+        res = client.invoke(test, {"type": "invoke", "f": "read",
+                                   "value": None, "process": 0})
+        assert res["type"] == "ok" and res["value"] == [1, 2, 5]
+
+    def test_monotonic_sql(self):
+        from jepsen_tpu.suites import cockroachdb as crdb
+
+        _, test, client, log = self._client(
+            crdb.MonotonicClient,
+            {r"INSERT INTO jepsen_mono_k0i\d": "val\tsts\n7\t100.5\n",
+             r"SELECT val, sts, node, process, tb":
+             "val\tsts\tnode\tprocess\ttb\n"
+             "2\t90.1\t0\t1\t0\n1\t80.2\t0\t1\t1\n"},
+            keys=(0,))
+        res = client.invoke(test, {"type": "invoke", "f": "add",
+                                   "value": (0, None), "process": 3})
+        assert res["type"] == "ok"
+        k, row = res["value"]
+        assert (k, row["val"], row["sts"]) == (0, 7, "100.5")
+        res = client.invoke(test, {"type": "invoke", "f": "read",
+                                   "value": (0, None), "process": 3})
+        k, rows = res["value"]
+        # Rows come back sorted by the decimal cluster timestamp.
+        assert [r["val"] for r in rows] == [1, 2]
+        cmds = [cmd for _n, cmd in log]
+        assert any("GREATEST" in cmd and "cluster_logical_timestamp" in cmd
+                   for cmd in cmds)
+
+    def test_monotonic_checker(self):
+        from jepsen_tpu.history import History, Op
+        from jepsen_tpu.suites.cockroachdb import check_monotonic
+
+        def row(val, sts, proc=0):
+            return {"val": val, "sts": sts, "node": 0,
+                    "process": proc, "tb": 0}
+
+        def hist(rows, adds=()):
+            ops = []
+            for v in adds:
+                ops.append(Op.from_dict(
+                    {"type": "invoke", "process": 0, "f": "add",
+                     "value": None, "time": 0}))
+                ops.append(Op.from_dict(
+                    {"type": "ok", "process": 0, "f": "add",
+                     "value": row(*v), "time": 0}))
+            ops.append(Op.from_dict(
+                {"type": "ok", "process": 1, "f": "read",
+                 "value": rows, "time": 0}))
+            return History(ops, reindex=True)
+
+        ok_h = hist([row(1, "10.0"), row(2, "11.0")],
+                    adds=[(1, "10.0"), (2, "11.0")])
+        assert check_monotonic().check({}, ok_h, {})["valid"] is True
+        # A definitely-added value missing from the final read is lost.
+        lost = check_monotonic().check(
+            {}, hist([row(1, "10.0")], adds=[(1, "10.0"), (2, "11.0")]), {})
+        assert lost["valid"] is False and lost["lost"] == [2]
+        # Values out of global order.
+        reorder = check_monotonic().check(
+            {}, hist([row(2, "10.0"), row(1, "11.0")]), {})
+        assert reorder["valid"] is False and reorder["value-reorders"]
+        # No final read: indeterminate.
+        no_read = History([Op.from_dict(
+            {"type": "ok", "process": 0, "f": "add",
+             "value": row(1, "10.0"), "time": 0})], reindex=True)
+        assert check_monotonic().check({}, no_read, {})["valid"] == "unknown"
+
+    def test_sequential_checker(self):
+        from jepsen_tpu.history import History, Op
+        from jepsen_tpu.suites.cockroachdb import sequential_checker
+
+        def read(k, seen):
+            return Op.from_dict({"type": "ok", "process": 0, "f": "read",
+                                 "value": [k, seen], "time": 0})
+
+        # Reads are [newest…oldest]: all, none, and a legal prefix-miss.
+        ok_h = History([read(0, ["0_1", "0_0"]), read(1, [None, None]),
+                        read(2, [None, "2_0"])], reindex=True)
+        res = sequential_checker().check({}, ok_h, {})
+        assert res["valid"] is True
+        assert (res["all-count"], res["none-count"],
+                res["some-count"]) == (1, 1, 1)
+        # A later subkey visible without an earlier one: violation.
+        bad_h = History([read(3, ["3_1", None])], reindex=True)
+        res = sequential_checker().check({}, bad_h, {})
+        assert res["valid"] is False and res["bad"][0]["key"] == 3
+
+    def test_comments_checker(self):
+        from jepsen_tpu.history import History, Op
+        from jepsen_tpu.suites.cockroachdb import comments_checker
+
+        def op(typ, f, v, p=0):
+            return Op.from_dict({"type": typ, "process": p, "f": f,
+                                 "value": v, "time": 0})
+
+        # Write 0 completes before write 1 invokes; a read seeing 1
+        # without 0 breaks strict serializability.
+        h = History([
+            op("invoke", "write", 0), op("ok", "write", 0),
+            op("invoke", "write", 1, p=1), op("ok", "write", 1, p=1),
+            op("invoke", "read", None, p=2), op("ok", "read", [1], p=2),
+        ], reindex=True)
+        res = comments_checker().check({}, h, {})
+        assert res["valid"] is False
+        assert res["errors"][0]["missing"] == [0]
+        # Seeing both (or neither) is fine.
+        h_ok = History([
+            op("invoke", "write", 0), op("ok", "write", 0),
+            op("invoke", "write", 1, p=1), op("ok", "write", 1, p=1),
+            op("invoke", "read", None, p=2), op("ok", "read", [0, 1], p=2),
+            op("invoke", "read", None, p=2), op("ok", "read", [], p=2),
+        ], reindex=True)
+        assert comments_checker().check({}, h_ok, {})["valid"] is True
+
+    def test_g2_sql(self):
+        from jepsen_tpu.suites import cockroachdb as crdb
+
+        _, test, client, log = self._client(
+            crdb.G2Client,
+            {r"INSERT INTO jepsen_g2_a .*SELECT 5": "id\n5\n"})
+        res = client.invoke(test, {"type": "invoke", "f": "insert",
+                                   "value": (0, [5, None]), "process": 0})
+        assert res["type"] == "ok"
+        # The other txn already committed: no row returned, too-late.
+        res = client.invoke(test, {"type": "invoke", "f": "insert",
+                                   "value": (0, [None, 6]), "process": 0})
+        assert res["type"] == "fail" and res["error"] == "too-late"
+        cmds = [cmd for _n, cmd in log]
+        assert any("NOT EXISTS" in cmd and "value % 3 = 0" in cmd
+                   for cmd in cmds)
 
 
 class EsStub(BaseHTTPRequestHandler):
@@ -1030,6 +1206,168 @@ class DgraphStub(BaseHTTPRequestHandler):
         self.end_headers()
 
 
+class DgraphKvStub(BaseHTTPRequestHandler):
+    """Alpha upsert-block stub: a linearizable (one big lock) record
+    store understanding the exact query/mutation grammar the suite's
+    clients emit — eq(pred, X) blocks, ge/eq filters, uid/field/math
+    var bindings, @if(eq(len(u), n)) conditions, set/delete mutations.
+    Query results snapshot BEFORE mutations apply (dgraph upsert
+    semantics)."""
+
+    records: dict = {}  # uid -> {field: value}
+    next_uid = [1]
+    lock = threading.Lock()
+
+    BLOCK = re.compile(
+        r'(\w+)(?P<var> as var)?\(func: eq\((\w+), ("[^"]*"|[-\d]+)\)\)'
+        r'(?: @filter\((\w+)\((\w+), ([-\d]+)\)\))?'
+        r'(?:\s*\{(?P<body>[^}]*)\})?')
+    MATH = re.compile(r'(\w+) as math\((\w+) ([+-]) ([-\d]+)\)')
+    BIND = re.compile(r'(\w+) as (uid|value|amount|key)\b')
+    COND = re.compile(r'eq\(len\((\w+)\), (\d+)\)')
+
+    def log_message(self, *a):
+        pass
+
+    def _reply(self, obj):
+        body = json.dumps(obj).encode()
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    @classmethod
+    def _parse_query(cls, q):
+        """-> {name: {"uids": [...], "rows": [...], "vals": {var: {uid: n}}}}
+        plus var-name -> block-name map."""
+        blocks, var_of = {}, {}
+        for m in cls.BLOCK.finditer(q or ""):
+            name, pred, lit = m.group(1), m.group(3), m.group(4)
+            want = json.loads(lit) if lit.startswith('"') else int(lit)
+            fop, ffield, flit = m.group(5), m.group(6), m.group(7)
+            uids = []
+            for uid, rec in sorted(cls.records.items()):
+                if rec.get(pred) != want:
+                    continue
+                if fop:
+                    got = rec.get(ffield)
+                    if got is None:
+                        continue
+                    fv = int(flit)
+                    if fop == "eq" and got != fv:
+                        continue
+                    if fop == "ge" and not got >= fv:
+                        continue
+                uids.append(uid)
+            body = m.group("body") or ""
+            vals: dict = {}
+            for bm in cls.BIND.finditer(body):
+                var, field = bm.group(1), bm.group(2)
+                var_of[var] = name
+                vals[var] = {
+                    u: (u if field == "uid" else cls.records[u].get(field))
+                    for u in uids}
+            for mm in cls.MATH.finditer(body):
+                var, src, sign, n = mm.groups()
+                var_of[var] = name
+                base = vals.get(src, {})
+                delta = int(n) if sign == "+" else -int(n)
+                vals[var] = {u: (v or 0) + delta for u, v in base.items()}
+            if m.group("var") is None:
+                var_of[name] = name
+            rows = []
+            if m.group("var") is None:
+                # Row fields: plain field tokens plus bound sources —
+                # DQL's `v as value` also exposes value in the output.
+                fields = set(
+                    t for t in re.sub(
+                        cls.MATH, "", re.sub(cls.BIND, "", body)).split()
+                    if t in ("uid", "value", "key", "amount"))
+                fields |= {bm.group(2) for bm in cls.BIND.finditer(body)}
+                for u in uids:
+                    row = {f: (u if f == "uid" else cls.records[u].get(f))
+                           for f in fields
+                           if f == "uid"
+                           or cls.records[u].get(f) is not None}
+                    rows.append(row)
+            blocks[name] = {"uids": uids, "rows": rows, "vals": vals}
+        return blocks, var_of
+
+    @classmethod
+    def _resolve(cls, blocks, var_of, var):
+        b = blocks.get(var_of.get(var) or var)
+        return b["uids"] if b else []
+
+    def do_POST(self):
+        raw = self.rfile.read(int(self.headers.get("Content-Length") or 0))
+        if self.path.startswith("/alter"):
+            self._reply({"data": {"code": "Success"}})
+            return
+        cls = type(self)
+        with cls.lock:
+            if self.path.startswith("/query"):
+                blocks, _ = cls._parse_query(raw.decode())
+                self._reply({"data": {n: b["rows"]
+                                      for n, b in blocks.items()}})
+                return
+            if self.path.startswith("/mutate"):
+                req = json.loads(raw)
+                muts = req.get("mutations")
+                if muts is None:
+                    muts = [{k: v for k, v in req.items()
+                             if k in ("set", "delete", "cond")}]
+                blocks, var_of = cls._parse_query(req.get("query"))
+                queries = {n: b["rows"]
+                           for n, b in blocks.items() if b["rows"]}
+                uids_out = {}
+                for mi, mut in enumerate(muts):
+                    cond = mut.get("cond")
+                    if cond:
+                        ok = all(
+                            len(cls._resolve(blocks, var_of, var)) == int(n)
+                            for var, n in cls.COND.findall(cond))
+                        if not ok:
+                            continue
+                    for obj in mut.get("set") or []:
+                        ref = obj.get("uid")
+                        if isinstance(ref, str) and ref.startswith("uid("):
+                            var = ref[4:-1]
+                            for u in cls._resolve(blocks, var_of, var):
+                                for f, v in obj.items():
+                                    if f == "uid":
+                                        continue
+                                    cls.records[u][f] = cls._val(
+                                        blocks, var_of, v, u)
+                        else:
+                            uid = f"0x{cls.next_uid[0]:x}"
+                            cls.next_uid[0] += 1
+                            cls.records[uid] = {
+                                f: v for f, v in obj.items() if f != "uid"}
+                            uids_out[f"blank-{mi}"] = uid
+                    for obj in mut.get("delete") or []:
+                        ref = obj.get("uid")
+                        if isinstance(ref, str) and ref.startswith("uid("):
+                            for u in cls._resolve(blocks, var_of,
+                                                  ref[4:-1]):
+                                cls.records.pop(u, None)
+                # Real alpha shape: query-block results nest under
+                # data["queries"]; only "uids" sits at data's top level.
+                self._reply({"data": {"code": "Success",
+                                      "queries": queries,
+                                      "uids": uids_out}})
+                return
+        self.send_response(404)
+        self.end_headers()
+
+    @classmethod
+    def _val(cls, blocks, var_of, v, uid):
+        if isinstance(v, str) and v.startswith("val("):
+            var = v[4:-1]
+            b = blocks.get(var_of.get(var))
+            return (b["vals"].get(var) or {}).get(uid)
+        return v
+
+
 class TestDgraphSuite:
     def test_upsert_against_stub(self, http_stub, tmp_path):
         from jepsen_tpu.suites import dgraph as dg
@@ -1067,6 +1405,106 @@ class TestDgraphSuite:
         )
         res = core.run(test)
         assert res["results"]["valid"] is True, res["results"]
+
+    def _run_kv(self, http_stub, tmp_path, workload, opts=None,
+                concurrency=4, time_limit=None):
+        from jepsen_tpu.suites import dgraph as dg
+
+        DgraphKvStub.records = {}
+        DgraphKvStub.next_uid = [1]
+        http_stub(DgraphKvStub, dg, "PORT")
+        test = dict(noop_test())
+        wl = dg.WORKLOADS[workload](opts or {})
+        g = wl["generator"]
+        if time_limit:
+            g = gen.time_limit(time_limit, g)
+        phases = [g]
+        if wl.get("final-generator") is not None:
+            phases.append(wl["final-generator"])
+        test.update(
+            name=f"dgraph-{workload}-stub", nodes=["127.0.0.1"],
+            concurrency=concurrency, **{"store-root": str(tmp_path)},
+            client=wl["client"], checker=wl["checker"],
+            generator=gen.phases(*phases),
+            **{k: v for k, v in wl.items()
+               if k not in ("client", "checker", "generator",
+                            "final-generator")},
+        )
+        return core.run(test)
+
+    def test_bank_against_stub(self, http_stub, tmp_path):
+        res = self._run_kv(http_stub, tmp_path, "bank", time_limit=2)
+        # The bank workload's checker IS the composed result here.
+        assert res["results"]["valid"] is True, res["results"]
+        reads = [op for op in res["history"]
+                 if op.f == "read" and op.is_ok]
+        assert reads and all(
+            sum(r.value.values()) == 100 for r in reads), "conservation"
+
+    def test_delete_against_stub(self, http_stub, tmp_path):
+        res = self._run_kv(http_stub, tmp_path, "delete",
+                           {"ops-per-key": 12}, time_limit=3)
+        assert res["results"]["valid"] is not False, res["results"]
+        # Deletes and upserts both actually landed.
+        fs = {(op.f, op.type) for op in res["history"] if op.is_ok}
+        assert ("upsert", "ok") in fs and ("read", "ok") in fs
+
+    def test_long_fork_against_stub(self, http_stub, tmp_path):
+        res = self._run_kv(http_stub, tmp_path, "long-fork", time_limit=3)
+        assert res["results"]["valid"] is not False, res["results"]
+
+    def test_wr_against_stub(self, http_stub, tmp_path):
+        res = self._run_kv(http_stub, tmp_path, "wr", {"ops": 40})
+        assert res["results"]["valid"] is not False, res["results"]
+        assert res["results"]["wr"]["valid"] is True, res["results"]
+        # Intra-txn read-your-writes: no internal anomalies possible.
+        assert "internal" not in res["results"]["wr"]["anomaly_types"]
+
+    def test_register_against_stub(self, http_stub, tmp_path):
+        res = self._run_kv(http_stub, tmp_path, "linearizable-register",
+                           {"per-key-limit": 8, "process-limit": 8},
+                           time_limit=3)
+        assert res["results"]["valid"] is not False, res["results"]
+        cas = [op for op in res["history"]
+               if op.f == "cas" and op.type in ("ok", "fail")]
+        assert cas, "no cas decisions"
+
+    def test_sequential_against_stub(self, http_stub, tmp_path):
+        res = self._run_kv(http_stub, tmp_path, "sequential",
+                           {"keys": 2}, time_limit=2)
+        assert res["results"]["valid"] is not False, res["results"]
+        incs = [op for op in res["history"] if op.f == "inc" and op.is_ok]
+        assert incs, "no increments"
+
+    def test_sequential_checker_catches_regression(self):
+        from jepsen_tpu.history import History, Op
+        from jepsen_tpu.suites.dgraph import sequential_reg_checker
+
+        def o(f, v, typ="ok", p=0):
+            return Op.from_dict({"type": typ, "process": p, "f": f,
+                                 "value": v, "time": 0})
+
+        bad = History([o("read", 3), o("read", 2)], reindex=True)
+        res = sequential_reg_checker().check({}, bad, {})
+        assert res["valid"] is False and res["non-monotonic"]
+        ok = History([o("read", 2), o("inc", 3), o("read", 3)],
+                     reindex=True)
+        assert sequential_reg_checker().check({}, ok, {})["valid"] is True
+
+    def test_delete_checker(self):
+        from jepsen_tpu.history import History, Op
+        from jepsen_tpu.suites.dgraph import delete_checker
+
+        def read(rows, p=0):
+            return Op.from_dict({"type": "ok", "process": p, "f": "read",
+                                 "value": rows, "time": 0})
+
+        ok = History([read([]), read([{"uid": "0x1", "key": 5}])],
+                     reindex=True)
+        assert delete_checker().check({}, ok, {})["valid"] is True
+        dup = History([read([{"uid": "0x1", "key": 5},
+                             {"uid": "0x2", "key": 5}])], reindex=True)
+        assert delete_checker().check({}, dup, {})["valid"] is False
 
     def test_traced_client(self, http_stub, tmp_path):
         from jepsen_tpu import trace as jtrace
@@ -1159,6 +1597,61 @@ class TestTidbSuite:
         assert any("JSON_ARRAY_APPEND" in cmd and
                    "BEGIN PESSIMISTIC" in cmd for cmd in cmds)
 
+    def _client(self, cls, responses):
+        from jepsen_tpu.suites import tidb as td
+
+        test = dict(noop_test())
+        test.update(nodes=["n1"])
+        log: list = []
+        c.setup_sessions(test, c.dummy(log, responses=responses))
+        client = getattr(td, cls)().open(test, "n1")
+        client.setup(test)
+        return test, client, log
+
+    def test_register_sql(self):
+        test, client, log = self._client("RegisterClient", {
+            r"SELECT COALESCE.*jepsen\.test": "JEPSEN_NULL\n",
+            r"SELECT ROW_COUNT": "0\n",
+        })
+        res = client.invoke(test, {"type": "invoke", "f": "read",
+                                   "value": (0, None), "process": 0})
+        assert res["type"] == "ok" and tuple(res["value"]) == (0, None)
+        res = client.invoke(test, {"type": "invoke", "f": "cas",
+                                   "value": (0, [1, 2]), "process": 0})
+        assert res["type"] == "fail"
+        client.invoke(test, {"type": "invoke", "f": "write",
+                             "value": (0, 4), "process": 0})
+        cmds = [cmd for _n, cmd in log]
+        assert any("ON DUPLICATE KEY" in cmd and "VALUES (0, 0, 4)" in cmd
+                   for cmd in cmds)
+
+    def test_kv_txn_client(self):
+        test, client, log = self._client("KvTxnClient", {
+            r"SELECT COALESCE": "JEPSEN_NULL\n7\n",
+        })
+        res = client.invoke(test, {
+            "type": "invoke", "f": "txn", "process": 0,
+            "value": [["r", 1, None], ["w", 2, 9], ["r", 3, None]]})
+        assert res["type"] == "ok"
+        assert res["value"] == [["r", 1, None], ["w", 2, 9], ["r", 3, 7]]
+        cmds = [cmd for _n, cmd in log]
+        assert any("BEGIN PESSIMISTIC" in cmd and
+                   "ON DUPLICATE KEY UPDATE val = 9" in cmd
+                   for cmd in cmds)
+
+    def test_increment_client(self):
+        test, client, log = self._client("IncrementClient", {
+            r"INSERT INTO jepsen\.cycle": "3\n",
+            r"SELECT COALESCE": "-1\n5\n",
+        })
+        res = client.invoke(test, {"type": "invoke", "f": "inc",
+                                   "value": 4, "process": 0})
+        assert res["type"] == "ok" and res["value"] == {4: 3}
+        res = client.invoke(test, {"type": "invoke", "f": "read",
+                                   "value": {0: None, 1: None},
+                                   "process": 0})
+        assert res["type"] == "ok" and res["value"] == {0: -1, 1: 5}
+
 
 class TestYugabyteSuite:
     def test_bank_against_fake(self, tmp_path):
@@ -1180,19 +1673,136 @@ class TestYugabyteSuite:
         res = core.run(test)
         assert res["results"]["valid"] is True, res["results"]
 
+    def _client(self, cls_name, responses, **kw):
+        from jepsen_tpu.suites import yugabyte as yb
+
+        test = dict(noop_test())
+        test.update(nodes=["n1"], accounts=[0, 1], **{"total-amount": 20},
+                    **{"max-transfer": 5})
+        log: list = []
+        c.setup_sessions(test, c.dummy(log, responses=responses))
+        client = getattr(yb, cls_name)(**kw).open(test, "n1")
+        client.setup(test)
+        return test, client, log
+
+    def test_ysql_counter(self):
+        test, client, log = self._client("YsqlCounterClient", {
+            r"SELECT count FROM jepsen_counter": "7\n"})
+        res = client.invoke(test, {"type": "invoke", "f": "add",
+                                   "value": 1, "process": 0})
+        assert res["type"] == "ok"
+        res = client.invoke(test, {"type": "invoke", "f": "read",
+                                   "value": None, "process": 0})
+        assert res["type"] == "ok" and res["value"] == 7
+        cmds = [cmd for _n, cmd in log]
+        assert any("count = count + 1" in cmd for cmd in cmds)
+
+    def test_ysql_single_key_acid_cas(self):
+        test, client, log = self._client("YsqlSingleKeyClient", {
+            r"WHERE id = 0 AND val = 3 RETURNING id": "0\n"})
+        res = client.invoke(test, {"type": "invoke", "f": "cas",
+                                   "value": (0, [3, 4]), "process": 0})
+        assert res["type"] == "ok"
+        res = client.invoke(test, {"type": "invoke", "f": "cas",
+                                   "value": (0, [1, 2]), "process": 0})
+        assert res["type"] == "fail"
+
+    def test_ycql_single_column_rows(self):
+        # Regression: single-column ycqlsh output has no "|" separator;
+        # counter/set/register reads must still parse their rows.
+        test, client, log = self._client("CqlCounterClient", {
+            r"SELECT count": " count\n-------\n     7\n\n(1 rows)\n"})
+        res = client.invoke(test, {"type": "invoke", "f": "read",
+                                   "value": None, "process": 0})
+        assert res["type"] == "ok" and res["value"] == 7
+
+    def test_ycql_single_key_lwt(self):
+        test, client, log = self._client("CqlSingleKeyClient", {
+            r"IF val = 3": " [applied]\n-----------\n      True\n",
+            r"IF val = 9": " [applied]\n-----------\n     False\n"})
+        res = client.invoke(test, {"type": "invoke", "f": "cas",
+                                   "value": (0, [3, 4]), "process": 0})
+        assert res["type"] == "ok"
+        res = client.invoke(test, {"type": "invoke", "f": "cas",
+                                   "value": (0, [9, 4]), "process": 0})
+        assert res["type"] == "fail"
+        cmds = [cmd for _n, cmd in log]
+        assert any("IF val = 3" in cmd for cmd in cmds)
+
+    def test_ycql_bank_txn_block(self):
+        test, client, log = self._client("CqlBankClient", {
+            r"SELECT id, balance":
+            " id | balance\n----+---------\n  0 |      10\n  1 |      10\n"})
+        res = client.invoke(test, {"type": "invoke", "f": "read",
+                                   "value": None, "process": 0})
+        assert res["type"] == "ok" and res["value"] == {0: 10, 1: 10}
+        client.invoke(test, {"type": "invoke", "f": "transfer",
+                             "value": {"from": 0, "to": 1, "amount": 3},
+                             "process": 0})
+        cmds = [cmd for _n, cmd in log]
+        assert any("BEGIN TRANSACTION" in cmd and "END TRANSACTION" in cmd
+                   and "balance - 3" in cmd for cmd in cmds)
+
+    def test_ycql_multi_key(self):
+        test, client, log = self._client("CqlMultiKeyClient", {
+            r"SELECT k, val":
+            " k | val\n---+-----\n 0 |   2\n 2 |   4\n"})
+        res = client.invoke(test, {
+            "type": "invoke", "f": "read",
+            "value": (5, {0: None, 1: None, 2: None}), "process": 0})
+        assert res["type"] == "ok"
+        k, got = res["value"]
+        assert (k, got) == (5, {0: 2, 1: None, 2: 4})
+        res = client.invoke(test, {"type": "invoke", "f": "write",
+                                   "value": (5, {1: 3}), "process": 0})
+        assert res["type"] == "ok"
+        cmds = [cmd for _n, cmd in log]
+        assert any("BEGIN TRANSACTION" in cmd and
+                   "VALUES (5, 1, 3)" in cmd for cmd in cmds)
+
+    def test_append_table_client(self):
+        test, client, log = self._client("AppendTableClient", {
+            r"json_agg": "[1, 2]\n"})
+        res = client.invoke(test, {
+            "type": "invoke", "f": "txn", "process": 0,
+            "value": [["r", 1, None], ["append", 1, 3]]})
+        assert res["type"] == "ok"
+        assert res["value"][0] == ["r", 1, [1, 2]]
+        cmds = [cmd for _n, cmd in log]
+        assert any("(k, v) VALUES (1, 3)" in cmd and "WHERE k = 1" in cmd
+                   for cmd in cmds)
+
+    def test_default_value_checker(self):
+        from jepsen_tpu.history import History, Op
+        from jepsen_tpu.suites.yugabyte import dv_checker
+
+        def read(rows):
+            return Op.from_dict({"type": "ok", "process": 0, "f": "read",
+                                 "value": rows, "time": 0})
+
+        ok = History([read([{"id": 1, "v": 0}])], reindex=True)
+        assert dv_checker().check({}, ok, {})["valid"] is True
+        bad = History([read([{"id": 1, "v": None}])], reindex=True)
+        res = dv_checker().check({}, bad, {})
+        assert res["valid"] is False and res["bad-read-count"] == 1
+
     def test_matrix_shape(self):
         from jepsen_tpu.suites import yugabyte as yb
 
         fns = yb.matrix_test_fns()
-        assert "append-partition+kill" in fns
-        assert "bank-none" in fns
-        assert len(fns) == 3 * 4
-        t = fns["set-none"]({"time_limit": 1})
-        assert t["name"] == "yugabyte-set-none"
+        assert "ysql-append-partition+kill" in fns
+        assert "ycql-bank-none" in fns
+        # Every ycql and ysql workload appears against every fault set.
+        assert len(fns) == len(yb.WORKLOADS) * 4
+        t = fns["ysql-set-none"]({"time_limit": 1})
+        assert t["name"] == "yugabyte-ysql-set-none"
         assert "nemesis" not in t
-        t2 = fns["append-partition"]({"time_limit": 1})
+        t2 = fns["ysql-append-partition"]({"time_limit": 1})
         assert t2["nemesis"] is not None
         assert "plot" in t2
+        # Bare legacy names still resolve (to the ysql variants).
+        t3 = yb.test_fn({"workload": "bank", "time_limit": 1})
+        assert t3["name"].startswith("yugabyte-ysql-bank")
 
 
 class CrateStub(BaseHTTPRequestHandler):
