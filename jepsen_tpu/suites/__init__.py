@@ -40,7 +40,9 @@ same shape on this framework's protocols. Roster (→ reference suite):
 - ``redis``      — --workload queue (rabbitmq/disque shape) | register
   (EVAL compare-and-set)
 - ``rabbitmq``   — management-API queue + total-queue checker
-  (rabbitmq/; disque is the redis queue workload)
+  (rabbitmq/)
+- ``disque``     — ADDJOB/GETJOB/ACKJOB jobs over the disque wire
+  protocol, source-built DB + cluster-meet join (disque/)
 - ``chronos``    — job-scheduler run-window verification (chronos/)
 - ``raftis``     — RESP read/write register on a Raft KV (raftis/)
 - ``faunadb``    — temporal-database workloads (pages, monotonic,

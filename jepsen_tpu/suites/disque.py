@@ -1,0 +1,189 @@
+"""Disque suite: distributed message-queue jobs over the disque wire
+protocol.
+
+Mirrors the reference disque suite (disque/src/jepsen/disque.clj:1-321):
+its own DB lifecycle (built from source, joined via `cluster meet`), a
+job client speaking ADDJOB/GETJOB/ACKJOB — disque's protocol is RESP,
+so the redis suite's :class:`~jepsen_tpu.suites.redis.Resp` codec
+carries it — and the enqueue/dequeue/drain queue workload under the
+total-queue checker (disque.clj:243-283's :total-queue).
+
+The reference folds dequeue+ack into one client step (disque.clj:
+195-207 `dequeue!`): a GETJOB with no job is a definite :fail, a job is
+ACKJOBed then reported ok.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from .. import checker as jchecker
+from .. import cli, client as jclient, db as jdb, generator as gen
+from .. import nemesis as jnemesis, net as jnet
+from ..control import util as cu
+from .. import control as c
+from . import std_generator
+from .redis import Resp
+
+PORT = 7711
+DIR = "/opt/disque"
+DATA_DIR = "/var/lib/disque"
+BINARY = f"{DIR}/src/disque-server"
+CONTROL = f"{DIR}/src/disque"
+LOG = f"{DATA_DIR}/log"
+PID = "/var/run/disque.pid"
+QUEUE = "jepsen"
+JOB_TIMEOUT_MS = 100
+
+
+class DisqueClient(jclient.Client):
+    """ADDJOB/GETJOB/ACKJOB over RESP (disque.clj:141-156's protocol,
+    via the jedisque driver there)."""
+
+    def __init__(self, conn: Optional[Resp] = None):
+        self.conn = conn
+
+    def open(self, test, node):
+        return DisqueClient(Resp(str(node), PORT))
+
+    def _dequeue(self, op):
+        # GETJOB NOHANG COUNT 1 FROM <queue> -> [[queue, id, body]] | None
+        jobs = self.conn.cmd("GETJOB", "NOHANG", "COUNT", 1,
+                             "FROM", QUEUE)
+        if not jobs:
+            return {**op, "type": "fail", "error": "empty"}
+        _q, job_id, body = jobs[0][:3]
+        self.conn.cmd("ACKJOB", job_id)
+        return {**op, "type": "ok", "value": int(body)}
+
+    def invoke(self, test, op):
+        f = op["f"]
+        if f == "enqueue":
+            res = self.conn.cmd("ADDJOB", QUEUE, op["value"],
+                                JOB_TIMEOUT_MS)
+            if not isinstance(res, str) or not res.startswith("D"):
+                return {**op, "type": "info", "error": f"addjob: {res!r}"}
+            return {**op, "type": "ok"}
+        if f == "dequeue":
+            return self._dequeue(op)
+        if f == "drain":
+            drained = []
+            while True:
+                got = self._dequeue({**op, "f": "dequeue"})
+                if got["type"] == "fail":
+                    break
+                drained.append(got["value"])
+            return {**op, "type": "ok", "value": drained}
+        raise ValueError(f"unknown f {f!r}")
+
+    def close(self, test):
+        if self.conn is not None:
+            self.conn.close()
+
+
+class DisqueDB(jdb.DB, jdb.Process, jdb.LogFiles):
+    """Built from source, started via the daemon helper, joined with
+    `disque cluster meet` (disque.clj:39-118)."""
+
+    def __init__(self, version: str = "master"):
+        self.version = version
+
+    def setup(self, test, node):
+        from .. import core
+
+        with c.su():
+            c.exec_star(
+                f"test -d {DIR} || "
+                f"git clone https://github.com/antirez/disque.git {DIR}")
+            c.exec_star(f"cd {DIR} && git fetch && "
+                        f"git reset --hard {self.version} && make")
+        self.start(test, node)
+        # Barrier before the meet: setups run in parallel, and a MEET
+        # sent while the primary is still building is silently dropped
+        # (disque.clj:95-104 synchronizes the same way).
+        core.synchronize(test)
+        primary = test["nodes"][0]
+        if node != primary:
+            out = c.exec_star(
+                f"{CONTROL} -p {PORT} cluster meet {primary} {PORT}")
+            if "OK" not in out:
+                raise RuntimeError(f"cluster meet failed: {out!r}")
+
+    def start(self, test, node):
+        with c.su():
+            c.exec("mkdir", "-p", DATA_DIR)
+            cu.start_daemon(
+                {"logfile": LOG, "pidfile": PID, "chdir": DIR},
+                BINARY,
+                "--port", PORT,
+                "--bind", "0.0.0.0",
+                "--dir", DATA_DIR,
+            )
+
+    def kill(self, test, node):
+        cu.grepkill("disque-server")
+
+    def teardown(self, test, node):
+        self.kill(test, node)
+        with c.su():
+            c.exec_star(f"rm -rf {DATA_DIR}/* {PID} {LOG}")
+
+    def log_files(self, test, node):
+        return [LOG]
+
+
+def queue_workload(opts: Optional[dict] = None) -> dict:
+    """Enqueue/dequeue mix, then a per-thread drain; total-queue
+    multiset semantics (disque.clj:243-283)."""
+    o = dict(opts or {})
+    counter = [0]
+
+    def enq(test=None, ctx=None):
+        counter[0] += 1
+        return {"type": "invoke", "f": "enqueue", "value": counter[0]}
+
+    def deq(test=None, ctx=None):
+        return {"type": "invoke", "f": "dequeue", "value": None}
+
+    load = gen.clients(gen.limit(int(o.get("ops") or 200),
+                                 gen.mix([enq, deq])))
+    drain = gen.clients(gen.each_thread({"type": "invoke", "f": "drain",
+                                         "value": None}))
+    return {
+        "client": DisqueClient(),
+        "checker": jchecker.compose({
+            "total-queue": jchecker.total_queue(),
+            "stats": jchecker.stats(),
+        }),
+        "generator": gen.phases(load, drain),
+        "load-generator": load,
+        "final-generator": drain,
+    }
+
+
+def test_fn(opts: dict) -> dict:
+    wl = queue_workload(opts)
+    return {
+        "name": "disque-queue",
+        "db": DisqueDB(str(opts.get("version") or "master")),
+        "net": jnet.iptables(),
+        "nemesis": jnemesis.partition_random_halves(),
+        **{k: v for k, v in wl.items()
+           if k not in ("generator", "load-generator", "final-generator")},
+        "generator": std_generator(
+            opts, wl["load-generator"],
+            final_client_gen=wl["final-generator"]),
+    }
+
+
+def _add_opts(p):
+    p.add_argument("--ops", type=int, default=200)
+    p.add_argument("--version", default="master")
+
+
+def main(argv=None):
+    cli.main_exit(cli.single_test_cmd(test_fn, add_opts=_add_opts), argv)
+
+
+if __name__ == "__main__":
+    main()

@@ -110,6 +110,19 @@ def match(cls: str, term: Any = None) -> dict:
     return m
 
 
+def upsert_index(name: str, source: str, values: Any) -> dict:
+    """f/upsert-index! (bank.clj:146-153): declare a covering index."""
+    return {"upsert_index": {"name": name, "source": source,
+                             "values": list(values)}}
+
+
+def match_index(name: str) -> dict:
+    """q/match over a DECLARED index (bank.clj:158-165's (q/match idx)):
+    rows are the index's values projection; an undeclared index is an
+    error."""
+    return {"match_index": name}
+
+
 def guarded_transfer(cls: str, frm: Any, to: Any, amount: int) -> dict:
     """bank.clj's transfer txn: abort if the source would go negative."""
     return {"transfer": {"class": cls, "from": frm, "to": to,
@@ -252,6 +265,33 @@ class BankClient(jclient.Client):
 
     def close(self, test):
         self.conn.close()
+
+
+class BankIndexClient(BankClient):
+    """bank.clj:139-182's IndexClient: reads go through a covering
+    index (ref + balance value pairs via q/match) instead of per-ref
+    gets; transfers delegate to the plain bank client."""
+
+    IDX = "accounts_by_balance"
+
+    def open(self, test, node):
+        return BankIndexClient(Fauna(str(node)))
+
+    def setup(self, test):
+        self.conn.query(upsert_index(
+            self.IDX, self.CLS, ["id", "balance"]))
+        super().setup(test)
+
+    def invoke(self, test, op):
+        if op["f"] != "read":
+            return super().invoke(test, op)
+
+        def go():
+            pairs = self.conn.query(match_index(self.IDX))
+            return {**op, "type": "ok",
+                    "value": {i: b for i, b in pairs}}
+
+        return _with_errors(op, True, go)
 
 
 class SetClient(jclient.Client):
@@ -877,6 +917,13 @@ def bank_workload(opts: dict) -> dict:
     return {**wl, "client": BankClient()}
 
 
+def bank_index_workload(opts: dict) -> dict:
+    """bank.clj:184-191's index-workload: same invariant, reads served
+    by the covering index."""
+    wl = wbank.test(opts)
+    return {**wl, "client": BankIndexClient()}
+
+
 def set_workload(opts: dict) -> dict:
     o = dict(opts or {})
     counter = [0]
@@ -1065,6 +1112,7 @@ def internal_workload(opts: dict) -> dict:
 
 WORKLOADS = {
     "bank": bank_workload,
+    "bank-index": bank_index_workload,
     "set": set_workload,
     "pages": pages_workload,
     "monotonic": monotonic_workload,

@@ -221,6 +221,17 @@ class HazelcastDB(jdb.DB, jdb.Process, jdb.LogFiles):
         <cp-member-count>{cp_count}</cp-member-count>
         <group-size>{group}</group-size>
     </cp-subsystem>
+    <!-- Split-brain protection: every jepsen.lock* structure requires
+         a majority EXCEPT jepsen.lock.no-quorum — the deliberately
+         exempted lock the lock-no-quorum workload exercises
+         (hazelcast.clj:676-683's server config). -->
+    <split-brain-protection name="majority" enabled="true">
+        <minimum-cluster-size>{len(nodes) // 2 + 1}</minimum-cluster-size>
+    </split-brain-protection>
+    <lock name="jepsen.lock">
+        <split-brain-protection-ref>majority</split-brain-protection-ref>
+    </lock>
+    <lock name="jepsen.lock.no-quorum"/>
 </hazelcast>
 """
         with c.su():
@@ -298,10 +309,19 @@ def lock_workload(opts: Optional[dict] = None) -> dict:
     workloads/lock.py), plus the bridge client."""
     wl = wlock.lock_test(opts)
     o = dict(opts or {})
-    wl["client"] = LockClient()
+    wl["client"] = LockClient(name=str(o.get("lock-name") or "jepsen.lock"))
     wl["generator"] = gen.clients(
         gen.limit(int(o.get("ops") or 500), wl["generator"]))
     return wl
+
+
+def lock_no_quorum_workload(opts: Optional[dict] = None) -> dict:
+    """hazelcast.clj:676-683's :lock-no-quorum: the same mutex workload
+    against the lock the server config exempts from split-brain
+    protection ("jepsen.lock.no-quorum") — the misconfiguration the
+    reference demonstrates losing linearizability under partitions."""
+    return lock_workload({**(opts or {}),
+                          "lock-name": "jepsen.lock.no-quorum"})
 
 
 def semaphore_workload(opts: Optional[dict] = None) -> dict:
@@ -315,6 +335,7 @@ def semaphore_workload(opts: Optional[dict] = None) -> dict:
 
 WORKLOADS = {
     "lock": lock_workload,
+    "lock-no-quorum": lock_no_quorum_workload,
     "semaphore": semaphore_workload,
     "id-gen": id_gen_workload,
 }

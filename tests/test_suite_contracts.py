@@ -16,7 +16,7 @@ from jepsen_tpu.workloads import noop_test
 
 SUITES = [
     "aerospike", "chronos", "cockroachdb", "consul", "crate", "dgraph",
-    "elasticsearch", "etcd", "faunadb", "hazelcast", "ignite",
+    "disque", "elasticsearch", "etcd", "faunadb", "hazelcast", "ignite",
     "logcabin", "mongodb", "mysql", "postgres", "rabbitmq", "raftis",
     "redis", "rethinkdb", "robustirc", "stolon", "tidb", "yugabyte",
     "zookeeper",

@@ -303,6 +303,80 @@ class TestRedisSuite:
             srv.server_close()
 
 
+class DisqueStub(RedisStub):
+    """ADDJOB/GETJOB/ACKJOB job semantics over the same RESP frame
+    handling: jobs stay un-acked until ACKJOB (a crashed consumer's job
+    comes back), like disque."""
+
+    def __init__(self):
+        super().__init__()
+        self.jobs: dict = {}  # id -> (queue, body)
+        self.pending: list = []  # job ids awaiting GETJOB
+        self.unacked: dict = {}  # id -> (queue, body)
+        self.next_id = [0]
+
+    def dispatch(self, args) -> bytes:
+        cmd = args[0].upper()
+        with self.lock:
+            if cmd == "ADDJOB":
+                _q, body = args[1], args[2]
+                self.next_id[0] += 1
+                jid = f"D-deadbeef-{self.next_id[0]:08d}-0"
+                self.jobs[jid] = (_q, body)
+                self.pending.append(jid)
+                return f"${len(jid)}\r\n{jid}\r\n".encode()
+            if cmd == "GETJOB":
+                if not self.pending:
+                    return b"*-1\r\n"
+                jid = self.pending.pop(0)
+                q, body = self.jobs[jid]
+                self.unacked[jid] = (q, body)
+                out = (f"*1\r\n*3\r\n${len(q)}\r\n{q}\r\n"
+                       f"${len(jid)}\r\n{jid}\r\n"
+                       f"${len(body)}\r\n{body}\r\n")
+                return out.encode()
+            if cmd == "ACKJOB":
+                self.unacked.pop(args[1], None)
+                return b":1\r\n"
+        return b"-ERR unknown\r\n"
+
+
+class TestDisqueSuite:
+    def test_queue_against_stub(self, tmp_path, monkeypatch):
+        import socketserver
+
+        from jepsen_tpu.suites import disque as dq
+
+        stub = DisqueStub()
+        srv = socketserver.ThreadingTCPServer(("127.0.0.1", 0),
+                                              stub.Handler)
+        srv.daemon_threads = True
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        monkeypatch.setattr(dq, "PORT", srv.server_address[1])
+        try:
+            test = dict(noop_test())
+            wl = dq.queue_workload({"ops": 60})
+            test.update(
+                name="disque-stub",
+                nodes=["127.0.0.1"],
+                concurrency=4,
+                **{"store-root": str(tmp_path)},
+                client=wl["client"],
+                checker=wl["checker"],
+                generator=wl["generator"],
+            )
+            res = core.run(test)
+            tq = res["results"]["total-queue"]
+            assert res["results"]["valid"] is True, res["results"]
+            assert tq["lost_count"] == 0
+            assert tq["attempt_count"] > 0
+            # Every acked job left the unacked table.
+            assert not stub.unacked
+        finally:
+            srv.shutdown()
+            srv.server_close()
+
+
 class TestMysqlDirtyReads:
     def test_checker(self):
         from jepsen_tpu.history import History, Op
@@ -712,9 +786,11 @@ class BridgeStub:
         self.sem_capacity = sem_capacity
         self.ids = [0]
         self.lock_timeout = lock_timeout
+        self.seen_names: set = set()
 
     def dispatch(self, conn_id, words) -> str:
         cmd, name = words[0], words[1]
+        self.seen_names.add(name)
         import time as _t
 
         with self.cond:
@@ -796,6 +872,15 @@ class TestHazelcastSuite:
         assert oks and all(isinstance(op.value, int) for op in oks)
         fences = [op.value for op in sorted(oks, key=lambda o: o.time)]
         assert fences == sorted(fences)
+
+    def test_lock_no_quorum_against_stub(self, bridge, tmp_path):
+        hz, stub = bridge
+        res = self._run(hz, tmp_path, "lock-no-quorum",
+                        {"model": "mutex", "ops": 30})
+        # A correct (stub) server is linearizable even on the exempted
+        # lock; the point here is the distinct lock name is routed.
+        assert res["results"]["valid"] is True, res["results"]
+        assert "jepsen.lock.no-quorum" in stub.seen_names
 
     def test_semaphore_against_stub(self, bridge, tmp_path):
         hz, _stub = bridge
@@ -2130,6 +2215,7 @@ class FaunaStub(BaseHTTPRequestHandler):
     lock = threading.Lock()
     clock = [0]
     instances: dict = {}  # (cls, id) -> [(ts, data), ...]
+    indexes: dict = {}    # name -> {"source", "values"}
     auto = [0]
 
     @classmethod
@@ -2138,6 +2224,7 @@ class FaunaStub(BaseHTTPRequestHandler):
             cls.clock[0] = 0
             cls.instances = {}
             cls.auto[0] = 0
+            cls.indexes = {}
 
     def log_message(self, *a):
         pass
@@ -2228,6 +2315,27 @@ class FaunaStub(BaseHTTPRequestHandler):
                 if "term" in x and data.get("key") != x["term"]:
                     continue
                 out.append({"value": data.get("value")})
+            return out
+        if "upsert_index" in x:
+            d = x["upsert_index"]
+            cls.indexes[d["name"]] = {"source": d["source"],
+                                      "values": list(d["values"])}
+            return {"created": d["name"]}
+        if "match_index" in x:
+            idx = cls.indexes.get(x["match_index"])
+            if idx is None:
+                raise _FaunaErr("index not found")
+            out = []
+            for (kcls, _rid), _versions in sorted(cls.instances.items()):
+                if kcls != idx["source"]:
+                    continue
+                data = cls._visible((kcls, _rid), snap)
+                if data is None:
+                    continue
+                # Covering-index projection: "id" is the ref id,
+                # anything else a data field.
+                out.append([_rid if f == "id" else data.get(f)
+                            for f in idx["values"]])
             return out
         if "not" in x:
             return not cls._eval(x["not"], now, snap)
@@ -2320,7 +2428,7 @@ def _run_fauna(fdb, tmp_path, workload, opts=None, concurrency=4):
            if k not in ("generator", "final-generator")},
     )
     g = wl["generator"]
-    if workload == "bank":
+    if workload in ("bank", "bank-index"):
         # wbank.test's generator is unbounded (the suite's
         # std_generator time-limits it in test_fn).
         g = gen.clients(gen.limit(int((opts or {}).get("ops") or 40), g))
@@ -2342,6 +2450,16 @@ class TestFaunaSuite:
         assert reads and all(
             sum(v for v in op.value.values() if v is not None) == 100
             for op in reads)
+
+    def test_bank_index_against_stub(self, fauna, tmp_path):
+        res = self._run(fauna, tmp_path, "bank-index", {"ops": 60})
+        assert res["results"]["valid"] is True, res["results"]
+        reads = [op for op in res["history"]
+                 if op.f == "read" and op.type == "ok"]
+        # Index reads return only EXISTING accounts (zero-balance ones
+        # are deleted), yet conservation must still hold.
+        assert reads and all(
+            sum(op.value.values()) == 100 for op in reads)
 
     def test_set_against_stub(self, fauna, tmp_path):
         res = self._run(fauna, tmp_path, "set",
