@@ -155,6 +155,49 @@ def main() -> int:
         except Exception as e:  # noqa: BLE001
             out["headroom_10x"] = {"error": f"{type(e).__name__}: {e}"}
 
+        # Scale headline: BASELINE's real metric is *max history length
+        # verified inside the 300 s CPU budget* — measure it by doubling
+        # from 1M ops on the production (native) dispatch until a check
+        # exceeds the per-size cap or the bench budget tightens. History
+        # GENERATION (python) dominates wall here and is excluded from
+        # the verified-in seconds.
+        try:
+            if _left() < 120:
+                out["max_verified_ops"] = {"skipped": "budget"}
+            else:
+                best = None
+                size = 1_000_000
+                last_total = None
+                while size <= 4_000_000 and _left() > 90:
+                    # Each doubling costs ~2x the last (generation
+                    # included); don't start one that could blow the
+                    # global budget mid-flight.
+                    if last_total is not None \
+                            and 2.5 * last_total > _left() - 60:
+                        break
+                    t_gen0 = time.perf_counter()
+                    # Crash RATE scaled down so the absolute :info-op
+                    # count stays inside the native engine's 256-open-op
+                    # window (0.002 * 1M = 2000 opens would silently
+                    # push the check onto the python oracle).
+                    big = random_register_history(
+                        random.Random(size), n_ops=size, n_procs=10,
+                        cas=True, crash_p=20.0 / size, fail_p=0.02)
+                    t0 = time.perf_counter()
+                    bres = wgl.check_history(model, big)
+                    bdt = time.perf_counter() - t0
+                    last_total = time.perf_counter() - t_gen0
+                    if bres["valid"] is not True or bdt > BASELINE_S:
+                        break
+                    best = {"ops": size, "value_s": round(bdt, 3),
+                            "backend": bres.get("backend"),
+                            "ops_per_s": round(size / bdt, 1)}
+                    size *= 2
+                out["max_verified_ops"] = best or {
+                    "error": "1M-op check failed or over budget"}
+        except Exception as e:  # noqa: BLE001
+            out["max_verified_ops"] = {"error": f"{type(e).__name__}: {e}"}
+
         # Host-side companion: threaded-interpreter scheduling throughput
         # (the reference's generator claims >20k ops/s on the JVM,
         # generator.clj:67-70). A ZERO-latency client isolates the
@@ -192,6 +235,22 @@ def main() -> int:
             out["interpreter_ops_per_s"] = round(max(rates), 1)
             out["interpreter_ops_per_s_median"] = round(
                 sorted(rates)[1], 1)
+            # High-concurrency scheduling: 100 workers (the GIL-bound
+            # regime the restrict-memo/switch-interval work targets).
+            rates100 = []
+            for _rep in range(2):
+                itest100 = dict(itest)
+                itest100.update(
+                    concurrency=100,
+                    client=AtomClient(AtomState(), latency=0),
+                    generator=jgen.clients(jgen.limit(n_i, _w)))
+                with with_relative_time():
+                    t0 = time.perf_counter()
+                    ih = jinterp.run(itest100)
+                    idt = time.perf_counter() - t0
+                n_ok = sum(1 for op in ih if op.get("type") == "ok")
+                rates100.append(n_ok / idt)
+            out["interpreter_100w_ops_per_s"] = round(max(rates100), 1)
         except Exception as e:  # noqa: BLE001
             out["interpreter_ops_per_s"] = None
             out["interpreter_error"] = f"{type(e).__name__}: {e}"

@@ -133,9 +133,17 @@ class Context:
     def free_thread_list(self) -> tuple:
         # Deterministic order: numeric threads sorted, nemesis last.
         # Tuple, not list: the value is cached, so it must be immutable.
+        # Split by type and sort without a key fn: a keyed sort over
+        # ~concurrency threads ran every scheduler step and dominated
+        # high-concurrency interpreter profiles.
         if self._flist is None:
-            self._flist = tuple(sorted(
-                self.free_threads, key=lambda t: (isinstance(t, str), t)))
+            ints = []
+            names = []
+            for t in self.free_threads:
+                (ints if type(t) is int else names).append(t)
+            ints.sort()
+            names.sort(key=str)
+            self._flist = tuple(ints) + tuple(names)
         return self._flist
 
     def __repr__(self) -> str:
@@ -536,12 +544,24 @@ on_update = OnUpdate
 # Thread routing
 
 
+# (pred, id(workers)) -> (workers ref, allowed thread set, restricted
+# workers dict). Thread ids are fixed for a run and workers dicts are
+# immutable (replaced wholesale on process bumps), so the Python-level
+# pred sweep runs once per (pred, workers-generation) instead of per
+# scheduler step; holding the dict ref keeps the id stable. Bounded by
+# a clear-on-overflow (generations = info-op count, normally tiny).
+_RESTRICT_MEMO: dict = {}
+_RESTRICT_MEMO_MAX = 4096
+
+
 def on_threads_context(pred: Callable[[Any], bool], ctx: Context) -> Context:
     """Restrict a context to threads satisfying pred (generator.clj:826-843).
 
     Memoized per (ctx, pred): a deep generator stack restricts the same
     immutable context several times per scheduler step, which dominated
-    interpreter throughput before caching."""
+    interpreter throughput before caching. The pred sweep itself is
+    additionally memoized per workers-generation (see _RESTRICT_MEMO),
+    so steady-state restriction is one C-level set intersection."""
     cache = ctx._restrict
     if cache is None:
         cache = ctx._restrict = {}
@@ -551,10 +571,24 @@ def on_threads_context(pred: Callable[[Any], bool], ctx: Context) -> Context:
         hit = None
         cache = None
     if hit is None:
-        hit = ctx.with_(
-            free_threads=frozenset(t for t in ctx.free_threads if pred(t)),
-            workers={t: p for t, p in ctx.workers.items() if pred(t)},
-        )
+        ent = None
+        key = (pred, id(ctx.workers)) if cache is not None else None
+        if key is not None:
+            ent = _RESTRICT_MEMO.get(key)
+            if ent is not None and ent[0] is not ctx.workers:
+                ent = None
+        if ent is None:
+            allowed = frozenset(t for t in ctx.workers if pred(t))
+            rworkers = {t: p for t, p in ctx.workers.items()
+                        if t in allowed}
+            ent = (ctx.workers, allowed, rworkers)
+            if key is not None:
+                if len(_RESTRICT_MEMO) > _RESTRICT_MEMO_MAX:
+                    _RESTRICT_MEMO.clear()
+                _RESTRICT_MEMO[key] = ent
+        _, allowed, rworkers = ent
+        hit = ctx.with_(free_threads=ctx.free_threads & allowed,
+                        workers=rworkers)
         if cache is not None:
             cache[pred] = hit
     return hit

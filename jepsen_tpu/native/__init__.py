@@ -53,7 +53,7 @@ def _compile(src_path: Path, stem: str, extra_args=()) -> Optional[Path]:
 
 
 def _build() -> Optional[ctypes.CDLL]:
-    so = _compile(_SRC, "wgl_native")
+    so = _compile(_SRC, "wgl_native", ("-pthread",))
     if so is None:
         return None
     lib = ctypes.CDLL(str(so))
@@ -78,6 +78,11 @@ def _build() -> Optional[ctypes.CDLL]:
         ctypes.POINTER(ctypes.c_int32),
     ]
     lib.wgl_check_dfs.restype = ctypes.c_int
+    # The parallel DFS: same signature plus a thread count.
+    lib.wgl_check_dfs_par.argtypes = lib.wgl_check_dfs.argtypes + [
+        ctypes.c_int32,
+    ]
+    lib.wgl_check_dfs_par.restype = ctypes.c_int
     lib.wgl_witness_stride.argtypes = []
     lib.wgl_witness_stride.restype = ctypes.c_int
     lib.wgl_max_open.argtypes = []

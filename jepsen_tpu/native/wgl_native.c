@@ -19,6 +19,8 @@
  *                             -3 out of memory
  */
 
+#include <pthread.h>
+#include <stdatomic.h>
 #include <stdint.h>
 #include <stdlib.h>
 #include <string.h>
@@ -562,6 +564,79 @@ static int vec_push(vec_t *v, const cfg_t *c) {
 }
 
 /* ------------------------------------------------------------------ */
+/* Shared expansion logic: candidate bounds and the per-slot transition
+ * filter, used identically by the sequential DFS, the parallel DFS's
+ * seeding sweep, and its workers (one copy — the three loops cannot
+ * drift). */
+
+typedef struct {
+    int32_t nD, nO, S, W;
+    const int32_t *invD, *retD, *opD, *a1D, *a2D, *sufret;
+    const int32_t *invO, *opO, *a1O, *a2O;
+    int32_t model_id;
+    int64_t model_param;
+} tabs_t;
+
+static inline void cfg_bounds(const tabs_t *T, const cfg_t *c,
+                              int32_t *wlim_out, int32_t *min_ret_out) {
+    int32_t wlim = (T->nD - c->p < T->W) ? T->nD - c->p : T->W;
+    int32_t min_ret =
+        T->sufret[(c->p + T->W < T->nD) ? c->p + T->W : T->nD];
+    for (int j = 0; j < wlim; j++)
+        if (!((c->win >> j) & 1) && T->retD[c->p + j] < min_ret)
+            min_ret = T->retD[c->p + j];
+    *wlim_out = wlim;
+    *min_ret_out = min_ret;
+}
+
+/* Try candidate slot j (0..wlim-1 window ops, wlim..wlim+nO-1 open
+ * ops). 0 = filtered, 1 = successor written to *out, 2 = the history
+ * completed (accepting linearization found). */
+static inline int cfg_try(const tabs_t *T, const cfg_t *c, int32_t wlim,
+                          int32_t min_ret, int32_t j, cfg_t *out) {
+    cfg_t c2 = *c;
+    if (j < wlim) {
+        if ((c->win >> j) & 1)
+            return 0;
+        int32_t row = c->p + j;
+        if (T->invD[row] >= min_ret && T->retD[row] != min_ret)
+            return 0;
+        if (!step_model(T->model_id, T->model_param, c->st, T->opD[row],
+                        T->a1D[row], T->a2D[row], c2.st))
+            return 0;
+        c2.win = c->win | (1ULL << j);
+        while (c2.win & 1) {
+            c2.win >>= 1;
+            c2.p++;
+        }
+        if (c2.p >= T->nD)
+            return 2;
+    } else {
+        int o = j - wlim;
+        if (open_test(c, o))
+            return 0;
+        if (T->invO[o] >= min_ret)
+            return 0;
+        if (!step_model(T->model_id, T->model_param, c->st, T->opO[o],
+                        T->a1O[o], T->a2O[o], c2.st))
+            return 0;
+        open_set_bit(&c2, o);
+    }
+    *out = c2;
+    return 1;
+}
+
+static inline int32_t cfg_depth(const cfg_t *c) {
+    int32_t d = c->p;
+    uint64_t w = c->win;
+    while (w) {
+        d += (int32_t)(w & 1);
+        w >>= 1;
+    }
+    return d;
+}
+
+/* ------------------------------------------------------------------ */
 /* Depth-first search with memoization (Lowe / knossos-"linear" style):
  * follow one linearization, backtracking on dead ends; the memo set
  * guarantees each configuration is expanded at most once, so valid
@@ -645,6 +720,9 @@ int wgl_check_dfs(
     }
     size_t sp = 0;
 
+    tabs_t T = {nD, nO, S, W, invD, retD, opD, a1D, a2D, sufret,
+                invO, opO, a1O, a2O, model_id, model_param};
+
     frame_t root;
     memset(&root, 0, sizeof(root));
     memcpy(root.cfg.st, init_state, sizeof(int32_t) * (size_t)S);
@@ -666,17 +744,10 @@ int wgl_check_dfs(
                 verdict = -1;
                 break;
             }
-            fr->wlim = (nD - c->p < W) ? nD - c->p : W;
-            int32_t min_ret = sufret[(c->p + W < nD) ? c->p + W : nD];
-            for (int j = 0; j < fr->wlim; j++)
-                if (!((c->win >> j) & 1) && retD[c->p + j] < min_ret)
-                    min_ret = retD[c->p + j];
-            fr->min_ret = min_ret;
+            cfg_bounds(&T, c, &fr->wlim, &fr->min_ret);
             fr->next_j = 0;
             {
-                int32_t d = c->p;
-                uint64_t w = c->win;
-                while (w) { d += (int32_t)(w & 1); w >>= 1; }
+                int32_t d = cfg_depth(c);
                 wit_record(wit_buf, wit_cap, wit_len, max_linearized, d, c);
                 if (d > *max_linearized)
                     *max_linearized = d;
@@ -685,32 +756,13 @@ int wgl_check_dfs(
         int advanced = 0;
         while (fr->next_j < fr->wlim + nO) {
             int j = fr->next_j++;
-            cfg_t c2 = *c;
-            if (j < fr->wlim) {
-                if ((c->win >> j) & 1)
-                    continue;
-                int32_t row = c->p + j;
-                if (invD[row] >= fr->min_ret && retD[row] != fr->min_ret)
-                    continue;
-                if (!step_model(model_id, model_param, c->st, opD[row],
-                                a1D[row], a2D[row], c2.st))
-                    continue;
-                c2.win = c->win | (1ULL << j);
-                while (c2.win & 1) { c2.win >>= 1; c2.p++; }
-                if (c2.p >= nD) {
-                    verdict = 1;
-                    break;
-                }
-            } else {
-                int o = j - fr->wlim;
-                if (open_test(c, o))
-                    continue;
-                if (invO[o] >= fr->min_ret)
-                    continue;
-                if (!step_model(model_id, model_param, c->st, opO[o],
-                                a1O[o], a2O[o], c2.st))
-                    continue;
-                open_set_bit(&c2, o);
+            cfg_t c2;
+            int r = cfg_try(&T, c, fr->wlim, fr->min_ret, j, &c2);
+            if (r == 0)
+                continue;
+            if (r == 2) {
+                verdict = 1;
+                break;
             }
             int ins = dom_insert(&seen, &c2);
             if (ins < 0) {
@@ -741,6 +793,288 @@ int wgl_check_dfs(
     *configs_explored = explored;
     free(stack);
     dom_free(&seen);
+    return verdict;
+}
+
+/* ------------------------------------------------------------------ */
+/* Parallel DFS: the same memoized search fanned over worker threads.
+ *
+ * Discovered-but-unexpanded configs live on ONE shared LIFO stack;
+ * workers pop small batches off the top, expand them, and push
+ * successors back in reverse candidate order (so the stack top is the
+ * real-time-first candidate — the ordering that makes valid histories
+ * near-linear in the sequential DFS). The dominance memo is ONE
+ * logical set striped into PAR_STRIPES independently-growing hash
+ * tables, each under its own mutex — a worker that finds a config
+ * dominated can rely on whichever worker inserted the dominating
+ * config to (have) explore(d) its whole subtree, exactly the
+ * sequential argument. Refutation (verdict 0) is only claimed when the
+ * stack empties with zero configs mid-expansion and no budget trip or
+ * cancellation, so concurrent pruning can never manufacture a false
+ * "invalid". Valid verdicts short-circuit all workers. */
+
+#define PAR_STRIPES 128
+#define PAR_POP_BATCH 16
+/* successors of one config: <= W window + nO open candidates */
+#define PAR_MAX_SUCC (64 + 64 * NO_WORDS)
+
+typedef struct {
+    tabs_t T;
+    int64_t max_configs;
+    const volatile int32_t *cancel;
+    domset_t sets[PAR_STRIPES];
+    pthread_mutex_t mus[PAR_STRIPES];
+    /* shared work stack + in-flight accounting */
+    pthread_mutex_t qmu;
+    vec_t q;
+    size_t q_peak;
+    atomic_llong pending; /* configs on the stack or mid-expansion */
+    atomic_llong explored;
+    atomic_int decided; /* 0 running | 1 valid | -1 budget/cancel | -3 oom */
+    /* deepest-config witness capture (shared; mutex-guarded) */
+    pthread_mutex_t wit_mu;
+    int32_t *wit_buf;
+    int32_t wit_cap;
+    int32_t *wit_len;
+    int32_t maxlin_plain;
+    atomic_int maxlin;
+} par_t;
+
+static int par_insert(par_t *P, const cfg_t *c) {
+    uint64_t h = dom_key_hash(c->p, c->win, c->st);
+    int s = (int)(h >> 56) & (PAR_STRIPES - 1);
+    pthread_mutex_lock(&P->mus[s]);
+    int r = dom_insert(&P->sets[s], c);
+    pthread_mutex_unlock(&P->mus[s]);
+    return r;
+}
+
+static void par_witness(par_t *P, const cfg_t *c) {
+    int32_t d = cfg_depth(c);
+    int32_t ml = atomic_load_explicit(&P->maxlin, memory_order_relaxed);
+    if (d < ml)
+        return;
+    if (d == ml && !P->wit_buf)
+        return;
+    pthread_mutex_lock(&P->wit_mu);
+    wit_record(P->wit_buf, P->wit_cap, P->wit_len, &P->maxlin_plain, d, c);
+    if (d > P->maxlin_plain)
+        P->maxlin_plain = d;
+    atomic_store_explicit(&P->maxlin, P->maxlin_plain,
+                          memory_order_relaxed);
+    pthread_mutex_unlock(&P->wit_mu);
+}
+
+/* Pop up to max_k configs off the top of the shared stack. */
+static int par_pop(par_t *P, cfg_t *out, int max_k) {
+    pthread_mutex_lock(&P->qmu);
+    int k = (int)((P->q.len < (size_t)max_k) ? P->q.len : (size_t)max_k);
+    for (int i = 0; i < k; i++)
+        out[i] = P->q.items[--P->q.len];
+    pthread_mutex_unlock(&P->qmu);
+    return k;
+}
+
+/* Push k configs; 0 on OOM. */
+static int par_push(par_t *P, const cfg_t *cs, int k) {
+    pthread_mutex_lock(&P->qmu);
+    for (int i = 0; i < k; i++) {
+        if (!vec_push(&P->q, &cs[i])) {
+            pthread_mutex_unlock(&P->qmu);
+            return 0;
+        }
+    }
+    if (P->q.len > P->q_peak)
+        P->q_peak = P->q.len;
+    pthread_mutex_unlock(&P->qmu);
+    return 1;
+}
+
+static void *par_worker(void *arg) {
+    par_t *P = (par_t *)arg;
+    const tabs_t *T = &P->T;
+    cfg_t *batch = (cfg_t *)malloc(sizeof(cfg_t) * PAR_POP_BATCH);
+    cfg_t *succ = (cfg_t *)malloc(sizeof(cfg_t) * PAR_MAX_SUCC);
+    if (!batch || !succ) {
+        free(batch);
+        free(succ);
+        atomic_store(&P->decided, -3);
+        return NULL;
+    }
+    int64_t local = 0, flushed = 0;
+    while (!atomic_load_explicit(&P->decided, memory_order_relaxed)) {
+        int k = par_pop(P, batch, PAR_POP_BATCH);
+        if (k == 0) {
+            if (atomic_load_explicit(&P->pending, memory_order_acquire)
+                    == 0)
+                break; /* nothing queued, nothing mid-expansion: done */
+            struct timespec ts = {0, 50000}; /* 50 us */
+            nanosleep(&ts, NULL);
+            continue;
+        }
+        for (int bi = 0; bi < k; bi++) {
+            if (atomic_load_explicit(&P->decided, memory_order_relaxed))
+                break; /* decided != 0: refutation is off the table, so
+                          the un-decremented pending is harmless */
+            cfg_t *c = &batch[bi];
+            local++;
+            if ((local & 0x3FF) == 0) {
+                atomic_fetch_add(&P->explored, local - flushed);
+                flushed = local;
+                if (atomic_load_explicit(&P->explored,
+                                         memory_order_relaxed)
+                        > P->max_configs ||
+                    (P->cancel && *P->cancel)) {
+                    atomic_store(&P->decided, -1);
+                    break;
+                }
+            }
+            int32_t wlim, min_ret;
+            cfg_bounds(T, c, &wlim, &min_ret);
+            par_witness(P, c);
+            int ns = 0;
+            for (int j = 0; j < wlim + T->nO; j++) {
+                cfg_t c2;
+                int r = cfg_try(T, c, wlim, min_ret, j, &c2);
+                if (r == 0)
+                    continue;
+                if (r == 2) {
+                    atomic_store(&P->decided, 1);
+                    break;
+                }
+                int ins = par_insert(P, &c2);
+                if (ins < 0) {
+                    atomic_store(&P->decided, -3);
+                    break;
+                }
+                if (ins)
+                    succ[ns++] = c2;
+            }
+            if (atomic_load_explicit(&P->decided, memory_order_relaxed))
+                break;
+            if (ns) {
+                /* reverse so the stack top is the lowest-j candidate
+                 * (the real-time-first descent order) */
+                for (int a = 0, b = ns - 1; a < b; a++, b--) {
+                    cfg_t tmp = succ[a];
+                    succ[a] = succ[b];
+                    succ[b] = tmp;
+                }
+                atomic_fetch_add_explicit(&P->pending, ns,
+                                          memory_order_release);
+                if (!par_push(P, succ, ns)) {
+                    atomic_store(&P->decided, -3);
+                    break;
+                }
+            }
+            atomic_fetch_sub_explicit(&P->pending, 1,
+                                      memory_order_release);
+        }
+    }
+    atomic_fetch_add(&P->explored, local - flushed);
+    free(batch);
+    free(succ);
+    return NULL;
+}
+
+int wgl_check_dfs_par(
+    int32_t nD, int32_t nO, int32_t S, int32_t W,
+    const int32_t *invD, const int32_t *retD, const int32_t *opD,
+    const int32_t *a1D, const int32_t *a2D,
+    const int32_t *sufret,
+    const int32_t *invO, const int32_t *opO,
+    const int32_t *a1O, const int32_t *a2O,
+    const int32_t *init_state,
+    int32_t model_id, int64_t model_param,
+    int64_t max_configs,
+    int64_t *configs_explored, int32_t *frontier_max,
+    int32_t *max_linearized,
+    int32_t *wit_buf, int32_t wit_cap, int32_t *wit_len,
+    const volatile int32_t *cancel,
+    int32_t n_threads) {
+    if (W > 64 || nO > 64 * NO_WORDS || S > S_MAX)
+        return -2;
+    *configs_explored = 0;
+    *frontier_max = 0;
+    *max_linearized = 0;
+    if (wit_len)
+        *wit_len = 0;
+    if (nD == 0)
+        return 1;
+    if (n_threads < 1)
+        n_threads = 1;
+    if (n_threads > 64)
+        n_threads = 64;
+
+    par_t *P = (par_t *)calloc(1, sizeof(par_t));
+    if (!P)
+        return -3;
+    tabs_t T = {nD, nO, S, W, invD, retD, opD, a1D, a2D, sufret,
+                invO, opO, a1O, a2O, model_id, model_param};
+    P->T = T;
+    P->max_configs = max_configs;
+    P->cancel = cancel;
+    P->wit_buf = wit_buf;
+    P->wit_cap = wit_cap;
+    P->wit_len = wit_len;
+    atomic_init(&P->pending, 0);
+    atomic_init(&P->explored, 0);
+    atomic_init(&P->decided, 0);
+    atomic_init(&P->maxlin, 0);
+    pthread_mutex_init(&P->wit_mu, NULL);
+    pthread_mutex_init(&P->qmu, NULL);
+    for (int i = 0; i < PAR_STRIPES; i++) {
+        pthread_mutex_init(&P->mus[i], NULL);
+        if (!dom_init(&P->sets[i], 1 << 8)) {
+            for (int j = 0; j < i; j++)
+                dom_free(&P->sets[j]);
+            free(P);
+            return -3;
+        }
+    }
+
+    int verdict;
+    {
+        cfg_t root_cfg;
+        memset(&root_cfg, 0, sizeof(root_cfg));
+        memcpy(root_cfg.st, init_state, sizeof(int32_t) * (size_t)S);
+        par_insert(P, &root_cfg);
+        atomic_store(&P->pending, 1);
+        if (!par_push(P, &root_cfg, 1)) {
+            verdict = -3;
+            goto out;
+        }
+    }
+
+    {
+        pthread_t tids[64];
+        int started = 0;
+        for (int i = 0; i < n_threads; i++) {
+            if (pthread_create(&tids[i], NULL, par_worker, P) != 0)
+                break;
+            started++;
+        }
+        if (started == 0)
+            atomic_store(&P->decided, -3);
+        for (int i = 0; i < started; i++)
+            pthread_join(tids[i], NULL);
+        verdict = atomic_load(&P->decided); /* 0 = space exhausted */
+    }
+
+out:
+    *configs_explored = atomic_load(&P->explored);
+    *max_linearized = atomic_load(&P->maxlin);
+    /* diagnostic: deepest the shared work stack ever got */
+    *frontier_max = (int32_t)(P->q_peak > 0x7FFFFFFF
+                                  ? 0x7FFFFFFF : P->q_peak);
+    free(P->q.items);
+    for (int i = 0; i < PAR_STRIPES; i++) {
+        dom_free(&P->sets[i]);
+        pthread_mutex_destroy(&P->mus[i]);
+    }
+    pthread_mutex_destroy(&P->wit_mu);
+    pthread_mutex_destroy(&P->qmu);
+    free(P);
     return verdict;
 }
 

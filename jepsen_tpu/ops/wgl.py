@@ -1385,8 +1385,10 @@ def check_encoded_competition(enc: EncodedHistory,
 
     def native_side():
         try:
+            strategy, n_thr = wgl_c.parallel_policy()
             nat = wgl_c.check_encoded_native(
-                enc, max_configs=native_max_configs, cancel=cancel)
+                enc, max_configs=native_max_configs, cancel=cancel,
+                strategy=strategy, n_threads=n_thr)
         except Exception:  # noqa: BLE001 - the race must survive a loser
             nat = None
         if nat is not None:
@@ -1492,7 +1494,19 @@ def check_history(
         # 2.5 * 57 B * budget/0.75 at exhaustion (~3 GB at the 10k-op
         # default), and the budget trips before further growth.
         budget = 1_000_000 + 2_000 * enc.n
-        nat = wgl_c.check_encoded_native(enc, max_configs=budget)
+        # Two-phase dispatch: valid histories decide in ~op_count
+        # configs, so a cheap sequential probe catches them at full
+        # speed; a probe-budget trip means invalid-suspect (a
+        # refutation must COVER the reachable space, and coverage
+        # parallelizes) — rerun with the parallel DFS when this host
+        # has cores to fan over.
+        quick = min(budget, 200_000 + 20 * enc.n)
+        nat = wgl_c.check_encoded_native(enc, max_configs=quick)
+        if nat is not None and nat["valid"] == "unknown":
+            strategy, n_thr = wgl_c.parallel_policy()
+            nat = wgl_c.check_encoded_native(
+                enc, max_configs=budget, strategy=strategy,
+                n_threads=n_thr)
         if nat is not None and nat["valid"] != "unknown":
             nat["backend"] = "native"
             return nat

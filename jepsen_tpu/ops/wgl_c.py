@@ -12,6 +12,7 @@ compiler exists — callers then use the pure-python oracle.
 from __future__ import annotations
 
 import ctypes
+import os
 import time as _time
 from typing import Optional
 
@@ -51,13 +52,26 @@ def _model_id(model: Model):
     return None, None
 
 
+def parallel_policy() -> tuple[str, int]:
+    """The ONE place the parallel-dispatch policy lives: (strategy,
+    n_threads) for a full-budget search on this host — the fanned DFS
+    when there are cores to fan over, the sequential engine otherwise
+    (thread+lock overhead only costs on small hosts)."""
+    n_thr = min(8, os.cpu_count() or 1)
+    return ("dfs-par" if n_thr >= 4 else "dfs"), n_thr
+
+
 def check_encoded_native(
     enc: EncodedHistory, max_configs: int = 50_000_000,
     strategy: str = "dfs", cancel: Optional["ctypes.c_int32"] = None,
+    n_threads: Optional[int] = None,
 ) -> Optional[dict]:
     """Decide linearizability in the C engine; None when unsupported.
     ``strategy``: "dfs" (memoized depth-first — near-linear on valid
-    histories) or "bfs" (level-synchronous, the device kernel's shape).
+    histories), "dfs-par" (the same search fanned over ``n_threads``
+    workers sharing a striped dominance memo — refutations must cover
+    the whole reachable space, and the coverage parallelizes), or
+    "bfs" (level-synchronous, the device kernel's shape).
     ``cancel``: a ctypes.c_int32 the DFS polls — setting it nonzero
     from another thread makes the search return "unknown" promptly
     (the competition race's loser cancellation)."""
@@ -99,16 +113,22 @@ def check_encoded_native(
         mid, param, max_configs,
         ctypes.byref(explored), ctypes.byref(fmax), ctypes.byref(maxlin),
     )
-    if strategy == "dfs":
+    if strategy in ("dfs", "dfs-par"):
         # Deepest-config capture: the refutation witness (reference
         # renders these as linear.svg, checker.clj:202-209).
         stride = int(lib.wgl_witness_stride())
         wit_cap = 5
         wit_buf = np.zeros(wit_cap * stride, dtype=np.int32)
         wit_len = ctypes.c_int32(0)
-        verdict = lib.wgl_check_dfs(
-            *common, p(wit_buf), wit_cap, ctypes.byref(wit_len),
-            ctypes.byref(cancel) if cancel is not None else None)
+        wit_args = (p(wit_buf), wit_cap, ctypes.byref(wit_len),
+                    ctypes.byref(cancel) if cancel is not None else None)
+        if strategy == "dfs-par":
+            if n_threads is None:
+                n_threads = min(8, os.cpu_count() or 1)
+            verdict = lib.wgl_check_dfs_par(*common, *wit_args,
+                                            int(n_threads))
+        else:
+            verdict = lib.wgl_check_dfs(*common, *wit_args)
     else:
         wit_buf = None
         verdict = lib.wgl_check(*common)

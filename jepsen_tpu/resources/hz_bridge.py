@@ -34,6 +34,28 @@ except ImportError:  # surfaced at startup, not per-request
 LOCK_TIMEOUT_S = 5.0
 
 
+class _MapLock:
+    """AP pessimistic lock over an IMap key (map.lock/unlock) with a
+    fence counter riding the map value — the non-CP lock shape the
+    lock-no-quorum workload exercises. FencedLock API compatible for
+    the bridge's purposes."""
+
+    def __init__(self, imap, key: str):
+        self.imap = imap
+        self.key = key
+
+    def try_lock_and_get_fence(self, timeout: float):
+        if not self.imap.try_lock(self.key, lease_time=None,
+                                  timeout=timeout):
+            return 0
+        fence = (self.imap.get(self.key) or 0) + 1
+        self.imap.put(self.key, fence)
+        return fence
+
+    def unlock(self):
+        self.imap.unlock(self.key)
+
+
 class Bridge(socketserver.ThreadingTCPServer):
     allow_reuse_address = True
     daemon_threads = True
@@ -52,9 +74,21 @@ class Bridge(socketserver.ThreadingTCPServer):
         self.ids: dict = {}
 
     def lock(self, name):
+        # The reference's lock-no-quorum scenario (hazelcast.clj:
+        # 676-683) configured a 3.x ILock without a quorum rule; 3.x
+        # locks and their XML are gone in 5.x, so the honest modern
+        # translation is structural: names ending ".no-quorum" get an
+        # AP map-based lock (keeps serving in minority partitions —
+        # the misconfiguration under test) while everything else gets
+        # the CP-subsystem FencedLock (Raft, majority by construction).
         with self.guard:
             if name not in self.locks:
-                self.locks[name] = self.cp.get_lock(name).blocking()
+                if name.endswith(".no-quorum"):
+                    self.locks[name] = _MapLock(
+                        self.client.get_map("jepsen-ap-locks").blocking(),
+                        name)
+                else:
+                    self.locks[name] = self.cp.get_lock(name).blocking()
             return self.locks[name]
 
     def sem(self, name):

@@ -104,8 +104,14 @@ class DisqueDB(jdb.DB, jdb.Process, jdb.LogFiles):
         core.synchronize(test)
         primary = test["nodes"][0]
         if node != primary:
+            # CLUSTER MEET takes a literal IP (redis-3.x cluster code);
+            # resolve the primary's name ON THE NODE, like the
+            # reference's (net/ip) (disque.clj:100-103).
             out = c.exec_star(
-                f"{CONTROL} -p {PORT} cluster meet {primary} {PORT}")
+                f"ip=$(getent ahostsv4 {primary} | head -1 | "
+                "awk '{print $1}'); "
+                f"{CONTROL} -p {PORT} cluster meet "
+                f"${{ip:-{primary}}} {PORT}")
             if "OK" not in out:
                 raise RuntimeError(f"cluster meet failed: {out!r}")
 

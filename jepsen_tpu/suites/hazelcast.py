@@ -221,17 +221,6 @@ class HazelcastDB(jdb.DB, jdb.Process, jdb.LogFiles):
         <cp-member-count>{cp_count}</cp-member-count>
         <group-size>{group}</group-size>
     </cp-subsystem>
-    <!-- Split-brain protection: every jepsen.lock* structure requires
-         a majority EXCEPT jepsen.lock.no-quorum — the deliberately
-         exempted lock the lock-no-quorum workload exercises
-         (hazelcast.clj:676-683's server config). -->
-    <split-brain-protection name="majority" enabled="true">
-        <minimum-cluster-size>{len(nodes) // 2 + 1}</minimum-cluster-size>
-    </split-brain-protection>
-    <lock name="jepsen.lock">
-        <split-brain-protection-ref>majority</split-brain-protection-ref>
-    </lock>
-    <lock name="jepsen.lock.no-quorum"/>
 </hazelcast>
 """
         with c.su():
@@ -317,9 +306,10 @@ def lock_workload(opts: Optional[dict] = None) -> dict:
 
 def lock_no_quorum_workload(opts: Optional[dict] = None) -> dict:
     """hazelcast.clj:676-683's :lock-no-quorum: the same mutex workload
-    against the lock the server config exempts from split-brain
-    protection ("jepsen.lock.no-quorum") — the misconfiguration the
-    reference demonstrates losing linearizability under partitions."""
+    against "jepsen.lock.no-quorum", which the node bridge serves as an
+    AP map-based lock instead of a CP FencedLock (resources/
+    hz_bridge.py) — the 3.x quorum-exempt ILock's honest 5.x
+    translation, expected to lose linearizability under partitions."""
     return lock_workload({**(opts or {}),
                           "lock-name": "jepsen.lock.no-quorum"})
 

@@ -11,9 +11,10 @@ import pytest
 @pytest.mark.perf
 def test_interpreter_throughput_floor():
     """Scheduler throughput with a zero-latency client (the measured
-    quantity in bench.py); the floor is half the reference's >20k ops/s
-    claim (generator.clj:67-70) to absorb CI-machine variance — the
-    steady-state number on a quiet machine is ~23k."""
+    quantity in bench.py); the floor matches the reference's >20k ops/s
+    JVM claim (generator.clj:67-70) outright — after the SimpleQueue /
+    restrict-memo / switch-interval work the quiet-machine steady state
+    is ~2x it, which is the variance headroom."""
     from jepsen_tpu import generator as gen
     from jepsen_tpu import nemesis as jnem
     from jepsen_tpu.generator import interpreter as jinterp
@@ -37,7 +38,7 @@ def test_interpreter_throughput_floor():
             dt = time.perf_counter() - t0
         ok = sum(1 for op in h if op.get("type") == "ok")
         best = max(best, ok / dt)
-    assert best > 10000, f"{best:.0f} ops/s"
+    assert best > 20000, f"{best:.0f} ops/s"
 
 
 @pytest.mark.perf
