@@ -70,6 +70,12 @@ INT32_MAX = np.int32(2**31 - 1)
 # north-star decision).
 F_SCHEDULE = (16, 128, 1024, 2048, 4096, 8192, 32768)
 
+# Sliding-window table budget (bytes): above this the kernel keeps the
+# [F, W] element-gather formulation instead of materializing the
+# ND x W x 8 row table (a 1M-op history at W=1024 would be 16 GB — the
+# whole chip; the vmapped batch kernel pays one table per member).
+WINTAB_MAX_BYTES = 128 * 1024 * 1024
+
 # Expansions larger than this use the two-stage compaction: a fused
 # (validity, iota) single-key sort over the full expansion, then one
 # row-gather into a STAGE1_P_MULT*F buffer for the multi-key dedup sort.
@@ -115,7 +121,7 @@ def _enable_compile_cache() -> None:
 @functools.lru_cache(maxsize=64)
 def _build_kernel(model_key, F: int, W: int, KO: int, S: int, ND: int, NO: int,
                   axis_name: Optional[str] = None, n_shards: int = 1,
-                  B: Optional[int] = None):
+                  B: Optional[int] = None, wintab_ok: bool = True):
     """Returns a jitted BFS driver with static shapes.
 
     model_key = (model-class, cache signature) — step_jax must be a pure
@@ -250,21 +256,47 @@ def _build_kernel(model_key, F: int, W: int, KO: int, S: int, ND: int, NO: int,
             a1O_row = jnp.broadcast_to(a1O[oc][None, :], (F, OB))
             a2O_row = jnp.broadcast_to(a2O[oc][None, :], (F, OB))
 
+        # Sliding-window table, materialized ONCE per call on device:
+        # winTab[r] = tabD[r : r + W] flattened. TPU gather cost follows
+        # INDEX COUNT far more than payload bytes (calibration note
+        # above), so trading the per-level [F, W] element gather
+        # (F*W indices) for one [F]-row gather of 16*W-byte rows cuts
+        # the kernel's largest op ~4x (measured: 1.83 -> 1.43 ms/level
+        # at F=8192, W=64). The build is itself one [ND, W] gather, paid
+        # once; HBM cost is ND * W * 8 lanes (16 MB at int16,
+        # ND=16384, W=64) — W-fold over tabD, so long histories / wide
+        # windows (and the vmapped batch kernel, which pays one table
+        # PER member) fall back to the element-gather formulation
+        # rather than risk RESOURCE_EXHAUSTED.
+        use_wintab = wintab_ok and ND * W * 8 * 2 <= WINTAB_MAX_BYTES
+        if use_wintab:
+            wrows = jnp.minimum(
+                jnp.arange(ND, dtype=jnp.int32)[:, None] + slots[None, :],
+                ND - 1)
+            winTab = tabD[wrows].reshape(ND, W * 8)
+
         def level(carry):
             p, mD, mO, st, valid, lvl, acc, ovf, fmax = carry
 
             rows = p[:, None] + slots[None, :]  # [F, W]
             in_rng = rows < nD
-            rc = jnp.minimum(rows, ND - 1)
-            # The level's single dynamic gather; int16 tables (when
-            # every value fits) halve its bytes, and columns are widened
-            # to int32 LAZILY per consumer so the converts fuse into the
-            # consuming wheres (a whole-block astype materialized a
-            # ~0.6 ms/level conversion at F=8192). NOTE: a slice-gather
-            # formulation (slice_sizes=(W, 8), one start per config)
-            # measured CATASTROPHICALLY worse — XLA lowered it to a
-            # serial per-config dynamic-slice loop (~12 ms/level).
-            win = tabD[rc]  # [F, W, 8] int16|int32
+            # ONE [F]-row gather of the sliding-window table (or the
+            # [F, W] element gather when the table would be too big);
+            # int16 tables (when every value fits) halve its bytes, and
+            # columns are widened to int32 LAZILY per consumer so the
+            # converts fuse into the consuming wheres (a whole-block
+            # astype materialized a ~0.6 ms/level conversion at
+            # F=8192). NOTE: a slice-gather formulation
+            # (slice_sizes=(W, 8), one start per config) measured
+            # CATASTROPHICALLY worse — XLA lowered it to a serial
+            # per-config dynamic-slice loop (~12 ms/level); the
+            # element-gather formulation tabD[min(rows, ND-1)] measured
+            # ~0.9 ms/level at F=8192 vs ~0.55 ms for the row gather.
+            if use_wintab:
+                pc = jnp.minimum(p, ND - 1)
+                win = winTab[pc].reshape(F, W, 8)  # int16|int32
+            else:
+                win = tabD[jnp.minimum(rows, ND - 1)]  # [F, W, 8]
             invw = jnp.where(in_rng, win[..., 0].astype(jnp.int32),
                              INT32_MAX)
             retw = jnp.where(in_rng, win[..., 1].astype(jnp.int32),
@@ -608,7 +640,21 @@ def _build_kernel(model_key, F: int, W: int, KO: int, S: int, ND: int, NO: int,
             jnp.asarray(False),
             jnp.int32(1),
         )
-        out = lax.while_loop(cond, level, init)
+        # Two levels per loop iteration: halves the while_loop's fixed
+        # per-iteration overhead (dispatch + cond evaluation). The
+        # second application is SELECTED AWAY when the first one ended
+        # the search (accept/overflow/exhaustion/level budget) — the
+        # loop must stop exactly where a 1x body would, or chunk
+        # budgets overshoot and the stuck-config capture reads an
+        # already-emptied frontier.
+        def body2(c):
+            c1 = level(c)
+            go = cond(c1)
+            c2 = level(c1)
+            return tuple(
+                jnp.where(go, x2, x1) for x2, x1 in zip(c2, c1))
+
+        out = lax.while_loop(cond, body2, init)
         p, mD, mO, st, valid, lvl, acc, ovf, fmax = out
         nonempty = jnp.any(valid)
         count = jnp.sum(valid.astype(jnp.int32))
@@ -642,18 +688,23 @@ def _build_batch_kernel(model_key, F: int, W: int, KO: int, S: int, ND: int,
     import jax
 
     # jit retraces per input dtype, so int16 vs int32 tables need no
-    # separate build.
-    raw, _ = _build_kernel(model_key, F, W, KO, S, ND, NO, B=B)
+    # separate build. The sliding-window table is disabled under vmap:
+    # it would materialize once PER BATCH MEMBER.
+    raw, _ = _build_kernel(model_key, F, W, KO, S, ND, NO, B=B,
+                           wintab_ok=False)
     return jax.jit(jax.vmap(raw))
 
 
-def _levels_per_call(M: int, target_s: float = 3.0) -> int:
+def _levels_per_call(M: int, target_s: float = 5.0) -> int:
     """Bound single-program wall time: the TPU runtime (and the relay in
     front of it) kills long-running programs, which is what crashed the
-    worker on long histories. Empirical per-level cost ≈ 0.35 ms fixed
-    (window gather) + 12 ns × M (sorts + streaming over the expansion)."""
-    est = 3.5e-4 + 1.2e-8 * M
-    return max(8, min(4096, int(target_s / est)))
+    worker on long histories. Empirical per-level cost ≈ 0.2 ms fixed
+    (row gather + loop overhead at the 2x unroll) + 9 ns × M (sorts +
+    streaming over the expansion); each chunk boundary costs a relay
+    round trip, so the target leans long while staying well under the
+    relay's patience."""
+    est = 2.0e-4 + 9.0e-9 * M
+    return max(8, min(8192, int(target_s / est)))
 
 
 # ---------------------------------------------------------------------------

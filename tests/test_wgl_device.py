@@ -94,6 +94,37 @@ def test_many_open_ops_returns_unknown():
 
 
 class TestTwoStageCompaction:
+    def test_wintab_fallback_matches_host(self, monkeypatch):
+        """Shrink the sliding-window-table budget so the kernel takes
+        the element-gather fallback, and check differential agreement
+        (the guard that keeps 1M-op histories from materializing a
+        chip-sized table)."""
+        import random
+
+        from jepsen_tpu.models import CasRegister
+        from jepsen_tpu.ops import wgl, wgl_host
+        from jepsen_tpu.ops.encode import encode_history
+        from jepsen_tpu.testing import perturb_history, random_register_history
+
+        monkeypatch.setattr(wgl, "WINTAB_MAX_BYTES", 0)
+        wgl._build_kernel.cache_clear()
+        try:
+            model = CasRegister(init=0)
+            rng = random.Random(23)
+            for i in range(6):
+                h = random_register_history(
+                    rng, n_ops=30, n_procs=4, cas=True, crash_p=0.05)
+                if i % 2:
+                    h = perturb_history(rng, h)
+                dev = wgl.check_encoded_device(
+                    encode_history(model, h), f_schedule=(16, 64))
+                host = wgl_host.check_history_host(model, h)
+                if dev["valid"] == "unknown":
+                    continue
+                assert dev["valid"] == host["valid"], (i, dev, host)
+        finally:
+            wgl._build_kernel.cache_clear()
+
     def test_two_stage_matches_host(self, monkeypatch):
         """Force the big-M pre-compaction path on tiny shapes and check
         differential agreement with the host oracle."""
