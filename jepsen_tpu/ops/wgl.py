@@ -268,7 +268,10 @@ def _build_kernel(model_key, F: int, W: int, KO: int, S: int, ND: int, NO: int,
         # windows (and the vmapped batch kernel, which pays one table
         # PER member) fall back to the element-gather formulation
         # rather than risk RESOURCE_EXHAUSTED.
-        use_wintab = wintab_ok and ND * W * 8 * 2 <= WINTAB_MAX_BYTES
+        # Budgeted at 4-byte lanes: the dtype is a runtime property
+        # (int16 when values fit, int32 otherwise) while this bool is
+        # fixed at trace time, so the guard assumes the wide case.
+        use_wintab = wintab_ok and ND * W * 8 * 4 <= WINTAB_MAX_BYTES
         if use_wintab:
             wrows = jnp.minimum(
                 jnp.arange(ND, dtype=jnp.int32)[:, None] + slots[None, :],

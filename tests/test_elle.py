@@ -413,6 +413,126 @@ class TestMonotonicKeyCheck:
         assert res["realtime_unavailable"] is True
 
 
+class TestStrictSerFuzz:
+    """Cross-engine soundness fuzz: for histories of SINGLE-micro-op
+    txns over independent register keys, strict serializability
+    coincides with per-key linearizability — so every anomaly the elle
+    wr checker reports with the realtime graph composed must be
+    confirmed by the WGL linearizability engine. (The converse need
+    not hold: elle's version-order inference is deliberately
+    conservative.)"""
+
+    @staticmethod
+    def _gen(rng, n_steps=30, n_keys=2, n_procs=4):
+        """A valid concurrent execution: unique writes, reads served at
+        linearization points, occasional overlapping op pairs."""
+        from jepsen_tpu.history import History, Op
+
+        regs: dict = {}
+        next_v = [100]
+        rows = []  # (type, proc, mops)
+        free = list(range(n_procs))
+        for _ in range(n_steps):
+            rng.shuffle(free)
+            group = free[:rng.choice([1, 1, 2])]
+            invs = []
+            for proc in group:
+                k = rng.randrange(n_keys)
+                if rng.random() < 0.5:
+                    v = next_v[0]
+                    next_v[0] += 1
+                    mop = ["w", k, v]
+                else:
+                    mop = ["r", k, None]
+                rows.append(("invoke", proc, [mop]))
+                invs.append((proc, mop))
+            rng.shuffle(invs)
+            for proc, mop in invs:  # linearize in shuffled order
+                if mop[0] == "w":
+                    regs[mop[1]] = mop[2]
+                    rows.append(("ok", proc, [mop]))
+                else:
+                    rows.append(("ok", proc,
+                                 [["r", mop[1], regs.get(mop[1])]]))
+        return History([
+            Op(typ, proc, "txn", mops, time=i * 1_000_000)
+            for i, (typ, proc, mops) in enumerate(rows)
+        ])
+
+    @staticmethod
+    def _perturb(rng, h):
+        """Swap one ok read's value for another value written to the
+        same key (or the initial None) — usually a strict-ser break."""
+        from jepsen_tpu.history import History
+
+        ops = list(h)
+        written: dict = {}
+        for op in ops:
+            if op.type == "ok":
+                f, k, v = op.value[0]
+                if f == "w":
+                    written.setdefault(k, []).append(v)
+        reads = [i for i, op in enumerate(ops)
+                 if op.type == "ok" and op.value[0][0] == "r"]
+        if not reads:
+            return None
+        i = rng.choice(reads)
+        _f, k, cur = ops[i].value[0]
+        pool = [v for v in written.get(k, []) if v != cur] + (
+            [None] if cur is not None else [])
+        if not pool:
+            return None
+        ops[i] = ops[i].with_(value=[["r", k, rng.choice(pool)]])
+        return History(ops, reindex=False)
+
+    @staticmethod
+    def _wgl_valid(h) -> bool:
+        """Per-key linearizability through the WGL engine (keys are
+        independent registers)."""
+        from jepsen_tpu.history import History
+        from jepsen_tpu.models import CasRegister
+        from jepsen_tpu.ops import wgl
+
+        keys = sorted({op.value[0][1] for op in h})
+        for k in keys:
+            ops = []
+            for op in h:
+                f, kk, v = op.value[0]
+                if kk != k:
+                    continue
+                ops.append(op.with_(
+                    f="write" if f == "w" else "read", value=v))
+            res = wgl.check_history(
+                CasRegister(init=None), History(ops, reindex=False),
+                backend="native")
+            if res["valid"] is False:
+                return False
+            assert res["valid"] is True, res
+        return True
+
+    def test_realtime_verdicts_sound(self):
+        flagged = 0
+        for seed in range(40):
+            rng = random.Random(1000 + seed)
+            h = self._gen(rng)
+            res = ew.check(h, linearizable_keys=True,
+                           additional_graphs=["realtime"])
+            assert res["valid"] is True, (seed, res)
+            bad = self._perturb(rng, h)
+            if bad is None:
+                continue
+            bres = ew.check(bad, linearizable_keys=True,
+                            additional_graphs=["realtime"])
+            if bres["valid"] is False:
+                flagged += 1
+                # The heart of the fuzz: an elle+realtime anomaly must
+                # be a REAL strict-ser (== per-key linearizability)
+                # violation.
+                assert self._wgl_valid(bad) is False, (
+                    seed, bres["anomaly_types"])
+        assert flagged >= 10, f"only {flagged} perturbations flagged"
+
+
 class TestGeneratedHistories:
     def test_serializable_simulation_clean(self):
         """Apply random append txns against an in-memory serial store —
