@@ -1307,9 +1307,12 @@ def _device_search(enc: EncodedHistory, plan: DevicePlan, schedule: list,
             # that's fine, escalation is lossless). The count rides the
             # packed flags vector — no extra device read. Kept rows are
             # compacted to the front, so the slice is lossless.
+            # Only worth it with a long horizon left: a late-history
+            # spike after a de-escalation costs two rung restarts
+            # (~1.5 s measured) to save milliseconds of small-F levels.
             attempt.setdefault("counts", []).append(count)
             F2 = pick_capacity(count)
-            if F2 < F:
+            if F2 < F and total_levels - lvl > 1000:
                 fr = tuple(
                     a[:F2] if np.ndim(a) >= 1 else a for a in fr[:-1]
                 ) + (fr[-1],)
