@@ -12,6 +12,10 @@ assumption (cycle/wr.clj:20-30):
   order from per-process write order, merged by observation order.
   (Implemented as: realtime per-process chains; cross-process order only
   via reads — conservative.)
+- ``wfr_keys=True``: writes follow reads within a txn — a txn that
+  externally reads k=v1 and writes k=v2 fixes v1 < v2, recovering
+  version orders with no realtime or session assumptions
+  (cycle/wr.clj:28-30).
 - default: only wr edges + the direct anomalies (G1a, G1b, internal) —
   what elle can infer with no assumptions.
 """
@@ -35,9 +39,15 @@ def _ret_index(op):
 
 def check(history, anomalies: Iterable[str] = DEFAULT_ANOMALIES,
           linearizable_keys: bool = False, sequential_keys: bool = False,
-          device: Optional[bool] = None,
+          wfr_keys: bool = False, device: Optional[bool] = None,
           additional_graphs: Iterable[str] = ()) -> dict:
     """Check a read/write-register history.
+
+    ``wfr_keys`` is the reference's :wfr-keys? (cycle/wr.clj:28-30):
+    assume writes follow reads within a transaction, so a txn that
+    externally reads k=v1 and writes k=v2 fixes v1 < v2 in k's version
+    order — ww/rw edges recoverable with no realtime or session
+    assumptions at all.
 
     ``additional_graphs`` composes extra precedence orders into the
     cycle search (cycle/wr.clj:17-19's :additional-graphs): "realtime"
@@ -105,34 +115,45 @@ def check(history, anomalies: Iterable[str] = DEFAULT_ANOMALIES,
         if extra or linearizable_keys or sequential_keys else None
     )
 
-    if linearizable_keys or sequential_keys:
+    if linearizable_keys or sequential_keys or wfr_keys:
         # Version order per key. Ordering two writes by raw ok-completion
         # order is UNSOUND for concurrent txns (either order is legal), so
         # an edge w1 -> w2 is added only when the order is forced:
         # - same process: program order (the sequential_keys assumption);
         # - linearizable_keys: true realtime precedence — w1's completion
         #   strictly before w2's invocation, when invocation indexes are
-        #   recoverable from a full (paired) history.
+        #   recoverable from a full (paired) history;
+        # - wfr_keys: a txn's external read of k precedes its own write
+        #   of k in the version order (cycle/wr.clj:28-30).
         writes_by_key: dict = {}
         for i, op in enumerate(oks):
             for k, v in ext_writes(_value(op) or []).items():
                 writes_by_key.setdefault(k, []).append((i, v))
         for k, ws in writes_by_key.items():
             chains: list[tuple[int, int]] = []
-            for a in range(len(ws)):
-                for b in range(a + 1, len(ws)):
-                    i1, _v1 = ws[a]
-                    i2, _v2 = ws[b]
-                    if i1 == i2:
+            if linearizable_keys or sequential_keys:
+                for a in range(len(ws)):
+                    for b in range(a + 1, len(ws)):
+                        i1, _v1 = ws[a]
+                        i2, _v2 = ws[b]
+                        if i1 == i2:
+                            continue
+                        if _proc(oks[i1]) == _proc(oks[i2]):
+                            chains.append((i1, i2))
+                        elif (
+                            linearizable_keys
+                            and intervals is not None
+                            and _ret_index(oks[i1])
+                            < intervals.get(id(oks[i2]), (-1, -1))[0]
+                        ):
+                            chains.append((i1, i2))
+            if wfr_keys:
+                for i2, _v2 in ws:
+                    r = ext_reads(_value(oks[i2]) or []).get(k)
+                    if r is None:
                         continue
-                    if _proc(oks[i1]) == _proc(oks[i2]):
-                        chains.append((i1, i2))
-                    elif (
-                        linearizable_keys
-                        and intervals is not None
-                        and _ret_index(oks[i1])
-                        < intervals.get(id(oks[i2]), (-1, -1))[0]
-                    ):
+                    i1 = author.get((k, r))
+                    if i1 is not None and i1 != i2:
                         chains.append((i1, i2))
             for i1, i2 in chains:
                 g.add(i1, i2, WW)

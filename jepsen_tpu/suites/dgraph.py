@@ -685,8 +685,9 @@ def wr_workload(opts: Optional[dict] = None) -> dict:
         "min_txn_length": 2,
         "max_txn_length": 4,
         "max_writes_per_key": 16,
-        # wr.clj:22-31: sequential version orders + the realtime graph
-        # (dgraph claims linearizability) — strict serializability.
+        # wr.clj:22-31: wfr + sequential version orders + the realtime
+        # graph (dgraph claims linearizability) — strict serializability.
+        "wfr_keys": True,
         "sequential_keys": True,
         "additional_graphs": ["realtime"],
         "anomalies": ["G0", "G1c", "G-single", "G1a", "G1b", "internal"],

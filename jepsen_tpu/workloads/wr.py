@@ -24,6 +24,7 @@ def checker(opts: Optional[dict] = None) -> Checker:
             anomalies=anomalies,
             linearizable_keys=o.get("linearizable_keys", False),
             sequential_keys=o.get("sequential_keys", False),
+            wfr_keys=o.get("wfr_keys", False),
             device=o.get("device"),
             additional_graphs=o.get("additional_graphs", ()),
         )

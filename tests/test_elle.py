@@ -201,6 +201,23 @@ class TestWrAnomalies:
         res = ew.check(h)
         assert "G1c" in res["anomaly_types"]
 
+    def test_write_skew_with_wfr_keys(self):
+        # Writes-follow-reads alone recovers the version orders: each
+        # txn reads v1 and writes v2 of the same key, so v1 < v2 — the
+        # two cross rw edges close a G2 with NO realtime or session
+        # assumptions (cycle/wr.clj:28-30).
+        h = [
+            T([["w", "x", 1], ["w", "y", 1]]),
+            T([["r", "x", 1], ["w", "x", 2], ["r", "y", 1]]),
+            T([["r", "y", 1], ["w", "y", 2], ["r", "x", 1]]),
+        ]
+        res = ew.check(h, wfr_keys=True)
+        assert res["valid"] is False
+        assert "G2" in res["anomaly_types"] \
+            or "G-single" in res["anomaly_types"]
+        # Without the assumption the version orders are unknowable.
+        assert ew.check(h)["valid"] is True
+
     def test_write_skew_with_linearizable_keys(self):
         # t0 reads x's initial write, writes y; t1 reads y's initial
         # write, writes x — two rw edges under per-key realtime order.
