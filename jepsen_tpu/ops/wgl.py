@@ -1223,6 +1223,8 @@ def _device_search(enc: EncodedHistory, plan: DevicePlan, schedule: list,
     import jax as _jax
 
     dev_args = tuple(_jax.device_put(a) for a in plan.args)
+    rung_entry = int(fr[-1])  # level at which the current rung started
+    deesc_from = None  # capacity last de-escalated FROM (known adequate)
     while True:
         _, kern = _build_kernel(mk, F, W, KO, S, ND, NO, B=plan.B)
         if fr[0].shape[0] < F:
@@ -1298,8 +1300,23 @@ def _device_search(enc: EncodedHistory, plan: DevicePlan, schedule: list,
         if ovf and not lossy:
             # Escalate, resuming losslessly from the kept frontier. (At the
             # top capacity the kernel already continued past the overflow
-            # as a greedy beam.)
-            F = schedule[schedule.index(F) + 1]
+            # as a greedy beam.) A rung that overflowed almost
+            # immediately under-called the frontier badly: skip an
+            # extra rung rather than pay another restart (each costs a
+            # dispatch + relay round trip, ~0.5 s measured) — adaptive,
+            # so low-concurrency histories that never overflow keep
+            # running at the tiny capacities.
+            idx = schedule.index(F)
+            step = 2 if lvl - rung_entry < 64 else 1
+            nxt = schedule[min(idx + step, len(schedule) - 1)]
+            if deesc_from is not None and F < deesc_from:
+                # Re-overflow after a de-escalation: climb back to the
+                # capacity that was adequate before it, never past.
+                nxt = min(nxt, deesc_from)
+                if nxt >= deesc_from:
+                    deesc_from = None
+            F = nxt
+            rung_entry = lvl
         else:
             # De-escalate when the frontier has shrunk: resume at the
             # smallest adequate capacity (never below the last overflow's
@@ -1313,10 +1330,12 @@ def _device_search(enc: EncodedHistory, plan: DevicePlan, schedule: list,
             attempt.setdefault("counts", []).append(count)
             F2 = pick_capacity(count)
             if F2 < F and total_levels - lvl > 1000:
+                deesc_from = F
                 fr = tuple(
                     a[:F2] if np.ndim(a) >= 1 else a for a in fr[:-1]
                 ) + (fr[-1],)
                 F = F2
+                rung_entry = lvl
 
 
 # Open-set word count of the native engine's witness encoding (must
