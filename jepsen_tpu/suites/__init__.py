@@ -24,9 +24,10 @@ same shape on this framework's protocols. Roster (→ reference suite):
 - ``yugabyte``   — the dual-API matrix: 7 ycql workloads over ycqlsh +
   10 ysql workloads over ysqlsh × fault sets + test-all sweep
   (yugabyte/core.clj:73-103)
-- ``mongodb``    — replica-set document-cas with linearizable reads;
-  --storage-engine rocksdb covers mongodb-rocks (mongodb-smartos/,
-  mongodb-rocks/; SmartOS provisioning lives in os_/smartos.py)
+- ``mongodb``    — replica-set document-cas with linearizable reads +
+  the two-phase-commit bank (transfer.clj); --storage-engine rocksdb
+  covers mongodb-rocks (mongodb-smartos/, mongodb-rocks/; SmartOS
+  provisioning lives in os_/smartos.py)
 - ``hazelcast``  — CP-subsystem fenced-lock/semaphore/id-gen through a
   node-side bridge daemon, mutex-model checking on device (hazelcast/)
 - ``ignite``     — REST cas register + incr counter (ignite/)

@@ -31,6 +31,18 @@ COLL = "cas"
 WC = "{w: 'majority', wtimeout: 5000}"
 
 
+def _mongo_eval(test, node, script: str) -> str:
+    """One mongosh --eval round trip on ``node`` (both clients' shared
+    transport)."""
+
+    def run(t, n):
+        return c.exec_star(
+            f"mongosh --quiet --eval {c.escape(script)} "
+            f"{c.escape(DB)}")
+
+    return c.on_nodes(test, run, [node])[node]
+
+
 class MongoClient(jclient.Client):
     """Keyed CAS register over one document per key:
     ``{_id: <key>, v: <int>}``."""
@@ -42,12 +54,7 @@ class MongoClient(jclient.Client):
         return MongoClient(node)
 
     def _eval(self, test, script: str) -> str:
-        def run(t, node):
-            return c.exec_star(
-                f"mongosh --quiet --eval {c.escape(script)} "
-                f"{c.escape(DB)}")
-
-        return c.on_nodes(test, run, [self.node])[self.node]
+        return _mongo_eval(test, self.node, script)
 
     def invoke(self, test, op):
         kv = op["value"]
@@ -151,12 +158,122 @@ def register_workload(opts: Optional[dict] = None) -> dict:
     return wl
 
 
+class MongoBankClient(jclient.Client):
+    """Bank transfers via MongoDB's documented two-phase-commit pattern
+    (mongodb_smartos/transfer.clj:43-180, following the "Perform
+    Two-Phase Commits" tutorial): a pending txn document, guarded $inc
+    debits/credits with pendingTransactions bookkeeping, then
+    applied/done state transitions — all five phases in ONE mongosh
+    eval. The pattern is NOT atomic under faults (that's the point of
+    the reference test): a mid-script crash leaves a pending txn, so
+    any error is :info, never :fail."""
+
+    def __init__(self, node: Any = None):
+        self.node = node
+
+    def open(self, test, node):
+        return MongoBankClient(node)
+
+    def _eval(self, test, script: str) -> str:
+        return _mongo_eval(test, self.node, script)
+
+    def setup(self, test):
+        # Idempotent per-account upserts, issued from the first node
+        # only: setup fans out to every node concurrently, and writes
+        # against non-primary members are rejected anyway (the same
+        # gating MongoDB.setup uses for rs.initiate).
+        nodes = test.get("nodes") or [self.node]
+        if self.node != nodes[0]:
+            return
+        from ..workloads import bank as wbank
+
+        stmts = "; ".join(
+            f"db.accounts.updateOne({{_id: {a}}}, "
+            f"{{$setOnInsert: {{balance: {b}, "
+            f"pendingTransactions: []}}}}, "
+            f"{{upsert: true, writeConcern: {WC}}})"
+            for a, b in wbank.initial_balances(test))
+        self._eval(test, stmts)
+
+    def invoke(self, test, op):
+        if op["f"] == "read":
+            out = self._eval(
+                test,
+                "r = db.runCommand({find: 'accounts', filter: {}, "
+                "readConcern: {level: 'majority'}}); "
+                "print(JSON.stringify(r.cursor.firstBatch))")
+            rows = json.loads(out.strip().split("\n")[-1])
+            return {**op, "type": "ok",
+                    "value": {int(r["_id"]): int(r["balance"])
+                              for r in rows}}
+        v = op["value"]
+        script = (
+            # p0: create the pending transaction document.
+            f"t = db.txns.insertOne({{state: 'pending', "
+            f"from: {v['from']}, to: {v['to']}, "
+            f"amount: {v['amount']}}}); "
+            f"tid = t.insertedId; "
+            # p1: mark it applying.
+            f"db.txns.updateOne({{_id: tid, state: 'pending'}}, "
+            f"{{$set: {{state: 'applying'}}}}); "
+            # p2: apply to both accounts, guarded against re-application.
+            f"db.accounts.updateOne({{_id: {v['from']}, "
+            f"pendingTransactions: {{$ne: tid}}}}, "
+            f"{{$inc: {{balance: -{v['amount']}}}, "
+            f"$push: {{pendingTransactions: tid}}}}, "
+            f"{{writeConcern: {WC}}}); "
+            f"db.accounts.updateOne({{_id: {v['to']}, "
+            f"pendingTransactions: {{$ne: tid}}}}, "
+            f"{{$inc: {{balance: {v['amount']}}}, "
+            f"$push: {{pendingTransactions: tid}}}}); "
+            # p3: mark applied.
+            f"db.txns.updateOne({{_id: tid, state: 'applying'}}, "
+            f"{{$set: {{state: 'applied'}}}}); "
+            # p4: clear bookkeeping and close out.
+            f"db.accounts.updateOne({{_id: {v['from']}}}, "
+            f"{{$pull: {{pendingTransactions: tid}}}}, "
+            f"{{writeConcern: {WC}}}); "
+            f"db.accounts.updateOne({{_id: {v['to']}}}, "
+            f"{{$pull: {{pendingTransactions: tid}}}}, "
+            f"{{writeConcern: {WC}}}); "
+            f"db.txns.updateOne({{_id: tid, state: 'applied'}}, "
+            f"{{$set: {{state: 'done'}}}}); "
+            f"print('DONE')"
+        )
+        try:
+            out = self._eval(test, script)
+        except c.RemoteError:
+            # Somewhere mid-pattern: the txn may be partially applied.
+            return {**op, "type": "info", "error": "two-phase-interrupted"}
+        if "DONE" not in out:
+            return {**op, "type": "info", "error": "two-phase-incomplete"}
+        return {**op, "type": "ok"}
+
+    def close(self, test):
+        pass
+
+
+def bank_workload(opts: Optional[dict] = None) -> dict:
+    """transfer.clj's bank: the two-phase-commit pattern offers no
+    balance guard (negatives are legal) and no atomicity for readers —
+    the conservation checker is what catches the pattern's windows."""
+    from ..workloads import bank as wbank
+
+    wl = wbank.test({**(opts or {}), "negative-balances?": True})
+    return {**wl, "client": MongoBankClient()}
+
+
+WORKLOADS = {"register": register_workload, "bank": bank_workload}
+
+
 def test_fn(opts: dict) -> dict:
-    wl = register_workload(opts)
+    name = opts.get("workload") or "register"
+    wl = WORKLOADS[name](opts)
     engine = opts.get("storage_engine")
+    label = "document-cas" if name == "register" else name
     return {
-        "name": ("mongodb-rocks-document-cas" if engine == "rocksdb"
-                 else "mongodb-document-cas"),
+        "name": (f"mongodb-rocks-{label}" if engine == "rocksdb"
+                 else f"mongodb-{label}"),
         "db": MongoDB(engine),
         "net": jnet.iptables(),
         "nemesis": jnemesis.partition_random_halves(),
@@ -166,6 +283,8 @@ def test_fn(opts: dict) -> dict:
 
 
 def _add_opts(p):
+    p.add_argument("--workload", choices=sorted(WORKLOADS),
+                   default="register")
     p.add_argument("--storage-engine", default=None,
                    help="e.g. rocksdb (the mongodb-rocks variant)")
 

@@ -45,7 +45,8 @@ def _workload_cases():
     map — the reference's big suites are big because of workload
     breadth, so each entry must satisfy the interpreter contract."""
     cases = []
-    for name in ("cockroachdb", "dgraph", "tidb", "yugabyte", "faunadb"):
+    for name in ("cockroachdb", "dgraph", "tidb", "yugabyte", "faunadb",
+                 "mongodb"):
         mod = importlib.import_module(f"jepsen_tpu.suites.{name}")
         for wl in sorted(getattr(mod, "WORKLOADS", {})):
             cases.append((name, wl))

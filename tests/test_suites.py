@@ -1130,6 +1130,61 @@ class TestMongoSuite:
         assert any("mongosh --quiet --eval" in cmd and
                    "readConcern: {level: " in cmd for cmd in cmds)
 
+    def test_bank_two_phase_commit(self):
+        from jepsen_tpu.suites import mongodb as mg
+
+        test = dict(noop_test())
+        test.update(nodes=["n1"], accounts=[0, 1], **{"total-amount": 20},
+                    **{"max-transfer": 5})
+        log: list = []
+        c.setup_sessions(test, c.dummy(log, responses={
+            r"db\.txns\.insertOne": "DONE\n",
+            r"find: .accounts.":
+            '[{"_id": 0, "balance": 7}, {"_id": 1, "balance": 13}]\n'}))
+        client = mg.MongoBankClient().open(test, "n1")
+        client.setup(test)
+        res = client.invoke(test, {"type": "invoke", "f": "transfer",
+                                   "value": {"from": 0, "to": 1,
+                                             "amount": 3}, "process": 0})
+        assert res["type"] == "ok"
+        res = client.invoke(test, {"type": "invoke", "f": "read",
+                                   "value": None, "process": 0})
+        assert res["type"] == "ok" and res["value"] == {0: 7, 1: 13}
+        cmds = [cmd for _n, cmd in log]
+        # The documented five-phase pattern, in one eval (shell escaping
+        # mangles quotes and $-operators, so match operator-free
+        # fragments).
+        assert any("db.txns.insertOne" in cmd
+                   and "pendingTransactions" in cmd
+                   and "balance: -3" in cmd
+                   and "applied" in cmd
+                   and "pull" in cmd
+                   for cmd in cmds)
+        # A mid-pattern failure is indeterminate, never a definite fail:
+        # both the incomplete-output branch...
+        c.setup_sessions(test, c.dummy(log, responses={
+            r"db\.txns\.insertOne": "connection lost"}))
+        client = mg.MongoBankClient().open(test, "n1")
+        res = client.invoke(test, {"type": "invoke", "f": "transfer",
+                                   "value": {"from": 0, "to": 1,
+                                             "amount": 3}, "process": 0})
+        assert res["type"] == "info"
+
+        # ...and the hard transport-error branch (the real mid-script
+        # crash shape).
+        def boom(host, action):
+            raise c.RemoteError({"cmd": action["cmd"], "host": host,
+                                 "exit": 1, "out": "", "err": "boom"})
+
+        c.setup_sessions(test, c.dummy(log, responses={
+            r"db\.txns\.insertOne": boom}))
+        client = mg.MongoBankClient().open(test, "n1")
+        res = client.invoke(test, {"type": "invoke", "f": "transfer",
+                                   "value": {"from": 0, "to": 1,
+                                             "amount": 3}, "process": 0})
+        assert res["type"] == "info" \
+            and res["error"] == "two-phase-interrupted"
+
 
 class TestAerospikeSuite:
     def test_json_groups(self):
