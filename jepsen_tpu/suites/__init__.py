@@ -13,11 +13,13 @@ same shape on this framework's protocols. Roster (→ reference suite):
 - ``cockroachdb``— full workload roster (register/bank/sets/monotonic/
   sequential/comments/g2/append) over `cockroach sql`, combined nemesis
   incl. clock skew (cockroachdb/)
-- ``postgres``   — psql serializable list-append (single-node shape)
+- ``postgres``   — psql serializable list-append + bank (postgres-rds's
+  bank-test; single-node shape)
 - ``stolon``     — HA Postgres: keeper/sentinel/proxy + own etcd store,
-  append through the proxy (stolon/)
-- ``mysql``      — dirty-reads on --flavor galera | percona | ndb
-  (galera/, percona/, mysql-cluster/)
+  append + the double-spend ledger (ledger.clj) through the proxy
+  (stolon/)
+- ``mysql``      — dirty-reads + bank + sets on --flavor galera |
+  percona | ndb (galera/, percona/, mysql-cluster/)
 - ``tidb``       — full workload roster (bank/append/register/set/
   long-fork/monotonic/sequential/txn) over the mysql CLI; monotonic
   uses the elle monotonic-key + realtime cycle analyzer (tidb/)
@@ -32,7 +34,8 @@ same shape on this framework's protocols. Roster (→ reference suite):
   node-side bridge daemon, mutex-model checking on device (hazelcast/)
 - ``ignite``     — REST cas register + incr counter (ignite/)
 - ``aerospike``  — aql set workload, pause-capable DB (aerospike/)
-- ``elasticsearch`` — set inserts under partitions (elasticsearch/)
+- ``elasticsearch`` — set inserts + the dirty-read probe
+  (elasticsearch/sets.clj, dirty_read.clj)
 - ``crate``      — dirty-read / lost-updates / _version divergence
   (crate/)
 - ``dgraph``     — full workload roster (upsert/set/bank/delete/

@@ -10,6 +10,7 @@ way Elasticsearch loses inserts.
 from __future__ import annotations
 
 import json
+import urllib.error
 import urllib.request
 from typing import Optional
 
@@ -109,7 +110,113 @@ class ElasticsearchDB(jdb.DB, jdb.Process, jdb.LogFiles):
         return [self.LOG]
 
 
-def test_fn(opts: dict) -> dict:
+class DirtyReadClient(jclient.Client, jclient.Reusable):
+    """elasticsearch/dirty_read.clj:32-104: writes index a doc per
+    value, reads GET it by id (can observe un-replicated state — the
+    dirty read under test), strong-reads refresh then search
+    everything."""
+
+    def __init__(self, base: Optional[str] = None, timeout: float = 10.0):
+        self.base = base
+        self.timeout = timeout
+
+    def open(self, test, node):
+        return DirtyReadClient(f"http://{node}:{PORT}", self.timeout)
+
+    def _req(self, method: str, path: str, body: Optional[dict] = None):
+        req = urllib.request.Request(
+            self.base + path,
+            data=None if body is None else json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"},
+            method=method)
+        with urllib.request.urlopen(req, timeout=self.timeout) as r:
+            return json.loads(r.read().decode() or "{}")
+
+    def invoke(self, test, op):
+        f = op["f"]
+        if f == "write":
+            self._req("PUT", f"/{INDEX}/_doc/{op['value']}",
+                      {"v": op["value"]})
+            return {**op, "type": "ok"}
+        if f == "read":
+            try:
+                res = self._req("GET", f"/{INDEX}/_doc/{op['value']}")
+            except urllib.error.HTTPError as e:
+                if e.code == 404:
+                    return {**op, "type": "fail", "error": "not-found"}
+                raise
+            if not res.get("found", True):
+                return {**op, "type": "fail", "error": "not-found"}
+            return {**op, "type": "ok",
+                    "value": res.get("_source", {}).get("v", op["value"])}
+        if f == "strong-read":
+            self._req("POST", f"/{INDEX}/_refresh")
+            # Paginated like SetClient.read: a bare size-10000 search
+            # silently truncates past ES's max_result_window, turning
+            # long runs into phantom "lost" writes.
+            vals = set()
+            search_after = None
+            while True:
+                body = {"query": {"match_all": {}},
+                        "sort": [{"v": "asc"}], "size": 10000}
+                if search_after is not None:
+                    body["search_after"] = search_after
+                res = self._req("GET", f"/{INDEX}/_search", body)
+                hits = res.get("hits", {}).get("hits", [])
+                if not hits:
+                    break
+                vals.update(h["_source"]["v"] for h in hits)
+                sort_vals = hits[-1].get("sort")
+                if len(hits) < 10000 or not sort_vals:
+                    break
+                search_after = sort_vals
+            return {**op, "type": "ok", "value": sorted(vals)}
+        raise ValueError(f"unknown f {f!r}")
+
+    def close(self, test):
+        pass
+
+
+def dirty_read_checker() -> jchecker.Checker:
+    """dirty_read.clj:106-156: a read must never observe a value that no
+    strong read confirmed (dirty), every acked write must survive
+    (lost), and the per-thread strong reads must agree."""
+    from ..checker import checker_fn
+
+    def chk(test, history, opts):
+        writes, reads, strong = set(), set(), []
+        for op in history:
+            if not op.is_ok:
+                continue
+            if op.f == "write":
+                writes.add(op.value)
+            elif op.f == "read":
+                reads.add(op.value)
+            elif op.f == "strong-read":
+                strong.append(set(op.value or []))
+        if not strong:
+            return {"valid": "unknown", "error": "no strong reads"}
+        on_all = set.intersection(*strong)
+        on_some = set.union(*strong)
+        dirty = sorted(reads - on_some)
+        lost = sorted(writes - on_some)
+        some_lost = sorted(writes - on_all)
+        nodes_agree = on_all == on_some
+        return {
+            "valid": bool(nodes_agree and not dirty and not lost),
+            "nodes-agree": nodes_agree,
+            "read-count": len(reads),
+            "on-all-count": len(on_all),
+            "on-some-count": len(on_some),
+            "dirty": dirty,
+            "lost": lost,
+            "some-lost": some_lost,
+        }
+
+    return checker_fn(chk, "dirty-read")
+
+
+def set_workload(opts: dict) -> dict:
     import itertools
 
     ids = itertools.count()
@@ -118,25 +225,81 @@ def test_fn(opts: dict) -> dict:
         return {"type": "invoke", "f": "add", "value": next(ids)}
 
     return {
-        "name": "elasticsearch-set",
-        "db": ElasticsearchDB(),
-        "net": jnet.iptables(),
-        "nemesis": jnemesis.partition_random_halves(),
         "client": SetClient(),
         "checker": jchecker.compose({
             "set": jchecker.set_checker(),
             "stats": jchecker.stats(),
         }),
-        "generator": std_generator(
-            opts, gen.clients(gen.stagger(0.05, add)),
-            final_client_gen=gen.clients(
-                gen.once({"type": "invoke", "f": "read", "value": None})),
-            dt=10),
+        "generator": gen.stagger(
+            0.05, gen.limit(int(opts.get("ops") or 200), add)),
+        "final-generator": gen.clients(gen.once(
+            {"type": "invoke", "f": "read", "value": None})),
     }
 
 
+def dirty_read_workload(opts: dict) -> dict:
+    """dirty_read.clj:158-189's rw-gen: writers emit sequential ids,
+    readers probe recently-written ones; a final per-thread strong
+    read closes the run."""
+    import itertools
+    import threading
+    from collections import deque
+
+    last = deque(maxlen=16)
+    lock = threading.Lock()
+    ctr = itertools.count()
+
+    def write(t=None, ctx=None):
+        v = next(ctr)
+        with lock:
+            last.append(v)
+        return {"type": "invoke", "f": "write", "value": v}
+
+    def read(t=None, ctx=None):
+        with lock:
+            pool = list(last)
+        v = pool[gen.rand_int(len(pool))] if pool else 0
+        return {"type": "invoke", "f": "read", "value": v}
+
+    return {
+        "client": DirtyReadClient(),
+        "checker": jchecker.compose({
+            "dirty-read": dirty_read_checker(),
+            "stats": jchecker.stats(),
+        }),
+        "generator": gen.stagger(0.02, gen.reserve(2, write, read)),
+        "final-generator": gen.clients(gen.each_thread(
+            {"type": "invoke", "f": "strong-read", "value": None})),
+    }
+
+
+WORKLOADS = {"set": set_workload, "dirty-read": dirty_read_workload}
+
+
+def test_fn(opts: dict) -> dict:
+    name = opts.get("workload") or "set"
+    wl = WORKLOADS[name](opts)
+    return {
+        "name": f"elasticsearch-{name}",
+        "db": ElasticsearchDB(),
+        "net": jnet.iptables(),
+        "nemesis": jnemesis.partition_random_halves(),
+        **{k: v for k, v in wl.items()
+           if k not in ("generator", "final-generator")},
+        "generator": std_generator(
+            opts, gen.clients(wl["generator"]),
+            final_client_gen=wl.get("final-generator"), dt=10),
+    }
+
+
+def _add_opts(p):
+    p.add_argument("--workload", choices=sorted(WORKLOADS),
+                   default="set")
+    p.add_argument("--ops", type=int, default=200)
+
+
 def main(argv=None):
-    cli.main_exit(cli.single_test_cmd(test_fn), argv)
+    cli.main_exit(cli.single_test_cmd(test_fn, add_opts=_add_opts), argv)
 
 
 if __name__ == "__main__":

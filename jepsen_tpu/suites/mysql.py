@@ -33,7 +33,7 @@ class DirtyReadsClient(jclient.Client):
         self.user = user
 
     def open(self, test, node):
-        return DirtyReadsClient(node, self.user)
+        return type(self)(node, self.user)
 
     def setup(self, test):
         n = int(test.get("row-count") or 10)
@@ -52,6 +52,11 @@ class DirtyReadsClient(jclient.Client):
 
         return c.on_nodes(test, run, [self.node])[self.node]
 
+    @staticmethod
+    def _is_conflict(e: Exception) -> bool:
+        s = str(e)
+        return "Deadlock" in s or "lock wait" in s.lower()
+
     def invoke(self, test, op):
         if op["f"] == "read":
             out = self._sql(test, f"SELECT x FROM {TABLE};")
@@ -67,7 +72,7 @@ class DirtyReadsClient(jclient.Client):
             ]))
             return {**op, "type": "ok"}
         except c.RemoteError as e:
-            if "Deadlock" in str(e) or "lock wait" in str(e).lower():
+            if self._is_conflict(e):
                 return {**op, "type": "fail", "error": "conflict"}
             raise
 
@@ -220,6 +225,9 @@ class MysqlClusterDB(jdb.DB, jdb.Process, jdb.LogFiles):
                 "cat > /etc/my.cnf <<'JEPSEN_EOF'\n"
                 "[mysqld]\n"
                 "ndbcluster\n"
+                # Without this every CREATE TABLE lands on node-local
+                # InnoDB and the "cluster" is N independent databases.
+                "default-storage-engine=NDBCLUSTER\n"
                 f"ndb-connectstring={first}\n"
                 "bind-address=0.0.0.0\n"
                 "[mysql_cluster]\n"
@@ -252,7 +260,84 @@ FLAVORS = {"galera": MariaGaleraDB, "percona": PerconaDB,
            "ndb": MysqlClusterDB}
 
 
-def test_fn(opts: dict) -> dict:
+BANK_TABLE = "jepsen.bank"
+SET_TABLE = "jepsen.sets"
+
+
+class MysqlBankClient(DirtyReadsClient):
+    """galera.clj:260-370's bank: transfers in one serializable txn,
+    reads select every balance. Galera's certification-based
+    replication famously admits conservation violations under
+    partitions — negative balances are allowed so the conservation
+    checker (not a CHECK constraint) is the judge."""
+
+    def setup(self, test):
+        from ..workloads import bank as wbank
+
+        rows = ", ".join(
+            f"({a}, {b})" for a, b in wbank.initial_balances(test))
+        self._sql(test,
+                  "CREATE DATABASE IF NOT EXISTS jepsen;\n"
+                  f"CREATE TABLE IF NOT EXISTS {BANK_TABLE} "
+                  "(id INT PRIMARY KEY, balance BIGINT NOT NULL);\n"
+                  f"INSERT IGNORE INTO {BANK_TABLE} VALUES {rows};")
+
+    def invoke(self, test, op):
+        if op["f"] == "read":
+            out = self._sql(test,
+                            f"SELECT id, balance FROM {BANK_TABLE};")
+            value = {}
+            for line in out.strip().split("\n"):
+                if "\t" in line:
+                    a, b = line.split("\t")[:2]
+                    value[int(a)] = int(b)
+            return {**op, "type": "ok", "value": value}
+        v = op["value"]
+        try:
+            self._sql(test, "\n".join([
+                "SET SESSION TRANSACTION ISOLATION LEVEL SERIALIZABLE;",
+                "START TRANSACTION;",
+                f"SELECT balance FROM {BANK_TABLE} "
+                f"WHERE id IN ({v['from']}, {v['to']}) FOR UPDATE;",
+                f"UPDATE {BANK_TABLE} SET balance = balance - "
+                f"{v['amount']} WHERE id = {v['from']};",
+                f"UPDATE {BANK_TABLE} SET balance = balance + "
+                f"{v['amount']} WHERE id = {v['to']};",
+                "COMMIT;",
+            ]))
+            return {**op, "type": "ok"}
+        except c.RemoteError as e:
+            if self._is_conflict(e):
+                return {**op, "type": "fail", "error": "conflict"}
+            raise
+
+
+class MysqlSetsClient(DirtyReadsClient):
+    """galera.clj:238-258's sets: blind unique inserts + full reads."""
+
+    def setup(self, test):
+        self._sql(test,
+                  "CREATE DATABASE IF NOT EXISTS jepsen;\n"
+                  f"CREATE TABLE IF NOT EXISTS {SET_TABLE} "
+                  "(val BIGINT PRIMARY KEY);")
+
+    def invoke(self, test, op):
+        try:
+            if op["f"] == "read":
+                out = self._sql(test, f"SELECT val FROM {SET_TABLE};")
+                return {**op, "type": "ok",
+                        "value": sorted(int(x) for x in out.split()
+                                        if x.strip())}
+            self._sql(test,
+                      f"INSERT INTO {SET_TABLE} VALUES ({op['value']});")
+            return {**op, "type": "ok"}
+        except c.RemoteError as e:
+            if self._is_conflict(e):
+                return {**op, "type": "fail", "error": "conflict"}
+            raise
+
+
+def dirty_reads_workload(opts: dict) -> dict:
     counter = [0]
 
     def write(test=None, ctx=None):
@@ -263,22 +348,70 @@ def test_fn(opts: dict) -> dict:
         return {"type": "invoke", "f": "read", "value": None}
 
     return {
-        "name": f"mysql-{opts.get('flavor') or 'galera'}-dirty-reads",
         "row-count": int(opts.get("row_count") or 10),
-        "db": FLAVORS[opts.get("flavor") or "galera"](),
-        "net": jnet.iptables(),
-        "nemesis": jnemesis.partition_random_halves(),
         "client": DirtyReadsClient(),
         "checker": jchecker.compose({
             "dirty-reads": dirty_reads_checker(),
             "stats": jchecker.stats(),
         }),
-        "generator": std_generator(opts, gen.mix([read, write]), dt=10),
+        "generator": gen.mix([read, write]),
+    }
+
+
+def bank_workload(opts: dict) -> dict:
+    from ..workloads import bank as wbank
+
+    wl = wbank.test({**opts, "negative-balances?": True})
+    return {**wl, "client": MysqlBankClient()}
+
+
+def sets_workload(opts: dict) -> dict:
+    import itertools
+
+    ids = itertools.count()
+
+    def add(t=None, ctx=None):
+        return {"type": "invoke", "f": "add", "value": next(ids)}
+
+    return {
+        "client": MysqlSetsClient(),
+        "generator": gen.stagger(0.05, add),
+        "final-generator": gen.clients(gen.once(
+            {"type": "invoke", "f": "read", "value": None})),
+        "checker": jchecker.compose({
+            "set": jchecker.set_full(),
+            "stats": jchecker.stats(),
+        }),
+    }
+
+
+WORKLOADS = {
+    "dirty-reads": dirty_reads_workload,
+    "bank": bank_workload,
+    "sets": sets_workload,
+}
+
+
+def test_fn(opts: dict) -> dict:
+    name = opts.get("workload") or "dirty-reads"
+    wl = WORKLOADS[name](opts)
+    return {
+        "name": f"mysql-{opts.get('flavor') or 'galera'}-{name}",
+        "db": FLAVORS[opts.get("flavor") or "galera"](),
+        "net": jnet.iptables(),
+        "nemesis": jnemesis.partition_random_halves(),
+        **{k: v for k, v in wl.items()
+           if k not in ("generator", "final-generator")},
+        "generator": std_generator(
+            opts, wl["generator"], dt=10,
+            final_client_gen=wl.get("final-generator")),
     }
 
 
 def _add_opts(p):
     p.add_argument("--flavor", choices=sorted(FLAVORS), default="galera")
+    p.add_argument("--workload", choices=sorted(WORKLOADS),
+                   default="dirty-reads")
 
 
 def main(argv=None):

@@ -38,7 +38,7 @@ class PsqlClient(jclient.Client):
         self.port = port
 
     def open(self, test, node):
-        return PsqlClient(node, self.user, self.host, self.port)
+        return type(self)(node, self.user, self.host, self.port)
 
     def setup(self, test):
         self._psql(test,
@@ -123,21 +123,92 @@ class PostgresDB(jdb.DB, jdb.Process, jdb.LogFiles):
         return [self.LOG]
 
 
-def test_fn(opts: dict) -> dict:
+BANK_TABLE = "jepsen_bank"
+
+
+class PgBankClient(PsqlClient):
+    """Bank transfers in serializable psql transactions
+    (postgres_rds.clj:133-260's BankClient shape): reads select every
+    balance, transfers run two guarded UPDATEs in one txn;
+    serialization failures are definite :fail."""
+
+    def setup(self, test):
+        from ..workloads import bank as wbank
+
+        rows = ", ".join(
+            f"({a}, {b})" for a, b in wbank.initial_balances(test))
+        self._psql(test,
+                   f"CREATE TABLE IF NOT EXISTS {BANK_TABLE} "
+                   "(id int PRIMARY KEY, "
+                   "balance bigint NOT NULL CHECK (balance >= 0));\n"
+                   f"INSERT INTO {BANK_TABLE} VALUES {rows} "
+                   "ON CONFLICT (id) DO NOTHING")
+
+    def invoke(self, test, op):
+        if op["f"] == "read":
+            out = self._psql(
+                test, f"SELECT id, balance FROM {BANK_TABLE}")
+            value = {}
+            for line in out.split("\n"):
+                if "|" in line:
+                    a, b = line.split("|")[:2]
+                    value[int(a)] = int(b)
+            return {**op, "type": "ok", "value": value}
+        v = op["value"]
+        try:
+            self._psql(test, ";\n".join([
+                "BEGIN ISOLATION LEVEL SERIALIZABLE",
+                f"UPDATE {BANK_TABLE} SET balance = balance - "
+                f"{v['amount']} WHERE id = {v['from']}",
+                f"UPDATE {BANK_TABLE} SET balance = balance + "
+                f"{v['amount']} WHERE id = {v['to']}",
+                "COMMIT",
+            ]) + ";")
+            return {**op, "type": "ok"}
+        except c.RemoteError as e:
+            s = str(e)
+            if "could not serialize" in s or "deadlock" in s \
+                    or "violates check constraint" in s:
+                return {**op, "type": "fail", "error": "serialization"}
+            raise
+
+
+def append_workload(opts: dict) -> dict:
     wl = wa.test({"key_count": 4})
+    return {"client": PsqlClient(), "checker": wl["checker"],
+            "generator": wl["generator"]}
+
+
+def bank_workload(opts: dict) -> dict:
+    from ..workloads import bank as wbank
+
+    wl = wbank.test(opts)
+    return {**wl, "client": PgBankClient()}
+
+
+WORKLOADS = {"append": append_workload, "bank": bank_workload}
+
+
+def test_fn(opts: dict) -> dict:
+    name = opts.get("workload") or "append"
+    wl = WORKLOADS[name](opts)
     return {
-        "name": "postgres-append",
+        "name": f"postgres-{name}",
         "db": PostgresDB(),
         "net": jnet.iptables(),
         "nemesis": jnemesis.partition_random_halves(),
-        "client": PsqlClient(),
-        "checker": wl["checker"],
+        **{k: v for k, v in wl.items() if k != "generator"},
         "generator": std_generator(opts, wl["generator"], dt=10),
     }
 
 
+def _add_opts(p):
+    p.add_argument("--workload", choices=sorted(WORKLOADS),
+                   default="append")
+
+
 def main(argv=None):
-    cli.main_exit(cli.single_test_cmd(test_fn), argv)
+    cli.main_exit(cli.single_test_cmd(test_fn, add_opts=_add_opts), argv)
 
 
 if __name__ == "__main__":

@@ -46,7 +46,8 @@ def _workload_cases():
     breadth, so each entry must satisfy the interpreter contract."""
     cases = []
     for name in ("cockroachdb", "dgraph", "tidb", "yugabyte", "faunadb",
-                 "mongodb"):
+                 "mongodb", "postgres", "stolon", "mysql",
+                 "elasticsearch"):
         mod = importlib.import_module(f"jepsen_tpu.suites.{name}")
         for wl in sorted(getattr(mod, "WORKLOADS", {})):
             cases.append((name, wl))

@@ -377,6 +377,134 @@ class TestDisqueSuite:
             srv.server_close()
 
 
+class TestSmallSuiteWorkloads:
+    """The r4 gap-fills: postgres bank, mysql bank/sets, stolon ledger,
+    elasticsearch dirty-read."""
+
+    def test_postgres_bank_sql(self):
+        from jepsen_tpu.suites import postgres as pg
+
+        test = dict(noop_test())
+        test.update(nodes=["n1"], accounts=[0, 1], **{"total-amount": 20},
+                    **{"max-transfer": 5})
+        log: list = []
+        c.setup_sessions(test, c.dummy(log, responses={
+            r"SELECT id, balance": "0|10\n1|10\n"}))
+        client = pg.PgBankClient().open(test, "n1")
+        client.setup(test)
+        res = client.invoke(test, {"type": "invoke", "f": "read",
+                                   "value": None, "process": 0})
+        assert res["type"] == "ok" and res["value"] == {0: 10, 1: 10}
+        client.invoke(test, {"type": "invoke", "f": "transfer",
+                             "value": {"from": 0, "to": 1, "amount": 3},
+                             "process": 0})
+        cmds = [cmd for _n, cmd in log]
+        assert any("BEGIN ISOLATION LEVEL SERIALIZABLE" in cmd
+                   and "balance - 3" in cmd for cmd in cmds)
+
+    def test_mysql_bank_against_fake(self, tmp_path):
+        from jepsen_tpu.suites import mysql as my
+
+        tables: dict = {}
+        test = dict(noop_test())
+        test.update(
+            name="mysql-bank-stub", nodes=["n1", "n2"], concurrency=4,
+            **{"store-root": str(tmp_path)},
+        )
+        c.setup_sessions(test, c.dummy(responses={
+            r"mysql": _sql_fake(tables)}))
+        wl = my.bank_workload({})
+        test.update({k: v for k, v in wl.items()
+                     if k not in ("client", "checker", "generator")})
+        test["client"] = wl["client"]
+        test["checker"] = wl["checker"]
+        test["generator"] = gen.clients(gen.limit(60, wl["generator"]))
+        res = core.run(test)
+        assert res["results"]["valid"] is True, res["results"]
+
+    def test_mysql_sets_sql(self):
+        from jepsen_tpu.suites import mysql as my
+
+        test = dict(noop_test())
+        test.update(nodes=["n1"])
+        log: list = []
+        c.setup_sessions(test, c.dummy(log, responses={
+            r"SELECT val": "1\n4\n"}))
+        client = my.MysqlSetsClient().open(test, "n1")
+        client.setup(test)
+        assert client.invoke(test, {"type": "invoke", "f": "add",
+                                    "value": 4,
+                                    "process": 0})["type"] == "ok"
+        res = client.invoke(test, {"type": "invoke", "f": "read",
+                                   "value": None, "process": 0})
+        assert res["type"] == "ok" and res["value"] == [1, 4]
+
+    def test_stolon_ledger_client_and_checker(self):
+        from jepsen_tpu.history import History, Op
+        from jepsen_tpu.suites import stolon as st
+
+        test = dict(noop_test())
+        test.update(nodes=["n1"])
+        log: list = []
+        c.setup_sessions(test, c.dummy(log, responses={
+            r"\\if :ok": "APPLIED\n"}))
+        client = st.LedgerClient().open(test, "n1")
+        client.setup(test)
+        # Deposits insert unconditionally.
+        res = client.invoke(test, {"type": "invoke", "f": "transfer",
+                                   "value": (3, 10), "process": 0})
+        assert res["type"] == "ok"
+        # Withdrawals run the balance-guarded \gset/\if transaction.
+        res = client.invoke(test, {"type": "invoke", "f": "transfer",
+                                   "value": (3, -9), "process": 0})
+        assert res["type"] == "ok"
+        cmds = [cmd for _n, cmd in log]
+        assert any("SUM(amount)" in cmd and "gset" in cmd
+                   and "REFUSED" in cmd for cmd in cmds)
+
+        def op(typ, acct, amt):
+            return Op.from_dict({"type": typ, "process": 0,
+                                 "f": "transfer", "value": [acct, amt],
+                                 "time": 0})
+
+        # Double spend: two -9 withdrawals against one +10 deposit.
+        bad = History([op("ok", 0, 10), op("ok", 0, -9), op("ok", 0, -9)],
+                      reindex=True)
+        res = st.ledger_checker().check({}, bad, {})
+        assert res["valid"] is False and res["errors"][0]["account"] == 0
+        # Charitable indeterminacy: info deposits count, info
+        # withdrawals don't.
+        ok_h = History([op("ok", 1, 10), op("info", 1, -9),
+                        op("ok", 1, -9)], reindex=True)
+        assert st.ledger_checker().check({}, ok_h, {})["valid"] is True
+
+    def test_es_dirty_read_against_stub(self, http_stub, tmp_path):
+        from jepsen_tpu.suites import elasticsearch as es_suite
+
+        EsStub.store = {}
+        http_stub(EsStub, es_suite, "PORT")
+        test = dict(noop_test())
+        wl = es_suite.dirty_read_workload({})
+        test.update(
+            name="es-dirty-read-stub", nodes=["127.0.0.1"],
+            concurrency=4, **{"store-root": str(tmp_path)},
+            client=wl["client"], checker=wl["checker"],
+            generator=gen.phases(
+                gen.clients(gen.time_limit(2, wl["generator"])),
+                wl["final-generator"]),
+        )
+        res = core.run(test)
+        assert res["results"]["valid"] is not False, res["results"]
+        dr = res["results"]["dirty-read"]
+        assert dr["valid"] is True, dr
+        # Reads deliberately race in-flight writes (the dirty-read
+        # probe), so most legitimately miss; they must still DECIDE.
+        decided = [op for op in res["history"]
+                   if op.f == "read" and op.type in ("ok", "fail")]
+        assert decided, "no read decisions"
+        assert dr["on-some-count"] > 0
+
+
 class TestMysqlDirtyReads:
     def test_checker(self):
         from jepsen_tpu.history import History, Op
@@ -644,6 +772,15 @@ class EsStub(BaseHTTPRequestHandler):
         self._reply({})  # refresh
 
     def do_GET(self):
+        if "/_doc/" in self.path:
+            doc_id = self.path.split("/_doc/")[1].split("?")[0]
+            with self.lock:
+                doc = self.store.get(doc_id)
+            if doc is None:
+                self._reply({"found": False}, code=404)
+                return
+            self._reply({"found": True, "_source": doc})
+            return
         with self.lock:
             hits = [{"_source": v} for v in self.store.values()]
         self._reply({"hits": {"hits": hits}})
