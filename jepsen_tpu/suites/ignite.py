@@ -10,6 +10,12 @@ ATOMIC (or TRANSACTIONAL) cache — so this suite drives those and checks:
   subhistories decided on the device kernel;
 - **counter**: ``incr`` deltas with concurrent reads, checked with the
   O(n) counter-bounds checker (checker.clj:734-792).
+
+The reference's bank workload needs multi-key transactions, which the
+REST connector cannot express (no txn begin/commit commands; Ignite's
+SQL transactions require the JDBC/thin client) — the multi-key
+conservation axis is covered framework-wide by the SQL suites'
+bank workloads (cockroachdb/tidb/yugabyte/postgres/mysql).
 """
 
 from __future__ import annotations
