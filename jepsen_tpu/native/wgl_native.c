@@ -370,13 +370,25 @@ static size_t dominance_prune(cfg_t *items, size_t len, int S) {
  * Stored masks that are supersets of a new mask are removed: the new
  * (dominating) entry prunes everything they would have pruned. */
 
+/* Dominance-memo mask width: one word for the window-read complement
+ * (the read-collapse reduction) plus the open-op set.  For register
+ * models, a linearized READ never changes state, so config A dominates
+ * B at the same (p, non-read window bits, state) whenever A has
+ * linearized a SUPERSET of the window reads with a SUBSET of the open
+ * ops: delete the extra reads from B's accepting completion and the
+ * state trajectory is unchanged while every min-return bound only
+ * loosens.  Encoding the read bits as their complement turns both
+ * conditions into one componentwise subset test over [read-compl,
+ * open words] — exactly the antichain machinery below. */
+#define DOM_WORDS (NO_WORDS + 1)
+
 typedef struct {
     int32_t p;
     uint64_t win;
     int32_t st[S_MAX];
     int32_t n;        /* stored masks */
     int32_t mcap;
-    uint64_t *masks;  /* n * NO_WORDS, popcount-ascending */
+    uint64_t *masks;  /* n * DOM_WORDS, popcount-ascending */
     uint8_t *pc;      /* popcount per mask */
 } dom_slot_t;
 
@@ -417,27 +429,34 @@ static void dom_free(domset_t *s) {
     free(s->used);
 }
 
-static int open_popcount(const uint64_t *m) {
+static int dom_popcount(const uint64_t *m) {
     int n = 0;
-    for (int w = 0; w < NO_WORDS; w++)
+    for (int w = 0; w < DOM_WORDS; w++)
         n += __builtin_popcountll(m[w]);
-    /* Clamped to fit the uint8_t pc lanes: 256 set bits (every open op
-     * of a full 4-word set) would wrap to 0 and skip the whole subset
-     * scan. The clamp only coarsens the scan bound — subset checks run
-     * on the real masks. */
+    /* Clamped to fit the uint8_t pc lanes: 320 set bits (a full
+     * 5-word vector) would wrap and skip the whole subset scan. The
+     * clamp only coarsens the scan bound — subset checks run on the
+     * real masks. */
     return n > 255 ? 255 : n;
+}
+
+static inline int dom_subset(const uint64_t *a, const uint64_t *b) {
+    for (int w = 0; w < DOM_WORDS; w++)
+        if (a[w] & ~b[w])
+            return 0;
+    return 1;
 }
 
 static int dom_slot_grow(dom_slot_t *d) {
     int nc = d->mcap ? d->mcap * 2 : 4;
     /* one allocation: masks block then pc block */
     uint64_t *nm = (uint64_t *)malloc(
-        (sizeof(uint64_t) * NO_WORDS + 1) * (size_t)nc);
+        (sizeof(uint64_t) * DOM_WORDS + 1) * (size_t)nc);
     if (!nm)
         return 0;
-    uint8_t *npc = (uint8_t *)(nm + (size_t)nc * NO_WORDS);
+    uint8_t *npc = (uint8_t *)(nm + (size_t)nc * DOM_WORDS);
     if (d->n) {
-        memcpy(nm, d->masks, sizeof(uint64_t) * NO_WORDS * (size_t)d->n);
+        memcpy(nm, d->masks, sizeof(uint64_t) * DOM_WORDS * (size_t)d->n);
         memcpy(npc, d->pc, (size_t)d->n);
     }
     free(d->masks);
@@ -449,38 +468,61 @@ static int dom_slot_grow(dom_slot_t *d) {
 
 static int dom_grow(domset_t *s);
 
+/* Project a config onto the memo coordinates: win_key = window bits
+ * with in-window READ bits removed; mvec = [read-complement, open
+ * words].  romask[p] has bit j set when det row p+j is state-neutral
+ * (a register read); NULL disables the read-collapse (non-register
+ * models). */
+static inline void dom_project(const cfg_t *c, const uint64_t *romask,
+                               int32_t nD, int32_t W,
+                               uint64_t *win_key, uint64_t *m) {
+    uint64_t ro = 0;
+    if (romask && c->p < nD) {
+        int32_t wl = nD - c->p;
+        if (wl > W)
+            wl = W;
+        uint64_t lim = (wl >= 64) ? ~0ULL : ((1ULL << wl) - 1);
+        ro = romask[c->p] & lim;
+    }
+    *win_key = c->win & ~ro;
+    m[0] = ro & ~c->win;
+    for (int w = 0; w < NO_WORDS; w++)
+        m[1 + w] = c->open[w];
+}
+
 /* 1 = inserted (explore), 0 = dominated (prune), -1 = OOM */
-static int dom_insert(domset_t *s, const cfg_t *c) {
+static int dom_insert(domset_t *s, int32_t p, uint64_t win_key,
+                      const int32_t *st, const uint64_t *mvec) {
     if (s->count * 4 >= s->cap * 3) {
         if (!dom_grow(s))
             return -1;
     }
-    uint64_t h = dom_key_hash(c->p, c->win, c->st);
+    uint64_t h = dom_key_hash(p, win_key, st);
     size_t i = (size_t)(h & (s->cap - 1));
     dom_slot_t *d = NULL;
     while (s->used[i]) {
         d = &s->slots[i];
-        if (d->p == c->p && d->win == c->win &&
-            memcmp(d->st, c->st, sizeof(d->st)) == 0)
+        if (d->p == p && d->win == win_key &&
+            memcmp(d->st, st, sizeof(d->st)) == 0)
             break;
         d = NULL;
         i = (i + 1) & (s->cap - 1);
     }
-    int pc_new = open_popcount(c->open);
+    int pc_new = dom_popcount(mvec);
     if (d == NULL) {
         /* fresh key */
         s->used[i] = 1;
         d = &s->slots[i];
-        d->p = c->p;
-        d->win = c->win;
-        memcpy(d->st, c->st, sizeof(d->st));
+        d->p = p;
+        d->win = win_key;
+        memcpy(d->st, st, sizeof(d->st));
         d->n = 0;
         d->mcap = 0;
         d->masks = NULL;
         d->pc = NULL;
         if (!dom_slot_grow(d))
             return -1;
-        memcpy(d->masks, c->open, sizeof(uint64_t) * NO_WORDS);
+        memcpy(d->masks, mvec, sizeof(uint64_t) * DOM_WORDS);
         d->pc[0] = (uint8_t)pc_new;
         d->n = 1;
         s->count++;
@@ -490,17 +532,17 @@ static int dom_insert(domset_t *s, const cfg_t *c) {
      * subsets of the new mask */
     int32_t k = 0;
     for (; k < d->n && d->pc[k] <= pc_new; k++)
-        if (open_subset(d->masks + (size_t)k * NO_WORDS, c->open))
+        if (dom_subset(d->masks + (size_t)k * DOM_WORDS, mvec))
             return 0; /* dominated */
     /* remove stored supersets (they are now redundant pruners) */
     int32_t w = k;
     for (int32_t j = k; j < d->n; j++) {
-        if (open_subset(c->open, d->masks + (size_t)j * NO_WORDS))
+        if (dom_subset(mvec, d->masks + (size_t)j * DOM_WORDS))
             continue; /* superset of new: drop */
         if (w != j) {
-            memcpy(d->masks + (size_t)w * NO_WORDS,
-                   d->masks + (size_t)j * NO_WORDS,
-                   sizeof(uint64_t) * NO_WORDS);
+            memcpy(d->masks + (size_t)w * DOM_WORDS,
+                   d->masks + (size_t)j * DOM_WORDS,
+                   sizeof(uint64_t) * DOM_WORDS);
             d->pc[w] = d->pc[j];
         }
         w++;
@@ -509,12 +551,12 @@ static int dom_insert(domset_t *s, const cfg_t *c) {
     if (d->n == d->mcap && !dom_slot_grow(d))
         return -1;
     /* insert at position k (popcount order preserved) */
-    memmove(d->masks + (size_t)(k + 1) * NO_WORDS,
-            d->masks + (size_t)k * NO_WORDS,
-            sizeof(uint64_t) * NO_WORDS * (size_t)(d->n - k));
+    memmove(d->masks + (size_t)(k + 1) * DOM_WORDS,
+            d->masks + (size_t)k * DOM_WORDS,
+            sizeof(uint64_t) * DOM_WORDS * (size_t)(d->n - k));
     memmove(d->pc + k + 1, d->pc + k, (size_t)(d->n - k));
-    memcpy(d->masks + (size_t)k * NO_WORDS, c->open,
-           sizeof(uint64_t) * NO_WORDS);
+    memcpy(d->masks + (size_t)k * DOM_WORDS, mvec,
+           sizeof(uint64_t) * DOM_WORDS);
     d->pc[k] = (uint8_t)pc_new;
     d->n++;
     return 1;
@@ -569,24 +611,193 @@ static int vec_push(vec_t *v, const cfg_t *c) {
  * seeding sweep, and its workers (one copy — the three loops cannot
  * drift). */
 
+/* Twin tables for the interval-containment symmetry reduction.
+ *
+ * Two ops with the same (op, a1, a2) have identical step behavior, so
+ * they are interchangeable wherever both are applicable.  If i's
+ * realtime interval is CONTAINED in j's (inv_i >= inv_j and
+ * ret_i <= ret_j), any completion that linearizes j "now" and i at a
+ * later point t can be rewritten with the two swapped: j at t is legal
+ * because inv_j <= inv_i < min_ret_t, and every intermediate filter
+ * only LOOSENS (the pending set trades i for j, and ret_i <= ret_j
+ * can only raise the min-return bound).  So a search that, at each
+ * config, skips candidate j whenever a contained same-class twin i is
+ * itself applicable is still complete — it explores the innermost
+ * applicable twin first and the rest never need to be branched on.
+ * Open (:info) ops have ret = +inf, which makes every later-invoked
+ * same-class open a contained twin, and every same-class determinate
+ * op invoked after the open one too (det ops prune opens; opens never
+ * prune dets).  This collapses the 2^k applied-subset blowup of
+ * crashed ops around a refutation's stuck point. */
+typedef struct {
+    int32_t n_cls;
+    int32_t *clsD;      /* [nD] class id per det row */
+    int32_t *cposD;     /* [nD] row's position inside its class list */
+    int32_t *crows_off; /* [n_cls+1] CSR offsets into crows */
+    int32_t *crows;     /* det rows per class, ascending row (== inv) */
+    int32_t *clsO;      /* [nO] class id per open op */
+    int32_t *cposO;     /* [nO] open's position inside its class list */
+    int32_t *copen_off; /* [n_cls+1] CSR offsets into copens */
+    int32_t *copens;    /* open idxs per class, ascending idx (== inv) */
+    int32_t *odet_start;/* [nO] first index in the open's class crows
+                           with invD >= invO[o] */
+} twins_t;
+
 typedef struct {
     int32_t nD, nO, S, W;
     const int32_t *invD, *retD, *opD, *a1D, *a2D, *sufret;
     const int32_t *invO, *opO, *a1O, *a2O;
     int32_t model_id;
     int64_t model_param;
+    const twins_t *tw; /* NULL = reduction disabled */
 } tabs_t;
 
+typedef struct {
+    int32_t op, a1, a2, kind, idx; /* kind: 0 det, 1 open */
+} tkey_t;
+
+static int tkey_cmp(const void *pa, const void *pb) {
+    const tkey_t *a = (const tkey_t *)pa, *b = (const tkey_t *)pb;
+    if (a->op != b->op) return a->op < b->op ? -1 : 1;
+    if (a->a1 != b->a1) return a->a1 < b->a1 ? -1 : 1;
+    if (a->a2 != b->a2) return a->a2 < b->a2 ? -1 : 1;
+    if (a->kind != b->kind) return a->kind - b->kind;
+    return a->idx < b->idx ? -1 : (a->idx > b->idx);
+}
+
+static void twin_free(twins_t *X) {
+    if (!X)
+        return;
+    free(X->clsD);
+    free(X->cposD);
+    free(X->crows_off);
+    free(X->crows);
+    free(X->clsO);
+    free(X->cposO);
+    free(X->copen_off);
+    free(X->copens);
+    free(X->odet_start);
+    free(X);
+}
+
+/* Build the class tables; NULL on OOM or when the inv arrays are not
+ * ascending (the encoders sort by invocation — verified here so the
+ * reduction silently disables rather than mis-pruning if that ever
+ * changes). */
+static twins_t *twin_build(int32_t nD, int32_t nO,
+                           const int32_t *opD, const int32_t *a1D,
+                           const int32_t *a2D, const int32_t *invD,
+                           const int32_t *opO, const int32_t *a1O,
+                           const int32_t *a2O, const int32_t *invO) {
+    for (int32_t i = 1; i < nD; i++)
+        if (invD[i] < invD[i - 1])
+            return NULL;
+    for (int32_t i = 1; i < nO; i++)
+        if (invO[i] < invO[i - 1])
+            return NULL;
+    size_t n = (size_t)nD + (size_t)nO;
+    tkey_t *keys = (tkey_t *)malloc(sizeof(tkey_t) * (n ? n : 1));
+    twins_t *X = (twins_t *)calloc(1, sizeof(twins_t));
+    if (!keys || !X) {
+        free(keys);
+        free(X);
+        return NULL;
+    }
+    for (int32_t i = 0; i < nD; i++)
+        keys[i] = (tkey_t){opD[i], a1D[i], a2D[i], 0, i};
+    for (int32_t i = 0; i < nO; i++)
+        keys[nD + i] = (tkey_t){opO[i], a1O[i], a2O[i], 1, i};
+    qsort(keys, n, sizeof(tkey_t), tkey_cmp);
+    int32_t n_cls = 0;
+    for (size_t i = 0; i < n; i++)
+        if (i == 0 || keys[i].op != keys[i - 1].op ||
+            keys[i].a1 != keys[i - 1].a1 || keys[i].a2 != keys[i - 1].a2)
+            n_cls++;
+    X->n_cls = n_cls;
+    X->clsD = (int32_t *)malloc(sizeof(int32_t) * (nD ? nD : 1));
+    X->cposD = (int32_t *)malloc(sizeof(int32_t) * (nD ? nD : 1));
+    X->crows_off = (int32_t *)calloc((size_t)n_cls + 1, sizeof(int32_t));
+    X->crows = (int32_t *)malloc(sizeof(int32_t) * (nD ? nD : 1));
+    X->clsO = (int32_t *)malloc(sizeof(int32_t) * (nO ? nO : 1));
+    X->cposO = (int32_t *)malloc(sizeof(int32_t) * (nO ? nO : 1));
+    X->copen_off = (int32_t *)calloc((size_t)n_cls + 1, sizeof(int32_t));
+    X->copens = (int32_t *)malloc(sizeof(int32_t) * (nO ? nO : 1));
+    X->odet_start = (int32_t *)malloc(sizeof(int32_t) * (nO ? nO : 1));
+    if (!X->clsD || !X->cposD || !X->crows_off || !X->crows || !X->clsO ||
+        !X->cposO || !X->copen_off || !X->copens || !X->odet_start) {
+        free(keys);
+        twin_free(X);
+        return NULL;
+    }
+    /* qsort's (op,a1,a2,kind,idx) total order yields ascending idx per
+     * (class, kind) run — class member lists stay inv-sorted. */
+    int32_t cls = -1, nd = 0, no = 0;
+    for (size_t i = 0; i < n; i++) {
+        if (i == 0 || keys[i].op != keys[i - 1].op ||
+            keys[i].a1 != keys[i - 1].a1 || keys[i].a2 != keys[i - 1].a2)
+            cls++;
+        if (keys[i].kind == 0) {
+            X->clsD[keys[i].idx] = cls;
+            X->crows[nd++] = keys[i].idx;
+            X->crows_off[cls + 1] = nd;
+        } else {
+            X->clsO[keys[i].idx] = cls;
+            X->copens[no++] = keys[i].idx;
+            X->copen_off[cls + 1] = no;
+        }
+    }
+    /* fill gaps: classes with no det (or open) members inherit the
+     * previous end so off[c]..off[c+1] is an empty range */
+    for (int32_t c2 = 1; c2 <= n_cls; c2++) {
+        if (X->crows_off[c2] < X->crows_off[c2 - 1])
+            X->crows_off[c2] = X->crows_off[c2 - 1];
+        if (X->copen_off[c2] < X->copen_off[c2 - 1])
+            X->copen_off[c2] = X->copen_off[c2 - 1];
+    }
+    for (int32_t c2 = 0; c2 < n_cls; c2++) {
+        for (int32_t q = X->crows_off[c2]; q < X->crows_off[c2 + 1]; q++)
+            X->cposD[X->crows[q]] = q;
+        for (int32_t q = X->copen_off[c2]; q < X->copen_off[c2 + 1]; q++)
+            X->cposO[X->copens[q]] = q;
+    }
+    for (int32_t o = 0; o < nO; o++) {
+        int32_t c2 = X->clsO[o];
+        int32_t lo = X->crows_off[c2], hi = X->crows_off[c2 + 1];
+        while (lo < hi) {
+            int32_t mid = (lo + hi) >> 1;
+            if (invD[X->crows[mid]] < invO[o])
+                lo = mid + 1;
+            else
+                hi = mid;
+        }
+        X->odet_start[o] = lo;
+    }
+    free(keys);
+    return X;
+}
+
 static inline void cfg_bounds(const tabs_t *T, const cfg_t *c,
-                              int32_t *wlim_out, int32_t *min_ret_out) {
+                              int32_t *wlim_out, int32_t *min_ret_out,
+                              int32_t *n_cand_out) {
     int32_t wlim = (T->nD - c->p < T->W) ? T->nD - c->p : T->W;
     int32_t min_ret =
         T->sufret[(c->p + T->W < T->nD) ? c->p + T->W : T->nD];
     for (int j = 0; j < wlim; j++)
         if (!((c->win >> j) & 1) && T->retD[c->p + j] < min_ret)
             min_ret = T->retD[c->p + j];
-    *wlim_out = wlim;
+    /* invD ascends with row, and inv < ret makes the ret==min_ret
+     * escape impossible once invD >= min_ret — so the candidate scan
+     * can stop at the first too-late row (typ. 1/3 of the window).
+     * Same for the invO-ascending open ops. */
+    int32_t we = 0;
+    while (we < wlim && T->invD[c->p + we] < min_ret)
+        we++;
+    int32_t ol = 0;
+    while (ol < T->nO && T->invO[ol] < min_ret)
+        ol++;
+    *wlim_out = we;
     *min_ret_out = min_ret;
+    *n_cand_out = we + ol;
 }
 
 /* Try candidate slot j (0..wlim-1 window ops, wlim..wlim+nO-1 open
@@ -595,12 +806,30 @@ static inline void cfg_bounds(const tabs_t *T, const cfg_t *c,
 static inline int cfg_try(const tabs_t *T, const cfg_t *c, int32_t wlim,
                           int32_t min_ret, int32_t j, cfg_t *out) {
     cfg_t c2 = *c;
+    const twins_t *X = T->tw;
     if (j < wlim) {
         if ((c->win >> j) & 1)
             return 0;
         int32_t row = c->p + j;
         if (T->invD[row] >= min_ret && T->retD[row] != min_ret)
             return 0;
+        if (X) {
+            /* twin pruning: a later-invoked same-class det op whose
+             * return is no later (contained interval) and which is
+             * itself applicable makes this branch redundant */
+            int32_t end = X->crows_off[X->clsD[row] + 1];
+            for (int32_t q = X->cposD[row] + 1; q < end; q++) {
+                int32_t r2 = X->crows[q];
+                if (r2 - c->p >= wlim)
+                    break; /* rows ascend: the rest are out of window */
+                if (T->invD[r2] >= min_ret)
+                    break; /* rows ascend in inv: the rest fail too */
+                if (((c->win >> (r2 - c->p)) & 1))
+                    continue; /* already linearized */
+                if (T->retD[r2] <= T->retD[row])
+                    return 0; /* contained applicable twin exists */
+            }
+        }
         if (!step_model(T->model_id, T->model_param, c->st, T->opD[row],
                         T->a1D[row], T->a2D[row], c2.st))
             return 0;
@@ -617,6 +846,41 @@ static inline int cfg_try(const tabs_t *T, const cfg_t *c, int32_t wlim,
             return 0;
         if (T->invO[o] >= min_ret)
             return 0;
+        if (T->model_id == MODEL_CAS_REGISTER && T->opO[o] == OP_READ)
+            return 0; /* applying a state-neutral open changes nothing:
+                         the parent config dominates the successor */
+        if (X) {
+            int32_t cls = X->clsO[o];
+            /* later-invoked same-class opens: contained (ret = inf) */
+            int32_t oend = X->copen_off[cls + 1];
+            for (int32_t q = X->cposO[o] + 1; q < oend; q++) {
+                int32_t o2 = X->copens[q];
+                if (T->invO[o2] >= min_ret)
+                    break; /* opens ascend in inv */
+                if (!open_test(c, o2))
+                    return 0;
+            }
+            /* determinate same-class ops invoked after this open: their
+             * finite interval is contained in [invO, inf) */
+            int32_t dend = X->crows_off[cls + 1];
+            int32_t lo = X->odet_start[o], hi = dend;
+            while (lo < hi) { /* first class row still in the window */
+                int32_t mid = (lo + hi) >> 1;
+                if (X->crows[mid] < c->p)
+                    lo = mid + 1;
+                else
+                    hi = mid;
+            }
+            for (int32_t q = lo; q < dend; q++) {
+                int32_t r2 = X->crows[q];
+                if (r2 - c->p >= wlim)
+                    break;
+                if (T->invD[r2] >= min_ret)
+                    break;
+                if (!((c->win >> (r2 - c->p)) & 1))
+                    return 0; /* applicable det twin exists */
+            }
+        }
         if (!step_model(T->model_id, T->model_param, c->st, T->opO[o],
                         T->a1O[o], T->a2O[o], c2.st))
             return 0;
@@ -645,10 +909,34 @@ static inline int32_t cfg_depth(const cfg_t *c) {
 
 typedef struct {
     cfg_t cfg;
-    int32_t next_j; /* next candidate slot to try: 0..wlim+nO */
+    int32_t next_j; /* next candidate slot to try: 0..n_cand */
     int32_t min_ret;
     int32_t wlim;
+    int32_t n_cand;
+    /* eager-read cache: the successor computed by the first-visit scan
+     * (avoids running cfg_try twice on the hot read path) */
+    cfg_t eager;
+    int32_t eager_j; /* -1 = none */
+    int32_t eager_r;
 } frame_t;
+
+/* Per-row "state-neutral" mask for the read-collapse dominance: bit j
+ * of romask[p] is set when det row p+j is a register READ.  NULL for
+ * models with no state-neutral ops. */
+static uint64_t *romask_build(int32_t nD, int32_t model_id,
+                              const int32_t *opD) {
+    if (model_id != MODEL_CAS_REGISTER)
+        return NULL;
+    uint64_t *ro = (uint64_t *)malloc(sizeof(uint64_t) * (nD ? nD : 1));
+    if (!ro)
+        return NULL;
+    uint64_t acc = 0;
+    for (int32_t p = nD - 1; p >= 0; p--) {
+        acc = (acc << 1) | (uint64_t)(opD[p] == OP_READ);
+        ro[p] = acc;
+    }
+    return ro;
+}
 
 /* Witness buffer entry stride, in int32 lanes:
  * [p, win_lo, win_hi, open x 2*NO_WORDS, st x S_MAX] */
@@ -709,7 +997,7 @@ int wgl_check_dfs(
         return 1;
 
     domset_t seen;
-    if (!dom_init(&seen, 1 << 12))
+    if (!dom_init(&seen, 1 << 16))
         return -3;
 
     size_t depth_cap = (size_t)nD + (size_t)nO + 2;
@@ -721,14 +1009,22 @@ int wgl_check_dfs(
     size_t sp = 0;
 
     tabs_t T = {nD, nO, S, W, invD, retD, opD, a1D, a2D, sufret,
-                invO, opO, a1O, a2O, model_id, model_param};
+                invO, opO, a1O, a2O, model_id, model_param, NULL};
+    twins_t *X = twin_build(nD, nO, opD, a1D, a2D, invD,
+                            opO, a1O, a2O, invO);
+    T.tw = X; /* NULL (OOM / unsorted inv) just disables the reduction */
+    uint64_t *romask = romask_build(nD, model_id, opD);
 
     frame_t root;
     memset(&root, 0, sizeof(root));
     memcpy(root.cfg.st, init_state, sizeof(int32_t) * (size_t)S);
     root.next_j = -1; /* compute bounds lazily on first visit */
     stack[sp++] = root;
-    dom_insert(&seen, &root.cfg);
+    {
+        uint64_t wk, mv[DOM_WORDS];
+        dom_project(&root.cfg, romask, nD, W, &wk, mv);
+        dom_insert(&seen, root.cfg.p, wk, root.cfg.st, mv);
+    }
 
     int64_t explored = 0;
     int verdict = 0;
@@ -744,8 +1040,29 @@ int wgl_check_dfs(
                 verdict = -1;
                 break;
             }
-            cfg_bounds(&T, c, &fr->wlim, &fr->min_ret);
+            cfg_bounds(&T, c, &fr->wlim, &fr->min_ret, &fr->n_cand);
             fr->next_j = 0;
+            fr->eager_j = -1;
+            if (romask && fr->wlim > 0) {
+                /* eager-read propagation: an applicable window READ can
+                 * be moved to the front of any accepting completion
+                 * (state-neutral; dropping it from the pending set only
+                 * loosens min-return bounds), so this config has
+                 * exactly ONE successor worth branching on. */
+                for (int32_t j = 0; j < fr->wlim; j++) {
+                    if (!((romask[c->p] >> j) & 1))
+                        continue;
+                    int r = cfg_try(&T, c, fr->wlim, fr->min_ret, j,
+                                    &fr->eager);
+                    if (r) {
+                        fr->next_j = j;
+                        fr->n_cand = j + 1;
+                        fr->eager_j = j;
+                        fr->eager_r = r;
+                        break;
+                    }
+                }
+            }
             {
                 int32_t d = cfg_depth(c);
                 wit_record(wit_buf, wit_cap, wit_len, max_linearized, d, c);
@@ -754,17 +1071,25 @@ int wgl_check_dfs(
             }
         }
         int advanced = 0;
-        while (fr->next_j < fr->wlim + nO) {
+        while (fr->next_j < fr->n_cand) {
             int j = fr->next_j++;
             cfg_t c2;
-            int r = cfg_try(&T, c, fr->wlim, fr->min_ret, j, &c2);
+            int r;
+            if (j == fr->eager_j) {
+                c2 = fr->eager;
+                r = fr->eager_r;
+            } else {
+                r = cfg_try(&T, c, fr->wlim, fr->min_ret, j, &c2);
+            }
             if (r == 0)
                 continue;
             if (r == 2) {
                 verdict = 1;
                 break;
             }
-            int ins = dom_insert(&seen, &c2);
+            uint64_t wk, mv[DOM_WORDS];
+            dom_project(&c2, romask, nD, W, &wk, mv);
+            int ins = dom_insert(&seen, c2.p, wk, c2.st, mv);
             if (ins < 0) {
                 verdict = -3;
                 break;
@@ -793,6 +1118,8 @@ int wgl_check_dfs(
     *configs_explored = explored;
     free(stack);
     dom_free(&seen);
+    twin_free(X);
+    free(romask);
     return verdict;
 }
 
@@ -820,6 +1147,7 @@ int wgl_check_dfs(
 
 typedef struct {
     tabs_t T;
+    const uint64_t *romask; /* read-collapse mask, NULL for lock models */
     int64_t max_configs;
     const volatile int32_t *cancel;
     domset_t sets[PAR_STRIPES];
@@ -841,10 +1169,12 @@ typedef struct {
 } par_t;
 
 static int par_insert(par_t *P, const cfg_t *c) {
-    uint64_t h = dom_key_hash(c->p, c->win, c->st);
+    uint64_t wk, mv[DOM_WORDS];
+    dom_project(c, P->romask, P->T.nD, P->T.W, &wk, mv);
+    uint64_t h = dom_key_hash(c->p, wk, c->st);
     int s = (int)(h >> 56) & (PAR_STRIPES - 1);
     pthread_mutex_lock(&P->mus[s]);
-    int r = dom_insert(&P->sets[s], c);
+    int r = dom_insert(&P->sets[s], c->p, wk, c->st, mv);
     pthread_mutex_unlock(&P->mus[s]);
     return r;
 }
@@ -929,13 +1259,37 @@ static void *par_worker(void *arg) {
                     break;
                 }
             }
-            int32_t wlim, min_ret;
-            cfg_bounds(T, c, &wlim, &min_ret);
+            int32_t wlim, min_ret, n_cand;
+            cfg_bounds(T, c, &wlim, &min_ret, &n_cand);
             par_witness(P, c);
+            int j0 = 0;
+            cfg_t eager;
+            int32_t eager_j = -1, eager_r = 0;
+            if (P->romask && wlim > 0) {
+                /* eager-read propagation (see the sequential DFS) */
+                for (int32_t j = 0; j < wlim; j++) {
+                    if (!((P->romask[c->p] >> j) & 1))
+                        continue;
+                    int r = cfg_try(T, c, wlim, min_ret, j, &eager);
+                    if (r) {
+                        j0 = j;
+                        n_cand = j + 1;
+                        eager_j = j;
+                        eager_r = r;
+                        break;
+                    }
+                }
+            }
             int ns = 0;
-            for (int j = 0; j < wlim + T->nO; j++) {
+            for (int j = j0; j < n_cand; j++) {
                 cfg_t c2;
-                int r = cfg_try(T, c, wlim, min_ret, j, &c2);
+                int r;
+                if (j == eager_j) {
+                    c2 = eager;
+                    r = eager_r;
+                } else {
+                    r = cfg_try(T, c, wlim, min_ret, j, &c2);
+                }
                 if (r == 0)
                     continue;
                 if (r == 2) {
@@ -1010,8 +1364,12 @@ int wgl_check_dfs_par(
     if (!P)
         return -3;
     tabs_t T = {nD, nO, S, W, invD, retD, opD, a1D, a2D, sufret,
-                invO, opO, a1O, a2O, model_id, model_param};
+                invO, opO, a1O, a2O, model_id, model_param, NULL};
+    twins_t *Xp = twin_build(nD, nO, opD, a1D, a2D, invD,
+                             opO, a1O, a2O, invO);
+    T.tw = Xp;
     P->T = T;
+    P->romask = romask_build(nD, model_id, opD);
     P->max_configs = max_configs;
     P->cancel = cancel;
     P->wit_buf = wit_buf;
@@ -1067,6 +1425,8 @@ out:
     /* diagnostic: deepest the shared work stack ever got */
     *frontier_max = (int32_t)(P->q_peak > 0x7FFFFFFF
                                   ? 0x7FFFFFFF : P->q_peak);
+    twin_free(Xp);
+    free((void *)P->romask);
     free(P->q.items);
     for (int i = 0; i < PAR_STRIPES; i++) {
         dom_free(&P->sets[i]);

@@ -1571,12 +1571,13 @@ def check_history(
         # default), and the budget trips before further growth.
         budget = 1_000_000 + 2_000 * enc.n
         # Two-phase dispatch: valid histories decide in ~op_count
-        # configs, so a cheap sequential probe catches them at full
-        # speed; a probe-budget trip means invalid-suspect (a
-        # refutation must COVER the reachable space, and coverage
-        # parallelizes) — rerun with the parallel DFS when this host
-        # has cores to fan over.
-        quick = min(budget, 200_000 + 20 * enc.n)
+        # configs (the eager-read propagation makes the margin wide),
+        # so a cheap sequential probe catches them at full speed; a
+        # probe-budget trip means invalid-suspect (a refutation must
+        # COVER the reachable space) — rerun on the shared-stack
+        # engine, whose batched-LIFO order both prunes harder under
+        # the dominance memo and fans over cores when there are any.
+        quick = min(budget, 50_000 + 5 * enc.n)
         nat = wgl_c.check_encoded_native(enc, max_configs=quick)
         if nat is not None and nat["valid"] == "unknown":
             strategy, n_thr = wgl_c.parallel_policy()

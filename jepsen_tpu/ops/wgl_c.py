@@ -54,11 +54,14 @@ def _model_id(model: Model):
 
 def parallel_policy() -> tuple[str, int]:
     """The ONE place the parallel-dispatch policy lives: (strategy,
-    n_threads) for a full-budget search on this host — the fanned DFS
-    when there are cores to fan over, the sequential engine otherwise
-    (thread+lock overhead only costs on small hosts)."""
-    n_thr = min(8, os.cpu_count() or 1)
-    return ("dfs-par" if n_thr >= 4 else "dfs"), n_thr
+    n_threads) for a full-budget search on this host. The shared-stack
+    engine wins refutations even on a single core: its batched-LIFO
+    pops interleave sibling subtrees, an order under which the
+    dominance memo prunes ~3x more configs than the strict depth-first
+    descent (measured on the 10k-op invalid twin: 0.5M vs 1.5M configs,
+    0.35 s vs 0.84 s at 1 thread), and with real cores the coverage
+    additionally fans out."""
+    return "dfs-par", max(2, min(8, os.cpu_count() or 1))
 
 
 def check_encoded_native(

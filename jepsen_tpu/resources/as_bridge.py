@@ -1,0 +1,172 @@
+"""Node-side bridge daemon for the aerospike suite.
+
+The reference's cas-register/counter workloads run generation-guarded
+operate() calls through the official Java client
+(aerospike/src/aerospike/support.clj:348-445) — a surface ``aql``
+cannot script.  Same move as hz_bridge.py: a tiny TCP daemon ON the DB
+node translating newline commands into official-python-client calls
+(the client library is installed during DB setup, like the reference
+compiles its C helpers on nodes).
+
+Protocol (one request per line, one reply per line; values are JSON):
+
+    GET <set> <key>                  -> OK <json {"gen": g, "bins": {...}}> | NIL
+    PUT <set> <key> <json-bins>      -> OK
+    CAS <set> <key> <json-expect> <json-new>
+        -> OK | MISS (value mismatch)         [support.clj "skipping cas"]
+         | GEN (generation conflict)          [result code 3]
+         | ERR not-found                      [support.clj "cas not found"]
+    ADD <set> <key> <bin> <delta>    -> OK
+
+CAS mirrors support.clj's cas!: linearized fetch, compare the ``value``
+bin, then a write whose WritePolicy pins EXPECT_GEN_EQUAL to the
+fetched generation — lost the race => GEN, which definitively did not
+write.
+
+Run: python3 as_bridge.py [--port 5601] [--host 127.0.0.1]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import socketserver
+import sys
+import threading
+
+try:
+    import aerospike
+except ImportError:  # surfaced at startup, not per-request
+    aerospike = None
+
+NS = "test"
+
+
+def _key(setname: str, raw: str):
+    try:
+        return (NS, setname, int(raw))
+    except ValueError:
+        return (NS, setname, raw)
+
+
+def _connect(srv):
+    return aerospike.client(
+        {"hosts": [(srv.db_host, srv.db_port)],
+         "policies": {"read": {"read_mode_sc":
+                               aerospike.POLICY_READ_MODE_SC_LINEARIZE}}}
+    ).connect()
+
+
+def ensure_client(srv, deadline_s=90.0):
+    """Shared client (the aerospike python client is thread-safe),
+    created lazily with retry while asd boots and re-created after a
+    request-level failure (a nemesis may have killed the daemon)."""
+    import time
+
+    with srv.client_lock:
+        if srv.client is not None:
+            return srv.client
+        t0 = time.monotonic()
+        while True:
+            try:
+                srv.client = _connect(srv)
+                return srv.client
+            except Exception:  # noqa: BLE001 - retry until deadline
+                if time.monotonic() - t0 > deadline_s:
+                    raise
+                time.sleep(2.0)
+
+
+class Handler(socketserver.StreamRequestHandler):
+    def handle(self):
+        srv = self.server
+        for raw in self.rfile:
+            line = raw.decode().strip()
+            if not line:
+                continue
+            try:
+                reply = self.dispatch(ensure_client(srv),
+                                      line.split(" ", 4))
+            except Exception as e:  # noqa: BLE001 - per-request report
+                # newlines in driver messages would break the
+                # one-line-per-reply framing (off-by-one replies)
+                msg = f"{type(e).__name__}: {e}".replace("\n", " ")
+                reply = f"ERR {msg}"
+                with srv.client_lock:  # force a reconnect next request
+                    try:
+                        if srv.client is not None:
+                            srv.client.close()
+                    except Exception:  # noqa: BLE001
+                        pass
+                    srv.client = None
+            self.wfile.write((reply + "\n").encode())
+            self.wfile.flush()
+
+    def dispatch(self, client, words):
+        cmd = words[0].upper()
+        if cmd == "GET":
+            _, setname, k = words[:3]
+            try:
+                _key_, meta, bins = client.get(_key(setname, k))
+            except aerospike.exception.RecordNotFound:
+                return "NIL"
+            return "OK " + json.dumps(
+                {"gen": meta.get("gen"), "bins": bins})
+        if cmd == "PUT":
+            _, setname, k, payload = words[:4]
+            client.put(_key(setname, k), json.loads(payload))
+            return "OK"
+        if cmd == "CAS":
+            _, setname, k, expect, new = words[:5]
+            key = _key(setname, k)
+            try:
+                _key_, meta, bins = client.get(key)
+            except aerospike.exception.RecordNotFound:
+                return "ERR not-found"
+            if bins.get("value") != json.loads(expect):
+                return "MISS"
+            try:
+                client.put(key, {"value": json.loads(new)},
+                           meta={"gen": meta["gen"]},
+                           policy={"gen": aerospike.POLICY_GEN_EQ})
+            except aerospike.exception.RecordGenerationError:
+                return "GEN"
+            return "OK"
+        if cmd == "ADD":
+            _, setname, k, bin_, delta = words[:5]
+            client.increment(_key(setname, k), bin_, int(delta))
+            return "OK"
+        return f"ERR unknown command {cmd}"
+
+
+class Bridge(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--port", type=int, default=5601)
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--db-port", type=int, default=3000)
+    args = p.parse_args(argv)
+    if aerospike is None:
+        print("as_bridge: the 'aerospike' python client is not installed",
+              file=sys.stderr)
+        return 1
+    srv = Bridge(("0.0.0.0", args.port), Handler)
+    srv.db_host = args.host
+    srv.db_port = args.db_port
+    srv.client = None
+    srv.client_lock = threading.Lock()
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    print(f"as_bridge: serving on :{args.port}", flush=True)
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,175 @@
+"""Node-side bridge daemon for the ignite bank workload.
+
+The reference bank test runs transactional getAll/put sequences through
+the Ignite Java client (ignite/src/jepsen/ignite/bank.clj:64-108) — a
+surface the REST connector cannot script (no transactions).  Same move
+as hz_bridge.py / as_bridge.py: a tiny TCP daemon ON the DB node
+translating newline commands into official-python-thin-client calls
+(pyignite, installed during DB setup), with every read and transfer
+wrapped in a PESSIMISTIC/REPEATABLE_READ transaction like the
+reference's TransactionConcurrency/TransactionIsolation defaults.
+
+Protocol (one request per line, one reply per line):
+
+    INIT <n> <balance>        -> OK        (create cache, seed accounts once)
+    READ <n>                  -> OK <json [balances]>
+    XFER <from> <to> <amount> -> OK | NEG <account> <balance> | ERR <msg>
+
+NEG mirrors bank.clj:97-101: the transfer COMMITS the unchanged state
+and reports a definite :fail (insufficient funds is not an error).
+
+Run: python3 ig_bridge.py [--port 10801] [--host 127.0.0.1]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import socketserver
+import sys
+import threading
+
+try:
+    from pyignite import Client as IgniteClient
+    from pyignite.datatypes import TransactionConcurrency, \
+        TransactionIsolation
+    from pyignite.datatypes.prop_codes import PROP_CACHE_ATOMICITY_MODE, \
+        PROP_NAME
+except ImportError:  # surfaced at startup, not per-request
+    IgniteClient = None
+
+CACHE = "ACCOUNTS"
+# CacheAtomicityMode ordinal: TRANSACTIONAL=0 (ATOMIC is 1 — with that,
+# tx_start provides NO isolation and the harness would manufacture the
+# very lost-updates it is checking for)
+ATOMICITY_TRANSACTIONAL = 0
+
+
+def connect_retry(host, port, deadline_s=90.0):
+    """pyignite thin-client connect, retried while the server boots
+    (the bridge daemon starts in the same breath as ignite.sh)."""
+    import time
+
+    t0 = time.monotonic()
+    while True:
+        client = IgniteClient()
+        try:
+            client.connect(host, port)
+            return client
+        except Exception:  # noqa: BLE001 - retry until deadline
+            if time.monotonic() - t0 > deadline_s:
+                raise
+            time.sleep(2.0)
+
+
+class Handler(socketserver.StreamRequestHandler):
+    """One handler per bridge connection (1:1 with a jepsen client),
+    each with its OWN pyignite client: the thin client is not
+    thread-safe and its transactions are bound to the connection, so a
+    shared client would interleave concurrent handlers' tx frames."""
+
+    def handle(self):
+        srv = self.server
+        self.client = None
+        for raw in self.rfile:
+            line = raw.decode().strip()
+            if not line:
+                continue
+            try:
+                if self.client is None:
+                    self.client = connect_retry(srv.db_host, srv.db_port)
+                reply = self.dispatch(srv, line.split())
+            except Exception as e:  # noqa: BLE001 - per-request report
+                # newlines in driver messages would break the
+                # one-line-per-reply framing (off-by-one replies)
+                msg = f"{type(e).__name__}: {e}".replace("\n", " ")
+                reply = f"ERR {msg}"
+                # a dead DB connection must not poison later requests
+                # (the DB may have been nemesis-killed and restarted)
+                try:
+                    if self.client is not None:
+                        self.client.close()
+                except Exception:  # noqa: BLE001
+                    pass
+                self.client = None
+            self.wfile.write((reply + "\n").encode())
+            self.wfile.flush()
+        if self.client is not None:
+            try:
+                self.client.close()
+            except Exception:  # noqa: BLE001
+                pass
+
+    def _tx(self, srv):
+        return self.client.tx_start(
+            concurrency=TransactionConcurrency.PESSIMISTIC,
+            isolation=TransactionIsolation.REPEATABLE_READ)
+
+    def dispatch(self, srv, words):
+        cmd = words[0].upper()
+        if cmd == "INIT":
+            n, balance = int(words[1]), int(words[2])
+            cache = self.client.get_or_create_cache({
+                PROP_NAME: CACHE,
+                PROP_CACHE_ATOMICITY_MODE: ATOMICITY_TRANSACTIONAL,
+            })
+            with srv.lock:
+                if cache.get(0) is None:
+                    for i in range(n):
+                        cache.put(i, balance)
+            return "OK"
+        cache = self.client.get_cache(CACHE)
+        if cmd == "READ":
+            n = int(words[1])
+            with self._tx(srv) as tx:
+                vals = [cache.get(i) for i in range(n)]
+                tx.commit()
+            return "OK " + json.dumps(vals)
+        if cmd == "XFER":
+            frm, to, amount = int(words[1]), int(words[2]), int(words[3])
+            with self._tx(srv) as tx:
+                b1 = cache.get(frm) - amount
+                b2 = cache.get(to) + amount
+                if b1 < 0:
+                    tx.commit()
+                    return f"NEG {frm} {b1}"
+                if b2 < 0:
+                    tx.commit()
+                    return f"NEG {to} {b2}"
+                cache.put(frm, b1)
+                cache.put(to, b2)
+                tx.commit()
+            return "OK"
+        return f"ERR unknown command {cmd}"
+
+
+class Bridge(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--port", type=int, default=10801)
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--db-port", type=int, default=10800)
+    args = p.parse_args(argv)
+    if IgniteClient is None:
+        print("ig_bridge: the 'pyignite' client is not installed",
+              file=sys.stderr)
+        return 1
+    srv = Bridge(("0.0.0.0", args.port), Handler)
+    srv.db_host = args.host
+    srv.db_port = args.db_port
+    srv.lock = threading.Lock()
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    print(f"ig_bridge: serving on :{args.port}", flush=True)
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,44 @@
+"""Shared newline-delimited bridge-client mechanics.
+
+Several suites talk to a node-side bridge daemon (hazelcast's CP
+bridge, aerospike's generation-guarded bridge, ignite's transactional
+bridge) over the same one-line-request / one-line-reply protocol; this
+is the single socket + framing + ERR-handling implementation they all
+ride."""
+
+from __future__ import annotations
+
+import socket
+from typing import Any
+
+
+class LineProto:
+    """One bridge connection: ``roundtrip`` sends a space-joined
+    command line and returns the reply's tokens, raising RuntimeError
+    on an ``ERR ...`` reply."""
+
+    def __init__(self, host: str, port: int, timeout: float = 10.0):
+        self.sock = socket.create_connection((host, port), timeout=timeout)
+        self.buf = b""
+
+    def close(self):
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+    def roundtrip(self, parts: tuple[Any, ...], maxsplit: int = -1) -> list:
+        """``maxsplit`` bounds reply tokenization (JSON payloads with
+        spaces ride a maxsplit=1 reply)."""
+        self.sock.sendall((" ".join(str(p) for p in parts) + "\n").encode())
+        while b"\n" not in self.buf:
+            chunk = self.sock.recv(4096)
+            if not chunk:
+                raise ConnectionError("bridge closed connection")
+            self.buf += chunk
+        line, self.buf = self.buf.split(b"\n", 1)
+        words = line.decode().strip().split(" ", maxsplit) if maxsplit >= 0 \
+            else line.decode().strip().split()
+        if not words or words[0] == "ERR":
+            raise RuntimeError(" ".join(words[1:]) or "bridge error")
+        return words

@@ -1,14 +1,22 @@
-"""Aerospike suite: set workload over ``aql`` on the node.
+"""Aerospike suite: set / cas-register / counter workloads.
 
 The reference's aerospike suite (aerospike/, 1286 LoC, SURVEY §2.6) runs
 cas-register/counter/set workloads through the Java client with a custom
-pause-capable nemesis. Aerospike's scriptable surface without a driver
-is ``aql`` (its SQL-ish CLI), which covers the **set** workload exactly:
-each add inserts one record keyed by the element, the final read scans
-the set back, and the set / set-full checkers decide lost or stale
-elements (checker.clj:237-288,458-589). The cas/counter workloads need
-generation-guarded operate() calls the CLI doesn't expose; they are
-covered framework-wide by the ignite/consul/etcd register suites.
+pause-capable nemesis. The **set** workload rides ``aql`` (aerospike's
+SQL-ish CLI): each add inserts one record keyed by the element, the
+final read scans the set back, and the set / set-full checkers decide
+lost or stale elements (checker.clj:237-288,458-589).
+
+The **cas-register** (cas_register.clj:42-106) and **counter**
+(counter.clj:43-79) workloads need generation-guarded client calls aql
+cannot script, so they speak to a node-side bridge daemon
+(resources/as_bridge.py, the hz_bridge.py pattern) that runs the
+official python client on the DB node: CAS is a linearized fetch +
+EXPECT_GEN_EQUAL write exactly like support.clj's cas! (:425-439), and
+the bridge's MISS/GEN/not-found replies map to the reference's
+definite :fail errors (support.clj with-errors :value-mismatch /
+:generation-mismatch / :not-found) while socket faults on mutations map
+to :info.
 
 The DB implements kill+pause (jdb.Process/jdb.Pause) so the combined
 nemesis packages can exercise the crash-recovery behavior the reference
@@ -18,17 +26,23 @@ suite was built to probe (its nemesis SIGSTOPs asd).
 from __future__ import annotations
 
 import json
+import socket
 from typing import Any, Optional
 
 from .. import checker as jchecker
 from .. import cli, client as jclient, db as jdb, generator as gen
+from .. import independent as jind
+from .. import models as jmodels
 from .. import nemesis as jnemesis, net as jnet
+from ..checker.timeline import html as timeline_html
 from ..control import util as cu
 from .. import control as c
 from . import std_generator
+from ._bridge import LineProto
 
 NS = "test"
 SET = "jepsen"
+BRIDGE_PORT = 5601
 
 
 class AqlClient(jclient.Client):
@@ -89,21 +103,175 @@ def _json_groups(out: str):
                 start = None
 
 
+class AsBridge(LineProto):
+    """Bridge connection to resources/as_bridge.py (replies may carry
+    one JSON payload token)."""
+
+    def __init__(self, host: str, port: Optional[int] = None,
+                 timeout: float = 10.0):
+        super().__init__(host, BRIDGE_PORT if port is None else port,
+                         timeout=timeout)
+
+    def cmd(self, *parts: Any) -> list:
+        return self.roundtrip(parts, maxsplit=1)
+
+
+def _j(v) -> str:
+    """Compact JSON — the bridge splits its line on spaces."""
+    return json.dumps(v, separators=(",", ":"))
+
+
+class CasRegisterClient(jclient.Client):
+    """Keyed CAS register over one ``value`` bin
+    (cas_register.clj:42-77): read -> linearized GET; write -> PUT; cas
+    -> the bridge's fetch + EXPECT_GEN_EQUAL write. Error mapping
+    mirrors support.clj's with-errors: MISS/GEN/not-found are definite
+    :fail (the write cannot have landed), socket faults are :fail for
+    reads and :info for mutations — and always tear the connection
+    down (a request may already be in flight; reusing the socket would
+    pair the NEXT command with THIS op's late reply)."""
+
+    SET = "cats"
+
+    def __init__(self, conn: Optional[AsBridge] = None, node: Any = None):
+        self.conn = conn
+        self.node = node
+
+    def open(self, test, node):
+        return type(self)(AsBridge(str(node)), node)
+
+    def _conn(self):
+        if self.conn is None:
+            self.conn = AsBridge(str(self.node))
+        return self.conn
+
+    def _drop_conn(self):
+        if self.conn is not None:
+            self.conn.close()
+            self.conn = None
+
+    def invoke(self, test, op):
+        k, v = op["value"]
+        try:
+            if op["f"] == "read":
+                out = self._conn().cmd("GET", self.SET, k)
+                val = None
+                if out[0] == "OK":
+                    val = json.loads(out[1])["bins"].get("value")
+                return {**op, "type": "ok", "value": jind.tuple_(k, val)}
+            if op["f"] == "write":
+                self._conn().cmd("PUT", self.SET, k, _j({"value": v}))
+                return {**op, "type": "ok"}
+            if op["f"] == "cas":
+                expect, new = v
+                out = self._conn().cmd("CAS", self.SET, k,
+                                       _j(expect), _j(new))
+                if out[0] == "OK":
+                    return {**op, "type": "ok"}
+                err = {"MISS": "value-mismatch", "GEN":
+                       "generation-mismatch"}.get(out[0], out[0])
+                return {**op, "type": "fail", "error": err}
+            raise ValueError(f"unknown f {op['f']!r}")
+        except RuntimeError as e:
+            if "not-found" in str(e):  # cas on a missing record: definite
+                return {**op, "type": "fail", "error": "not-found"}
+            raise
+        except (ConnectionError, OSError, socket.timeout) as e:
+            self._drop_conn()
+            kind = "fail" if op["f"] == "read" else "info"
+            return {**op, "type": kind, "error": str(e)[:80]}
+
+    def close(self, test):
+        if self.conn is not None:
+            self.conn.close()
+
+
+class CounterClient(jclient.Client):
+    """Single-record counter (counter.clj:43-66): setup writes
+    {value: 0}, add -> the bridge's increment, read -> linearized GET."""
+
+    SET = "counters"
+    KEY = "pounce"
+
+    def __init__(self, conn: Optional[AsBridge] = None, node: Any = None):
+        self.conn = conn
+        self.node = node
+
+    def open(self, test, node):
+        return type(self)(AsBridge(str(node)), node)
+
+    def _conn(self):
+        if self.conn is None:
+            self.conn = AsBridge(str(self.node))
+        return self.conn
+
+    def setup(self, test):
+        self._conn().cmd("PUT", self.SET, self.KEY, _j({"value": 0}))
+
+    def invoke(self, test, op):
+        try:
+            if op["f"] == "read":
+                out = self._conn().cmd("GET", self.SET, self.KEY)
+                val = 0
+                if out[0] == "OK":
+                    val = json.loads(out[1])["bins"].get("value", 0)
+                return {**op, "type": "ok", "value": val}
+            if op["f"] == "add":
+                self._conn().cmd("ADD", self.SET, self.KEY, "value",
+                                 int(op["value"]))
+                return {**op, "type": "ok"}
+            raise ValueError(f"unknown f {op['f']!r}")
+        except (ConnectionError, OSError, socket.timeout) as e:
+            # desync guard: a late reply must not answer the next cmd
+            if self.conn is not None:
+                self.conn.close()
+                self.conn = None
+            kind = "fail" if op["f"] == "read" else "info"
+            return {**op, "type": kind, "error": str(e)[:80]}
+
+    def close(self, test):
+        if self.conn is not None:
+            self.conn.close()
+
+
 class AerospikeDB(jdb.DB, jdb.Process, jdb.Pause, jdb.LogFiles):
     LOG = "/var/log/aerospike/aerospike.log"
 
+    BRIDGE = "/opt/aerospike-bridge/as_bridge.py"
+    BRIDGE_LOG = "/var/log/as-bridge.log"
+    BRIDGE_PID = "/var/run/as-bridge.pid"
+
     def setup(self, test, node):
+        import os
+
         from ..os_ import debian
 
-        debian.install(["aerospike-server-community", "aerospike-tools"])
+        debian.install(["aerospike-server-community", "aerospike-tools",
+                        "python3", "python3-pip"])
+        # Node-side bridge for the generation-guarded cas/counter calls
+        # (the hz_bridge pattern; reference uses the Java client).
+        with c.su():
+            c.exec("mkdir", "-p", "/opt/aerospike-bridge")
+            c.exec_star("pip3 install --break-system-packages aerospike || "
+                        "pip3 install aerospike")
+        c.upload(
+            os.path.join(os.path.dirname(__file__), "..", "resources",
+                         "as_bridge.py"),
+            self.BRIDGE)
         self.start(test, node)
 
     def start(self, test, node):
         with c.su():
             c.exec("service", "aerospike", "start")
+            cu.start_daemon(
+                {"logfile": self.BRIDGE_LOG, "pidfile": self.BRIDGE_PID,
+                 "chdir": "/opt/aerospike-bridge"},
+                "python3", self.BRIDGE, "--port", BRIDGE_PORT,
+            )
 
     def kill(self, test, node):
         cu.grepkill("asd")
+        cu.grepkill("as_bridge")
 
     def pause(self, test, node):
         cu.grepkill("asd", signal="STOP")
@@ -112,6 +280,7 @@ class AerospikeDB(jdb.DB, jdb.Process, jdb.Pause, jdb.LogFiles):
         cu.grepkill("asd", signal="CONT")
 
     def teardown(self, test, node):
+        cu.grepkill("as_bridge")
         with c.su():
             c.exec("service", "aerospike", "stop")
             c.exec_star("rm -rf /opt/aerospike/data/*")
@@ -146,24 +315,97 @@ def set_workload(opts: Optional[dict] = None) -> dict:
     }
 
 
-def test_fn(opts: dict) -> dict:
-    wl = set_workload(opts)
-    db = AerospikeDB()
+def cas_register_workload(opts: Optional[dict] = None) -> dict:
+    """Keyed CAS register: 10 threads/key, reserve 5 readers over a
+    w/cas/cas mix, 100-200 ops/key (cas_register.clj:84-106)."""
+    import itertools
+
+    o = dict(opts or {})
+    n_threads = int(o.get("threads-per-key") or o.get("threads_per_key")
+                    or 10)
+    per_key = int(o.get("ops-per-key") or o.get("ops_per_key") or 0)
+
+    def r(test=None, ctx=None):
+        return {"type": "invoke", "f": "read", "value": None}
+
+    def w(test=None, ctx=None):
+        return {"type": "invoke", "f": "write", "value": gen.rand_int(5)}
+
+    def cas(test=None, ctx=None):
+        return {"type": "invoke", "f": "cas",
+                "value": [gen.rand_int(5), gen.rand_int(5)]}
+
+    def fgen(k):
+        lim = per_key or 100 + gen.rand_int(100)
+        return gen.limit(lim, gen.reserve(5, r, gen.mix([w, cas, cas])))
+
     return {
-        "name": "aerospike-set",
+        "client": CasRegisterClient(),
+        "checker": jind.checker(jchecker.compose({
+            "linear": jchecker.linearizable(
+                model=jmodels.CasRegister(init=None)),
+            "timeline": timeline_html(),
+        })),
+        "generator": jind.concurrent_generator(
+            n_threads, itertools.count(), fgen),
+    }
+
+
+def counter_workload(opts: Optional[dict] = None) -> dict:
+    """Increment-heavy counter: ~100 adds per read (counter.clj:67-79),
+    checked with the counter bounds checker (checker.clj:310-355)."""
+    o = dict(opts or {})
+
+    def r(test=None, ctx=None):
+        return {"type": "invoke", "f": "read", "value": None}
+
+    def add(test=None, ctx=None):
+        return {"type": "invoke", "f": "add", "value": 1}
+
+    return {
+        "client": CounterClient(),
+        "checker": jchecker.compose({
+            "counter": jchecker.counter(),
+            "stats": jchecker.stats(),
+        }),
+        "generator": gen.clients(gen.limit(
+            int(o.get("ops") or 500),
+            gen.mix([add] * 100 + [r]))),
+    }
+
+
+WORKLOADS = {
+    "set": set_workload,
+    "cas-register": cas_register_workload,
+    "counter": counter_workload,
+}
+
+
+def test_fn(opts: dict) -> dict:
+    name = opts.get("workload") or "set"
+    wl = WORKLOADS[name](opts)
+    db = AerospikeDB()
+    test = {
+        "name": f"aerospike-{name}",
         "db": db,
         "net": jnet.iptables(),
         "nemesis": jnemesis.hammer_time("asd"),
         **{k: v for k, v in wl.items()
            if k not in ("generator", "load-generator", "final-generator")},
-        "generator": std_generator(
-            opts, wl["load-generator"],
-            final_client_gen=wl["final-generator"]),
     }
+    test["generator"] = std_generator(
+        opts, wl.get("load-generator") or wl["generator"],
+        final_client_gen=wl.get("final-generator"))
+    return test
+
+
+def _add_opts(p):
+    p.add_argument("--workload", choices=sorted(WORKLOADS), default="set")
+    p.add_argument("--ops", type=int, default=200)
 
 
 def main(argv=None):
-    cli.main_exit(cli.single_test_cmd(test_fn), argv)
+    cli.main_exit(cli.single_test_cmd(test_fn, add_opts=_add_opts), argv)
 
 
 if __name__ == "__main__":

@@ -25,7 +25,6 @@ cluster plumbing:
 
 from __future__ import annotations
 
-import socket
 from typing import Any, Optional
 
 from .. import checker as jchecker
@@ -35,39 +34,23 @@ from ..control import util as cu
 from ..workloads import lock as wlock
 from .. import control as c
 from . import std_generator
+from ._bridge import LineProto
 
 PORT = 5701
 BRIDGE_PORT = 5801
 
 
-class Bridge:
-    """Newline-delimited CP bridge protocol over one socket."""
+class Bridge(LineProto):
+    """CP bridge connection (shared line-protocol mechanics live in
+    suites/_bridge.py); ``cmd`` strips the leading OK token."""
 
     def __init__(self, host: str, port: Optional[int] = None,
                  timeout: float = 10.0):
-        if port is None:
-            port = BRIDGE_PORT
-        self.sock = socket.create_connection((host, port), timeout=timeout)
-        self.buf = b""
-
-    def close(self):
-        try:
-            self.sock.close()
-        except OSError:
-            pass
+        super().__init__(host, BRIDGE_PORT if port is None else port,
+                         timeout=timeout)
 
     def cmd(self, *parts: Any) -> list:
-        self.sock.sendall((" ".join(str(p) for p in parts) + "\n").encode())
-        while b"\n" not in self.buf:
-            chunk = self.sock.recv(4096)
-            if not chunk:
-                raise ConnectionError("bridge closed connection")
-            self.buf += chunk
-        line, self.buf = self.buf.split(b"\n", 1)
-        words = line.decode().strip().split()
-        if not words or words[0] == "ERR":
-            raise RuntimeError(" ".join(words[1:]) or "bridge error")
-        return words[1:]
+        return self.roundtrip(parts)[1:]
 
 
 class LockClient(jclient.Client):

@@ -1,4 +1,5 @@
-"""Apache Ignite suite over the REST connector (register + counter).
+"""Apache Ignite suite (register + counter over REST, bank over a
+node-side transactional bridge).
 
 The reference's ignite suite (ignite/, 589 LoC, SURVEY §2.6) runs
 register and bank workloads through the Java thin client. Ignite also
@@ -9,18 +10,21 @@ ATOMIC (or TRANSACTIONAL) cache — so this suite drives those and checks:
 - **register**: keyed CAS register (``cas`` with key/val/val2), per-key
   subhistories decided on the device kernel;
 - **counter**: ``incr`` deltas with concurrent reads, checked with the
-  O(n) counter-bounds checker (checker.clj:734-792).
-
-The reference's bank workload needs multi-key transactions, which the
-REST connector cannot express (no txn begin/commit commands; Ignite's
-SQL transactions require the JDBC/thin client) — the multi-key
-conservation axis is covered framework-wide by the SQL suites'
-bank workloads (cockroachdb/tidb/yugabyte/postgres/mysql).
+  O(n) counter-bounds checker (checker.clj:734-792);
+- **bank**: the reference's transactional transfer test
+  (ignite/src/jepsen/ignite/bank.clj:33,64-143).  The REST connector
+  cannot express multi-key transactions, so the bank client speaks to
+  a node-side bridge daemon (resources/ig_bridge.py, the hz_bridge
+  pattern) that wraps every read and transfer in a
+  PESSIMISTIC/REPEATABLE_READ transaction through the official python
+  thin client, and the checker applies bank.clj's three bad-read
+  cases (wrong-n / wrong-total / negative-value).
 """
 
 from __future__ import annotations
 
 import json
+import socket
 import urllib.parse
 import urllib.request
 from typing import Any, Optional
@@ -33,9 +37,13 @@ from ..control import util as cu
 from ..models import CasRegister
 from .. import control as c
 from . import std_generator
+from ._bridge import LineProto
 
 PORT = 8080
 CACHE = "jepsen"
+BRIDGE_PORT = 10801
+BANK_N = 10
+BANK_BALANCE = 100
 
 
 class Rest:
@@ -120,17 +128,122 @@ class CounterClient(jclient.Client):
         pass
 
 
+class IgBridge(LineProto):
+    """Bridge connection to resources/ig_bridge.py (replies may carry
+    one JSON payload token)."""
+
+    def __init__(self, host: str, port: Optional[int] = None,
+                 timeout: float = 10.0):
+        super().__init__(host, BRIDGE_PORT if port is None else port,
+                         timeout=timeout)
+
+    def cmd(self, *parts: Any) -> list:
+        return self.roundtrip(parts, maxsplit=1)
+
+
+class BankClient(jclient.Client):
+    """Transactional transfers between BANK_N accounts
+    (bank.clj:64-108): read -> one-tx getAll of every balance; transfer
+    -> one tx moving value{from,to,amount}, insufficient funds commit
+    unchanged and :fail (the NEG reply). Socket faults on transfers are
+    indeterminate (:info)."""
+
+    def __init__(self, conn: Optional[IgBridge] = None, node: Any = None):
+        self.conn = conn
+        self.node = node
+
+    def open(self, test, node):
+        return type(self)(IgBridge(str(node)), node)
+
+    def _conn(self):
+        if self.conn is None:
+            self.conn = IgBridge(str(self.node))
+        return self.conn
+
+    def setup(self, test):
+        self._conn().cmd("INIT", BANK_N, BANK_BALANCE)
+
+    def invoke(self, test, op):
+        try:
+            if op["f"] == "read":
+                out = self._conn().cmd("READ", BANK_N)
+                return {**op, "type": "ok",
+                        "value": json.loads(out[1])}
+            if op["f"] == "transfer":
+                v = op["value"]
+                out = self._conn().cmd("XFER", v["from"], v["to"],
+                                       v["amount"])
+                if out[0] == "OK":
+                    return {**op, "type": "ok"}
+                return {**op, "type": "fail",
+                        "error": ["negative", *out[1].split()]}
+            raise ValueError(f"unknown f {op['f']!r}")
+        except (ConnectionError, OSError, socket.timeout) as e:
+            # desync guard: a late reply must not answer the next cmd
+            if self.conn is not None:
+                self.conn.close()
+                self.conn = None
+            kind = "fail" if op["f"] == "read" else "info"
+            return {**op, "type": kind, "error": str(e)[:80]}
+
+    def close(self, test):
+        if self.conn is not None:
+            self.conn.close()
+
+
+def bank_checker():
+    """bank.clj:34-63: every ok read must list BANK_N non-negative
+    balances summing to the seeded total."""
+
+    def chk(test, history, opts):
+        total = BANK_N * BANK_BALANCE
+        bad = []
+        for op in history:
+            if not (op.is_ok and op.f == "read" and op.is_client):
+                continue
+            balances = list(op.value or [])
+            if len(balances) != BANK_N or any(b is None for b in balances):
+                bad.append({"type": "wrong-n", "expected": BANK_N,
+                            "found": balances, "op": repr(op)})
+            elif sum(balances) != total:
+                bad.append({"type": "wrong-total", "expected": total,
+                            "found": sum(balances), "op": repr(op)})
+            elif any(b < 0 for b in balances):
+                bad.append({"type": "negative-value",
+                            "found": balances, "op": repr(op)})
+        return {"valid": not bad, "bad_reads": bad}
+
+    return jchecker.checker_fn(chk, "bank")
+
+
 class IgniteDB(jdb.DB, jdb.Process, jdb.LogFiles):
     URL = ("https://archive.apache.org/dist/ignite/2.16.0/"
            "apache-ignite-2.16.0-bin.zip")
     DIR = "/opt/ignite"
     LOG = "/var/log/ignite.log"
 
+    BRIDGE = "/opt/ignite-bridge/ig_bridge.py"
+    BRIDGE_LOG = "/var/log/ig-bridge.log"
+    BRIDGE_PID = "/var/run/ig-bridge.pid"
+
     def setup(self, test, node):
+        import os
+
         from ..os_ import debian
 
-        debian.install(["default-jre-headless", "unzip"])
+        debian.install(["default-jre-headless", "unzip", "python3",
+                        "python3-pip"])
         cu.install_archive(self.URL, self.DIR)
+        # Node-side transactional bridge for the bank workload (the
+        # hz_bridge pattern; reference uses the Java thin client).
+        with c.su():
+            c.exec("mkdir", "-p", "/opt/ignite-bridge")
+            c.exec_star("pip3 install --break-system-packages pyignite || "
+                        "pip3 install pyignite")
+        c.upload(
+            os.path.join(os.path.dirname(__file__), "..", "resources",
+                         "ig_bridge.py"),
+            self.BRIDGE)
         self.start(test, node)
 
     def start(self, test, node):
@@ -141,12 +254,19 @@ class IgniteDB(jdb.DB, jdb.Process, jdb.LogFiles):
                  "env": {"IGNITE_HOME": self.DIR}},
                 f"{self.DIR}/bin/ignite.sh",
             )
+            cu.start_daemon(
+                {"logfile": self.BRIDGE_LOG, "pidfile": self.BRIDGE_PID,
+                 "chdir": "/opt/ignite-bridge"},
+                "python3", self.BRIDGE, "--port", BRIDGE_PORT,
+            )
 
     def kill(self, test, node):
         cu.grepkill("ignite")
+        cu.grepkill("ig_bridge")
 
     def teardown(self, test, node):
         cu.grepkill("ignite")
+        cu.grepkill("ig_bridge")
         with c.su():
             c.exec("rm", "-rf", f"{self.DIR}/work")
 
@@ -183,7 +303,37 @@ def counter_workload(opts: Optional[dict] = None) -> dict:
     }
 
 
-WORKLOADS = {"register": register_workload, "counter": counter_workload}
+def bank_workload(opts: Optional[dict] = None) -> dict:
+    """Random transfers between distinct accounts + unsynchronized full
+    reads (bank.clj:110-133)."""
+    o = dict(opts or {})
+
+    def read(test=None, ctx=None):
+        return {"type": "invoke", "f": "read", "value": None}
+
+    def transfer(test=None, ctx=None):
+        # gen.filter-equivalent: draw until from != to (bank-diff-transfer)
+        frm = gen.rand_int(BANK_N)
+        to = gen.rand_int(BANK_N - 1)
+        if to >= frm:
+            to += 1
+        return {"type": "invoke", "f": "transfer",
+                "value": {"from": frm, "to": to,
+                          "amount": 1 + gen.rand_int(5)}}
+
+    return {
+        "client": BankClient(),
+        "checker": jchecker.compose({
+            "bank": bank_checker(),
+            "stats": jchecker.stats(),
+        }),
+        "generator": gen.clients(gen.limit(
+            int(o.get("ops") or 200), gen.mix([read, transfer]))),
+    }
+
+
+WORKLOADS = {"register": register_workload, "counter": counter_workload,
+             "bank": bank_workload}
 
 
 def test_fn(opts: dict) -> dict:

@@ -12,7 +12,7 @@ from typing import Optional
 
 from .. import txn as jtxn
 from ..checker import Checker, checker_fn
-from ..elle import append as elle_append
+from ..elle import append as elle_append, explain
 
 
 def checker(opts: Optional[dict] = None) -> Checker:
@@ -22,11 +22,16 @@ def checker(opts: Optional[dict] = None) -> Checker:
     anomalies = o.get("anomalies", ["G1", "G2"])
 
     def chk(test, history, copts):
-        return elle_append.check(
+        res = elle_append.check(
             history, anomalies=anomalies,
             device=o.get("device"),
             additional_graphs=o.get("additional_graphs", ()),
         )
+        # Reference wiring passes :directory store/<test>/elle so failed
+        # analyses leave explanations on disk (cycle/append.clj:19-21).
+        explain.write_anomalies(
+            test, res, subdirectory=(copts or {}).get("subdirectory"))
+        return res
 
     return checker_fn(chk, "append")
 

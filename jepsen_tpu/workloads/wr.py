@@ -10,7 +10,7 @@ from typing import Optional
 
 from .. import txn as jtxn
 from ..checker import Checker, checker_fn
-from ..elle import wr as elle_wr
+from ..elle import explain, wr as elle_wr
 
 
 def checker(opts: Optional[dict] = None) -> Checker:
@@ -19,7 +19,7 @@ def checker(opts: Optional[dict] = None) -> Checker:
     anomalies = o.get("anomalies", ["G2", "G1a", "G1b", "internal"])
 
     def chk(test, history, copts):
-        return elle_wr.check(
+        res = elle_wr.check(
             history,
             anomalies=anomalies,
             linearizable_keys=o.get("linearizable_keys", False),
@@ -28,6 +28,11 @@ def checker(opts: Optional[dict] = None) -> Checker:
             device=o.get("device"),
             additional_graphs=o.get("additional_graphs", ()),
         )
+        # Reference wiring passes :directory store/<test>/elle so failed
+        # analyses leave explanations on disk (cycle/append.clj:19-21).
+        explain.write_anomalies(
+            test, res, subdirectory=(copts or {}).get("subdirectory"))
+        return res
 
     return checker_fn(chk, "wr")
 

@@ -94,7 +94,10 @@ class TestBasicCas:
 
     def test_valid(self, result):
         test, _, _ = result
-        assert test["results"]["valid"] is True
+        # stats may be "unknown" in the (astronomically unlikely but
+        # possible) run where all ~150 cas ops miss; linearizability is
+        # the deterministic guarantee.
+        assert test["results"]["valid"] is not False
         assert test["results"]["linear"]["valid"] is True
 
     def test_first_read(self, result):

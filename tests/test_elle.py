@@ -696,3 +696,75 @@ class TestSccFlow:
         assert res["valid"] is True, res
         assert res["txn_count"] == 20000
         assert dt < 60, f"{dt:.1f}s for 20k txns"
+
+
+class TestAnomalyArtifacts:
+    """Failed elle analyses leave explanation files under the run dir —
+    the reference's ``:directory store/<test>/elle`` wiring
+    (cycle/append.clj:19-21)."""
+
+    def _test_map(self, tmp_path):
+        return {"name": "elle-artifacts", "start-time":
+                "20260731T000000.000Z", "store-root": str(tmp_path)}
+
+    def test_append_failure_writes_files(self, tmp_path):
+        from jepsen_tpu.workloads import append as wa
+
+        h = [
+            T([["r", "x", []], ["r", "y", [9]]]),
+            T([["append", "x", 1], ["append", "y", 9]]),
+        ]
+        chk = wa.checker()
+        test = self._test_map(tmp_path)
+        res = chk.check(test, h, {})
+        assert res["valid"] is False
+        d = tmp_path / "elle-artifacts" / "20260731T000000.000Z" / "elle"
+        assert res["directory"] == str(d)
+        files = sorted(p.name for p in d.iterdir())
+        assert "G-single.txt" in files
+        txt = (d / "G-single.txt").read_text()
+        # The explanation names the witness txns and walks the cycle.
+        assert "T0 =" in txt and "T1 =" in txt
+        assert "append" in txt
+        assert "cannot be serialized" in txt
+        assert "[rw:" in txt or "[ww:" in txt or "[wr:" in txt
+
+    def test_wr_failure_writes_files(self, tmp_path):
+        from jepsen_tpu.workloads import wr as wwr
+
+        # Direct (non-cycle) anomaly: read of a FAILED txn's write (G1a).
+        h = [
+            T([["w", "x", 1]], type="fail"),
+            T([["r", "x", 1]]),
+        ]
+        chk = wwr.checker(dict(anomalies=["G1"]))
+        test = self._test_map(tmp_path)
+        res = chk.check(test, h, {})
+        assert res["valid"] is False
+        d = tmp_path / "elle-artifacts" / "20260731T000000.000Z" / "elle"
+        assert d.is_dir() and any(d.iterdir())
+        body = "".join(p.read_text() for p in d.iterdir())
+        assert "witness" in body.lower()
+
+    def test_clean_result_writes_nothing(self, tmp_path):
+        from jepsen_tpu.workloads import append as wa
+
+        h = [T([["append", "x", 1]]), T([["r", "x", [1]]])]
+        chk = wa.checker()
+        test = self._test_map(tmp_path)
+        res = chk.check(test, h, {})
+        assert res["valid"] is True
+        d = tmp_path / "elle-artifacts" / "20260731T000000.000Z" / "elle"
+        assert not d.exists()
+
+    def test_no_store_run_is_safe(self):
+        from jepsen_tpu.workloads import append as wa
+
+        h = [
+            T([["r", "x", []], ["r", "y", [9]]]),
+            T([["append", "x", 1], ["append", "y", 9]]),
+        ]
+        res = wa.checker().check({"no-store?": True, "name": "x",
+                                  "start-time": "t"}, h, {})
+        assert res["valid"] is False
+        assert "directory" not in res

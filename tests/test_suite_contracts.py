@@ -47,7 +47,7 @@ def _workload_cases():
     cases = []
     for name in ("cockroachdb", "dgraph", "tidb", "yugabyte", "faunadb",
                  "mongodb", "postgres", "stolon", "mysql",
-                 "elasticsearch"):
+                 "elasticsearch", "aerospike", "ignite"):
         mod = importlib.import_module(f"jepsen_tpu.suites.{name}")
         for wl in sorted(getattr(mod, "WORKLOADS", {})):
             cases.append((name, wl))
