@@ -20,6 +20,11 @@ def _render_cycle(i: int, w: dict) -> list[str]:
     cycle = w.get("cycle") or []
     txns = w.get("txns") or []
     kinds = w.get("kinds") or []
+    # Witness cycles are closed (the first node repeated at the end,
+    # elle/__init__.py _witness); render each transaction ONCE and let
+    # the final edge wrap back to T0.
+    if len(cycle) > 1 and cycle[0] == cycle[-1]:
+        cycle = cycle[:-1]
     for j, node in enumerate(cycle):
         txn = txns[j] if j < len(txns) else f"txn #{node}"
         lines.append(f"  T{j} = {txn}")
@@ -37,9 +42,8 @@ def _render_cycle(i: int, w: dict) -> list[str]:
         }
         why = " & ".join(reason.get(k, k) for k in ks) if ks else "edge"
         lines.append(f"    T{a} < T{b}\t[{kind}: {why}]")
-    lines.append(f"  ... and T{len(kinds) - 1 if kinds else 0} < T0 "
-                 "closes the cycle: these transactions cannot be "
-                 "serialized.")
+    lines.append("  T0 is ordered before itself: these transactions "
+                 "cannot be serialized.")
     return lines
 
 

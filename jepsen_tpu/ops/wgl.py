@@ -79,8 +79,14 @@ WINTAB_MAX_BYTES = 128 * 1024 * 1024
 # Expansions larger than this use the two-stage compaction: a fused
 # (validity, iota) single-key sort over the full expansion, then one
 # row-gather into a STAGE1_P_MULT*F buffer for the multi-key dedup sort.
-# Patchable for tests.
-BIG_M_THRESHOLD = 1 << 19
+# Patchable for tests. r5 profile (v5e, 10k-op history, F=4096, B=32,
+# M=131072): the single-stage path's 8-operand dedup sort was 0.39
+# ms/level (47% of level wall) and the compaction sort another 0.14;
+# routing through stage 1 shrinks both to P=8F rows and cut the
+# steady-state decision 7.5 s -> ~5 s, so the threshold sits just above
+# the M of the small capacities where the expansion already fits the
+# stage-2 buffer (F=1024, B<=32).
+BIG_M_THRESHOLD = 1 << 15
 # Stage-1 survivor buffer, as a multiple of F. Survivor counts beyond it
 # read as overflow (lossless), so it trades stage-2 sort size against
 # escalation churn.
@@ -355,6 +361,11 @@ def _build_kernel(model_key, F: int, W: int, KO: int, S: int, ND: int, NO: int,
                     jnp.sum(candv.astype(jnp.int32), axis=1) > B)
                 slot_row = jnp.broadcast_to(
                     jnp.arange(C, dtype=jnp.int32)[None, :], (F, C))
+                # 5-operand sort carrying the op tuple as payload. (A
+                # 2-operand (key, slot) sort + three take_along_axis
+                # payload gathers measured 6x WORSE end-to-end on a v5e
+                # — axis-1 gathers at [F, B] lower as badly as the 1-D
+                # per-column gathers the compaction notes record.)
                 sel = lax.sort(
                     ((~candv).astype(u32), slot_row, opc, a1c, a2c),
                     dimension=1, num_keys=1)
@@ -444,6 +455,12 @@ def _build_kernel(model_key, F: int, W: int, KO: int, S: int, ND: int, NO: int,
                 (s3,) = lax.sort((fused,), dimension=0, num_keys=1)
                 # (deterministic: the embedded iota makes keys unique)
                 vidx = (s3[:P] & u32(0x7FFFFFFF)).astype(jnp.int32)
+                # Packed [M, NC] stack + ONE [P]-row gather. (Per-column
+                # 1-D gathers of the P indices measured CATASTROPHICALLY
+                # worse on a v5e — 4.1 s -> 33 s on the north-star
+                # history: XLA lowers the repeated 32k-index 1-D gathers
+                # far worse than one row gather, the same cliff the
+                # dedup-sort note below records at 65k.)
                 colmat = jnp.stack(
                     [pcol] + dcols + scols + ocols, axis=1
                 )  # [M, NC]
@@ -732,11 +749,29 @@ def initial_frontier(F: int, W: int, KO: int, S: int, init_state) -> tuple:
     )
 
 
+@functools.lru_cache(maxsize=64)
+def _pad_program(F_new: int):
+    """Jitted on-device frontier grow. The frontier lives on the device
+    between chunks; padding it with host numpy (np.asarray per array)
+    paid five device->host syncs per rung restart — ~0.5 s of each
+    measured ~0.65 s restart on a tunneled v5e. One async device
+    program removes the round trips entirely."""
+    import jax
+    import jax.numpy as jnp
+
+    def pad(*arrs):
+        return tuple(
+            jnp.pad(a, [(0, F_new - a.shape[0])] + [(0, 0)] * (a.ndim - 1))
+            for a in arrs
+        )
+
+    return jax.jit(pad)
+
+
 def _pad_frontier(fr: tuple, F_new: int) -> tuple:
     """Grow a returned frontier to a larger capacity (escalation resume)."""
     p, mD, mO, st, valid, lvl = fr
-    grow = lambda a: np.pad(np.asarray(a), [(0, F_new - a.shape[0])] + [(0, 0)] * (a.ndim - 1))
-    return (grow(p), grow(mD), grow(mO), grow(st), grow(valid), np.int32(lvl))
+    return _pad_program(F_new)(p, mD, mO, st, valid) + (np.int32(lvl),)
 
 
 class DevicePlan:

@@ -101,9 +101,14 @@ class Handler(socketserver.StreamRequestHandler):
                 pass
 
     def _tx(self, srv):
+        # Finite timeout: Ignite only runs deadlock detection on
+        # transactions with a timeout > 0, and the jepsen client gives
+        # up at 10 s — a wedged tx must surface as ERR (:info) before
+        # then, not hold its pessimistic locks forever.
         return self.client.tx_start(
             concurrency=TransactionConcurrency.PESSIMISTIC,
-            isolation=TransactionIsolation.REPEATABLE_READ)
+            isolation=TransactionIsolation.REPEATABLE_READ,
+            timeout=5000)
 
     def dispatch(self, srv, words):
         cmd = words[0].upper()
@@ -127,9 +132,16 @@ class Handler(socketserver.StreamRequestHandler):
             return "OK " + json.dumps(vals)
         if cmd == "XFER":
             frm, to, amount = int(words[1]), int(words[2]), int(words[3])
+            if frm == to:
+                return "OK"  # self-transfer: balances unchanged
             with self._tx(srv) as tx:
-                b1 = cache.get(frm) - amount
-                b2 = cache.get(to) + amount
+                # Acquire the two pessimistic key locks in KEY ORDER:
+                # opposite-order transfers (A: 0->1, B: 1->0) would
+                # otherwise lock one key each and block forever on the
+                # other's (READ scans ascending, so it is compatible).
+                bal = {k: cache.get(k) for k in sorted((frm, to))}
+                b1 = bal[frm] - amount
+                b2 = bal[to] + amount
                 if b1 < 0:
                     tx.commit()
                     return f"NEG {frm} {b1}"

@@ -11,6 +11,8 @@ from __future__ import annotations
 import socket
 from typing import Any
 
+from .. import client as jclient
+
 
 class LineProto:
     """One bridge connection: ``roundtrip`` sends a space-joined
@@ -42,3 +44,45 @@ class LineProto:
         if not words or words[0] == "ERR":
             raise RuntimeError(" ".join(words[1:]) or "bridge error")
         return words
+
+
+class BridgeClient(jclient.Client):
+    """Connection lifecycle + socket-fault mapping shared by the
+    bridge-speaking workload clients (aerospike cas-register/counter,
+    ignite bank). Subclasses set ``PROTO`` (a LineProto subclass taking
+    one host argument) and implement ``invoke`` with ``self._conn()``
+    for the lazy connection and ``self._fault(op, e)`` in the socket
+    except-arm."""
+
+    PROTO: type = LineProto
+
+    def __init__(self, conn: Any = None, node: Any = None):
+        self.conn = conn
+        self.node = node
+
+    def open(self, test, node):
+        return type(self)(type(self).PROTO(str(node)), node)
+
+    def _conn(self):
+        if self.conn is None:
+            self.conn = type(self).PROTO(str(self.node))
+        return self.conn
+
+    def _drop_conn(self):
+        """Always tear the connection down on a socket fault: a request
+        may still be in flight, and reusing the socket would pair the
+        NEXT command with THIS op's late reply."""
+        if self.conn is not None:
+            self.conn.close()
+            self.conn = None
+
+    def _fault(self, op, e):
+        """Socket faults are definite :fail for reads (no state moved)
+        and indeterminate :info for mutations."""
+        self._drop_conn()
+        kind = "fail" if op["f"] == "read" else "info"
+        return {**op, "type": kind, "error": str(e)[:80]}
+
+    def close(self, test):
+        if self.conn is not None:
+            self.conn.close()

@@ -38,7 +38,7 @@ from ..checker.timeline import html as timeline_html
 from ..control import util as cu
 from .. import control as c
 from . import std_generator
-from ._bridge import LineProto
+from ._bridge import BridgeClient, LineProto
 
 NS = "test"
 SET = "jepsen"
@@ -121,34 +121,16 @@ def _j(v) -> str:
     return json.dumps(v, separators=(",", ":"))
 
 
-class CasRegisterClient(jclient.Client):
+class CasRegisterClient(BridgeClient):
     """Keyed CAS register over one ``value`` bin
     (cas_register.clj:42-77): read -> linearized GET; write -> PUT; cas
     -> the bridge's fetch + EXPECT_GEN_EQUAL write. Error mapping
     mirrors support.clj's with-errors: MISS/GEN/not-found are definite
-    :fail (the write cannot have landed), socket faults are :fail for
-    reads and :info for mutations — and always tear the connection
-    down (a request may already be in flight; reusing the socket would
-    pair the NEXT command with THIS op's late reply)."""
+    :fail (the write cannot have landed); socket-fault mapping and
+    connection teardown ride BridgeClient."""
 
     SET = "cats"
-
-    def __init__(self, conn: Optional[AsBridge] = None, node: Any = None):
-        self.conn = conn
-        self.node = node
-
-    def open(self, test, node):
-        return type(self)(AsBridge(str(node)), node)
-
-    def _conn(self):
-        if self.conn is None:
-            self.conn = AsBridge(str(self.node))
-        return self.conn
-
-    def _drop_conn(self):
-        if self.conn is not None:
-            self.conn.close()
-            self.conn = None
+    PROTO = AsBridge
 
     def invoke(self, test, op):
         k, v = op["value"]
@@ -177,33 +159,16 @@ class CasRegisterClient(jclient.Client):
                 return {**op, "type": "fail", "error": "not-found"}
             raise
         except (ConnectionError, OSError, socket.timeout) as e:
-            self._drop_conn()
-            kind = "fail" if op["f"] == "read" else "info"
-            return {**op, "type": kind, "error": str(e)[:80]}
-
-    def close(self, test):
-        if self.conn is not None:
-            self.conn.close()
+            return self._fault(op, e)
 
 
-class CounterClient(jclient.Client):
+class CounterClient(BridgeClient):
     """Single-record counter (counter.clj:43-66): setup writes
     {value: 0}, add -> the bridge's increment, read -> linearized GET."""
 
     SET = "counters"
     KEY = "pounce"
-
-    def __init__(self, conn: Optional[AsBridge] = None, node: Any = None):
-        self.conn = conn
-        self.node = node
-
-    def open(self, test, node):
-        return type(self)(AsBridge(str(node)), node)
-
-    def _conn(self):
-        if self.conn is None:
-            self.conn = AsBridge(str(self.node))
-        return self.conn
+    PROTO = AsBridge
 
     def setup(self, test):
         self._conn().cmd("PUT", self.SET, self.KEY, _j({"value": 0}))
@@ -222,16 +187,7 @@ class CounterClient(jclient.Client):
                 return {**op, "type": "ok"}
             raise ValueError(f"unknown f {op['f']!r}")
         except (ConnectionError, OSError, socket.timeout) as e:
-            # desync guard: a late reply must not answer the next cmd
-            if self.conn is not None:
-                self.conn.close()
-                self.conn = None
-            kind = "fail" if op["f"] == "read" else "info"
-            return {**op, "type": kind, "error": str(e)[:80]}
-
-    def close(self, test):
-        if self.conn is not None:
-            self.conn.close()
+            return self._fault(op, e)
 
 
 class AerospikeDB(jdb.DB, jdb.Process, jdb.Pause, jdb.LogFiles):

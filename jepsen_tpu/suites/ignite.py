@@ -37,7 +37,7 @@ from ..control import util as cu
 from ..models import CasRegister
 from .. import control as c
 from . import std_generator
-from ._bridge import LineProto
+from ._bridge import BridgeClient, LineProto
 
 PORT = 8080
 CACHE = "jepsen"
@@ -141,24 +141,14 @@ class IgBridge(LineProto):
         return self.roundtrip(parts, maxsplit=1)
 
 
-class BankClient(jclient.Client):
+class BankClient(BridgeClient):
     """Transactional transfers between BANK_N accounts
     (bank.clj:64-108): read -> one-tx getAll of every balance; transfer
     -> one tx moving value{from,to,amount}, insufficient funds commit
     unchanged and :fail (the NEG reply). Socket faults on transfers are
-    indeterminate (:info)."""
+    indeterminate (:info) via BridgeClient."""
 
-    def __init__(self, conn: Optional[IgBridge] = None, node: Any = None):
-        self.conn = conn
-        self.node = node
-
-    def open(self, test, node):
-        return type(self)(IgBridge(str(node)), node)
-
-    def _conn(self):
-        if self.conn is None:
-            self.conn = IgBridge(str(self.node))
-        return self.conn
+    PROTO = IgBridge
 
     def setup(self, test):
         self._conn().cmd("INIT", BANK_N, BANK_BALANCE)
@@ -179,16 +169,7 @@ class BankClient(jclient.Client):
                         "error": ["negative", *out[1].split()]}
             raise ValueError(f"unknown f {op['f']!r}")
         except (ConnectionError, OSError, socket.timeout) as e:
-            # desync guard: a late reply must not answer the next cmd
-            if self.conn is not None:
-                self.conn.close()
-                self.conn = None
-            kind = "fail" if op["f"] == "read" else "info"
-            return {**op, "type": kind, "error": str(e)[:80]}
-
-    def close(self, test):
-        if self.conn is not None:
-            self.conn.close()
+            return self._fault(op, e)
 
 
 def bank_checker():
