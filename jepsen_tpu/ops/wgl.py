@@ -945,6 +945,41 @@ OPTIMISTIC_MIN_OPS = 1500
 OPTIMISTIC_BEAM_F = 4096
 
 
+def level_byte_floor(plan: DevicePlan, F: int) -> int:
+    """Single-pass HBM byte floor of one BFS level at capacity ``F``:
+    every major tensor stream counted once in and once out, enumerated
+    from the kernel's static shapes. A LOWER bound on real traffic —
+    each bitonic sort re-reads its operands log^2 times — so
+    floor / (wall * measured copy bandwidth) is a utilization figure
+    that is measured on both axes and provably <= 1 (bench.py's
+    ``device_util``)."""
+    W, KO, S, ND, NO = plan.dims
+    KD = W // 32
+    KO1 = max(KO, 1)
+    C = W + KO * 32
+    SEL = plan.B is not None and plan.B < C
+    B = plan.B if SEL else C
+    M = F * B
+    NC = 1 + KD + S + KO1
+    esz = 2 if plan.tab16 else 4
+    two_stage = M > BIG_M_THRESHOLD
+    P = min(M, max(STAGE1_P_MULT * F, 64)) if two_stage else M
+    total = 0
+    total += 2 * F * W * 8 * esz            # window-table row gather
+    if SEL:
+        total += 2 * 5 * F * C * 4          # candidate pre-selection sort
+    total += 2 * M * 4 * (3 + S + 1)        # model step over the expansion
+    total += 2 * M * (KD + KO1) * 4         # new-mask build
+    if two_stage:
+        total += 2 * M * 4                  # stage-1 fused compaction sort
+        total += 2 * M * NC * 4             # colmat stack + row gather in
+        total += 2 * P * NC * 4             # ... and survivors out
+    total += 2 * (3 + NC) * P * 4           # multi-operand dedup sort
+    total += 2 * 2 * P * 4                  # fused-key compaction sort
+    total += 2 * F * NC * 4                 # top-F row gather
+    return total
+
+
 def _enc_fingerprint(enc: EncodedHistory, plan: DevicePlan) -> str:
     """Content hash tying a search checkpoint to one (history, model,
     shape-plan) so a stale file can never resume the wrong search."""

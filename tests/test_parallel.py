@@ -140,3 +140,33 @@ class TestShardPlacement:
         res = check_batch(model, hists, f=16, mesh=mesh)
         assert len(res) == 13
         assert all(r["valid"] is True for r in res)
+
+
+def test_batch_larger_members_lockstep():
+    """r4 verdict weak 6: the batch path was only ever tested on small
+    members. 5 x 600-op members (one perturbed) through the shared
+    vmapped pass; verdicts must match the native engine per member.
+    The batch kernel builds with wintab_ok=False (wgl.py), so member
+    count scales HBM by the expansion temporaries only — the real-chip
+    8 x 10k smoke lives in bench.py (batch_replay_large)."""
+    import random
+
+    from jepsen_tpu.models import CasRegister
+    from jepsen_tpu.ops import wgl_c
+    from jepsen_tpu.ops.encode import encode_history
+    from jepsen_tpu.parallel import check_batch
+    from jepsen_tpu.testing import perturb_history, random_register_history
+
+    rng = random.Random(43)
+    model = CasRegister(init=0)
+    hists = [
+        random_register_history(rng, n_ops=600, n_procs=6, cas=True,
+                                crash_p=0.002)
+        for _ in range(5)
+    ]
+    hists[2] = perturb_history(rng, hists[2])
+    got = check_batch(model, hists, f=1024)
+    want = [wgl_c.check_encoded_native(encode_history(model, h))
+            for h in hists]
+    assert [g["valid"] for g in got] == [w["valid"] for w in want]
+    assert sum(1 for w in want if w["valid"] is False) >= 1

@@ -272,3 +272,73 @@ def test_dfs_cooperative_cancel():
     # And without the flag the same search decides definitively.
     res2 = wgl_c.check_encoded_native(enc)
     assert res2["valid"] in (True, False)
+
+
+class TestRandomRegisterEncoded:
+    """The vectorized encoder-direct generator feeding the scale bench
+    (BASELINE's max-verified metric; bench.py max_verified_ops)."""
+
+    def test_valid_by_construction_both_engines(self):
+        import numpy as np
+
+        from jepsen_tpu.ops import wgl_c, wgl_host
+        from jepsen_tpu.testing import random_register_encoded
+
+        for seed in range(40):
+            enc = random_register_encoded(seed, n_ops=100, n_procs=4,
+                                          crash_p=0.03)
+            assert np.all(np.diff(enc.inv) > 0)
+            nat = wgl_c.check_encoded_native(enc)
+            assert nat is not None and nat["valid"] is True, (seed, nat)
+            host = wgl_host.check_encoded(enc)
+            assert host["valid"] is True, (seed, host)
+
+    def test_window_bounded_in_length(self):
+        from jepsen_tpu.ops import wgl
+        from jepsen_tpu.testing import random_register_encoded
+
+        ws = []
+        for n in (10_000, 100_000):
+            enc = random_register_encoded(7, n_ops=n, n_procs=10,
+                                          crash_p=20 / n)
+            t = wgl.det_tables(enc)
+            ws.append(t["W"])
+            assert t["W"] <= 64, "outgrew the native engine's bitset"
+            assert t["nO"] <= 128
+        # the block-shuffled schedule keeps W flat as n grows
+        assert abs(ws[1] - ws[0]) <= 16, ws
+
+    def test_device_kernel_agrees(self):
+        from jepsen_tpu.ops import wgl, wgl_c
+        from jepsen_tpu.testing import random_register_encoded
+
+        enc = random_register_encoded(3, n_ops=400, n_procs=4,
+                                      crash_p=0.01)
+        nat = wgl_c.check_encoded_native(enc)
+        dev = wgl.check_encoded_device(enc, f_schedule=(64, 1024))
+        assert nat["valid"] is True
+        assert dev["valid"] is True, dev
+
+
+def test_level_byte_floor_sane():
+    """The measured-utilization numerator (bench.py device_util) must be
+    positive, grow with capacity, and stay far below any per-level wall
+    x bandwidth product the kernel could plausibly achieve."""
+    import random as _random
+
+    from jepsen_tpu.models import CasRegister
+    from jepsen_tpu.ops import wgl
+    from jepsen_tpu.ops.encode import encode_history
+    from jepsen_tpu.testing import random_register_history
+
+    h = random_register_history(_random.Random(5), n_ops=400, n_procs=6,
+                                cas=True, crash_p=0.01)
+    enc = encode_history(CasRegister(init=0), h)
+    plan = wgl.plan_device(enc)
+    floors = [wgl.level_byte_floor(plan, F) for F in (256, 1024, 4096)]
+    assert all(f > 0 for f in floors)
+    assert floors[0] < floors[1] < floors[2]
+    # single-pass floor at F=4096 stays in the tens of MB: a blown-up
+    # accounting here would push device_util over 1 and break the
+    # metric's (0, 1] contract
+    assert floors[2] < 500 * 1024 * 1024
