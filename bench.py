@@ -655,8 +655,12 @@ def main() -> int:
                 bres = check_encoded_native(
                     big, max_configs=8 * big.n + 50_000_000)
                 bdt = time.perf_counter() - t0
+                # Success criterion is the BASELINE definition (verified
+                # inside 300 s), NOT the bench-budget-squeezed sizing
+                # cap: a check that outran a tight cap but stayed under
+                # 300 s is a legitimate data point for the metric.
                 if bres is not None and bres["valid"] is True \
-                        and bdt <= cap:
+                        and bdt <= BASELINE_S:
                     scale = {"ops": big.n, "invocations": n_inv,
                              "value_s": round(bdt, 3),
                              "backend": "native",

@@ -82,15 +82,18 @@ WINTAB_MAX_BYTES = 128 * 1024 * 1024
 # Patchable for tests. r5 profile (v5e, 10k-op history, F=4096, B=32,
 # M=131072): the single-stage path's 8-operand dedup sort was 0.39
 # ms/level (47% of level wall) and the compaction sort another 0.14;
-# routing through stage 1 shrinks both to P=8F rows and cut the
-# steady-state decision 7.5 s -> ~5 s, so the threshold sits just above
-# the M of the small capacities where the expansion already fits the
-# stage-2 buffer (F=1024, B<=32).
+# routing through stage 1 shrinks both to P = STAGE1_P_MULT*F rows and
+# cut the steady-state decision 7.5 s -> ~5 s, so the threshold sits
+# just above the M of the small capacities where the expansion already
+# fits the stage-2 buffer (F=1024, B<=32).
 BIG_M_THRESHOLD = 1 << 15
 # Stage-1 survivor buffer, as a multiple of F. Survivor counts beyond it
 # read as overflow (lossless), so it trades stage-2 sort size against
-# escalation churn.
-STAGE1_P_MULT = 8
+# escalation churn. v5e sweep on the 10k-op north-star history:
+# 8 -> 4.53 s steady, 4 -> 3.81 s, 2 -> 24.8 s (the buffer undercuts the
+# per-level survivor count, every level reads as overflow and the search
+# climbs to the 32768 rung) — 4 is the knee.
+STAGE1_P_MULT = 4
 
 
 def _next_pow2(x: int, lo: int = 32) -> int:
