@@ -180,6 +180,15 @@ def _taxonomy_pass(g: DepGraph, out: dict, bit: int, name: str,
     succ_full = succ_lists(edges, n, DEP_MASK | bit)
     reach = SccReach(succ_wwr, sccs_lists(succ_full), use_device,
                      device_min=DEVICE_MIN_TXNS)
+    # Every rw edge's reachability source is known up front: batch the
+    # device closure rows into one transfer instead of one relay round
+    # trip per query (SccReach.prefetch).
+    reach.prefetch([
+        (comp_id, b)
+        for (a, b), kind in edges.items()  # order irrelevant here
+        if kind & RW
+        for same, comp_id in [reach.same_comp(a, b)] if same
+    ])
     g_single = None
     g2 = None
     for (a, b), kind in sorted(edges.items()):
