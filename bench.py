@@ -634,21 +634,32 @@ def main() -> int:
             scale["exponent"] = round(e_fit, 3)
             out["max_verified_ops"] = scale
             _checkpoint()  # calibration survives a mid-big-check kill
-            # Budget shape: generation first (n_inv / gen_rate
-            # seconds), then a check that must fit both the 300 s
-            # definition and what's left of the bench budget after
-            # generation; an overshoot is reported, not hidden.
-            cap = min(BASELINE_S, _left() - 40)
-            size_for = lambda c: int(
-                8_000_000 * (c / t8) ** (1 / e_fit) * 0.95)
-            n_inv = size_for(max(cap, 0.001))
-            while cap > 2 * t8 and \
-                    n_inv / gen_rate + cap + 40 > _left():
-                cap = min(cap, _left() - n_inv / gen_rate - 40)
-                if cap <= 0:
+            # Budget shape per attempt: generation first (n_inv /
+            # gen_rate seconds), then a check that must fit both the
+            # 300 s definition and what's left of the bench budget
+            # after generation; an overshoot is reported, not hidden.
+            # The calibration exponent is NOISY run to run (the 1M
+            # point is a ~1-3 s measurement; observed fits 1.0-1.6 on
+            # the same box), so an attempt landing far under the
+            # frontier refits the model from the two LARGEST
+            # measurements and goes again while the budget allows —
+            # the metric wants the largest N actually verified, not
+            # the first guess.
+            n_prev, t_prev = 8_000_000, t8
+            cap = BASELINE_S
+            for _attempt in range(3):
+                cap = min(BASELINE_S, _left() - 40)
+                size_for = lambda c: int(
+                    n_prev * (c / t_prev) ** (1 / e_fit) * 0.95)
+                n_inv = size_for(max(cap, 0.001))
+                while cap > 2 * t_prev and \
+                        n_inv / gen_rate + cap + 40 > _left():
+                    cap = min(cap, _left() - n_inv / gen_rate - 40)
+                    if cap <= 0:
+                        break
+                    n_inv = size_for(cap)
+                if not (n_inv > n_prev and cap > 2 * t_prev):
                     break
-                n_inv = size_for(cap)
-            if n_inv > 8_000_000 and cap > 2 * t8:
                 big = random_register_encoded(
                     n_inv, n_ops=n_inv, n_procs=10, crash_p=20 / n_inv)
                 t0 = time.perf_counter()
@@ -665,10 +676,19 @@ def main() -> int:
                              "value_s": round(bdt, 3),
                              "backend": "native",
                              "exponent": round(e_fit, 3)}
+                    out["max_verified_ops"] = scale
+                    _checkpoint()
+                    if bdt >= 0.75 * BASELINE_S:
+                        break  # close enough to the frontier
+                    e_fit = min(1.6, max(1.0,
+                                         math.log(bdt / t_prev)
+                                         / math.log(n_inv / n_prev)))
+                    n_prev, t_prev = n_inv, bdt
                 else:
                     scale["overshoot"] = {
                         "ops": big.n, "value_s": round(bdt, 3),
                         "valid": None if bres is None else bres["valid"]}
+                    break
             scale["ops_per_s"] = round(scale["ops"] / scale["value_s"], 1)
             scale["cap_s"] = round(cap, 1)
             scale["note"] = ("ops = encoded rows actually verified; "
