@@ -432,8 +432,17 @@ def main() -> int:
                 out["device_kernel_s"] = None
                 out["device_kernel_note"] = "skipped: budget"
             else:
+                # Both passes run with a telemetry registry injected, so
+                # the measured program is the stats-carrying kernel
+                # variant (per-level frontier rows in the loop carry —
+                # sub-5% overhead, see docs/telemetry.md) and the round
+                # records frontier/compile metrics alongside the wall
+                # time.
+                from jepsen_tpu import telemetry as jtel
+
+                treg = jtel.Registry()
                 t0 = time.perf_counter()
-                dres = wgl.check_encoded_device(enc)
+                dres = wgl.check_encoded_device(enc, metrics=treg)
                 warm_s = round(time.perf_counter() - t0, 3)
                 out["device_valid"] = dres["valid"]
                 out["levels"] = dres.get("levels")
@@ -442,10 +451,22 @@ def main() -> int:
                     out["device_kernel_s"] = warm_s
                     out["device_kernel_note"] = "warm pass (compile included)"
                 else:
+                    treg = jtel.Registry()  # steady pass gets its own
                     t0 = time.perf_counter()
-                    dres = wgl.check_encoded_device(enc)
+                    dres = wgl.check_encoded_device(enc, metrics=treg)
                     out["device_kernel_s"] = round(
                         time.perf_counter() - t0, 3)
+                tsum = treg.summary()
+                levels_ev = treg.events("wgl_level")
+                fronts = [e["frontier"] for e in levels_ev] or [0]
+                out["device_telemetry"] = {
+                    "metrics": tsum,
+                    "levels_recorded": len(levels_ev),
+                    "frontier_mean": round(sum(fronts) / len(fronts), 1),
+                    # nearest-rank p99: ceil(0.99 n) - 1
+                    "frontier_p99": sorted(fronts)[
+                        max(0, -(-99 * len(fronts) // 100) - 1)],
+                }
                 lv = int(dres.get("levels") or 1)
                 # Derived figures only from a steady pass — a
                 # compile-inclusive warm pass would inflate per-level

@@ -223,23 +223,54 @@ def linearizable(options: Optional[dict] = None, **kw) -> Checker:
         backend = (test or {}).get("checker_backend", default_backend)
         return "device" if backend == "tpu" else backend
 
-    def _check_one(test, ops, backend):
+    def _check_one(test, ops, backend, **kw):
         """The single-history dispatch, shared by chk() and the keyed
         batch's unknown-recheck path (so a backend added to one can't be
-        forgotten in the other)."""
+        forgotten in the other). ``kw`` carries telemetry wiring
+        (metrics registry, heartbeat chunk_callback) into the device
+        drivers; the native/host engines ignore it."""
         if backend == "sharded":
             from ..parallel.frontier import check_history_sharded
 
             return check_history_sharded(
-                model, ops, mesh=(test or {}).get("mesh"))
+                model, ops, mesh=(test or {}).get("mesh"),
+                metrics=kw.get("metrics"))
         from ..ops import wgl
 
-        return wgl.check_history(model, ops, backend=backend)
+        return wgl.check_history(model, ops, backend=backend, **kw)
 
     def chk(test, history, opts):
+        import time as _time
+
+        from .. import telemetry as jtelemetry
+
         backend = _resolve_backend(test)
         ops = history.client_ops()
-        res = _check_one(test, ops, backend)
+        reg = jtelemetry.of_test(test)
+        kw = {}
+        if reg is not None:
+            # Device paths get the registry plus a heartbeat: the
+            # knossos-style "checking... 43%" progress line with ETA,
+            # fed by the driver's per-chunk callback.
+            kw["metrics"] = reg
+            kw["chunk_callback"] = jtelemetry.Heartbeat(
+                total=len(ops), registry=reg)
+        t0 = _time.perf_counter()
+        res = _check_one(test, ops, backend, **kw)
+        if reg is not None:
+            reg.histogram(
+                "checker_seconds",
+                "Checker wall seconds by checker and engine",
+                labelnames=("checker", "backend"),
+                buckets=(0.01, 0.05, 0.25, 1.0, 5.0, 30.0, 120.0, 600.0),
+            ).labels(
+                checker="linearizable",
+                backend=str(res.get("backend")
+                            or ("device" if res.get("device") else backend)),
+            ).observe(_time.perf_counter() - t0)
+            reg.gauge("checker_op_count",
+                      "Ops seen by the linearizable checker").set(
+                          res.get("op_count") or len(ops))
         # Writing full search diagnostics "can take hours" in the reference
         # (checker.clj:210-213); keep attempts bounded likewise.
         if isinstance(res.get("attempts"), list):
@@ -282,9 +313,15 @@ def linearizable(options: Optional[dict] = None, **kw) -> Checker:
             raise RuntimeError("batch check requires the device backend")
         import jax
 
+        import time as _time
+
+        from .. import telemetry as jtelemetry
         from ..ops import wgl
         from ..parallel import check_batch, make_mesh
 
+        reg = jtelemetry.of_test(test)
+        kw = {"metrics": reg} if reg is not None else {}
+        t0 = _time.perf_counter()
         # Shard the batch over every local device (the reference's
         # bounded-pmap key axis, mapped onto the mesh's dp axis).
         mesh = make_mesh() if len(jax.devices()) > 1 else None
@@ -299,7 +336,21 @@ def linearizable(options: Optional[dict] = None, **kw) -> Checker:
         for k, r in out_map.items():
             if r.get("valid") == "unknown":
                 out_map[k] = _check_one(
-                    test, keyed_histories[k].client_ops(), backend)
+                    test, keyed_histories[k].client_ops(), backend, **kw)
+        if reg is not None:
+            reg.histogram(
+                "checker_seconds",
+                "Checker wall seconds by checker and engine",
+                labelnames=("checker", "backend"),
+                buckets=(0.01, 0.05, 0.25, 1.0, 5.0, 30.0, 120.0, 600.0),
+            ).labels(checker="linearizable", backend="batch").observe(
+                _time.perf_counter() - t0)
+            kc = reg.counter(
+                "checker_batch_keys_total",
+                "Keys decided through the batched device check",
+                labelnames=("result",))
+            for r in out_map.values():
+                kc.labels(result=str(r.get("valid"))).inc()
         return out_map
 
     out.batch_check = batch_check

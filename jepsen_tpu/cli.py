@@ -70,6 +70,11 @@ def add_test_opts(p: argparse.ArgumentParser) -> None:
                    choices=["auto", "device", "tpu", "host", "native",
                             "sharded", "competition"],
                    default="auto")
+    p.add_argument("--telemetry", action="store_true",
+                   help="collect framework metrics (checker/kernel "
+                        "counters, op-latency histograms, phase "
+                        "timings) + client spans into the run's store "
+                        "directory (metrics.jsonl/.prom, spans.jsonl)")
     p.add_argument("--store-root", default=None,
                    help="directory for the store/ tree")
 
@@ -121,6 +126,8 @@ def _apply_std_opts(test: dict, opts: dict) -> dict:
         test["leave-db-running?"] = True
     if opts.get("logging_json"):
         test["logging-json"] = True
+    if opts.get("telemetry"):
+        test["telemetry?"] = True
     if opts.get("store_root"):
         test["store-root"] = opts["store_root"]
     if opts.get("checker_backend") and opts["checker_backend"] != "auto":

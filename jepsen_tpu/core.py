@@ -36,6 +36,7 @@ from . import db as jdb
 from . import nemesis as jnemesis
 from . import os_ as jos
 from . import store
+from . import telemetry as jtelemetry
 from .checker import check_safe
 from .generator import interpreter
 from .history import History, Op
@@ -132,14 +133,21 @@ def analyze(test: dict) -> dict:
         h = h.reindex()
     test = dict(test)
     test["history"] = h
+    reg = jtelemetry.of_test(test)
     checker = test.get("checker")
-    if checker is not None:
-        test["results"] = check_safe(checker, test, h)
-    else:
-        test["results"] = {"valid": True}
+    with jtelemetry.timed_phase(reg, "analyze"):
+        if checker is not None:
+            test["results"] = check_safe(checker, test, h)
+        else:
+            test["results"] = {"valid": True}
     LOG.info("Analysis complete")
     if test.get("name") and test.get("start-time") and not test.get("no-store?"):
         store.save_2(test)
+        if reg is not None:
+            # Standalone `analyze` runs (no core.run around them) still
+            # get their metrics persisted; core.run re-exports a more
+            # complete snapshot at the end (atomic replace, last wins).
+            jtelemetry.store_metrics(test)
     return test
 
 
@@ -211,6 +219,16 @@ def run(test: dict) -> dict:
     :results. See module docstring for the phase diagram."""
     test = prepare_test(test)
     persist = bool(test.get("name")) and not test.get("no-store?")
+    reg = jtelemetry.of_test(test)
+    if reg is not None and persist and test.get("client") is not None:
+        # Telemetry runs get the tracing client for free: every client
+        # lifecycle call records a span (trace.clj's with-trace), and
+        # spans.jsonl lands in the store next to metrics.jsonl below.
+        from . import trace as jtrace
+
+        collector = jtrace.Collector()
+        test["trace-collector"] = collector
+        test["client"] = jtrace.tracing(test["client"], collector)
     if persist:
         store.path_mk(test)
         store.start_logging(test)
@@ -222,9 +240,11 @@ def run(test: dict) -> dict:
         try:
             jdb._on_nodes(test, osys.setup, nodes)
             try:
-                jdb.cycle(test)
+                with jtelemetry.timed_phase(reg, "db.cycle"):
+                    jdb.cycle(test)
                 with with_relative_time():
-                    history = run_case(test)
+                    with jtelemetry.timed_phase(reg, "run_case"):
+                        history = run_case(test)
                 test["history"] = history
                 if persist:
                     store.save_1(test)
@@ -247,5 +267,16 @@ def run(test: dict) -> dict:
 
                 control.close_sessions(sessions)
     finally:
+        if persist and reg is not None:
+            # Sinks go out even when a phase above threw: spans.jsonl +
+            # metrics.jsonl/.prom next to the (phase-1-durable) history.
+            try:
+                from . import trace as jtrace
+
+                if test.get("trace-collector") is not None:
+                    jtrace.store_spans(test, test["trace-collector"])
+                jtelemetry.store_metrics(test)
+            except Exception:
+                LOG.warning("telemetry export failed", exc_info=True)
         if persist:
             store.stop_logging(test)

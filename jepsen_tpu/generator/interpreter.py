@@ -249,8 +249,18 @@ def run(test: dict) -> list[dict]:
 
     Requires: test["client"] (a Client prototype), test["nemesis"] (already
     set up), test["generator"], test["concurrency"], test["nodes"]."""
+    from .. import telemetry as jtelemetry
+
     ctx = make_context(test)
     nemesis = test.get("nemesis") or jnemesis.noop()
+    _reg = jtelemetry.of_test(test)
+    # Op-latency histogram by (f, completion type). Metric object is
+    # resolved ONCE here; the completion path below only guards on the
+    # None, so a telemetry-off run allocates nothing per op.
+    _lat = (_reg.histogram(
+        "jepsen_op_latency_seconds",
+        "Client op latency (invoke to completion) by f and type",
+        labelnames=("f", "type")) if _reg is not None else None)
     threads = ctx.free_thread_list()
     done_q: "queue.SimpleQueue[tuple]" = queue.SimpleQueue()
     workers: dict[Any, _WorkerThread] = {
@@ -275,10 +285,16 @@ def run(test: dict) -> list[dict]:
             thread, op2 = done_q.get(block=block, timeout=timeout)
         except queue.Empty:
             return False
-        outstanding.pop(thread, None)
+        inv = outstanding.pop(thread, None)
         op2 = dict(op2)
         op2.pop("exception", None)
         op2["time"] = relative_time_nanos()
+        if _lat is not None and inv is not None and thread != NEMESIS \
+                and goes_in_history(op2):
+            _lat.labels(f=str(op2.get("f")),
+                        type=str(op2.get("type"))).observe(
+                            max(op2["time"] - inv.get("time", op2["time"]),
+                                0) / 1e9)
         ctx = ctx.with_(
             time=max(ctx.time, op2["time"]),
             free_threads=ctx.free_threads | {thread},

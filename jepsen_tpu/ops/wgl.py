@@ -95,6 +95,16 @@ BIG_M_THRESHOLD = 1 << 15
 # climbs to the 32768 rung) — 4 is the knee.
 STAGE1_P_MULT = 4
 
+# Per-level stats ring carried by the telemetry kernel variant
+# (collect_stats=True): one [level, frontier, expanded, overflow] int32
+# row per BFS level, written in-loop with a dynamic_update_slice (never
+# a debug.callback — the level loop stays pure). Ring semantics: a chunk
+# longer than this keeps its most recent LEVEL_STAT_ROWS levels; the
+# host driver reads the ring once per chunk (chunks are bounded by
+# _levels_per_call, so loss only occurs on tiny-M searches with >512
+# levels per chunk, where each row is cheapest anyway).
+LEVEL_STAT_ROWS = 512
+
 
 def _next_pow2(x: int, lo: int = 32) -> int:
     return max(lo, 1 << (int(x) - 1).bit_length())
@@ -130,8 +140,16 @@ def _enable_compile_cache() -> None:
 @functools.lru_cache(maxsize=64)
 def _build_kernel(model_key, F: int, W: int, KO: int, S: int, ND: int, NO: int,
                   axis_name: Optional[str] = None, n_shards: int = 1,
-                  B: Optional[int] = None, wintab_ok: bool = True):
+                  B: Optional[int] = None, wintab_ok: bool = True,
+                  collect_stats: bool = False):
     """Returns a jitted BFS driver with static shapes.
+
+    ``collect_stats``: carry a LEVEL_STAT_ROWS x 4 per-level stats ring
+    through the loop and return it after the packed flags vector (the
+    telemetry variant — a SEPARATE compiled program, so the default
+    kernel is bit-identical with telemetry off). Host-side consumers
+    read the ring once per chunk; stats never route through
+    debug.callback inside the level loop.
 
     model_key = (model-class, cache signature) — step_jax must be a pure
     function of the class + signature.
@@ -169,6 +187,8 @@ def _build_kernel(model_key, F: int, W: int, KO: int, S: int, ND: int, NO: int,
     import jax.numpy as jnp
     from jax import lax
 
+    assert not (collect_stats and axis_name is not None), \
+        "per-level stats collection is single-device only"
     _enable_compile_cache()
     model_cls, _sig, model_args = model_key
     model = model_cls._from_cache_key(model_args)
@@ -288,7 +308,7 @@ def _build_kernel(model_key, F: int, W: int, KO: int, S: int, ND: int, NO: int,
             winTab = tabD[wrows].reshape(ND, W * 8)
 
         def level(carry):
-            p, mD, mO, st, valid, lvl, acc, ovf, fmax = carry
+            p, mD, mO, st, valid, lvl, acc, ovf, fmax = carry[:9]
 
             rows = p[:, None] + slots[None, :]  # [F, W]
             in_rng = rows < nD
@@ -412,6 +432,10 @@ def _build_kernel(model_key, F: int, W: int, KO: int, S: int, ND: int, NO: int,
             np_ = jnp.broadcast_to(p[:, None], (F, CC)).reshape(M) + s
             nmD = shift_words_right(nmD, s)
             nvalid = cand.reshape(M)
+            if collect_stats:
+                # Expansion size BEFORE dedup/compaction — with the kept
+                # count below this gives the per-level dedup ratio.
+                n_exp = jnp.sum(nvalid.astype(jnp.int32))
 
             acc_now = jnp.any(nvalid & (np_ >= nD))
             if axis_name is not None:
@@ -626,7 +650,7 @@ def _build_kernel(model_key, F: int, W: int, KO: int, S: int, ND: int, NO: int,
             # in beam mode, where the truncated frontier advances.
             lossy_b = lossy != 0
             sel = lambda new, old: jnp.where(ovf_now & ~lossy_b, old, new)
-            return (
+            out = (
                 sel(kp, p),
                 sel(kmD, mD),
                 sel(kmO, mO),
@@ -638,9 +662,26 @@ def _build_kernel(model_key, F: int, W: int, KO: int, S: int, ND: int, NO: int,
                 jnp.maximum(fmax,
                             jnp.minimum(count, FT).astype(jnp.int32)),
             )
+            if collect_stats:
+                # Stats row for the level this application ATTEMPTED
+                # (number lvl+1): kept frontier, expansion size, overflow
+                # flag. Written unconditionally — an overflow attempt is
+                # recorded even though the frontier is restored, and a
+                # retry at a larger capacity rewrites the same ring slot.
+                row = jnp.stack([
+                    lvl + 1,
+                    jnp.minimum(count, FT),
+                    n_exp,
+                    ovf_now.astype(jnp.int32),
+                ]).astype(jnp.int32)
+                stats = lax.dynamic_update_slice(
+                    carry[9], row[None, :],
+                    ((lvl + 1) % LEVEL_STAT_ROWS, jnp.int32(0)))
+                out = out + (stats,)
+            return out
 
         def cond(carry):
-            _p, _mD, _mO, _st, valid, lvl, acc, ovf, _fm = carry
+            valid, lvl, acc, ovf = carry[4], carry[5], carry[6], carry[7]
             nonempty = jnp.any(valid)
             if axis_name is not None:
                 nonempty = lax.pmax(nonempty.astype(jnp.int32),
@@ -663,6 +704,8 @@ def _build_kernel(model_key, F: int, W: int, KO: int, S: int, ND: int, NO: int,
             jnp.asarray(False),
             jnp.int32(1),
         )
+        if collect_stats:
+            init = init + (jnp.zeros((LEVEL_STAT_ROWS, 4), jnp.int32),)
         # Two levels per loop iteration: halves the while_loop's fixed
         # per-iteration overhead (dispatch + cond evaluation). The
         # second application is SELECTED AWAY when the first one ended
@@ -678,7 +721,7 @@ def _build_kernel(model_key, F: int, W: int, KO: int, S: int, ND: int, NO: int,
                 jnp.where(go, x2, x1) for x2, x1 in zip(c2, c1))
 
         out = lax.while_loop(cond, body2, init)
-        p, mD, mO, st, valid, lvl, acc, ovf, fmax = out
+        p, mD, mO, st, valid, lvl, acc, ovf, fmax = out[:9]
         nonempty = jnp.any(valid)
         count = jnp.sum(valid.astype(jnp.int32))
         if axis_name is not None:
@@ -696,6 +739,10 @@ def _build_kernel(model_key, F: int, W: int, KO: int, S: int, ND: int, NO: int,
             acc.astype(jnp.int32), ovf.astype(jnp.int32),
             nonempty.astype(jnp.int32), lvl, fmax, count,
         ])
+        if collect_stats:
+            # Stats ride between flags and the frontier: the resumable
+            # frontier is always the LAST five outputs (out[-5:]).
+            return flags, out[9], p, mD, mO, st, valid
         return flags, p, mD, mO, st, valid
 
     return kernel, jax.jit(kernel)
@@ -732,6 +779,36 @@ def _levels_per_call(M: int, target_s: float = 5.0) -> int:
 
 # ---------------------------------------------------------------------------
 # Host driver
+
+
+def _note_chunk_metrics(metrics, lvl_stats, lvl0: int, lvl: int, F: int,
+                        chunk_wall: float, stage: str) -> None:
+    """Fold one chunk's kernel stats ring + wall time into a telemetry
+    registry. Host-side only; never called when telemetry is off."""
+    c = metrics.counter
+    c("wgl_chunks_total", "Device kernel chunk invocations").inc()
+    c("wgl_levels_total", "Completed BFS levels").inc(max(lvl - lvl0, 0))
+    c("wgl_kernel_seconds_total",
+      "Chunk wall seconds by stage (the first chunk after a fresh kernel "
+      "build carries the jit trace/lower/compile cost)",
+      labelnames=("stage",)).labels(stage=stage).inc(chunk_wall)
+    metrics.gauge("wgl_capacity", "Current frontier capacity F").set(F)
+    if lvl_stats is None:
+        return
+    rows = lvl_stats[np.argsort(lvl_stats[:, 0], kind="stable")]
+    for level_n, frontier, expanded, ovf_f in rows.tolist():
+        if level_n <= lvl0 or level_n > lvl + 1:
+            continue  # stale ring slots (zeros or a resumed prefix)
+        # level_n <= lvl: a completed level. level_n == lvl + 1: the
+        # attempt that ended the chunk — an overflow awaiting escalation,
+        # or the level that emptied/accepted the frontier.
+        metrics.event(
+            "wgl_level", level=int(level_n), frontier=int(frontier),
+            expanded=int(expanded), overflow=bool(ovf_f), F=int(F),
+            completed=bool(level_n <= lvl))
+        metrics.gauge(
+            "wgl_frontier_max",
+            "Peak post-dedup frontier size").max(int(frontier))
 
 
 def _model_cache_key(model: Model):
@@ -1067,6 +1144,7 @@ def check_encoded_device(
     optimistic: Optional[bool] = None,
     checkpoint_path: Optional[str] = None,
     chunk_callback=None,
+    metrics=None,
 ) -> dict:
     """Decide linearizability of an encoded history on the default JAX
     backend (TPU when present). Result map mirrors the host oracle
@@ -1090,7 +1168,15 @@ def check_encoded_device(
     "can take hours", checker.clj:210-213, and restart from zero). The
     file is deleted on a successful verdict. ``chunk_callback(info)`` is
     invoked after every chunk (progress reporting; exceptions
-    propagate, which also makes interruption testable)."""
+    propagate, which also makes interruption testable).
+
+    ``metrics``: a ``jepsen_tpu.telemetry.Registry``. When given, the
+    kernel is built in its collect_stats variant and the driver records
+    per-level frontier/expansion events, capacity escalations, kernel
+    cache hits and the compile-vs-execute wall split into the registry
+    (one extra device->host read per chunk). None (the default) leaves
+    the kernel and driver hot paths byte-identical to the
+    pre-telemetry build."""
     t0 = _time.perf_counter()
     n = enc.n
     plan = plan_device(enc, max_open=max_open, window_cap=window_cap,
@@ -1157,7 +1243,8 @@ def check_encoded_device(
         res = _device_search(enc, plan, schedule, levels_per_call, t0,
                              resume_from=disk,
                              disk_checkpoint=dck("full"),
-                             chunk_callback=chunk_callback)
+                             chunk_callback=chunk_callback,
+                             metrics=metrics)
         res["resumed_from_level"] = int(disk["fr"][-1])
         return finish(res)
     if optimistic and beam_cap is not None:
@@ -1189,7 +1276,8 @@ def check_encoded_device(
             checkpoint=checkpoint,
             resume_from=beam_resume,
             disk_checkpoint=dck("beam"),
-            chunk_callback=chunk_callback)
+            chunk_callback=chunk_callback,
+                             metrics=metrics)
         if res["valid"] is True:
             res["phase"] = "optimistic-beam"
             return finish(res)
@@ -1203,7 +1291,8 @@ def check_encoded_device(
             _time.perf_counter(),
             resume_from=checkpoint or None,
             disk_checkpoint=dck("full"),
-            chunk_callback=chunk_callback)
+            chunk_callback=chunk_callback,
+                             metrics=metrics)
         full["wall_s"] = _time.perf_counter() - t0
         full["optimistic_attempts"] = res.get("attempts")
         return finish(full)
@@ -1223,7 +1312,8 @@ def check_encoded_device(
         enc, plan, schedule, levels_per_call, t0,
         resume_from=resume,
         disk_checkpoint=dck("full"),
-        chunk_callback=chunk_callback))
+        chunk_callback=chunk_callback,
+                             metrics=metrics))
 
 
 def _device_search(enc: EncodedHistory, plan: DevicePlan, schedule: list,
@@ -1231,7 +1321,7 @@ def _device_search(enc: EncodedHistory, plan: DevicePlan, schedule: list,
                    checkpoint: Optional[dict] = None,
                    resume_from: Optional[dict] = None,
                    disk_checkpoint: Optional[tuple] = None,
-                   chunk_callback=None) -> dict:
+                   chunk_callback=None, metrics=None) -> dict:
     """One escalating/de-escalating frontier search over ``schedule``;
     the top capacity continues past overflow as a greedy beam.
 
@@ -1240,10 +1330,17 @@ def _device_search(enc: EncodedHistory, plan: DevicePlan, schedule: list,
     ``resume_from``: such a dict to start from instead of level 0.
     ``disk_checkpoint``: (path, fingerprint, phase) — persist the
     resumable frontier after every chunk. ``chunk_callback(info)``:
-    per-chunk progress hook."""
+    per-chunk progress hook. ``metrics``: telemetry registry (see
+    check_encoded_device)."""
     n = enc.n
     W, KO, S, ND, NO = plan.dims
     total_levels = int(plan.args[2])
+    collect = metrics is not None
+    if collect:
+        metrics.gauge("wgl_window",
+                      "Required real-time window width (slots)").set(W)
+        metrics.gauge("wgl_total_levels",
+                      "BFS levels required for acceptance").set(total_levels)
 
     mk = _model_cache_key(enc.model)
     attempts = []
@@ -1299,7 +1396,18 @@ def _device_search(enc: EncodedHistory, plan: DevicePlan, schedule: list,
     rung_entry = int(fr[-1])  # level at which the current rung started
     deesc_from = None  # capacity last de-escalated FROM (known adequate)
     while True:
-        _, kern = _build_kernel(mk, F, W, KO, S, ND, NO, B=plan.B)
+        if collect:
+            misses0 = _build_kernel.cache_info().misses
+        _, kern = _build_kernel(mk, F, W, KO, S, ND, NO, B=plan.B,
+                                collect_stats=collect)
+        if collect:
+            fresh_build = _build_kernel.cache_info().misses > misses0
+            metrics.counter(
+                "wgl_kernel_cache_total",
+                "Per-bucket kernel build-cache lookups",
+                labelnames=("cache", "result")).labels(
+                    cache="build_kernel",
+                    result="miss" if fresh_build else "hit").inc()
         if fr[0].shape[0] < F:
             fr = _pad_frontier(fr, F)
         attempt = {"F": F, "levels": 0, "calls": 0, "wall_s": 0.0}
@@ -1320,11 +1428,18 @@ def _device_search(enc: EncodedHistory, plan: DevicePlan, schedule: list,
         out = kern(*call_args, *fr[:-1], np.int32(lvl0), np.int32(lossy))
         acc, ovf, nonempty, lvl, fmax, count = (
             int(x) for x in np.asarray(out[0]))
-        fr = tuple(out[1:]) + (np.int32(lvl),)
+        # The resumable frontier is always the last five outputs; the
+        # telemetry kernel inserts its stats ring at out[1].
+        fr = tuple(out[-5:]) + (np.int32(lvl),)
         fmax_all = max(fmax_all, fmax)
         attempt["levels"] = lvl
         attempt["calls"] += 1
-        attempt["wall_s"] = round(attempt["wall_s"] + _time.perf_counter() - t_call, 3)
+        chunk_wall = _time.perf_counter() - t_call
+        attempt["wall_s"] = round(attempt["wall_s"] + chunk_wall, 3)
+        if collect:
+            _note_chunk_metrics(
+                metrics, np.asarray(out[1]), lvl0, lvl, F, chunk_wall,
+                "compile" if fresh_build else "execute")
         if lossy and bool(ovf):
             # Record the last LOSSLESS frontier for the exhaustive
             # fallback — but never shallower than one already seeded
@@ -1342,10 +1457,16 @@ def _device_search(enc: EncodedHistory, plan: DevicePlan, schedule: list,
                 path, fingerprint, phase, truncated, fr,
                 lossless_fr=checkpoint.get("fr")
                 if checkpoint is not None else None)
+        if collect and lossy and bool(ovf):
+            metrics.counter(
+                "wgl_beam_truncations_total",
+                "Chunks in which the lossy beam dropped configs").inc()
         if chunk_callback is not None:
             chunk_callback({"level": lvl, "F": F,
                             "frontier_max": fmax_all,
-                            "wall_s": _time.perf_counter() - t0})
+                            "wall_s": _time.perf_counter() - t0,
+                            "total_levels": total_levels,
+                            "count": count})
         if acc:
             # Sound even after truncation: dropping configs only removes
             # accepting paths, never invents one.
@@ -1388,6 +1509,12 @@ def _device_search(enc: EncodedHistory, plan: DevicePlan, schedule: list,
                 nxt = min(nxt, deesc_from)
                 if nxt >= deesc_from:
                     deesc_from = None
+            if collect:
+                metrics.counter(
+                    "wgl_capacity_escalations_total",
+                    "Lossless frontier-capacity escalations").inc()
+                metrics.event("wgl_escalation", level=lvl, from_F=F,
+                              to_F=nxt)
             F = nxt
             rung_entry = lvl
         else:
@@ -1407,6 +1534,10 @@ def _device_search(enc: EncodedHistory, plan: DevicePlan, schedule: list,
                 fr = tuple(
                     a[:F2] if np.ndim(a) >= 1 else a for a in fr[:-1]
                 ) + (fr[-1],)
+                if collect:
+                    metrics.counter(
+                        "wgl_capacity_deescalations_total",
+                        "Frontier-capacity de-escalations").inc()
                 F = F2
                 rung_entry = lvl
 
@@ -1473,8 +1604,10 @@ def capture_stuck(kern, dev_args: tuple, entry_fr: tuple, lvl: int,
     try:
         out = kern(*dev_args[:2], np.int32(lvl), *dev_args[3:],
                    *entry_fr[:-1], np.int32(lvl0), np.int32(0))
+        # out[-5:] — the frontier is the last five outputs on both the
+        # plain and the telemetry (stats-carrying) kernel variants.
         return _frontier_stuck_configs(
-            enc, plan, tuple(np.asarray(x) for x in out[1:]))
+            enc, plan, tuple(np.asarray(x) for x in out[-5:]))
     except Exception:
         return []
 

@@ -82,6 +82,7 @@ def check_encoded_sharded(
     levels_per_call: Optional[int] = None,
     max_escalations: int = 2,
     checkpoint_path: Optional[str] = None,
+    metrics=None,
 ) -> dict:
     """Decide linearizability of one encoded history with the frontier
     sharded over ``mesh``'s ``axis``. Result map mirrors
@@ -99,6 +100,12 @@ def check_encoded_sharded(
     on a definite verdict. The sharded search is always lossless, so a
     resumed frontier is exact regardless of mesh size (the width is
     re-rounded to the new mesh's per-device multiple).
+
+    ``metrics``: telemetry registry; records per-chunk events
+    (global/per-device config counts), sharded-kernel cache hits and
+    the analytic all_gather traffic (the exchange matrix's byte size ×
+    levels run — the kernel itself stays unchanged; per-level stats
+    collection is single-device only).
     """
     t0 = _time.perf_counter()
     if mesh is None:
@@ -125,12 +132,33 @@ def check_encoded_sharded(
         F = max(-(-f_req // D), 16)
         return F * D
 
+    def allgather_bytes_per_level(F: int) -> int:
+        """Byte size of the per-level candidate exchange: every shard
+        ships its packed [P, NC+1] u32 matrix to every other shard (one
+        tiled all_gather over the frontier axis)."""
+        KD = W // 32
+        CC = plan.B or (W + KO * 32)
+        M = F * CC
+        P = min(M, max(wgl.STAGE1_P_MULT * F, 64))
+        NC = 1 + KD + S + max(KO, 1)
+        return D * P * (NC + 1) * 4
+
     def run_capacity(FT: int, fr_global: tuple, attempt: dict) -> tuple:
         """Chunked search at one global capacity; returns (result|None,
         frontier) — None result means lossless overflow (escalate)."""
         F = FT // D
+        if metrics is not None:
+            misses0 = _sharded_kernel.cache_info().misses
         sharded = _sharded_kernel(mk, F, W, KO, S, ND, NO, axis, mesh,
                                   B=plan.B)
+        if metrics is not None:
+            fresh = _sharded_kernel.cache_info().misses > misses0
+            metrics.counter(
+                "wgl_kernel_cache_total",
+                "Per-bucket kernel build-cache lookups",
+                labelnames=("cache", "result")).labels(
+                    cache="sharded_kernel",
+                    result="miss" if fresh else "hit").inc()
         fr = fr_global
         lpc = levels_per_call or wgl._levels_per_call(
             F * (plan.B or (W + KO * 32)))
@@ -157,8 +185,30 @@ def check_encoded_sharded(
                     checkpoint_path, fingerprint, "sharded", False, fr)
             attempt["levels"] = int(lvl)
             attempt["calls"] += 1
-            attempt["wall_s"] = round(
-                attempt["wall_s"] + _time.perf_counter() - t_call, 3)
+            chunk_wall = _time.perf_counter() - t_call
+            attempt["wall_s"] = round(attempt["wall_s"] + chunk_wall, 3)
+            if metrics is not None:
+                c = metrics.counter
+                c("wgl_sharded_chunks_total",
+                  "Frontier-sharded kernel chunk invocations").inc()
+                c("wgl_sharded_levels_total",
+                  "BFS levels run by the sharded search").inc(
+                      max(int(lvl) - lvl0, 0))
+                c("wgl_allgather_bytes_total",
+                  "Analytic bytes moved by the per-level candidate "
+                  "all_gather").inc(
+                      allgather_bytes_per_level(F)
+                      * max(int(lvl) - lvl0, 0))
+                metrics.gauge(
+                    "wgl_sharded_configs_per_device",
+                    "Live configs per device after the last chunk",
+                    labelnames=("n_shards",)).labels(
+                        n_shards=D).set(int(_cnt) / D)
+                metrics.event(
+                    "wgl_sharded_chunk", level=int(lvl), F=F,
+                    n_shards=D, global_capacity=FT, count=int(_cnt),
+                    frontier_max=fmax_all[0],
+                    wall_s=round(chunk_wall, 4))
 
             def result(valid, **extra):
                 r = {"valid": valid, "op_count": n, "device": True,
@@ -218,6 +268,13 @@ def check_encoded_sharded(
                 wgl._clear_search_checkpoint(checkpoint_path)
             return res
         attempt["overflowed"] = True
+        if metrics is not None and _esc < max_escalations:
+            # Only escalations that actually retry count (matching the
+            # single-device driver); the final schedule-exhausted
+            # overflow is not an escalation.
+            metrics.counter(
+                "wgl_capacity_escalations_total",
+                "Lossless frontier-capacity escalations").inc()
         FT = capacities(FT * 4)
         fr = wgl._pad_frontier(fr, FT)
     return {"valid": "unknown", "op_count": n, "device": True,

@@ -115,7 +115,8 @@ def to_edn_value(x: Any) -> Any:
 _TEST_SKIP_KEYS = frozenset(
     # Live objects that don't serialize: protocols, generators, functions.
     ("client", "nemesis", "generator", "checker", "db", "os", "net", "remote",
-     "barrier", "store", "history", "results")
+     "barrier", "store", "history", "results",
+     "telemetry-registry", "trace-collector")
 )
 
 

@@ -1,0 +1,87 @@
+"""Framework-wide telemetry: metrics registry, sinks, and heartbeats.
+
+The reference exports OpenCensus spans from ONE suite (dgraph's
+trace.clj) and nothing from the checker side; this package instruments
+the whole stack — WGL kernel chunks (per-level frontier sizes, dedup
+ratios, capacity escalations, compile-vs-execute split), the
+frontier-sharded search (per-device config counts, all_gather bytes),
+the interpreter/client path (op latency histograms by ``f`` and
+``type``), and ``core.run`` phase timings — and writes ``metrics.jsonl``
++ ``metrics.prom`` into the run's ``store/`` directory next to
+``spans.jsonl``, with ``jepsen_tpu.web``'s ``/metrics`` page rendering
+them per run.
+
+Gating seam: everything hangs off ``test["telemetry?"]`` (the
+``--telemetry`` CLI flag). :func:`of_test` returns the test's registry —
+creating and caching it under ``test["telemetry-registry"]`` — or None
+when telemetry is off, and every instrumentation site guards on that
+None, so a disabled run takes zero extra allocations; the jit'd WGL
+kernel in particular is only built with its stats carry when a registry
+is actually injected (``metrics=`` on the driver entry points). See
+docs/telemetry.md.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from .export import (  # noqa: F401
+    export_jsonl,
+    export_prometheus,
+    jsonl_lines,
+    prometheus_text,
+    store_metrics,
+)
+from .heartbeat import Heartbeat  # noqa: F401
+from .registry import (  # noqa: F401
+    DEFAULT_BUCKETS,
+    Counter,
+    Gauge,
+    Histogram,
+    Registry,
+    timed_phase,
+)
+
+
+import threading as _threading
+
+_of_test_lock = _threading.Lock()
+
+
+def enabled(test: Optional[dict]) -> bool:
+    """Is telemetry requested on this test map?"""
+    return bool(test and test.get("telemetry?"))
+
+
+def of_test(test: Optional[dict]) -> Optional[Registry]:
+    """The test's registry, created on first ask — or None when telemetry
+    is off (callers guard their instrumentation on that None). Creation
+    is locked: composed checkers ask from parallel threads, and a racy
+    double-create would silently split the series."""
+    if not enabled(test):
+        return None
+    reg = test.get("telemetry-registry")
+    if reg is None:
+        with _of_test_lock:
+            reg = test.get("telemetry-registry")
+            if reg is None:
+                reg = test["telemetry-registry"] = Registry()
+    return reg
+
+
+__all__ = [
+    "Counter",
+    "DEFAULT_BUCKETS",
+    "Gauge",
+    "Heartbeat",
+    "Histogram",
+    "Registry",
+    "enabled",
+    "export_jsonl",
+    "export_prometheus",
+    "jsonl_lines",
+    "of_test",
+    "prometheus_text",
+    "store_metrics",
+    "timed_phase",
+]

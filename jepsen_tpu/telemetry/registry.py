@@ -1,0 +1,302 @@
+"""Thread-safe metrics registry: counters, gauges and histograms with
+labels, plus a bounded append-only *event* stream for per-level series
+(BFS frontier sizes and the like) that don't fit the scalar model.
+
+The shape follows the Prometheus client-library data model (the same one
+the reference's dgraph suite feeds through OpenCensus) without the
+dependency: a :class:`Registry` owns named metrics, a metric owns one
+child per label-value tuple, children hold the numbers. Everything is
+lock-protected and cheap enough to sit on the interpreter's completion
+path; the WGL kernel itself never sees any of this — device-side stats
+ride the kernel's returned stats rows (``ops/wgl.py``) and are folded in
+host-side, so telemetry off ⇒ the jit'd program is bit-identical.
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+import time as _time
+from collections import deque
+from typing import Any, Iterable, Optional, Sequence
+
+# Latency-ish default buckets (seconds), 0.5 ms .. 10 s.
+DEFAULT_BUCKETS = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+    0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+)
+
+
+class _CounterChild:
+    __slots__ = ("_lock", "value")
+
+    def __init__(self, lock: threading.Lock):
+        self._lock = lock
+        self.value = 0.0
+
+    def inc(self, amount: float = 1.0) -> None:
+        if amount < 0:
+            raise ValueError("counters only go up")
+        with self._lock:
+            self.value += amount
+
+
+class _GaugeChild:
+    __slots__ = ("_lock", "value")
+
+    def __init__(self, lock: threading.Lock):
+        self._lock = lock
+        self.value = 0.0
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self.value = float(value)
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self.value += amount
+
+    def dec(self, amount: float = 1.0) -> None:
+        self.inc(-amount)
+
+    def max(self, value: float) -> None:
+        """Ratchet: keep the largest value seen (frontier peaks)."""
+        with self._lock:
+            if value > self.value:
+                self.value = float(value)
+
+
+class _HistogramChild:
+    __slots__ = ("_lock", "buckets", "counts", "sum", "count")
+
+    def __init__(self, lock: threading.Lock, buckets: Sequence[float]):
+        self._lock = lock
+        self.buckets = tuple(buckets)  # upper bounds, ascending, no +Inf
+        self.counts = [0] * (len(self.buckets) + 1)  # last = +Inf
+        self.sum = 0.0
+        self.count = 0
+
+    def observe(self, value: float) -> None:
+        i = bisect.bisect_left(self.buckets, value)
+        with self._lock:
+            self.counts[i] += 1
+            self.sum += value
+            self.count += 1
+
+
+class Metric:
+    """One named metric; holds a child per label-value tuple."""
+
+    kind = "untyped"
+
+    def __init__(self, name: str, help: str = "",
+                 labelnames: Sequence[str] = ()):
+        self.name = name
+        self.help = help
+        self.labelnames = tuple(labelnames)
+        self._lock = threading.Lock()
+        self._children: dict[tuple, Any] = {}
+        if not self.labelnames:
+            self._default = self.labels()
+
+    def _make_child(self):
+        raise NotImplementedError
+
+    def labels(self, **labelvalues: Any):
+        if set(labelvalues) != set(self.labelnames):
+            raise ValueError(
+                f"metric {self.name} takes labels {self.labelnames}, "
+                f"got {tuple(labelvalues)}")
+        key = tuple(str(labelvalues[n]) for n in self.labelnames)
+        child = self._children.get(key)
+        if child is None:
+            with self._lock:
+                child = self._children.setdefault(key, self._make_child())
+        return child
+
+    def samples(self) -> list[dict]:
+        with self._lock:
+            items = sorted(self._children.items())
+        out = []
+        for key, child in items:
+            s: dict = {
+                "name": self.name,
+                "type": self.kind,
+                "labels": dict(zip(self.labelnames, key)),
+            }
+            if isinstance(child, _HistogramChild):
+                with child._lock:
+                    s["count"] = child.count
+                    s["sum"] = child.sum
+                    s["buckets"] = dict(
+                        zip([*map(str, child.buckets), "+Inf"],
+                            list(child.counts)))
+            else:
+                s["value"] = child.value
+            out.append(s)
+        return out
+
+
+class Counter(Metric):
+    kind = "counter"
+
+    def _make_child(self):
+        return _CounterChild(self._lock)
+
+    def inc(self, amount: float = 1.0) -> None:
+        self._default.inc(amount)
+
+    @property
+    def value(self) -> float:
+        return self._default.value
+
+
+class Gauge(Metric):
+    kind = "gauge"
+
+    def _make_child(self):
+        return _GaugeChild(self._lock)
+
+    def set(self, value: float) -> None:
+        self._default.set(value)
+
+    def inc(self, amount: float = 1.0) -> None:
+        self._default.inc(amount)
+
+    def dec(self, amount: float = 1.0) -> None:
+        self._default.dec(amount)
+
+    def max(self, value: float) -> None:
+        self._default.max(value)
+
+    @property
+    def value(self) -> float:
+        return self._default.value
+
+
+class Histogram(Metric):
+    kind = "histogram"
+
+    def __init__(self, name, help="", labelnames=(),
+                 buckets: Sequence[float] = DEFAULT_BUCKETS):
+        b = tuple(sorted(float(x) for x in buckets if x != float("inf")))
+        if not b:
+            raise ValueError("histogram needs at least one finite bucket")
+        self.buckets = b
+        super().__init__(name, help, labelnames)
+
+    def _make_child(self):
+        return _HistogramChild(self._lock, self.buckets)
+
+    def observe(self, value: float) -> None:
+        self._default.observe(value)
+
+
+class Registry:
+    """Named-metric registry + bounded event stream.
+
+    Register-or-get semantics: asking twice for the same name returns the
+    same metric; asking with a different type/labelset raises (a silent
+    second registration would split the series)."""
+
+    def __init__(self, max_events: int = 100_000):
+        self._lock = threading.Lock()
+        self._metrics: dict[str, Metric] = {}
+        self._events: deque = deque(maxlen=max_events)
+        self.created_at = _time.time()
+
+    def _get_or_make(self, cls, name, help, labelnames, **extra) -> Any:
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = self._metrics[name] = cls(name, help, labelnames,
+                                              **extra)
+                return m
+        if not isinstance(m, cls) or m.labelnames != tuple(labelnames):
+            raise ValueError(
+                f"metric {name} already registered as {m.kind} with "
+                f"labels {m.labelnames}")
+        return m
+
+    def counter(self, name: str, help: str = "",
+                labelnames: Sequence[str] = ()) -> Counter:
+        return self._get_or_make(Counter, name, help, labelnames)
+
+    def gauge(self, name: str, help: str = "",
+              labelnames: Sequence[str] = ()) -> Gauge:
+        return self._get_or_make(Gauge, name, help, labelnames)
+
+    def histogram(self, name: str, help: str = "",
+                  labelnames: Sequence[str] = (),
+                  buckets: Sequence[float] = DEFAULT_BUCKETS) -> Histogram:
+        m = self._get_or_make(Histogram, name, help, labelnames,
+                              buckets=buckets)
+        want = tuple(sorted(float(x) for x in buckets
+                            if x != float("inf")))
+        if m.buckets != want:
+            raise ValueError(
+                f"metric {name} already registered with buckets "
+                f"{m.buckets}")
+        return m
+
+    def event(self, name: str, **fields: Any) -> None:
+        """Append one point to the event stream (per-BFS-level frontier
+        rows etc.). Bounded: oldest points fall off past ``max_events``.
+        Locked against :meth:`events` — iterating a deque while another
+        thread appends raises."""
+        with self._lock:
+            self._events.append({"name": name, **fields})
+
+    def events(self, name: Optional[str] = None) -> list[dict]:
+        with self._lock:
+            evs = list(self._events)
+        if name is None:
+            return evs
+        return [e for e in evs if e.get("name") == name]
+
+    def collect(self) -> list[dict]:
+        """Samples of every metric, sorted by (name, labels)."""
+        with self._lock:
+            metrics = sorted(self._metrics.items())
+        out: list[dict] = []
+        for _name, m in metrics:
+            out.extend(m.samples())
+        return out
+
+    def summary(self) -> dict:
+        """Flat ``name{labels}`` -> value dict (histograms fold to
+        count/sum) — what bench.py embeds in its JSON line."""
+        out: dict = {}
+        for s in self.collect():
+            labels = s.get("labels") or {}
+            key = s["name"]
+            if labels:
+                inner = ",".join(f"{k}={v}" for k, v in sorted(labels.items()))
+                key = f"{key}{{{inner}}}"
+            if s["type"] == "histogram":
+                out[key] = {"count": s["count"], "sum": round(s["sum"], 6)}
+            else:
+                v = s["value"]
+                out[key] = int(v) if float(v).is_integer() else round(v, 6)
+        return out
+
+
+def timed_phase(registry: Optional[Registry], phase: str):
+    """Context manager recording wall seconds of a run phase into
+    ``run_phase_seconds{phase=...}`` (no-op when registry is None)."""
+    from contextlib import contextmanager
+
+    @contextmanager
+    def _cm():
+        t0 = _time.perf_counter()
+        try:
+            yield
+        finally:
+            if registry is not None:
+                registry.gauge(
+                    "run_phase_seconds",
+                    "Wall seconds per test-lifecycle phase",
+                    labelnames=("phase",),
+                ).labels(phase=phase).set(_time.perf_counter() - t0)
+
+    return _cm()

@@ -10,7 +10,9 @@ client wrapper that spans every invoke.
 
 from __future__ import annotations
 
+import itertools
 import json
+import os
 import threading
 import time
 from contextlib import contextmanager
@@ -26,6 +28,10 @@ class Collector:
         self.spans: list[dict] = []
         self._lock = threading.Lock()
         self._local = threading.local()
+        # Span-id source: itertools.count.__next__ is atomic under the
+        # GIL, so concurrent spans can never mint colliding ids (the old
+        # len(self.spans) read outside the lock could).
+        self._ids = itertools.count()
 
     def _stack(self) -> list:
         st = getattr(self._local, "stack", None)
@@ -37,7 +43,7 @@ class Collector:
     def span(self, name: str, **attrs: Any):
         """Record a span around the body (trace.clj:9-30's with-trace)."""
         stack = self._stack()
-        sid = f"{threading.get_ident():x}-{len(self.spans)}-{len(stack)}"
+        sid = f"{threading.get_ident():x}-{next(self._ids)}"
         parent = stack[-1] if stack else None
         rec = {
             "name": name,
@@ -61,11 +67,17 @@ class Collector:
                 self.spans.append(rec)
 
     def export_jsonl(self, path) -> int:
+        """Write every span as one JSON line. Full snapshot into a tmp
+        file + atomic rename: repeated exports of a growing collector are
+        deterministic (each export is complete or absent — a crashed
+        export can never leave a truncated spans.jsonl behind)."""
         with self._lock:
             spans = list(self.spans)
-        with open(path, "w") as f:
+        tmp = f"{path}.{os.getpid()}.tmp"
+        with open(tmp, "w") as f:
             for s in spans:
                 f.write(json.dumps(s) + "\n")
+        os.replace(tmp, path)
         return len(spans)
 
 

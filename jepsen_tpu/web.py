@@ -3,7 +3,10 @@
 Mirrors jepsen.web (jepsen/src/jepsen/web.clj): a table of tests (name,
 start time, validity) linking into each run's files, plain file serving
 for history.edn / results.edn / jepsen.log / plots, and zip download of a
-run (web.clj:48-69, served via cli serve — cli.clj:323-340).
+run (web.clj:48-69, served via cli serve — cli.clj:323-340); plus a
+``/metrics`` page rendering each run's telemetry (metrics.jsonl, written
+by runs with ``test["telemetry?"]``/``--telemetry``) next to the results
+table, with the raw spans/metrics artifacts linked from the index.
 """
 
 from __future__ import annotations
@@ -48,6 +51,11 @@ td, th { border: 1px solid #ccc; padding: 0.3em 0.8em; text-align: left; }
 """
 
 
+# Per-run artifacts the index row links to directly (the telemetry +
+# tracing sinks; everything else is reachable through the file listing).
+_TELEMETRY_FILES = ("metrics.jsonl", "metrics.prom", "spans.jsonl")
+
+
 def _index_page(root: Path) -> str:
     rows = []
     tests = store.tests(root=root)
@@ -59,17 +67,101 @@ def _index_page(root: Path) -> str:
                    "unknown": "valid-unknown"}.get(v, "")
             vs = {True: "valid", False: "INVALID",
                   "unknown": "unknown"}.get(v, "—")
+            tele = " ".join(
+                f'<a href="/files/{name}/{start}/{fn}">{fn}</a>'
+                for fn in _TELEMETRY_FILES if (run / fn).exists()
+            ) or "—"
             rows.append(
                 f'<tr class="{cls}"><td><a href="/files/{name}/{start}/">'
                 f'{html.escape(name)}</a></td>'
                 f"<td>{html.escape(start)}</td><td>{vs}</td>"
+                f"<td>{tele}</td>"
                 f'<td><a href="/zip/{name}/{start}">zip</a></td></tr>'
             )
     return (
         f"<html><head><title>Jepsen</title><style>{_STYLE}</style></head>"
-        "<body><h1>Jepsen tests</h1><table>"
-        "<tr><th>Test</th><th>Started</th><th>Valid?</th><th></th></tr>"
+        "<body><h1>Jepsen tests</h1>"
+        '<p><a href="/metrics">metrics</a></p><table>'
+        "<tr><th>Test</th><th>Started</th><th>Valid?</th>"
+        "<th>Telemetry</th><th></th></tr>"
         + "".join(rows) + "</table></body></html>"
+    )
+
+
+def _metrics_summary(run_dir: Path, limit: int = 200) -> list[tuple]:
+    """Parse a run's metrics.jsonl into display rows
+    (metric, labels, value) — histograms fold to count/mean, events to a
+    per-name count."""
+    f = run_dir / "metrics.jsonl"
+    if not f.exists():
+        return []
+    rows: list[tuple] = []
+    event_counts: dict[str, int] = {}
+    try:
+        with open(f) as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                s = json.loads(line)
+                kind = s.get("type")
+                if kind == "event":
+                    n = s.get("name", "?")
+                    event_counts[n] = event_counts.get(n, 0) + 1
+                    continue
+                labels = ",".join(
+                    f"{k}={v}" for k, v in sorted(
+                        (s.get("labels") or {}).items()))
+                if kind == "histogram":
+                    cnt = s.get("count") or 0
+                    mean = (s.get("sum") or 0) / cnt if cnt else 0
+                    val = f"n={cnt} mean={mean:.4g}s"
+                else:
+                    v = s.get("value")
+                    val = str(int(v)) if isinstance(v, (int, float)) \
+                        and float(v).is_integer() else f"{v:.6g}"
+                rows.append((s.get("name", "?"), labels, val))
+    except Exception:
+        return [("(unparseable metrics.jsonl)", "", "")]
+    for n, c in sorted(event_counts.items()):
+        rows.append((n, "(events)", str(c)))
+    return rows[:limit]
+
+
+def _metrics_page(root: Path) -> str:
+    sections = []
+    tests = store.tests(root=root)
+    for name in sorted(tests):
+        for start in sorted(tests[name], reverse=True):
+            run = tests[name][start]
+            rows = _metrics_summary(run)
+            if not rows:
+                continue
+            body = "".join(
+                f"<tr><td>{html.escape(m)}</td><td>{html.escape(l)}</td>"
+                f"<td>{html.escape(v)}</td></tr>"
+                for m, l, v in rows
+            )
+            links = " · ".join(
+                f'<a href="/files/{name}/{start}/{fn}">{fn}</a>'
+                for fn in _TELEMETRY_FILES if (run / fn).exists()
+            )
+            sections.append(
+                f'<h2><a href="/files/{name}/{start}/">'
+                f"{html.escape(name)} / {html.escape(start)}</a></h2>"
+                f"<p>{links}</p><table>"
+                "<tr><th>Metric</th><th>Labels</th><th>Value</th></tr>"
+                + body + "</table>"
+            )
+    if not sections:
+        sections.append(
+            "<p>No runs with telemetry yet — run a test with "
+            "<code>--telemetry</code>.</p>")
+    return (
+        f"<html><head><title>Jepsen metrics</title>"
+        f"<style>{_STYLE}</style></head>"
+        '<body><h1>Run metrics</h1><p><a href="/">index</a></p>'
+        + "".join(sections) + "</body></html>"
     )
 
 
@@ -103,6 +195,9 @@ def make_handler(root: Path):
             try:
                 if path in ("/", "/index.html"):
                     self._send(200, _index_page(root).encode())
+                    return
+                if path in ("/metrics", "/metrics/"):
+                    self._send(200, _metrics_page(root).encode())
                     return
                 if path.startswith("/zip/"):
                     rel = path[len("/zip/"):].strip("/")

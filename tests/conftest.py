@@ -33,6 +33,10 @@ def pytest_configure(config):
     config.addinivalue_line("markers", "perf: throughput-floor tests")
     config.addinivalue_line(
         "markers", "integration: tests driving real external processes")
+    config.addinivalue_line(
+        "markers",
+        "slow: compile-heavy tests excluded from the tier-1 budget "
+        "(tier-1 runs -m 'not slow')")
 
 
 def pytest_addoption(parser):

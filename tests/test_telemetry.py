@@ -1,0 +1,538 @@
+"""Telemetry subsystem tests: registry semantics + concurrency, the
+Prometheus exposition golden format, sinks into the store tree, the
+heartbeat, per-BFS-level WGL kernel stats (monotone-consistent with the
+verdict), sharded-search metrics, CLI wiring, and the end-to-end
+traced+metered smoke run."""
+
+import argparse
+import json
+import logging
+import random
+import threading
+
+import pytest
+
+from jepsen_tpu import checker as jchecker
+from jepsen_tpu import cli, core, telemetry
+from jepsen_tpu import generator as gen
+from jepsen_tpu.models import CasRegister
+from jepsen_tpu.telemetry import Heartbeat, Registry
+from jepsen_tpu.workloads import AtomClient, AtomDB, AtomState, noop_test
+
+
+class TestRegistry:
+    def test_counter_gauge_basics(self):
+        reg = Registry()
+        c = reg.counter("reqs_total", "requests")
+        c.inc()
+        c.inc(2.5)
+        assert c.value == 3.5
+        with pytest.raises(ValueError):
+            c.inc(-1)
+        g = reg.gauge("depth")
+        g.set(7)
+        g.inc()
+        g.dec(3)
+        assert g.value == 5
+        g.max(2)
+        assert g.value == 5  # ratchet never lowers
+        g.max(9)
+        assert g.value == 9
+
+    def test_label_semantics(self):
+        reg = Registry()
+        c = reg.counter("ops_total", labelnames=("f", "type"))
+        c.labels(f="read", type="ok").inc()
+        c.labels(f="read", type="ok").inc()
+        c.labels(f="write", type="ok").inc()
+        # Same label values -> the same child object.
+        assert c.labels(f="read", type="ok") is c.labels(type="ok", f="read")
+        assert c.labels(f="read", type="ok").value == 2
+        # Wrong label names are an error, not a silent new series.
+        with pytest.raises(ValueError):
+            c.labels(f="read")
+        with pytest.raises(ValueError):
+            c.labels(f="read", typ="ok")
+        # Register-or-get: same spec returns the same metric; a
+        # different type or labelset for the same name raises.
+        assert reg.counter("ops_total", labelnames=("f", "type")) is c
+        with pytest.raises(ValueError):
+            reg.gauge("ops_total")
+        with pytest.raises(ValueError):
+            reg.counter("ops_total", labelnames=("f",))
+
+    def test_histogram_buckets(self):
+        reg = Registry()
+        h = reg.histogram("lat", buckets=(0.1, 1.0))
+        for v in (0.05, 0.5, 5.0):
+            h.observe(v)
+        (s,) = [x for x in reg.collect() if x["name"] == "lat"]
+        assert s["count"] == 3
+        assert s["sum"] == pytest.approx(5.55)
+        assert s["buckets"] == {"0.1": 1, "1.0": 1, "+Inf": 1}
+        # Boundary lands in the bucket whose upper bound it equals.
+        h.observe(0.1)
+        (s,) = [x for x in reg.collect() if x["name"] == "lat"]
+        assert s["buckets"]["0.1"] == 2
+
+    def test_concurrent_increments(self):
+        reg = Registry()
+        c = reg.counter("hot_total", labelnames=("lane",))
+        h = reg.histogram("hot_lat", buckets=(0.5,))
+        n_threads, n_iter = 8, 2000
+        barrier = threading.Barrier(n_threads)
+
+        def work(i):
+            child = c.labels(lane=i % 2)
+            barrier.wait()
+            for _ in range(n_iter):
+                child.inc()
+                h.observe(0.1)
+
+        ts = [threading.Thread(target=work, args=(i,))
+              for i in range(n_threads)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        total = sum(s["value"] for s in reg.collect()
+                    if s["name"] == "hot_total")
+        assert total == n_threads * n_iter
+        (s,) = [x for x in reg.collect() if x["name"] == "hot_lat"]
+        assert s["count"] == n_threads * n_iter
+
+    def test_events_bounded(self):
+        reg = Registry(max_events=10)
+        for i in range(25):
+            reg.event("tick", i=i)
+        evs = reg.events("tick")
+        assert len(evs) == 10
+        assert evs[-1]["i"] == 24  # newest kept, oldest dropped
+
+
+class TestExposition:
+    def _golden_registry(self):
+        reg = Registry()
+        reg.counter("requests_total", "Total requests",
+                    labelnames=("code",)).labels(code=200).inc(3)
+        reg.gauge("temp", "Temperature").set(1.5)
+        h = reg.histogram("lat_seconds", "Latency", buckets=(0.1, 1.0))
+        for v in (0.05, 0.5, 5.0):
+            h.observe(v)
+        return reg
+
+    def test_prometheus_golden(self):
+        text = telemetry.prometheus_text(self._golden_registry())
+        assert text == (
+            "# HELP lat_seconds Latency\n"
+            "# TYPE lat_seconds histogram\n"
+            'lat_seconds_bucket{le="0.1"} 1\n'
+            'lat_seconds_bucket{le="1.0"} 2\n'
+            'lat_seconds_bucket{le="+Inf"} 3\n'
+            "lat_seconds_sum 5.55\n"
+            "lat_seconds_count 3\n"
+            "# HELP requests_total Total requests\n"
+            "# TYPE requests_total counter\n"
+            'requests_total{code="200"} 3\n'
+            "# HELP temp Temperature\n"
+            "# TYPE temp gauge\n"
+            "temp 1.5\n"
+        )
+
+    def test_label_escaping(self):
+        reg = Registry()
+        reg.counter("weird_total", labelnames=("v",)).labels(
+            v='a"b\\c\nd').inc()
+        text = telemetry.prometheus_text(reg)
+        assert r'weird_total{v="a\"b\\c\nd"} 1' in text
+
+    def test_jsonl_roundtrip(self):
+        reg = self._golden_registry()
+        reg.event("wgl_level", level=1, frontier=2)
+        lines = [json.loads(l) for l in telemetry.jsonl_lines(reg)]
+        kinds = {(s.get("name"), s.get("type")) for s in lines}
+        assert ("requests_total", "counter") in kinds
+        assert ("lat_seconds", "histogram") in kinds
+        assert ("wgl_level", "event") in kinds
+
+    def test_store_metrics(self, tmp_path):
+        reg = self._golden_registry()
+        test = {"name": "t", "start-time": "20260803T000000.000Z",
+                "store-root": str(tmp_path), "telemetry-registry": reg}
+        paths = telemetry.store_metrics(test)
+        assert paths is not None
+        d = tmp_path / "t" / "20260803T000000.000Z"
+        assert (d / "metrics.jsonl").exists()
+        assert "# TYPE temp gauge" in (d / "metrics.prom").read_text()
+        # no-store? and registry-less tests are no-ops
+        assert telemetry.store_metrics({"name": "x"}) is None
+        test["no-store?"] = True
+        assert telemetry.store_metrics(test) is None
+
+
+class TestGating:
+    def test_of_test(self):
+        assert telemetry.of_test(None) is None
+        assert telemetry.of_test({}) is None
+        t = {"telemetry?": True}
+        reg = telemetry.of_test(t)
+        assert isinstance(reg, Registry)
+        assert telemetry.of_test(t) is reg  # cached on the test map
+
+    def test_serializable_test_elides_registry(self):
+        from jepsen_tpu import store
+
+        t = {"name": "x", "telemetry?": True}
+        telemetry.of_test(t)
+        s = store.serializable_test(t)
+        assert "telemetry-registry" not in s
+        assert s["telemetry?"] is True
+
+
+class TestHeartbeat:
+    def test_heartbeat_logs_progress_and_eta(self, caplog):
+        log = logging.getLogger("test.heartbeat")
+        hb = Heartbeat(interval_s=0, label="lin", log=log)
+        with caplog.at_level(logging.INFO, logger="test.heartbeat"):
+            hb({"level": 43, "total_levels": 100, "wall_s": 43.0,
+                "count": 7, "F": 16})
+        assert hb.beats == 1
+        msg = caplog.records[-1].getMessage()
+        assert "43%" in msg and "level 43/100" in msg
+        assert "ETA 57s" in msg and "frontier 7" in msg and "F=16" in msg
+
+    def test_heartbeat_rate_limit_and_registry(self):
+        reg = Registry()
+        hb = Heartbeat(interval_s=3600, registry=reg,
+                       log=logging.getLogger("test.hb2"))
+        hb({"level": 10, "total_levels": 20, "wall_s": 5.0})
+        hb({"level": 11, "total_levels": 20, "wall_s": 6.0})  # suppressed
+        assert hb.beats == 1
+        assert reg.gauge("wgl_progress_level").value == 10
+        assert reg.gauge("wgl_progress_percent").value == 50.0
+
+
+class TestWglLevelStats:
+    """Per-BFS-level kernel stats must be monotone-consistent with the
+    verdict (acceptance criterion: a CPU-mesh WGL check with telemetry
+    reports per-level frontier sizes, the compile/execute split, and
+    escalation counts). Only the single-bucket valid-history test rides
+    tier 1; the multi-compile variants are marked slow."""
+
+    def test_valid_history_levels(self):
+        from jepsen_tpu.ops import wgl
+        from jepsen_tpu.testing import random_register_history
+
+        h = random_register_history(random.Random(11), n_ops=40,
+                                    n_procs=4, crash_p=0.1)
+        reg = Registry()
+        # Single-rung schedule: exactly one compiled telemetry-variant
+        # bucket (keeps the tier-1 budget); 1024 dominates this
+        # history's frontier peak so no escalation occurs.
+        res = wgl.check_history_device(CasRegister(init=0), h,
+                                       f_schedule=(1024,), metrics=reg)
+        assert res["valid"] is True
+        completed = [e for e in reg.events("wgl_level") if e["completed"]]
+        levels = [e["level"] for e in completed]
+        # One record per level, strictly monotone, reaching the verdict's
+        # level count exactly.
+        assert levels == list(range(1, res["levels"] + 1))
+        assert all(e["frontier"] >= 1 for e in completed)
+        # Dedup can only shrink the expansion.
+        assert all(e["frontier"] <= e["expanded"] for e in completed)
+        # The kernel's own running max agrees with the per-level series.
+        assert res["frontier_max"] == max(
+            e["frontier"] for e in reg.events("wgl_level"))
+        assert reg.gauge("wgl_frontier_max").value == res["frontier_max"]
+        s = reg.summary()
+        assert s["wgl_levels_total"] == res["levels"]
+        assert any(k.startswith("wgl_kernel_seconds_total") for k in s)
+
+    @pytest.mark.slow
+    def test_invalid_history_ends_empty(self):
+        from jepsen_tpu.ops import wgl
+        from jepsen_tpu.testing import (perturb_history,
+                                        random_register_history)
+
+        rng = random.Random(12)
+        refuted = 0
+        for _ in range(8):
+            h = perturb_history(rng, random_register_history(
+                rng, n_ops=24, n_procs=3, crash_p=0.1))
+            reg = Registry()
+            res = wgl.check_history_device(CasRegister(init=0), h,
+                                           metrics=reg)
+            if res["valid"] is not False:
+                continue
+            refuted += 1
+            evs = reg.events("wgl_level")
+            completed = [e for e in evs if e["completed"]]
+            assert [e["level"] for e in completed] == list(
+                range(1, res["levels"] + 1))
+            # The refuting attempt: the frontier emptied one level past
+            # the last completed one.
+            last = evs[-1]
+            assert last["completed"] is False
+            assert last["frontier"] == 0
+            assert last["level"] == res["levels"] + 1
+        assert refuted > 0
+
+    @pytest.mark.slow
+    def test_escalation_metrics(self):
+        from jepsen_tpu.ops import wgl
+        from jepsen_tpu.testing import random_register_history
+
+        h = random_register_history(random.Random(13), n_ops=24,
+                                    n_procs=6, crash_p=0.3)
+        reg = Registry()
+        res = wgl.check_history_device(CasRegister(init=0), h,
+                                       f_schedule=(2, 4096), metrics=reg)
+        assert res["valid"] is True
+        assert reg.counter("wgl_capacity_escalations_total").value >= 1
+        esc = reg.events("wgl_escalation")
+        assert esc and esc[0]["from_F"] == 2 and esc[0]["to_F"] == 4096
+        # The overflow attempt at the tiny capacity is recorded too.
+        assert any(e["overflow"] for e in reg.events("wgl_level"))
+        # Kernel build-cache lookups recorded per bucket.
+        s = reg.summary()
+        assert any(k.startswith("wgl_kernel_cache_total") for k in s)
+
+    @pytest.mark.slow
+    def test_disabled_means_plain_kernel(self):
+        """Telemetry off ⇒ the driver requests the stats-less kernel
+        variant (zero new allocations in the kernel path)."""
+        from jepsen_tpu.models import Model
+        from jepsen_tpu.ops import wgl
+        from jepsen_tpu.testing import random_register_history
+        from jepsen_tpu.ops.encode import encode_history
+
+        h = random_register_history(random.Random(14), n_ops=20,
+                                    n_procs=3, crash_p=0.1)
+        enc = encode_history(CasRegister(init=0), h)
+        plan = wgl.plan_device(enc)
+        mk = wgl._model_cache_key(enc.model)
+        W, KO, S, ND, NO = plan.dims
+        _, kern_plain = wgl._build_kernel(mk, 16, W, KO, S, ND, NO,
+                                          B=plan.B)
+        _, kern_stats = wgl._build_kernel(mk, 16, W, KO, S, ND, NO,
+                                          B=plan.B, collect_stats=True)
+        fr = wgl.initial_frontier(16, W, KO, S, plan.init_state)
+        import numpy as np
+
+        out_plain = kern_plain(*plan.args[:2], np.int32(3),
+                               *plan.args[3:], *fr[:-1], np.int32(0),
+                               np.int32(0))
+        out_stats = kern_stats(*plan.args[:2], np.int32(3),
+                               *plan.args[3:], *fr[:-1], np.int32(0),
+                               np.int32(0))
+        assert len(out_plain) == 6  # flags + 5 frontier arrays, no stats
+        assert len(out_stats) == 7
+        assert out_stats[1].shape == (wgl.LEVEL_STAT_ROWS, 4)
+        # Same flags / frontier either way.
+        assert (np.asarray(out_plain[0]) == np.asarray(out_stats[0])).all()
+        assert (np.asarray(out_plain[-5]) == np.asarray(out_stats[-5])).all()
+
+
+class TestBatchCheckTelemetry:
+    @pytest.mark.slow
+    def test_batch_check_records_metrics(self):
+        from jepsen_tpu.testing import random_register_history
+
+        rng = random.Random(41)
+        hs = {k: random_register_history(rng, n_ops=20, n_procs=3,
+                                         crash_p=0.1)
+              for k in ("a", "b")}
+        chk = jchecker.linearizable(model=CasRegister(init=0))
+        test = {"telemetry?": True}
+        out = chk.batch_check(test, hs)
+        assert set(out) == {"a", "b"}
+        s = test["telemetry-registry"].summary()
+        assert "checker_seconds{backend=batch,checker=linearizable}" in s
+        keys = sum(v for k, v in s.items()
+                   if k.startswith("checker_batch_keys_total"))
+        assert keys == 2
+
+
+class TestShardedTelemetry:
+    @pytest.mark.slow
+    def test_sharded_chunk_metrics(self):
+        from jepsen_tpu.parallel import make_mesh
+        from jepsen_tpu.parallel.frontier import check_history_sharded
+        from jepsen_tpu.testing import random_register_history
+
+        mesh = make_mesh(8, shape=(8, 1))
+        h = random_register_history(random.Random(31), n_ops=60,
+                                    n_procs=4, crash_p=0.05, cas=True)
+        reg = Registry()
+        res = check_history_sharded(CasRegister(init=0), h, mesh=mesh,
+                                    f_total=128, metrics=reg)
+        assert res["valid"] is True
+        assert reg.counter("wgl_allgather_bytes_total").value > 0
+        evs = reg.events("wgl_sharded_chunk")
+        assert evs
+        assert evs[-1]["n_shards"] == res["n_shards"] == 8
+        assert evs[-1]["level"] == res["levels"]
+        s = reg.summary()
+        assert s["wgl_sharded_levels_total"] == res["levels"]
+        assert any(k.startswith("wgl_kernel_cache_total{cache=sharded")
+                   for k in s)
+
+
+def _smoke_test_map(tmp_path, n_ops=30):
+    state = AtomState()
+    test = dict(noop_test())
+    test.update({
+        "name": "telemetry-smoke",
+        "telemetry?": True,
+        "store-root": str(tmp_path),
+        "nodes": ["n1", "n2"],
+        "concurrency": 4,
+        "db": AtomDB(state),
+        "client": AtomClient(state, latency=0),
+        "checker": jchecker.compose({
+            "linear": jchecker.linearizable(model=CasRegister(init=0)),
+            "stats": jchecker.stats(),
+        }),
+        "generator": gen.clients(gen.limit(n_ops, gen.mix([
+            lambda: {"f": "write", "value": gen.rand_int(5)},
+            lambda: {"f": "read"},
+        ]))),
+    })
+    return test
+
+
+class TestEndToEnd:
+    """The tier-1-safe smoke: ONE tiny register test with telemetry on
+    (class fixture), asserted on by every test below."""
+
+    @pytest.fixture(scope="class")
+    def run(self, tmp_path_factory):
+        root = tmp_path_factory.mktemp("telemetry-store")
+        res = core.run(_smoke_test_map(root))
+        return res, root
+
+    def test_run_valid_and_artifacts_present(self, run):
+        res, root = run
+        assert res["results"]["valid"] is True
+        d = root / "telemetry-smoke" / res["start-time"]
+        for fn in ("spans.jsonl", "metrics.jsonl", "metrics.prom",
+                   "history.edn", "results.edn"):
+            assert (d / fn).exists(), fn
+
+    def test_spans_cover_client_lifecycle(self, run):
+        res, root = run
+        d = root / "telemetry-smoke" / res["start-time"]
+        spans = [json.loads(l) for l in
+                 (d / "spans.jsonl").read_text().splitlines()]
+        assert any(s["name"] == "client.invoke" for s in spans)
+        assert any(s["name"] == "client.setup" for s in spans)
+
+    def test_metrics_series_populated(self, run):
+        res, root = run
+        d = root / "telemetry-smoke" / res["start-time"]
+        lines = (d / "metrics.jsonl").read_text().splitlines()
+        names = {json.loads(l).get("name") for l in lines}
+        assert "jepsen_op_latency_seconds" in names
+        assert "run_phase_seconds" in names
+        assert "checker_seconds" in names
+        prom = (d / "metrics.prom").read_text()
+        assert "# TYPE jepsen_op_latency_seconds histogram" in prom
+        assert "# TYPE run_phase_seconds gauge" in prom
+        # Every completed client op is in the latency histogram.
+        lat = [json.loads(l) for l in lines
+               if '"jepsen_op_latency_seconds"' in l]
+        assert sum(s["count"] for s in lat) == 30
+        # All three lifecycle phases timed.
+        phases = {json.loads(l)["labels"]["phase"] for l in lines
+                  if '"run_phase_seconds"' in l}
+        assert phases == {"db.cycle", "run_case", "analyze"}
+
+    def test_web_pages_surface_metrics(self, run):
+        from jepsen_tpu import web
+
+        res, root = run
+        idx = web._index_page(root)
+        start = res["start-time"]
+        assert f"/files/telemetry-smoke/{start}/metrics.jsonl" in idx
+        assert f"/files/telemetry-smoke/{start}/spans.jsonl" in idx
+        assert '<a href="/metrics">' in idx
+        page = web._metrics_page(root)
+        assert "telemetry-smoke" in page
+        assert "jepsen_op_latency_seconds" in page
+        assert "run_phase_seconds" in page
+
+    def test_metrics_page_empty_store(self, tmp_path):
+        from jepsen_tpu import web
+
+        assert "No runs with telemetry" in web._metrics_page(tmp_path)
+
+    def test_no_telemetry_run_writes_no_metrics(self, tmp_path):
+        t = _smoke_test_map(tmp_path, n_ops=5)
+        t.pop("telemetry?")
+        res = core.run(t)
+        d = tmp_path / "telemetry-smoke" / res["start-time"]
+        assert (d / "results.edn").exists()
+        assert not (d / "metrics.jsonl").exists()
+        assert not (d / "spans.jsonl").exists()
+
+
+class TestCliWiring:
+    def test_telemetry_flag_sets_test_key(self):
+        p = argparse.ArgumentParser()
+        cli.add_test_opts(p)
+        opts = cli.options_map(p.parse_args(["--telemetry"]))
+        assert cli._apply_std_opts({}, opts).get("telemetry?") is True
+        opts = cli.options_map(p.parse_args([]))
+        assert "telemetry?" not in cli._apply_std_opts({}, opts)
+
+    def test_cli_run_with_telemetry_writes_store(self, tmp_path):
+        def test_fn(opts):
+            t = _smoke_test_map(tmp_path, n_ops=10)
+            t.pop("telemetry?")  # the flag must supply it
+            t["name"] = "cli-telemetry"
+            return t
+
+        cmds = cli.single_test_cmd(test_fn)
+        code = cli.run(cmds, ["test", "--telemetry", "--store-root",
+                              str(tmp_path), "--nodes", "n1,n2",
+                              "--concurrency", "4"])
+        assert code == cli.EXIT_OK
+        runs = list((tmp_path / "cli-telemetry").iterdir())
+        run_dirs = [r for r in runs if r.is_dir() and not r.is_symlink()]
+        assert len(run_dirs) == 1
+        assert (run_dirs[0] / "metrics.prom").exists()
+        assert (run_dirs[0] / "spans.jsonl").exists()
+
+
+@pytest.mark.perf
+def test_telemetry_overhead_floor():
+    """Interpreter throughput with telemetry ON must stay within the
+    acceptance envelope (<5% target; the floor here is loose for CI
+    noise — it exists to catch order-of-magnitude regressions)."""
+    import time
+
+    from jepsen_tpu import nemesis as jnem
+    from jepsen_tpu.generator import interpreter as jinterp
+    from jepsen_tpu.util import with_relative_time
+
+    def w(test=None, ctx=None):
+        return {"type": "invoke", "f": "write", "value": 1}
+
+    def run_once(tele):
+        test = dict(noop_test())
+        test.update(name=None, nodes=["n1"], concurrency=8,
+                    client=AtomClient(AtomState(), latency=0),
+                    nemesis=jnem.noop(),
+                    generator=gen.clients(gen.limit(20000, w)))
+        if tele:
+            test["telemetry?"] = True
+        with with_relative_time():
+            t0 = time.perf_counter()
+            h = jinterp.run(test)
+            dt = time.perf_counter() - t0
+        ok = sum(1 for op in h if op.get("type") == "ok")
+        return ok / dt
+
+    base = max(run_once(False) for _ in range(3))
+    tele = max(run_once(True) for _ in range(3))
+    assert tele > 0.8 * base, f"telemetry {tele:.0f} vs base {base:.0f} ops/s"

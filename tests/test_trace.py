@@ -1,0 +1,80 @@
+"""Tracing collector tests: span-id uniqueness under thread contention
+(the old ``len(self.spans)`` read outside the lock could mint colliding
+ids) and deterministic repeated exports (atomic full-snapshot writes)."""
+
+import json
+import threading
+
+from jepsen_tpu import trace
+
+
+class TestSpanIds:
+    def test_span_ids_unique_under_threads(self):
+        """Regression: hammer Collector.span from N threads; every span
+        must get a distinct id."""
+        col = trace.Collector()
+        n_threads, n_spans = 8, 200
+        barrier = threading.Barrier(n_threads)
+
+        def work():
+            barrier.wait()
+            for _ in range(n_spans):
+                with col.span("hammer"):
+                    pass
+
+        threads = [threading.Thread(target=work) for _ in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(col.spans) == n_threads * n_spans
+        ids = [s["span_id"] for s in col.spans]
+        assert len(set(ids)) == len(ids)
+
+    def test_nested_spans_parented(self):
+        col = trace.Collector()
+        with col.span("outer") as outer:
+            with col.span("inner"):
+                pass
+        inner_rec = next(s for s in col.spans if s["name"] == "inner")
+        assert inner_rec["parent_id"] == outer["span_id"]
+        outer_rec = next(s for s in col.spans if s["name"] == "outer")
+        assert outer_rec["parent_id"] is None
+
+
+class TestExport:
+    def test_repeated_export_is_full_snapshot(self, tmp_path):
+        col = trace.Collector()
+        p = tmp_path / "spans.jsonl"
+        for _ in range(3):
+            with col.span("a"):
+                pass
+        assert col.export_jsonl(p) == 3
+        lines = p.read_text().splitlines()
+        assert len(lines) == 3
+        # Grow the collector, export to the SAME path again: the file is
+        # replaced with the complete snapshot (never appended-duplicated,
+        # never truncated mid-write — tmp + atomic rename).
+        for _ in range(2):
+            with col.span("b"):
+                pass
+        assert col.export_jsonl(p) == 5
+        lines = p.read_text().splitlines()
+        assert len(lines) == 5
+        names = [json.loads(l)["name"] for l in lines]
+        assert names.count("a") == 3 and names.count("b") == 2
+        # No tmp litter left behind.
+        assert list(tmp_path.iterdir()) == [p]
+
+    def test_export_records_error_and_duration(self, tmp_path):
+        col = trace.Collector()
+        try:
+            with col.span("boom"):
+                raise ValueError("nope")
+        except ValueError:
+            pass
+        p = tmp_path / "s.jsonl"
+        col.export_jsonl(p)
+        rec = json.loads(p.read_text())
+        assert rec["error"] == "ValueError: nope"
+        assert rec["duration_us"] >= 0
