@@ -42,12 +42,34 @@ import time
 
 N_OPS = int(os.environ.get("BENCH_N_OPS", "10000"))
 BASELINE_S = 300.0
-BUDGET_S = float(os.environ.get("BENCH_BUDGET_S", "740"))
+# r6: the device scale metric runs under the SAME 300 s definition as
+# the native one (it had a 160 s sub-budget before), and a
+# frontier-sharded entry joins it — the default budget grows to hold
+# the two extra ~300 s-class legs. Every long leg still prints a full
+# checkpoint line first, so a driver-side kill never loses earlier
+# sections.
+BUDGET_S = float(os.environ.get("BENCH_BUDGET_S", "1200"))
 _T0 = time.monotonic()
 
 
 def _left() -> float:
     return BUDGET_S - (time.monotonic() - _T0)
+
+
+class _Deadline(Exception):
+    """Raised by a device driver's chunk callback past a leg's wall
+    deadline (the overshoot-abort contract: exceptions propagate out of
+    the chunk loops). Carries the callback info's ``key`` field."""
+
+
+def _deadline_cb(seconds: float, key: str = "level"):
+    end = time.monotonic() + seconds
+
+    def cb(info):
+        if time.monotonic() > end:
+            raise _Deadline(info.get(key))
+
+    return cb
 
 
 def main() -> int:
@@ -321,16 +343,60 @@ def main() -> int:
                             crash_p=0.002, fail_p=0.02)
                         for _ in range(8)
                     ]
+                    # Comparison field (one round only): the pre-r6
+                    # no-escalation number — every member overflows the
+                    # shared f=256 capacity and reports unknown. Warm
+                    # the f=256 bucket first so the timed comparison
+                    # doesn't carry the compile the escalation run
+                    # would then reuse for free.
+                    check_batch(model, smokeh, f=256, escalate=False)
                     t0 = time.perf_counter()
-                    rsS = check_batch(model, smokeh, f=256,
+                    rs0 = check_batch(model, smokeh, f=256,
                                       escalate=False)
-                    out["batch_replay_large"]["smoke_8x10k"] = {
+                    no_esc = {
                         "value_s": round(time.perf_counter() - t0, 3),
-                        "decided": sum(1 for r in rsS
+                        "decided": sum(1 for r in rs0
                                        if r["valid"] != "unknown"),
-                        "unknown": sum(1 for r in rsS
+                        "unknown": sum(1 for r in rs0
                                        if r["valid"] == "unknown"),
                     }
+                    # Headline: the batched escalation pipeline —
+                    # overflowing members regroup into vmapped
+                    # re-batches up the frontier schedule (resuming
+                    # from their checkpointed frontiers); serial
+                    # fallback only past the top rung. Per-rung timing
+                    # rides the result's "rungs" list; a deadline on
+                    # the chunk callback bounds the leg.
+                    t0 = time.perf_counter()
+                    try:
+                        rsS = check_batch(
+                            model, smokeh, f=256, escalate=True,
+                            chunk_callback=_deadline_cb(
+                                min(240, _left() - 60), key="F"))
+                        smoke = {
+                            "value_s": round(
+                                time.perf_counter() - t0, 3),
+                            "decided": sum(1 for r in rsS
+                                           if r["valid"] != "unknown"),
+                            "unknown": sum(1 for r in rsS
+                                           if r["valid"] == "unknown"),
+                            "escalated": sum(1 for r in rsS
+                                             if r.get("escalated")),
+                            "serial_fallbacks": sum(
+                                1 for r in rsS
+                                if r.get("escalated") == "serial"),
+                            "rungs": next(
+                                (r["rungs"] for r in rsS
+                                 if r.get("rungs")), None),
+                        }
+                    except _Deadline as dl:
+                        smoke = {
+                            "value_s": round(
+                                time.perf_counter() - t0, 3),
+                            "deadline_at_F": str(dl),
+                        }
+                    smoke["no_escalation_compare"] = no_esc
+                    out["batch_replay_large"]["smoke_8x10k"] = smoke
         except Exception as e:  # noqa: BLE001
             out["batch_replay_large"] = {
                 "error": f"{type(e).__name__}: {e}"}
@@ -555,52 +621,136 @@ def main() -> int:
 
         _checkpoint()
 
-        # Device entry for the metric, under an enforced ~160 s
-        # sub-budget (the device kernel's per-level latency makes a
-        # 300 s device leg untenable inside one bench run). Same
-        # history family as the headline (random_register_history);
-        # 30k ops measured ~105 s steady, ~150 s loaded, on a v5e. The
-        # device's wide lane is the batch/mesh axis, not single-history
-        # latency — see batch_replay_large. The deadline is ENFORCED
-        # through the chunk callback (exceptions propagate out of the
-        # chunk loop), not merely reported.
+        # Device entry for the metric, under the SAME 300 s definition
+        # as the native leg (the arbitrary 160 s sub-budget is gone —
+        # r6 unification): the metric is the largest history the device
+        # kernel verifies inside BASELINE_S. Mechanics: attempts are
+        # sized from the measured rate, the deadline is ENFORCED
+        # through the chunk callback (overshoot-abort — exceptions
+        # propagate out of the chunk loop), an aborted attempt RETRIES
+        # DOWNWARD, and a finish far under the frontier retries upward
+        # while the leg's wall budget lasts. The leg's own wall cap
+        # (which squeezes the check cap when the whole bench is
+        # running out of room) is reported as cap_s.
         try:
-            if _left() < 230 or not devices_ok:
+            if _left() < 260 or not devices_ok:
                 out["max_verified_ops_device"] = {"skipped": "budget"}
             else:
-                dh = random_register_history(
-                    random.Random(2031), n_ops=30_000, n_procs=10,
-                    cas=True, crash_p=20 / 30_000, fail_p=0.02)
-                denc = encode_history(model, dh)
+                leg_end = time.monotonic() + min(420, _left() - 130)
 
-                class _DevDeadline(Exception):
-                    pass
+                def _dev_attempt(n_inv, cap):
+                    dh = random_register_history(
+                        random.Random(2031), n_ops=n_inv, n_procs=10,
+                        cas=True, crash_p=20 / n_inv, fail_p=0.02)
+                    denc = encode_history(model, dh)
+                    t0 = time.perf_counter()
+                    try:
+                        r = wgl.check_encoded_device(
+                            denc, chunk_callback=_deadline_cb(cap))
+                        return denc.n, r["valid"], \
+                            time.perf_counter() - t0, None
+                    except _Deadline as dl:
+                        return denc.n, None, \
+                            time.perf_counter() - t0, int(str(dl))
 
-                deadline = time.monotonic() + 160
-
-                def _dl(info):
-                    if time.monotonic() > deadline:
-                        raise _DevDeadline(info.get("level"))
-
-                t0 = time.perf_counter()
-                try:
-                    dres2 = wgl.check_encoded_device(
-                        denc, chunk_callback=_dl)
-                    dvalid = dres2["valid"]
-                except _DevDeadline as dl:
-                    dvalid = f"deadline at level {dl}"
-                ddt = time.perf_counter() - t0
+                best = None
+                tries = []
+                n_inv = 3 * N_OPS  # 30k at the production N_OPS
+                for _a in range(3):
+                    cap = min(BASELINE_S, leg_end - time.monotonic())
+                    if cap < 30:
+                        break
+                    ops, dvalid, ddt, at_lvl = _dev_attempt(n_inv, cap)
+                    tries.append({
+                        "invocations": n_inv, "ops": ops,
+                        "value_s": round(ddt, 3), "cap_s": round(cap, 1),
+                        "valid": (dvalid if at_lvl is None
+                                  else f"deadline at level {at_lvl}")})
+                    if dvalid is True and ddt <= BASELINE_S:
+                        if best is None or ops > best["ops"]:
+                            best = {"ops": ops, "invocations": n_inv,
+                                    "value_s": round(ddt, 3),
+                                    "cap_s": round(cap, 1)}
+                        if ddt >= 0.6 * cap:
+                            break  # close enough to the frontier
+                        # Upward retry: size to the cap from the
+                        # measured rate, conservatively (device level
+                        # cost grows with frontier width, so the
+                        # linear model overestimates reachable size).
+                        n_inv = int(n_inv * min(cap / max(ddt, 1e-3),
+                                                3.0) * 0.7)
+                    else:
+                        n_inv = int(n_inv * 0.6)  # downward retry
                 out["max_verified_ops_device"] = {
-                    "ops": denc.n, "invocations": 30_000,
-                    "value_s": round(ddt, 3),
-                    "valid": dvalid,
-                    "budget_s": 160,
-                    "note": "wall includes any cold compiles; "
-                            "single-history device latency — the batch "
-                            "axis is the device's scale lane",
+                    **(best or {"ops": 0}),
+                    "valid": True if best is not None
+                    else "no attempt verified within cap",
+                    "budget_s": BASELINE_S,
+                    "attempts": tries,
+                    "note": "unified 300 s definition (same as "
+                            "max_verified_ops); overshoot-abort via "
+                            "chunk callback + downward retry; wall "
+                            "includes any cold compiles",
                 }
         except Exception as e:  # noqa: BLE001
             out["max_verified_ops_device"] = {
+                "error": f"{type(e).__name__}: {e}"}
+
+        _checkpoint()
+
+        # Frontier-sharded entry under the SAME 300 s definition: one
+        # history's search frontier sharded over the local mesh
+        # (jepsen_tpu.parallel.frontier — ICI sequence parallelism on
+        # real multi-chip hosts, a 1-device mesh degenerately
+        # elsewhere). Single attempt sized from the unsharded leg's
+        # result; same overshoot-abort contract via the sharded
+        # driver's chunk callback.
+        try:
+            if _left() < 180 or not devices_ok:
+                out["max_verified_ops_device_sharded"] = {
+                    "skipped": "budget"}
+            else:
+                import jax as _jx
+
+                from jepsen_tpu.parallel import make_mesh
+                from jepsen_tpu.parallel.frontier import \
+                    check_encoded_sharded
+
+                mesh = make_mesh()
+                # Half the unsharded best: the sharded driver is pure
+                # lossless escalation (no optimistic beam), so equal
+                # sizing would mostly measure schedule exhaustion.
+                n_sh = max(N_OPS, int(
+                    out.get("max_verified_ops_device", {}).get(
+                        "invocations") or 3 * N_OPS) // 2)
+                sh = random_register_history(
+                    random.Random(2032), n_ops=n_sh, n_procs=10,
+                    cas=True, crash_p=20 / n_sh, fail_p=0.02)
+                senc = encode_history(model, sh)
+                scap = min(BASELINE_S, _left() - 120)
+                t0 = time.perf_counter()
+                try:
+                    sres = check_encoded_sharded(
+                        senc, mesh=mesh, f_total=4096,
+                        chunk_callback=_deadline_cb(scap))
+                    svalid = sres["valid"]
+                    sextra = {"levels": sres.get("levels"),
+                              "n_shards": sres.get("n_shards")}
+                except _Deadline as dl:
+                    svalid = f"deadline at level {dl}"
+                    sextra = {"n_shards": int(mesh.shape["dp"])}
+                out["max_verified_ops_device_sharded"] = {
+                    "ops": senc.n, "invocations": n_sh,
+                    "value_s": round(time.perf_counter() - t0, 3),
+                    "valid": svalid,
+                    "budget_s": BASELINE_S, "cap_s": round(scap, 1),
+                    **sextra,
+                    "note": "frontier-sharded (ICI sequence-parallel) "
+                            "entry under the unified 300 s definition; "
+                            f"{len(_jx.devices())} local device(s)",
+                }
+        except Exception as e:  # noqa: BLE001
+            out["max_verified_ops_device_sharded"] = {
                 "error": f"{type(e).__name__}: {e}"}
 
         _checkpoint()

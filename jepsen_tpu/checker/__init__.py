@@ -326,12 +326,16 @@ def linearizable(options: Optional[dict] = None, **kw) -> Checker:
         # bounded-pmap key axis, mapped onto the mesh's dp axis).
         mesh = make_mesh() if len(jax.devices()) > 1 else None
         ks = list(keyed_histories)
+        # Overflowing keys re-batch up the frontier schedule as new
+        # vmapped programs (parallel.batch) — the serial driver is the
+        # batch path's own last resort now, not this layer's first move.
         results = check_batch(
-            model, [keyed_histories[k].client_ops() for k in ks], mesh=mesh
+            model, [keyed_histories[k].client_ops() for k in ks],
+            mesh=mesh, metrics=reg
         )
         out_map = dict(zip(ks, results))
         # Keys the shared batch couldn't decide (didn't fit the common
-        # shape bucket, capacity exhausted) get the full per-key path,
+        # shape bucket, schedule exhausted) get the full per-key path,
         # which includes the auto backend's host-oracle fallback.
         for k, r in out_map.items():
             if r.get("valid") == "unknown":

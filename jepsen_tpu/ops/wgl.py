@@ -141,8 +141,19 @@ def _enable_compile_cache() -> None:
 def _build_kernel(model_key, F: int, W: int, KO: int, S: int, ND: int, NO: int,
                   axis_name: Optional[str] = None, n_shards: int = 1,
                   B: Optional[int] = None, wintab_ok: bool = True,
-                  collect_stats: bool = False):
+                  collect_stats: bool = False, donate: bool = False):
     """Returns a jitted BFS driver with static shapes.
+
+    ``donate``: jit with the five frontier buffers donated
+    (input/output aliased in place) — the chunked drivers re-feed the
+    returned frontier and never touch the input again, so the carry
+    stops costing an extra frontier-sized allocation + copy per chunk.
+    Callers that donate MUST NOT reuse the passed frontier arrays.
+    Donated programs are pid-salted OUT of the cross-process persistent
+    compile cache (see the salt note in the kernel body): a donated
+    executable served from the on-disk cache intermittently corrupts
+    its outputs on this jax. ``JEPSEN_WGL_NO_DONATE=1`` kills donation
+    everywhere (operational escape hatch).
 
     ``collect_stats``: carry a LEVEL_STAT_ROWS x 4 per-level stats ring
     through the loop and return it after the packed flags vector (the
@@ -183,12 +194,16 @@ def _build_kernel(model_key, F: int, W: int, KO: int, S: int, ND: int, NO: int,
     rows (so dedup + compaction are TWO sorts and a static slice — no
     cumsum/searchsorted/permutation-gather chains, which cost ~1 ms each),
     and `searchsorted` is never used on the hot path."""
+    import os
+
     import jax
     import jax.numpy as jnp
     from jax import lax
 
     assert not (collect_stats and axis_name is not None), \
         "per-level stats collection is single-device only"
+    if os.environ.get("JEPSEN_WGL_NO_DONATE"):
+        donate = False  # operational kill-switch for buffer donation
     _enable_compile_cache()
     model_cls, _sig, model_args = model_key
     model = model_cls._from_cache_key(model_args)
@@ -308,7 +323,7 @@ def _build_kernel(model_key, F: int, W: int, KO: int, S: int, ND: int, NO: int,
             winTab = tabD[wrows].reshape(ND, W * 8)
 
         def level(carry):
-            p, mD, mO, st, valid, lvl, acc, ovf, fmax = carry[:9]
+            p, mD, mO, st, valid, lvl, acc, ovf, fmax, stuck = carry[:10]
 
             rows = p[:, None] + slots[None, :]  # [F, W]
             in_rng = rows < nD
@@ -519,32 +534,39 @@ def _build_kernel(model_key, F: int, W: int, KO: int, S: int, ND: int, NO: int,
                     pre_ovf = lax.pmax(pre_ovf.astype(jnp.int32),
                                        axis_name) > 0
                     L = n_shards * P
-            # Group hashes on the L compacted rows (not the M-row
+            # Group hash on the L compacted rows (not the M-row
             # expansion); on the sharded path this runs replicated
             # post-exchange, so every device computes identical hashes.
-            gh1 = jnp.full((L,), u32(2166136261))
-            gh2 = jnp.full((L,), u32(0x9E3779B9))
+            gh = jnp.full((L,), u32(2166136261))
             for c in [pcol] + dcols + scols:
-                gh1 = (gh1 ^ c) * u32(16777619)
-                gh2 = (gh2 ^ (c + u32(0x85EBCA6B))) * u32(0xC2B2AE35)
-            # Full multi-operand dedup sort. (A slimmer 3-operand
-            # fused-key sort + post-sort row gather of the identity
-            # columns measured ~2.5 ms/level WORSE at L=65536 on a v5e:
-            # 65k-row gathers cost more than the extra sort operands;
-            # only the F-row top-slice gather below is cheap.)
-            key0 = (~nvalid).astype(u32)  # valid rows first
-            n_keys = 3 + len(ocols)
+                gh = (gh ^ c) * u32(16777619)
+            # ONE fused sort key: validity bit over 31 hash bits. The
+            # dedup sort is the bitonic network's worst customer —
+            # ~log^2(L) compare-exchange stages each streaming EVERY
+            # operand — so operand count is the cost axis; the earlier
+            # (key0, gh1, gh2) triple paid two extra operands per stage
+            # for hash bits the grouping never needed. Losing hash bits
+            # only risks collisions, and a collision only interleaves
+            # two real groups: same_group below re-compares the REAL
+            # columns, so the worst case is a missed prune, never a
+            # wrong merge. (A slimmer sort + post-sort row gather of
+            # the identity columns measured ~2.5 ms/level WORSE at
+            # L=65536 on a v5e: 65k-row gathers cost more than sort
+            # operands; only the F-row top-slice gather below is cheap.)
+            fkey = jnp.where(nvalid, gh >> 1,  # valid rows first
+                             (gh >> 1) | u32(0x80000000))
+            n_keys = 1 + len(ocols)
             sorted_ = lax.sort(
-                tuple([key0, gh1, gh2] + ocols + [pcol] + dcols + scols),
+                tuple([fkey] + ocols + [pcol] + dcols + scols),
                 dimension=0,
                 num_keys=n_keys,
             )
-            skey0 = sorted_[0]
-            socols = list(sorted_[3:3 + len(ocols)])
-            spcol = sorted_[3 + len(ocols)]
-            sdcols = list(sorted_[4 + len(ocols):4 + len(ocols) + KD])
-            sscols = list(sorted_[4 + len(ocols) + KD:])
-            svalid = skey0 == u32(0)
+            sfkey = sorted_[0]
+            socols = list(sorted_[1:1 + len(ocols)])
+            spcol = sorted_[1 + len(ocols)]
+            sdcols = list(sorted_[2 + len(ocols):2 + len(ocols) + KD])
+            sscols = list(sorted_[2 + len(ocols) + KD:])
+            svalid = (sfkey & u32(0x80000000)) == u32(0)
 
             def shifted(c, fill):
                 return jnp.concatenate([jnp.full((1,), fill, c.dtype), c[:-1]])
@@ -647,20 +669,35 @@ def _build_kernel(model_key, F: int, W: int, KO: int, S: int, ND: int, NO: int,
 
             # On overflow keep the pre-expansion frontier intact so the
             # search can resume losslessly at a larger capacity — unless
-            # in beam mode, where the truncated frontier advances.
+            # in beam mode, where the truncated frontier advances. A
+            # level that EMPTIES the frontier (count == 0 — the
+            # refutation / beam-exhaustion case) also keeps the
+            # pre-expansion state: the returned frontier is then the
+            # last non-empty one, which IS the refutation witness — the
+            # host decodes it directly instead of re-running the chunk
+            # (which would need the chunk's entry frontier, a buffer
+            # donation invalidates). The sticky ``stuck`` flag carries
+            # the emptiness verdict the frontier no longer encodes.
             lossy_b = lossy != 0
-            sel = lambda new, old: jnp.where(ovf_now & ~lossy_b, old, new)
+            # A lossless overflow that also kept nothing is an
+            # ESCALATION, not a dead end (candidates were dropped, the
+            # retry at a larger capacity may keep them) — stuck only
+            # when the emptiness is exact.
+            stuck_now = (count == 0) & ~(ovf_now & ~lossy_b)
+            dead = (ovf_now & ~lossy_b) | (count == 0)
+            sel = lambda new, old: jnp.where(dead, old, new)
             out = (
                 sel(kp, p),
                 sel(kmD, mD),
                 sel(kmO, mO),
                 sel(kst, st),
                 sel(kvalid, valid),
-                jnp.where((ovf_now & ~lossy_b) | (count == 0), lvl, lvl + 1),
+                jnp.where(dead, lvl, lvl + 1),
                 acc | acc_now,
                 ovf | ovf_now,
                 jnp.maximum(fmax,
                             jnp.minimum(count, FT).astype(jnp.int32)),
+                stuck | stuck_now,
             )
             if collect_stats:
                 # Stats row for the level this application ATTEMPTED
@@ -675,13 +712,14 @@ def _build_kernel(model_key, F: int, W: int, KO: int, S: int, ND: int, NO: int,
                     ovf_now.astype(jnp.int32),
                 ]).astype(jnp.int32)
                 stats = lax.dynamic_update_slice(
-                    carry[9], row[None, :],
+                    carry[10], row[None, :],
                     ((lvl + 1) % LEVEL_STAT_ROWS, jnp.int32(0)))
                 out = out + (stats,)
             return out
 
         def cond(carry):
-            valid, lvl, acc, ovf = carry[4], carry[5], carry[6], carry[7]
+            valid, lvl, acc, ovf, stuck = (
+                carry[4], carry[5], carry[6], carry[7], carry[9])
             nonempty = jnp.any(valid)
             if axis_name is not None:
                 nonempty = lax.pmax(nonempty.astype(jnp.int32),
@@ -689,6 +727,7 @@ def _build_kernel(model_key, F: int, W: int, KO: int, S: int, ND: int, NO: int,
             return (
                 (~acc)
                 & ((lossy != 0) | (~ovf))
+                & (~stuck)
                 & nonempty
                 & (lvl < max_levels)
             )
@@ -703,6 +742,7 @@ def _build_kernel(model_key, F: int, W: int, KO: int, S: int, ND: int, NO: int,
             jnp.asarray(False),
             jnp.asarray(False),
             jnp.int32(1),
+            jnp.asarray(False),
         )
         if collect_stats:
             init = init + (jnp.zeros((LEVEL_STAT_ROWS, 4), jnp.int32),)
@@ -721,16 +761,22 @@ def _build_kernel(model_key, F: int, W: int, KO: int, S: int, ND: int, NO: int,
                 jnp.where(go, x2, x1) for x2, x1 in zip(c2, c1))
 
         out = lax.while_loop(cond, body2, init)
-        p, mD, mO, st, valid, lvl, acc, ovf, fmax = out[:9]
+        p, mD, mO, st, valid, lvl, acc, ovf, fmax, stuck = out[:10]
         nonempty = jnp.any(valid)
         count = jnp.sum(valid.astype(jnp.int32))
         if axis_name is not None:
             # These flags are consumed as replicated outputs (out_specs
             # P()), so they must actually BE replicated — a device whose
             # slice of the global order is empty would otherwise report a
-            # locally empty frontier as a global refutation.
+            # locally empty frontier as a global refutation. (``stuck``
+            # is computed from the replicated global keep-count, so it
+            # needs no collective.)
             nonempty = lax.pmax(nonempty.astype(jnp.int32), axis_name) > 0
             count = lax.psum(count, axis_name)
+        # The frontier no longer empties on a dead end (it holds the
+        # refutation witness); ``stuck`` carries the emptiness verdict
+        # the nonempty flag used to derive from the frontier itself.
+        nonempty = nonempty & ~stuck
         # ONE packed scalar vector: the host driver fetches this single
         # array per chunk (each separate device->host read pays a full
         # relay round trip — unpacked flags cost ~1 s/chunk on a
@@ -739,42 +785,89 @@ def _build_kernel(model_key, F: int, W: int, KO: int, S: int, ND: int, NO: int,
             acc.astype(jnp.int32), ovf.astype(jnp.int32),
             nonempty.astype(jnp.int32), lvl, fmax, count,
         ])
+        if donate and jax.default_backend() == "cpu":
+            # PER-PROCESS HLO salt: on the CPU backend, donated
+            # executables must never be served from the persistent
+            # compile cache. A donated program whose executable
+            # round-trips the on-disk cache intermittently returns
+            # GARBAGE frontiers on this jax (observed on CPU: empty
+            # frontiers reading as instant refutations, phantom
+            # level-1 accepts — load-dependent, i.e. a sequencing race
+            # between the in-place aliased writes and a prior consumer
+            # of the input buffers; a fresh in-process compile of the
+            # identical program is always correct). Embedding the pid
+            # as a dead constant gives every process a distinct cache
+            # key, so donated kernels always compile in-process —
+            # their in-process jit reuse (all chunks of all searches)
+            # is untouched, and the plain/sharded variants keep full
+            # cross-process caching. Accelerator backends are NOT
+            # salted: donation + executable serialization is their
+            # production-standard pairing, and re-paying 15-90 s
+            # compiles per bucket per bench round would dwarf the
+            # donation win; JEPSEN_WGL_NO_DONATE=1 remains the escape
+            # hatch if an accelerator shows the same race.
+            salt = jnp.full((6,), os.getpid() & 0x7FFFFFFF, jnp.int32)
+            flags = (flags + salt) - salt
         if collect_stats:
             # Stats ride between flags and the frontier: the resumable
             # frontier is always the LAST five outputs (out[-5:]).
-            return flags, out[9], p, mD, mO, st, valid
+            return flags, out[10], p, mD, mO, st, valid
         return flags, p, mD, mO, st, valid
 
+    if donate:
+        # Alias the five frontier buffers (args 9..13) in place: the
+        # drivers never reuse a frontier after handing it to a chunk —
+        # escalation resumes from the RETURNED frontier (restored on
+        # overflow), the refutation witness is the returned frontier
+        # too (see the ``stuck`` notes above), and the only entry-state
+        # consumer left (the beam's lossless checkpoint) snapshots
+        # explicitly before the call. Tables/scalars are NOT donated:
+        # they're uploaded once per search and reused across chunks.
+        return kernel, jax.jit(kernel, donate_argnums=(9, 10, 11, 12, 13))
     return kernel, jax.jit(kernel)
 
 
 @functools.lru_cache(maxsize=32)
 def _build_batch_kernel(model_key, F: int, W: int, KO: int, S: int, ND: int,
-                        NO: int, B: Optional[int] = None):
+                        NO: int, B: Optional[int] = None,
+                        donate: bool = False):
     """vmapped kernel over a leading batch axis on every argument — the
     batch-replay path (jepsen_tpu.parallel.batch); shardable over a device
     mesh by placing the batch axis on the mesh's data axis. ``B`` must
-    dominate every batched history's own candidate cap."""
+    dominate every batched history's own candidate cap. ``donate``
+    aliases the five stacked frontier buffers in place (see
+    ``_build_kernel``) — the escalation pipeline re-feeds the returned
+    stack every chunk and never reuses an input."""
+    import os
+
     import jax
 
+    if os.environ.get("JEPSEN_WGL_NO_DONATE"):
+        donate = False  # operational kill-switch for buffer donation
     # jit retraces per input dtype, so int16 vs int32 tables need no
     # separate build. The sliding-window table is disabled under vmap:
-    # it would materialize once PER BATCH MEMBER.
+    # it would materialize once PER BATCH MEMBER. The raw kernel is
+    # built with the matching ``donate`` so the vmapped HLO carries the
+    # donated variant's compile-cache salt (see _build_kernel).
     raw, _ = _build_kernel(model_key, F, W, KO, S, ND, NO, B=B,
-                           wintab_ok=False)
+                           wintab_ok=False, donate=donate)
+    if donate:
+        return jax.jit(jax.vmap(raw), donate_argnums=(9, 10, 11, 12, 13))
     return jax.jit(jax.vmap(raw))
 
 
-def _levels_per_call(M: int, target_s: float = 5.0) -> int:
+def _levels_per_call(M: int, target_s: float = 8.0) -> int:
     """Bound single-program wall time: the TPU runtime (and the relay in
     front of it) kills long-running programs, which is what crashed the
     worker on long histories. Empirical per-level cost ≈ 0.2 ms fixed
     (row gather + loop overhead at the 2x unroll) + 9 ns × M (sorts +
     streaming over the expansion); each chunk boundary costs a relay
     round trip, so the target leans long while staying well under the
-    relay's patience."""
+    relay's patience. Raised 5 s → 8 s with the donated frontier carry
+    + host-overlap chunk scheduling: chunk boundaries are pure loss
+    now, so fewer of them directly raises occupancy."""
     est = 2.0e-4 + 9.0e-9 * M
-    return max(8, min(8192, int(target_s / est)))
+    return max(8, min(16384, int(target_s / est)))
 
 
 # ---------------------------------------------------------------------------
@@ -827,6 +920,20 @@ def initial_frontier(F: int, W: int, KO: int, S: int, init_state) -> tuple:
         np.arange(F) == 0,
         np.int32(0),
     )
+
+
+def _snapshot_frontier(fr: tuple) -> tuple:
+    """HOST-side frontier snapshot: the one consumer of a chunk's ENTRY
+    state left after buffer donation (the beam's lossless checkpoint)
+    reads it back through this before the donated call. Deliberately a
+    BLOCKING np.asarray, not an async device-side copy: the readback
+    forces the buffers to materialize before the donated call can
+    start its in-place writes (an async copy racing a donated write is
+    exactly the corruption class the compile-cache salt note records),
+    and host arrays cannot be clobbered afterwards. Rare path —
+    top-capacity beam chunks before the first truncation — and
+    frontier-sized, so the round trip is noise."""
+    return tuple(np.asarray(a) for a in fr[:-1]) + (fr[-1],)
 
 
 @functools.lru_cache(maxsize=64)
@@ -1025,14 +1132,28 @@ OPTIMISTIC_MIN_OPS = 1500
 OPTIMISTIC_BEAM_F = 4096
 
 
-def level_byte_floor(plan: DevicePlan, F: int) -> int:
+def level_byte_floor(plan: DevicePlan, F: int, batch: bool = False,
+                     sharded: bool = False) -> int:
     """Single-pass HBM byte floor of one BFS level at capacity ``F``:
     every major tensor stream counted once in and once out, enumerated
     from the kernel's static shapes. A LOWER bound on real traffic —
     each bitonic sort re-reads its operands log^2 times — so
     floor / (wall * measured copy bandwidth) is a utilization figure
     that is measured on both axes and provably <= 1 (bench.py's
-    ``device_util``)."""
+    ``device_util``).
+
+    ``batch``: floor of ONE member of the vmapped batch kernel, whose
+    only formulation difference is wintab_ok=False — the [F, W] element
+    gather reads the same bytes as the [F]-row table gather, and the
+    two-stage trigger is the same ``M > BIG_M_THRESHOLD`` (the batch
+    kernel is vmapped, never axis-sharded), so the flag exists to keep
+    this predicate honest against the kernel's rather than to change
+    the arithmetic. ``sharded``: per-shard floor of the frontier-sharded
+    kernel, which takes the two-stage path at EVERY M (its ``axis_name``
+    trigger) and re-keys the dedup over the n_shards×P exchanged rows —
+    counted here at the local P only, and excluding the all_gather
+    itself (tracked analytically by the sharded driver), so it stays a
+    per-device lower bound."""
     W, KO, S, ND, NO = plan.dims
     KD = W // 32
     KO1 = max(KO, 1)
@@ -1042,7 +1163,10 @@ def level_byte_floor(plan: DevicePlan, F: int) -> int:
     M = F * B
     NC = 1 + KD + S + KO1
     esz = 2 if plan.tab16 else 4
-    two_stage = M > BIG_M_THRESHOLD
+    # Mirrors the kernel's trigger exactly: ``axis_name is not None or
+    # M > BIG_M_THRESHOLD`` — the batch kernel has no axis_name, so its
+    # predicate matches the single-device one.
+    two_stage = sharded or M > BIG_M_THRESHOLD
     P = min(M, max(STAGE1_P_MULT * F, 64)) if two_stage else M
     total = 0
     total += 2 * F * W * 8 * esz            # window-table row gather
@@ -1054,7 +1178,7 @@ def level_byte_floor(plan: DevicePlan, F: int) -> int:
         total += 2 * M * 4                  # stage-1 fused compaction sort
         total += 2 * M * NC * 4             # colmat stack + row gather in
         total += 2 * P * NC * 4             # ... and survivors out
-    total += 2 * (3 + NC) * P * 4           # multi-operand dedup sort
+    total += 2 * (1 + NC) * P * 4           # fused-key dedup sort
     total += 2 * 2 * P * 4                  # fused-key compaction sort
     total += 2 * F * NC * 4                 # top-F row gather
     return total
@@ -1399,7 +1523,7 @@ def _device_search(enc: EncodedHistory, plan: DevicePlan, schedule: list,
         if collect:
             misses0 = _build_kernel.cache_info().misses
         _, kern = _build_kernel(mk, F, W, KO, S, ND, NO, B=plan.B,
-                                collect_stats=collect)
+                                collect_stats=collect, donate=True)
         if collect:
             fresh_build = _build_kernel.cache_info().misses > misses0
             metrics.counter(
@@ -1421,11 +1545,26 @@ def _device_search(enc: EncodedHistory, plan: DevicePlan, schedule: list,
         lvl0 = int(fr[-1])
         budget = np.int32(min(total_levels, lvl0 + lpc))
         lossy = F == schedule[-1]
-        entry_fr = fr  # entry state: lossless while `truncated` is False
+        # The kernel donates the frontier buffers (in-place carry), so
+        # the entry state is gone after the call. The only consumer
+        # that still needs it — the beam's last-lossless checkpoint —
+        # snapshots it on device first; every other entry-state use is
+        # served by the RETURNED frontier (restored on overflow, held
+        # at the last non-empty level on a dead end).
+        entry_fr = None
+        if lossy and not truncated and checkpoint is not None:
+            entry_fr = _snapshot_frontier(fr)
         call_args = dev_args[:2] + (budget,) + dev_args[3:]
         # The frontier stays device-resident across chunks; the single
         # packed flags vector is the only per-chunk device->host read.
         out = kern(*call_args, *fr[:-1], np.int32(lvl0), np.int32(lossy))
+        if collect:
+            # Analytic (shape-derived — no device read, no sync).
+            metrics.counter(
+                "wgl_donated_frontier_bytes_total",
+                "Frontier bytes aliased in place by buffer donation "
+                "(the per-chunk carry copy the kernel no longer "
+                "pays)").inc(sum(int(a.nbytes) for a in out[-5:]))
         acc, ovf, nonempty, lvl, fmax, count = (
             int(x) for x in np.asarray(out[0]))
         # The resumable frontier is always the last five outputs; the
@@ -1482,11 +1621,13 @@ def _device_search(enc: EncodedHistory, plan: DevicePlan, schedule: list,
                 )
             # Refutation witness: the search's final configurations —
             # what the reference renders as linear.svg
-            # (checker.clj:202-209).
+            # (checker.clj:202-209). The kernel holds the last
+            # non-empty frontier on a dead end (see the ``stuck``
+            # notes), so the witness is decoded straight from the
+            # returned state — no re-run chunk, no entry snapshot.
             return result(False, lvl, max_linearized=lvl,
-                          stuck_configs=capture_stuck(
-                              kern, dev_args, entry_fr, lvl, lvl0, enc,
-                              plan))
+                          stuck_configs=_returned_stuck_configs(
+                              enc, plan, fr))
         if lvl >= total_levels:
             return result(
                 "unknown", lvl, info="level budget exhausted without verdict"
@@ -1592,22 +1733,18 @@ def decode_stuck_config(enc: EncodedHistory, det_rows, open_rows,
     }
 
 
-def capture_stuck(kern, dev_args: tuple, entry_fr: tuple, lvl: int,
-                  lvl0: int, enc: EncodedHistory,
-                  plan: DevicePlan) -> list:
+def _returned_stuck_configs(enc: EncodedHistory, plan: DevicePlan,
+                            fr: tuple) -> list:
     """Refutation witness, shared by the single-device and sharded
-    drivers: re-run one chunk from its entry frontier stopping AT the
-    stuck level ``lvl`` (the kernel does not advance past the level that
-    empties the frontier, so the re-run reproduces the last non-empty
-    one), then decode the surviving rows. Diagnostics must never mask
-    the verdict — any failure returns an empty witness."""
+    drivers: the kernel keeps the LAST NON-EMPTY frontier when a level
+    dead-ends (the ``stuck`` carry flag reports the emptiness instead),
+    so the witness is decoded straight from the returned frontier — the
+    pre-donation design's re-run chunk (which needed the chunk's entry
+    frontier, a buffer donation invalidates) is gone. Diagnostics must
+    never mask the verdict — any failure returns an empty witness."""
     try:
-        out = kern(*dev_args[:2], np.int32(lvl), *dev_args[3:],
-                   *entry_fr[:-1], np.int32(lvl0), np.int32(0))
-        # out[-5:] — the frontier is the last five outputs on both the
-        # plain and the telemetry (stats-carrying) kernel variants.
         return _frontier_stuck_configs(
-            enc, plan, tuple(np.asarray(x) for x in out[-5:]))
+            enc, plan, tuple(np.asarray(x) for x in fr[:5]))
     except Exception:
         return []
 

@@ -7,13 +7,34 @@ common static shape bucket, the WGL kernel is vmapped over the batch, and
 the batch axis is sharded across the mesh's ``dp`` axis, so N chips each
 replay B/N histories concurrently.
 
-Histories that overflow the shared frontier capacity (or don't fit the
-device encoding at all) are re-checked individually with the escalating
-single-history driver / host oracle.
+**Bucketed batched escalation.** Members that overflow the shared
+frontier capacity are NOT handed to the serial single-history driver one
+by one (the pre-r6 design, which serialized exactly the members the
+batch axis exists for). Instead they are regrouped into a new vmapped
+re-batch at the next ``F_SCHEDULE`` rung, each member resuming from its
+own checkpointed frontier (the kernel restores the pre-overflow state,
+so escalation is lossless) at its own level, and the pipeline loops up
+the schedule until every member is decided. The TOP rung runs in beam
+(lossy) mode per member — the single driver's rule at its schedule's
+top capacity — so truncation-sound accepts land in-batch too. The
+serial ``check_encoded_device`` fallback remains only as the LAST
+resort, for members the whole batched ladder leaves undecided.
+
+Each rung runs chunked (per-member dynamic level budgets bound single
+program wall time), the stacked frontier buffers are donated between
+chunks (in-place carry), and the next rung's static tables are stacked
+on the host WHILE the device executes the current chunk — the re-batch
+is a row-select of an already-planned bucket by the time the overflow
+flags arrive.
+
+Histories that don't fit the device encoding at all are still checked
+individually (host-oracle dispatch via ``check_encoded_device``).
 """
 
 from __future__ import annotations
 
+import functools
+import time as _time
 from typing import Optional, Sequence
 
 import numpy as np
@@ -24,9 +45,23 @@ from ..ops import wgl
 from ..ops.encode import EncodedHistory, encode_history
 
 
+def _put(arrs, mesh=None, batch_axis: str = "dp"):
+    """device_put a list of [Bk, ...] arrays, dp-sharded when meshed.
+    Uploading once per rung (not per chunk) keeps the chunk loop's only
+    host->device traffic at the two tiny per-chunk scalar vectors."""
+    import jax
+
+    if mesh is None:
+        return [jax.device_put(a) for a in arrs]
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    sh = NamedSharding(mesh, PartitionSpec(batch_axis))
+    return [jax.device_put(a, sh) for a in arrs]
+
+
 def _stack(plans, f: int, dims, mesh=None, batch_axis: str = "dp"):
     """Stack per-history arg tuples (+ fresh frontiers) along a new leading
-    batch axis and (when a mesh is given) shard that axis across the mesh."""
+    batch axis and shard that axis across the mesh when one is given."""
     W, KO, S, _ND, _NO = dims
     full = [
         p.args + wgl.initial_frontier(f, W, KO, S, p.init_state)
@@ -35,13 +70,27 @@ def _stack(plans, f: int, dims, mesh=None, batch_axis: str = "dp"):
     ]
     cols = list(zip(*full))
     stacked = [np.stack(c, axis=0) for c in cols]
-    if mesh is not None:
-        import jax
-        from jax.sharding import NamedSharding, PartitionSpec
+    return _put(stacked, mesh, batch_axis)
 
-        sh = NamedSharding(mesh, PartitionSpec(batch_axis))
-        stacked = [jax.device_put(a, sh) for a in stacked]
-    return stacked
+
+@functools.lru_cache(maxsize=32)
+def _regroup_program(F_new: int):
+    """Jitted on-device re-batch: row-gather the overflowed members'
+    frontiers out of the old stack and pad the capacity axis to the next
+    rung — the frontiers never leave the device between rungs."""
+    import jax
+    import jax.numpy as jnp
+
+    def rg(idx, *arrs):
+        out = []
+        for a in arrs:
+            g = a[idx]
+            pad = [(0, 0), (0, F_new - g.shape[1])] + \
+                [(0, 0)] * (g.ndim - 2)
+            out.append(jnp.pad(g, pad))
+        return tuple(out)
+
+    return jax.jit(rg)
 
 
 def check_encoded_batch(
@@ -51,15 +100,36 @@ def check_encoded_batch(
     batch_axis: str = "dp",
     max_open: int = 128,
     window_cap: int = 1024,
-    escalate: bool = True,
+    escalate=True,
+    f_schedule: Optional[tuple] = None,
+    levels_per_call: Optional[int] = None,
+    metrics=None,
+    chunk_callback=None,
 ) -> list[dict]:
     """Check a batch of encoded histories (same model family) together.
 
     Returns one result map per history, in order, in the same shape as
     `jepsen_tpu.ops.wgl.check_encoded_device`.
+
+    ``escalate``: ``True`` (default) — members that overflow the shared
+    capacity ``f`` are re-batched up ``f_schedule`` (default
+    ``wgl.F_SCHEDULE``) as new vmapped programs, resuming from their
+    checkpointed frontiers; the serial driver only sees members that
+    overflow the TOP rung. ``"serial"`` — the legacy behavior: every
+    overflowing member goes straight to ``check_encoded_device``
+    (kept one round for bench comparison). ``False`` — overflowing
+    members report unknown.
+
+    ``chunk_callback(info)``: invoked after every device chunk with
+    {"F", "chunk", "active", "batch", "level_max", "wall_s", "rung"} —
+    exceptions propagate (bench.py's deadline enforcement rides this).
+
+    ``metrics``: telemetry registry; records re-batch counts, per-chunk
+    batch occupancy, donated-frontier bytes and serial fallbacks.
     """
     if not encs:
         return []
+    t0 = _time.perf_counter()
     model = encs[0].model
     mk = wgl._model_cache_key(model)
     if any(wgl._model_cache_key(e.model) != mk for e in encs):
@@ -81,60 +151,305 @@ def check_encoded_batch(
             }
         else:
             idx.append(i)
-    if idx:
-        dims = np.array([plans[i].dims for i in idx])  # (W, KO, S, ND, NO)
-        W, KO, ND, NO = (
-            int(dims[:, 0].max()),
-            int(dims[:, 1].max()),
-            int(dims[:, 3].max()),
-            int(dims[:, 4].max()),
-        )
-        S = int(dims[0, 2])
-        padded = [
-            wgl.plan_device(encs[i], max_open=max_open, window_cap=window_cap,
-                            pad_to=(W, KO, ND, NO))
-            for i in idx
-        ]
-        # Round the batch up to the mesh's dp extent for even sharding.
-        if mesh is not None:
-            dp = int(np.prod([mesh.shape[a] for a in mesh.axis_names if a == batch_axis]))
-            while len(padded) % max(dp, 1):
-                padded.append(padded[0])
-        # The shared candidate cap must dominate every member (None if
-        # any member's own cap already reaches its C).
-        Bs = [p.B for p in padded]
-        B = None if any(b is None for b in Bs) else max(Bs)
-        kern = wgl._build_batch_kernel(mk, f, W, KO, S, ND, NO, B=B)
-        out = kern(*_stack(padded, f, (W, KO, S, ND, NO), mesh, batch_axis))
-        # out[0] is the packed per-history flags matrix [B, 6] — one
-        # device->host read for the whole batch.
-        flags = np.asarray(out[0])
-        acc, ovf, nonempty, lvl, fmax = (flags[:, c] for c in range(5))
-        for b, i in enumerate(idx):
-            if acc[b]:
-                results[i] = {
-                    "valid": True, "op_count": encs[i].n, "device": True,
-                    "levels": int(lvl[b]), "frontier_max": int(fmax[b]), "batched": True,
-                }
-            elif not ovf[b]:
-                results[i] = {
-                    "valid": False, "op_count": encs[i].n, "device": True,
-                    "levels": int(lvl[b]), "max_linearized": int(lvl[b]),
-                    "frontier_max": int(fmax[b]), "batched": True,
-                }
-            elif escalate and any(x > f for x in wgl.F_SCHEDULE):
-                results[i] = wgl.check_encoded_device(
-                    encs[i],
-                    f_schedule=tuple(x for x in wgl.F_SCHEDULE if x > f),
-                    max_open=max_open,
-                    window_cap=window_cap,
-                )
-                results[i]["escalated"] = True
+    if not idx:
+        return results  # type: ignore[return-value]
+
+    dims = np.array([plans[i].dims for i in idx])  # (W, KO, S, ND, NO)
+    W, KO, ND, NO = (
+        int(dims[:, 0].max()),
+        int(dims[:, 1].max()),
+        int(dims[:, 3].max()),
+        int(dims[:, 4].max()),
+    )
+    S = int(dims[0, 2])
+    padded = [
+        wgl.plan_device(encs[i], max_open=max_open, window_cap=window_cap,
+                        pad_to=(W, KO, ND, NO))
+        for i in idx
+    ]
+    # Row -> original enc index (None for mesh-divisibility padding).
+    orig: list[Optional[int]] = list(idx)
+    dp = 1
+    if mesh is not None:
+        dp = int(np.prod([mesh.shape[a] for a in mesh.axis_names
+                          if a == batch_axis])) or 1
+        while len(padded) % dp:
+            padded.append(padded[0])
+            orig.append(None)
+    # The shared candidate cap must dominate every member (None if
+    # any member's own cap already reaches its C).
+    Bs = [p.B for p in padded]
+    B = None if any(b is None for b in Bs) else max(Bs)
+    CC = B or (W + KO * 32)
+
+    sched = sorted(set(f_schedule if f_schedule is not None
+                       else wgl.F_SCHEDULE))
+    batched_esc = escalate is True or escalate == "batch"
+    rungs = [f] + ([x for x in sched if x > f] if batched_esc else [])
+
+    # Per-row running state across rungs.
+    n_rows = len(padded)
+    lvls = np.zeros(n_rows, np.int32)
+    fmax_all = np.ones(n_rows, np.int32)
+    totals_all = np.array([int(p.args[2]) for p in padded], np.int32)
+    status = ["run"] * n_rows  # run | acc | stuck | exhausted | ovf
+    rung_stats: list[dict] = []
+    live = list(range(n_rows))
+    fr5 = None  # stacked device frontier arrays for `live`, at current F
+    statics = None  # stacked device tables for `live`
+    pending = None  # host-stacked tables for the NEXT bucket (overlap)
+
+    def _host_stack(rows):
+        cols = list(zip(*[padded[r].args for r in rows]))
+        return [np.stack(c, axis=0) for c in cols]
+
+    def _pad_rows(rows):
+        """Mesh divisibility: repeat the first row (verdicts ignored)."""
+        rows = list(rows)
+        while len(rows) % dp:
+            rows.append(rows[0])
+        return rows
+
+    for ri, F in enumerate(rungs):
+        live = _pad_rows(live)
+        Bk = len(live)
+        # The TOP rung runs in beam (lossy) mode, exactly like the
+        # single driver at its schedule's top capacity: on overflow the
+        # kernel keeps the best F configs per member and continues.
+        # Accepts stay sound under truncation; a refutation or
+        # exhaustion after a member truncated reads as unknown. (A
+        # single-rung pipeline keeps the legacy lossless semantics —
+        # its overflow verdicts belong to the fallback policy.)
+        lossy_rung = batched_esc and len(rungs) > 1 and F == rungs[-1]
+        for r in set(live):
+            status[r] = "run"  # rows entering a rung are undecided again
+        if ri == 0:
+            stacked = _stack([padded[r] for r in live], F,
+                             (W, KO, S, ND, NO), mesh, batch_axis)
+            statics, fr5 = stacked[:9], list(stacked[9:14])
+        else:
+            # Re-batch: row-select the pre-stacked bucket (planned while
+            # the previous rung's device chunk ran), regroup the
+            # checkpointed frontiers on device at the new capacity.
+            rowsel = np.array([prev_live.index(r) for r in live])
+            statics = _put([c[rowsel] for c in pending], mesh, batch_axis)
+            new_fr = _regroup_program(F)(rowsel, *fr5)
+            fr5 = _put(list(new_fr), mesh, batch_axis)
+            if metrics is not None:
+                metrics.counter(
+                    "wgl_rebatch_total",
+                    "Overflowed members regrouped into a higher-capacity "
+                    "vmapped re-batch").inc()
+                metrics.event(
+                    "wgl_rebatch", from_F=rungs[ri - 1], to_F=F,
+                    members=sum(1 for r in live if orig[r] is not None),
+                    level_min=int(lvls[live].min()),
+                    level_max=int(lvls[live].max()))
+        kern = wgl._build_batch_kernel(mk, F, W, KO, S, ND, NO, B=B,
+                                       donate=True)
+        # Chunk budget: the vmapped kernel runs ceil(Bk/dp) members per
+        # device SEQUENTIALLY, so the single-program wall-time model
+        # must scale the per-member expansion by that factor or an
+        # 8-member batch runs ~8x the target per program (the
+        # long-program condition the chunking exists to avoid).
+        lpc = levels_per_call or wgl._levels_per_call(
+            F * CC * max(1, -(-Bk // dp)))
+        totals = totals_all[live]
+        lsub = lvls[live].astype(np.int32)
+        fsub = fmax_all[live]
+        active = np.ones(Bk, bool)
+        acc_s = np.zeros(Bk, bool)
+        ovf_s = np.zeros(Bk, bool)  # lossy rung: "truncated at least once"
+        stuck_s = np.zeros(Bk, bool)
+        calls = 0
+        t_rung = _time.perf_counter()
+        pending = None
+        prev_live = live
+        next_F = rungs[ri + 1] if ri + 1 < len(rungs) else None
+        while active.any():
+            budgets = np.where(active, np.minimum(totals, lsub + lpc),
+                               lsub).astype(np.int32)
+            # dp-shard the per-chunk scalar vectors too, so sharding
+            # propagation keeps the whole program data-parallel.
+            budgets_d, lvl0_d, lossy_d = _put(
+                [budgets, lsub,
+                 np.full(Bk, int(lossy_rung), np.int32)],
+                mesh, batch_axis)
+            out = kern(statics[0], statics[1], budgets_d, *statics[3:9],
+                       *fr5, lvl0_d, lossy_d)
+            calls += 1
+            # Double-buffered chunk scheduling: the device is executing
+            # the dispatched chunk; use the gap to host-plan the next
+            # bucket (stack the static tables of every member that could
+            # still overflow) so the re-batch is a row-select by the
+            # time the flags arrive.
+            if pending is None and next_F is not None:
+                pending = _host_stack(live)
+            flags = np.asarray(out[0])  # [Bk, 6] — the one blocking read
+            fr5 = list(out[-5:])
+            if metrics is not None:
+                metrics.counter(
+                    "wgl_donated_frontier_bytes_total",
+                    "Frontier bytes aliased in place by buffer donation "
+                    "(the per-chunk carry copy the kernel no longer "
+                    "pays)").inc(sum(int(a.nbytes) for a in fr5))
+            acc = flags[:, 0].astype(bool)
+            ovf = flags[:, 1].astype(bool)
+            nonempty = flags[:, 2].astype(bool)
+            lsub = np.where(active, flags[:, 3], lsub).astype(np.int32)
+            fsub = np.maximum(fsub, np.where(active, flags[:, 4], 1))
+            acc_s |= active & acc
+            # No ~acc guard: a lossy-rung member can truncate AND accept
+            # in one chunk, and the beam marker must record it (the
+            # single driver sets truncated before checking acc). In
+            # lossless rungs classification checks acc first anyway.
+            ovf_s |= active & ovf
+            stuck_s |= active & ~acc & ~nonempty & (lossy_rung | ~ovf)
+            if lossy_rung:
+                # Beam mode continues past overflow: ovf only records
+                # truncation, it doesn't stop the member.
+                active = active & ~acc & nonempty & (lsub < totals)
             else:
-                results[i] = {
-                    "valid": "unknown", "op_count": encs[i].n, "device": True,
-                    "info": f"frontier overflow at shared capacity {f}",
-                }
+                active = (active & ~acc & ~ovf & nonempty
+                          & (lsub < totals))
+            if metrics is not None:
+                metrics.counter(
+                    "wgl_batch_chunks_total",
+                    "Batched-escalation kernel chunk invocations").inc()
+                metrics.gauge(
+                    "wgl_batch_occupancy",
+                    "Members still searching / batch rows, after the "
+                    "last chunk", labelnames=("F",)).labels(F=F).set(
+                        float(active.sum()) / Bk)
+                metrics.event(
+                    "wgl_batch_chunk", F=F, chunk=calls,
+                    active=int(active.sum()), batch=Bk,
+                    level_max=int(lsub.max()),
+                    wall_s=round(_time.perf_counter() - t_rung, 4))
+            if chunk_callback is not None:
+                chunk_callback({
+                    "F": F, "rung": ri, "chunk": calls,
+                    "active": int(active.sum()), "batch": Bk,
+                    "level_max": int(lsub.max()),
+                    "wall_s": _time.perf_counter() - t0})
+        lvls[live] = lsub
+        fmax_all[live] = fsub
+        rung_stats.append({
+            "F": F, "members": sum(1 for r in live
+                                   if orig[r] is not None),
+            "calls": calls,
+            "wall_s": round(_time.perf_counter() - t_rung, 3),
+        })
+        # Classify this rung's rows; decided members get results NOW so
+        # a later-rung failure can't lose them.
+        overflowed = []
+        for b, r in enumerate(live):
+            i = orig[r]
+            if status[r] != "run":
+                continue  # a mesh-padding duplicate decided twice
+            truncated = lossy_rung and bool(ovf_s[b])
+            if acc_s[b]:
+                status[r] = "acc"
+            elif stuck_s[b]:
+                status[r] = "stuck"
+            elif ovf_s[b] and not lossy_rung:
+                status[r] = "ovf"
+                overflowed.append(r)
+                continue
+            else:
+                status[r] = "exhausted"
+            if i is None:
+                continue
+            base = {
+                "op_count": encs[i].n, "device": True,
+                "levels": int(lvls[r]), "frontier_max": int(fmax_all[r]),
+                "batched": True,
+            }
+            if ri > 0:
+                # Snapshot: rung_stats keeps growing after this rung;
+                # an aliased reference would retro-report rungs this
+                # member never ran.
+                base.update(escalated=True, decided_at_F=F,
+                            rungs=list(rung_stats))
+            if truncated:
+                base["beam"] = True
+            if status[r] == "acc":
+                # Sound even after a lossy-rung truncation: dropping
+                # configs only removes accepting paths, never invents
+                # one (the single driver's beam rule).
+                results[i] = {"valid": True, **base}
+            elif status[r] == "stuck" and truncated:
+                # Beam exhaustion is NOT a refutation — configs were
+                # dropped along the way. This is what the serial LAST
+                # resort is for: the single driver's phase ordering
+                # (optimistic beam first, then exhaustive-from-lossless)
+                # differs from the ladder's lossless-then-beam path and
+                # may still decide. Mark undecided; the fallback pass
+                # below picks these up.
+                status[r] = "ovf"
+                overflowed.append(r)
+                continue
+            elif status[r] == "stuck":
+                results[i] = {"valid": False,
+                              "max_linearized": int(lvls[r]), **base}
+                try:
+                    # The kernel keeps the last non-empty frontier on a
+                    # dead end: decode this member's refutation witness
+                    # from its row of the stack (witness parity with the
+                    # single-history driver; never masks the verdict).
+                    results[i]["stuck_configs"] = \
+                        wgl._frontier_stuck_configs(
+                            encs[i], padded[r],
+                            tuple(np.asarray(a[b]) for a in fr5))
+                except Exception:  # noqa: BLE001 - diagnostics only
+                    pass
+            else:
+                results[i] = {"valid": "unknown",
+                              "info": "level budget exhausted", **base}
+        if not overflowed:
+            live = []
+            break
+        live = overflowed
+        if next_F is None:
+            break
+
+    # Members still overflowing past the top batched rung: the serial
+    # single-history driver is the LAST resort (beam mode at the top
+    # capacity, optimistic phase, host-oracle handoff — machinery the
+    # lockstep batch kernel doesn't carry).
+    serial_rows = [r for r in live if orig[r] is not None
+                   and status[r] == "ovf"]
+    for r in serial_rows:
+        i = orig[r]
+        if escalate is False:
+            results[i] = {
+                "valid": "unknown", "op_count": encs[i].n, "device": True,
+                "info": f"frontier overflow at shared capacity {f}",
+            }
+            continue
+        if escalate == "serial":
+            serial_sched = tuple(x for x in sched if x > f) or (f,)
+        else:
+            serial_sched = tuple(rungs)
+        if metrics is not None:
+            metrics.counter(
+                "wgl_batch_serial_fallback_total",
+                "Members handed to the serial single-history driver "
+                "after the batched rungs overflowed").inc()
+        results[i] = wgl.check_encoded_device(
+            encs[i], f_schedule=serial_sched, max_open=max_open,
+            window_cap=window_cap, metrics=metrics,
+            chunk_callback=chunk_callback)
+        results[i]["escalated"] = "serial"
+        if len(rungs) > 1:
+            results[i]["rungs"] = rung_stats
+    if metrics is not None:
+        c = metrics.counter(
+            "wgl_batch_members_total",
+            "Members decided through the batched checker by outcome",
+            labelnames=("result",))
+        for i in idx:
+            c.labels(result=str(results[i].get("valid"))).inc()
     return results  # type: ignore[return-value]
 
 

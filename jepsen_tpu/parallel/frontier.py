@@ -82,6 +82,7 @@ def check_encoded_sharded(
     levels_per_call: Optional[int] = None,
     max_escalations: int = 2,
     checkpoint_path: Optional[str] = None,
+    chunk_callback=None,
     metrics=None,
 ) -> dict:
     """Decide linearizability of one encoded history with the frontier
@@ -100,6 +101,11 @@ def check_encoded_sharded(
     on a definite verdict. The sharded search is always lossless, so a
     resumed frontier is exact regardless of mesh size (the width is
     re-rounded to the new mesh's per-device multiple).
+
+    ``chunk_callback(info)``: invoked after every chunk with progress
+    (level, global capacity, wall) — exceptions propagate, which is how
+    bench.py enforces its deadline on the sharded leg (same contract as
+    ``check_encoded_device``).
 
     ``metrics``: telemetry registry; records per-chunk events
     (global/per-device config counts), sharded-kernel cache hits and
@@ -170,7 +176,6 @@ def check_encoded_sharded(
         while True:
             t_call = _time.perf_counter()
             lvl0 = int(fr[-1])
-            entry_fr = fr  # chunk entry (for the refutation witness)
             budget = np.int32(min(total_levels, lvl0 + lpc))
             call_args = dev_args[:2] + (budget,) + dev_args[3:]
             out = sharded(*call_args, *fr[:-1], np.int32(lvl0),
@@ -219,16 +224,24 @@ def check_encoded_sharded(
                 r.update(extra)
                 return r
 
+            if chunk_callback is not None:
+                chunk_callback({"level": int(lvl), "F": F,
+                                "global_capacity": FT, "n_shards": D,
+                                "frontier_max": fmax_all[0],
+                                "total_levels": total_levels,
+                                "count": int(_cnt),
+                                "wall_s": _time.perf_counter() - t0})
             if bool(acc):
                 return result(True), fr
             if bool(ovf):
                 return None, fr  # lossless overflow: escalate
             if not bool(nonempty):
+                # The kernel returns the last NON-EMPTY frontier on a
+                # dead end (wgl ``stuck`` notes): decode it directly.
                 return result(
                     False, max_linearized=int(lvl),
-                    stuck_configs=wgl.capture_stuck(
-                        sharded, dev_args, entry_fr, lvl, lvl0, enc,
-                        plan)), fr
+                    stuck_configs=wgl._returned_stuck_configs(
+                        enc, plan, fr)), fr
             if int(lvl) >= total_levels:
                 return result("unknown",
                               info="level budget exhausted"), fr

@@ -40,9 +40,14 @@ def find_histories(root: Any = None, name: Optional[str] = None,
 
 
 def replay(model: Model, paths: Sequence[Path], mesh=None, f: int = 256,
-           write_results: bool = True) -> list[dict]:
+           write_results: bool = True, escalate=True,
+           metrics=None) -> list[dict]:
     """Decide every stored history in one batched device program; returns
-    one result map per path (order preserved)."""
+    one result map per path (order preserved). Members that overflow the
+    shared capacity ``f`` re-batch up the frontier schedule as new
+    vmapped programs (``escalate`` — see
+    ``parallel.batch.check_encoded_batch``) instead of dropping to the
+    serial driver; ``metrics`` threads a telemetry registry through."""
     paths = [Path(p) for p in paths]
     histories = []
     for p in paths:
@@ -83,7 +88,8 @@ def replay(model: Model, paths: Sequence[Path], mesh=None, f: int = 256,
     if idx:
         from .batch import check_encoded_batch
 
-        batch = check_encoded_batch(encs, mesh=mesh, f=f)
+        batch = check_encoded_batch(encs, mesh=mesh, f=f,
+                                    escalate=escalate, metrics=metrics)
         for i, res in zip(idx, batch):
             results[i] = res
     if write_results:
@@ -121,6 +127,7 @@ def replay_store(model_name: str = "cas-register", root: Any = None,
         "valid": sum(1 for r in results if r["valid"] is True),
         "invalid": sum(1 for r in results if r["valid"] is False),
         "unknown": sum(1 for r in results if r["valid"] == "unknown"),
+        "escalated": sum(1 for r in results if r.get("escalated")),
         "runs": {str(p): r["valid"] for p, r in zip(paths, results)},
     }
     return summary

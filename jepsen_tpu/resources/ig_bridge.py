@@ -37,6 +37,11 @@ try:
         PROP_NAME
 except ImportError:  # surfaced at startup, not per-request
     IgniteClient = None
+    # Define the companion names too: a dispatch without pyignite must
+    # fail with the startup's clean report, never a NameError.
+    TransactionConcurrency = TransactionIsolation = None
+    PROP_CACHE_ATOMICITY_MODE = "atomicity_mode"
+    PROP_NAME = "name"
 
 CACHE = "ACCOUNTS"
 # CacheAtomicityMode ordinal: TRANSACTIONAL=0 (ATOMIC is 1 — with that,
@@ -133,7 +138,17 @@ class Handler(socketserver.StreamRequestHandler):
         if cmd == "XFER":
             frm, to, amount = int(words[1]), int(words[2]), int(words[3])
             if frm == to:
-                return "OK"  # self-transfer: balances unchanged
+                # Self-transfer: balances unchanged either way, but the
+                # reference still applies the insufficient-funds rule
+                # (bank.clj:97-101 computes b1 = balance - amount before
+                # looking at the destination) — an amount above the
+                # balance must commit unchanged and report NEG, not OK.
+                with self._tx(srv) as tx:
+                    bal = cache.get(frm)
+                    tx.commit()
+                if bal - amount < 0:
+                    return f"NEG {frm} {bal - amount}"
+                return "OK"
             with self._tx(srv) as tx:
                 # Acquire the two pessimistic key locks in KEY ORDER:
                 # opposite-order transfers (A: 0->1, B: 1->0) would

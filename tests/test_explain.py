@@ -1,0 +1,51 @@
+"""Golden tests for elle/explain.py anomaly rendering.
+
+The closed-cycle path (witness cycles repeat the first node at the end,
+elle/__init__.py ``_witness``) previously had zero coverage: a rendering
+regression — duplicated T0 row, wrap-around edge pointing at the wrong
+transaction — would ship silently into the ``elle/<anomaly>.txt``
+artifacts the reference workflow reads after a failed analysis."""
+
+from jepsen_tpu.elle.explain import _render_cycle, render_anomaly
+
+
+CLOSED_2CYCLE = {
+    "cycle": [3, 7, 3],  # closed: first node repeated at the end
+    "txns": ["[[:append 1 4]]", "[[:r 1 [4 5]] [:append 2 9]]"],
+    "kinds": [["wr"], ["rw", "realtime"]],
+}
+
+GOLDEN = """G-single (1 witness)
+
+Cycle 0:
+  T0 = [[:append 1 4]]
+  T1 = [[:r 1 [4 5]] [:append 2 9]]
+
+  Then:
+    T0 < T1\t[wr: the second txn read this txn's write]
+    T1 < T0\t[rw+realtime: it read a state the other txn overwrote \
+& it completed before the other began (real time)]
+  T0 is ordered before itself: these transactions cannot be serialized.
+"""
+
+
+def test_closed_two_cycle_golden():
+    assert render_anomaly("G-single", [CLOSED_2CYCLE]) == GOLDEN
+
+
+def test_closed_cycle_renders_each_txn_once_and_wraps():
+    lines = _render_cycle(0, CLOSED_2CYCLE)
+    # The repeated closing node must NOT produce a duplicate T2 row...
+    assert sum(1 for ln in lines if " = " in ln) == 2
+    # ...and the final edge wraps back to T0.
+    assert any(ln.strip().startswith("T1 < T0") for ln in lines)
+
+
+def test_open_cycle_and_direct_witnesses_still_render():
+    # An (unclosed) 3-cycle: every edge indexes a real transaction.
+    w = {"cycle": [1, 2, 5], "txns": ["a", "b", "c"],
+         "kinds": [["ww"], ["process"], []]}
+    out = render_anomaly("G0", [w, {"key": 8, "value": None}])
+    assert "G0 (2 witnesses)" in out
+    assert "T2 < T0\t[?: edge]" in out  # empty kinds -> placeholder edge
+    assert "Witness 1:" in out and "key: 8" in out

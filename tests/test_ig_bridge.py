@@ -1,0 +1,86 @@
+"""ig_bridge dispatch semantics, driven through a fake pyignite client
+(the real thin client only exists on DB nodes). Focus: the XFER
+insufficient-funds rule — including SELF-transfers, which must apply the
+same NEG check the reference's b1 computation implies (bank.clj:97-101)
+rather than short-circuiting to OK."""
+
+import threading
+from contextlib import contextmanager
+
+from jepsen_tpu.resources import ig_bridge
+
+
+class FakeCache:
+    def __init__(self, store):
+        self.store = store
+
+    def get(self, k):
+        return self.store.get(k)
+
+    def put(self, k, v):
+        self.store[k] = v
+
+
+class FakeClient:
+    def __init__(self, store):
+        self.cache = FakeCache(store)
+
+    def get_cache(self, name):
+        return self.cache
+
+    def get_or_create_cache(self, props):
+        return self.cache
+
+
+class FakeSrv:
+    lock = threading.Lock()
+
+
+def _handler(store):
+    h = ig_bridge.Handler.__new__(ig_bridge.Handler)
+    h.client = FakeClient(store)
+
+    @contextmanager
+    def tx(_srv):
+        class _Tx:
+            def commit(self):
+                pass
+
+        yield _Tx()
+
+    h._tx = tx
+    return h
+
+
+def test_init_read_xfer_roundtrip():
+    store = {}
+    h = _handler(store)
+    assert h.dispatch(FakeSrv(), "INIT 3 10".split()) == "OK"
+    assert store == {0: 10, 1: 10, 2: 10}
+    assert h.dispatch(FakeSrv(), "READ 3".split()) == "OK [10, 10, 10]"
+    assert h.dispatch(FakeSrv(), "XFER 0 1 4".split()) == "OK"
+    assert store == {0: 6, 1: 14, 2: 10}
+
+
+def test_xfer_insufficient_funds_is_neg_and_commits_unchanged():
+    store = {0: 5, 1: 5}
+    h = _handler(store)
+    assert h.dispatch(FakeSrv(), "XFER 0 1 9".split()) == "NEG 0 -4"
+    assert store == {0: 5, 1: 5}
+
+
+def test_self_xfer_within_balance_ok_unchanged():
+    store = {0: 5, 1: 5}
+    h = _handler(store)
+    assert h.dispatch(FakeSrv(), "XFER 1 1 5".split()) == "OK"
+    assert store == {0: 5, 1: 5}
+
+
+def test_self_xfer_over_balance_is_neg_not_ok():
+    """The pre-r6 bridge short-circuited frm == to to OK; the reference
+    bank applies the insufficient-funds rule before looking at the
+    destination, so an over-balance self-transfer is a definite NEG."""
+    store = {0: 5, 1: 5}
+    h = _handler(store)
+    assert h.dispatch(FakeSrv(), "XFER 1 1 9".split()) == "NEG 1 -4"
+    assert store == {0: 5, 1: 5}

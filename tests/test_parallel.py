@@ -85,6 +85,69 @@ def test_batch_escalation():
     hists = [random_register_history(rng, n_ops=20, n_procs=5, crash_p=0.3) for _ in range(4)]
     got = check_batch(model, hists, f=2)  # force shared-capacity overflow
     assert all(g["valid"] is True for g in got)
+    # r6: overflow escalates as vmapped RE-BATCHES up the schedule, not
+    # one serial search per member — the rung ladder is recorded and no
+    # member fell through to the serial last resort.
+    assert all(g.get("escalated") is True for g in got)
+    rungs = next(g["rungs"] for g in got if g.get("rungs"))
+    assert [r["F"] for r in rungs][0] == 2 and len(rungs) >= 2
+
+
+def test_batched_escalation_differential_single_device():
+    """ISSUE r6 acceptance: escalation re-batching is differentially
+    tested against single-history ``check_encoded_device`` verdicts on
+    CPU — valid, invalid, AND unknown-overflow members in one batch.
+    The batch pipeline and the single driver get the SAME frontier
+    schedule, so every verdict (and the BFS level it lands on) must
+    agree: batched rungs resume losslessly from checkpointed frontiers
+    exactly like the single driver's escalation, and members that
+    overflow the top batched rung fall through to that very driver."""
+    from jepsen_tpu.ops import wgl
+    from jepsen_tpu.ops.encode import encode_history
+    from jepsen_tpu.parallel.batch import check_encoded_batch
+
+    rng = random.Random(77)
+    model = CasRegister(init=0)
+    hists = []
+    for i in range(5):
+        h = random_register_history(rng, n_ops=18, n_procs=4, cas=True,
+                                    crash_p=0.2)
+        if i % 2:
+            h = perturb_history(rng, h)
+        hists.append(h)
+    encs = [encode_history(model, h) for h in hists]
+    got = check_encoded_batch(encs, f=2, f_schedule=(4, 8))
+    want = [wgl.check_encoded_device(e, f_schedule=(2, 4, 8))
+            for e in encs]
+    assert [g["valid"] for g in got] == [w["valid"] for w in want]
+    # All three outcome classes are actually exercised (seed-pinned):
+    # a valid member, a refuted member, and one whose tiny top capacity
+    # leaves even the lossy top rung's beam undecided (unknown-
+    # overflow). Decided members never touch the serial driver; the
+    # beam-exhausted one falls through to it as the LAST resort (and
+    # stays unknown there too — the schedules match).
+    assert {str(g["valid"]) for g in got} == {"True", "False", "unknown"}
+    assert any(g.get("escalated") is True for g in got)
+    assert all(g.get("escalated") == "serial" for g in got
+               if g["valid"] == "unknown")
+    # Lossless resume invariant: the BFS level of every decision matches
+    # the single driver's exactly.
+    for g, w in zip(got, want):
+        if g["valid"] is not True or not g.get("batched"):
+            continue
+        assert g["levels"] == w["levels"]
+    # Refuted members carry a decodable witness (parity with the single
+    # driver's stuck_configs).
+    refuted = [g for g in got if g["valid"] is False and g.get("batched")]
+    assert all("max_linearized" in g for g in refuted)
+
+    # Serial last resort: a single-rung pipeline (no batched headroom)
+    # hands overflowing members to the serial driver, which runs the
+    # SAME schedule — verdicts again match member for member.
+    got1 = check_encoded_batch(encs, f=2, f_schedule=())
+    want1 = [wgl.check_encoded_device(e, f_schedule=(2,)) for e in encs]
+    assert [g["valid"] for g in got1] == [w["valid"] for w in want1]
+    assert any(g.get("escalated") == "serial" for g in got1)
 
 
 def test_graft_entry_points():
