@@ -51,6 +51,15 @@ BASELINE_S = 300.0
 BUDGET_S = float(os.environ.get("BENCH_BUDGET_S", "1200"))
 _T0 = time.monotonic()
 
+# Flight recorder: every leg is a phase; a budget breach (the r5
+# failure: bench_wall_s 855.7 > 740 with no attributable trail) or a
+# crash flushes flightrecord.json naming the offending phase. Stdlib-
+# only import — telemetry never pulls jax at import time.
+from jepsen_tpu.telemetry.flight import FlightRecorder  # noqa: E402
+
+FLIGHT_PATH = os.environ.get("BENCH_FLIGHT_RECORD", "flightrecord.json")
+_REC = FlightRecorder(budget_s=BUDGET_S)
+
 
 def _left() -> float:
     return BUDGET_S - (time.monotonic() - _T0)
@@ -86,6 +95,7 @@ def main() -> int:
         from jepsen_tpu.ops.encode import encode_history
         from jepsen_tpu.testing import perturb_history, random_register_history
 
+        _REC.begin("generate")
         model = CasRegister(init=0)
         history = random_register_history(
             random.Random(2026), n_ops=N_OPS, n_procs=10, cas=True,
@@ -100,6 +110,7 @@ def main() -> int:
         # every host-side metric reports {min, median, n} over >=3 reps
         # (round-over-round deltas were previously indistinguishable
         # from noise); the headline is the min.
+        _REC.begin("headline_native")
         wgl.check_history(model, history)  # warm (native lib build etc.)
         times = []
         for _rep in range(3):
@@ -119,6 +130,7 @@ def main() -> int:
         # Transparency: decide a FRESH same-shape history through the
         # production dispatch too (guards against any caching between the
         # warm and measured runs serving stale results).
+        _REC.begin("fresh_history")
         fresh = random_register_history(
             random.Random(2027), n_ops=N_OPS, n_procs=10, cas=True,
             crash_p=0.002, fail_p=0.02
@@ -136,6 +148,7 @@ def main() -> int:
         # through the production dispatch (the native engine refutes
         # definitively where capacity-limited searches can only say
         # unknown).
+        _REC.begin("invalid_refutation")
         bad = perturb_history(random.Random(7), history)
         btimes = []
         for _rep in range(3):
@@ -157,6 +170,7 @@ def main() -> int:
 
         # Headroom: a 10x longer history through the production dispatch
         # (the native engine scales near-linearly on valid histories).
+        _REC.begin("headroom_10x")
         try:
             if _left() < 60:
                 out["headroom_10x"] = {"skipped": "budget"}
@@ -196,6 +210,7 @@ def main() -> int:
         # of scheduler speed (what r2 actually measured). Run through
         # the raw interpreter (not core.run) so analysis time isn't
         # charged to scheduling.
+        _REC.begin("interpreter")
         try:
             from jepsen_tpu import generator as jgen
             from jepsen_tpu import nemesis as jnem
@@ -264,12 +279,14 @@ def main() -> int:
             except Exception:  # noqa: BLE001 - timeout or spawn failure
                 return False
 
+        _REC.begin("device_probe")
         devices_ok = _device_reachable()
         if not devices_ok:
             out["device_note"] = "TPU backend unreachable; device " \
                                  "sections skipped"
         # Batch replay: 100 histories decided as one vmapped program
         # (BASELINE config 5). Worst case ~90 s (compile + 2 runs).
+        _REC.begin("batch_replay_100")
         try:
             if _left() < 100 or not devices_ok:
                 out["batch_replay_100"] = {"skipped": "budget"}
@@ -310,6 +327,7 @@ def main() -> int:
         # full-bench-size members inside HBM (members overflowing the
         # shared capacity report unknown rather than escalate — the
         # smoke bounds memory, not verdicts).
+        _REC.begin("batch_replay_large")
         try:
             if _left() < 150 or not devices_ok:
                 out["batch_replay_large"] = {"skipped": "budget"}
@@ -407,6 +425,7 @@ def main() -> int:
         # histories) plus an INVALID companion whose big cyclic
         # component routes through the per-SCC MXU closure. Worst case
         # ~60 s.
+        _REC.begin("elle_txn")
         try:
             if _left() < 70 or not devices_ok:
                 out["elle_txn"] = {"skipped": "budget"}
@@ -469,6 +488,7 @@ def main() -> int:
         # Mutex-model linearizability (hazelcast CP lock config): a 5k-op
         # correct lock-service history on the device kernel. Worst case
         # ~120 s (two BFS passes of ~3.6k levels).
+        _REC.begin("mutex_5k")
         try:
             if _left() < 130 or not devices_ok:
                 out["mutex_5k"] = {"skipped": "budget"}
@@ -493,6 +513,7 @@ def main() -> int:
         # batch/scale engine measured single-history; optimistic beam +
         # exhaustive fallback). Costliest section (~90 s/pass): one timed
         # warm pass; a steady-state second pass only if budget remains.
+        _REC.begin("device_kernel")
         try:
             if _left() < 110 or not devices_ok:
                 out["device_kernel_s"] = None
@@ -602,6 +623,23 @@ def main() -> int:
                         "achieved/attainable")
                 except Exception:  # diagnostic only
                     pass
+                # Roofline attribution: per-chunk latency-vs-bandwidth
+                # classification, achieved GB/s and occupancy from the
+                # registry's wgl_chunk/wgl_level events + the byte-floor
+                # model, priced at the measured copy bandwidth when this
+                # run produced one (telemetry/profile.py — the per-level
+                # answer to "which part of the search is slow").
+                try:
+                    from jepsen_tpu.telemetry import profile as jprof
+
+                    attr = jprof.attribute(
+                        treg, plan=wgl.plan_device(enc),
+                        copy_bw_gbs=out.get("hbm_copy_gbs"))
+                    if attr.get("device"):
+                        out["device_attribution"] = attr["device"]
+                except Exception as e:  # noqa: BLE001 - diagnostics only
+                    out["device_attribution"] = {
+                        "error": f"{type(e).__name__}: {e}"}
         except Exception as e:  # noqa: BLE001
             out["device_kernel_s"] = None
             out["device_error"] = f"{type(e).__name__}: {e}"
@@ -632,6 +670,7 @@ def main() -> int:
         # while the leg's wall budget lasts. The leg's own wall cap
         # (which squeezes the check cap when the whole bench is
         # running out of room) is reported as cap_s.
+        _REC.begin("max_verified_ops_device")
         try:
             if _left() < 260 or not devices_ok:
                 out["max_verified_ops_device"] = {"skipped": "budget"}
@@ -705,6 +744,7 @@ def main() -> int:
         # elsewhere). Single attempt sized from the unsharded leg's
         # result; same overshoot-abort contract via the sharded
         # driver's chunk callback.
+        _REC.begin("max_verified_ops_device_sharded")
         try:
             if _left() < 180 or not devices_ok:
                 out["max_verified_ops_device_sharded"] = {
@@ -754,6 +794,7 @@ def main() -> int:
                 "error": f"{type(e).__name__}: {e}"}
 
         _checkpoint()
+        _REC.begin("max_verified_ops")
         try:
             if _left() < 120:
                 raise TimeoutError("budget")
@@ -881,7 +922,33 @@ def main() -> int:
     except Exception as e:  # noqa: BLE001 - always emit the JSON line
         out["error"] = f"{type(e).__name__}: {e}"
         rc = 1
+        # Post-mortem for the crash case too: the record names the
+        # phase that blew up (phase "error" fields outrank walls).
+        out["flight_record"] = _REC.flush(FLIGHT_PATH, reason="exception")
+    _REC.end()
+    # vs_previous: self-report the round-over-round deltas against the
+    # newest committed BENCH_r*.json, so a regression rides the new
+    # round's own JSON line instead of waiting for a judge to diff
+    # artifacts by hand (jepsen_tpu.benchcmp is the standalone gate).
+    try:
+        from jepsen_tpu import benchcmp as _bc
+
+        vp = _bc.vs_previous(
+            out, root=os.path.dirname(os.path.abspath(__file__)))
+        if vp is not None:
+            out["vs_previous"] = vp
+    except Exception as e:  # noqa: BLE001 - deltas never sink the bench
+        out["vs_previous"] = {"error": f"{type(e).__name__}: {e}"}
     out["bench_wall_s"] = round(time.monotonic() - _T0, 1)
+    if out["bench_wall_s"] > BUDGET_S:
+        # Budget watchdog: the contract breach is recorded IN the JSON
+        # (not silently blown, the r5 failure mode) together with the
+        # flight-recorder post-mortem naming the offending leg.
+        out["budget_exceeded"] = True
+        out["budget_s"] = BUDGET_S
+        out["flight_record"] = _REC.flush(FLIGHT_PATH,
+                                          reason="budget_breach")
+        out["flight_offending_phase"] = _REC.offending_phase()
     print(json.dumps(out))
     return rc
 

@@ -75,6 +75,12 @@ def add_test_opts(p: argparse.ArgumentParser) -> None:
                         "counters, op-latency histograms, phase "
                         "timings) + client spans into the run's store "
                         "directory (metrics.jsonl/.prom, spans.jsonl)")
+    p.add_argument("--profile", action="store_true",
+                   help="performance attribution (implies --telemetry): "
+                        "roofline classification of the device search "
+                        "(profile.json), device memory watermarks, and "
+                        "a jax.profiler trace captured into the run's "
+                        "store directory (profile_trace/)")
     p.add_argument("--store-root", default=None,
                    help="directory for the store/ tree")
 
@@ -128,6 +134,10 @@ def _apply_std_opts(test: dict, opts: dict) -> dict:
         test["logging-json"] = True
     if opts.get("telemetry"):
         test["telemetry?"] = True
+    if opts.get("profile"):
+        # Profiling rides the telemetry registry; the flag implies it.
+        test["telemetry?"] = True
+        test["profile?"] = True
     if opts.get("store_root"):
         test["store-root"] = opts["store_root"]
     if opts.get("checker_backend") and opts["checker_backend"] != "auto":

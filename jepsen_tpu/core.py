@@ -135,7 +135,8 @@ def analyze(test: dict) -> dict:
     test["history"] = h
     reg = jtelemetry.of_test(test)
     checker = test.get("checker")
-    with jtelemetry.timed_phase(reg, "analyze"):
+    with jtelemetry.timed_phase(reg, "analyze",
+                                recorder=test.get("flight-recorder")):
         if checker is not None:
             test["results"] = check_safe(checker, test, h)
         else:
@@ -148,6 +149,11 @@ def analyze(test: dict) -> dict:
             # get their metrics persisted; core.run re-exports a more
             # complete snapshot at the end (atomic replace, last wins).
             jtelemetry.store_metrics(test)
+            if test.get("profile?"):
+                try:
+                    jtelemetry.store_profile(test)
+                except Exception:  # diagnostics never sink the run
+                    LOG.warning("profile export failed", exc_info=True)
     return test
 
 
@@ -220,6 +226,13 @@ def run(test: dict) -> dict:
     test = prepare_test(test)
     persist = bool(test.get("name")) and not test.get("no-store?")
     reg = jtelemetry.of_test(test)
+    frec = None
+    if reg is not None:
+        # Flight recorder rides every telemetry run: phases mirror
+        # run_phase_seconds, and a crash flushes flightrecord.json into
+        # the store naming the phase that died (FDR semantics — cheap
+        # to feed, only written when something goes wrong).
+        frec = test["flight-recorder"] = jtelemetry.FlightRecorder()
     if reg is not None and persist and test.get("client") is not None:
         # Telemetry runs get the tracing client for free: every client
         # lifecycle call records a span (trace.clj's with-trace), and
@@ -237,18 +250,29 @@ def run(test: dict) -> dict:
         sessions = _with_sessions(test)
         osys: jos.OS = test.get("os") or jos.noop()
         nodes = test.get("nodes") or []
+        # Opt-in on-device capture (--profile / test["profile?"]): a
+        # jax.profiler trace of the whole run into the store dir. The
+        # context is a no-op when jax/profiling is unavailable.
+        import contextlib as _ctx
+
+        prof_cm = (
+            jtelemetry.trace_capture(store.path_mk(test, "profile_trace"))
+            if persist and test.get("profile?") else _ctx.nullcontext())
         try:
             jdb._on_nodes(test, osys.setup, nodes)
             try:
-                with jtelemetry.timed_phase(reg, "db.cycle"):
-                    jdb.cycle(test)
-                with with_relative_time():
-                    with jtelemetry.timed_phase(reg, "run_case"):
-                        history = run_case(test)
-                test["history"] = history
-                if persist:
-                    store.save_1(test)
-                test = analyze(test)
+                with prof_cm:
+                    with jtelemetry.timed_phase(reg, "db.cycle",
+                                                recorder=frec):
+                        jdb.cycle(test)
+                    with with_relative_time():
+                        with jtelemetry.timed_phase(reg, "run_case",
+                                                    recorder=frec):
+                            history = run_case(test)
+                    test["history"] = history
+                    if persist:
+                        store.save_1(test)
+                    test = analyze(test)
                 return log_results(test)
             finally:
                 snarf_logs(test)
@@ -266,6 +290,14 @@ def run(test: dict) -> dict:
                 from . import control
 
                 control.close_sessions(sessions)
+    except BaseException:
+        # The run died: flush the flight record into the store — the
+        # post-mortem names the lifecycle phase that was open (FDR
+        # semantics; the write itself never raises).
+        if frec is not None and persist:
+            jtelemetry.store_flight_record(test, frec, reason="exception",
+                                           registry=reg)
+        raise
     finally:
         if persist and reg is not None:
             # Sinks go out even when a phase above threw: spans.jsonl +
@@ -276,6 +308,10 @@ def run(test: dict) -> dict:
                 if test.get("trace-collector") is not None:
                     jtrace.store_spans(test, test["trace-collector"])
                 jtelemetry.store_metrics(test)
+                if test.get("profile?"):
+                    # profile.json: roofline attribution + memory
+                    # watermarks, rendered by the /profile web page.
+                    jtelemetry.store_profile(test)
             except Exception:
                 LOG.warning("telemetry export failed", exc_info=True)
         if persist:

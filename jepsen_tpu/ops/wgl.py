@@ -886,6 +886,11 @@ def _note_chunk_metrics(metrics, lvl_stats, lvl0: int, lvl: int, F: int,
       "build carries the jit trace/lower/compile cost)",
       labelnames=("stage",)).labels(stage=stage).inc(chunk_wall)
     metrics.gauge("wgl_capacity", "Current frontier capacity F").set(F)
+    # Per-chunk event: the attribution seam telemetry.profile consumes —
+    # (levels run, capacity, wall, compile-vs-execute) is exactly what a
+    # roofline classification needs per chunk.
+    metrics.event("wgl_chunk", level0=int(lvl0), level=int(lvl),
+                  F=int(F), wall_s=round(chunk_wall, 6), stage=stage)
     if lvl_stats is None:
         return
     rows = lvl_stats[np.argsort(lvl_stats[:, 0], kind="stable")]
@@ -1807,7 +1812,8 @@ def check_encoded_competition(enc: EncodedHistory,
             strategy, n_thr = wgl_c.parallel_policy()
             nat = wgl_c.check_encoded_native(
                 enc, max_configs=native_max_configs, cancel=cancel,
-                strategy=strategy, n_threads=n_thr)
+                strategy=strategy, n_threads=n_thr,
+                metrics=kw.get("metrics"))
         except Exception:  # noqa: BLE001 - the race must survive a loser
             nat = None
         if nat is not None:
@@ -1921,12 +1927,13 @@ def check_history(
         # engine, whose batched-LIFO order both prunes harder under
         # the dominance memo and fans over cores when there are any.
         quick = min(budget, 50_000 + 5 * enc.n)
-        nat = wgl_c.check_encoded_native(enc, max_configs=quick)
+        nat = wgl_c.check_encoded_native(enc, max_configs=quick,
+                                         metrics=kw.get("metrics"))
         if nat is not None and nat["valid"] == "unknown":
             strategy, n_thr = wgl_c.parallel_policy()
             nat = wgl_c.check_encoded_native(
                 enc, max_configs=budget, strategy=strategy,
-                n_threads=n_thr)
+                n_threads=n_thr, metrics=kw.get("metrics"))
         if nat is not None and nat["valid"] != "unknown":
             nat["backend"] = "native"
             return nat

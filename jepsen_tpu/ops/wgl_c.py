@@ -67,7 +67,7 @@ def parallel_policy() -> tuple[str, int]:
 def check_encoded_native(
     enc: EncodedHistory, max_configs: int = 50_000_000,
     strategy: str = "dfs", cancel: Optional["ctypes.c_int32"] = None,
-    n_threads: Optional[int] = None,
+    n_threads: Optional[int] = None, metrics=None,
 ) -> Optional[dict]:
     """Decide linearizability in the C engine; None when unsupported.
     ``strategy``: "dfs" (memoized depth-first — near-linear on valid
@@ -77,7 +77,12 @@ def check_encoded_native(
     "bfs" (level-synchronous, the device kernel's shape).
     ``cancel``: a ctypes.c_int32 the DFS polls — setting it nonzero
     from another thread makes the search return "unknown" promptly
-    (the competition race's loser cancellation)."""
+    (the competition race's loser cancellation).
+    ``metrics``: a telemetry Registry — the engine's existing
+    configs-explored / wall returns are folded into
+    ``wgl_native_nodes_total`` / ``wgl_native_wall_seconds_total``
+    (labelled by strategy), so the native-vs-device race is visible in
+    ``/metrics`` next to the kernel counters."""
     lib = native.load()
     if lib is None:
         return None
@@ -143,6 +148,10 @@ def check_encoded_native(
         "frontier_max": int(fmax.value),
         "wall_s": wall,
     }
+    if metrics is not None:
+        _note_native_metrics(metrics, strategy, int(explored.value), wall,
+                             verdict)
+
     if verdict == 1:
         return {"valid": True, **base}
     if verdict == 0:
@@ -158,6 +167,26 @@ def check_encoded_native(
         return {"valid": "unknown",
                 "info": "native engine out of memory", **base}
     return None  # unsupported shape
+
+
+def _note_native_metrics(metrics, strategy: str, explored: int,
+                         wall: float, verdict: int) -> None:
+    """Surface the C engine's existing progress returns as registry
+    counters (host-side only; never called when telemetry is off)."""
+    metrics.counter(
+        "wgl_native_nodes_total",
+        "Configurations explored by the native C search",
+        labelnames=("strategy",)).labels(strategy=strategy).inc(explored)
+    metrics.counter(
+        "wgl_native_wall_seconds_total",
+        "Native C search wall seconds",
+        labelnames=("strategy",)).labels(strategy=strategy).inc(wall)
+    metrics.counter(
+        "wgl_native_searches_total",
+        "Native C searches by verdict",
+        labelnames=("verdict",)).labels(
+            verdict={1: "valid", 0: "invalid"}.get(verdict,
+                                                   "unknown")).inc()
 
 
 def _decode_witness(enc: EncodedHistory, buf: np.ndarray, n_entries: int,

@@ -1,26 +1,108 @@
 """SmartOS provisioning (jepsen.os.smartos, jepsen/src/jepsen/os/
-smartos.clj): pkgsrc package management over the control session."""
+smartos.clj:13-60): hostname + hostfile setup and the pkgin/pkgsrc
+package flow, including the bootstrap for zones that ship without
+pkgin at all."""
 
 from __future__ import annotations
 
-from typing import Iterable
+from typing import Iterable, Optional
 
 from .. import control as c
 from . import OS
 
+# pkgsrc bootstrap tarball for bare zones (smartos.clj's bootstrap
+# step); overridable for newer branches.
+BOOTSTRAP_URL = (
+    "https://pkgsrc.smartos.org/packages/SmartOS/bootstrap/"
+    "bootstrap-2021Q4-x86_64.tar.gz"
+)
+
+
+def setup_hostname(node) -> None:
+    """Pin the zone's hostname to its node name (smartos.clj:13-21):
+    live via ``hostname``, durable via ``/etc/nodename`` (the SmartOS
+    boot-time hostname source)."""
+    with c.su():
+        c.exec("hostname", str(node))
+        c.exec_star(f"echo {c.escape(str(node))} > /etc/nodename")
+
+
+def setup_hostfile(test: Optional[dict] = None) -> None:
+    """Make every test node resolve (smartos.clj:23-30): the zone's own
+    name maps to loopback; peers that don't resolve yet get hostfile
+    entries only when the test map carries addresses (``node-ips``)."""
+    name = c.exec("hostname")
+    try:
+        c.exec("grep", name, "/etc/hosts")
+    except c.RemoteError:
+        with c.su():
+            c.exec_star(f"echo 127.0.0.1 {c.escape(name)} >> /etc/hosts")
+    ips = (test or {}).get("node-ips") or {}
+    for peer, ip in sorted(ips.items()):
+        try:
+            c.exec("grep", str(peer), "/etc/hosts")
+        except c.RemoteError:
+            with c.su():
+                c.exec_star(
+                    f"echo {c.escape(str(ip))} {c.escape(str(peer))} "
+                    ">> /etc/hosts")
+
+
+def bootstrapped() -> bool:
+    """Is pkgin present? (bare zones ship without the pkgsrc
+    bootstrap)."""
+    try:
+        c.exec("which", "pkgin")
+        return True
+    except c.RemoteError:
+        return False
+
+
+def bootstrap(url: str = BOOTSTRAP_URL) -> None:
+    """Install the pkgsrc bootstrap tarball (smartos.clj:32-43): fetch,
+    unpack over /, rebuild the pkg db."""
+    with c.su():
+        c.exec_star(
+            f"curl -k {c.escape(url)} | gtar -zxpf - -C / "
+            "&& pkg_admin rebuild && pkgin -y update")
+
+
+def update() -> None:
+    """Refresh the pkgin repository database (smartos.clj:45-47)."""
+    with c.su():
+        c.exec("pkgin", "-y", "update")
+
+
+def installed(pkgs: Iterable[str]) -> dict:
+    """Map of package -> version for installed packages (pkg_info -E;
+    smartos.clj:49-53)."""
+    out = {}
+    for p in pkgs:
+        try:
+            v = c.exec("pkg_info", "-E", p)
+            out[p] = v.strip()
+        except c.RemoteError:
+            pass
+    return out
+
 
 def install(pkgs: Iterable[str]) -> None:
-    """pkgin-based install-if-missing (smartos.clj's pkgin flow)."""
+    """pkgin-based install-if-missing (smartos.clj:55-60)."""
     pkgs = list(pkgs)
-    if not pkgs:
-        return
-    with c.su():
-        c.exec("pkgin", "-y", "install", *pkgs)
+    have = installed(pkgs)
+    missing = [p for p in pkgs if p not in have]
+    if missing:
+        with c.su():
+            c.exec("pkgin", "-y", "install", *missing)
 
 
 class SmartOS(OS):
     def setup(self, test, node):
-        install(["curl", "wget", "unzip", "gtar"])
+        setup_hostname(node)
+        setup_hostfile(test)
+        if not bootstrapped():
+            bootstrap()
+        install(["curl", "wget", "unzip", "gtar", "rsync"])
 
     def teardown(self, test, node):
         pass

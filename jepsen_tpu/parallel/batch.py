@@ -340,6 +340,18 @@ def check_encoded_batch(
             "calls": calls,
             "wall_s": round(_time.perf_counter() - t_rung, 3),
         })
+        if metrics is not None:
+            # Rung-level attribution event (telemetry.profile): decided
+            # vs escalated member counts explain WHY the pipeline moved
+            # up the ladder — members that overflowed this capacity.
+            metrics.event(
+                "wgl_batch_rung", F=F,
+                members=rung_stats[-1]["members"], calls=calls,
+                wall_s=rung_stats[-1]["wall_s"],
+                decided=int(np.sum(acc_s | stuck_s)),
+                overflowed=int(np.sum(ovf_s & ~acc_s & ~stuck_s))
+                if not lossy_rung else 0,
+                lossy=bool(lossy_rung))
         # Classify this rung's rows; decided members get results NOW so
         # a later-rung failure can't lose them.
         overflowed = []

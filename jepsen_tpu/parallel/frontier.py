@@ -213,7 +213,12 @@ def check_encoded_sharded(
                     "wgl_sharded_chunk", level=int(lvl), F=F,
                     n_shards=D, global_capacity=FT, count=int(_cnt),
                     frontier_max=fmax_all[0],
-                    wall_s=round(chunk_wall, 4))
+                    wall_s=round(chunk_wall, 4),
+                    # Per-chunk interconnect traffic (analytic), so
+                    # telemetry.profile can attribute the exchange's
+                    # share without re-deriving the byte model.
+                    allgather_bytes=allgather_bytes_per_level(F)
+                    * max(int(lvl) - lvl0, 0))
 
             def result(valid, **extra):
                 r = {"valid": valid, "op_count": n, "device": True,

@@ -25,6 +25,7 @@ from __future__ import annotations
 
 from typing import Optional
 
+from . import flight, profile  # noqa: F401
 from .export import (  # noqa: F401
     export_jsonl,
     export_prometheus,
@@ -32,7 +33,14 @@ from .export import (  # noqa: F401
     prometheus_text,
     store_metrics,
 )
+from .flight import FlightRecorder, store_flight_record  # noqa: F401
 from .heartbeat import Heartbeat  # noqa: F401
+from .profile import (  # noqa: F401
+    attribute,
+    memory_watermarks,
+    store_profile,
+    trace_capture,
+)
 from .registry import (  # noqa: F401
     DEFAULT_BUCKETS,
     Counter,
@@ -72,16 +80,24 @@ def of_test(test: Optional[dict]) -> Optional[Registry]:
 __all__ = [
     "Counter",
     "DEFAULT_BUCKETS",
+    "FlightRecorder",
     "Gauge",
     "Heartbeat",
     "Histogram",
     "Registry",
+    "attribute",
     "enabled",
     "export_jsonl",
     "export_prometheus",
+    "flight",
     "jsonl_lines",
+    "memory_watermarks",
     "of_test",
+    "profile",
     "prometheus_text",
+    "store_flight_record",
     "store_metrics",
+    "store_profile",
     "timed_phase",
+    "trace_capture",
 ]

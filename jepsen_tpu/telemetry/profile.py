@@ -1,0 +1,411 @@
+"""Performance attribution: a roofline profiler over the WGL telemetry.
+
+Round 5's verdict could say the kernel's ``device_util`` was an honest
+0.119 but not *which levels* were latency- vs bandwidth-bound. This
+module closes that gap host-side, from data the drivers already record
+when a registry is injected:
+
+- the stats-variant kernel's per-level ``wgl_level`` rows
+  (``[level, frontier, expanded, overflow]`` — ``ops/wgl.py``),
+- the per-chunk ``wgl_chunk`` events (levels run, capacity ``F``, wall,
+  compile-vs-execute stage),
+- the ``wgl.level_byte_floor`` byte model (a provable LOWER bound on a
+  level's HBM traffic, enumerated from the kernel's static shapes).
+
+The classification is the roofline argument in time units: at capacity
+``F`` a level costs at least ``t_bw = byte_floor(F) / copy_bw`` of pure
+streaming and at least ``t_lat`` of fixed overhead (dispatch + the
+bitonic sort's pass latency on a mostly-empty frontier — the measured
+~0.2 ms/level constant in ``wgl._levels_per_call``). Whichever bound
+explains more of the measured per-level wall names the chunk:
+**bandwidth-bound** (the byte floor dominates — more capacity or fewer
+bytes help) or **latency-bound** (the fixed floor dominates — fewer,
+fatter levels help). Compile chunks are attributed separately — their
+wall is jit cost, not the chip. Without a measured copy bandwidth the
+classifier falls back to frontier occupancy (a frontier filling its
+capacity streams real bytes; a near-empty one pays latency).
+
+Also here: opt-in ``jax.profiler`` trace capture + device
+``memory_stats()`` watermarks (the ``--profile`` CLI flag), the
+``profile.json`` store artifact the ``/profile`` web page renders, and
+attribution for the batched pipeline (per-rung occupancy — why a member
+escalated) and the frontier-sharded driver (all_gather bytes — the
+interconnect's share of the level's traffic). See docs/profiling.md.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import time as _time
+from typing import Any, Callable, Optional
+
+from .registry import Registry
+
+# Fixed per-level latency floor (seconds): dispatch + loop overhead at
+# the 2x unroll — the constant term of wgl._levels_per_call's measured
+# per-level cost model.
+LATENCY_FLOOR_S = 2.0e-4
+
+# Occupancy fallback threshold for the no-measured-bandwidth case: a
+# chunk whose mean frontier fills less than this fraction of F is
+# latency-bound (its levels are mostly fixed overhead).
+OCCUPANCY_THRESHOLD = 0.25
+
+
+def _byte_floor_fn(plan, byte_floor, **floor_kw) -> Optional[Callable]:
+    """Resolve the bytes-per-level model: an explicit callable wins,
+    else wrap ``wgl.level_byte_floor`` over the plan."""
+    if byte_floor is not None:
+        return byte_floor
+    if plan is None:
+        return None
+    from ..ops import wgl
+
+    return lambda F: wgl.level_byte_floor(plan, F, **floor_kw)
+
+
+def _classify(per_level_s: float, floor_bytes: Optional[int],
+              copy_bw_gbs: Optional[float], occupancy: Optional[float],
+              latency_floor_s: float) -> tuple[str, Optional[float], float]:
+    """(bound, util, latency_share) for one executing chunk."""
+    latency_share = min(1.0, latency_floor_s / per_level_s) \
+        if per_level_s > 0 else 0.0
+    util = None
+    if floor_bytes and copy_bw_gbs:
+        t_bw = floor_bytes / (copy_bw_gbs * 1e9)
+        util = min(1.0, t_bw / per_level_s) if per_level_s > 0 else 0.0
+        bound = "bandwidth" if util >= latency_share else "latency"
+    elif occupancy is not None:
+        bound = "bandwidth" if occupancy >= OCCUPANCY_THRESHOLD \
+            else "latency"
+    else:
+        bound = "latency" if latency_share >= 0.5 else "indeterminate"
+    return bound, util, latency_share
+
+
+def attribute(registry: Registry, plan=None,
+              byte_floor: Optional[Callable[[int], int]] = None,
+              copy_bw_gbs: Optional[float] = None,
+              latency_floor_s: float = LATENCY_FLOOR_S,
+              max_chunks: int = 60) -> dict:
+    """Fold a run's registry into a performance-attribution map.
+
+    Returns ``{"device": ..., "batch": ..., "sharded": ...}`` — each
+    section present only when its events exist. ``plan`` (a
+    ``wgl.DevicePlan``) or ``byte_floor(F) -> bytes`` enables the byte
+    model; ``copy_bw_gbs`` (bench.py's measured on-device copy
+    bandwidth) enables achieved-GB/s and the measured-roofline
+    classification. ``max_chunks`` bounds the per-chunk list in the
+    output (head + tail kept, middle elided) so bench JSON stays small.
+    """
+    out: dict = {}
+    dev = _attribute_device(registry, plan, byte_floor, copy_bw_gbs,
+                            latency_floor_s, max_chunks)
+    if dev is not None:
+        out["device"] = dev
+    batch = _attribute_batch(registry)
+    if batch is not None:
+        out["batch"] = batch
+    sharded = _attribute_sharded(registry, plan, byte_floor)
+    if sharded is not None:
+        out["sharded"] = sharded
+    return out
+
+
+def _attribute_device(registry, plan, byte_floor, copy_bw_gbs,
+                      latency_floor_s, max_chunks) -> Optional[dict]:
+    chunks_ev = registry.events("wgl_chunk")
+    if not chunks_ev:
+        return None
+    floor = _byte_floor_fn(plan, byte_floor)
+    # Per-level rows grouped by capacity: escalation retries rewrite the
+    # same level number at a larger F, so the (F, level-range) pair is
+    # the only unambiguous join key for a chunk's levels.
+    by_F: dict[int, list[dict]] = {}
+    for e in registry.events("wgl_level"):
+        by_F.setdefault(int(e["F"]), []).append(e)
+
+    chunks = []
+    for ev in chunks_ev:
+        F = int(ev["F"])
+        lvl0, lvl = int(ev["level0"]), int(ev["level"])
+        wall = float(ev["wall_s"])
+        levels = max(lvl - lvl0, 0)
+        c: dict = {"F": F, "level0": lvl0, "level": lvl,
+                   "levels": levels, "wall_s": round(wall, 4),
+                   "stage": ev.get("stage", "execute")}
+        rows = [e for e in by_F.get(F, ())
+                if lvl0 < int(e["level"]) <= lvl]
+        occ = None
+        if rows:
+            occ = sum(int(e["frontier"]) for e in rows) / (len(rows) * F)
+            c["occupancy"] = round(occ, 4)
+            c["frontier_mean"] = round(
+                sum(int(e["frontier"]) for e in rows) / len(rows), 1)
+            c["expanded_total"] = sum(int(e["expanded"]) for e in rows)
+        if levels == 0:
+            # An attempt that completed no level: an overflow awaiting
+            # escalation (or an instant accept) — wall is real, but a
+            # per-level rate is meaningless.
+            c["bound"] = ("compile" if c["stage"] == "compile"
+                          else "overflow")
+            chunks.append(c)
+            continue
+        per_level = wall / levels
+        c["per_level_ms"] = round(per_level * 1e3, 4)
+        fb = int(floor(F)) if floor is not None else None
+        if fb is not None:
+            c["bytes_floor"] = fb * levels
+            if wall > 0:
+                c["achieved_gbs"] = round(fb * levels / wall / 1e9, 2)
+        if c["stage"] == "compile":
+            # First chunk after a fresh build: the wall is jit cost.
+            c["bound"] = "compile"
+        else:
+            bound, util, lat = _classify(per_level, fb, copy_bw_gbs, occ,
+                                         latency_floor_s)
+            c["bound"] = bound
+            c["latency_share"] = round(lat, 4)
+            if util is not None:
+                c["util"] = round(util, 4)
+        chunks.append(c)
+
+    # Rung (capacity) aggregation + run summary.
+    rungs: dict[int, dict] = {}
+    totals = {"wall_s": 0.0, "levels": 0, "bytes_floor": 0}
+    # Executing chunks only (a compile chunk's wall conflates jit cost
+    # with its levels' execution, so BOTH its wall and its bytes stay
+    # out of the achieved-GB/s figure).
+    exec_totals = {"wall_s": 0.0, "bytes_floor": 0}
+    bound_wall: dict[str, float] = {}
+    for c in chunks:
+        r = rungs.setdefault(c["F"], {
+            "F": c["F"], "chunks": 0, "levels": 0, "wall_s": 0.0,
+            "bytes_floor": 0, "_occ": [], "_bw": {}})
+        r["chunks"] += 1
+        r["levels"] += c["levels"]
+        r["wall_s"] += c["wall_s"]
+        r["bytes_floor"] += c.get("bytes_floor") or 0
+        if "occupancy" in c:
+            r["_occ"].append(c["occupancy"])
+        r["_bw"][c["bound"]] = r["_bw"].get(c["bound"], 0.0) + c["wall_s"]
+        totals["wall_s"] += c["wall_s"]
+        totals["levels"] += c["levels"]
+        totals["bytes_floor"] += c.get("bytes_floor") or 0
+        if c["bound"] != "compile":
+            exec_totals["wall_s"] += c["wall_s"]
+            exec_totals["bytes_floor"] += c.get("bytes_floor") or 0
+        bound_wall[c["bound"]] = bound_wall.get(c["bound"], 0.0) \
+            + c["wall_s"]
+    rung_list = []
+    for F in sorted(rungs):
+        r = rungs[F]
+        occ = r.pop("_occ")
+        bw = r.pop("_bw")
+        if occ:
+            r["occupancy_mean"] = round(sum(occ) / len(occ), 4)
+        r["wall_s"] = round(r["wall_s"], 4)
+        if r["bytes_floor"] and r["wall_s"] > 0:
+            r["achieved_gbs"] = round(
+                r["bytes_floor"] / r["wall_s"] / 1e9, 2)
+        r["bound"] = max(bw, key=bw.get)
+        rung_list.append(r)
+
+    summary: dict = {
+        "levels": totals["levels"],
+        "wall_s": round(totals["wall_s"], 4),
+        "bound_wall_s": {b: round(w, 4)
+                         for b, w in sorted(bound_wall.items())},
+        "copy_bw_gbs": copy_bw_gbs,
+    }
+    hot = {b: w for b, w in bound_wall.items()
+           if b in ("latency", "bandwidth")}
+    if hot:
+        summary["dominant_bound"] = max(hot, key=hot.get)
+    if totals["bytes_floor"]:
+        summary["bytes_floor_total"] = totals["bytes_floor"]
+    if exec_totals["bytes_floor"] and exec_totals["wall_s"] > 0:
+        summary["achieved_gbs"] = round(
+            exec_totals["bytes_floor"] / exec_totals["wall_s"] / 1e9, 2)
+        if copy_bw_gbs:
+            summary["util"] = round(
+                exec_totals["bytes_floor"] / exec_totals["wall_s"]
+                / (copy_bw_gbs * 1e9), 4)
+
+    if len(chunks) > max_chunks:
+        head = chunks[: max_chunks // 2]
+        tail = chunks[-(max_chunks - len(head)):]
+        summary["chunks_elided"] = len(chunks) - len(head) - len(tail)
+        chunks = head + tail
+    return {"chunks": chunks, "rungs": rung_list, "summary": summary}
+
+
+def _attribute_batch(registry) -> Optional[dict]:
+    """Per-rung occupancy of the batched escalation pipeline: WHY a
+    member escalated is visible as its rung's final occupancy (members
+    still searching when the rung's ladder moved on) plus the rebatch
+    events' member counts."""
+    chunk_ev = registry.events("wgl_batch_chunk")
+    rung_ev = registry.events("wgl_batch_rung")
+    rebatch_ev = registry.events("wgl_rebatch")
+    if not (chunk_ev or rung_ev):
+        return None
+    by_F: dict[int, list[dict]] = {}
+    for e in chunk_ev:
+        by_F.setdefault(int(e["F"]), []).append(e)
+    rungs = []
+    for e in rung_ev:
+        F = int(e["F"])
+        r = {k: e[k] for k in
+             ("F", "members", "calls", "wall_s", "decided", "overflowed",
+              "lossy") if k in e}
+        evs = by_F.get(F, ())
+        if evs:
+            occs = [int(x["active"]) / max(int(x["batch"]), 1)
+                    for x in evs]
+            r["occupancy_mean"] = round(sum(occs) / len(occs), 4)
+            r["occupancy_final"] = round(occs[-1], 4)
+        rungs.append(r)
+    if not rungs:  # chunk events only (older recordings)
+        for F in sorted(by_F):
+            evs = by_F[F]
+            occs = [int(x["active"]) / max(int(x["batch"]), 1)
+                    for x in evs]
+            rungs.append({"F": F, "calls": len(evs),
+                          "occupancy_mean": round(sum(occs) / len(occs), 4),
+                          "occupancy_final": round(occs[-1], 4)})
+    out: dict = {"rungs": rungs}
+    if rebatch_ev:
+        out["escalations"] = [
+            {"from_F": e["from_F"], "to_F": e["to_F"],
+             "members": e["members"]} for e in rebatch_ev]
+    return out
+
+
+def _attribute_sharded(registry, plan, byte_floor) -> Optional[dict]:
+    """Interconnect share of the frontier-sharded search: the analytic
+    all_gather bytes vs the per-shard compute byte floor — how much of
+    the level's traffic is the exchange itself."""
+    ev = registry.events("wgl_sharded_chunk")
+    if not ev:
+        return None
+    floor = _byte_floor_fn(plan, byte_floor, sharded=True)
+    ag_total = 0
+    floor_total = 0
+    prev_level = 0
+    chunks = []
+    for e in ev:
+        lvl = int(e["level"])
+        levels = max(lvl - prev_level, 0)
+        prev_level = lvl
+        c = {"level": lvl, "F": int(e["F"]),
+             "n_shards": int(e["n_shards"]),
+             "wall_s": e.get("wall_s")}
+        ag = e.get("allgather_bytes")
+        if ag is not None:
+            ag_total += int(ag)
+            c["allgather_bytes"] = int(ag)
+        if floor is not None:
+            fb = int(floor(int(e["F"]))) * levels
+            floor_total += fb
+            c["bytes_floor"] = fb
+        chunks.append(c)
+    if not ag_total:
+        # Fall back to the run counter (events predating the per-chunk
+        # field still carry the total).
+        ag_total = int(registry.summary().get(
+            "wgl_allgather_bytes_total", 0))
+    out: dict = {"chunks": chunks[-60:],
+                 "interconnect": {"allgather_bytes_total": ag_total}}
+    if ag_total and floor_total:
+        out["interconnect"]["share_of_traffic"] = round(
+            ag_total / (ag_total + floor_total), 4)
+        out["interconnect"]["compute_bytes_floor_total"] = floor_total
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Opt-in on-device capture (--profile): jax.profiler trace + HBM marks
+
+
+@contextlib.contextmanager
+def trace_capture(outdir):
+    """Capture a ``jax.profiler`` trace into ``outdir`` for the body;
+    yields the directory, or None when the profiler is unavailable (no
+    jax, trace already running, unsupported backend). Never raises —
+    profiling must not take the run down."""
+    started = False
+    try:
+        import jax
+
+        os.makedirs(str(outdir), exist_ok=True)
+        jax.profiler.start_trace(str(outdir))
+        started = True
+    except Exception:
+        pass
+    try:
+        yield str(outdir) if started else None
+    finally:
+        if started:
+            try:
+                import jax
+
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+
+
+def memory_watermarks() -> list[dict]:
+    """Per-device ``memory_stats()`` snapshot (bytes_in_use /
+    peak_bytes_in_use watermarks where the backend reports them); empty
+    when jax or the stats are unavailable."""
+    try:
+        import jax
+
+        out = []
+        for d in jax.devices():
+            stats = None
+            try:
+                stats = d.memory_stats()
+            except Exception:
+                pass
+            if stats:
+                out.append({
+                    "device": str(d),
+                    **{k: int(v) for k, v in sorted(stats.items())
+                       if isinstance(v, (int, float))}})
+        return out
+    except Exception:
+        return []
+
+
+def store_profile(test: dict, registry: Optional[Registry] = None,
+                  plan=None, copy_bw_gbs: Optional[float] = None,
+                  extra: Optional[dict] = None) -> Optional[str]:
+    """Write ``profile.json`` (attribution + memory watermarks) into the
+    test's store directory next to metrics.jsonl; None when the test has
+    no store or no registry."""
+    reg = registry if registry is not None \
+        else test.get("telemetry-registry")
+    if reg is None:
+        return None
+    if not (test.get("name") and test.get("start-time")) or test.get(
+            "no-store?"):
+        return None
+    from .. import store
+
+    doc = {
+        "generated_at": _time.time(),
+        "attribution": attribute(reg, plan=plan, copy_bw_gbs=copy_bw_gbs),
+        "memory_watermarks": memory_watermarks(),
+    }
+    if extra:
+        doc.update(extra)
+    path = store.path_mk(test, "profile.json")
+    tmp = f"{path}.{os.getpid()}.tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, indent=1, sort_keys=True, default=str)
+    os.replace(tmp, path)
+    return str(path)

@@ -281,16 +281,22 @@ class Registry:
         return out
 
 
-def timed_phase(registry: Optional[Registry], phase: str):
+def timed_phase(registry: Optional[Registry], phase: str, recorder=None):
     """Context manager recording wall seconds of a run phase into
-    ``run_phase_seconds{phase=...}`` (no-op when registry is None)."""
+    ``run_phase_seconds{phase=...}`` (no-op when registry is None).
+    ``recorder``: an optional ``flight.FlightRecorder`` — the same phase
+    is entered in its ledger, so a crashed run's flightrecord.json names
+    the lifecycle phase that died."""
     from contextlib import contextmanager
+
+    from . import flight as _flight
 
     @contextmanager
     def _cm():
         t0 = _time.perf_counter()
         try:
-            yield
+            with _flight.phase(recorder, phase):
+                yield
         finally:
             if registry is not None:
                 registry.gauge(

@@ -52,8 +52,10 @@ td, th { border: 1px solid #ccc; padding: 0.3em 0.8em; text-align: left; }
 
 
 # Per-run artifacts the index row links to directly (the telemetry +
-# tracing sinks; everything else is reachable through the file listing).
-_TELEMETRY_FILES = ("metrics.jsonl", "metrics.prom", "spans.jsonl")
+# tracing + profiling sinks; everything else is reachable through the
+# file listing).
+_TELEMETRY_FILES = ("metrics.jsonl", "metrics.prom", "spans.jsonl",
+                    "profile.json", "flightrecord.json")
 
 
 def _index_page(root: Path) -> str:
@@ -81,7 +83,8 @@ def _index_page(root: Path) -> str:
     return (
         f"<html><head><title>Jepsen</title><style>{_STYLE}</style></head>"
         "<body><h1>Jepsen tests</h1>"
-        '<p><a href="/metrics">metrics</a></p><table>'
+        '<p><a href="/metrics">metrics</a> · '
+        '<a href="/profile">profile</a></p><table>'
         "<tr><th>Test</th><th>Started</th><th>Valid?</th>"
         "<th>Telemetry</th><th></th></tr>"
         + "".join(rows) + "</table></body></html>"
@@ -165,6 +168,114 @@ def _metrics_page(root: Path) -> str:
     )
 
 
+def _profile_rows(run_dir: Path) -> Optional[dict]:
+    f = run_dir / "profile.json"
+    if not f.exists():
+        return None
+    try:
+        return json.loads(f.read_text())
+    except Exception:
+        return {"error": "unparseable profile.json"}
+
+
+def _profile_section(doc: dict) -> str:
+    """Render one run's profile.json: the device attribution's rung
+    table + summary, batch occupancy, sharded interconnect share, and
+    memory watermarks."""
+    if doc.get("error"):
+        return f"<p>{html.escape(doc['error'])}</p>"
+    attr = doc.get("attribution") or {}
+    parts = []
+    dev = attr.get("device")
+    if dev:
+        s = dev.get("summary") or {}
+        head = " · ".join(
+            f"{k}: {v}" for k, v in sorted(s.items())
+            if not isinstance(v, dict))
+        bw = s.get("bound_wall_s") or {}
+        if bw:
+            head += " · wall by bound: " + ", ".join(
+                f"{k}={v}s" for k, v in sorted(bw.items()))
+        rows = "".join(
+            "<tr>" + "".join(
+                f"<td>{html.escape(str(r.get(k, '—')))}</td>"
+                for k in ("F", "chunks", "levels", "wall_s",
+                          "occupancy_mean", "achieved_gbs", "bound"))
+            + "</tr>"
+            for r in dev.get("rungs") or [])
+        parts.append(
+            f"<h3>Device search (roofline)</h3><p>{html.escape(head)}</p>"
+            "<table><tr><th>F</th><th>chunks</th><th>levels</th>"
+            "<th>wall s</th><th>occupancy</th><th>GB/s</th>"
+            "<th>bound</th></tr>" + rows + "</table>")
+    batch = attr.get("batch")
+    if batch:
+        rows = "".join(
+            "<tr>" + "".join(
+                f"<td>{html.escape(str(r.get(k, '—')))}</td>"
+                for k in ("F", "members", "calls", "wall_s", "decided",
+                          "overflowed", "occupancy_mean",
+                          "occupancy_final"))
+            + "</tr>"
+            for r in batch.get("rungs") or [])
+        parts.append(
+            "<h3>Batched escalation (why members escalated)</h3>"
+            "<table><tr><th>F</th><th>members</th><th>calls</th>"
+            "<th>wall s</th><th>decided</th><th>overflowed</th>"
+            "<th>occ mean</th><th>occ final</th></tr>"
+            + rows + "</table>")
+    sharded = attr.get("sharded")
+    if sharded:
+        ic = sharded.get("interconnect") or {}
+        parts.append(
+            "<h3>Frontier-sharded interconnect</h3><p>"
+            + html.escape(" · ".join(
+                f"{k}: {v}" for k, v in sorted(ic.items())))
+            + "</p>")
+    marks = doc.get("memory_watermarks") or []
+    if marks:
+        rows = "".join(
+            f"<tr><td>{html.escape(str(m.get('device')))}</td>"
+            f"<td>{m.get('bytes_in_use', '—')}</td>"
+            f"<td>{m.get('peak_bytes_in_use', '—')}</td></tr>"
+            for m in marks)
+        parts.append(
+            "<h3>Device memory watermarks</h3>"
+            "<table><tr><th>device</th><th>bytes in use</th>"
+            "<th>peak bytes</th></tr>" + rows + "</table>")
+    return "".join(parts) or "<p>(empty profile)</p>"
+
+
+def _profile_page(root: Path) -> str:
+    sections = []
+    tests = store.tests(root=root)
+    for name in sorted(tests):
+        for start in sorted(tests[name], reverse=True):
+            run = tests[name][start]
+            doc = _profile_rows(run)
+            if doc is None:
+                continue
+            links = " · ".join(
+                f'<a href="/files/{name}/{start}/{fn}">{fn}</a>'
+                for fn in ("profile.json", "flightrecord.json",
+                           "metrics.jsonl") if (run / fn).exists())
+            sections.append(
+                f'<h2><a href="/files/{name}/{start}/">'
+                f"{html.escape(name)} / {html.escape(start)}</a></h2>"
+                f"<p>{links}</p>" + _profile_section(doc))
+    if not sections:
+        sections.append(
+            "<p>No runs with profiles yet — run a test with "
+            "<code>--profile</code>.</p>")
+    return (
+        f"<html><head><title>Jepsen profiles</title>"
+        f"<style>{_STYLE}</style></head>"
+        '<body><h1>Performance attribution</h1>'
+        '<p><a href="/">index</a> · <a href="/metrics">metrics</a></p>'
+        + "".join(sections) + "</body></html>"
+    )
+
+
 def _listing_page(rel: str, d: Path) -> str:
     items = "".join(
         f'<li><a href="/files/{rel}{f.name}{"/" if f.is_dir() else ""}">'
@@ -198,6 +309,9 @@ def make_handler(root: Path):
                     return
                 if path in ("/metrics", "/metrics/"):
                     self._send(200, _metrics_page(root).encode())
+                    return
+                if path in ("/profile", "/profile/"):
+                    self._send(200, _profile_page(root).encode())
                     return
                 if path.startswith("/zip/"):
                     rel = path[len("/zip/"):].strip("/")

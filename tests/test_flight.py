@@ -1,0 +1,198 @@
+"""Flight recorder: bounded ring, phase deadlines, offending-phase
+diagnosis, budget-breach/exception flushes, and the zero-cost disabled
+path."""
+
+from __future__ import annotations
+
+import json
+import time
+
+import pytest
+
+from jepsen_tpu.telemetry import FlightRecorder, flight
+
+
+class TestRing:
+    def test_note_ring_bounded(self):
+        rec = FlightRecorder(max_events=5)
+        for i in range(12):
+            rec.note("tick", i=i)
+        snap = rec.snapshot()
+        assert len(snap["events"]) == 5
+        assert [e["i"] for e in snap["events"]] == list(range(7, 12))
+
+    def test_events_carry_relative_time(self):
+        rec = FlightRecorder()
+        rec.note("x")
+        (e,) = rec.snapshot()["events"]
+        assert e["t"] >= 0
+
+
+class TestPhases:
+    def test_phase_ledger_and_walls(self):
+        rec = FlightRecorder()
+        with rec.phase("a"):
+            pass
+        with rec.phase("b"):
+            pass
+        snap = rec.snapshot()
+        names = [p["phase"] for p in snap["phases"]]
+        assert names == ["a", "b"]
+        assert all("wall_s" in p for p in snap["phases"])
+
+    def test_deadline_overshoot_named(self):
+        rec = FlightRecorder()
+        with rec.phase("fast", deadline_s=100):
+            pass
+        with rec.phase("slow", deadline_s=0.0):
+            time.sleep(0.01)
+        assert rec.offending_phase() == "slow"
+        slow = rec.snapshot()["phases"][1]
+        assert slow["overshoot_s"] > 0
+
+    def test_exception_records_error_and_reraises(self):
+        rec = FlightRecorder()
+        with pytest.raises(ValueError):
+            with rec.phase("doomed"):
+                raise ValueError("boom")
+        ph = rec.snapshot()["phases"][0]
+        assert ph["error"].startswith("ValueError")
+        assert rec.offending_phase() == "doomed"
+
+    def test_sequential_begin_end(self):
+        rec = FlightRecorder()
+        rec.begin("one")
+        rec.begin("two")  # implicitly ends "one"
+        rec.end()
+        snap = rec.snapshot()
+        assert [p["phase"] for p in snap["phases"]] == ["one", "two"]
+        assert all("end_s" in p for p in snap["phases"])
+
+
+class TestBudget:
+    def test_budget_breach_names_spanning_phase(self):
+        rec = FlightRecorder(budget_s=0.005)
+        with rec.phase("innocent"):
+            pass
+        with rec.phase("culprit"):
+            time.sleep(0.02)  # crosses the budget inside this phase
+        with rec.phase("after"):
+            pass
+        assert rec.breached()
+        assert rec.offending_phase() == "culprit"
+        snap = rec.snapshot()
+        assert snap["reason"] == "budget_breach"
+        assert snap["budget_breached"] is True
+        assert snap["offending_phase"] == "culprit"
+
+    def test_open_phase_blamed_when_budget_unset(self):
+        rec = FlightRecorder()
+        cm = rec.phase("running")
+        cm.__enter__()
+        assert rec.offending_phase() == "running"
+        cm.__exit__(None, None, None)
+
+    def test_longest_phase_is_fallback(self):
+        rec = FlightRecorder()
+        with rec.phase("short"):
+            pass
+        with rec.phase("long"):
+            time.sleep(0.01)
+        assert rec.offending_phase() == "long"
+
+
+class TestFlush:
+    def test_flush_writes_json_atomically(self, tmp_path):
+        rec = FlightRecorder(budget_s=0.0)
+        with rec.phase("leg"):
+            time.sleep(0.002)
+        p = tmp_path / "flightrecord.json"
+        out = rec.flush(p, registry=None)
+        assert out == str(p)
+        doc = json.loads(p.read_text())
+        assert doc["reason"] == "budget_breach"
+        assert doc["offending_phase"] == "leg"
+        assert doc["phases"][0]["phase"] == "leg"
+        assert not list(tmp_path.glob("*.tmp"))
+
+    def test_flush_includes_registry_tail(self, tmp_path):
+        from jepsen_tpu.telemetry import Registry
+
+        reg = Registry()
+        for i in range(150):
+            reg.event("wgl_level", level=i)
+        rec = FlightRecorder()
+        p = tmp_path / "fr.json"
+        rec.flush(p, reason="exception", registry=reg)
+        doc = json.loads(p.read_text())
+        assert doc["reason"] == "exception"
+        assert len(doc["registry_tail"]) == 100
+        assert doc["registry_tail"][-1]["level"] == 149
+
+    def test_flush_never_raises(self):
+        rec = FlightRecorder()
+        # Unwritable path: flush must swallow, not crash the incident.
+        rec.flush("/nonexistent-dir-xyz/fr.json")
+
+
+class TestDisabledPath:
+    def test_none_recorder_is_shared_noop(self):
+        """Zero per-call allocations when disabled: every phase() on a
+        None recorder returns the SAME no-op context manager."""
+        cm1 = flight.phase(None, "a")
+        cm2 = flight.phase(None, "b", deadline_s=5)
+        assert cm1 is cm2 is flight._NOOP_CM
+        with cm1:
+            pass
+
+    def test_timed_phase_without_recorder(self):
+        from jepsen_tpu.telemetry import Registry, timed_phase
+
+        reg = Registry()
+        with timed_phase(reg, "analyze", recorder=None):
+            pass
+        assert any(s["name"] == "run_phase_seconds"
+                   for s in reg.collect())
+
+
+class TestStoreIntegration:
+    def test_store_flight_record(self, tmp_path):
+        from jepsen_tpu.telemetry import store_flight_record
+
+        test = {"name": "t", "start-time": "20260803T000000",
+                "store-root": str(tmp_path)}
+        rec = FlightRecorder()
+        with rec.phase("analyze"):
+            pass
+        p = store_flight_record(test, rec, reason="exception")
+        doc = json.loads(open(p).read())
+        assert doc["reason"] == "exception"
+        assert str(tmp_path) in p
+
+    def test_no_store_returns_none(self):
+        from jepsen_tpu.telemetry import store_flight_record
+
+        assert store_flight_record({}, FlightRecorder()) is None
+
+
+class TestBenchWatchdogContract:
+    """The acceptance shape: a forced budget breach produces a
+    flightrecord.json naming the offending phase — exercised on the
+    recorder exactly as bench.py drives it (sequential begin() legs, a
+    blown budget, flush at the end)."""
+
+    def test_forced_breach_names_offending_leg(self, tmp_path):
+        rec = FlightRecorder(budget_s=0.01)
+        for leg in ("generate", "headline_native", "device_kernel"):
+            rec.begin(leg)
+            if leg == "device_kernel":
+                time.sleep(0.03)  # the leg that blows the budget
+        rec.end()
+        assert rec.breached()
+        p = tmp_path / "flightrecord.json"
+        rec.flush(p, reason="budget_breach")
+        doc = json.loads(p.read_text())
+        assert doc["reason"] == "budget_breach"
+        assert doc["offending_phase"] == "device_kernel"
+        assert [x["phase"] for x in doc["phases"]] == [
+            "generate", "headline_native", "device_kernel"]

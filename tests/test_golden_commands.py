@@ -98,6 +98,50 @@ class TestGoldenLifecycles:
         log = _lifecycle(TidbDB(), base_test)
         _assert_golden("tidb_lifecycle", _normalize(log))
 
+    # Beyond the big four: the remaining high-traffic lifecycles, locked
+    # the same way (archive installs, apt installs, config renders,
+    # daemon spawns, teardown).
+
+    def test_etcd(self, base_test):
+        from jepsen_tpu.suites.etcd import EtcdDB
+
+        log = _lifecycle(EtcdDB(), base_test)
+        _assert_golden("etcd_lifecycle", _normalize(log))
+
+    def test_redis(self, base_test):
+        from jepsen_tpu.suites.redis import RedisDB
+
+        log = _lifecycle(RedisDB(), base_test)
+        _assert_golden("redis_lifecycle", _normalize(log))
+
+    def test_zookeeper(self, base_test):
+        from jepsen_tpu.suites.zookeeper import ZookeeperDB
+
+        log = _lifecycle(ZookeeperDB(), base_test)
+        _assert_golden("zookeeper_lifecycle", _normalize(log))
+
+    def test_mongodb(self, base_test):
+        from jepsen_tpu.suites.mongodb import MongoDB
+
+        log = _lifecycle(MongoDB(), base_test)
+        _assert_golden("mongodb_lifecycle", _normalize(log))
+
+    def test_aerospike_bridge_install(self, base_test):
+        """The one bridge-install stream: aerospike's setup uploads the
+        node-side as_bridge.py and spawns it as a daemon next to the
+        server — the upload + spawn wire contract the bridge clients
+        ride."""
+        from jepsen_tpu.suites.aerospike import AerospikeDB
+
+        log = _lifecycle(AerospikeDB(), base_test)
+        text = _normalize(log)
+        _assert_golden("aerospike_lifecycle", text)
+        # Belt and braces beyond the byte lock: the stream must carry
+        # the bridge upload and its daemon spawn.
+        assert "as_bridge.py -> /opt/aerospike-bridge/as_bridge.py" \
+            in text
+        assert "as_bridge.py --port" in text
+
 
 class TestGoldenWorkloadSlices:
     """One flagship-workload slice per command-stream suite: client open

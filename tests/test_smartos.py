@@ -1,0 +1,107 @@
+"""SmartOS provisioning: hostname/hostfile setup + the pkgin/pkgsrc
+bootstrap flow (smartos.clj:13-60), asserted against the dummy remote's
+command stream — a bare zone bootstraps pkgsrc and installs the base
+packages; an already-provisioned zone touches nothing it doesn't have
+to."""
+
+from __future__ import annotations
+
+import pytest
+
+from jepsen_tpu import control as c
+from jepsen_tpu.os_ import smartos
+from jepsen_tpu.workloads import noop_test
+
+
+def _fail(host, action):
+    """Response callable simulating a nonzero exit (grep miss, missing
+    binary, uninstalled package)."""
+    raise c.RemoteError({"cmd": action["cmd"], "host": host,
+                         "exit": 1, "out": "", "err": ""})
+
+
+def _setup(responses, test_extra=None):
+    test = dict(noop_test())
+    test.update(nodes=["n1"])
+    if test_extra:
+        test.update(test_extra)
+    log: list = []
+    c.setup_sessions(test, c.dummy(log, responses=responses))
+    osys = smartos.os()
+    c.on_nodes(test, lambda t, n: osys.setup(t, n), ["n1"])
+    return [cmd for _host, cmd in log]
+
+
+class TestSmartOSSetup:
+    def test_bare_zone_bootstraps_pkgsrc(self):
+        """No pkgin, nothing resolves, nothing installed: the full
+        provisioning stream — hostname pin, hostfile append, pkgsrc
+        bootstrap tarball, install of every base package."""
+        cmds = _setup({
+            r"which pkgin": _fail,
+            r"grep": _fail,
+            r"pkg_info": _fail,
+            r"hostname$": "n1",
+        })
+        stream = "\n".join(cmds)
+        assert any("hostname n1" in x for x in cmds)
+        assert any("/etc/nodename" in x for x in cmds)
+        assert "127.0.0.1 n1 >> /etc/hosts" in stream
+        # Bootstrap: fetch tarball over /, rebuild pkg db, update repo.
+        boot = [x for x in cmds if "bootstrap-2021Q4" in x]
+        assert boot and "gtar -zxpf - -C /" in boot[0] \
+            and "pkg_admin rebuild" in boot[0]
+        inst = [x for x in cmds if "pkgin -y install" in x]
+        assert len(inst) == 1
+        for pkg in ("curl", "wget", "unzip", "gtar", "rsync"):
+            assert pkg in inst[0]
+        # Ordering: hostfile before bootstrap before install.
+        assert stream.index("/etc/hosts") < stream.index("bootstrap-2021Q4") \
+            < stream.index("pkgin -y install")
+
+    def test_provisioned_zone_is_idempotent(self):
+        """pkgin present, hostname resolves, packages installed: no
+        bootstrap, no install, no hostfile append."""
+        cmds = _setup({
+            r"pkg_info": "pkg-1.0",
+            r"hostname$": "n1",
+        })
+        stream = "\n".join(cmds)
+        assert "bootstrap" not in stream
+        assert "pkgin -y install" not in stream
+        assert ">> /etc/hosts" not in stream
+        # The probes themselves still ran.
+        assert any("which pkgin" in x for x in cmds)
+        assert any("pkg_info" in x for x in cmds)
+
+    def test_hostfile_adds_unresolvable_peers(self):
+        """Peers with addresses in test["node-ips"] get hostfile lines
+        when grep says they don't resolve."""
+        cmds = _setup(
+            {r"grep": _fail, r"pkg_info": "ok", r"^hostname$": "n1"},
+            test_extra={"node-ips": {"n2": "10.0.0.2", "n3": "10.0.0.3"}})
+        stream = "\n".join(cmds)
+        assert "10.0.0.2 n2 >> /etc/hosts" in stream
+        assert "10.0.0.3 n3 >> /etc/hosts" in stream
+
+    def test_install_only_missing_packages(self):
+        """pkg_info hits for some packages: only the missing ones are
+        handed to pkgin."""
+        def pkg_info(host, action):
+            if "curl" in action["cmd"] or "wget" in action["cmd"]:
+                return "ok"
+            raise c.RemoteError({"cmd": action["cmd"], "host": host,
+                                 "exit": 1, "out": "", "err": ""})
+
+        cmds = _setup({
+            r"pkg_info": pkg_info,
+            r"hostname$": "n1",
+        })
+        inst = [x for x in cmds if "pkgin -y install" in x]
+        assert len(inst) == 1
+        assert "curl" not in inst[0] and "wget" not in inst[0]
+        for pkg in ("unzip", "gtar", "rsync"):
+            assert pkg in inst[0]
+
+    def test_repr(self):
+        assert repr(smartos.os()) == "<os.smartos>"
