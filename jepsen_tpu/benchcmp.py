@@ -61,8 +61,18 @@ METRICS: list[tuple[str, str, str]] = [
     ("max_verified_ops_device", "max_verified_ops_device.ops", "higher"),
     ("max_verified_ops_device_sharded",
      "max_verified_ops_device_sharded.ops", "higher"),
+    ("smoke_8x10k_decided",
+     "batch_replay_large.smoke_8x10k.decided", "higher"),
     ("bench_wall_s", "bench_wall_s", "info"),
     ("multichip_ok", "multichip_ok", "higher"),
+    # Owner-partitioned frontier exchange (ISSUE 4): the analytic
+    # per-device per-level exchange bytes of the sharded search on the
+    # multichip mesh — seconds-like direction (more interconnect bytes
+    # per level is a regression); the drop factor vs the replicated
+    # all_gather model is scale-like (it should ride mesh size).
+    ("multichip_exchange_bytes_per_level",
+     "exchange_bytes_per_level.alltoall", "lower"),
+    ("multichip_exchange_drop_x", "exchange_drop_x", "higher"),
 ]
 
 DEFAULT_THRESHOLD = 0.10
@@ -154,6 +164,15 @@ def load_round(path: str) -> dict:
         inner = raw.get("parsed")
         if isinstance(inner, dict):
             data.update(inner)
+        elif isinstance(raw.get("tail"), str):
+            # dryrun_multichip prints one machine-readable JSON line
+            # (exchange byte model, mode agreement) amid the backend's
+            # log noise — the newest one wins.
+            for line in raw["tail"].splitlines():
+                d = _parse_json_line(line)
+                if d is not None and ("multichip" in d
+                                      or "exchange_bytes_per_level" in d):
+                    data.update(d)
     elif isinstance(raw, dict) and ("parsed" in raw or "tail" in raw):
         inner = raw.get("parsed")
         if isinstance(inner, dict):

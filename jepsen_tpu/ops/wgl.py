@@ -141,7 +141,8 @@ def _enable_compile_cache() -> None:
 def _build_kernel(model_key, F: int, W: int, KO: int, S: int, ND: int, NO: int,
                   axis_name: Optional[str] = None, n_shards: int = 1,
                   B: Optional[int] = None, wintab_ok: bool = True,
-                  collect_stats: bool = False, donate: bool = False):
+                  collect_stats: bool = False, donate: bool = False,
+                  exchange: str = "alltoall"):
     """Returns a jitted BFS driver with static shapes.
 
     ``donate``: jit with the five frontier buffers donated
@@ -168,14 +169,45 @@ def _build_kernel(model_key, F: int, W: int, KO: int, S: int, ND: int, NO: int,
     ``axis_name``/``n_shards``: frontier-sharded mode (the framework's
     sequence-parallelism axis — SURVEY §5's "shard the frontier across
     chips"). F becomes the PER-DEVICE capacity of a mesh axis named
-    ``axis_name`` with ``n_shards`` devices: each device expands and
-    locally compacts its frontier shard, an ``all_gather`` over ICI
-    exchanges the compacted candidates, the global dedup/dominance/
-    compaction runs replicated (identical inputs ⇒ identical results —
-    no divergence), and each device keeps its slice of the global
-    order. Verdict semantics are exactly the single-device kernel's at
-    capacity F×n_shards. Must be invoked under ``shard_map`` with the
-    frontier args sharded on axis 0 and everything else replicated.
+    ``axis_name`` with ``n_shards`` devices; each device expands and
+    locally compacts its frontier shard, then exchanges candidates per
+    ``exchange``:
+
+    - ``"alltoall"`` (default) — OWNER-PARTITIONED exchange: every
+      candidate is routed to the shard owning its dedup-hash range
+      (``owner = group_hash % n_shards`` — the same fused hash the
+      dedup sort keys on, so all duplicates/dominance-group members of
+      a config land on ONE shard), shipped in fixed ``ceil(P/D)``-row
+      per-destination buckets by ONE ``lax.all_to_all``; each shard
+      dedups/dominance-compacts ONLY its disjoint hash range and keeps
+      up to F of its owned rows. Exchange bytes per level are
+      ``~P*(NC+1)*4`` (each row crosses ICI once) instead of the
+      all_gather's ``D*P*(NC+1)*4``, the dedup sort shrinks D× per
+      device, and the global capacity F×n_shards genuinely scales with
+      the mesh. A shard whose owned range overflows F (or a routing
+      bucket that overflows) raises the LOSSLESS overflow flag — the
+      driver escalates exactly as for a global overflow, so verdicts
+      are unchanged.
+    - ``"allgather"`` — the legacy replicated exchange (the
+      differential oracle, kept behind ``JEPSEN_WGL_EXCHANGE=
+      allgather``): one tiled ``all_gather`` ships every shard's
+      compacted candidates everywhere, the global dedup/dominance/
+      compaction runs replicated (identical inputs ⇒ identical
+      results), and each device keeps its slice of the global order.
+
+    Verdict semantics in both modes are exactly the single-device
+    kernel's at capacity F×n_shards: the partitioned mode may escalate
+    earlier under shard imbalance, and escalation is lossless, so any
+    DEFINITE verdict (and its level) is identical across modes — but a
+    skew-triggered escalation does consume the driver's finite
+    ``max_escalations`` budget, so at the schedule's very end the
+    partitioned mode can report "unknown" where the replicated mode
+    still decides (never a conflicting verdict). Must be invoked under
+    ``shard_map`` with the frontier args sharded on axis 0 and
+    everything else replicated. Sharded kernels return an 8-entry
+    packed flags vector (the two extra entries are the per-shard
+    max/min live-config counts — true occupancy for the imbalance
+    telemetry).
 
     ``B``: per-config candidate cap (static). A config's determinate
     candidates are pairwise concurrent — for candidates j≠k,
@@ -202,6 +234,7 @@ def _build_kernel(model_key, F: int, W: int, KO: int, S: int, ND: int, NO: int,
 
     assert not (collect_stats and axis_name is not None), \
         "per-level stats collection is single-device only"
+    assert exchange in ("alltoall", "allgather"), exchange
     if os.environ.get("JEPSEN_WGL_NO_DONATE"):
         donate = False  # operational kill-switch for buffer donation
     _enable_compile_cache()
@@ -513,17 +546,68 @@ def _build_kernel(model_key, F: int, W: int, KO: int, S: int, ND: int, NO: int,
                 ocols = [pmat[:, 1 + KD + S + w] for w in range(len(ocols))]
                 nvalid = lax.iota(jnp.int32, P) < jnp.minimum(n_cand, P)
                 L = P
-                if axis_name is not None:
-                    # Frontier-parallel exchange: ship each shard's
-                    # compacted candidates to every device (ONE tiled
-                    # all_gather of a packed [P, NC+1] matrix); the
-                    # global dedup below then runs replicated.
-                    # pmat's columns are already (pcol, dcols, scols,
-                    # ocols) in order — prepend validity and ship.
+                if axis_name is not None and exchange == "alltoall":
+                    # OWNER-PARTITIONED exchange: route each candidate
+                    # to the shard owning its dedup-hash range. The
+                    # owner hash is the SAME FNV over the group-identity
+                    # columns (p, maskD, state — never the open masks)
+                    # the dedup sort keys on, so every member of a
+                    # dedup/dominance group lands on one shard and the
+                    # per-shard dedup below is globally exact over
+                    # disjoint hash ranges — no replicated sort.
+                    ghl = jnp.full((P,), u32(2166136261))
+                    for c in [pcol] + dcols + scols:
+                        ghl = (ghl ^ c) * u32(16777619)
+                    owner = ghl % u32(n_shards)
+                    # Fixed-size per-destination buckets (ceil(P/D)
+                    # rows each): one 2-operand (owner-key, iota) sort
+                    # groups rows by destination, per-destination
+                    # counts place them at static bucket offsets, ONE
+                    # row gather assembles the send matrix. A bucket
+                    # overflow (hash imbalance beyond the ceil(P/D)
+                    # slack) raises the LOSSLESS overflow flag — folded
+                    # into the ordinary escalate path, so verdicts stay
+                    # sound.
+                    okey = jnp.where(nvalid, owner, u32(n_shards))
+                    osort = lax.sort((okey, lax.iota(u32, P)),
+                                     dimension=0, num_keys=2)
+                    sidx = osort[1].astype(jnp.int32)
+                    dsts = jnp.arange(n_shards, dtype=jnp.uint32)
+                    cnt = jnp.sum(
+                        (nvalid[:, None]
+                         & (owner[:, None] == dsts[None, :])
+                         ).astype(jnp.int32), axis=0)  # [D]
+                    off = jnp.concatenate(
+                        [jnp.zeros((1,), jnp.int32),
+                         jnp.cumsum(cnt)[:-1]])
+                    PBK = -(-P // n_shards)  # bucket rows/destination
+                    pre_ovf = pre_ovf | jnp.any(cnt > PBK)
+                    slot = lax.iota(jnp.int32, n_shards * PBK)
+                    d_of = slot // PBK
+                    j_of = slot % PBK
+                    bvalid = j_of < cnt[d_of]
+                    bsrc = sidx[jnp.minimum(off[d_of] + j_of, P - 1)]
+                    bmat = jnp.concatenate(
+                        [(~bvalid).astype(u32)[:, None], pmat[bsrc]],
+                        axis=1)  # [D*PBK, NC+1]
+                    gmat = lax.all_to_all(
+                        bmat, axis_name, split_axis=0, concat_axis=0,
+                        tiled=True)  # [D*PBK, .] — all owned by me
+                    L = n_shards * PBK
+                elif axis_name is not None:
+                    # Legacy replicated exchange (the differential
+                    # oracle): ship each shard's compacted candidates
+                    # to every device (ONE tiled all_gather of a packed
+                    # [P, NC+1] matrix); the global dedup below then
+                    # runs replicated. pmat's columns are already
+                    # (pcol, dcols, scols, ocols) in order — prepend
+                    # validity and ship.
                     gmat = lax.all_gather(
                         jnp.concatenate(
                             [(~nvalid).astype(u32)[:, None], pmat], axis=1),
                         axis_name, axis=0, tiled=True)  # [n_shards*P, .]
+                    L = n_shards * P
+                if axis_name is not None:
                     kvalid0 = gmat[:, 0]
                     pcol = gmat[:, 1]
                     dcols = [gmat[:, 2 + w] for w in range(KD)]
@@ -533,10 +617,12 @@ def _build_kernel(model_key, F: int, W: int, KO: int, S: int, ND: int, NO: int,
                     nvalid = kvalid0 == u32(0)
                     pre_ovf = lax.pmax(pre_ovf.astype(jnp.int32),
                                        axis_name) > 0
-                    L = n_shards * P
             # Group hash on the L compacted rows (not the M-row
-            # expansion); on the sharded path this runs replicated
-            # post-exchange, so every device computes identical hashes.
+            # expansion); on the allgather path this runs replicated
+            # post-exchange (every device computes identical hashes),
+            # on the alltoall path it re-derives the routing hash from
+            # the shipped real columns (deterministic — shipping the
+            # hash would cost an extra exchange column for nothing).
             gh = jnp.full((L,), u32(2166136261))
             for c in [pcol] + dcols + scols:
                 gh = (gh ^ c) * u32(16777619)
@@ -606,8 +692,22 @@ def _build_kernel(model_key, F: int, W: int, KO: int, S: int, ND: int, NO: int,
             # (The done-flag propagation stops at is_start rows, so
             # head[i] always comes from row i's own segment.)
             keep = svalid & ~(same_group & prev_sub) & ~head_sub
-            count = jnp.sum(keep.astype(jnp.int32))
-            ovf_now = pre_ovf | (count > FT)
+            if axis_name is not None and exchange == "alltoall":
+                # Partitioned capacity: each shard holds ONLY its owned
+                # hash range, so the overflow condition is per-shard
+                # (count_local > F). Pigeonhole makes it subsume the
+                # global one: global count > F*D implies some shard's
+                # owned count > F. A shard overflowing while the global
+                # count still fits FT is imbalance — the lossless
+                # escalation resolves it at 4x, so verdicts/levels are
+                # unchanged vs the replicated mode.
+                count_local = jnp.sum(keep.astype(jnp.int32))
+                count = lax.psum(count_local, axis_name)
+                ovf_now = pre_ovf | (lax.pmax(
+                    (count_local > F).astype(jnp.int32), axis_name) > 0)
+            else:
+                count = jnp.sum(keep.astype(jnp.int32))
+                ovf_now = pre_ovf | (count > FT)
 
             # Compaction: bring kept rows to the front, most-advanced
             # (largest p) first and fewest-opens-used next — so beam-mode
@@ -638,7 +738,15 @@ def _build_kernel(model_key, F: int, W: int, KO: int, S: int, ND: int, NO: int,
             order = comp[1]
             rowmat = jnp.stack(
                 [spcol] + sdcols + socols + sscols, axis=1)  # [L, NC]
-            if axis_name is not None:
+            if axis_name is not None and exchange == "alltoall":
+                # Each shard keeps its own (disjoint) owned slice — no
+                # global order exists or is needed; count_local <= F
+                # here whenever the level survives (overflow restores
+                # the pre-expansion frontier).
+                kvalid = lax.iota(jnp.int32, F) < jnp.minimum(
+                    count_local, F)
+                ordF = lax.slice_in_dim(order, 0, F, axis=0)
+            elif axis_name is not None:
                 # Each device keeps its slice of the global order.
                 shard0 = lax.axis_index(axis_name).astype(jnp.int32) * F
                 kvalid = (lax.iota(jnp.int32, F) + shard0) < jnp.minimum(
@@ -770,7 +878,12 @@ def _build_kernel(model_key, F: int, W: int, KO: int, S: int, ND: int, NO: int,
             # slice of the global order is empty would otherwise report a
             # locally empty frontier as a global refutation. (``stuck``
             # is computed from the replicated global keep-count, so it
-            # needs no collective.)
+            # needs no collective.) The per-shard max/min live counts
+            # ride the flags vector too: TRUE per-shard occupancy for
+            # the imbalance telemetry (the old gauge reported
+            # count / n_shards, a mean that hid all skew).
+            cnt_max = lax.pmax(count, axis_name)
+            cnt_min = lax.pmin(count, axis_name)
             nonempty = lax.pmax(nonempty.astype(jnp.int32), axis_name) > 0
             count = lax.psum(count, axis_name)
         # The frontier no longer empties on a dead end (it holds the
@@ -781,10 +894,13 @@ def _build_kernel(model_key, F: int, W: int, KO: int, S: int, ND: int, NO: int,
         # array per chunk (each separate device->host read pays a full
         # relay round trip — unpacked flags cost ~1 s/chunk on a
         # tunneled TPU, more than the chunk's compute).
-        flags = jnp.stack([
+        flag_list = [
             acc.astype(jnp.int32), ovf.astype(jnp.int32),
             nonempty.astype(jnp.int32), lvl, fmax, count,
-        ])
+        ]
+        if axis_name is not None:
+            flag_list += [cnt_max, cnt_min]
+        flags = jnp.stack(flag_list)
         if donate and jax.default_backend() == "cpu":
             # PER-PROCESS HLO salt: on the CPU backend, donated
             # executables must never be served from the persistent
@@ -806,7 +922,8 @@ def _build_kernel(model_key, F: int, W: int, KO: int, S: int, ND: int, NO: int,
             # compiles per bucket per bench round would dwarf the
             # donation win; JEPSEN_WGL_NO_DONATE=1 remains the escape
             # hatch if an accelerator shows the same race.
-            salt = jnp.full((6,), os.getpid() & 0x7FFFFFFF, jnp.int32)
+            salt = jnp.full(flags.shape, os.getpid() & 0x7FFFFFFF,
+                            jnp.int32)
             flags = (flags + salt) - salt
         if collect_stats:
             # Stats ride between flags and the frontier: the resumable
@@ -1137,8 +1254,25 @@ OPTIMISTIC_MIN_OPS = 1500
 OPTIMISTIC_BEAM_F = 4096
 
 
+def _stage1_shape(plan: DevicePlan, F: int) -> tuple:
+    """(M, P, NC) of one level at capacity ``F`` — the expansion size,
+    the stage-1 survivor-buffer rows and the packed candidate column
+    count, mirroring the kernel's static arithmetic. The ONE place the
+    byte models (``level_byte_floor``, ``exchange_bytes_per_level``)
+    read these from, so they cannot drift apart."""
+    W, KO, S, _ND, _NO = plan.dims
+    KD = W // 32
+    C = W + KO * 32
+    SEL = plan.B is not None and plan.B < C
+    M = F * (plan.B if SEL else C)
+    P = min(M, max(STAGE1_P_MULT * F, 64))
+    NC = 1 + KD + S + max(KO, 1)
+    return M, P, NC
+
+
 def level_byte_floor(plan: DevicePlan, F: int, batch: bool = False,
-                     sharded: bool = False) -> int:
+                     sharded: bool = False,
+                     exchange: str = "allgather") -> int:
     """Single-pass HBM byte floor of one BFS level at capacity ``F``:
     every major tensor stream counted once in and once out, enumerated
     from the kernel's static shapes. A LOWER bound on real traffic —
@@ -1155,24 +1289,28 @@ def level_byte_floor(plan: DevicePlan, F: int, batch: bool = False,
     this predicate honest against the kernel's rather than to change
     the arithmetic. ``sharded``: per-shard floor of the frontier-sharded
     kernel, which takes the two-stage path at EVERY M (its ``axis_name``
-    trigger) and re-keys the dedup over the n_shards×P exchanged rows —
-    counted here at the local P only, and excluding the all_gather
-    itself (tracked analytically by the sharded driver), so it stays a
-    per-device lower bound."""
+    trigger) and re-keys the dedup over the exchanged rows — counted
+    here at the local P only, and excluding the exchange collective
+    itself (tracked analytically by the sharded driver via
+    ``exchange_bytes_per_level``), so it stays a per-device lower
+    bound. ``exchange``: with ``sharded`` and ``"alltoall"``, adds the
+    partitioned mode's extra local stages (the 2-operand owner-routing
+    sort + the bucket-assembly row gather); the dedup itself runs over
+    ~P owned rows either way (the allgather mode's replicated D×P sort
+    is deliberately NOT counted — the floor is per-device work the
+    partitioning cannot remove)."""
     W, KO, S, ND, NO = plan.dims
     KD = W // 32
     KO1 = max(KO, 1)
     C = W + KO * 32
     SEL = plan.B is not None and plan.B < C
-    B = plan.B if SEL else C
-    M = F * B
-    NC = 1 + KD + S + KO1
+    M, P1, NC = _stage1_shape(plan, F)
     esz = 2 if plan.tab16 else 4
     # Mirrors the kernel's trigger exactly: ``axis_name is not None or
     # M > BIG_M_THRESHOLD`` — the batch kernel has no axis_name, so its
     # predicate matches the single-device one.
     two_stage = sharded or M > BIG_M_THRESHOLD
-    P = min(M, max(STAGE1_P_MULT * F, 64)) if two_stage else M
+    P = P1 if two_stage else M
     total = 0
     total += 2 * F * W * 8 * esz            # window-table row gather
     if SEL:
@@ -1186,7 +1324,37 @@ def level_byte_floor(plan: DevicePlan, F: int, batch: bool = False,
     total += 2 * (1 + NC) * P * 4           # fused-key dedup sort
     total += 2 * 2 * P * 4                  # fused-key compaction sort
     total += 2 * F * NC * 4                 # top-F row gather
+    if sharded and exchange == "alltoall":
+        total += 2 * 2 * P * 4              # owner-routing (key, iota) sort
+        total += 2 * P * NC * 4             # bucket-assembly row gather
     return total
+
+
+def exchange_bytes_per_level(plan: DevicePlan, F: int, n_shards: int,
+                             exchange: str = "alltoall") -> int:
+    """Analytic per-device byte volume of ONE BFS level's candidate
+    exchange in the frontier-sharded kernel — the mode-aware model the
+    sharded driver records per chunk (``exchange_bytes`` on
+    ``wgl_sharded_chunk``) and telemetry.profile prices against the
+    compute byte floor.
+
+    ``F`` is the PER-DEVICE capacity. The exchanged row is the packed
+    ``[*, NC+1]`` u32 matrix (validity column + the NC candidate
+    columns):
+
+    - ``"allgather"`` — every shard ships its full [P, NC+1] stage-1
+      survivor matrix to every other shard: ``n_shards*P*(NC+1)*4``
+      bytes per device per level (O(D) in the mesh).
+    - ``"alltoall"`` — each row is hash-routed to its owner shard once:
+      ``n_shards`` fixed buckets of ``ceil(P/n_shards)`` rows, i.e.
+      ``~P*(NC+1)*4`` bytes per device per level (mesh-size
+      independent; one bucket stays local, counted anyway to keep the
+      model a simple upper envelope of the wire traffic)."""
+    _M, P, NC = _stage1_shape(plan, F)
+    if exchange == "allgather":
+        return n_shards * P * (NC + 1) * 4
+    Pb = -(-P // n_shards)
+    return n_shards * Pb * (NC + 1) * 4
 
 
 def _enc_fingerprint(enc: EncodedHistory, plan: DevicePlan) -> str:

@@ -12,20 +12,33 @@ ICI.
 
 Mechanics (see the ``axis_name`` notes on ``wgl._build_kernel``): each
 device expands its F-local configs and compacts them with the cheap
-fused-key sort; ONE tiled ``all_gather`` exchanges compacted candidate
-matrices; the global dedup/dominance/compaction then runs replicated
-(identical inputs on every device ⇒ identical results, no divergence);
-each device keeps its slice of the global order. Verdicts are exactly
-the single-device kernel's at capacity ``f_total``.
+fused-key sort; then ONE collective per level exchanges candidates. In
+the default OWNER-PARTITIONED mode (``exchange="alltoall"``) each
+candidate is hash-routed to the shard that owns its dedup-hash range
+(``owner = group_hash % D``) in fixed per-destination buckets over one
+``lax.all_to_all``, and each shard dedups/dominance-compacts ONLY its
+disjoint range — per-level exchange bytes are ``~P*(NC+1)*4`` (each
+row crosses ICI once) and the dedup sort is D× smaller per device, so
+global capacity genuinely scales with the mesh. The legacy replicated
+mode (``exchange="allgather"``, also ``JEPSEN_WGL_EXCHANGE=allgather``
+— the differential oracle and operational kill-switch) ships every
+shard's candidates everywhere and runs the global dedup replicated.
+Every verdict either mode returns is the single-device kernel's at
+capacity ``f_total``, at the same level; the one asymmetry is WHEN a
+mode gives up — the partitioned mode's per-shard overflow can burn an
+escalation on hash skew the replicated mode absorbs, so under a tight
+``max_escalations`` budget it may report "unknown" where allgather
+still decides (never the reverse verdict — overflow is lossless).
 
 Compiles + executes on any mesh — the driver validates it on a virtual
 8-device CPU mesh (tests/ + __graft_entry__.dryrun_multichip); on real
-multi-chip hardware the all_gather rides ICI.
+multi-chip hardware the exchange rides ICI.
 """
 
 from __future__ import annotations
 
 import functools
+import os as _os
 import time as _time
 from typing import Optional
 
@@ -36,9 +49,24 @@ from ..ops.encode import EncodedHistory
 from . import make_mesh
 
 
+def _resolve_exchange(exchange: Optional[str]) -> str:
+    """Exchange-mode resolution: JEPSEN_WGL_EXCHANGE env > explicit arg
+    > the partitioned default. The env var is an operational
+    KILL-SWITCH — like ``JEPSEN_WGL_NO_DONATE`` it must win everywhere,
+    including over code paths that pass an explicit mode, or a fleet
+    rollback would silently miss them."""
+    mode = _os.environ.get("JEPSEN_WGL_EXCHANGE") or exchange \
+        or "alltoall"
+    if mode not in ("alltoall", "allgather"):
+        raise ValueError(
+            f"unknown WGL exchange mode {mode!r} "
+            "(expected 'alltoall' or 'allgather')")
+    return mode
+
+
 @functools.lru_cache(maxsize=32)
 def _sharded_kernel(mk, F: int, W: int, KO: int, S: int, ND: int, NO: int,
-                    axis: str, mesh, B=None):
+                    axis: str, mesh, B=None, exchange: str = "alltoall"):
     """jit(shard_map(raw kernel)) cached per (model, shapes, mesh) —
     without this every check would re-trace and re-lower the whole BFS
     program (15-90 s per bucket on TPU)."""
@@ -52,7 +80,8 @@ def _sharded_kernel(mk, F: int, W: int, KO: int, S: int, ND: int, NO: int,
 
     D = int(mesh.shape[axis])
     raw, _ = wgl._build_kernel(mk, F, W, KO, S, ND, NO,
-                               axis_name=axis, n_shards=D, B=B)
+                               axis_name=axis, n_shards=D, B=B,
+                               exchange=exchange)
     repl = P()
     shard1 = P(axis)
     in_specs = (
@@ -84,10 +113,22 @@ def check_encoded_sharded(
     checkpoint_path: Optional[str] = None,
     chunk_callback=None,
     metrics=None,
+    exchange: Optional[str] = None,
 ) -> dict:
     """Decide linearizability of one encoded history with the frontier
     sharded over ``mesh``'s ``axis``. Result map mirrors
-    ``wgl.check_encoded_device`` plus ``sharded``/``n_shards`` keys.
+    ``wgl.check_encoded_device`` plus ``sharded``/``n_shards``/
+    ``exchange`` keys.
+
+    ``exchange``: per-level candidate exchange mode — ``"alltoall"``
+    (default: owner-partitioned, each shard dedups only its hash
+    range) or ``"allgather"`` (the legacy replicated exchange, the
+    differential oracle). ``JEPSEN_WGL_EXCHANGE`` (the operational
+    kill-switch) overrides BOTH this argument and the default.
+    Checkpoints are mode-portable: the resumable frontier is
+    the same global row set either way, so a file saved under one mode
+    (or mesh size — the width is re-rounded to the new mesh's
+    per-device multiple) resumes exactly under the other.
 
     ``f_total`` is the GLOBAL frontier capacity, rounded up to a
     per-device multiple (the result's ``frontier_total`` reports the
@@ -108,12 +149,14 @@ def check_encoded_sharded(
     ``check_encoded_device``).
 
     ``metrics``: telemetry registry; records per-chunk events
-    (global/per-device config counts), sharded-kernel cache hits and
-    the analytic all_gather traffic (the exchange matrix's byte size ×
-    levels run — the kernel itself stays unchanged; per-level stats
-    collection is single-device only).
+    (global + true per-shard max/min config counts), sharded-kernel
+    cache hits, the analytic exchange traffic (the mode-aware
+    ``wgl.exchange_bytes_per_level`` model × levels run) and the
+    ``wgl_shard_imbalance`` gauge (max-shard occupancy / ideal
+    count/D); per-level stats collection is single-device only.
     """
     t0 = _time.perf_counter()
+    exchange = _resolve_exchange(exchange)
     if mesh is None:
         mesh = make_mesh()
     D = int(mesh.shape[axis])
@@ -121,10 +164,11 @@ def check_encoded_sharded(
     n = enc.n
     if plan.nD == 0:
         return {"valid": True, "op_count": n, "device": True, "levels": 0,
-                "sharded": True, "n_shards": D}
+                "sharded": True, "n_shards": D, "exchange": exchange}
     if not plan.ok:
         return {"valid": "unknown", "op_count": n, "device": True,
-                "info": plan.reason, "sharded": True, "n_shards": D}
+                "info": plan.reason, "sharded": True, "n_shards": D,
+                "exchange": exchange}
     W, KO, S, ND, NO = plan.dims
     mk = wgl._model_cache_key(enc.model)
     total_levels = int(plan.args[2])
@@ -138,16 +182,12 @@ def check_encoded_sharded(
         F = max(-(-f_req // D), 16)
         return F * D
 
-    def allgather_bytes_per_level(F: int) -> int:
-        """Byte size of the per-level candidate exchange: every shard
-        ships its packed [P, NC+1] u32 matrix to every other shard (one
-        tiled all_gather over the frontier axis)."""
-        KD = W // 32
-        CC = plan.B or (W + KO * 32)
-        M = F * CC
-        P = min(M, max(wgl.STAGE1_P_MULT * F, 64))
-        NC = 1 + KD + S + max(KO, 1)
-        return D * P * (NC + 1) * 4
+    def exchange_bytes_per_level(F: int) -> int:
+        """Mode-aware per-level exchange byte model (see
+        ``wgl.exchange_bytes_per_level``): ``D*P*(NC+1)*4`` for the
+        replicated all_gather, ``~P*(NC+1)*4`` for the hash-routed
+        all_to_all (each row crosses ICI once)."""
+        return wgl.exchange_bytes_per_level(plan, F, D, exchange)
 
     def run_capacity(FT: int, fr_global: tuple, attempt: dict) -> tuple:
         """Chunked search at one global capacity; returns (result|None,
@@ -156,7 +196,7 @@ def check_encoded_sharded(
         if metrics is not None:
             misses0 = _sharded_kernel.cache_info().misses
         sharded = _sharded_kernel(mk, F, W, KO, S, ND, NO, axis, mesh,
-                                  B=plan.B)
+                                  B=plan.B, exchange=exchange)
         if metrics is not None:
             fresh = _sharded_kernel.cache_info().misses > misses0
             metrics.counter(
@@ -180,8 +220,10 @@ def check_encoded_sharded(
             call_args = dev_args[:2] + (budget,) + dev_args[3:]
             out = sharded(*call_args, *fr[:-1], np.int32(lvl0),
                           np.int32(0))
-            # ONE packed device->host read per chunk (see wgl kernel).
-            acc, ovf, nonempty, lvl, fmax, _cnt = (
+            # ONE packed device->host read per chunk (see wgl kernel);
+            # the sharded flags vector carries the per-shard max/min
+            # live counts after the global scalars.
+            acc, ovf, nonempty, lvl, fmax, _cnt, cmax, cmin = (
                 int(x) for x in np.asarray(out[0]))
             fmax_all[0] = max(fmax_all[0], fmax)
             fr = tuple(out[1:]) + (np.int32(lvl),)
@@ -194,35 +236,68 @@ def check_encoded_sharded(
             attempt["wall_s"] = round(attempt["wall_s"] + chunk_wall, 3)
             if metrics is not None:
                 c = metrics.counter
+                levels_run = max(int(lvl) - lvl0, 0)
+                ex_bytes = exchange_bytes_per_level(F) * levels_run
                 c("wgl_sharded_chunks_total",
                   "Frontier-sharded kernel chunk invocations").inc()
                 c("wgl_sharded_levels_total",
                   "BFS levels run by the sharded search").inc(
-                      max(int(lvl) - lvl0, 0))
-                c("wgl_allgather_bytes_total",
+                      levels_run)
+                c("wgl_exchange_bytes_total",
                   "Analytic bytes moved by the per-level candidate "
-                  "all_gather").inc(
-                      allgather_bytes_per_level(F)
-                      * max(int(lvl) - lvl0, 0))
-                metrics.gauge(
+                  "exchange, by mode",
+                  labelnames=("exchange",)).labels(
+                      exchange=exchange).inc(ex_bytes)
+                if exchange == "allgather":
+                    # Back-compat: pre-partitioning dashboards read the
+                    # all_gather-named counter.
+                    c("wgl_allgather_bytes_total",
+                      "Analytic bytes moved by the per-level candidate "
+                      "all_gather (legacy replicated mode only)").inc(
+                          ex_bytes)
+                g = metrics.gauge(
                     "wgl_sharded_configs_per_device",
-                    "Live configs per device after the last chunk",
-                    labelnames=("n_shards",)).labels(
-                        n_shards=D).set(int(_cnt) / D)
+                    "TRUE per-shard live configs after the last chunk "
+                    "(max/min across shards — not a count/D mean). In "
+                    "allgather mode the skew is the slice LAYOUT "
+                    "(contiguous global order), not hash imbalance",
+                    labelnames=("n_shards", "stat"))
+                g.labels(n_shards=D, stat="max").set(cmax)
+                g.labels(n_shards=D, stat="min").set(cmin)
+                if exchange == "alltoall":
+                    # Hash-routing balance — only meaningful in the
+                    # partitioned mode: allgather's contiguous slice
+                    # layout puts every row on the first shards by
+                    # construction, which would read as maximal "skew"
+                    # on a perfectly healthy run.
+                    metrics.gauge(
+                        "wgl_shard_imbalance",
+                        "Max-shard occupancy / ideal (global count / "
+                        "n_shards) after the last chunk; 1.0 = "
+                        "perfectly balanced (alltoall mode only)",
+                        labelnames=("n_shards",)).labels(
+                            n_shards=D).set(
+                                round(cmax * D / max(int(_cnt), 1), 4))
+                ev_extra = {"allgather_bytes": ex_bytes} \
+                    if exchange == "allgather" else {}
                 metrics.event(
                     "wgl_sharded_chunk", level=int(lvl), F=F,
                     n_shards=D, global_capacity=FT, count=int(_cnt),
+                    count_max=cmax, count_min=cmin,
                     frontier_max=fmax_all[0],
                     wall_s=round(chunk_wall, 4),
                     # Per-chunk interconnect traffic (analytic), so
                     # telemetry.profile can attribute the exchange's
-                    # share without re-deriving the byte model.
-                    allgather_bytes=allgather_bytes_per_level(F)
-                    * max(int(lvl) - lvl0, 0))
+                    # share without re-deriving the byte model; the
+                    # legacy allgather_bytes alias rides along in
+                    # allgather mode only.
+                    exchange=exchange, exchange_bytes=ex_bytes,
+                    **ev_extra)
 
             def result(valid, **extra):
                 r = {"valid": valid, "op_count": n, "device": True,
-                     "sharded": True, "n_shards": D, "levels": int(lvl),
+                     "sharded": True, "n_shards": D,
+                     "exchange": exchange, "levels": int(lvl),
                      "frontier_total": FT, "frontier_max": fmax_all[0],
                      "window": W,
                      "wall_s": _time.perf_counter() - t0}
@@ -296,7 +371,7 @@ def check_encoded_sharded(
         FT = capacities(FT * 4)
         fr = wgl._pad_frontier(fr, FT)
     return {"valid": "unknown", "op_count": n, "device": True,
-            "sharded": True, "n_shards": D,
+            "sharded": True, "n_shards": D, "exchange": exchange,
             "info": f"frontier capacity schedule exhausted at {FT // 4}",
             "attempts": attempts,
             "wall_s": _time.perf_counter() - t0}

@@ -29,7 +29,8 @@ Also here: opt-in ``jax.profiler`` trace capture + device
 ``memory_stats()`` watermarks (the ``--profile`` CLI flag), the
 ``profile.json`` store artifact the ``/profile`` web page renders, and
 attribution for the batched pipeline (per-rung occupancy — why a member
-escalated) and the frontier-sharded driver (all_gather bytes — the
+escalated) and the frontier-sharded driver (mode-aware exchange bytes,
+``exchange_bytes`` with the legacy ``allgather_bytes`` alias — the
 interconnect's share of the level's traffic). See docs/profiling.md.
 """
 
@@ -56,8 +57,27 @@ OCCUPANCY_THRESHOLD = 0.25
 
 def _byte_floor_fn(plan, byte_floor, **floor_kw) -> Optional[Callable]:
     """Resolve the bytes-per-level model: an explicit callable wins,
-    else wrap ``wgl.level_byte_floor`` over the plan."""
+    else wrap ``wgl.level_byte_floor`` over the plan. Context kwargs
+    (``sharded``, ``exchange``) are forwarded to explicit callables
+    that accept them; older single-argument callables keep working."""
     if byte_floor is not None:
+        if not floor_kw:
+            return byte_floor
+        # Decide by SIGNATURE, not by catching TypeError from the call
+        # — a TypeError raised inside the callable must propagate, not
+        # silently re-invoke it without the context kwargs.
+        import inspect
+
+        try:
+            params = inspect.signature(byte_floor).parameters
+            accepts_kw = any(
+                p.kind == inspect.Parameter.VAR_KEYWORD
+                for p in params.values()
+            ) or all(k in params for k in floor_kw)
+        except (TypeError, ValueError):  # builtins/odd callables
+            accepts_kw = False
+        if accepts_kw:
+            return lambda F: byte_floor(F, **floor_kw)
         return byte_floor
     if plan is None:
         return None
@@ -286,13 +306,18 @@ def _attribute_batch(registry) -> Optional[dict]:
 
 def _attribute_sharded(registry, plan, byte_floor) -> Optional[dict]:
     """Interconnect share of the frontier-sharded search: the analytic
-    all_gather bytes vs the per-shard compute byte floor — how much of
-    the level's traffic is the exchange itself."""
+    exchange bytes (mode-aware — the hash-routed all_to_all or the
+    legacy replicated all_gather) vs the per-shard compute byte floor —
+    how much of the level's traffic is the exchange itself."""
     ev = registry.events("wgl_sharded_chunk")
     if not ev:
         return None
-    floor = _byte_floor_fn(plan, byte_floor, sharded=True)
-    ag_total = 0
+    # Exchange mode of the run (events predating the field are the
+    # legacy all_gather recordings).
+    mode = next((e["exchange"] for e in ev if "exchange" in e),
+                "allgather")
+    floor = _byte_floor_fn(plan, byte_floor, sharded=True, exchange=mode)
+    ex_total = 0
     floor_total = 0
     prev_level = 0
     chunks = []
@@ -303,25 +328,39 @@ def _attribute_sharded(registry, plan, byte_floor) -> Optional[dict]:
         c = {"level": lvl, "F": int(e["F"]),
              "n_shards": int(e["n_shards"]),
              "wall_s": e.get("wall_s")}
-        ag = e.get("allgather_bytes")
-        if ag is not None:
-            ag_total += int(ag)
-            c["allgather_bytes"] = int(ag)
+        # New field first; back-compat with recordings that only carry
+        # the all_gather-named alias.
+        ex = e.get("exchange_bytes", e.get("allgather_bytes"))
+        if ex is not None:
+            ex_total += int(ex)
+            c["exchange_bytes"] = int(ex)
+        for k in ("count_max", "count_min"):
+            if k in e:
+                c[k] = int(e[k])
         if floor is not None:
             fb = int(floor(int(e["F"]))) * levels
             floor_total += fb
             c["bytes_floor"] = fb
         chunks.append(c)
-    if not ag_total:
-        # Fall back to the run counter (events predating the per-chunk
-        # field still carry the total).
-        ag_total = int(registry.summary().get(
-            "wgl_allgather_bytes_total", 0))
-    out: dict = {"chunks": chunks[-60:],
-                 "interconnect": {"allgather_bytes_total": ag_total}}
-    if ag_total and floor_total:
+    if not ex_total:
+        # Fall back to the run counters (older recordings carry only
+        # the unlabeled all_gather total; newer ones label the
+        # exchange counter by mode).
+        s = registry.summary()
+        ex_total = int(sum(
+            v for k, v in s.items()
+            if k.startswith("wgl_exchange_bytes_total"))) or \
+            int(s.get("wgl_allgather_bytes_total", 0))
+    out: dict = {"exchange": mode,
+                 "chunks": chunks[-60:],
+                 "interconnect": {"exchange_bytes_total": ex_total,
+                                  # legacy alias, kept one layer deep so
+                                  # pre-partitioning consumers keep
+                                  # reading a number
+                                  "allgather_bytes_total": ex_total}}
+    if ex_total and floor_total:
         out["interconnect"]["share_of_traffic"] = round(
-            ag_total / (ag_total + floor_total), 4)
+            ex_total / (ex_total + floor_total), 4)
         out["interconnect"]["compute_bytes_floor_total"] = floor_total
     return out
 

@@ -227,8 +227,10 @@ def _profile_section(doc: dict) -> str:
     sharded = attr.get("sharded")
     if sharded:
         ic = sharded.get("interconnect") or {}
+        mode = sharded.get("exchange")
+        head = f" ({html.escape(str(mode))} exchange)" if mode else ""
         parts.append(
-            "<h3>Frontier-sharded interconnect</h3><p>"
+            f"<h3>Frontier-sharded interconnect{head}</h3><p>"
             + html.escape(" · ".join(
                 f"{k}: {v}" for k, v in sorted(ic.items())))
             + "</p>")

@@ -156,6 +156,54 @@ class TestMainGate:
         capsys.readouterr()
 
 
+class TestMultichipExchangeMetric:
+    """ISSUE 4 CI satellite: benchcmp knows the new MULTICHIP
+    exchange-bytes metric and its direction (bytes-per-level regress
+    when they GROW; the drop factor when it shrinks)."""
+
+    def _wrapper(self, a2a, drop):
+        return {"n_devices": 8, "rc": 0, "ok": True, "skipped": False,
+                "tail": "log noise\n" + json.dumps({
+                    "multichip": True, "n_devices": 8,
+                    "exchange_modes_agree": True,
+                    "exchange_bytes_per_level": {
+                        "alltoall": a2a, "allgather": 8 * a2a},
+                    "exchange_drop_x": drop}) + "\n"}
+
+    def test_parsed_from_multichip_tail(self, tmp_path):
+        p = tmp_path / "MULTICHIP_r90.json"
+        p.write_text(json.dumps(self._wrapper(1280, 8.0)))
+        m = benchcmp.extract(benchcmp.load_round(str(p))["data"])
+        assert m["multichip_exchange_bytes_per_level"] == 1280.0
+        assert m["multichip_exchange_drop_x"] == 8.0
+        assert m["multichip_ok"] == 1.0
+
+    def test_direction_lower_for_exchange_bytes(self, tmp_path):
+        prev = benchcmp.extract(
+            {"exchange_bytes_per_level": {"alltoall": 1000},
+             "exchange_drop_x": 8.0})
+        worse = benchcmp.extract(
+            {"exchange_bytes_per_level": {"alltoall": 2000},
+             "exchange_drop_x": 4.0})
+        d = benchcmp.deltas(prev, worse)
+        assert d["multichip_exchange_bytes_per_level"]["regression"] \
+            is True
+        assert d["multichip_exchange_drop_x"]["regression"] is True
+        better = benchcmp.extract(
+            {"exchange_bytes_per_level": {"alltoall": 500},
+             "exchange_drop_x": 16.0})
+        d2 = benchcmp.deltas(prev, better)
+        assert not benchcmp.regressions(d2)
+
+    def test_committed_rounds_unaffected(self):
+        """The committed r01-r05 multichip artifacts predate the
+        metric: it simply leaves a hole in their columns."""
+        rounds = [benchcmp.load_round(p) for p in MULTI]
+        for r in rounds:
+            m = benchcmp.extract(r["data"])
+            assert "multichip_exchange_bytes_per_level" not in m
+
+
 class TestVsPrevious:
     def test_embeds_delta_block_against_newest_round(self):
         current = {"value": 0.03, "invalid_s": 0.35,

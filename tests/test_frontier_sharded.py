@@ -75,6 +75,78 @@ class TestShardedDifferential:
         assert got["valid"] is True
 
 
+class TestExchangeModes:
+    """Owner-partitioned (alltoall) vs replicated (allgather) exchange:
+    the two modes must return IDENTICAL verdicts and levels — the
+    partitioned mode may escalate earlier under shard imbalance, but
+    escalation is lossless, so the decision point never moves."""
+
+    @pytest.fixture(autouse=True)
+    def _no_ambient_kill_switch(self, monkeypatch):
+        """The env kill-switch overrides explicit ``exchange=`` args by
+        design — an ambient JEPSEN_WGL_EXCHANGE would make every
+        cross-mode comparison here silently compare a mode against
+        itself."""
+        monkeypatch.delenv("JEPSEN_WGL_EXCHANGE", raising=False)
+
+    def test_cross_mesh_differential(self, mesh):
+        """Verdict + level equality for D in {1, 2, 8} in both exchange
+        modes (all at the same global capacity — ``capacities`` rounds
+        128 to 128 on every one of these meshes)."""
+        model = CasRegister(init=0)
+        rng = random.Random(63)
+        meshes = {8: mesh, 2: make_mesh(2, shape=(2, 1)),
+                  1: make_mesh(1, shape=(1, 1))}
+        for i in range(3):
+            h = random_register_history(rng, n_ops=60, n_procs=4,
+                                        crash_p=0.05, cas=True)
+            if i == 1:
+                h = perturb_history(rng, h)
+            want = wgl_host.check_history_host(model, h)["valid"]
+            got = {}
+            for D, m in meshes.items():
+                for mode in ("alltoall", "allgather"):
+                    r = check_history_sharded(model, h, mesh=m,
+                                              f_total=128,
+                                              exchange=mode)
+                    assert r["valid"] == want, (i, D, mode, r)
+                    assert r["n_shards"] == D and r["exchange"] == mode
+                    got[(D, mode)] = r.get("levels")
+            assert len(set(got.values())) == 1, (i, got)
+
+    def test_env_kill_switch_selects_allgather(self, mesh, monkeypatch):
+        model = CasRegister(init=0)
+        h = random_register_history(random.Random(31), n_ops=60,
+                                    n_procs=4, crash_p=0.05, cas=True)
+        monkeypatch.setenv("JEPSEN_WGL_EXCHANGE", "allgather")
+        r = check_history_sharded(model, h, mesh=mesh, f_total=128)
+        assert r["exchange"] == "allgather"
+        # A kill-switch must win EVERYWHERE — including over explicit
+        # arguments — or a fleet rollback would miss those callers.
+        r2 = check_history_sharded(model, h, mesh=mesh, f_total=128,
+                                   exchange="alltoall")
+        assert r2["exchange"] == "allgather"
+        monkeypatch.setenv("JEPSEN_WGL_EXCHANGE", "bogus")
+        with pytest.raises(ValueError):
+            check_history_sharded(model, h, mesh=mesh, f_total=128)
+
+    def test_partitioned_imbalance_overflow_is_lossless(self, mesh):
+        """A tiny per-shard capacity makes the partitioned mode's
+        per-shard (owner-range) overflow fire where the global count
+        still fits — the lossless escalation must absorb it and the
+        verdict must still match the oracle."""
+        model = CasRegister(init=0)
+        rng = random.Random(77)
+        h = random_register_history(rng, n_ops=80, n_procs=6,
+                                    crash_p=0.1, cas=True)
+        want = wgl_host.check_history_host(model, h)["valid"]
+        got = check_history_sharded(model, h, mesh=mesh, f_total=16,
+                                    max_escalations=4,
+                                    exchange="alltoall")
+        assert got["valid"] == want
+        assert got["attempts"]
+
+
 class TestCheckerBackendDispatch:
     def test_sharded_backend_via_checker(self, mesh):
         from jepsen_tpu import checker as jchecker
@@ -120,6 +192,53 @@ class TestShardedCheckpoint:
         got = check_encoded_sharded(enc, mesh=mesh, f_total=128,
                                     checkpoint_path=ck)
         assert got["valid"] == want
+        if got["valid"] != "unknown":
+            assert not os.path.exists(ck)
+
+    @pytest.mark.parametrize("save_mode,resume_mode", [
+        ("allgather", "alltoall"), ("alltoall", "allgather")])
+    def test_cross_mode_resume(self, mesh, tmp_path, save_mode,
+                               resume_mode, monkeypatch):
+        """Checkpoints are exchange-mode-portable: the resumable
+        frontier is the same global row set either way, so a file saved
+        mid-search under one mode resumes exactly under the other."""
+        # An ambient kill-switch would collapse both legs to one mode.
+        monkeypatch.delenv("JEPSEN_WGL_EXCHANGE", raising=False)
+        from jepsen_tpu.ops import wgl, wgl_host
+        from jepsen_tpu.ops.encode import encode_history
+
+        model = CasRegister(init=0)
+        h = random_register_history(random.Random(41), n_ops=100,
+                                    n_procs=5, cas=True, crash_p=0.05)
+        enc = encode_history(model, h)
+        want = wgl_host.check_history_host(model, h)["valid"]
+        ck = str(tmp_path / f"x_{save_mode}.npz")
+
+        class _Stop(Exception):
+            pass
+
+        def _first_chunk_only(info):
+            raise _Stop()  # checkpoint is saved BEFORE the callback
+
+        with pytest.raises(_Stop):
+            check_encoded_sharded(enc, mesh=mesh, f_total=128,
+                                  checkpoint_path=ck,
+                                  levels_per_call=8,
+                                  exchange=save_mode,
+                                  chunk_callback=_first_chunk_only)
+        import os
+
+        assert os.path.exists(ck)
+        plan = wgl.plan_device(enc)
+        saved = wgl._load_search_checkpoint(
+            ck, wgl._enc_fingerprint(enc, plan))
+        assert saved is not None and int(saved["fr"][-1]) >= 8
+        got = check_encoded_sharded(enc, mesh=mesh, f_total=128,
+                                    checkpoint_path=ck,
+                                    exchange=resume_mode)
+        assert got["valid"] == want
+        assert got["exchange"] == resume_mode
+        assert got.get("resumed_from_level", 0) >= 8
         if got["valid"] != "unknown":
             assert not os.path.exists(ck)
 

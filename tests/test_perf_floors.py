@@ -55,6 +55,84 @@ def test_edn_parse_throughput_floor():
     assert rate > 1.0, f"{rate:.1f} MB/s"
 
 
+class TestExchangeByteModel:
+    """Analytic pins on the owner-partitioned exchange byte model
+    (ISSUE 4 acceptance) — pure arithmetic over the kernel's static
+    shapes, no device, so these run in tier-1 unconditionally."""
+
+    @staticmethod
+    def _plan(n_ops=200):
+        from jepsen_tpu.models import CasRegister
+        from jepsen_tpu.ops import wgl
+        from jepsen_tpu.ops.encode import encode_history
+        from jepsen_tpu.testing import random_register_history
+
+        h = random_register_history(random.Random(9), n_ops=n_ops,
+                                    n_procs=8, cas=True, crash_p=0.02)
+        return wgl.plan_device(encode_history(CasRegister(init=0), h))
+
+    def test_partitioned_bytes_drop_4x_at_d8(self):
+        """Equal GLOBAL capacity, D=8: the hash-routed all_to_all moves
+        >=4x fewer per-level bytes than the replicated all_gather."""
+        from jepsen_tpu.ops import wgl
+
+        plan = self._plan()
+        D = 8
+        for f_total in (1024, 4096, 32768):
+            F = max(-(-f_total // D), 16)  # the driver's capacities()
+            ag = wgl.exchange_bytes_per_level(plan, F, D, "allgather")
+            a2a = wgl.exchange_bytes_per_level(plan, F, D, "alltoall")
+            assert ag >= 4 * a2a, (f_total, ag, a2a)
+
+    def test_partitioned_never_exceeds_allgather(self):
+        """bytes(alltoall) <= bytes(allgather) for every D > 1, and the
+        two models coincide at D=1 (both ship the local P rows once)."""
+        from jepsen_tpu.ops import wgl
+
+        plan = self._plan()
+        for D in (1, 2, 4, 8, 16, 64):
+            F = max(-(-4096 // D), 16)
+            ag = wgl.exchange_bytes_per_level(plan, F, D, "allgather")
+            a2a = wgl.exchange_bytes_per_level(plan, F, D, "alltoall")
+            if D == 1:
+                assert a2a == ag
+            else:
+                assert a2a <= ag, (D, a2a, ag)
+
+    def test_alltoall_scales_with_mesh(self):
+        """The allgather model is O(D) in the mesh at fixed per-device
+        capacity; the partitioned model is mesh-size independent up to
+        bucket rounding (the whole point of owner-compute
+        partitioning)."""
+        from jepsen_tpu.ops import wgl
+
+        plan = self._plan()
+        F = 512
+        ag = [wgl.exchange_bytes_per_level(plan, F, D, "allgather")
+              for D in (2, 4, 8)]
+        a2a = [wgl.exchange_bytes_per_level(plan, F, D, "alltoall")
+               for D in (2, 4, 8)]
+        assert ag[1] == 2 * ag[0] and ag[2] == 4 * ag[0]
+        # Bucket rounding (ceil(P/D) rows per destination) bounds the
+        # partitioned model's growth at < 1% here.
+        assert max(a2a) <= min(a2a) * 1.01
+
+    def test_sharded_floor_counts_routing_stages(self):
+        """The per-shard compute floor is exchange-aware: the
+        partitioned mode adds its owner-routing sort + bucket gather
+        (small next to the dedup), and both sharded floors stay above
+        nothing-sharded nonsense values."""
+        from jepsen_tpu.ops import wgl
+
+        plan = self._plan()
+        base = wgl.level_byte_floor(plan, 512, sharded=True)
+        a2a = wgl.level_byte_floor(plan, 512, sharded=True,
+                                   exchange="alltoall")
+        assert a2a > base
+        # The added routing stages are a small fraction of a level.
+        assert a2a < base * 1.5
+
+
 @pytest.mark.perf
 def test_native_engine_throughput_floor():
     from jepsen_tpu import native

@@ -129,6 +129,9 @@ class TestSyntheticAttribution:
             {"from_F": 256, "to_F": 1024, "members": 3}]
 
     def test_sharded_interconnect_share(self):
+        """Legacy recordings (allgather_bytes only, no exchange field)
+        still attribute — the mode defaults to allgather and the old
+        total key keeps reading."""
         reg = Registry()
         reg.event("wgl_sharded_chunk", level=10, F=128, n_shards=8,
                   global_capacity=1024, count=500, frontier_max=600,
@@ -137,11 +140,44 @@ class TestSyntheticAttribution:
                   global_capacity=1024, count=400, frontier_max=600,
                   wall_s=0.4, allgather_bytes=4_000_000)
         out = profile.attribute(reg, byte_floor=lambda F, **kw: 600_000)
+        assert out["sharded"]["exchange"] == "allgather"
         ic = out["sharded"]["interconnect"]
         assert ic["allgather_bytes_total"] == 8_000_000
+        assert ic["exchange_bytes_total"] == 8_000_000
         # 8 MB exchanged vs 20 levels x 0.6 MB compute floor.
         assert ic["share_of_traffic"] == pytest.approx(
             8e6 / (8e6 + 12e6), abs=1e-4)
+
+    def test_sharded_partitioned_exchange_share(self):
+        """New-style recordings: exchange mode + exchange_bytes + the
+        per-shard max/min occupancy ride each chunk; the mode reaches
+        the byte-floor model as a keyword."""
+        reg = Registry()
+        seen_kw = {}
+        reg.event("wgl_sharded_chunk", level=10, F=128, n_shards=8,
+                  global_capacity=1024, count=500, count_max=90,
+                  count_min=40, frontier_max=600, wall_s=0.5,
+                  exchange="alltoall", exchange_bytes=500_000)
+        reg.event("wgl_sharded_chunk", level=20, F=128, n_shards=8,
+                  global_capacity=1024, count=400, count_max=70,
+                  count_min=30, frontier_max=600, wall_s=0.4,
+                  exchange="alltoall", exchange_bytes=500_000)
+
+        def floor(F, **kw):
+            seen_kw.update(kw)
+            return 600_000
+
+        out = profile.attribute(reg, byte_floor=floor)
+        sh = out["sharded"]
+        assert sh["exchange"] == "alltoall"
+        assert seen_kw.get("exchange") == "alltoall"
+        ic = sh["interconnect"]
+        assert ic["exchange_bytes_total"] == 1_000_000
+        assert ic["allgather_bytes_total"] == 1_000_000  # legacy alias
+        assert ic["share_of_traffic"] == pytest.approx(
+            1e6 / (1e6 + 12e6), abs=1e-4)
+        assert sh["chunks"][-1]["count_max"] == 70
+        assert sh["chunks"][-1]["count_min"] == 30
 
 
 @pytest.mark.slow

@@ -367,12 +367,35 @@ class TestShardedTelemetry:
         res = check_history_sharded(CasRegister(init=0), h, mesh=mesh,
                                     f_total=128, metrics=reg)
         assert res["valid"] is True
-        assert reg.counter("wgl_allgather_bytes_total").value > 0
         evs = reg.events("wgl_sharded_chunk")
         assert evs
         assert evs[-1]["n_shards"] == res["n_shards"] == 8
         assert evs[-1]["level"] == res["levels"]
+        # Mode-aware exchange accounting: the event carries the mode +
+        # the analytic exchange_bytes; the run counter is labeled by
+        # mode (the allgather-named counter only exists in legacy
+        # mode).
+        assert evs[-1]["exchange"] == res["exchange"]
+        assert evs[-1]["exchange_bytes"] > 0
+        if res["exchange"] == "allgather":
+            assert evs[-1]["allgather_bytes"] == evs[-1]["exchange_bytes"]
+        else:
+            assert "allgather_bytes" not in evs[-1]
+        # TRUE per-shard occupancy (max/min), not a count/D mean — and
+        # the imbalance gauge derived from it.
+        assert evs[-1]["count_max"] >= evs[-1]["count_min"] >= 0
+        assert evs[-1]["count_max"] <= evs[-1]["count"]
         s = reg.summary()
+        ex_key = f"wgl_exchange_bytes_total{{exchange={res['exchange']}}}"
+        assert s[ex_key] > 0
+        g = s["wgl_sharded_configs_per_device{n_shards=8,stat=max}"]
+        assert g == evs[-1]["count_max"]
+        if res["exchange"] == "alltoall":
+            # Hash-routing balance gauge: alltoall mode only (the
+            # allgather slice layout would read as spurious skew).
+            assert s["wgl_shard_imbalance{n_shards=8}"] >= 1.0
+        else:
+            assert "wgl_shard_imbalance{n_shards=8}" not in s
         assert s["wgl_sharded_levels_total"] == res["levels"]
         assert any(k.startswith("wgl_kernel_cache_total{cache=sharded")
                    for k in s)
