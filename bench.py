@@ -348,6 +348,77 @@ def main() -> int:
             out["interpreter_ops_per_s"] = None
             out["interpreter_error"] = f"{type(e).__name__}: {e}"
 
+        # Online monitor (jepsen_tpu.online): a seeded-invalid N_OPS
+        # history streamed through the monitor (host engine — no
+        # compiles). Two numbers: `ops_to_detection` — history ops
+        # observed when the first invalid segment's verdict lands, the
+        # violation seeded in the stream's first 30% with bounded-lag
+        # pacing (admission-pipeline backpressure: never run more than
+        # ~2 chunks past the decided watermark) — and
+        # `online_overhead_pct`, the end-to-end cost of deciding WHILE
+        # streaming (observe + drain) vs the same stream decided
+        # post-hoc through the production dispatch. Both lower-is-better
+        # in benchcmp.
+        _REC.begin("online_10k")
+        try:
+            from jepsen_tpu.online import OnlineMonitor
+            from jepsen_tpu.testing import chunked_register_history
+
+            oh = chunked_register_history(
+                random.Random(2031), n_ops=N_OPS, n_procs=4,
+                chunk_ops=60)
+            t0 = time.perf_counter()
+            for _op in oh:
+                pass
+            vres = wgl.check_history(model, oh)
+            t_off = time.perf_counter() - t0
+            mon = OnlineMonitor(model, engine="host")
+            t0 = time.perf_counter()
+            for op in oh:
+                mon.observe(op)
+            fin = mon.finish()
+            t_on = time.perf_counter() - t0
+            obad = perturb_history(random.Random(9), oh, within=0.3)
+            mon2 = OnlineMonitor(model, abort_on_violation=True,
+                                 engine="host")
+            t0 = time.perf_counter()
+            fed = 0
+            for op in obad:
+                mon2.observe(op)
+                fed += 1
+                if mon2.aborted:
+                    break
+                # Bounded wait (~30 s worst case): a dead scheduler
+                # worker freezes the watermark, and an unbounded spin
+                # here would wedge the whole bench.
+                for _ in range(30_000):
+                    if mon2.aborted or \
+                            fed - mon2.decided_through_index < 400:
+                        break
+                    time.sleep(0.001)
+            fin2 = mon2.finish()
+            t_detect = time.perf_counter() - t0
+            out["online_10k"] = {
+                "n_ops": len(obad),
+                "valid": fin["valid"],
+                "valid_agrees_offline": fin["valid"] == vres["valid"],
+                "online_s": round(t_on, 3),
+                "offline_s": round(t_off, 3),
+                "online_overhead_pct": round(
+                    100.0 * (t_on - t_off) / t_off, 1),
+                "segments_decided": fin["segments_decided"],
+                "detected_valid": fin2["valid"],
+                "aborted": fin2["aborted"],
+                "ops_to_detection": fin2.get("ops_to_detection"),
+                "seconds_to_detection": fin2.get("seconds_to_detection"),
+                "detection_wall_s": round(t_detect, 3),
+                "detection_frac": round(
+                    fin2["ops_to_detection"] / len(obad), 4)
+                if fin2.get("ops_to_detection") else None,
+            }
+        except Exception as e:  # noqa: BLE001
+            out["online_10k"] = {"error": f"{type(e).__name__}: {e}"}
+
         # --- Device sections, costliest-compile last, each budgeted ----
         # A wedged TPU relay hangs the FIRST jax op forever (not an
         # exception — the per-section try/except can't catch it), which

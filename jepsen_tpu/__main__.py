@@ -27,6 +27,9 @@ def cas_register_test(opts: dict) -> dict:
         name="cas-register",
         db=AtomDB(state),
         client=AtomClient(state),
+        # The online monitor (--online) needs the model on the test map;
+        # the demo DB resets the register to 0 in setup.
+        model=CasRegister(init=0),
         checker=jchecker.compose({
             "linear": jchecker.linearizable(model=CasRegister(init=0)),
             "stats": jchecker.stats(),

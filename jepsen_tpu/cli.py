@@ -81,6 +81,24 @@ def add_test_opts(p: argparse.ArgumentParser) -> None:
                         "(profile.json), device memory watermarks, and "
                         "a jax.profiler trace captured into the run's "
                         "store directory (profile_trace/)")
+    p.add_argument("--online", action="store_true",
+                   help="decide linearizability WHILE the run executes: "
+                        "stream ops through the online monitor "
+                        "(jepsen_tpu.online), deciding closed segments "
+                        "on the batched device pipeline concurrently "
+                        "with the workload; writes online.json (served "
+                        "at /online) next to the results")
+    p.add_argument("--online-abort", action="store_true",
+                   help="stop the run at the first invalid segment "
+                        "(records ops_to_detection / "
+                        "seconds_to_detection); implies --online")
+    p.add_argument("--online-engine",
+                   choices=["auto", "device", "host"], default="auto",
+                   help="segment-deciding engine for --online: the "
+                        "batched device pipeline, the host enumerator, "
+                        "or auto (device when the model supports it "
+                        "and a round batches >1 member); a non-auto "
+                        "choice implies --online")
     p.add_argument("--store-root", default=None,
                    help="directory for the store/ tree")
 
@@ -138,6 +156,16 @@ def _apply_std_opts(test: dict, opts: dict) -> dict:
         # Profiling rides the telemetry registry; the flag implies it.
         test["telemetry?"] = True
         test["profile?"] = True
+    # --online-abort / an explicit --online-engine imply --online (the
+    # --profile/--telemetry precedent) — silently ignoring them would
+    # leave a user believing violation-abort protection is armed.
+    if (opts.get("online") or opts.get("online_abort")
+            or (opts.get("online_engine") or "auto") != "auto"):
+        test["online?"] = True
+        if opts.get("online_abort"):
+            test["online-abort?"] = True
+        if opts.get("online_engine") and opts["online_engine"] != "auto":
+            test["online-engine"] = opts["online_engine"]
     if opts.get("store_root"):
         test["store-root"] = opts["store_root"]
     if opts.get("checker_backend") and opts["checker_backend"] != "auto":

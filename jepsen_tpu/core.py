@@ -226,6 +226,20 @@ def run(test: dict) -> dict:
     test = prepare_test(test)
     persist = bool(test.get("name")) and not test.get("no-store?")
     reg = jtelemetry.of_test(test)
+    monitor = None
+    if test.get("online?"):
+        # Online linearizability monitor (--online): tee ops from the
+        # interpreter as they land, decide closed segments on a worker
+        # thread while the workload runs, optionally abort on the first
+        # violation. The import itself is gated — with --online absent
+        # the subsystem costs nothing (no thread, no metrics).
+        from . import online as jonline
+
+        monitor = jonline.of_test(test)
+        if monitor is not None:
+            test["online-monitor"] = monitor
+            test["op-observer"] = monitor.observe
+            test["stop-event"] = monitor.stop_event
     frec = None
     if reg is not None:
         # Flight recorder rides every telemetry run: phases mirror
@@ -272,6 +286,21 @@ def run(test: dict) -> dict:
                     test["history"] = history
                     if persist:
                         store.save_1(test)
+                    if monitor is not None:
+                        with jtelemetry.timed_phase(reg, "online.finish",
+                                                    recorder=frec):
+                            test["online-results"] = monitor.finish()
+                        LOG.info("Online monitor: valid=%r decided "
+                                 "through index %s%s",
+                                 test["online-results"].get("valid"),
+                                 test["online-results"].get(
+                                     "decided_through_index"),
+                                 " (run aborted on violation)"
+                                 if test["online-results"].get("aborted")
+                                 else "")
+                        if persist:
+                            jonline.store_online(test,
+                                                 test["online-results"])
                     test = analyze(test)
                 return log_results(test)
             finally:
@@ -299,6 +328,18 @@ def run(test: dict) -> dict:
                                            registry=reg)
         raise
     finally:
+        if monitor is not None and test.get("online-results") is None:
+            # The run died before the success-path finish: shut the
+            # scheduler worker down (bounded drain) so a failed run
+            # leaks no thread, and keep whatever partial verdict the
+            # stream reached next to the flight record.
+            try:
+                test["online-results"] = monitor.finish(timeout=15.0)
+                if persist:
+                    jonline.store_online(test, test["online-results"])
+            except Exception:
+                LOG.warning("online monitor shutdown failed",
+                            exc_info=True)
         if persist and reg is not None:
             # Sinks go out even when a phase above threw: spans.jsonl +
             # metrics.jsonl/.prom next to the (phase-1-durable) history.

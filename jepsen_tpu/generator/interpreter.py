@@ -248,12 +248,25 @@ def run(test: dict) -> list[dict]:
     (interpreter.clj:181-310).
 
     Requires: test["client"] (a Client prototype), test["nemesis"] (already
-    set up), test["generator"], test["concurrency"], test["nodes"]."""
+    set up), test["generator"], test["concurrency"], test["nodes"].
+
+    Optional live-run hooks (both resolved ONCE; absent keys cost one
+    None check per op):
+
+    - ``test["op-observer"]``: called with every history-bound op as it
+      lands (invocations and completions) — the online monitor's tee.
+      Exceptions are logged, never propagated into the run.
+    - ``test["stop-event"]``: a ``threading.Event``; once set, the
+      scheduler stops dispatching and returns the history accumulated so
+      far (ops still in flight are abandoned to their daemon workers) —
+      the online monitor's ``abort_on_violation`` seam."""
     from .. import telemetry as jtelemetry
 
     ctx = make_context(test)
     nemesis = test.get("nemesis") or jnemesis.noop()
     _reg = jtelemetry.of_test(test)
+    _observer = test.get("op-observer")
+    _stop = test.get("stop-event")
     # Op-latency histogram by (f, completion type). Metric object is
     # resolved ONCE here; the completion path below only guards on the
     # None, so a telemetry-off run allocates nothing per op.
@@ -276,6 +289,14 @@ def run(test: dict) -> list[dict]:
     # workers).
     thread_of: dict[Any, Any] = {p: t for t, p in ctx.workers.items()}
     exc: Optional[BaseException] = None
+
+    def _note(op: dict) -> None:
+        history.append(op)
+        if _observer is not None:
+            try:
+                _observer(op)
+            except Exception:  # noqa: BLE001 - observers never sink runs
+                LOG.warning("op-observer failed", exc_info=True)
 
     def take_completion(block: bool, timeout: Optional[float] = None):
         """Apply completions from the shared queue; returns whether any
@@ -323,12 +344,19 @@ def run(test: dict) -> list[dict]:
                 thread_of[new_workers[thread]] = thread
                 ctx = ctx.with_(workers=new_workers)
             if goes_in_history(op2):
-                history.append(op2)
+                _note(op2)
             handled += 1
 
     _switch_interval_enter()
     try:
         while True:
+            # 0. External stop (online monitor abort): return the
+            # history as recorded so far; in-flight ops are abandoned
+            # to their daemon workers (the run is over).
+            if _stop is not None and _stop.is_set():
+                take_completion(block=False)
+                break
+
             # 1. Completions first (drain whatever has arrived).
             if take_completion(block=False):
                 continue
@@ -370,7 +398,7 @@ def run(test: dict) -> list[dict]:
             )
             gen = gen_update(gen2, test, ctx, op_)
             if goes_in_history(op_):
-                history.append(op_)
+                _note(op_)
     except BaseException as e:  # noqa: BLE001 - propagate after cleanup
         exc = e
     finally:

@@ -91,6 +91,25 @@ def simulate(gen, complete_fn: Callable, ctx: Optional[Context] = None,
                 in_flight = in_flight[1:]
 
 
+def with_nemesis(nemesis, complete_fn, test: Optional[dict] = None):
+    """Wrap ``complete_fn`` so nemesis-track invocations route through a
+    real :class:`jepsen_tpu.nemesis.Nemesis` instance (its completion
+    keeps the op's time + PERFECT_LATENCY unless the nemesis set one) —
+    lets the simulated generator drive stateful fault injectors like
+    the process-pause nemesis (jepsen_tpu.nemesis.pause)."""
+
+    def complete(ctx, op):
+        if op.get("process") == NEMESIS:
+            res = dict(nemesis.invoke(test or DEFAULT_TEST, op))
+            if res.get("time") == op.get("time"):
+                res["time"] = op["time"] + PERFECT_LATENCY
+            res.setdefault("type", "info")
+            return res
+        return complete_fn(ctx, op)
+
+    return complete
+
+
 def quick_ops(gen, ctx=None, test=None):
     """Every op succeeds instantly with zero latency."""
     return simulate(gen, lambda ctx, o: {**o, "type": "ok"}, ctx, test)

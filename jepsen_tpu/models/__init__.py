@@ -112,6 +112,27 @@ class Model:
         branching on data)."""
         raise NotImplementedError
 
+    # -- state portability (the online monitor's cross-segment carry) -------
+    # State lanes are only meaningful relative to the ValueTable they were
+    # encoded against; carrying a decided end-state across segment
+    # boundaries (jepsen_tpu.online) therefore round-trips through the
+    # *semantic* value domain: ``decode_state`` lifts lanes out of a table,
+    # ``encode_state`` re-interns them into the next segment's table. The
+    # defaults treat lanes as table-independent ints (correct for models
+    # whose lanes are plain counters — Mutex, ReentrantMutex,
+    # Semaphore); models with interned value ids in their lanes
+    # (registers, queues, and the owner-aware mutexes, whose owner lane
+    # is an interned ("process", p) id) override both.
+
+    def decode_state(self, state: Sequence[int], table: ValueTable) -> tuple:
+        """Lanes -> table-independent semantic state."""
+        return tuple(int(x) for x in state)
+
+    def encode_state(self, decoded: tuple, table: ValueTable) -> tuple[int, ...]:
+        """Semantic state -> lanes relative to ``table`` (interning any
+        values it introduces)."""
+        return tuple(int(x) for x in decoded)
+
     # -- kernel-cache identity ----------------------------------------------
     # The device kernel (ops/wgl.py) compiles one XLA program per model
     # *behavior*; these hooks define the hashable identity and how to rebuild

@@ -98,6 +98,17 @@ class OwnerAwareMutex(Model):
         owner2 = jnp.where(is_acq, a1s + 1, 0)
         return ok, owner2[..., None]
 
+    # The owner lane embeds an interned value id, so cross-table state
+    # carry (jepsen_tpu.online) must round-trip through the semantic
+    # owner: None when free, the ("process", p) tuple when held.
+    def decode_state(self, state, table):
+        owner = int(state[0])
+        return (table.lookup(owner - 1) if owner else None,)
+
+    def encode_state(self, decoded, table):
+        (owner,) = decoded
+        return (0 if owner is None else table.intern(owner) + 1,)
+
     def describe_op(self, opcode, a1, a2, table):
         verb = "acquire" if opcode == ACQUIRE else "release"
         return f"{verb} by {table.lookup(a1)!r}"
@@ -192,6 +203,16 @@ class FencedMutex(Model):
         owner2 = jnp.where(is_acq, a1s + 1, 0)
         last2 = jnp.where(is_acq & (a2s != UNKNOWN), a2s, last)
         return ok, jnp.stack([owner2, last2], axis=-1)
+
+    # Owner lane is an interned value id; the fence lane is a raw int.
+    def decode_state(self, state, table):
+        owner, last = (int(x) for x in state)
+        return (table.lookup(owner - 1) if owner else None, last)
+
+    def encode_state(self, decoded, table):
+        owner, last = decoded
+        return ((0 if owner is None else table.intern(owner) + 1),
+                int(last))
 
     def describe_op(self, opcode, a1, a2, table):
         if opcode == ACQUIRE:
@@ -292,6 +313,18 @@ class ReentrantFencedMutex(Model):
                                    jnp.where(count == 1,
                                              jnp.int32(UNKNOWN), cur)))
         return ok, jnp.stack([owner2, count2, cur2, hof2], axis=-1)
+
+    # Owner lane is an interned value id; count and both fence lanes
+    # are raw ints (UNKNOWN/-1 sentinels included).
+    def decode_state(self, state, table):
+        owner, count, cur, hof = (int(x) for x in state)
+        return (table.lookup(owner - 1) if owner else None, count, cur,
+                hof)
+
+    def encode_state(self, decoded, table):
+        owner, count, cur, hof = decoded
+        return ((0 if owner is None else table.intern(owner) + 1),
+                int(count), int(cur), int(hof))
 
     def describe_op(self, opcode, a1, a2, table):
         if opcode == ACQUIRE:

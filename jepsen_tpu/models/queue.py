@@ -50,6 +50,12 @@ class UnorderedQueue(Model):
             return (True, tuple(out))
         return (False, state)
 
+    def decode_state(self, state, table):
+        return tuple(table.lookup(int(x)) for x in state)
+
+    def encode_state(self, decoded, table):
+        return tuple(table.intern(v) for v in decoded)
+
     def describe_op(self, opcode, a1, a2, table):
         verb = "enqueue" if opcode == ENQUEUE else "dequeue"
         return f"{verb} {table.lookup(a1)!r}"

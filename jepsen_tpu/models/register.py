@@ -77,6 +77,12 @@ class CasRegister(Model):
         v2 = jnp.where(is_write, a1s, jnp.where(is_cas & cas_hit, a2s, v))
         return ok, v2[..., None]
 
+    def decode_state(self, state, table):
+        return (table.lookup(int(state[0])),)
+
+    def encode_state(self, decoded, table):
+        return (table.intern(decoded[0]),)
+
     def describe_op(self, opcode, a1, a2, table):
         if opcode == READ:
             return f"read -> {table.lookup(a1)!r}"
@@ -163,6 +169,12 @@ class MultiRegister(Model):
         write_mask = (~is_read)[..., None] & (lane == a1s[..., None])
         states2 = jnp.where(write_mask, a2s[..., None], states)
         return ok, states2
+
+    def decode_state(self, state, table):
+        return tuple(table.lookup(int(x)) for x in state)
+
+    def encode_state(self, decoded, table):
+        return tuple(table.intern(v) for v in decoded)
 
     def describe_op(self, opcode, a1, a2, table):
         verb = "read" if opcode == READ else "write"

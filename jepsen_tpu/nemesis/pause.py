@@ -1,0 +1,76 @@
+"""Minimal process-pause nemesis.
+
+The real-cluster analogue is SIGSTOP-ing a node's process (jepsen.nemesis
+hammer-time); here the target is the *simulated* generator
+(jepsen_tpu.generator.sim), which has no processes to signal — instead
+the nemesis flips a shared paused-set that a pause-aware completion
+function consults: ops invoked by a paused process complete only after a
+long stall, so their invocations stay open across what would otherwise
+be quiescent cut points. That is exactly the fault the online monitor's
+segmenter must survive (the no-quiescence slow path,
+docs/online.md#cut-rules): while a pause is live no segment closes, and
+the buffered ops ride forward until quiescence returns (or the stream
+ends and the terminal segment picks them up).
+
+Op shapes (generator nemesis track):
+
+    {"type": "info", "f": "pause",  "value": [proc, ...]}
+    {"type": "info", "f": "resume", "value": [proc, ...] | None}
+
+``value`` None on resume clears every pause.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Optional
+
+from . import Nemesis, Reflection
+
+
+class ProcessPause(Nemesis, Reflection):
+    """Pause/resume a set of client processes via a shared paused-set."""
+
+    def __init__(self, processes: Optional[Iterable] = None):
+        # Default targets when a pause op carries no value.
+        self.processes = set(processes or ())
+        self.paused: set = set()
+
+    def invoke(self, test, op):
+        f = op.get("f")
+        targets = op.get("value")
+        targets = set(targets) if targets is not None else set(
+            self.processes)
+        if f == "pause":
+            self.paused |= targets
+            return {**op, "value": sorted(self.paused, key=repr)}
+        if f == "resume":
+            if op.get("value") is None:
+                self.paused.clear()
+            else:
+                self.paused -= targets
+            return {**op, "value": sorted(self.paused, key=repr)}
+        raise ValueError(f"process-pause nemesis: unknown f {f!r}")
+
+    def teardown(self, test):
+        self.paused.clear()
+
+    def fs(self):
+        return ["pause", "resume"]
+
+    def __repr__(self):
+        return f"<nemesis.process-pause paused={sorted(self.paused, key=repr)}>"
+
+
+def stalled_completions(pause: ProcessPause, latency: int = 10,
+                        stall: int = 100_000):
+    """A sim complete-fn: ops invoked while their process is paused
+    complete ``stall`` ns later instead of ``latency`` ns — long enough
+    to straddle the would-be cut points of everything the unpaused
+    processes do meanwhile. Compose with :func:`jepsen_tpu.generator.
+    sim.with_nemesis` so the nemesis track drives the paused-set."""
+
+    def complete(ctx, op):
+        dt = stall if op.get("process") in pause.paused else latency
+        return {**op, "type": "ok", "time": op["time"] + dt}
+
+    return complete

@@ -1,0 +1,53 @@
+"""Online linearizability monitoring: decide the history WHILE the run
+executes, not after it.
+
+Three layers (see docs/online.md):
+
+- :mod:`segmenter` — incremental stream consumer: quiescent cut points,
+  P-compositional per-key split (reusing ``jepsen_tpu.independent``),
+  and the cross-segment state carry (exact feasible end-state sets).
+- :mod:`scheduler` — background dispatcher: groups closed segments into
+  members of the PR-2 batched device pipeline
+  (``jepsen_tpu.parallel.batch``), folds per-segment verdicts, and
+  exposes the monotone ``decided_through_index`` watermark.
+- :mod:`monitor` — the public :class:`OnlineMonitor`, wired into
+  ``core.run`` behind the ``--online`` CLI flag, with
+  ``abort_on_violation`` early-stop, telemetry, and the ``online.json``
+  store artifact (web ``/online`` page).
+
+Differential safety is the contract: a DEFINITE online verdict
+(valid/invalid) always equals the offline ``check_history`` verdict —
+pinned by tests/test_online.py across valid, seeded-invalid and
+overflow-unknown histories. The reverse direction is one-sided: the
+online fold may answer "unknown" where offline decides, in two honest
+cases — (1) a stream mixing keyed ``[k v]`` and keyless client ops (a
+streaming split cannot reproduce ``independent.subhistory``'s
+keyless-op broadcast), and (2) a lost carry (enumeration budget trip,
+timed-out close, or a crashed worker poisons a key's carried state, so
+that key's later segments fold unknown even where offline's
+first-accept search decides). See docs/online.md.
+"""
+
+from __future__ import annotations
+
+from .monitor import OnlineMonitor, of_test, store_online  # noqa: F401
+from .scheduler import SegmentScheduler  # noqa: F401
+from .segmenter import (  # noqa: F401
+    SINGLE_KEY,
+    KeySegment,
+    Segmenter,
+    encode_segment,
+    segment_states,
+)
+
+__all__ = [
+    "KeySegment",
+    "OnlineMonitor",
+    "SINGLE_KEY",
+    "SegmentScheduler",
+    "Segmenter",
+    "encode_segment",
+    "of_test",
+    "segment_states",
+    "store_online",
+]

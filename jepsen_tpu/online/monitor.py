@@ -1,0 +1,235 @@
+"""The public online-monitor surface: tee the live op stream into the
+segmenter, dispatch closed segments on the background scheduler, abort
+the run on a violation, and persist ``online.json``.
+
+Wiring (core.py / cli.py):
+
+- ``--online`` sets ``test["online?"]``; :func:`of_test` then builds an
+  :class:`OnlineMonitor` from the test map (it needs a model —
+  ``test["model"]``, or ``test["online"]["model"]``) and ``core.run``
+  installs ``monitor.observe`` as the interpreter's ``op-observer`` and
+  ``monitor.stop_event`` as its ``stop-event``.
+- ``--online-abort`` / ``test["online-abort?"]`` arms
+  ``abort_on_violation``: the first invalid segment sets the stop event,
+  the interpreter stops dispatching (the generator never drains), and
+  the monitor records ``ops_to_detection`` / ``seconds_to_detection``.
+- With ``--online`` absent none of this module is even imported: the
+  off path allocates no thread and registers no ``online_*`` metric
+  (tests/test_online.py pins that with a poisoned constructor).
+
+Telemetry (guarded on the test's registry): the scheduler feeds
+``online_segments_total{verdict}`` and ``online_decided_watermark``;
+the monitor feeds ``online_open_segment_ops`` (ops buffered in the
+still-open segment) and ``online_detection_seconds``.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time as _time
+from typing import Any, Optional
+
+from .segmenter import Segmenter
+from .scheduler import SegmentScheduler
+
+LOG = logging.getLogger("jepsen.online")
+
+
+class OnlineMonitor:
+    """Consume history ops while the run executes; maintain a live
+    folded linearizability verdict.
+
+    ``observe(op)`` is called from the interpreter's scheduler thread
+    for every history-bound op (invocations AND completions — the
+    segmenter needs both to see quiescence); it must stay cheap, so it
+    only buffers into the segmenter and hands closed segments to the
+    worker thread.
+    """
+
+    def __init__(
+        self,
+        model,
+        abort_on_violation: bool = False,
+        engine: str = "auto",
+        metrics=None,
+        max_configs: int = 500_000,
+        batch_f: int = 256,
+    ) -> None:
+        self.model = model
+        self.abort_on_violation = abort_on_violation
+        self.metrics = metrics
+        self.stop_event = threading.Event()
+        self._t0 = _time.monotonic()
+        self._ops_observed = 0
+        self._detection: Optional[dict] = None
+        self._finished: Optional[dict] = None
+        self._lock = threading.Lock()
+        self.segmenter = Segmenter()
+        self.scheduler = SegmentScheduler(
+            model, engine=engine, metrics=metrics,
+            max_configs=max_configs, batch_f=batch_f,
+            on_violation=self._on_violation)
+        self._open_gauge = (
+            metrics.gauge(
+                "online_open_segment_ops",
+                "Ops buffered in the online monitor's still-open segment")
+            if metrics is not None else None)
+
+    # -- live path -----------------------------------------------------------
+
+    def observe(self, op: Any) -> None:
+        """Tee one history op from the interpreter (exception-safe: a
+        monitor bug must never sink the run)."""
+        try:
+            with self._lock:
+                self._ops_observed += 1
+                segs = self.segmenter.offer(op)
+            if segs:
+                self.scheduler.submit(segs)
+            if self._open_gauge is not None:
+                self._open_gauge.set(self.segmenter.open_ops)
+        except Exception:  # noqa: BLE001
+            LOG.warning("online monitor observe failed", exc_info=True)
+
+    def _on_violation(self, violation: dict) -> None:
+        if self.segmenter.mixed_keys:
+            # A refutation in a mixed keyed/keyless stream is not
+            # trustworthy (see Segmenter.mixed_keys): the fold will
+            # degrade to "unknown", so neither record a detection nor
+            # abort a run offline might call valid.
+            LOG.warning(
+                "online monitor: invalid segment in a mixed "
+                "keyed/keyless stream ignored (fold degrades to unknown)")
+            return
+        with self._lock:
+            if self._detection is None:
+                self._detection = {
+                    "ops_to_detection": self._ops_observed,
+                    "seconds_to_detection": round(
+                        _time.monotonic() - self._t0, 4),
+                }
+                if self.metrics is not None:
+                    self.metrics.gauge(
+                        "online_detection_seconds",
+                        "Wall seconds from the first observed op to the "
+                        "first invalid segment verdict").set(
+                            self._detection["seconds_to_detection"])
+        if self.abort_on_violation:
+            LOG.warning(
+                "online monitor detected a linearizability violation "
+                "(segment seq %s); aborting the run",
+                violation.get("segment", {}).get("seq"))
+            self.stop_event.set()
+
+    @property
+    def aborted(self) -> bool:
+        return self.stop_event.is_set()
+
+    @property
+    def decided_through_index(self) -> int:
+        return self.scheduler.decided_through_index
+
+    # -- completion ----------------------------------------------------------
+
+    def finish(self, timeout: Optional[float] = 300.0) -> dict:
+        """Flush the terminal segment, drain the scheduler, and return
+        the folded result (idempotent)."""
+        if self._finished is not None:
+            return self._finished
+        with self._lock:
+            tail = self.segmenter.finish()
+        if tail:
+            try:
+                self.scheduler.submit(tail)
+            except RuntimeError:
+                # Scheduler already closed (worker died): the fold
+                # degrades to unknown; finish must still return.
+                LOG.warning("online scheduler closed before the "
+                            "terminal segment; fold degrades to unknown")
+        self.scheduler.close(timeout=timeout)
+        res = self.scheduler.result()
+        out = {
+            "valid": res["valid"],
+            "ops_observed": self._ops_observed,
+            "decided_through_index": res["decided_through_index"],
+            "segments_decided": res["segments_decided"],
+            "aborted": self.aborted,
+            "abort_on_violation": self.abort_on_violation,
+        }
+        if self._detection is not None:
+            out.update(self._detection)
+        if res.get("violation") is not None:
+            out["violation"] = res["violation"]
+        if self.segmenter.mixed_keys:
+            # Streaming cannot reproduce independent.subhistory's
+            # broadcast of keyless ops into every key (including keys
+            # the stream hasn't shown yet) — no definite verdict is
+            # safe here.
+            out["valid"] = "unknown"
+            out["info"] = ("mixed keyed/keyless stream: online split "
+                           "cannot match independent.subhistory; "
+                           "verdict degraded to unknown")
+        out["segments"] = res["segments"]
+        self._finished = out
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Test-map glue (core.run / cli).
+
+
+def of_test(test: dict):
+    """Build the test's monitor when ``test["online?"]`` is set and a
+    model is available; None otherwise (core.run skips the whole
+    subsystem on None — the zero-overhead off path)."""
+    if not test.get("online?"):
+        return None
+    opts = dict(test.get("online") or {})
+    model = opts.get("model") or test.get("model")
+    if model is None:
+        if opts.get("abort_on_violation") or test.get("online-abort?"):
+            # A user who armed abort-on-violation is RELYING on the
+            # monitor; degrading to "no monitor, full-length run" would
+            # silently void that protection — fail the run instead.
+            raise ValueError(
+                "--online-abort requires a model on the test map "
+                "(test['model'] or test['online']['model']) — without "
+                "one no monitor runs and no abort can ever fire")
+        LOG.warning(
+            "--online requested but the test map carries no model "
+            "(test['model'] or test['online']['model']); online "
+            "monitoring disabled")
+        return None
+    from .. import telemetry as jtelemetry
+
+    return OnlineMonitor(
+        model,
+        abort_on_violation=bool(
+            opts.get("abort_on_violation", test.get("online-abort?"))),
+        engine=opts.get("engine", test.get("online-engine") or "auto"),
+        metrics=jtelemetry.of_test(test),
+        max_configs=int(opts.get("max_configs", 500_000)),
+        batch_f=int(opts.get("batch_f", 256)),
+    )
+
+
+def store_online(test: dict, result: dict) -> Optional[str]:
+    """Write ``online.json`` into the run's store directory (rendered by
+    the web UI's ``/online`` page). Never raises."""
+    if not (test.get("name") and test.get("start-time")) or test.get(
+            "no-store?"):
+        return None
+    try:
+        from .. import store
+
+        p = store.path_mk(test, "online.json")
+        tmp = p.with_suffix(".json.tmp")
+        with open(tmp, "w") as f:
+            json.dump(result, f, indent=1, sort_keys=True, default=str)
+        tmp.replace(p)
+        return str(p)
+    except Exception:  # noqa: BLE001 - artifacts never sink the run
+        LOG.warning("could not store online.json", exc_info=True)
+        return None

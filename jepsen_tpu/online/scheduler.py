@@ -1,0 +1,554 @@
+"""Background segment dispatcher: batched device decisions, verdict fold,
+and the monotone ``decided_through_index`` watermark.
+
+A worker thread drains the segment queue WHILE the workload runs.
+Each round it collects every *ready* segment — a KeySegment is ready
+when its key's carried initial-state set is known, i.e. the key's
+previous segment has been decided (keys are independent, so distinct
+keys pipeline freely; one key's segments decide strictly in order) —
+encodes each (segment × carried-state) pair as one member, and decides
+the whole group:
+
+Deciding is two-stage. Non-terminal members go to the exhaustive host
+enumerator (``segmenter.segment_states``) first: one BFS yields both
+the verdict and the carried end-state set, so the common valid path
+never pays a second decision. The engine's decide oracle then takes
+what the enumerator can't — terminal segments (their carry is never
+consumed) and budget-tripped rescues (the trip loses the CARRY, not
+the verdict):
+
+- ``engine="device"``: oracle members go through the PR-2 batched
+  escalation pipeline (``parallel.batch.check_encoded_batch``) as ONE
+  vmapped program — the online monitor is exactly the streaming front
+  end that pipeline was missing. Members the ladder leaves unknown are
+  re-checked individually (auto dispatch), mirroring the lifted
+  checker's batch seam.
+- ``engine="host"``: the first-accept host oracle
+  (``ops.wgl_host.check_encoded`` — what the offline host backend
+  runs) — the compile-free path tests and small runs use.
+- ``engine="auto"``: device when the model is device-capable and a
+  round hands the oracle more than one member, host otherwise.
+
+Verdict fold (the differential-safety contract): a segment is *valid*
+iff any member (candidate initial state) linearizes — its carried set
+becomes the union of feasible end states over the valid members;
+*invalid* iff every member is refuted (any invalid segment makes the
+folded verdict invalid, with the witness segment + refutation info
+recorded); *unknown* otherwise, and every later segment of that key
+folds unknown too (no initial state to check from). The folded verdict
+therefore equals ``checker.merge_valid`` over segment verdicts, which
+equals the offline ``check_history`` verdict on the full history
+(tests/test_online.py pins this differentially).
+
+``decided_through_index`` only ever advances: it is the end index of
+the longest prefix of global segments whose KeySegments have all been
+decided.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+import time as _time
+from typing import Any, Callable, Optional
+
+from ..models import Model
+from .segmenter import (
+    SINGLE_KEY,
+    KeySegment,
+    encode_segment,
+    segment_states,
+)
+
+LOG = logging.getLogger("jepsen.online")
+
+
+class SegmentScheduler:
+    """Decide a stream of KeySegments concurrently with the workload.
+
+    ``on_violation(record)`` fires (once, from the worker thread) when a
+    segment folds invalid — the monitor uses it for abort_on_violation
+    and the detection metrics. ``metrics`` is a telemetry Registry or
+    None; series: ``online_segments_total{verdict}``,
+    ``online_decided_watermark``.
+    """
+
+    def __init__(
+        self,
+        model: Model,
+        engine: str = "auto",
+        metrics=None,
+        # Matches the offline host oracle's default (wgl_host
+        # check_encoded) — a smaller online budget would fold "unknown"
+        # where offline decides, breaking the differential contract.
+        max_configs: int = 500_000,
+        batch_f: int = 256,
+        on_violation: Optional[Callable[[dict], None]] = None,
+        max_segment_rows: int = 2000,
+    ) -> None:
+        if engine not in ("auto", "device", "host"):
+            raise ValueError(f"unknown online engine {engine!r}")
+        self.model = model
+        self.engine = engine
+        self.metrics = metrics
+        self.max_configs = max_configs
+        self.batch_f = batch_f
+        self.on_violation = on_violation
+        self.max_segment_rows = max_segment_rows
+
+        self._lock = threading.Lock()
+        self._inbox: "queue.SimpleQueue[Optional[list[KeySegment]]]" = (
+            queue.SimpleQueue())
+        self._pending: list[KeySegment] = []  # not yet ready/decided
+        # key -> carried decoded-state list; absent = model's own init
+        # (None member sentinel); "unknown" = carry lost (budget/overflow).
+        self._carry: dict[Any, Any] = {}
+        self._seq_outstanding: dict[int, int] = {}
+        self._seq_end: dict[int, int] = {}
+        self._next_seq = 0  # first global seq not yet fully decided
+        self._watermark = -1
+        # Display table is bounded by max_segment_rows; the fold runs on
+        # these counters so a verdict past the bound still lands.
+        self._segments: list[dict] = []
+        self._n_decided = 0
+        self._n_invalid = 0
+        self._n_unknown = 0
+        self._violation: Optional[dict] = None
+        self._closed = False
+        self._dead = False  # worker thread died; fold can't reach True
+        self._idle = threading.Event()
+        self._idle.set()
+        # Batches submitted but not yet fully decided; guards the idle
+        # event so wait_idle can't slip between a submit's clear() and
+        # its put().
+        self._inflight = 0
+        self._cnt_lock = threading.Lock()
+        self._thread = threading.Thread(
+            target=self._run, name="jepsen-online-scheduler", daemon=True)
+        self._thread.start()
+
+    # -- public surface ------------------------------------------------------
+
+    def submit(self, segments: list[KeySegment]) -> None:
+        """Enqueue all KeySegments of one cut (atomically, so the
+        watermark's per-seq accounting sees the full set)."""
+        if not segments:
+            return
+        # The closed check, in-flight accounting AND the enqueue share
+        # the lock close() flips the flag under: a submit that passed
+        # the check cannot land its batch after close()'s None marker
+        # (which would strand it in a queue no thread reads and wedge
+        # the idle event forever).
+        with self._cnt_lock:
+            if self._closed:
+                raise RuntimeError("scheduler is closed")
+            self._inflight += 1
+            self._idle.clear()
+            self._inbox.put(list(segments))
+
+    def close(self, timeout: Optional[float] = 60.0) -> None:
+        """Stop accepting segments and wait for the queue to drain."""
+        with self._cnt_lock:
+            if not self._closed:
+                self._closed = True
+                self._inbox.put(None)
+        self._thread.join(timeout)
+
+    @property
+    def decided_through_index(self) -> int:
+        return self._watermark
+
+    @property
+    def verdict(self) -> Any:
+        with self._lock:
+            return self._fold_locked()
+
+    @property
+    def violation(self) -> Optional[dict]:
+        return self._violation
+
+    def wait_idle(self, timeout: Optional[float] = None) -> bool:
+        """Block until every submitted segment has been decided (the
+        differential tests' sync point; the monitor's finish uses
+        close)."""
+        return self._idle.wait(timeout)
+
+    def result(self) -> dict:
+        with self._lock:
+            segs = list(self._segments)
+            out = {
+                "valid": self._fold_locked(),
+                "decided_through_index": self._watermark,
+                "segments_decided": self._n_decided,
+                "segments": segs,
+            }
+            if self._violation is not None:
+                out["violation"] = self._violation
+            return out
+
+    # -- worker --------------------------------------------------------------
+
+    def _ingest(self, batch: list[KeySegment]) -> None:
+        for seg in batch:
+            self._seq_outstanding[seg.seq] = (
+                self._seq_outstanding.get(seg.seq, 0) + 1)
+            self._seq_end[seg.seq] = seg.end_index
+            self._pending.append(seg)
+
+    def _run(self) -> None:
+        # Top-level guard: an exception anywhere outside _decide_round's
+        # own recovery (ingest, bookkeeping, even _record_locked inside
+        # the recovery handler) must not kill the worker with _idle
+        # cleared — that would wedge wait_idle()/close() (and bench's
+        # pacing loop) forever. Death folds the stream unknown (_dead),
+        # never a definite True over undecided ops.
+        try:
+            self._run_loop()
+        except Exception:  # noqa: BLE001 - the monitor must survive
+            LOG.warning("online scheduler worker died; stream folds "
+                        "unknown", exc_info=True)
+            with self._lock:
+                self._dead = True
+                for seg in self._pending:
+                    self._carry[seg.key] = "unknown"
+                    try:
+                        self._record_locked(
+                            seg, {"valid": "unknown",
+                                  "error": "scheduler worker died"}, None)
+                    except Exception:  # noqa: BLE001
+                        pass
+                self._pending = []
+        finally:
+            # However the worker exits, nothing may wait on it again:
+            # further submits must raise, and the idle event must fire.
+            with self._cnt_lock:
+                self._closed = True
+                self._inflight = 0
+            self._idle.set()
+
+    def _run_loop(self) -> None:
+        while True:
+            batch = self._inbox.get()
+            taken = 0
+            closing = batch is None
+            if not closing:
+                self._ingest(batch)
+                taken = 1
+                # Opportunistically drain everything already queued so
+                # one round sees the widest possible batch.
+                while True:
+                    try:
+                        more = self._inbox.get_nowait()
+                    except queue.Empty:
+                        break
+                    if more is None:
+                        closing = True
+                        break
+                    self._ingest(more)
+                    taken += 1
+            self._drain_ready()
+            # _drain_ready leaves _pending empty (the earliest pending
+            # segment of a key is always ready), so idleness is just
+            # "every submitted batch has been decided". On close,
+            # everything submitted before the marker has now been
+            # decided, so the in-flight count (undecidedness for the
+            # fold) zeros outright.
+            with self._cnt_lock:
+                self._inflight = 0 if closing else self._inflight - taken
+                if self._inflight == 0:
+                    self._idle.set()
+            if closing:
+                return
+
+    def _drain_ready(self) -> None:
+        while True:
+            ready = self._take_ready()
+            if not ready:
+                return
+            done: set = set()  # id() of segments _decide_round recorded
+            try:
+                self._decide_round(ready, done)
+            except Exception:  # noqa: BLE001 - the monitor must survive
+                LOG.warning("online segment round failed; folding unknown",
+                            exc_info=True)
+                with self._lock:
+                    for seg in ready:
+                        if id(seg) in done:  # recorded before the raise
+                            continue
+                        # The key's carry is lost with the round: later
+                        # segments have no initial state to check from.
+                        self._carry[seg.key] = "unknown"
+                        self._record_locked(seg, {"valid": "unknown",
+                                                  "error": "round failed"},
+                                            None)
+
+    def _take_ready(self) -> list[KeySegment]:
+        """Pop every pending segment whose key has no earlier pending
+        segment (per-key in-order; ready keys batch together)."""
+        ready: list[KeySegment] = []
+        taken_keys: set = set()
+        rest: list[KeySegment] = []
+        for seg in sorted(self._pending, key=lambda s: s.seq):
+            if seg.key in taken_keys:
+                rest.append(seg)
+            else:
+                taken_keys.add(seg.key)
+                ready.append(seg)
+        self._pending = rest
+        return ready
+
+    # -- deciding ------------------------------------------------------------
+
+    def _decide_round(self, ready: list[KeySegment], done: set) -> None:
+        # Build members; segments whose carry is lost fold unknown now.
+        members = []  # (seg, [EncodedHistory ...]) in ready order
+        for seg in ready:
+            carried = self._carry.get(seg.key)
+            if carried == "unknown":
+                with self._lock:
+                    self._record_locked(
+                        seg, {"valid": "unknown",
+                              "info": "carried state unknown"}, None)
+                done.add(id(seg))
+                continue
+            encs = encode_segment(self.model, seg, carried)
+            members.append((seg, encs))
+        if not members:
+            return
+        flat = [e for _seg, encs in members for e in encs]
+        seg_of = [seg for seg, encs in members for _ in encs]
+        # Stage 1: non-terminal members decide via the exhaustive
+        # enumerator — one BFS yields both the verdict and the carried
+        # end-state set, so the common valid path never pays a second
+        # decision.
+        # Stage 2: the engine's decide oracle (first-accept host check /
+        # PR-2 device batch) takes what the enumerator can't: terminal
+        # segments (their carry is never consumed, and a big
+        # non-quiescent tail must decide wherever offline does, not trip
+        # the enumeration budget) and budget-tripped rescues (the trip
+        # loses the CARRY, not the verdict).
+        results: list = [None] * len(flat)
+        durs = [0.0] * len(flat)  # per-member decide seconds
+        oracle_idx: list[int] = []
+        for idx, (seg, e) in enumerate(zip(seg_of, flat)):
+            if seg.terminal:
+                oracle_idx.append(idx)
+                continue
+            t1 = _time.perf_counter()
+            r = segment_states(e, max_configs=self.max_configs)
+            durs[idx] = _time.perf_counter() - t1
+            if r.get("valid") == "unknown":
+                oracle_idx.append(idx)
+            else:
+                results[idx] = r
+        if oracle_idx:
+            engine = self.engine
+            if engine == "auto":
+                engine = ("device" if self.model.device_capable
+                          and len(oracle_idx) > 1 else "host")
+            oracle_encs = [flat[i] for i in oracle_idx]
+            t1 = _time.perf_counter()
+            if engine == "device":
+                decided = self._decide_device(oracle_encs)
+            else:
+                from ..ops import wgl_host
+
+                decided = [wgl_host.check_encoded(
+                    e, max_configs=self.max_configs) for e in oracle_encs]
+            # A device batch decides all members in one program; split
+            # its wall evenly rather than charging it to the last row.
+            per_member = (_time.perf_counter() - t1) / len(oracle_idx)
+            for idx, r in zip(oracle_idx, decided):
+                durs[idx] += per_member
+                # `detail` keeps the oracle's own diagnostics so a
+                # refuted segment need not re-run a BFS to produce its
+                # witness (host shape: max_linearized + stuck_configs).
+                results[idx] = {"valid": r.get("valid"),
+                                "end_states": None,
+                                "enumeration_exhausted": True,
+                                "detail": r}
+        else:
+            engine = "host" if self.engine == "auto" else self.engine
+        oracle_set = set(oracle_idx)
+        i = 0
+        for seg, encs in members:
+            rs = results[i:i + len(encs)]
+            # Segments no member of which reached the oracle were
+            # decided wholly by the stage-1 host enumerator — label
+            # them so, whatever engine the round's oracle ran.
+            seg_engine = (engine if any(
+                k in oracle_set for k in range(i, i + len(encs)))
+                else "host")
+            seg_wall = sum(durs[i:i + len(encs)])
+            i += len(encs)
+            self._fold_segment(seg, encs, rs, seg_wall, seg_engine)
+            done.add(id(seg))
+
+    def _decide_device(self, encs: list) -> list[dict]:
+        """One vmapped batched-escalation program over all members
+        (parallel.batch); unknown members re-check individually through
+        the auto dispatch, like the lifted checker's batch seam."""
+        from ..ops import wgl
+        from ..parallel.batch import check_encoded_batch
+
+        results = check_encoded_batch(
+            encs, f=self.batch_f, metrics=self.metrics)
+        for i, r in enumerate(results):
+            if r.get("valid") == "unknown":
+                results[i] = wgl.check_encoded_device(encs[i],
+                                                      metrics=self.metrics)
+        return results
+
+    def _fold_segment(self, seg: KeySegment, encs, member_results,
+                      wall_s: float, engine: str) -> None:
+        valid_states: list = []
+        carry_lost = False
+        verdicts = []
+        for e, r in zip(encs, member_results):
+            v = r.get("valid")
+            verdicts.append(v)
+            if seg.terminal:
+                continue  # terminal end states are never consumed
+            if v is True:
+                # Oracle-decided members (enumeration_exhausted) carry
+                # no end states: the budget trip loses the carry, not
+                # the verdict.
+                states = r.get("end_states")
+                if states is None:
+                    carry_lost = True
+                else:
+                    valid_states.extend(states)
+            elif v is not False:
+                # An unknown member might still linearize from its
+                # candidate state into end states we cannot enumerate:
+                # narrowing the carry to the decided-valid members'
+                # states would be unsound (a later segment could refute
+                # from the narrowed set where offline is valid).
+                carry_lost = True
+        if any(v is True for v in verdicts):
+            verdict = True
+        elif all(v is False for v in verdicts):
+            verdict = False
+        else:
+            verdict = "unknown"
+        refutation = None
+        if verdict is False and self._violation is None:
+            # Witness diagnostics for the FIRST violation only (later
+            # refuted segments just fold; re-deriving a witness per
+            # segment would delay the abort signal the detection
+            # metrics measure). Prefer the oracle detail a refuted
+            # member already carries; fall back to one host BFS when
+            # the members were stage-1-decided (the enumerator returns
+            # no stuck configs). _violation has a single writer — this
+            # worker thread — so the unlocked read is safe.
+            refutation = next(
+                (r.get("detail") for r in member_results
+                 if r.get("valid") is False
+                 and (r.get("detail") or {}).get("stuck_configs")),
+                None)
+            if refutation is None:
+                from ..ops import wgl_host
+
+                try:
+                    refutation = wgl_host.check_encoded(
+                        encs[0], max_configs=self.max_configs)
+                except Exception:  # noqa: BLE001 - diagnostics only
+                    refutation = {"valid": False}
+        with self._lock:
+            if seg.terminal:
+                pass  # no later segment consumes this key's carry
+            elif verdict is True:
+                if carry_lost:
+                    # A lost enumeration on ANY valid member poisons the
+                    # whole carry — narrowing to the members that did
+                    # enumerate would be unsound.
+                    self._carry[seg.key] = "unknown"
+                else:
+                    seen = set()
+                    uniq = []
+                    for s in valid_states:
+                        if s not in seen:
+                            seen.add(s)
+                            uniq.append(s)
+                    self._carry[seg.key] = uniq
+            elif verdict == "unknown":
+                self._carry[seg.key] = "unknown"
+            self._record_locked(seg, {"valid": verdict}, refutation,
+                                wall_s=wall_s, engine=engine,
+                                members=len(encs))
+
+    # -- bookkeeping (callers hold the lock) ---------------------------------
+
+    def _record_locked(self, seg: KeySegment, result: dict,
+                       refutation: Optional[dict], wall_s: float = 0.0,
+                       engine: str = "none", members: int = 0) -> None:
+        row = {
+            "seq": seg.seq,
+            "key": None if seg.key == SINGLE_KEY else repr(seg.key),
+            "ops": seg.n_ops,
+            "start_index": seg.start_index,
+            "end_index": seg.end_index,
+            "terminal": seg.terminal,
+            "valid": result.get("valid"),
+            "engine": engine,
+            "members": members,
+            "wall_s": round(wall_s, 4),
+        }
+        if result.get("info"):
+            row["info"] = result["info"]
+        v = result.get("valid")
+        self._n_decided += 1
+        if v is False:
+            self._n_invalid += 1
+        elif v is not True:
+            self._n_unknown += 1
+        if len(self._segments) < self.max_segment_rows:
+            self._segments.append(row)
+        if result.get("valid") is False and self._violation is None:
+            self._violation = {
+                "segment": dict(row),
+                "refutation": {
+                    k: refutation.get(k)
+                    for k in ("max_linearized", "configs_explored",
+                              "stuck_configs")
+                } if refutation else None,
+            }
+            cb = self.on_violation
+            if cb is not None:
+                try:
+                    cb(self._violation)
+                except Exception:  # noqa: BLE001
+                    LOG.warning("on_violation callback failed",
+                                exc_info=True)
+        # Watermark: advance over the contiguous fully-decided prefix.
+        left = self._seq_outstanding.get(seg.seq, 0) - 1
+        self._seq_outstanding[seg.seq] = left
+        while self._seq_outstanding.get(self._next_seq) == 0:
+            self._watermark = max(self._watermark,
+                                  self._seq_end[self._next_seq])
+            del self._seq_outstanding[self._next_seq]
+            del self._seq_end[self._next_seq]
+            self._next_seq += 1
+        if self.metrics is not None:
+            self.metrics.counter(
+                "online_segments_total",
+                "Segments decided by the online monitor, by verdict",
+                labelnames=("verdict",)).labels(
+                    verdict=str(result.get("valid"))).inc()
+            self.metrics.gauge(
+                "online_decided_watermark",
+                "Highest history index through which the online verdict "
+                "is decided").set(self._watermark)
+
+    def _fold_locked(self) -> Any:
+        # merge_valid over EVERY decided segment, via counters — the
+        # display table is bounded, the fold must not be. Submitted but
+        # not-yet-decided segments (a close() that timed out mid-round)
+        # fold unknown: a definite True must cover the whole stream.
+        if self._n_invalid:
+            return False
+        if (self._n_unknown or self._inflight or self._seq_outstanding
+                or self._dead):
+            return "unknown"
+        return True

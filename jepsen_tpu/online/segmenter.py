@@ -1,0 +1,275 @@
+"""Streaming history segmenter: quiescent cuts + P-compositional key split.
+
+The decrease-and-conquer observation (PAPERS.md "Efficient
+Decrease-and-Conquer Linearizability Monitoring"): a history need not be
+decided as one monolithic search. Whenever the stream reaches a
+*quiescent* point — no invocation is open — real time totally orders
+everything before the cut against everything after it, so the history
+factors into closed segments that can be decided independently, provided
+each segment starts from a state the previous segment could actually
+have ended in. On top of that, P-compositionality (the
+``jepsen.independent`` key axis) splits each closed segment into per-key
+subsegments via the SAME ``history_keys``/``subhistory`` helpers the
+offline lifted checker uses, so the two paths cannot drift.
+
+Cut rules (the soundness contract, pinned by tests/test_online.py):
+
+- An invocation opens its process's interval; an ``:ok``/``:fail``
+  completion closes it. A cut is legal only at stream positions where no
+  interval is open.
+- An ``:info`` completion is indeterminate — knossos semantics keep its
+  interval open to the end of time — so the first ``:info`` *poisons*
+  quiescence: no further cut is ever legal, and the remainder of the
+  stream becomes one terminal segment (the no-quiescence slow path; the
+  process-pause nemesis exercises the transient version of this, where a
+  stalled invocation merely straddles a would-be cut point).
+
+State carry: segment k+1 must be checked from the states segment k could
+have ended in. :func:`segment_states` enumerates the EXACT feasible
+end-state set of a decided-valid segment (an exhaustive version of the
+host oracle's BFS — it keeps searching past the first accept and
+collects every accepting configuration's state, decoded to the semantic
+value domain via ``Model.decode_state`` so it survives the per-segment
+``ValueTable`` rebuild). Carrying the full set — not one arbitrary
+linearization's end state — is what makes the online verdict equal the
+offline one: two concurrent writes closing a segment leave {v1, v2} as
+legal initial states for the next.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+from typing import Any, Iterable, Optional
+
+import numpy as np
+
+from .. import independent as ind
+from ..history import History, Op
+from ..models import Model
+from ..ops.encode import EncodedHistory, encode_history
+
+# Key used for unkeyed (non-[k v]) histories: one stream, one carry.
+SINGLE_KEY = "__single__"
+
+
+@dataclass(frozen=True)
+class KeySegment:
+    """One key's slice of one closed segment of the stream.
+
+    ``ops`` are the key's subhistory ops with ``[k v]`` tuples unwrapped
+    (exactly what ``independent.subhistory`` hands the offline checker);
+    ``seq`` is the global segment ordinal (all KeySegments of one cut
+    share it); ``start_index``/``end_index`` bound the history indexes
+    the global segment covers; ``terminal`` marks the stream-end segment
+    (which may be non-quiescent: open/:info intervals are legal there).
+    """
+
+    key: Any
+    seq: int
+    ops: tuple
+    start_index: int
+    end_index: int
+    terminal: bool = False
+
+    @property
+    def n_ops(self) -> int:
+        return len(self.ops)
+
+
+class Segmenter:
+    """Incremental stream consumer: feed ops with :meth:`offer`, collect
+    closed :class:`KeySegment` lists; :meth:`finish` flushes the terminal
+    segment. Tracks in-flight invocations per process and cuts at
+    quiescent points only (see module docstring for the rules)."""
+
+    def __init__(self) -> None:
+        self._buffer: list[Op] = []
+        self._open: set = set()  # processes with an open invocation
+        self._poisoned = False  # an :info interval is open to end of time
+        self._seq = 0
+        self._next_index = 0  # assigned when ops arrive unindexed
+        self.ops_seen = 0
+        self._saw_keyed = False
+        self._saw_keyless = False
+
+    @property
+    def open_ops(self) -> int:
+        """Ops buffered in the not-yet-closed segment (telemetry)."""
+        return len(self._buffer)
+
+    @property
+    def open_invocations(self) -> int:
+        return len(self._open)
+
+    @property
+    def poisoned(self) -> bool:
+        return self._poisoned
+
+    @property
+    def segments_emitted(self) -> int:
+        return self._seq
+
+    @property
+    def mixed_keys(self) -> bool:
+        """True when the stream mixes keyed (``[k v]``) and keyless
+        client ops. Offline, ``independent.subhistory`` folds every
+        keyless op into EVERY key's subhistory — including keys that
+        first appear later in the stream — which a streaming split
+        cannot reproduce, so the monitor degrades the fold to
+        "unknown" rather than risk a verdict offline contradicts."""
+        return self._saw_keyed and self._saw_keyless
+
+    def _as_op(self, op) -> Op:
+        if not isinstance(op, Op):
+            op = Op.from_dict(op)
+        if op.index < 0:
+            op = op.with_(index=self._next_index)
+        self._next_index = max(self._next_index, op.index + 1)
+        return op
+
+    def offer(self, op) -> list[KeySegment]:
+        """Consume one history op (Op or plain scheduler dict); returns
+        the KeySegments of a newly closed segment, usually ``[]``."""
+        op = self._as_op(op)
+        self.ops_seen += 1
+        if not op.is_client:
+            return []  # nemesis ops have no invoke/complete discipline
+        if ind.is_tuple(op.value):
+            self._saw_keyed = True
+        else:
+            self._saw_keyless = True
+        self._buffer.append(op)
+        if op.is_invoke:
+            self._open.add(op.process)
+            return []
+        self._open.discard(op.process)
+        if op.is_info:
+            # Indeterminate: the interval stays open forever; quiescence
+            # is unreachable from here on (knossos OPEN-ret semantics).
+            self._poisoned = True
+        if self._open or self._poisoned or not self._buffer:
+            return []
+        return self._cut(terminal=False)
+
+    def finish(self) -> list[KeySegment]:
+        """Flush whatever remains as the terminal segment (legal even
+        when non-quiescent: open intervals encode as OPEN there, exactly
+        like the offline checker sees them)."""
+        if not self._buffer:
+            return []
+        return self._cut(terminal=True)
+
+    def _cut(self, terminal: bool) -> list[KeySegment]:
+        ops, self._buffer = self._buffer, []
+        seq = self._seq
+        self._seq += 1
+        start = ops[0].index
+        end = ops[-1].index
+        keys = sorted(ind.history_keys(ops), key=repr)
+        if not keys:
+            return [KeySegment(SINGLE_KEY, seq, tuple(ops), start, end,
+                               terminal)]
+        out = []
+        for k in keys:
+            sub = ind.subhistory(k, History(ops, reindex=False))
+            out.append(KeySegment(k, seq, tuple(sub), start, end, terminal))
+        return out
+
+
+# ---------------------------------------------------------------------------
+# State carry: encoding a segment from carried states, and enumerating
+# the feasible end states of a decided segment.
+
+
+def encode_segment(model: Model, seg: KeySegment,
+                   carried: Optional[Iterable[tuple]]) -> list[EncodedHistory]:
+    """Encode ``seg`` once per carried initial state.
+
+    ``carried`` is an iterable of *decoded* (semantic) states from the
+    previous segment's :func:`segment_states`, or None for the stream's
+    first segment (the model's own init). Each returned member shares
+    the segment's op rows but starts from one candidate state — the
+    batch members the scheduler hands to the PR-2 pipeline; the segment
+    is valid iff ANY member is.
+    """
+    base = encode_history(model, History(list(seg.ops), reindex=False))
+    if carried is None:
+        return [base]
+    out = []
+    for st in carried:
+        lanes = model.encode_state(st, base.table)
+        out.append(replace(base, init_state=np.asarray(lanes,
+                                                       dtype=np.int32)))
+    return out
+
+
+def segment_states(enc: EncodedHistory,
+                   max_configs: int = 500_000) -> dict:
+    """Exhaustively decide one encoded segment AND enumerate its feasible
+    end states.
+
+    Unlike the host oracle (ops/wgl_host.py), which stops at the first
+    accepting configuration, this BFS runs the whole reachable config
+    space so the returned ``end_states`` is the EXACT set of states some
+    valid linearization ends in — the next segment's legal initial
+    states. Returns ``{"valid": True|False|"unknown", "end_states":
+    [decoded states] | None, "configs_explored": n}``; ``end_states`` is
+    None on a budget trip (the caller then carries "unknown" forward).
+
+    Closed segments contain no ``:info`` ops (an :info poisons
+    quiescence, so only terminal segments can carry them); skippable
+    rows are handled anyway for the terminal case.
+    """
+    model = enc.model
+    n = enc.n
+    init = tuple(int(x) for x in enc.init_state)
+    if n == 0:
+        return {"valid": True,
+                "end_states": [model.decode_state(init, enc.table)],
+                "configs_explored": 0}
+    from ..ops import wgl_host
+
+    required = frozenset(i for i in range(n) if not enc.skippable[i])
+    ret_order = sorted(range(n), key=lambda i: int(enc.ret[i]))
+    start = (frozenset(), init)
+    frontier = {start}
+    seen = {start}
+    explored = 0
+    accepting_states: set = set()
+
+    def accepting(cfg) -> bool:
+        return required <= cfg[0]
+
+    if accepting(start):
+        accepting_states.add(init)
+    while frontier:
+        nxt = set()
+        for linearized, state in frontier:
+            explored += 1
+            if explored > max_configs:
+                return {"valid": "unknown", "end_states": None,
+                        "configs_explored": explored,
+                        "info": f"config budget {max_configs} exhausted"}
+            # Successor rule shared with the first-accept oracle
+            # (wgl_host.expand) — the differential contract depends on
+            # the two searches agreeing.
+            for j, state2 in wgl_host.expand(enc, linearized, state,
+                                             ret_order):
+                cfg2 = (linearized | {j}, state2)
+                if cfg2 in seen:
+                    continue
+                seen.add(cfg2)
+                if accepting(cfg2):
+                    accepting_states.add(state2)
+                nxt.add(cfg2)
+        frontier = nxt
+    if not accepting_states:
+        return {"valid": False, "end_states": [],
+                "configs_explored": explored}
+    return {
+        "valid": True,
+        "end_states": sorted(
+            (model.decode_state(s, enc.table) for s in accepting_states),
+            key=repr),
+        "configs_explored": explored,
+    }

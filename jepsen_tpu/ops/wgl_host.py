@@ -29,6 +29,41 @@ from ..history import History
 from ..models import Model
 
 
+def expand(enc: EncodedHistory, linearized: frozenset, state: tuple,
+           ret_order: list):
+    """Yield ``(j, state2)`` for every op legally linearizable next from
+    configuration ``(linearized, state)``.
+
+    This is the single copy of the WGL successor rule — real-time
+    pruning (op j may go next only if no still-unlinearized op completed
+    before j was invoked, j's own completion excluded from the bound)
+    plus the model transition. Shared by the first-accept oracle below
+    AND the exhaustive end-state enumerator
+    (``jepsen_tpu.online.segmenter.segment_states``): the online
+    differential contract depends on the two searches agreeing, so any
+    change to the rule lands in both by construction.
+    """
+    inv, ret, model = enc.inv, enc.ret, enc.model
+    # min completion among unlinearized ops (first unlinearized in ret
+    # order)
+    min_ret = int(OPEN) + 1
+    for i in ret_order:
+        if i not in linearized:
+            min_ret = int(ret[i])
+            break
+    for j in range(enc.n):
+        if j in linearized:
+            continue
+        # j's own ret may be the min; exclude it from the bound
+        if inv[j] >= min_ret and ret[j] != min_ret:
+            continue
+        ok, state2 = model.step_scalar(state, int(enc.opcode[j]),
+                                       int(enc.a1[j]), int(enc.a2[j]))
+        if not ok:
+            continue
+        yield j, state2
+
+
 def check_encoded(
     enc: EncodedHistory,
     max_configs: int = 500_000,
@@ -41,12 +76,10 @@ def check_encoded(
     not.
     """
     n = enc.n
-    inv = enc.inv
     ret = enc.ret
     skippable = enc.skippable
     required = frozenset(i for i in range(n) if not skippable[i])
     init = tuple(int(x) for x in enc.init_state)
-    model = enc.model
 
     if n == 0:
         return {"valid": True, "op_count": 0, "witness": [], "configs_explored": 0}
@@ -78,22 +111,7 @@ def check_encoded(
                     "frontier_max": frontier_max,
                     "info": f"config budget {max_configs} exhausted",
                 }
-            # min completion among unlinearized ops (first unlinearized in
-            # ret order)
-            min_ret = int(OPEN) + 1
-            for i in ret_order:
-                if i not in linearized:
-                    min_ret = int(ret[i])
-                    break
-            for j in range(n):
-                if j in linearized:
-                    continue
-                # j's own ret may be the min; exclude it from the bound
-                if inv[j] >= min_ret and ret[j] != min_ret:
-                    continue
-                ok, state2 = model.step_scalar(state, int(enc.opcode[j]), int(enc.a1[j]), int(enc.a2[j]))
-                if not ok:
-                    continue
+            for j, state2 in expand(enc, linearized, state, ret_order):
                 cfg2 = (linearized | {j}, state2)
                 if cfg2 not in parents:
                     parents[cfg2] = (cfg, j)

@@ -616,15 +616,71 @@ def random_register_encoded(
     )
 
 
-def perturb_history(rng: random.Random, history: History) -> History:
-    """Mutate one completion value — usually breaking linearizability."""
+def chunked_register_history(
+    rng: random.Random,
+    n_ops: int = 10_000,
+    n_procs: int = 4,
+    chunk_ops: int = 120,
+    cas: bool = True,
+    fail_p: float = 0.02,
+    values: int = 5,
+) -> History:
+    """A linearizable-by-construction register history with GUARANTEED
+    quiescent cut points — the online monitor's bench/test vehicle.
+
+    Concatenates :func:`random_register_history` chunks (crash_p=0, so
+    no :info op ever poisons quiescence). Each chunk drains all pending
+    invocations before it ends, so every chunk boundary is quiescent;
+    and each chunk is prefixed by a *sequential* ``write 0`` pair
+    (invoked and completed before anything else in the chunk), which
+    real-time-orders it first and resets the register to the state the
+    fresh chunk simulation assumed — so the concatenation stays
+    linearizable end to end. Times and indexes are rewritten globally
+    monotone.
+    """
+    ops: list[Op] = []
+    t = 0
+    while len(ops) < 2 * n_ops:
+        chunk = random_register_history(
+            rng, n_ops=min(chunk_ops, n_ops), n_procs=n_procs, cas=cas,
+            crash_p=0.0, fail_p=fail_p, values=values)
+        t += 10
+        ops.append(Op("invoke", 0, "write", 0, time=t))
+        t += 10
+        ops.append(Op("ok", 0, "write", 0, time=t))
+        for op in chunk:
+            t += 1
+            ops.append(op.with_(time=t))
+    # Whole chunks only (a mid-chunk truncation would strand open
+    # invocations); ~n_ops invocations, callers take len() as truth.
+    return History(ops, reindex=True)
+
+
+def perturb_history(rng: random.Random, history: History,
+                    within: float = 1.0) -> History:
+    """Mutate one completion value — usually breaking linearizability.
+
+    ``within`` restricts the mutated read to the first fraction of the
+    history (the online bench seeds its violation early, so detection
+    has room to beat the stream). ``[k v]``-tupled (independent) values
+    mutate the inner value, keeping the key."""
     ops = list(history)
-    ok_reads = [i for i, op in enumerate(ops) if op.is_ok and op.f == "read"]
+    bound = max(1, int(len(ops) * within))
+    ok_reads = [i for i, op in enumerate(ops[:bound])
+                if op.is_ok and op.f == "read"]
     if not ok_reads:
         return history
     i = rng.choice(ok_reads)
     op = ops[i]
-    ops[i] = op.with_(value=(op.value if op.value is None else op.value + 17) or 23)
+
+    def mut(v):
+        return (v if v is None else v + 17) or 23
+
+    from ..independent import KV
+
+    v = op.value
+    ops[i] = op.with_(value=KV(v.key, mut(v.value)) if isinstance(v, KV)
+                      else mut(v))
     return History(ops, reindex=False)
 
 

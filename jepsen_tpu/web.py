@@ -55,7 +55,7 @@ td, th { border: 1px solid #ccc; padding: 0.3em 0.8em; text-align: left; }
 # tracing + profiling sinks; everything else is reachable through the
 # file listing).
 _TELEMETRY_FILES = ("metrics.jsonl", "metrics.prom", "spans.jsonl",
-                    "profile.json", "flightrecord.json")
+                    "profile.json", "flightrecord.json", "online.json")
 
 
 def _index_page(root: Path) -> str:
@@ -84,7 +84,8 @@ def _index_page(root: Path) -> str:
         f"<html><head><title>Jepsen</title><style>{_STYLE}</style></head>"
         "<body><h1>Jepsen tests</h1>"
         '<p><a href="/metrics">metrics</a> · '
-        '<a href="/profile">profile</a></p><table>'
+        '<a href="/profile">profile</a> · '
+        '<a href="/online">online</a></p><table>'
         "<tr><th>Test</th><th>Started</th><th>Valid?</th>"
         "<th>Telemetry</th><th></th></tr>"
         + "".join(rows) + "</table></body></html>"
@@ -278,6 +279,77 @@ def _profile_page(root: Path) -> str:
     )
 
 
+def _online_section(doc: dict) -> str:
+    """Render one run's online.json: live watermark + verdict headline,
+    detection info when a violation aborted the run, and the decided
+    segment table."""
+    v = doc.get("valid")
+    vs = {True: "valid", False: "INVALID",
+          "unknown": "unknown"}.get(v, str(v))
+    cls = {True: "valid-true", False: "valid-false",
+           "unknown": "valid-unknown"}.get(v, "")
+    head = (
+        f'<p class="{cls}">online verdict: <b>{html.escape(vs)}</b> · '
+        f"decided through index {doc.get('decided_through_index')} of "
+        f"{doc.get('ops_observed')} ops · "
+        f"{doc.get('segments_decided')} segments"
+        + (" · <b>run aborted on violation</b>" if doc.get("aborted")
+           else "") + "</p>")
+    if doc.get("ops_to_detection") is not None:
+        head += (
+            f"<p>detection: {doc['ops_to_detection']} ops / "
+            f"{doc.get('seconds_to_detection')} s to the first invalid "
+            "segment</p>")
+    rows = "".join(
+        "<tr>" + "".join(
+            f"<td>{html.escape(str(s.get(k, '—')))}</td>"
+            for k in ("seq", "key", "ops", "start_index", "end_index",
+                      "valid", "engine", "members", "wall_s",
+                      "terminal"))
+        + "</tr>"
+        for s in (doc.get("segments") or [])[:200])
+    table = (
+        "<table><tr><th>seq</th><th>key</th><th>ops</th><th>start</th>"
+        "<th>end</th><th>valid</th><th>engine</th><th>members</th>"
+        "<th>wall s</th><th>terminal</th></tr>" + rows + "</table>"
+        if rows else "<p>(no segments decided)</p>")
+    return head + table
+
+
+def _online_page(root: Path) -> str:
+    sections = []
+    tests = store.tests(root=root)
+    for name in sorted(tests):
+        for start in sorted(tests[name], reverse=True):
+            run = tests[name][start]
+            f = run / "online.json"
+            if not f.exists():
+                continue
+            try:
+                doc = json.loads(f.read_text())
+            except Exception:
+                doc = None
+            sections.append(
+                f'<h2><a href="/files/{name}/{start}/">'
+                f"{html.escape(name)} / {html.escape(start)}</a></h2>"
+                f'<p><a href="/files/{name}/{start}/online.json">'
+                "online.json</a></p>"
+                + (_online_section(doc) if doc is not None
+                   else "<p>(unparseable online.json)</p>"))
+    if not sections:
+        sections.append(
+            "<p>No runs with online monitoring yet — run a test with "
+            "<code>--online</code>.</p>")
+    return (
+        f"<html><head><title>Jepsen online monitor</title>"
+        f"<style>{_STYLE}</style></head>"
+        "<body><h1>Online linearizability monitor</h1>"
+        '<p><a href="/">index</a> · <a href="/metrics">metrics</a> · '
+        '<a href="/profile">profile</a></p>'
+        + "".join(sections) + "</body></html>"
+    )
+
+
 def _listing_page(rel: str, d: Path) -> str:
     items = "".join(
         f'<li><a href="/files/{rel}{f.name}{"/" if f.is_dir() else ""}">'
@@ -314,6 +386,9 @@ def make_handler(root: Path):
                     return
                 if path in ("/profile", "/profile/"):
                     self._send(200, _profile_page(root).encode())
+                    return
+                if path in ("/online", "/online/"):
+                    self._send(200, _online_page(root).encode())
                     return
                 if path.startswith("/zip/"):
                     rel = path[len("/zip/"):].strip("/")

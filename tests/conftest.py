@@ -37,6 +37,10 @@ def pytest_configure(config):
         "markers",
         "slow: compile-heavy tests excluded from the tier-1 budget "
         "(tier-1 runs -m 'not slow')")
+    config.addinivalue_line(
+        "markers",
+        "online: online linearizability monitor tests "
+        "(jepsen_tpu.online; select with -m online)")
 
 
 def pytest_addoption(parser):

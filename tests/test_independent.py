@@ -96,6 +96,87 @@ class TestSubhistory:
         assert [o["value"] for o in s1] == ["a", "a", None]
         assert s1[2]["process"] == "nemesis"
 
+    def test_history_keys_ignores_untupled_values(self):
+        # Plain (non-KV) values contribute no key — even value tuples
+        # that merely LOOK like [k v] pairs (cas payloads).
+        h = [
+            {"type": "invoke", "process": 0, "f": "cas", "value": (1, 2)},
+            {"type": "invoke", "process": 1, "f": "r", "value": None},
+            {"type": "invoke", "process": 2, "f": "w",
+             "value": ind.KV("x", 3)},
+        ]
+        assert ind.history_keys(h) == {"x"}
+        assert ind.history_keys([]) == set()
+
+    def test_history_keys_mixed_key_types_on_ops(self):
+        # Op objects and dicts both feed the key set; keys may be any
+        # hashable (ints, strings, tuples).
+        h = [
+            Op.from_dict({"type": "invoke", "process": 0, "f": "w",
+                          "value": ind.KV(("shard", 0), 1), "time": 0,
+                          "index": 0}),
+            {"type": "invoke", "process": 1, "f": "w",
+             "value": ind.KV(7, 2)},
+        ]
+        assert ind.history_keys(h) == {("shard", 0), 7}
+
+    def test_subhistory_unwraps_only_the_outer_tuple(self):
+        # Nested KV values: the outer [k v] is the independent axis; an
+        # inner KV (or list payload) is the workload's own value and
+        # must survive untouched.
+        inner = ind.KV("b", 1)
+        h = [
+            {"type": "invoke", "process": 0, "f": "w",
+             "value": ind.KV("a", inner)},
+            {"type": "ok", "process": 0, "f": "w",
+             "value": ind.KV("a", [1, 2])},
+        ]
+        s = ind.subhistory("a", h)
+        assert s[0]["value"] is inner
+        assert s[1]["value"] == [1, 2]
+
+    def test_subhistory_keeps_info_and_other_keyless_ops(self):
+        # :info ops (crashed clients, nemesis transitions) carry no key
+        # when their value is None/untupled: they land in EVERY key's
+        # subhistory (independent.clj:250-261 keeps ops "without a
+        # differing key"); keyed :info ops land only in their own.
+        h = [
+            {"type": "invoke", "process": 0, "f": "w",
+             "value": ind.KV("a", 1)},
+            {"type": "info", "process": 0, "f": "w", "value": None},
+            {"type": "invoke", "process": 1, "f": "w",
+             "value": ind.KV("b", 2)},
+            {"type": "info", "process": 1, "f": "w",
+             "value": ind.KV("b", 2)},
+        ]
+        sa = ind.subhistory("a", h)
+        sb = ind.subhistory("b", h)
+        assert [o["value"] for o in sa] == [1, None]
+        assert [o["value"] for o in sb] == [None, 2, 2]
+        assert ind.subhistory("missing", h)[0]["value"] is None
+
+    def test_subhistory_of_ops_is_history_with_original_indexes(self):
+        # All-Op inputs come back as a History WITHOUT reindexing — the
+        # per-key indexes still point into the global history (what the
+        # lifted checker and the online segmenter both rely on).
+        ops = [
+            Op.from_dict({"type": "invoke", "process": 0, "f": "w",
+                          "value": ind.KV("a", 1), "time": 0, "index": 0}),
+            Op.from_dict({"type": "invoke", "process": 1, "f": "w",
+                          "value": ind.KV("b", 2), "time": 1, "index": 1}),
+            Op.from_dict({"type": "ok", "process": 0, "f": "w",
+                          "value": ind.KV("a", 1), "time": 2, "index": 2}),
+        ]
+        s = ind.subhistory("a", History(ops, reindex=False))
+        assert isinstance(s, History)
+        assert [o.index for o in s] == [0, 2]
+        assert [o.value for o in s] == [1, 1]
+        # Mixed dict/Op input degrades to a plain list.
+        s2 = ind.subhistory("a", ops[:1] + [
+            {"type": "ok", "process": 0, "f": "w",
+             "value": ind.KV("a", 1)}])
+        assert not isinstance(s2, History) and len(s2) == 2
+
 
 class TestChecker:
     def test_even_checker(self):

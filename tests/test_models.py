@@ -38,6 +38,31 @@ def test_value_table():
     assert t.lookup(UNKNOWN) is None
 
 
+def test_state_portability_across_tables():
+    # decode_state/encode_state (the online monitor's cross-segment
+    # carry) must round-trip a state through a DIFFERENT ValueTable:
+    # value-interning models re-intern, lane-valued models pass ints.
+    from jepsen_tpu.models import UnorderedQueue
+
+    t1, t2 = ValueTable(), ValueTable()
+    reg = CasRegister(init=0)
+    lanes = (t1.intern(7),)
+    sem = reg.decode_state(lanes, t1)
+    assert sem == (7,)
+    assert t2.lookup(reg.encode_state(sem, t2)[0]) == 7
+
+    q = UnorderedQueue()
+    qlanes = tuple(t1.intern(v) for v in ("a", "b"))
+    qsem = q.decode_state(qlanes, t1)
+    assert qsem == ("a", "b")
+    assert [t2.lookup(x) for x in q.encode_state(qsem, t2)] == ["a", "b"]
+
+    m = Mutex()
+    st = m.init_state(t1)
+    assert m.encode_state(m.decode_state(st, t1), t2) == \
+        tuple(int(x) for x in st)
+
+
 def _agree(model, states_ops):
     """Assert step_scalar and step_jax agree on a batch of transitions."""
     states = np.array([s for s, *_ in states_ops], dtype=np.int32)

@@ -205,3 +205,46 @@ class TestHammerTime:
             res = h.invoke(test, {"type": "info", "f": "start"})
         assert "already disrupting" in str(res["value"])
         h.invoke(test, {"type": "info", "f": "stop"})
+
+
+class TestProcessPause:
+    """Minimal process-pause nemesis for the simulated generator
+    (nemesis/pause.py) — the online monitor's no-quiescence fault."""
+
+    def test_pause_resume_tracks_paused_set(self):
+        from jepsen_tpu.nemesis.pause import ProcessPause
+
+        p = ProcessPause()
+        res = p.invoke({}, {"type": "info", "f": "pause", "value": [0, 2]})
+        assert res["value"] == [0, 2] and p.paused == {0, 2}
+        res = p.invoke({}, {"type": "info", "f": "resume", "value": [2]})
+        assert res["value"] == [0] and p.paused == {0}
+        # resume with value None clears every pause.
+        p.invoke({}, {"type": "info", "f": "pause", "value": [1]})
+        res = p.invoke({}, {"type": "info", "f": "resume", "value": None})
+        assert res["value"] == [] and p.paused == set()
+
+    def test_default_targets_and_reflection(self):
+        from jepsen_tpu.nemesis.pause import ProcessPause
+
+        p = ProcessPause(processes=[3])
+        p.invoke({}, {"type": "info", "f": "pause", "value": None})
+        assert p.paused == {3}
+        assert p.fs() == ["pause", "resume"]
+        p.teardown({})
+        assert p.paused == set()
+        with pytest.raises(ValueError):
+            p.invoke({}, {"type": "info", "f": "hammer"})
+
+    def test_stalled_completions_split_latency(self):
+        from jepsen_tpu.nemesis.pause import ProcessPause, \
+            stalled_completions
+
+        p = ProcessPause()
+        complete = stalled_completions(p, latency=10, stall=5000)
+        p.paused = {1}
+        fast = complete(None, {"process": 0, "time": 100})
+        slow = complete(None, {"process": 1, "time": 100})
+        assert fast["type"] == slow["type"] == "ok"
+        assert fast["time"] == 110
+        assert slow["time"] == 5100

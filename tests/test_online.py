@@ -1,0 +1,851 @@
+"""Online linearizability monitor (jepsen_tpu.online).
+
+Differential safety is the contract under test: for any history, the
+folded online verdict must equal the offline ``ops.wgl.check_history``
+verdict — valid, seeded-invalid, and overflow-unknown, including a
+history with no quiescent point (single terminal segment), with
+``abort_on_violation`` both on and off. Plus the streaming mechanics
+(quiescent cuts, :info poisoning, P-compositional key split, exact
+state carry), the scheduler's monotone watermark, early detection /
+abort-before-drain on a live interpreter run, and the zero-overhead
+off path (poisoned-constructor check, mirroring tests/test_profile.py).
+
+Everything here runs the compile-free host engine except the
+device-engine differential, which is marked ``slow`` (tier-1 runs
+``-m 'not slow'`` and has no budget for new compiles)."""
+
+import random
+import threading
+import time
+
+import pytest
+
+from jepsen_tpu import checker as jchecker
+from jepsen_tpu import core
+from jepsen_tpu import generator as gen
+from jepsen_tpu import independent as ind
+from jepsen_tpu.history import History, Op
+from jepsen_tpu.models import CasRegister
+from jepsen_tpu.online import (
+    SINGLE_KEY,
+    OnlineMonitor,
+    Segmenter,
+    SegmentScheduler,
+    encode_segment,
+    segment_states,
+)
+from jepsen_tpu.online.segmenter import KeySegment
+from jepsen_tpu.ops import wgl
+from jepsen_tpu.telemetry import Registry
+from jepsen_tpu.testing import (
+    chunked_register_history,
+    perturb_history,
+    random_register_history,
+)
+from jepsen_tpu.workloads import AtomClient, AtomDB, AtomState, noop_test
+
+pytestmark = pytest.mark.online
+
+
+def model():
+    return CasRegister(init=0)
+
+
+def stream(monitor: OnlineMonitor, history) -> dict:
+    for op in history:
+        monitor.observe(op)
+        if monitor.aborted:
+            break
+    return monitor.finish()
+
+
+def offline(history, **kw):
+    return wgl.check_history(model(), history, backend="host", **kw)
+
+
+def ops4(*specs):
+    """[(type, process, f, value), ...] -> History (times = positions)."""
+    return History([Op(t, p, f, v, time=i)
+                    for i, (t, p, f, v) in enumerate(specs)], reindex=True)
+
+
+# ---------------------------------------------------------------------------
+
+
+class TestSegmenter:
+    def test_sequential_ops_cut_at_every_completion(self):
+        h = ops4(("invoke", 0, "write", 1), ("ok", 0, "write", 1),
+                 ("invoke", 1, "read", None), ("ok", 1, "read", 1))
+        seg = Segmenter()
+        cuts = [seg.offer(op) for op in h]
+        assert [len(c) for c in cuts] == [0, 1, 0, 1]
+        assert cuts[1][0].ops[0].f == "write"
+        assert cuts[3][0].seq == 1
+        assert seg.finish() == []  # nothing buffered
+
+    def test_overlap_straddles_cut(self):
+        # p1's invocation is open when p0 completes: no cut until both
+        # close.
+        h = ops4(("invoke", 0, "write", 1), ("invoke", 1, "write", 2),
+                 ("ok", 0, "write", 1), ("ok", 1, "write", 2))
+        seg = Segmenter()
+        cuts = [len(seg.offer(op)) for op in h]
+        assert cuts == [0, 0, 0, 1]
+
+    def test_info_poisons_quiescence(self):
+        h = ops4(("invoke", 0, "write", 1), ("info", 0, "write", 1),
+                 ("invoke", 1, "write", 2), ("ok", 1, "write", 2))
+        seg = Segmenter()
+        assert [len(seg.offer(op)) for op in h] == [0, 0, 0, 0]
+        assert seg.poisoned
+        tail = seg.finish()
+        assert len(tail) == 1 and tail[0].terminal
+        assert tail[0].n_ops == 4
+
+    def test_terminal_segment_may_be_open(self):
+        seg = Segmenter()
+        assert seg.offer(Op("invoke", 0, "write", 1, time=0)) == []
+        tail = seg.finish()
+        assert len(tail) == 1 and tail[0].terminal and tail[0].n_ops == 1
+
+    def test_nemesis_ops_skipped(self):
+        seg = Segmenter()
+        assert seg.offer(Op("info", "nemesis", "pause", None, time=0)) == []
+        assert seg.open_ops == 0 and seg.open_invocations == 0
+
+    def test_keyed_cut_splits_per_key_same_seq(self):
+        h = ops4(("invoke", 0, "write", ind.KV("a", 1)),
+                 ("invoke", 1, "write", ind.KV("b", 2)),
+                 ("ok", 0, "write", ind.KV("a", 1)),
+                 ("ok", 1, "write", ind.KV("b", 2)))
+        seg = Segmenter()
+        cuts = seg.offer(h[0]) + seg.offer(h[1]) + seg.offer(h[2]) \
+            + seg.offer(h[3])
+        assert {s.key for s in cuts} == {"a", "b"}
+        assert {s.seq for s in cuts} == {0}
+        # Tuples are unwrapped, exactly like independent.subhistory.
+        for s in cuts:
+            assert all(not ind.is_tuple(op.value) for op in s.ops)
+
+    def test_plain_dict_ops_accepted(self):
+        seg = Segmenter()
+        seg.offer({"type": "invoke", "process": 0, "f": "write",
+                   "value": 1, "time": 0})
+        cut = seg.offer({"type": "ok", "process": 0, "f": "write",
+                         "value": 1, "time": 1})
+        assert len(cut) == 1 and cut[0].key == SINGLE_KEY
+
+
+class TestPauseNemesis:
+    """The process-pause nemesis (nemesis/pause.py) under the simulated
+    generator: a stalled invocation straddles every would-be cut point
+    (the no-quiescence slow path), and the buffered ops ride forward
+    until the stall completes."""
+
+    def run_sim(self, paused: bool):
+        from jepsen_tpu.generator import sim
+        from jepsen_tpu.nemesis.pause import ProcessPause, \
+            stalled_completions
+
+        pause = ProcessPause()
+        complete = sim.with_nemesis(
+            pause, stalled_completions(pause, latency=10, stall=100_000))
+        vals = iter(range(1, 100))
+        client = gen.limit(16, lambda: {"f": "write",
+                                        "value": next(vals)})
+        nem_track = ([{"type": "info", "f": "pause", "value": [1]}]
+                     if paused else [])
+        g = gen.nemesis(nem_track + [{"type": "info", "f": "resume",
+                                      "value": None}],
+                        gen.clients(client))
+        return sim.simulate(g, complete,
+                            sim.n_plus_nemesis_context(2))
+
+    def segment(self, history):
+        seg = Segmenter()
+        cuts = [seg.offer(op) for op in history]
+        return seg, cuts
+
+    def test_stalled_invocation_straddles_cut_points(self):
+        h = self.run_sim(paused=True)
+        # The paused process's completion lands last, 100k ns out.
+        stalls = [o for o in h if o.get("process") == 1
+                  and o.get("type") == "ok"]
+        assert len(stalls) == 1 and h[-1] is stalls[0]
+        seg, cuts = self.segment(h)
+        closed = [c for c in cuts if c]
+        # NO cut until the stalled op completes — every would-be
+        # quiescent point of the unpaused process is straddled — then
+        # ONE segment closes carrying every buffered client op.
+        assert len(closed) == 1 and cuts[-1] is closed[0]
+        n_client = sum(1 for o in h if o.get("process") != "nemesis")
+        assert closed[0][0].n_ops == n_client
+        assert seg.finish() == []
+
+    def test_same_stream_without_stalled_interval_cuts_freely(self):
+        # Control: drop the stalled process's ops from the SAME stream
+        # and the remaining (sequential) completions quiesce constantly
+        # — the straddle above is the open invocation, not the workload.
+        h = self.run_sim(paused=True)
+        h2 = [o for o in h if o.get("process") != 1]
+        _seg, cuts = self.segment(h2)
+        assert sum(1 for c in cuts if c) >= 15
+
+    def test_monitor_verdict_survives_pause(self):
+        h = self.run_sim(paused=True)
+        hist = History([Op.from_dict(o) for o in h], reindex=True)
+        assert offline(hist)["valid"] is True
+        mon = OnlineMonitor(model(), engine="host")
+        fin = stream(mon, hist)
+        assert fin["valid"] is True
+        assert fin["segments_decided"] == 1
+
+
+class TestSegmentStates:
+    def seg(self, h):
+        return KeySegment(SINGLE_KEY, 0, tuple(h), 0, len(h) - 1)
+
+    def test_concurrent_writes_enumerate_both_end_states(self):
+        h = ops4(("invoke", 0, "write", 1), ("invoke", 1, "write", 2),
+                 ("ok", 0, "write", 1), ("ok", 1, "write", 2))
+        enc = encode_segment(model(), self.seg(h), None)[0]
+        res = segment_states(enc)
+        assert res["valid"] is True
+        assert sorted(res["end_states"]) == [(1,), (2,)]
+
+    def test_invalid_segment(self):
+        h = ops4(("invoke", 0, "write", 1), ("ok", 0, "write", 1),
+                 ("invoke", 0, "read", None), ("ok", 0, "read", 9))
+        enc = encode_segment(model(), self.seg(h), None)[0]
+        res = segment_states(enc)
+        assert res["valid"] is False and res["end_states"] == []
+
+    def test_budget_trip_is_unknown(self):
+        h = random_register_history(random.Random(0), n_ops=40, n_procs=8)
+        enc = encode_segment(model(), self.seg(h), None)[0]
+        res = segment_states(enc, max_configs=3)
+        assert res["valid"] == "unknown" and res["end_states"] is None
+
+    def test_mutex_owner_carry_across_tables(self):
+        # OwnerAwareMutex's owner lane is an interned ("process", p) id,
+        # so a raw-lane carry is only sound when both segments' tables
+        # happen to agree. Here they don't: segment 1's table interns
+        # ("process", 1) as id 0 (p1's acquire encodes first), segment
+        # 2's as id 1 (p0's acquire encodes first) — the carry must
+        # round-trip through the semantic owner.
+        from jepsen_tpu.models import OwnerAwareMutex
+
+        h = ops4(("invoke", 1, "acquire", None),
+                 ("invoke", 0, "acquire", None),
+                 ("fail", 0, "acquire", None),
+                 ("ok", 1, "acquire", None),    # cut: p1 holds the lock
+                 ("invoke", 0, "acquire", None),
+                 ("invoke", 1, "release", None),
+                 ("ok", 1, "release", None),
+                 ("ok", 0, "acquire", None))    # cut
+        m = OwnerAwareMutex()
+        assert wgl.check_history(m, h, backend="host")["valid"] is True
+        mon = OnlineMonitor(m, engine="host")
+        fin = stream(mon, h)
+        assert fin["valid"] is True
+        assert fin["segments_decided"] == 2
+        # And the true refutation still refutes: p0 releasing a lock p1
+        # holds is invalid from the carried owner, matching offline.
+        h2 = ops4(("invoke", 1, "acquire", None),
+                  ("ok", 1, "acquire", None),
+                  ("invoke", 0, "release", None),
+                  ("ok", 0, "release", None))
+        assert wgl.check_history(m, h2, backend="host")["valid"] is False
+        fin2 = stream(OnlineMonitor(m, engine="host"), h2)
+        assert fin2["valid"] is False
+
+    def test_carried_state_reencodes_across_tables(self):
+        # Segment 2's table knows nothing of segment 1's values until
+        # encode_segment re-interns the carried (decoded) state.
+        h1 = ops4(("invoke", 0, "write", 7), ("ok", 0, "write", 7))
+        enc1 = encode_segment(model(), self.seg(h1), None)[0]
+        carry = segment_states(enc1)["end_states"]
+        assert carry == [(7,)]
+        h2 = ops4(("invoke", 0, "read", None), ("ok", 0, "read", 7))
+        members = encode_segment(model(), self.seg(h2), carry)
+        assert len(members) == 1
+        assert segment_states(members[0])["valid"] is True
+        # And from the WRONG carry the read refutes.
+        bad = encode_segment(model(), self.seg(h2), [(5,)])
+        assert segment_states(bad[0])["valid"] is False
+
+
+class TestScheduler:
+    def mk(self, **kw):
+        return SegmentScheduler(model(), engine="host", **kw)
+
+    def submit_history(self, sched, h):
+        seg = Segmenter()
+        for op in h:
+            sched.submit(seg.offer(op))
+        sched.submit(seg.finish())
+
+    def test_carry_makes_fold_order_sensitive(self):
+        # seg0 ends in {1,2} (concurrent writes); seg1's read 2 is valid
+        # ONLY because the full feasible end-state set is carried.
+        h = ops4(("invoke", 0, "write", 1), ("invoke", 1, "write", 2),
+                 ("ok", 0, "write", 1), ("ok", 1, "write", 2),
+                 ("invoke", 0, "read", None), ("ok", 0, "read", 2))
+        sched = self.mk()
+        self.submit_history(sched, h)
+        sched.close()
+        res = sched.result()
+        assert res["valid"] is True
+        assert res["segments_decided"] == 2
+        assert res["segments"][1]["members"] == 2  # one per carried state
+
+    def test_stale_carry_refutes(self):
+        h = ops4(("invoke", 0, "write", 1), ("ok", 0, "write", 1),
+                 ("invoke", 0, "read", None), ("ok", 0, "read", 2))
+        sched = self.mk()
+        self.submit_history(sched, h)
+        sched.close()
+        res = sched.result()
+        assert res["valid"] is False
+        assert res["violation"]["segment"]["seq"] == 1
+
+    def test_watermark_monotone_and_complete(self):
+        h = chunked_register_history(random.Random(2), n_ops=200,
+                                     n_procs=4, chunk_ops=40)
+        marks = []
+        sched = self.mk()
+        seg = Segmenter()
+        for op in h:
+            sched.submit(seg.offer(op))
+            marks.append(sched.decided_through_index)
+        sched.submit(seg.finish())
+        sched.close()
+        marks.append(sched.decided_through_index)
+        assert marks == sorted(marks)  # monotone
+        assert marks[-1] == h[-1].index  # everything decided at close
+
+    def test_unknown_carry_propagates_forward(self):
+        # Budget-tripped segment folds unknown; every later segment of
+        # the key folds unknown too (no initial state to check from).
+        h = chunked_register_history(random.Random(3), n_ops=120,
+                                     n_procs=4, chunk_ops=40)
+        sched = self.mk(max_configs=3)
+        self.submit_history(sched, h)
+        sched.close()
+        res = sched.result()
+        assert res["valid"] == "unknown"
+        verdicts = [row["valid"] for row in res["segments"]]
+        first_unknown = verdicts.index("unknown")
+        assert all(v == "unknown" for v in verdicts[first_unknown:])
+        assert all(v is True for v in verdicts[:first_unknown])
+
+    def test_fold_not_bounded_by_segment_table(self):
+        # The display table is bounded (max_segment_rows); the FOLD is
+        # not: an invalid segment past the bound still flips the
+        # verdict, and segments_decided counts every decision.
+        h = ops4(("invoke", 0, "write", 1), ("ok", 0, "write", 1),
+                 ("invoke", 0, "write", 2), ("ok", 0, "write", 2),
+                 ("invoke", 0, "write", 3), ("ok", 0, "write", 3),
+                 ("invoke", 0, "read", None), ("ok", 0, "read", 9))
+        sched = self.mk(max_segment_rows=2)
+        self.submit_history(sched, h)
+        sched.close()
+        res = sched.result()
+        assert res["valid"] is False
+        assert res["segments_decided"] == 4
+        assert len(res["segments"]) == 2  # table stays bounded
+        assert res["violation"]["segment"]["seq"] == 3
+
+    def test_failed_round_poisons_carry(self, monkeypatch):
+        # A round that raises folds its segments unknown AND loses the
+        # key's carry: later segments must fold unknown too, never a
+        # spurious invalid from a stale pre-failure state.
+        from jepsen_tpu.online import scheduler as sched_mod
+
+        real = sched_mod.segment_states
+        boom = {"armed": True}
+
+        def flaky(enc, **kw):
+            if boom["armed"]:
+                boom["armed"] = False
+                raise RuntimeError("transient engine failure")
+            return real(enc, **kw)
+
+        monkeypatch.setattr(sched_mod, "segment_states", flaky)
+        # write 5 then read 5: with the write's round failed, the read
+        # would refute from the stale init-state carry.
+        h = ops4(("invoke", 0, "write", 5), ("ok", 0, "write", 5),
+                 ("invoke", 0, "read", None), ("ok", 0, "read", 5))
+        sched = self.mk()
+        self.submit_history(sched, h)
+        sched.close()
+        res = sched.result()
+        assert res["valid"] == "unknown"
+        assert [row["valid"] for row in res["segments"]] == \
+            ["unknown", "unknown"]
+        assert "violation" not in res
+
+    def test_worker_death_folds_unknown_without_wedging(self,
+                                                        monkeypatch):
+        # An exception OUTSIDE _decide_round's recovery (here: the
+        # ingest path) kills the worker loop; the top-level guard must
+        # still release wait_idle()/close() (no wedge) and the fold must
+        # degrade to unknown — never a definite True over a stream the
+        # dead worker never decided, and later submits/finish must not
+        # raise out of the monitor.
+        sched = self.mk()
+        monkeypatch.setattr(
+            sched, "_ingest",
+            lambda batch: (_ for _ in ()).throw(RuntimeError("boom")))
+        h = ops4(("invoke", 0, "write", 1), ("ok", 0, "write", 1))
+        seg = Segmenter()
+        for op in h:
+            batch = seg.offer(op)
+            if batch:
+                sched.submit(batch)
+        assert sched.wait_idle(timeout=10), "idle event wedged"
+        sched.close(timeout=10)
+        assert sched.verdict == "unknown"
+        more = Segmenter()
+        more.offer({"type": "invoke", "process": 0, "f": "write",
+                    "value": 2, "time": 99})
+        with pytest.raises(RuntimeError):
+            sched.submit(more.finish())  # dead scheduler refuses work
+
+    def test_unknown_member_poisons_carry(self, monkeypatch):
+        # seg0 ends in {1, 2}; seg1 (read 2) is checked from two
+        # members. When the member from (1,) folds unknown (enumerator
+        # AND rescue oracle both out of budget), the carry must poison
+        # to "unknown", not narrow to (2,)'s end states — else seg2's
+        # read 1 refutes from the narrowed set (a false violation).
+        from jepsen_tpu.online import scheduler as sched_mod
+        from jepsen_tpu.ops import wgl_host
+
+        def from_one(enc):
+            return enc.model.decode_state(
+                tuple(int(x) for x in enc.init_state), enc.table) == (1,)
+
+        real_enum = sched_mod.segment_states
+        real_oracle = wgl_host.check_encoded
+        monkeypatch.setattr(
+            sched_mod, "segment_states",
+            lambda enc, **kw: {"valid": "unknown", "end_states": None,
+                               "configs_explored": 0}
+            if from_one(enc) else real_enum(enc, **kw))
+        monkeypatch.setattr(
+            wgl_host, "check_encoded",
+            lambda enc, **kw: {"valid": "unknown"}
+            if from_one(enc) else real_oracle(enc, **kw))
+        h = ops4(("invoke", 0, "write", 1), ("invoke", 1, "write", 2),
+                 ("ok", 0, "write", 1), ("ok", 1, "write", 2),
+                 ("invoke", 0, "read", None), ("ok", 0, "read", 2),
+                 ("invoke", 0, "read", None), ("ok", 0, "read", 1))
+        sched = self.mk()
+        self.submit_history(sched, h)
+        sched.close()
+        res = sched.result()
+        assert res["valid"] == "unknown"
+        assert "violation" not in res
+        assert [r["valid"] for r in res["segments"]] == \
+            [True, True, "unknown"]
+
+    def test_terminal_segment_skips_exhaustive_enumerator(self, monkeypatch):
+        # A terminal segment's carry is never consumed, so the host path
+        # must decide it with the first-accept oracle (what offline
+        # runs), never the exhaustive end-state enumerator — otherwise a
+        # big non-quiescent tail trips the enumeration budget into
+        # "unknown" where offline decides.
+        from jepsen_tpu.online import scheduler as sched_mod
+
+        real = sched_mod.segment_states
+        calls = []
+
+        def spy(enc, **kw):
+            calls.append(enc)
+            return real(enc, **kw)
+
+        monkeypatch.setattr(sched_mod, "segment_states", spy)
+        # :info at the start poisons quiescence: one terminal segment.
+        h = ops4(("invoke", 0, "write", 1), ("info", 0, "write", 1),
+                 ("invoke", 1, "write", 2), ("ok", 1, "write", 2),
+                 ("invoke", 1, "read", None), ("ok", 1, "read", 2))
+        sched = self.mk()
+        self.submit_history(sched, h)
+        sched.close()
+        assert sched.result()["valid"] is True
+        assert calls == []
+
+    def test_violation_carries_refutation_info(self):
+        h = ops4(("invoke", 0, "write", 1), ("ok", 0, "write", 1),
+                 ("invoke", 0, "read", None), ("ok", 0, "read", 9))
+        hits = []
+        sched = self.mk(on_violation=hits.append)
+        self.submit_history(sched, h)
+        sched.close()
+        assert len(hits) == 1
+        ref = hits[0]["refutation"]
+        assert ref is not None and "max_linearized" in ref
+
+    def test_timed_out_close_folds_unknown_not_valid(self, monkeypatch):
+        # A close() whose join times out mid-round must NOT report a
+        # definite True: undecided submitted segments fold unknown (the
+        # undecided tail could hold the violation).
+        import threading
+
+        from jepsen_tpu.online import scheduler as sched_mod
+
+        real = sched_mod.segment_states
+        gate = threading.Event()
+
+        def slow(enc, **kw):
+            gate.wait(30.0)
+            return real(enc, **kw)
+
+        monkeypatch.setattr(sched_mod, "segment_states", slow)
+        h = ops4(("invoke", 0, "write", 1), ("ok", 0, "write", 1))
+        sched = self.mk()
+        self.submit_history(sched, h)
+        sched.close(timeout=0.2)  # worker still blocked in the round
+        assert sched.result()["valid"] == "unknown"
+        gate.set()  # release the worker; now everything decides
+        assert sched.wait_idle(30.0)
+        sched.close()
+        assert sched.result()["valid"] is True
+
+
+# ---------------------------------------------------------------------------
+# The acceptance contract.
+
+
+class TestDifferential:
+    """Folded online verdict == offline check_history verdict, across
+    valid / seeded-invalid / overflow-unknown / no-quiescence histories,
+    abort_on_violation on and off."""
+
+    def both(self, h, abort, **kw):
+        mon = OnlineMonitor(model(), abort_on_violation=abort,
+                            engine="host", **kw)
+        return stream(mon, h)
+
+    @pytest.mark.parametrize("abort", [False, True])
+    def test_valid_history(self, abort):
+        h = chunked_register_history(random.Random(10), n_ops=300,
+                                     n_procs=4, chunk_ops=60)
+        assert offline(h)["valid"] is True
+        fin = self.both(h, abort)
+        assert fin["valid"] is True
+        assert not fin["aborted"]
+        assert fin["decided_through_index"] == h[-1].index
+
+    @pytest.mark.parametrize("abort", [False, True])
+    def test_seeded_invalid_history(self, abort):
+        h = perturb_history(
+            random.Random(4),
+            chunked_register_history(random.Random(11), n_ops=300,
+                                     n_procs=4, chunk_ops=60))
+        assert offline(h)["valid"] is False
+        fin = self.both(h, abort)
+        assert fin["valid"] is False
+        assert fin["aborted"] == abort
+        assert "violation" in fin
+        if abort:
+            assert fin["ops_to_detection"] <= fin["ops_observed"]
+            assert fin["seconds_to_detection"] >= 0
+
+    @pytest.mark.parametrize("abort", [False, True])
+    def test_overflow_unknown_history(self, abort):
+        # Wide concurrency + open intervals: both the offline host check
+        # and the per-segment enumerator trip the same config budget.
+        h = random_register_history(random.Random(12), n_ops=120,
+                                    n_procs=10, crash_p=0.2)
+        assert offline(h, host_max_configs=50)["valid"] == "unknown"
+        fin = self.both(h, abort, max_configs=50)
+        assert fin["valid"] == "unknown"
+        assert not fin["aborted"]  # unknown is not a violation
+
+    @pytest.mark.parametrize("abort", [False, True])
+    def test_no_quiescence_single_terminal_segment(self, abort):
+        # An early :info poisons quiescence: the remainder must fall
+        # back to ONE terminal segment and still agree with offline.
+        h = random_register_history(random.Random(13), n_ops=150,
+                                    n_procs=4, crash_p=0.04)
+        assert any(op.is_info for op in h)
+        off = offline(h)["valid"]
+        mon = OnlineMonitor(model(), abort_on_violation=abort,
+                            engine="host")
+        fin = stream(mon, h)
+        assert fin["valid"] == off
+        terminals = [s for s in fin["segments"] if s["terminal"]]
+        assert len(terminals) == 1
+
+    @pytest.mark.parametrize("abort", [False, True])
+    def test_keyed_history(self, abort):
+        # P-compositional split: disjoint process groups per key (the
+        # concurrent-generator contract), one key perturbed.
+        rng = random.Random(14)
+        ops = []
+        for i, k in enumerate(("a", "b", "c")):
+            for op in chunked_register_history(rng, n_ops=80, n_procs=2,
+                                               chunk_ops=40):
+                ops.append(op.with_(value=ind.KV(k, op.value),
+                                    process=op.process + 10 * i))
+        ops.sort(key=lambda o: o.time)
+        h = perturb_history(random.Random(5), History(ops, reindex=True))
+        off = jchecker.merge_valid(
+            offline(ind.subhistory(k, h))["valid"] for k in ("a", "b", "c"))
+        fin = self.both(h, abort)
+        assert fin["valid"] == off == False  # noqa: E712
+        assert {s["key"] for s in fin["segments"]} == \
+            {repr(k) for k in ("a", "b", "c")}
+
+    def test_mixed_keyed_keyless_stream_degrades_to_unknown(self):
+        # Offline, independent.subhistory folds every keyless op into
+        # EVERY key's subhistory (here: write 9 lands between key a's
+        # write 1 and read 9, so offline is valid). A streaming split
+        # routes the keyless cut to its own SINGLE_KEY carry chain and
+        # would refute a's read 9 from the stale (1,) carry — so on a
+        # mixed stream the fold must degrade to unknown and never abort.
+        h = ops4(("invoke", 0, "write", ind.KV("a", 1)),
+                 ("ok", 0, "write", ind.KV("a", 1)),
+                 ("invoke", 0, "write", 9), ("ok", 0, "write", 9),
+                 ("invoke", 0, "read", ind.KV("a", None)),
+                 ("ok", 0, "read", ind.KV("a", 9)))
+        assert offline(ind.subhistory("a", h))["valid"] is True
+        mon = OnlineMonitor(model(), abort_on_violation=True,
+                            engine="host")
+        assert not mon.segmenter.mixed_keys
+        fin = stream(mon, h)
+        assert mon.segmenter.mixed_keys
+        assert fin["valid"] == "unknown"
+        assert "info" in fin
+        assert not fin["aborted"]
+        assert "ops_to_detection" not in fin
+
+    @pytest.mark.slow
+    def test_device_engine_differential(self):
+        # The PR-2 batched pipeline as the deciding engine (compiles).
+        # The device oracle only takes what the enumerator can't —
+        # terminal segments and budget rescues — so the history ends
+        # with an open invocation (a terminal segment per key).
+        rng = random.Random(15)
+        ops = []
+        for i, k in enumerate(("a", "b")):
+            for op in chunked_register_history(rng, n_ops=60, n_procs=2,
+                                               chunk_ops=30):
+                ops.append(op.with_(value=ind.KV(k, op.value),
+                                    process=op.process + 10 * i))
+        ops.sort(key=lambda o: o.time)
+        t_end = ops[-1].time + 1
+        ops.append(Op("invoke", 0, "write", ind.KV("a", 3), time=t_end))
+        ops.append(Op("invoke", 10, "write", ind.KV("b", 3),
+                      time=t_end + 1))
+        h = History(ops, reindex=True)
+        off = jchecker.merge_valid(
+            offline(ind.subhistory(k, h))["valid"] for k in ("a", "b"))
+        mon = OnlineMonitor(model(), engine="device", batch_f=64)
+        fin = stream(mon, h)
+        assert fin["valid"] == off is True
+        terminal_rows = [s for s in fin["segments"] if s["terminal"]]
+        assert terminal_rows and all(s["engine"] == "device"
+                                     for s in terminal_rows)
+
+
+class TestEarlyDetection:
+    def test_paced_stream_detects_before_half(self):
+        # The bench's detection contract at test size: violation seeded
+        # in the first 30% of a 1k-op stream, fed with bounded lag
+        # (admission-pipeline style backpressure), must abort before
+        # half the ops are observed.
+        h = perturb_history(
+            random.Random(6),
+            chunked_register_history(random.Random(16), n_ops=1000,
+                                     n_procs=4, chunk_ops=60),
+            within=0.3)
+        assert offline(h)["valid"] is False
+        mon = OnlineMonitor(model(), abort_on_violation=True,
+                            engine="host")
+        fed = 0
+        for op in h:
+            mon.observe(op)
+            fed += 1
+            if mon.aborted:
+                break
+            # Bounded lag: never run more than ~2 chunks ahead of the
+            # decided watermark.
+            for _ in range(1000):
+                if mon.aborted or \
+                        fed - mon.decided_through_index < 300:
+                    break
+                time.sleep(0.001)
+        fin = mon.finish()
+        assert fin["aborted"]
+        assert fin["valid"] is False
+        assert fin["ops_to_detection"] < len(h) / 2
+
+    def test_interpreter_abort_before_generator_drains(self):
+        # Live run: a client that lies on one early read; the monitor's
+        # stop event must end the run with most of the generator unrun.
+        # The workload has think-time (stagger >> op latency) and few
+        # workers so the stream actually quiesces mid-run — a zero-gap
+        # or oversubscribed generator can keep some worker permanently
+        # busy for a whole run (seen under full-suite CPU load), and
+        # then the first closable segment is the terminal one, decided
+        # only after the generator drains.
+        state = AtomState()
+        lie_at = 40
+        counter = {"n": 0}
+
+        class LyingClient(AtomClient):
+            def invoke(self, test, op):
+                res = super().invoke(test, op)
+                counter["n"] += 1
+                if op.get("f") == "read" and counter["n"] >= lie_at \
+                        and res.get("value") != 93:
+                    return {**res, "value": 93}
+                return res
+
+        n_gen = 1500
+        test = dict(noop_test())
+        test.update(
+            name="online-abort",
+            **{"no-store?": True, "online?": True, "online-abort?": True,
+               "online-engine": "host"},
+            model=CasRegister(init=0),
+            db=AtomDB(state),
+            client=LyingClient(state, latency=0.001),
+            concurrency=2,
+            checker=jchecker.linearizable(model=CasRegister(init=0)),
+            generator=gen.clients(gen.stagger(0.008, gen.limit(
+                n_gen, gen.mix([
+                    lambda: {"f": "read"},
+                    lambda: {"f": "write", "value": gen.rand_int(5)},
+                ])))),
+        )
+        res = core.run(test)
+        fin = res["online-results"]
+        assert fin["aborted"] is True
+        assert fin["valid"] is False
+        assert fin["ops_to_detection"] > 0
+        # The generator never drained: far fewer than 2*n_gen ops landed.
+        assert len(res["history"]) < n_gen
+        assert res["results"]["valid"] is False  # offline agrees post-hoc
+
+
+# ---------------------------------------------------------------------------
+# Wiring: core.run e2e, store artifact, web page, telemetry, off path.
+
+
+class TestCoreRunWiring:
+    def cas_test(self, **extra):
+        state = AtomState()
+        test = dict(noop_test())
+        test.update(
+            name="online-e2e",
+            db=AtomDB(state),
+            client=AtomClient(state),
+            model=CasRegister(init=0),
+            concurrency=4,
+            checker=jchecker.linearizable(model=CasRegister(init=0)),
+            generator=gen.clients(gen.limit(120, gen.mix([
+                lambda: {"f": "read"},
+                lambda: {"f": "write", "value": gen.rand_int(5)},
+                lambda: {"f": "cas", "value": [gen.rand_int(5),
+                                               gen.rand_int(5)]},
+            ]))),
+        )
+        test.update(extra)
+        return test
+
+    def test_online_run_agrees_with_offline_checker(self, tmp_path):
+        test = self.cas_test(**{
+            "online?": True, "online-engine": "host",
+            "telemetry?": True, "store-root": str(tmp_path)})
+        res = core.run(test)
+        fin = res["online-results"]
+        assert fin["valid"] is res["results"]["valid"] is True
+        assert not fin["aborted"]
+        assert fin["segments_decided"] >= 1
+        # online.json landed in the store and the web page renders it.
+        from pathlib import Path
+
+        from jepsen_tpu import web
+
+        files = list(tmp_path.rglob("online.json"))
+        assert len(files) == 1
+        page = web._online_page(Path(tmp_path))
+        assert "online-e2e" in page and "online verdict" in page
+        idx = web._index_page(Path(tmp_path))
+        assert "/online" in idx and "online.json" in idx
+        # Telemetry series registered on the run's registry.
+        names = {s["name"] for s in res["telemetry-registry"].collect()}
+        assert "online_segments_total" in names
+        assert "online_decided_watermark" in names
+        assert "online_open_segment_ops" in names
+
+    def test_off_path_allocates_nothing(self, monkeypatch):
+        """With --online absent: no monitor is constructed, no worker
+        thread spawns, no online_* metric registers (poisoned
+        constructor, mirroring test_profile's disabled-path check)."""
+        import jepsen_tpu.online as jonline
+
+        def _boom(*a, **kw):
+            raise AssertionError("online subsystem touched on off path")
+
+        monkeypatch.setattr(jonline.OnlineMonitor, "__init__", _boom)
+        monkeypatch.setattr(jonline.SegmentScheduler, "__init__", _boom)
+        test = self.cas_test(**{"no-store?": True, "telemetry?": True})
+        res = core.run(test)
+        assert res["results"]["valid"] is True
+        assert "online-monitor" not in res and "online-results" not in res
+        names = {s["name"] for s in res["telemetry-registry"].collect()}
+        assert not any(n.startswith("online_") for n in names)
+        assert not any(t.name == "jepsen-online-scheduler"
+                       for t in threading.enumerate())
+
+    def test_online_without_model_degrades_gracefully(self):
+        from jepsen_tpu.online import of_test
+
+        assert of_test({"online?": True}) is None
+        assert of_test({}) is None
+        # ...but an ARMED abort must never be silently voided: a user
+        # relying on violation-abort gets a hard failure, not a
+        # full-length unmonitored run.
+        with pytest.raises(ValueError):
+            of_test({"online?": True, "online-abort?": True})
+
+    def test_cli_flags_set_test_map(self):
+        from jepsen_tpu.cli import _apply_std_opts
+
+        base = {"nodes": ["n1"], "concurrency": 1, "time_limit": 1,
+                "ssh": {"dummy?": True}}
+        t = _apply_std_opts({}, {**base, "online": True,
+                                 "online_abort": True,
+                                 "online_engine": "host"})
+        assert t["online?"] and t["online-abort?"]
+        assert t["online-engine"] == "host"
+        t2 = _apply_std_opts({}, base)
+        assert "online?" not in t2
+        # --online-abort / explicit non-auto --online-engine imply
+        # --online (would otherwise be silently ignored).
+        t3 = _apply_std_opts({}, {**base, "online_abort": True})
+        assert t3["online?"] and t3["online-abort?"]
+        t4 = _apply_std_opts({}, {**base, "online_engine": "device"})
+        assert t4["online?"] and t4["online-engine"] == "device"
+        t5 = _apply_std_opts({}, {**base, "online_engine": "auto"})
+        assert "online?" not in t5
+
+    def test_registry_metrics_after_violation(self):
+        reg = Registry()
+        h = perturb_history(
+            random.Random(8),
+            chunked_register_history(random.Random(18), n_ops=200,
+                                     n_procs=4, chunk_ops=50))
+        mon = OnlineMonitor(model(), engine="host", metrics=reg)
+        fin = stream(mon, h)
+        assert fin["valid"] is False
+        samples = reg.collect()
+        assert "online_detection_seconds" in {s["name"] for s in samples}
+        verdicts = {s["labels"]["verdict"] for s in samples
+                    if s["name"] == "online_segments_total"
+                    and s.get("labels")}
+        assert "False" in verdicts
