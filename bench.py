@@ -358,10 +358,17 @@ def main() -> int:
         # `online_overhead_pct`, the end-to-end cost of deciding WHILE
         # streaming (observe + drain) vs the same stream decided
         # post-hoc through the production dispatch. Both lower-is-better
-        # in benchcmp.
+        # in benchcmp. Since r6 the monitored pass runs with FULL
+        # decision-latency tracing on (registry histogram + span
+        # collector) — the overhead number prices the instrumented
+        # configuration items 1/3 will actually run, and the leg
+        # reports the per-op invoke→watermark-covered lag p50/p99
+        # (benchcmp: online_p99_decision_latency_s, lower).
         _REC.begin("online_10k")
         try:
+            from jepsen_tpu import trace as jtrace
             from jepsen_tpu.online import OnlineMonitor
+            from jepsen_tpu.telemetry import Registry
             from jepsen_tpu.testing import chunked_register_history
 
             oh = chunked_register_history(
@@ -372,7 +379,10 @@ def main() -> int:
                 pass
             vres = wgl.check_history(model, oh)
             t_off = time.perf_counter() - t0
-            mon = OnlineMonitor(model, engine="host")
+            treg = Registry()
+            tcol = jtrace.Collector()
+            mon = OnlineMonitor(model, engine="host", metrics=treg,
+                                collector=tcol)
             t0 = time.perf_counter()
             for op in oh:
                 mon.observe(op)
@@ -398,6 +408,7 @@ def main() -> int:
                     time.sleep(0.001)
             fin2 = mon2.finish()
             t_detect = time.perf_counter() - t0
+            lat = fin.get("decision_latency") or {}
             out["online_10k"] = {
                 "n_ops": len(obad),
                 "valid": fin["valid"],
@@ -406,6 +417,12 @@ def main() -> int:
                 "offline_s": round(t_off, 3),
                 "online_overhead_pct": round(
                     100.0 * (t_on - t_off) / t_off, 1),
+                "tracing": True,
+                "p50_decision_latency_s": lat.get("p50_s"),
+                "p90_decision_latency_s": lat.get("p90_s"),
+                "p99_decision_latency_s": lat.get("p99_s"),
+                "decision_latency_count": lat.get("count"),
+                "spans_recorded": len(tcol.spans),
                 "segments_decided": fin["segments_decided"],
                 "detected_valid": fin2["valid"],
                 "aborted": fin2["aborted"],
@@ -600,6 +617,34 @@ def main() -> int:
                             "smoke decided 0/8 members (r5 failure "
                             "mode) — escalation schedule or leg "
                             "deadline needs retuning")
+                    # ROADMAP "first metric to watch": decided must
+                    # stay >= the newest committed round's figure —
+                    # asserted HERE in the leg (an error field the
+                    # compact line carries), not just gated later by
+                    # benchcmp's threshold.
+                    try:
+                        import glob as _glob
+
+                        from jepsen_tpu import benchcmp as _bc
+
+                        # Sort by the padded round label, not the raw
+                        # path — lexical order misplaces r10 vs r9.
+                        _prev_files = sorted(_glob.glob(os.path.join(
+                            os.path.dirname(os.path.abspath(__file__)),
+                            "BENCH_r*.json")), key=_bc.round_label)
+                        if _prev_files:
+                            _prev = _bc.extract(_bc.load_round(
+                                _prev_files[-1])["data"])
+                            pd = _prev.get("smoke_8x10k_decided")
+                            if pd is not None:
+                                smoke["prev_round_decided"] = int(pd)
+                                if smoke.get("decided", 0) < pd:
+                                    smoke.setdefault("error", (
+                                        f"smoke decided "
+                                        f"{smoke.get('decided', 0)} < "
+                                        f"previous round's {int(pd)}"))
+                    except Exception:  # noqa: BLE001 - guard only
+                        pass
                     out["batch_replay_large"]["smoke_8x10k"] = smoke
         except Exception as e:  # noqa: BLE001
             out["batch_replay_large"] = {

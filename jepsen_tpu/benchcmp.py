@@ -79,6 +79,12 @@ METRICS: list[tuple[str, str, str]] = [
     # streaming vs post-hoc — both regressions when they grow.
     ("online_ops_to_detection", "online_10k.ops_to_detection", "lower"),
     ("online_overhead_pct", "online_10k.online_overhead_pct", "lower"),
+    # Decision-latency tracing (ISSUE 6): the p99 invoke→watermark-
+    # covered lag of the online monitor's seeded-invalid 10k-op stream
+    # — THE serving-stack signal ROADMAP item 3 benches against. Growth
+    # = the scheduler/pipeline got slower at covering ops; lower only.
+    ("online_p99_decision_latency_s",
+     "online_10k.p99_decision_latency_s", "lower"),
 ]
 
 DEFAULT_THRESHOLD = 0.10

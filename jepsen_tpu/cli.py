@@ -99,6 +99,14 @@ def add_test_opts(p: argparse.ArgumentParser) -> None:
                         "or auto (device when the model supports it "
                         "and a round batches >1 member); a non-auto "
                         "choice implies --online")
+    p.add_argument("--live-port", type=int, default=None,
+                   help="serve the results browser IN-PROCESS for the "
+                        "run's duration on this port: /live streams "
+                        "the online monitor's operational snapshot "
+                        "(watermark, queue depths, backlog, decision-"
+                        "latency p50/p99, stall detector) as ndjson, "
+                        "/live.html renders it as a self-refreshing "
+                        "dashboard")
     p.add_argument("--store-root", default=None,
                    help="directory for the store/ tree")
 
@@ -166,6 +174,8 @@ def _apply_std_opts(test: dict, opts: dict) -> dict:
             test["online-abort?"] = True
         if opts.get("online_engine") and opts["online_engine"] != "auto":
             test["online-engine"] = opts["online_engine"]
+    if opts.get("live_port") is not None:  # 0 = ephemeral port
+        test["live-port"] = int(opts["live_port"])
     if opts.get("store_root"):
         test["store-root"] = opts["store_root"]
     if opts.get("checker_backend") and opts["checker_backend"] != "auto":

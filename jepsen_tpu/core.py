@@ -226,20 +226,6 @@ def run(test: dict) -> dict:
     test = prepare_test(test)
     persist = bool(test.get("name")) and not test.get("no-store?")
     reg = jtelemetry.of_test(test)
-    monitor = None
-    if test.get("online?"):
-        # Online linearizability monitor (--online): tee ops from the
-        # interpreter as they land, decide closed segments on a worker
-        # thread while the workload runs, optionally abort on the first
-        # violation. The import itself is gated — with --online absent
-        # the subsystem costs nothing (no thread, no metrics).
-        from . import online as jonline
-
-        monitor = jonline.of_test(test)
-        if monitor is not None:
-            test["online-monitor"] = monitor
-            test["op-observer"] = monitor.observe
-            test["stop-event"] = monitor.stop_event
     frec = None
     if reg is not None:
         # Flight recorder rides every telemetry run: phases mirror
@@ -257,9 +243,67 @@ def run(test: dict) -> dict:
         test["trace-collector"] = collector
         test["client"] = jtrace.tracing(test["client"], collector)
     if persist:
+        # Store setup BEFORE the monitor/live-source/server blocks: a
+        # raising path_mk (unwritable store root) aborts the run before
+        # anything is registered process-globally — the finally below
+        # only covers failures past this point, so nothing started here
+        # may outlive an exception it can't see.
         store.path_mk(test)
         store.start_logging(test)
+    monitor = None
+    live_key = None
+    live_srv = None
     try:
+        # The online/live setup sits INSIDE the try: a raising
+        # of_test (bad engine opt) after start_logging above must still
+        # reach the finally, which stops the run's log handler and
+        # tears down whatever of the monitor / live source / server
+        # did come up (all its guards are None-safe).
+        if test.get("online?"):
+            # Online linearizability monitor (--online): tee ops from
+            # the interpreter as they land, decide closed segments on a
+            # worker thread while the workload runs, optionally abort
+            # on the first violation. Built AFTER the collector/flight
+            # recorder above so decision-latency spans and stall phases
+            # land in the same spans.jsonl / flightrecord.json the run
+            # already writes. The import itself is gated — with
+            # --online absent the subsystem costs nothing (no thread,
+            # no metrics).
+            from . import online as jonline
+
+            monitor = jonline.of_test(test)
+            if monitor is not None:
+                test["online-monitor"] = monitor
+                test["op-observer"] = monitor.observe
+                test["stop-event"] = monitor.stop_event
+        if monitor is not None:
+            # Live operational view: the monitor's snapshot is one
+            # /live line for the lifetime of the run (in-process
+            # servers only — `serve` in another process reads the
+            # stored artifacts).
+            from . import web as jweb
+
+            live_key = f"{test.get('name') or 'run'}/{test['start-time']}"
+            jweb.register_live_source(live_key, monitor.live_snapshot)
+        if test.get("live-port") is not None:  # 0 = ephemeral port
+            # --live-port: an in-process results server for the run's
+            # duration, so /live (and /metrics etc.) are reachable
+            # while the workload executes. Best-effort: a taken port
+            # logs and moves on — a dashboard must never sink the run.
+            from . import web as jweb
+
+            try:
+                live_srv = jweb.server(root=test.get("store-root"),
+                                       port=int(test["live-port"]))
+                threading.Thread(target=live_srv.serve_forever,
+                                 name="jepsen-live-web",
+                                 daemon=True).start()
+                LOG.info("Live dashboard on http://0.0.0.0:%d/live.html",
+                         live_srv.server_address[1])
+            except Exception:  # noqa: BLE001
+                LOG.warning("could not start live web server",
+                            exc_info=True)
+                live_srv = None
         LOG.info("Running test: %s/%s", test.get("name"), test["start-time"])
         sessions = _with_sessions(test)
         osys: jos.OS = test.get("os") or jos.noop()
@@ -328,6 +372,16 @@ def run(test: dict) -> dict:
                                            registry=reg)
         raise
     finally:
+        if live_key is not None:
+            from . import web as jweb
+
+            jweb.unregister_live_source(live_key)
+        if live_srv is not None:
+            try:
+                live_srv.shutdown()
+                live_srv.server_close()
+            except Exception:  # noqa: BLE001
+                pass
         if monitor is not None and test.get("online-results") is None:
             # The run died before the success-path finish: shut the
             # scheduler worker down (bounded drain) so a failed run

@@ -47,13 +47,16 @@ decided.
 
 from __future__ import annotations
 
+import contextlib as _contextlib
 import logging
 import queue
 import threading
 import time as _time
 from typing import Any, Callable, Optional
 
+from .. import trace as jtrace
 from ..models import Model
+from ..telemetry import flight as _flight
 from .segmenter import (
     SINGLE_KEY,
     KeySegment,
@@ -71,7 +74,19 @@ class SegmentScheduler:
     segment folds invalid — the monitor uses it for abort_on_violation
     and the detection metrics. ``metrics`` is a telemetry Registry or
     None; series: ``online_segments_total{verdict}``,
-    ``online_decided_watermark``.
+    ``online_decided_watermark``, ``online_scheduler_backlog``.
+
+    Decision-latency tracing (all optional, all None on the off path):
+    ``on_watermark(index)`` fires from the worker thread whenever the
+    decided watermark advances (called with the scheduler lock held —
+    the callback must not call back into the scheduler); ``collector``
+    is a ``trace.Collector`` receiving linked spans per decided segment
+    (stage ``segment``, children stage ``member``, engine calls stage
+    ``oracle`` whose span id is pushed as ``trace_span`` event tags so
+    kernel chunk events link back); ``flight`` is a FlightRecorder whose
+    ledger gets ``online.drain`` / ``online.dispatch`` / ``online.fold``
+    phase entries, so ``offending_phase`` can blame a stalled or crashed
+    online run.
     """
 
     def __init__(
@@ -86,6 +101,9 @@ class SegmentScheduler:
         batch_f: int = 256,
         on_violation: Optional[Callable[[dict], None]] = None,
         max_segment_rows: int = 2000,
+        on_watermark: Optional[Callable[[int], None]] = None,
+        collector=None,
+        flight=None,
     ) -> None:
         if engine not in ("auto", "device", "host"):
             raise ValueError(f"unknown online engine {engine!r}")
@@ -96,11 +114,17 @@ class SegmentScheduler:
         self.batch_f = batch_f
         self.on_violation = on_violation
         self.max_segment_rows = max_segment_rows
+        self.on_watermark = on_watermark
+        self.collector = collector
+        self.flight = flight
 
         self._lock = threading.Lock()
         self._inbox: "queue.SimpleQueue[Optional[list[KeySegment]]]" = (
             queue.SimpleQueue())
         self._pending: list[KeySegment] = []  # not yet ready/decided
+        # key -> segments submitted but not yet decided (guarded by
+        # _lock; the /live dashboard's per-key queue-depth view).
+        self._key_depth: dict[Any, int] = {}
         # key -> carried decoded-state list; absent = model's own init
         # (None member sentinel); "unknown" = carry lost (budget/overflow).
         self._carry: dict[Any, Any] = {}
@@ -143,6 +167,25 @@ class SegmentScheduler:
         with self._cnt_lock:
             if self._closed:
                 raise RuntimeError("scheduler is closed")
+            # Depth accounting rides inside the same critical section as
+            # the enqueue (lock order: _cnt_lock > _lock, matched
+            # nowhere in reverse): the worker cannot decide-and-
+            # decrement a segment before its increment lands.
+            with self._lock:
+                for seg in segments:
+                    self._key_depth[seg.key] = (
+                        self._key_depth.get(seg.key, 0) + 1)
+                if self.metrics is not None:
+                    # Under the SAME lock as the depth bump (mirroring
+                    # _record_locked's decrement-side set): a set
+                    # computed after release could overwrite the
+                    # worker's newer decrement with a stale count and
+                    # leave a drained run reporting backlog > 0.
+                    self.metrics.gauge(
+                        "online_scheduler_backlog",
+                        "Segments submitted to the online scheduler "
+                        "and not yet decided").set(
+                            sum(self._key_depth.values()))
             self._inflight += 1
             self._idle.clear()
             self._inbox.put(list(segments))
@@ -158,6 +201,32 @@ class SegmentScheduler:
     @property
     def decided_through_index(self) -> int:
         return self._watermark
+
+    @property
+    def backlog(self) -> int:
+        """Segments submitted and not yet decided."""
+        with self._lock:
+            return sum(self._key_depth.values())
+
+    def queue_depths(self) -> dict:
+        """Per-key undecided-segment counts (keys repr'd for JSON) —
+        the /live dashboard's queue view."""
+        with self._lock:
+            return {("(single)" if k == SINGLE_KEY else repr(k)): v
+                    for k, v in sorted(self._key_depth.items(),
+                                       key=lambda kv: repr(kv[0]))}
+
+    def stats(self) -> dict:
+        """One locked snapshot of the fold counters for the live view."""
+        with self._lock:
+            return {
+                "segments_decided": self._n_decided,
+                "segments_invalid": self._n_invalid,
+                "segments_unknown": self._n_unknown,
+                "decided_through_index": self._watermark,
+                "backlog": sum(self._key_depth.values()),
+                "verdict": self._fold_locked(),
+            }
 
     @property
     def verdict(self) -> Any:
@@ -247,7 +316,12 @@ class SegmentScheduler:
                         break
                     self._ingest(more)
                     taken += 1
-            self._drain_ready()
+            # The drain phase sits OUTSIDE _drain_ready's recovery
+            # catch: a crash inside a round crosses (and errors) only
+            # the inner dispatch/fold phases, so offending_phase blames
+            # the exact stage rather than the whole drain.
+            with _flight.phase(self.flight, "online.drain"):
+                self._drain_ready()
             # _drain_ready leaves _pending empty (the earliest pending
             # segment of a key is always ready), so idleness is just
             # "every submitted batch has been decided". On close,
@@ -301,6 +375,34 @@ class SegmentScheduler:
     # -- deciding ------------------------------------------------------------
 
     def _decide_round(self, ready: list[KeySegment], done: set) -> None:
+        with _flight.phase(self.flight, "online.dispatch"):
+            members, results, durs, oracle_idx, engine, oracle_span = \
+                self._dispatch_round(ready, done)
+        if not members:
+            return
+        oracle_set = set(oracle_idx)
+        with _flight.phase(self.flight, "online.fold"):
+            i = 0
+            for seg, encs in members:
+                rs = results[i:i + len(encs)]
+                # Segments no member of which reached the oracle were
+                # decided wholly by the stage-1 host enumerator — label
+                # them so, whatever engine the round's oracle ran.
+                seg_engine = (engine if any(
+                    k in oracle_set for k in range(i, i + len(encs)))
+                    else "host")
+                seg_wall = sum(durs[i:i + len(encs)])
+                member_spans = [
+                    (durs[k],
+                     "oracle" if k in oracle_set else "enumerator",
+                     oracle_span if k in oracle_set else None)
+                    for k in range(i, i + len(encs))]
+                i += len(encs)
+                self._fold_segment(seg, encs, rs, seg_wall, seg_engine,
+                                   member_spans=member_spans)
+                done.add(id(seg))
+
+    def _dispatch_round(self, ready: list[KeySegment], done: set):
         # Build members; segments whose carry is lost fold unknown now.
         members = []  # (seg, [EncodedHistory ...]) in ready order
         for seg in ready:
@@ -315,7 +417,7 @@ class SegmentScheduler:
             encs = encode_segment(self.model, seg, carried)
             members.append((seg, encs))
         if not members:
-            return
+            return members, [], [], [], "none", None
         flat = [e for _seg, encs in members for e in encs]
         seg_of = [seg for seg, encs in members for _ in encs]
         # Stage 1: non-terminal members decide via the exhaustive
@@ -342,20 +444,42 @@ class SegmentScheduler:
                 oracle_idx.append(idx)
             else:
                 results[idx] = r
+        oracle_span = None
         if oracle_idx:
             engine = self.engine
             if engine == "auto":
                 engine = ("device" if self.model.device_capable
                           and len(oracle_idx) > 1 else "host")
             oracle_encs = [flat[i] for i in oracle_idx]
+            col = self.collector
+            if col is not None:
+                # The oracle span covers the whole engine call (one
+                # batched device program can decide members of MANY
+                # segments); member spans point at it via oracle_span,
+                # and the span id rides as `trace_span` tags on the
+                # kernel chunk events emitted inside the call.
+                oracle_span = col.mint_id()
+            tag_cm = (jtrace.span_tags(trace_span=oracle_span)
+                      if oracle_span is not None
+                      else _contextlib.nullcontext())
             t1 = _time.perf_counter()
-            if engine == "device":
-                decided = self._decide_device(oracle_encs)
-            else:
-                from ..ops import wgl_host
+            t1_ns = _time.monotonic_ns()
+            with tag_cm:
+                if engine == "device":
+                    decided = self._decide_device(oracle_encs)
+                else:
+                    from ..ops import wgl_host
 
-                decided = [wgl_host.check_encoded(
-                    e, max_configs=self.max_configs) for e in oracle_encs]
+                    decided = [wgl_host.check_encoded(
+                        e, max_configs=self.max_configs)
+                        for e in oracle_encs]
+            if col is not None:
+                col.record(
+                    "online.oracle", start_ns=t1_ns,
+                    end_ns=_time.monotonic_ns(), span_id=oracle_span,
+                    stage="oracle", engine=engine,
+                    members=len(oracle_idx),
+                    seqs=sorted({seg_of[i].seq for i in oracle_idx}))
             # A device batch decides all members in one program; split
             # its wall evenly rather than charging it to the last row.
             per_member = (_time.perf_counter() - t1) / len(oracle_idx)
@@ -370,20 +494,7 @@ class SegmentScheduler:
                                 "detail": r}
         else:
             engine = "host" if self.engine == "auto" else self.engine
-        oracle_set = set(oracle_idx)
-        i = 0
-        for seg, encs in members:
-            rs = results[i:i + len(encs)]
-            # Segments no member of which reached the oracle were
-            # decided wholly by the stage-1 host enumerator — label
-            # them so, whatever engine the round's oracle ran.
-            seg_engine = (engine if any(
-                k in oracle_set for k in range(i, i + len(encs)))
-                else "host")
-            seg_wall = sum(durs[i:i + len(encs)])
-            i += len(encs)
-            self._fold_segment(seg, encs, rs, seg_wall, seg_engine)
-            done.add(id(seg))
+        return members, results, durs, oracle_idx, engine, oracle_span
 
     def _decide_device(self, encs: list) -> list[dict]:
         """One vmapped batched-escalation program over all members
@@ -401,7 +512,8 @@ class SegmentScheduler:
         return results
 
     def _fold_segment(self, seg: KeySegment, encs, member_results,
-                      wall_s: float, engine: str) -> None:
+                      wall_s: float, engine: str,
+                      member_spans=None) -> None:
         valid_states: list = []
         carry_lost = False
         verdicts = []
@@ -455,6 +567,25 @@ class SegmentScheduler:
                         encs[0], max_configs=self.max_configs)
                 except Exception:  # noqa: BLE001 - diagnostics only
                     refutation = {"valid": False}
+        col = self.collector
+        sid = None
+        if col is not None:
+            # Member spans, children of the segment span _record_locked
+            # will emit under this minted id (the parent is recorded
+            # after its children — the collector just appends).
+            now_ns = _time.monotonic_ns()
+            sid = col.mint_id()
+            for k, (dur_s, path, oracle_span) in enumerate(
+                    member_spans or []):
+                attrs = {"member": k, "path": path}
+                if oracle_span is not None:
+                    attrs["oracle_span"] = oracle_span
+                col.record(
+                    "online.member", parent_id=sid, stage="member",
+                    start_ns=now_ns - int(dur_s * 1e9), end_ns=now_ns,
+                    verdict=str(member_results[k].get("valid")
+                                if k < len(member_results) else None),
+                    **attrs)
         with self._lock:
             if seg.terminal:
                 pass  # no later segment consumes this key's carry
@@ -476,13 +607,14 @@ class SegmentScheduler:
                 self._carry[seg.key] = "unknown"
             self._record_locked(seg, {"valid": verdict}, refutation,
                                 wall_s=wall_s, engine=engine,
-                                members=len(encs))
+                                members=len(encs), span_id=sid)
 
     # -- bookkeeping (callers hold the lock) ---------------------------------
 
     def _record_locked(self, seg: KeySegment, result: dict,
                        refutation: Optional[dict], wall_s: float = 0.0,
-                       engine: str = "none", members: int = 0) -> None:
+                       engine: str = "none", members: int = 0,
+                       span_id: Optional[str] = None) -> None:
         row = {
             "seq": seg.seq,
             "key": None if seg.key == SINGLE_KEY else repr(seg.key),
@@ -497,6 +629,25 @@ class SegmentScheduler:
         }
         if result.get("info"):
             row["info"] = result["info"]
+        col = self.collector
+        if col is not None:
+            # Segment span: cut → decided (queue wait included), member
+            # children already recorded against span_id when the fold
+            # path minted one. Emitted HERE — the one recording seam
+            # every path crosses — so carry-lost, failed-round and
+            # worker-died segments keep the documented invariant that
+            # an op trace resolves to exactly one covering segment span
+            # (the collector lock is leaf-level; holding _lock here is
+            # safe). See trace.py's module docstring.
+            now_ns = _time.monotonic_ns()
+            col.record(
+                "online.segment", span_id=span_id, stage="segment",
+                start_ns=seg.cut_ns or now_ns, end_ns=now_ns,
+                seq=seg.seq, key=row["key"],
+                start_index=seg.start_index, end_index=seg.end_index,
+                terminal=seg.terminal, verdict=str(result.get("valid")),
+                engine=engine, members=members,
+                decide_s=round(wall_s, 6))
         v = result.get("valid")
         self._n_decided += 1
         if v is False:
@@ -521,7 +672,14 @@ class SegmentScheduler:
                 except Exception:  # noqa: BLE001
                     LOG.warning("on_violation callback failed",
                                 exc_info=True)
+        # Per-key queue depth (the /live view): this segment is decided.
+        d = self._key_depth.get(seg.key, 1) - 1
+        if d <= 0:
+            self._key_depth.pop(seg.key, None)
+        else:
+            self._key_depth[seg.key] = d
         # Watermark: advance over the contiguous fully-decided prefix.
+        before = self._watermark
         left = self._seq_outstanding.get(seg.seq, 0) - 1
         self._seq_outstanding[seg.seq] = left
         while self._seq_outstanding.get(self._next_seq) == 0:
@@ -530,6 +688,15 @@ class SegmentScheduler:
             del self._seq_outstanding[self._next_seq]
             del self._seq_end[self._next_seq]
             self._next_seq += 1
+        if self._watermark > before and self.on_watermark is not None:
+            # Called with the scheduler lock held (documented in the
+            # ctor): the monitor's handler takes only its own latency
+            # lock, so the op decision-latency histogram observes at
+            # the exact moment coverage lands.
+            try:
+                self.on_watermark(self._watermark)
+            except Exception:  # noqa: BLE001 - observers never sink us
+                LOG.warning("on_watermark callback failed", exc_info=True)
         if self.metrics is not None:
             self.metrics.counter(
                 "online_segments_total",
@@ -540,6 +707,10 @@ class SegmentScheduler:
                 "online_decided_watermark",
                 "Highest history index through which the online verdict "
                 "is decided").set(self._watermark)
+            self.metrics.gauge(
+                "online_scheduler_backlog",
+                "Segments submitted to the online scheduler and not yet "
+                "decided").set(sum(self._key_depth.values()))
 
     def _fold_locked(self) -> Any:
         # merge_valid over EVERY decided segment, via counters — the

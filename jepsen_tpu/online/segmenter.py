@@ -38,6 +38,7 @@ legal initial states for the next.
 
 from __future__ import annotations
 
+import time as _time
 from dataclasses import dataclass, replace
 from typing import Any, Iterable, Optional
 
@@ -70,6 +71,10 @@ class KeySegment:
     start_index: int
     end_index: int
     terminal: bool = False
+    # Monotonic ns when the cut closed (all KeySegments of one cut share
+    # it) — the start of the segment's trace span, so queue-wait before
+    # the scheduler picks it up is visible in the decision-latency chain.
+    cut_ns: int = 0
 
     @property
     def n_ops(self) -> int:
@@ -91,6 +96,10 @@ class Segmenter:
         self.ops_seen = 0
         self._saw_keyed = False
         self._saw_keyless = False
+        # The (index-assigned) Op the last offer() consumed — the
+        # monitor reads its index/kind for decision-latency tracking
+        # without re-parsing the raw dict. None before the first offer.
+        self.last_op: Optional[Op] = None
 
     @property
     def open_ops(self) -> int:
@@ -131,6 +140,7 @@ class Segmenter:
         """Consume one history op (Op or plain scheduler dict); returns
         the KeySegments of a newly closed segment, usually ``[]``."""
         op = self._as_op(op)
+        self.last_op = op
         self.ops_seen += 1
         if not op.is_client:
             return []  # nemesis ops have no invoke/complete discipline
@@ -165,14 +175,16 @@ class Segmenter:
         self._seq += 1
         start = ops[0].index
         end = ops[-1].index
+        cut_ns = _time.monotonic_ns()
         keys = sorted(ind.history_keys(ops), key=repr)
         if not keys:
             return [KeySegment(SINGLE_KEY, seq, tuple(ops), start, end,
-                               terminal)]
+                               terminal, cut_ns)]
         out = []
         for k in keys:
             sub = ind.subhistory(k, History(ops, reindex=False))
-            out.append(KeySegment(k, seq, tuple(sub), start, end, terminal))
+            out.append(KeySegment(k, seq, tuple(sub), start, end, terminal,
+                                  cut_ns))
         return out
 
 

@@ -55,6 +55,7 @@ from typing import Any, Optional
 import numpy as np
 
 from .encode import EncodedHistory, OPEN, encode_history
+from .. import trace as _trace
 from ..history import History
 from ..models import Model
 
@@ -1006,8 +1007,13 @@ def _note_chunk_metrics(metrics, lvl_stats, lvl0: int, lvl: int, F: int,
     # Per-chunk event: the attribution seam telemetry.profile consumes —
     # (levels run, capacity, wall, compile-vs-execute) is exactly what a
     # roofline classification needs per chunk.
+    # Trace-context tags (trace.span_tags): when a dispatching span is
+    # active on this thread (the online scheduler's oracle call), the
+    # chunk event carries its id — op→segment→oracle→chunk linkage with
+    # zero new kernel-driver arguments. {} (shared instance) otherwise.
     metrics.event("wgl_chunk", level0=int(lvl0), level=int(lvl),
-                  F=int(F), wall_s=round(chunk_wall, 6), stage=stage)
+                  F=int(F), wall_s=round(chunk_wall, 6), stage=stage,
+                  **_trace.event_tags())
     if lvl_stats is None:
         return
     rows = lvl_stats[np.argsort(lvl_stats[:, 0], kind="stable")]

@@ -39,6 +39,7 @@ from typing import Optional, Sequence
 
 import numpy as np
 
+from .. import trace as _trace
 from ..history import History
 from ..models import Model
 from ..ops import wgl
@@ -321,11 +322,14 @@ def check_encoded_batch(
                     "Members still searching / batch rows, after the "
                     "last chunk", labelnames=("F",)).labels(F=F).set(
                         float(active.sum()) / Bk)
+                # event_tags: trace-context linkage (trace_span of the
+                # dispatching oracle span, if any) — see trace.span_tags.
                 metrics.event(
                     "wgl_batch_chunk", F=F, chunk=calls,
                     active=int(active.sum()), batch=Bk,
                     level_max=int(lsub.max()),
-                    wall_s=round(_time.perf_counter() - t_rung, 4))
+                    wall_s=round(_time.perf_counter() - t_rung, 4),
+                    **_trace.event_tags())
             if chunk_callback is not None:
                 chunk_callback({
                     "F": F, "rung": ri, "chunk": calls,
@@ -351,7 +355,8 @@ def check_encoded_batch(
                 decided=int(np.sum(acc_s | stuck_s)),
                 overflowed=int(np.sum(ovf_s & ~acc_s & ~stuck_s))
                 if not lossy_rung else 0,
-                lossy=bool(lossy_rung))
+                lossy=bool(lossy_rung),
+                **_trace.event_tags())
         # Classify this rung's rows; decided members get results NOW so
         # a later-rung failure can't lose them.
         overflowed = []

@@ -44,6 +44,7 @@ from typing import Optional
 
 import numpy as np
 
+from .. import trace as _trace
 from ..ops import wgl
 from ..ops.encode import EncodedHistory
 from . import make_mesh
@@ -292,7 +293,9 @@ def check_encoded_sharded(
                     # legacy allgather_bytes alias rides along in
                     # allgather mode only.
                     exchange=exchange, exchange_bytes=ex_bytes,
-                    **ev_extra)
+                    # Trace-context linkage (trace.span_tags): the
+                    # dispatching span's id, when one is active.
+                    **ev_extra, **_trace.event_tags())
 
             def result(valid, **extra):
                 r = {"valid": valid, "op_count": n, "device": True,

@@ -85,6 +85,13 @@ def std_generator(opts: Optional[dict], client_gen,
     partitioner); ``final_nemesis_op`` correspondingly replaces the
     closing stop/heal op.
 
+    ``opts["nemesis_interval"]`` overrides ``dt`` (several suites
+    already resolved the opt per-suite and passed ``dt=``; honoring it
+    here makes every std_generator suite consistent). The interpreter
+    finishes an in-flight nemesis sleep before the time limit can cut
+    the phase, so a dt longer than the time limit — the contract tests
+    run time_limit 1.5 s — otherwise dominates the wall clock.
+
     The time limit wraps the WHOLE nemesis+client composite: an infinite
     ``cycle_`` otherwise keeps the phase alive forever after a bounded
     client generator exhausts (the interpreter only exits when every
@@ -92,6 +99,10 @@ def std_generator(opts: Optional[dict], client_gen,
     """
     o = dict(opts or {})
     tl = float(o.get("time_limit") or o.get("time-limit") or 60)
+    ni = o.get("nemesis_interval")
+    if ni is None:
+        ni = o.get("nemesis-interval")
+    dt = dt if ni is None else float(ni)  # explicit 0 = back-to-back
     if nemesis_gen is None:
         nemesis_gen = gen.cycle_([
             gen.sleep(dt),

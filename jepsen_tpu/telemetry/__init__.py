@@ -42,11 +42,13 @@ from .profile import (  # noqa: F401
     trace_capture,
 )
 from .registry import (  # noqa: F401
+    DECISION_LATENCY_BUCKETS,
     DEFAULT_BUCKETS,
     Counter,
     Gauge,
     Histogram,
     Registry,
+    bucket_quantile,
     timed_phase,
 )
 
@@ -79,8 +81,10 @@ def of_test(test: Optional[dict]) -> Optional[Registry]:
 
 __all__ = [
     "Counter",
+    "DECISION_LATENCY_BUCKETS",
     "DEFAULT_BUCKETS",
     "FlightRecorder",
+    "bucket_quantile",
     "Gauge",
     "Heartbeat",
     "Histogram",

@@ -52,12 +52,18 @@ class FlightRecorder:
     """
 
     def __init__(self, budget_s: Optional[float] = None,
-                 max_events: int = 512):
+                 max_events: int = 512, max_phases: int = 4096):
         self.budget_s = budget_s
         self._t0 = _time.monotonic()
         self._lock = threading.Lock()
         self._events: deque = deque(maxlen=max_events)
-        self._phases: list[dict] = []
+        # Bounded like the note ring: the online scheduler enters three
+        # ledger phases per decide round, so a long monitored stream
+        # would otherwise grow the ledger (and every flightrecord.json
+        # flush) without limit. Post-mortems want the RECENT window
+        # anyway; a phase dict evicted while still open is mutated
+        # harmlessly by its context manager.
+        self._phases: deque = deque(maxlen=max_phases)
         self._open: list[dict] = []  # stack of phases in flight
         self._seq: Optional[dict] = None  # current begin()-phase
 

@@ -26,6 +26,44 @@ DEFAULT_BUCKETS = (
     0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
 )
 
+# Decision-latency buckets (seconds) for the online monitor's
+# invoke→watermark-covered lag: the DEFAULT_BUCKETS top out at 10 s,
+# but a backlogged scheduler (or a device compile mid-stream) can hold
+# an op undecided for minutes — with everything past 10 s lumped into
+# +Inf, p99 estimation saturates at the last finite bound and a 30 s
+# stall reads exactly like a 30 min one. Extended tail fixes that.
+DECISION_LATENCY_BUCKETS = (
+    0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+    10.0, 30.0, 60.0, 120.0, 300.0,
+)
+
+
+def bucket_quantile(bounds: Sequence[float], counts: Sequence[int],
+                    q: float) -> Optional[float]:
+    """Prometheus-style ``histogram_quantile`` over PER-BUCKET (non-
+    cumulative) counts: find the bucket the q-rank falls in and
+    interpolate linearly inside it (lower edge = previous bound, 0 for
+    the first). ``counts`` may carry one extra trailing +Inf bucket;
+    ranks landing there clamp to the highest finite bound (the honest
+    answer a bucketed histogram can give). None when empty."""
+    counts = list(counts)
+    total = sum(counts)
+    if total <= 0:
+        return None
+    rank = max(q, 0.0) * total
+    cum = 0
+    lo = 0.0
+    for i, b in enumerate(bounds):
+        c = counts[i] if i < len(counts) else 0
+        cum += c
+        if cum >= rank:
+            if c <= 0:
+                return float(b)
+            frac = (rank - (cum - c)) / c
+            return lo + (float(b) - lo) * frac
+        lo = float(b)
+    return float(bounds[-1])  # +Inf bucket: clamp to last finite bound
+
 
 class _CounterChild:
     __slots__ = ("_lock", "value")
@@ -82,6 +120,11 @@ class _HistogramChild:
             self.counts[i] += 1
             self.sum += value
             self.count += 1
+
+    def quantile(self, q: float) -> Optional[float]:
+        with self._lock:
+            counts = list(self.counts)
+        return bucket_quantile(self.buckets, counts, q)
 
 
 class Metric:
@@ -191,6 +234,26 @@ class Histogram(Metric):
     def observe(self, value: float) -> None:
         self._default.observe(value)
 
+    def quantile(self, q: float) -> Optional[float]:
+        """Estimated q-quantile of the (unlabeled) default child."""
+        return self._default.quantile(q)
+
+    def stats(self, quantiles: Sequence[float] = (0.5, 0.9, 0.99)
+              ) -> dict:
+        """Count/sum plus interpolated quantiles of the default child —
+        the ``{"count", "sum_s", "p50_s", ...}`` summary block
+        online.json and the bench legs embed."""
+        child = self._default
+        with child._lock:
+            counts = list(child.counts)
+            out: dict = {"count": child.count,
+                         "sum_s": round(child.sum, 6)}
+        for q in quantiles:
+            v = bucket_quantile(self.buckets, counts, q)
+            out[f"p{int(round(q * 100))}_s"] = (
+                round(v, 6) if v is not None else None)
+        return out
+
 
 class Registry:
     """Named-metric registry + bounded event stream.
@@ -203,6 +266,12 @@ class Registry:
         self._lock = threading.Lock()
         self._metrics: dict[str, Metric] = {}
         self._events: deque = deque(maxlen=max_events)
+        # name -> newest event with that name (may outlive its ring
+        # slot): last_event() must stay O(1) — the web /live poll reads
+        # it per refresh while holding the same lock every hot-path
+        # metric call takes, so a 100k-deque reverse scan per poll
+        # would stall the instrumented paths.
+        self._last_by_name: dict[str, dict] = {}
         self.created_at = _time.time()
 
     def _get_or_make(self, cls, name, help, labelnames, **extra) -> Any:
@@ -245,7 +314,9 @@ class Registry:
         Locked against :meth:`events` — iterating a deque while another
         thread appends raises."""
         with self._lock:
-            self._events.append({"name": name, **fields})
+            ev = {"name": name, **fields}
+            self._events.append(ev)
+            self._last_by_name[name] = ev
 
     def events(self, name: Optional[str] = None) -> list[dict]:
         with self._lock:
@@ -253,6 +324,15 @@ class Registry:
         if name is None:
             return evs
         return [e for e in evs if e.get("name") == name]
+
+    def last_event(self, name: str) -> Optional[dict]:
+        """Newest event with this name, or None — O(1) via the
+        per-name index (a live dashboard polls this every second while
+        the hot paths contend for the same lock; the indexed entry may
+        outlive its bounded ring slot, which is fine for "newest")."""
+        with self._lock:
+            e = self._last_by_name.get(name)
+            return dict(e) if e is not None else None
 
     def collect(self) -> list[dict]:
         """Samples of every metric, sorted by (name, labels)."""

@@ -6,6 +6,28 @@ This module provides the same capability framework-wide without external
 collectors: nested spans with wall-clock bounds recorded per thread, an
 in-memory collector, JSON-lines export into the store directory, and a
 client wrapper that spans every invoke.
+
+Trace-context propagation (the online monitor's decision-latency chain):
+the thread-local ``span()`` stack cannot express a parent on another
+thread, so cross-thread causality — an op invocation observed on the
+interpreter thread, its segment decided on the scheduler worker, the
+device chunk that decided it — uses two explicit seams instead:
+
+- :meth:`Collector.record` logs an already-timed span with explicit
+  ``trace_id``/``parent_id``/``stage`` linkage (stages: ``op`` →
+  ``segment`` → ``member`` → ``oracle``). An op's trace id is
+  ``op-<history index>``; a segment span carries the
+  ``start_index``/``end_index`` range it covers, so an op trace resolves
+  to the one segment span whose key matches and whose range contains its
+  index, then down the parent ids.
+- :func:`span_tags` pushes a thread-local tag dict that
+  :func:`event_tags` returns; the kernel drivers (``ops/wgl.py``,
+  ``parallel/batch.py``, ``parallel/frontier.py``) merge it into their
+  per-chunk telemetry events, so device chunks link back to the
+  dispatching ``oracle`` span (``trace_span=<span id>``) without any new
+  plumbing through the kernel entry points. With no tags pushed,
+  ``event_tags()`` returns one shared empty dict — the off path
+  allocates nothing.
 """
 
 from __future__ import annotations
@@ -39,11 +61,45 @@ class Collector:
             st = self._local.stack = []
         return st
 
+    def mint_id(self) -> str:
+        """A fresh span id (atomic; see _ids). Public so a caller can
+        hand the id to children BEFORE the parent span is recorded —
+        the online scheduler mints a segment span's id up front, emits
+        member spans against it, then records the parent at fold time."""
+        return f"{threading.get_ident():x}-{next(self._ids)}"
+
+    def record(self, name: str, *, start_ns: int, end_ns: int,
+               span_id: Optional[str] = None,
+               trace_id: Optional[str] = None,
+               parent_id: Optional[str] = None,
+               stage: Optional[str] = None, **attrs: Any) -> dict:
+        """Log an already-timed span with explicit linkage (the
+        cross-thread seam: op → segment → member → oracle stages of the
+        online monitor's decision chain; see the module docstring)."""
+        rec: dict = {
+            "name": name,
+            "span_id": span_id or self.mint_id(),
+            "parent_id": parent_id,
+            "thread": threading.current_thread().name,
+            "start_ns": int(start_ns),
+            "end_ns": int(end_ns),
+            "duration_us": (int(end_ns) - int(start_ns)) // 1000,
+        }
+        if trace_id is not None:
+            rec["trace_id"] = trace_id
+        if stage is not None:
+            rec["stage"] = stage
+        if attrs:
+            rec["attrs"] = attrs
+        with self._lock:
+            self.spans.append(rec)
+        return rec
+
     @contextmanager
     def span(self, name: str, **attrs: Any):
         """Record a span around the body (trace.clj:9-30's with-trace)."""
         stack = self._stack()
-        sid = f"{threading.get_ident():x}-{next(self._ids)}"
+        sid = self.mint_id()
         parent = stack[-1] if stack else None
         rec = {
             "name": name,
@@ -90,6 +146,36 @@ def default_collector() -> Collector:
 
 def span(name: str, **attrs):
     return _default.span(name, **attrs)
+
+
+# ---------------------------------------------------------------------------
+# Trace-context event tags: a thread-local dict the kernel drivers merge
+# into their per-chunk telemetry events (wgl_chunk / wgl_batch_chunk /
+# wgl_sharded_chunk), linking device chunks to the span that dispatched
+# them without threading new arguments through the kernel entry points.
+
+_tags_local = threading.local()
+_EMPTY_TAGS: dict = {}
+
+
+@contextmanager
+def span_tags(**tags: Any):
+    """Attach trace-context tags to telemetry events emitted inside the
+    body (nests: inner tags shadow outer keys, the outer dict is
+    restored on exit). The online scheduler pushes
+    ``trace_span=<oracle span id>`` around each engine decide call."""
+    prev = getattr(_tags_local, "d", None)
+    _tags_local.d = {**prev, **tags} if prev else dict(tags)
+    try:
+        yield
+    finally:
+        _tags_local.d = prev
+
+
+def event_tags() -> dict:
+    """The current thread's trace-context tags — ``{}`` (one shared
+    instance, no allocation) when none are pushed."""
+    return getattr(_tags_local, "d", None) or _EMPTY_TAGS
 
 
 class TracingClient(jclient.Client):

@@ -7,6 +7,16 @@ run (web.clj:48-69, served via cli serve — cli.clj:323-340); plus a
 ``/metrics`` page rendering each run's telemetry (metrics.jsonl, written
 by runs with ``test["telemetry?"]``/``--telemetry``) next to the results
 table, with the raw spans/metrics artifacts linked from the index.
+
+Live operational view: ``/live`` serves an ndjson poll of every
+registered *live source* — one JSON line per in-flight run, fed by the
+online monitor's ``live_snapshot()`` (decided-watermark frontier,
+per-key queue depths, scheduler backlog, decision-latency quantiles,
+watermark-stall seconds, per-shard utilization). ``core.run`` registers
+a source while a monitored run executes (and an in-process server when
+``--live-port`` is set); ``/live.html`` is a self-refreshing dashboard
+over the same feed. With no live run the endpoint still answers one
+well-formed ``{"live_runs": 0}`` line, so pollers never special-case.
 """
 
 from __future__ import annotations
@@ -15,15 +25,64 @@ import html
 import io
 import json
 import logging
+import threading
 import zipfile
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from pathlib import Path
-from typing import Any, Optional
+from typing import Any, Callable, Optional
 from urllib.parse import unquote
 
 from . import store
 
 LOG = logging.getLogger("jepsen.web")
+
+
+# ---------------------------------------------------------------------------
+# Live sources: process-global so the serving handler (which only knows
+# the store root) can reach in-flight runs registered by core.run.
+
+_LIVE_LOCK = threading.Lock()
+_LIVE_SOURCES: dict[str, Callable[[], dict]] = {}
+
+
+def register_live_source(key: str, fn: Callable[[], dict]) -> None:
+    """Expose ``fn()`` (a dict snapshot, e.g. ``OnlineMonitor.
+    live_snapshot``) as one ``/live`` line under ``key`` until
+    unregistered. Re-registering a key replaces its source."""
+    with _LIVE_LOCK:
+        _LIVE_SOURCES[key] = fn
+
+
+def unregister_live_source(key: str) -> None:
+    with _LIVE_LOCK:
+        _LIVE_SOURCES.pop(key, None)
+
+
+def live_snapshots() -> list[dict]:
+    """One snapshot dict per registered source; a source that raises
+    yields an ``{"error": ...}`` line instead of sinking the poll."""
+    with _LIVE_LOCK:
+        items = list(_LIVE_SOURCES.items())
+    out = []
+    for key, fn in items:
+        try:
+            snap = dict(fn())
+        except Exception as e:  # noqa: BLE001 - a poll must not 500
+            snap = {"error": f"{type(e).__name__}: {e}"}
+        if snap.get("run") is None:  # unnamed run: label with the key
+            snap["run"] = key
+        out.append(snap)
+    return out
+
+
+def live_ndjson() -> str:
+    """The ``/live`` payload: one JSON line per live run, or one
+    ``{"live_runs": 0}`` line when nothing is registered."""
+    snaps = live_snapshots()
+    if not snaps:
+        return json.dumps({"live_runs": 0}) + "\n"
+    return "".join(json.dumps(s, sort_keys=True, default=str) + "\n"
+                   for s in snaps)
 
 
 def _valid_of(run_dir: Path) -> Any:
@@ -85,7 +144,8 @@ def _index_page(root: Path) -> str:
         "<body><h1>Jepsen tests</h1>"
         '<p><a href="/metrics">metrics</a> · '
         '<a href="/profile">profile</a> · '
-        '<a href="/online">online</a></p><table>'
+        '<a href="/online">online</a> · '
+        '<a href="/live.html">live</a></p><table>'
         "<tr><th>Test</th><th>Started</th><th>Valid?</th>"
         "<th>Telemetry</th><th></th></tr>"
         + "".join(rows) + "</table></body></html>"
@@ -120,6 +180,29 @@ def _metrics_summary(run_dir: Path, limit: int = 200) -> list[tuple]:
                     cnt = s.get("count") or 0
                     mean = (s.get("sum") or 0) / cnt if cnt else 0
                     val = f"n={cnt} mean={mean:.4g}s"
+                    # Buckets/quantiles, not just counts: the stored
+                    # sample carries per-bucket counts — render the
+                    # interpolated p50/p99 next to the mean (the
+                    # decision-latency family is useless without them).
+                    b = s.get("buckets") or {}
+                    # metrics.jsonl is written sort_keys=True, which
+                    # orders bucket keys LEXICALLY ("+Inf" first,
+                    # "10.0" before "2.5") — re-sort numerically with
+                    # +Inf last or bounds/counts feed bucket_quantile
+                    # misaligned.
+                    pairs = sorted(
+                        ((float("inf") if k == "+Inf" else float(k), c)
+                         for k, c in b.items()))
+                    bounds = [k for k, _c in pairs if k != float("inf")]
+                    if bounds and cnt:
+                        from .telemetry.registry import bucket_quantile
+
+                        counts = [c for _k, c in pairs]
+                        p50 = bucket_quantile(bounds, counts, 0.5)
+                        p99 = bucket_quantile(bounds, counts, 0.99)
+                        val += (f" p50={p50:.4g}s p99={p99:.4g}s"
+                                if p50 is not None and p99 is not None
+                                else "")
                 else:
                     v = s.get("value")
                     val = str(int(v)) if isinstance(v, (int, float)) \
@@ -350,6 +433,53 @@ def _online_page(root: Path) -> str:
     )
 
 
+_LIVE_HTML = """<html><head><title>Jepsen live</title>
+<style>%s
+#none { color: #888; } .stall { background: #f7c5c5; }
+pre { background: #f6f6f6; padding: 0.6em; }</style></head>
+<body><h1>Live runs</h1>
+<p><a href="/">index</a> · <a href="/metrics">metrics</a> ·
+<a href="/online">online</a> · raw feed: <a href="/live">/live</a>
+(ndjson poll)</p>
+<div id="runs"><p id="none">polling /live…</p></div>
+<script>
+async function tick() {
+  try {
+    const txt = await (await fetch('/live')).text();
+    const runs = txt.trim().split('\\n').map(JSON.parse);
+    const box = document.getElementById('runs');
+    if (runs.length === 1 && runs[0].live_runs === 0) {
+      box.innerHTML = '<p id="none">no live runs — start one with ' +
+                      '--online --live-port</p>';
+    } else {
+      box.innerHTML = runs.map(r => {
+        const lat = r.decision_latency || {};
+        const stall = (r.watermark_stall_seconds || 0) > 0;
+        return '<h2>' + (r.run || '?') + '</h2>' +
+          '<p' + (stall ? ' class="stall"' : '') + '>' +
+          'verdict ' + r.verdict +
+          ' · watermark ' + r.decided_through_index +
+          ' / ' + r.ops_observed + ' ops' +
+          ' · backlog ' + r.scheduler_backlog +
+          ' · open ' + r.open_segment_ops + ' ops' +
+          (stall ? ' · STALLED ' + r.watermark_stall_seconds + 's'
+                 : '') +
+          ' · p50/p99 decide ' + lat.p50_s + '/' + lat.p99_s + 's' +
+          '</p><pre>' + JSON.stringify(r, null, 1) + '</pre>';
+      }).join('');
+    }
+  } catch (e) { /* server gone: keep polling */ }
+  setTimeout(tick, 1000);
+}
+tick();
+</script></body></html>
+"""
+
+
+def _live_page() -> str:
+    return _LIVE_HTML % _STYLE
+
+
 def _listing_page(rel: str, d: Path) -> str:
     items = "".join(
         f'<li><a href="/files/{rel}{f.name}{"/" if f.is_dir() else ""}">'
@@ -389,6 +519,13 @@ def make_handler(root: Path):
                     return
                 if path in ("/online", "/online/"):
                     self._send(200, _online_page(root).encode())
+                    return
+                if path in ("/live", "/live/"):
+                    self._send(200, live_ndjson().encode(),
+                               "application/x-ndjson; charset=utf-8")
+                    return
+                if path == "/live.html":
+                    self._send(200, _live_page().encode())
                     return
                 if path.startswith("/zip/"):
                     rel = path[len("/zip/"):].strip("/")

@@ -520,11 +520,16 @@ class TestScheduler:
 class TestDifferential:
     """Folded online verdict == offline check_history verdict, across
     valid / seeded-invalid / overflow-unknown / no-quiescence histories,
-    abort_on_violation on and off."""
+    abort_on_violation on and off — WITH decision-latency tracing
+    enabled (registry + span collector), pinning the ISSUE-6 acceptance
+    clause that the contract survives tracing on."""
 
     def both(self, h, abort, **kw):
+        from jepsen_tpu import trace as jtrace
+
         mon = OnlineMonitor(model(), abort_on_violation=abort,
-                            engine="host", **kw)
+                            engine="host", metrics=Registry(),
+                            collector=jtrace.Collector(), **kw)
         return stream(mon, h)
 
     @pytest.mark.parametrize("abort", [False, True])
@@ -648,6 +653,313 @@ class TestDifferential:
         terminal_rows = [s for s in fin["segments"] if s["terminal"]]
         assert terminal_rows and all(s["engine"] == "device"
                                      for s in terminal_rows)
+
+
+# ---------------------------------------------------------------------------
+# Decision-latency tracing: the op→segment→member→oracle span chain,
+# the latency histogram, the stall detector, and the flight phases.
+
+
+class TestDecisionLatencyTracing:
+    def traced(self, h, **kw):
+        from jepsen_tpu import trace as jtrace
+
+        reg = Registry()
+        col = jtrace.Collector()
+        mon = OnlineMonitor(model(), metrics=reg, collector=col, **kw)
+        fin = stream(mon, h)
+        return fin, reg, col
+
+    def spans_by_stage(self, col):
+        out = {}
+        for s in col.spans:
+            out.setdefault(s.get("stage"), []).append(s)
+        return out
+
+    def test_latency_histogram_and_summary(self):
+        h = chunked_register_history(random.Random(21), n_ops=200,
+                                     n_procs=4, chunk_ops=40)
+        fin, reg, _col = self.traced(h, engine="host")
+        assert fin["valid"] is True
+        lat = fin["decision_latency"]
+        n_invokes = sum(1 for op in h if op.is_invoke)
+        assert lat["count"] == n_invokes
+        assert lat["undecided_ops"] == 0
+        assert lat["p50_s"] <= lat["p90_s"] <= lat["p99_s"]
+        # The same family lands on the registry, wide buckets included.
+        samples = [s for s in reg.collect()
+                   if s["name"] == "decision_latency_seconds"]
+        assert len(samples) == 1
+        assert samples[0]["count"] == n_invokes
+        assert "300.0" in samples[0]["buckets"]
+
+    def test_every_decided_op_resolves_to_one_segment_span(self):
+        h = chunked_register_history(random.Random(22), n_ops=120,
+                                     n_procs=4, chunk_ops=40)
+        fin, _reg, col = self.traced(h, engine="host")
+        assert fin["valid"] is True
+        by = self.spans_by_stage(col)
+        segs = by.get("segment") or []
+        assert len(segs) == fin["segments_decided"]
+        ops = by.get("op") or []
+        assert len(ops) == sum(1 for op in h if op.is_invoke)
+        for s in ops:
+            idx = s["attrs"]["index"]
+            assert s["trace_id"] == f"op-{idx}"
+            covering = [g for g in segs
+                        if g["attrs"]["start_index"] <= idx
+                        <= g["attrs"]["end_index"]]
+            assert len(covering) == 1, f"op {idx} covered by {covering}"
+        # Member spans parent into their segment span, one per carried
+        # state, and every segment has at least one.
+        members = by.get("member") or []
+        seg_ids = {g["span_id"] for g in segs}
+        assert members and all(m["parent_id"] in seg_ids
+                               for m in members)
+        parented = {m["parent_id"] for m in members}
+        assert parented == seg_ids
+
+    def test_oracle_span_links_terminal_members(self):
+        # A trailing open invocation makes the final segment terminal:
+        # its members bypass the enumerator and decide on the engine's
+        # oracle, whose span the member spans must reference (and only
+        # one such oracle span exists for them to resolve to).
+        h = ops4(("invoke", 0, "write", 1), ("ok", 0, "write", 1),
+                 ("invoke", 1, "read", None), ("ok", 1, "read", 1),
+                 ("invoke", 0, "write", 2))
+        fin, _reg, col = self.traced(h, engine="host")
+        assert fin["valid"] is True
+        by = self.spans_by_stage(col)
+        oracles = by.get("oracle") or []
+        assert len(oracles) == 1
+        assert oracles[0]["attrs"]["engine"] == "host"
+        oracle_members = [m for m in (by.get("member") or [])
+                          if m["attrs"].get("path") == "oracle"]
+        assert oracle_members
+        for m in oracle_members:
+            assert m["attrs"]["oracle_span"] == oracles[0]["span_id"]
+        # Enumerator-decided members carry no oracle linkage.
+        for m in (by.get("member") or []):
+            if m["attrs"].get("path") == "enumerator":
+                assert "oracle_span" not in m["attrs"]
+
+    def test_unknown_folded_segments_still_emit_segment_spans(
+            self, monkeypatch):
+        # Segments folded unknown OUTSIDE the happy fold path (here: a
+        # crashed decide round) must still emit their segment span, or
+        # the one-covering-span resolution rule breaks for ops the
+        # watermark covers anyway.
+        from jepsen_tpu import trace as jtrace
+        from jepsen_tpu.online import scheduler as sched_mod
+
+        monkeypatch.setattr(
+            sched_mod, "segment_states",
+            lambda enc, **kw: (_ for _ in ()).throw(
+                RuntimeError("engine crashed")))
+        col = jtrace.Collector()
+        sched = SegmentScheduler(model(), engine="host", collector=col)
+        seg = Segmenter()
+        h = ops4(("invoke", 0, "write", 1), ("ok", 0, "write", 1))
+        for op in h:
+            batch = seg.offer(op)
+            if batch:
+                sched.submit(batch)
+        assert sched.wait_idle(10.0)
+        sched.close()
+        assert sched.verdict == "unknown"
+        (span,) = [s for s in col.spans if s.get("stage") == "segment"]
+        assert span["attrs"]["verdict"] == "unknown"
+        assert span["attrs"]["start_index"] == 0
+        assert span["attrs"]["end_index"] == 1
+
+    def test_spans_export_jsonl(self, tmp_path):
+        from jepsen_tpu import trace as jtrace
+
+        h = chunked_register_history(random.Random(23), n_ops=60,
+                                     n_procs=2, chunk_ops=30)
+        _fin, _reg, col = self.traced(h, engine="host")
+        p = tmp_path / "spans.jsonl"
+        n = col.export_jsonl(p)
+        assert n == len(col.spans)
+        import json
+
+        lines = [json.loads(l) for l in p.read_text().splitlines()]
+        assert {l.get("stage") for l in lines} >= {"op", "segment",
+                                                   "member"}
+
+    @pytest.mark.slow
+    def test_device_chunk_events_carry_trace_span(self):
+        # The full chain on the device engine: terminal segments decide
+        # through the PR-2 batched pipeline, whose chunk events must be
+        # tagged with the dispatching oracle span id — every decided
+        # op's trace resolves op → segment → member → oracle → chunk.
+        # The straddling open invocation matters twice over: it makes
+        # the whole stream ONE terminal segment (terminal members skip
+        # the enumerator and go to the engine oracle), and it keeps the
+        # segment non-trivial (a terminal segment of just the open op
+        # plans nD=0 and short-circuits before any kernel chunk runs).
+        rng = random.Random(24)
+        base = list(chunked_register_history(rng, n_ops=40, n_procs=2,
+                                             chunk_ops=20))
+        ops = [Op("invoke", 9, "read", None, time=-1)] + base
+        h = History(ops, reindex=True)
+        fin, reg, col = self.traced(h, engine="device", batch_f=64)
+        assert fin["valid"] is offline(h)["valid"] is True
+        by = self.spans_by_stage(col)
+        oracles = {s["span_id"]: s for s in by.get("oracle") or []}
+        assert oracles
+        tagged = [e for e in reg.events()
+                  if e.get("trace_span") is not None]
+        assert tagged, "no chunk event carried a trace_span tag"
+        assert {e["trace_span"] for e in tagged} <= set(oracles)
+        # ...and each oracle-decided member resolves to exactly one
+        # oracle span (the linkage the latency attribution rides).
+        for m in by.get("member") or []:
+            osid = m["attrs"].get("oracle_span")
+            if osid is not None:
+                assert osid in oracles
+        # Off the scheduler thread the tags are gone: a fresh direct
+        # kernel call emits untagged events.
+        from jepsen_tpu import trace as jtrace
+
+        assert jtrace.event_tags() == {}
+
+
+class TestWatermarkStall:
+    def test_stall_gauge_fires_and_clears(self):
+        from jepsen_tpu.telemetry import FlightRecorder
+
+        reg = Registry()
+        rec = FlightRecorder()
+        mon = OnlineMonitor(model(), engine="host", metrics=reg,
+                            flight=rec, stall_after_s=0.05)
+
+        def gauge():
+            for s in reg.collect():
+                if s["name"] == "online_watermark_stall_seconds":
+                    return s["value"]
+            return None
+
+        # p0's invocation stays open: every would-be cut is straddled,
+        # the watermark sits at -1 while p1's ops keep flowing.
+        mon.observe(Op("invoke", 0, "write", 1, time=0))
+        t = 1
+        deadline = time.monotonic() + 5.0
+        while gauge() == 0.0 and time.monotonic() < deadline:
+            mon.observe(Op("invoke", 1, "write", t, time=10 * t))
+            mon.observe(Op("ok", 1, "write", t, time=10 * t + 1))
+            t += 1
+            time.sleep(0.02)
+        assert gauge() > 0.0, "stall gauge never fired"
+        phases = [p for p in rec.snapshot()["phases"]
+                  if p["phase"] == "online.watermark_stall"]
+        assert len(phases) == 1 and "end_s" not in phases[0]
+        assert rec.offending_phase() == "online.watermark_stall"
+        # Quiescence returns: the cut closes, the watermark advances,
+        # the gauge drops to zero and the stall phase ends.
+        mon.observe(Op("ok", 0, "write", 1, time=10 * t))
+        assert mon.scheduler.wait_idle(10.0)
+        fin = mon.finish()
+        assert fin["valid"] is True
+        assert gauge() == 0.0
+        phases = [p for p in rec.snapshot()["phases"]
+                  if p["phase"] == "online.watermark_stall"]
+        assert len(phases) == 1 and "end_s" in phases[0]
+
+    def test_quiet_gap_does_not_fire_stall(self):
+        # A fully-covered monitor that goes idle past stall_after_s
+        # (client think time, a paused workload) must NOT fire the
+        # stall on the first op after the gap: the stall clock starts
+        # when the first UNCOVERED op appears, not at the last
+        # pre-gap advance.
+        reg = Registry()
+        mon = OnlineMonitor(model(), engine="host", metrics=reg,
+                            stall_after_s=0.05)
+
+        def gauge():
+            for s in reg.collect():
+                if s["name"] == "online_watermark_stall_seconds":
+                    return s["value"]
+            return None
+
+        mon.observe(Op("invoke", 0, "write", 1, time=0))
+        mon.observe(Op("ok", 0, "write", 1, time=1))
+        assert mon.scheduler.wait_idle(10.0)
+        time.sleep(0.15)  # idle, nothing pending: > stall_after_s
+        mon.observe(Op("invoke", 0, "write", 2, time=2))
+        assert gauge() == 0.0, "spurious stall after an idle gap"
+        mon.observe(Op("ok", 0, "write", 2, time=3))
+        assert mon.finish()["valid"] is True
+
+    def test_live_snapshot_shape(self):
+        h = chunked_register_history(random.Random(25), n_ops=80,
+                                     n_procs=2, chunk_ops=40)
+        reg = Registry()
+        mon = OnlineMonitor(model(), engine="host", metrics=reg,
+                            name="live-test")
+        for op in h:
+            mon.observe(op)
+        mon.scheduler.wait_idle(10.0)
+        snap = mon.live_snapshot()
+        assert snap["run"] == "live-test"
+        assert snap["ops_observed"] == len(h)
+        assert snap["decided_through_index"] >= 0
+        assert snap["verdict"] in ("True", "unknown")
+        assert "queue_depths" in snap and "scheduler_backlog" in snap
+        assert snap["watermark_stall_seconds"] == 0.0
+        assert "p99_s" in snap["decision_latency"]
+        import json
+
+        json.dumps(snap)  # must be JSON-serializable as-is
+        mon.finish()
+
+
+class TestFlightPhases:
+    def test_scheduler_rounds_enter_ledger_phases(self):
+        from jepsen_tpu.telemetry import FlightRecorder
+
+        rec = FlightRecorder()
+        sched = SegmentScheduler(model(), engine="host", flight=rec)
+        seg = Segmenter()
+        h = ops4(("invoke", 0, "write", 1), ("ok", 0, "write", 1))
+        for op in h:
+            batch = seg.offer(op)
+            if batch:
+                sched.submit(batch)
+        assert sched.wait_idle(10.0)
+        sched.close()
+        names = [p["phase"] for p in rec.snapshot()["phases"]]
+        assert "online.drain" in names
+        assert "online.dispatch" in names
+        assert "online.fold" in names
+        # All closed (no wedged ledger entries on a healthy run).
+        assert all("end_s" in p for p in rec.snapshot()["phases"])
+
+    def test_crashed_round_blames_dispatch_phase(self, monkeypatch):
+        # A decide crash must error the EXACT stage's ledger entry so
+        # offending_phase blames online.dispatch, not the whole drain
+        # (the crashed-run post-mortem the satellite asks for).
+        from jepsen_tpu.online import scheduler as sched_mod
+        from jepsen_tpu.telemetry import FlightRecorder
+
+        monkeypatch.setattr(
+            sched_mod, "segment_states",
+            lambda enc, **kw: (_ for _ in ()).throw(
+                RuntimeError("engine crashed")))
+        rec = FlightRecorder()
+        sched = SegmentScheduler(model(), engine="host", flight=rec)
+        seg = Segmenter()
+        h = ops4(("invoke", 0, "write", 1), ("ok", 0, "write", 1))
+        for op in h:
+            batch = seg.offer(op)
+            if batch:
+                sched.submit(batch)
+        assert sched.wait_idle(10.0)
+        sched.close()
+        assert sched.verdict == "unknown"  # round failure folds unknown
+        assert rec.offending_phase() == "online.dispatch"
+        bad = [p for p in rec.snapshot()["phases"] if "error" in p]
+        assert [p["phase"] for p in bad] == ["online.dispatch"]
 
 
 class TestEarlyDetection:
@@ -802,6 +1114,24 @@ class TestCoreRunWiring:
         assert not any(t.name == "jepsen-online-scheduler"
                        for t in threading.enumerate())
 
+    def test_off_path_allocates_no_span_objects(self, monkeypatch):
+        """With neither --telemetry nor --online: no trace Collector is
+        ever constructed (poisoned constructor — the decision-latency
+        tracing layer must cost literally nothing off-path) and the
+        thread-local trace-context stays the one shared empty dict."""
+        from jepsen_tpu import trace as jtrace
+
+        def _boom(*a, **kw):
+            raise AssertionError("span object allocated on off path")
+
+        monkeypatch.setattr(jtrace.Collector, "__init__", _boom)
+        monkeypatch.setattr(jtrace.Collector, "record", _boom)
+        test = self.cas_test(**{"no-store?": True})
+        res = core.run(test)
+        assert res["results"]["valid"] is True
+        assert "trace-collector" not in res
+        assert jtrace.event_tags() is jtrace.event_tags() == {}
+
     def test_online_without_model_degrades_gracefully(self):
         from jepsen_tpu.online import of_test
 
@@ -833,6 +1163,11 @@ class TestCoreRunWiring:
         assert t4["online?"] and t4["online-engine"] == "device"
         t5 = _apply_std_opts({}, {**base, "online_engine": "auto"})
         assert "online?" not in t5
+        # --live-port rides into the test map (core.run starts the
+        # in-process dashboard server off it).
+        t6 = _apply_std_opts({}, {**base, "live_port": 8080})
+        assert t6["live-port"] == 8080
+        assert "live-port" not in _apply_std_opts({}, base)
 
     def test_registry_metrics_after_violation(self):
         reg = Registry()

@@ -33,6 +33,23 @@ def _contract_opts(**extra):
             "threads-per-key": 2, "ops-per-key": 4, **extra}
 
 
+def test_std_generator_honors_nemesis_interval():
+    """The contract tests pass ``nemesis_interval: 0.1``; std_generator
+    must use it as the nemesis cycle sleep instead of the per-suite
+    ``dt`` default — otherwise every contract test below sleeps out a
+    5-10 s nemesis interval against a 1.5 s time limit (the interpreter
+    finishes an in-flight sleep before the limit can cut the phase),
+    which alone used to cost tier-1 ~4 minutes."""
+    from jepsen_tpu.suites import std_generator
+
+    g = std_generator({"time_limit": 1, "nemesis_interval": 0.25},
+                      [{"f": "read"}], dt=10)
+    assert "'value': 0.25" in repr(g) and "'value': 10" not in repr(g)
+    # Without the opt the dt argument still rules.
+    g2 = std_generator({"time_limit": 1}, [{"f": "read"}], dt=10)
+    assert "'value': 10" in repr(g2)
+
+
 @pytest.mark.parametrize("name", SUITES)
 def test_suite_test_fn_contract(name):
     mod = importlib.import_module(f"jepsen_tpu.suites.{name}")

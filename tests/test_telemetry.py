@@ -170,6 +170,94 @@ class TestExposition:
         assert telemetry.store_metrics(test) is None
 
 
+class TestDecisionLatencyFamily:
+    """The `decision_latency_seconds` histogram family: wide buckets,
+    full cumulative Prometheus `_bucket`/`_sum`/`_count` exposition
+    (golden), and the interpolated-quantile summary online.json and the
+    bench leg embed."""
+
+    def test_prometheus_golden_full_bucket_family(self):
+        reg = Registry()
+        h = reg.histogram(
+            "decision_latency_seconds",
+            "Per-op lag from observed invocation to decided-watermark "
+            "coverage", buckets=telemetry.DECISION_LATENCY_BUCKETS)
+        for v in (0.02, 0.3, 45.0, 400.0):
+            h.observe(v)
+        text = telemetry.prometheus_text(reg)
+        assert text == (
+            "# HELP decision_latency_seconds Per-op lag from observed "
+            "invocation to decided-watermark coverage\n"
+            "# TYPE decision_latency_seconds histogram\n"
+            'decision_latency_seconds_bucket{le="0.005"} 0\n'
+            'decision_latency_seconds_bucket{le="0.01"} 0\n'
+            'decision_latency_seconds_bucket{le="0.025"} 1\n'
+            'decision_latency_seconds_bucket{le="0.05"} 1\n'
+            'decision_latency_seconds_bucket{le="0.1"} 1\n'
+            'decision_latency_seconds_bucket{le="0.25"} 1\n'
+            'decision_latency_seconds_bucket{le="0.5"} 2\n'
+            'decision_latency_seconds_bucket{le="1.0"} 2\n'
+            'decision_latency_seconds_bucket{le="2.5"} 2\n'
+            'decision_latency_seconds_bucket{le="5.0"} 2\n'
+            'decision_latency_seconds_bucket{le="10.0"} 2\n'
+            'decision_latency_seconds_bucket{le="30.0"} 2\n'
+            'decision_latency_seconds_bucket{le="60.0"} 3\n'
+            'decision_latency_seconds_bucket{le="120.0"} 3\n'
+            'decision_latency_seconds_bucket{le="300.0"} 3\n'
+            'decision_latency_seconds_bucket{le="+Inf"} 4\n'
+            "decision_latency_seconds_sum 445.32\n"
+            "decision_latency_seconds_count 4\n"
+        )
+
+    def test_wide_buckets_resolve_past_the_default_top(self):
+        # The default 10 s-top buckets would park a 45 s lag in +Inf and
+        # saturate p99 at 10 s; the decision-latency family must keep
+        # resolving there (the whole reason it has its own buckets).
+        assert telemetry.DECISION_LATENCY_BUCKETS[-1] == 300.0
+        h = Registry().histogram(
+            "d", buckets=telemetry.DECISION_LATENCY_BUCKETS)
+        for _ in range(100):
+            h.observe(45.0)
+        assert 30.0 < h.quantile(0.99) <= 60.0
+
+    def test_bucket_quantile_semantics(self):
+        bq = telemetry.bucket_quantile
+        # Linear interpolation inside the covering bucket (lower edge =
+        # previous bound; 0 for the first bucket).
+        assert bq((1.0, 2.0), [10, 0, 0], 0.5) == pytest.approx(0.5)
+        assert bq((1.0, 2.0), [0, 10, 0], 0.5) == pytest.approx(1.5)
+        assert bq((1.0, 2.0), [5, 5, 0], 0.9) == pytest.approx(1.8)
+        # Ranks landing in +Inf clamp to the highest finite bound.
+        assert bq((1.0, 2.0), [0, 0, 5], 0.99) == 2.0
+        # Empty histogram has no quantiles.
+        assert bq((1.0,), [0, 0], 0.5) is None
+
+    def test_stats_summary_block(self):
+        h = Registry().histogram(
+            "d", buckets=telemetry.DECISION_LATENCY_BUCKETS)
+        for _ in range(100):
+            h.observe(0.03)
+        st = h.stats()
+        assert st["count"] == 100
+        assert st["sum_s"] == pytest.approx(3.0)
+        # All mass in the (0.025, 0.05] bucket: every quantile
+        # interpolates inside it, monotone in q.
+        assert 0.025 < st["p50_s"] <= st["p90_s"] <= st["p99_s"] <= 0.05
+        # Empty histogram: summary stays well-formed with null quantiles.
+        empty = Registry().histogram("e").stats()
+        assert empty == {"count": 0, "sum_s": 0.0, "p50_s": None,
+                         "p90_s": None, "p99_s": None}
+
+    def test_last_event(self):
+        reg = Registry()
+        assert reg.last_event("wgl_sharded_chunk") is None
+        for i in range(5):
+            reg.event("wgl_sharded_chunk", count=i)
+            reg.event("other", i=i)
+        ev = reg.last_event("wgl_sharded_chunk")
+        assert ev["count"] == 4  # newest, not first
+
+
 class TestGating:
     def test_of_test(self):
         assert telemetry.of_test(None) is None

@@ -1,9 +1,13 @@
 """Tracing collector tests: span-id uniqueness under thread contention
 (the old ``len(self.spans)`` read outside the lock could mint colliding
-ids) and deterministic repeated exports (atomic full-snapshot writes)."""
+ids), deterministic repeated exports (atomic full-snapshot writes), the
+explicit-linkage ``record()`` seam the online monitor's cross-thread
+decision chain uses, and the thread-local ``span_tags``/``event_tags``
+trace-context that kernel chunk events merge in."""
 
 import json
 import threading
+import time
 
 from jepsen_tpu import trace
 
@@ -78,3 +82,95 @@ class TestExport:
         rec = json.loads(p.read_text())
         assert rec["error"] == "ValueError: nope"
         assert rec["duration_us"] >= 0
+
+
+class TestRecordLinkage:
+    """`Collector.record` — the cross-thread seam: an already-timed span
+    with explicit trace/parent/stage linkage, minted ids handed to
+    children BEFORE the parent is recorded (the online scheduler's
+    segment→member→oracle chain)."""
+
+    def test_explicit_linkage_round_trips(self, tmp_path):
+        col = trace.Collector()
+        t0 = time.monotonic_ns()
+        sid = col.mint_id()  # parent id exists before the parent span
+        child = col.record("online.member", start_ns=t0, end_ns=t0 + 1000,
+                           parent_id=sid, stage="member", member=0)
+        assert child["parent_id"] == sid and child["span_id"] != sid
+        parent = col.record("online.segment", start_ns=t0,
+                            end_ns=t0 + 5000, span_id=sid, stage="segment",
+                            start_index=0, end_index=3)
+        assert parent["span_id"] == sid
+        op = col.record("op.decision", start_ns=t0, end_ns=t0 + 2500,
+                        trace_id="op-3", stage="op", index=3)
+        assert op["trace_id"] == "op-3"
+        assert op["duration_us"] == 2
+        # Export preserves the linkage fields verbatim.
+        p = tmp_path / "spans.jsonl"
+        assert col.export_jsonl(p) == 3
+        lines = [json.loads(l) for l in p.read_text().splitlines()]
+        by_stage = {l["stage"]: l for l in lines}
+        assert by_stage["member"]["parent_id"] == \
+            by_stage["segment"]["span_id"]
+        assert by_stage["op"]["trace_id"] == "op-3"
+        assert by_stage["op"]["attrs"]["index"] == 3
+
+    def test_mint_ids_unique_across_threads(self):
+        col = trace.Collector()
+        ids, lock = [], threading.Lock()
+
+        def work():
+            mine = [col.mint_id() for _ in range(500)]
+            with lock:
+                ids.extend(mine)
+
+        ts = [threading.Thread(target=work) for _ in range(8)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert len(set(ids)) == len(ids) == 8 * 500
+
+
+class TestSpanTags:
+    """Thread-local trace-context tags (`span_tags`/`event_tags`): the
+    kernel drivers merge `event_tags()` into their chunk telemetry
+    events, so the dispatching oracle span's id rides along with zero
+    new kernel arguments — and the off path allocates nothing."""
+
+    def test_nesting_shadowing_and_restore(self):
+        assert trace.event_tags() == {}
+        with trace.span_tags(trace_span="s1"):
+            assert trace.event_tags() == {"trace_span": "s1"}
+            with trace.span_tags(trace_span="s2", rung=1):
+                assert trace.event_tags() == {"trace_span": "s2",
+                                              "rung": 1}
+            assert trace.event_tags() == {"trace_span": "s1"}
+        assert trace.event_tags() == {}
+
+    def test_untagged_path_shares_one_empty_dict(self):
+        # The off path must not allocate per call: with no tags pushed,
+        # event_tags() returns the SAME empty-dict instance every time.
+        assert trace.event_tags() is trace.event_tags()
+        assert trace.event_tags() == {}
+
+    def test_tags_are_thread_local(self):
+        seen = {}
+
+        def work():
+            seen["other"] = dict(trace.event_tags())
+
+        with trace.span_tags(trace_span="mine"):
+            t = threading.Thread(target=work)
+            t.start()
+            t.join()
+            assert trace.event_tags() == {"trace_span": "mine"}
+        assert seen["other"] == {}
+
+    def test_tags_restore_after_exception(self):
+        try:
+            with trace.span_tags(trace_span="s1"):
+                raise ValueError("boom")
+        except ValueError:
+            pass
+        assert trace.event_tags() == {}

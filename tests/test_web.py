@@ -1,0 +1,210 @@
+"""Web endpoint smoke tests over a REAL in-process HTTP server: `/`,
+`/metrics`, `/profile`, `/online`, `/live` and `/live.html` must answer
+well-formed payloads both on an empty store (no telemetry anywhere) and
+after a telemetry+online run wrote its artifacts — plus the live-source
+registry that `/live` streams (register/replace/unregister, a raising
+source degrades to an error line, a monitor-backed source serves its
+operational snapshot as one ndjson line)."""
+
+import json
+import threading
+import urllib.request
+
+import pytest
+
+from jepsen_tpu import checker as jchecker
+from jepsen_tpu import core, web
+from jepsen_tpu import generator as gen
+from jepsen_tpu.models import CasRegister
+from jepsen_tpu.online import OnlineMonitor
+from jepsen_tpu.testing import chunked_register_history
+from jepsen_tpu.workloads import AtomClient, AtomDB, AtomState, noop_test
+
+
+def cas_test(tmp_path, **extra):
+    state = AtomState()
+    test = dict(noop_test())
+    test.update(
+        name="web-smoke",
+        db=AtomDB(state),
+        client=AtomClient(state),
+        model=CasRegister(init=0),
+        concurrency=2,
+        checker=jchecker.linearizable(model=CasRegister(init=0)),
+        generator=gen.clients(gen.limit(60, gen.mix([
+            lambda: {"f": "read"},
+            lambda: {"f": "write", "value": gen.rand_int(5)},
+        ]))),
+    )
+    test["store-root"] = str(tmp_path)
+    test.update(extra)
+    return test
+
+
+@pytest.fixture()
+def get(tmp_path):
+    """Serve tmp_path on an ephemeral port; yields a GET helper
+    returning (status, content_type, body)."""
+    srv = web.server(root=tmp_path, port=0)
+    # Small poll interval: shutdown() waits one poll, and the default
+    # 0.5 s would cost every test here half a second of teardown.
+    threading.Thread(target=lambda: srv.serve_forever(poll_interval=0.05),
+                     daemon=True).start()
+    port = srv.server_address[1]
+
+    def _get(path):
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=10) as r:
+            return r.status, r.headers.get("Content-Type"), \
+                r.read().decode()
+
+    yield _get
+    srv.shutdown()
+    srv.server_close()
+
+
+PAGES = ("/", "/metrics", "/profile", "/online", "/live.html")
+
+
+class TestEndpointsWithoutTelemetry:
+    def test_all_pages_answer_on_an_empty_store(self, get):
+        for path in PAGES:
+            status, ctype, body = get(path)
+            assert status == 200, path
+            assert ctype.startswith("text/html"), path
+            assert "<html" in body and "</html>" in body, path
+        # The placeholder copy names the flag that would populate each.
+        assert "--telemetry" in get("/metrics")[2]
+        assert "--profile" in get("/profile")[2]
+        assert "--online" in get("/online")[2]
+
+    def test_live_is_wellformed_ndjson_with_no_live_run(self, get):
+        status, ctype, body = get("/live")
+        assert status == 200
+        assert ctype.startswith("application/x-ndjson")
+        lines = [json.loads(l) for l in body.splitlines()]
+        assert lines == [{"live_runs": 0}]
+
+    def test_unknown_path_is_404(self, get):
+        with pytest.raises(urllib.error.HTTPError) as e:
+            get("/no-such-page")
+        assert e.value.code == 404
+
+
+class TestEndpointsWithTelemetry:
+    def test_pages_render_a_monitored_runs_artifacts(self, tmp_path, get):
+        # ONE core.run covers both e2e seams (tier-1 budget: each run
+        # costs ~2 s): the artifact-rendering assertions below AND the
+        # --live-port in-process server lifecycle (port 0 = ephemeral;
+        # the run completes, the live source is unregistered afterwards
+        # — no leaked /live line — and the server thread is shut down).
+        res = core.run(cas_test(tmp_path, **{
+            "online?": True, "online-engine": "host",
+            "telemetry?": True, "live-port": 0}))
+        assert res["results"]["valid"] is True
+        assert res["online-results"]["valid"] is True
+        assert json.loads(web.live_ndjson()) == {"live_runs": 0}
+        assert not any(t.name == "jepsen-live-web"
+                       for t in threading.enumerate())
+        # Index links every artifact the run wrote.
+        body = get("/")[2]
+        assert "web-smoke" in body
+        for fn in ("metrics.jsonl", "online.json", "spans.jsonl"):
+            assert fn in body, fn
+        # /metrics renders the series; histograms carry interpolated
+        # quantiles next to the mean, not just counts.
+        body = get("/metrics")[2]
+        assert "online_decided_watermark" in body
+        assert "decision_latency_seconds" in body
+        assert "p50=" in body and "p99=" in body
+        # /online renders the verdict + segment table.
+        body = get("/online")[2]
+        assert "web-smoke" in body and "online verdict" in body
+        # /profile stays well-formed when the run had no --profile.
+        status, _ct, body = get("/profile")
+        assert status == 200 and "</html>" in body
+
+
+class TestMetricsQuantileRendering:
+    def test_quantiles_survive_sort_keys_bucket_order(self, tmp_path):
+        """metrics.jsonl is written with sort_keys=True, which orders
+        histogram bucket keys LEXICALLY ('+Inf' first, '10.0' before
+        '2.5'); the /metrics renderer must re-sort numerically or the
+        interpolated p50/p99 come from misaligned bounds/counts."""
+        from jepsen_tpu.telemetry import (
+            DECISION_LATENCY_BUCKETS, Registry, export_jsonl)
+
+        reg = Registry()
+        h = reg.histogram("decision_latency_seconds", "Lag",
+                          buckets=DECISION_LATENCY_BUCKETS)
+        for _ in range(50):
+            h.observe(0.02)   # (0.01, 0.025] bucket
+        for _ in range(50):
+            h.observe(45.0)   # (30, 60] bucket
+        run = tmp_path / "t" / "20260803T000000.000Z"
+        run.mkdir(parents=True)
+        export_jsonl(reg, run / "metrics.jsonl")
+        (rows,) = [web._metrics_summary(run)]
+        (val,) = [v for m, _l, v in rows
+                  if m == "decision_latency_seconds"]
+        # True interpolated quantiles: p50 = 0.025 (upper edge of the
+        # bucket holding rank 50), p99 = 30 + 30*(49/50) = 59.4.
+        assert "p50=0.025s" in val, val
+        assert "p99=59.4s" in val, val
+    def test_register_replace_unregister(self, get):
+        web.register_live_source("a", lambda: {"x": 1})
+        try:
+            (line,) = [json.loads(l)
+                       for l in get("/live")[2].splitlines()]
+            assert line == {"x": 1, "run": "a"}
+            # Re-registering a key replaces its source; a source's own
+            # "run" field wins over the key.
+            web.register_live_source("a", lambda: {"run": "mine", "x": 2})
+            (line,) = [json.loads(l)
+                       for l in get("/live")[2].splitlines()]
+            assert line == {"run": "mine", "x": 2}
+        finally:
+            web.unregister_live_source("a")
+        assert json.loads(get("/live")[2]) == {"live_runs": 0}
+        web.unregister_live_source("a")  # idempotent
+
+    def test_raising_source_degrades_to_error_line(self, get):
+        def boom():
+            raise RuntimeError("wedged")
+
+        web.register_live_source("bad", boom)
+        web.register_live_source("ok", lambda: {"x": 1})
+        try:
+            lines = {json.loads(l)["run"]: json.loads(l)
+                     for l in get("/live")[2].splitlines()}
+            assert lines["ok"]["x"] == 1
+            assert lines["bad"]["error"] == "RuntimeError: wedged"
+        finally:
+            web.unregister_live_source("bad")
+            web.unregister_live_source("ok")
+
+    def test_monitor_snapshot_serves_as_live_line(self, get):
+        import random
+
+        from jepsen_tpu.telemetry import Registry
+
+        h = chunked_register_history(random.Random(31), n_ops=80,
+                                     n_procs=2, chunk_ops=40)
+        mon = OnlineMonitor(CasRegister(init=0), engine="host",
+                            metrics=Registry(), name="live-run")
+        web.register_live_source("live-run", mon.live_snapshot)
+        try:
+            for op in h:
+                mon.observe(op)
+            assert mon.scheduler.wait_idle(10.0)
+            (line,) = [json.loads(l)
+                       for l in get("/live")[2].splitlines()]
+            assert line["run"] == "live-run"
+            assert line["ops_observed"] == len(h)
+            assert line["decided_through_index"] >= 0
+            assert "queue_depths" in line
+            assert "p99_s" in line["decision_latency"]
+            assert line["watermark_stall_seconds"] == 0.0
+        finally:
+            web.unregister_live_source("live-run")
+            mon.finish()
