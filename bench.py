@@ -135,6 +135,7 @@ def _compact(out: dict) -> dict:
             _set(path, v)
     for k in ("metric", "value", "unit", "vs_baseline", "ops_per_s",
               "backend", "fresh_valid", "invalid_valid", "device_valid",
+              "device_utilization_pct",
               "levels", "bench_wall_s", "budget_exceeded", "budget_s",
               "flight_offending_phase", "error", "device_error",
               "device_note", "interpreter_error"):
@@ -578,11 +579,21 @@ def main() -> int:
                     # north-star history's beam accept. decided >= 1 is
                     # asserted below (and gated round-over-round by
                     # benchcmp's smoke_8x10k_decided metric).
+                    # Registry injected: the stamped batch-chunk events
+                    # reconstruct mean device utilization across the
+                    # escalation schedule (telemetry.utilization) — the
+                    # ROADMAP "first metric to watch" leg, now watched
+                    # for EFFICIENCY (benchcmp: smoke_8x10k_
+                    # utilization_pct, higher) and not just decided>=1.
+                    from jepsen_tpu.telemetry import Registry as _Reg
+
+                    smoke_reg = _Reg()
                     t0 = time.perf_counter()
                     try:
                         rsS = check_batch(
                             model, smokeh, f=256, escalate=True,
                             f_schedule=(256, 2048, 8192),
+                            metrics=smoke_reg,
                             chunk_callback=_deadline_cb(
                                 min(240, _left() - 60), key="F"))
                         smoke = {
@@ -608,6 +619,20 @@ def main() -> int:
                             "deadline_at_F": str(dl),
                             "decided": 0,
                         }
+                    try:
+                        from jepsen_tpu.telemetry.profile import \
+                            _attribute_utilization as _util_of
+
+                        _u = _util_of(smoke_reg)
+                        if _u is not None:
+                            smoke["utilization_pct"] = \
+                                _u["summary"]["mean_utilization_pct"]
+                            if _u["summary"].get(
+                                    "gap_attribution_share"):
+                                smoke["gap_share"] = _u["summary"][
+                                    "gap_attribution_share"]
+                    except Exception:  # noqa: BLE001 - diagnostics only
+                        pass
                     smoke["no_escalation_compare"] = no_esc
                     # The r5 regression guard: a smoke that decides
                     # NOTHING is a failed leg, recorded as such (the
@@ -868,6 +893,17 @@ def main() -> int:
                         copy_bw_gbs=out.get("hbm_copy_gbs"))
                     if attr.get("device"):
                         out["device_attribution"] = attr["device"]
+                    if attr.get("utilization"):
+                        # Occupancy view (distinct from the roofline
+                        # device_util): busy share of the measured
+                        # pass's makespan + idle-gap attribution
+                        # (telemetry.utilization).
+                        _us = attr["utilization"]["summary"]
+                        out["device_utilization_pct"] = \
+                            _us["mean_utilization_pct"]
+                        if _us.get("gap_attribution_share"):
+                            out["device_gap_share"] = \
+                                _us["gap_attribution_share"]
                 except Exception as e:  # noqa: BLE001 - diagnostics only
                     out["device_attribution"] = {
                         "error": f"{type(e).__name__}: {e}"}
@@ -1196,6 +1232,17 @@ def main() -> int:
         out["flight_record"] = _REC.flush(FLIGHT_PATH,
                                           reason="budget_breach")
         out["flight_offending_phase"] = _REC.offending_phase()
+    # Cross-run perf ledger: one compact record per leg that produced a
+    # number, appended to store/ledger.jsonl (JEPSEN_LEDGER_PATH
+    # overrides) — `python -m jepsen_tpu.ledger --check` gates the
+    # trend between committed bench rounds.
+    try:
+        from jepsen_tpu.telemetry import ledger as _ledger
+
+        for rec in _ledger.records_of_bench(out):
+            _ledger.append(rec)
+    except Exception:  # noqa: BLE001 - the ledger never sinks the bench
+        pass
     # Full result to disk, compact line to stdout (see RESULT_PATH
     # notes above — the r5 tail-truncation fix).
     _write_full(out)

@@ -63,6 +63,13 @@ METRICS: list[tuple[str, str, str]] = [
      "max_verified_ops_device_sharded.ops", "higher"),
     ("smoke_8x10k_decided",
      "batch_replay_large.smoke_8x10k.decided", "higher"),
+    # Device-saturation observability (ISSUE 7): mean device
+    # utilization of the smoke leg's escalation schedule, reconstructed
+    # from stamped batch-chunk events (telemetry.utilization) — the
+    # ROADMAP "first metric to watch" leg, now watched for EFFICIENCY
+    # and not just decided>=1. Shrinking = the ladder idles the mesh.
+    ("smoke_8x10k_utilization_pct",
+     "batch_replay_large.smoke_8x10k.utilization_pct", "higher"),
     ("bench_wall_s", "bench_wall_s", "info"),
     ("multichip_ok", "multichip_ok", "higher"),
     # Owner-partitioned frontier exchange (ISSUE 4): the analytic
@@ -218,13 +225,17 @@ def _merge_rounds(rounds: list[dict]) -> list[dict]:
 
 
 def deltas(prev: dict, cur: dict,
-           threshold: float = DEFAULT_THRESHOLD) -> dict:
+           threshold: float = DEFAULT_THRESHOLD,
+           metrics: Optional[list] = None) -> dict:
     """Metric-wise delta block between two rounds' extracted metrics:
     ``{metric: {prev, cur, delta_pct, regression}}``. ``delta_pct`` is
     signed (cur vs prev); regression is direction-aware and gated at
-    ``threshold`` (fraction)."""
+    ``threshold`` (fraction). ``metrics`` defaults to the bench
+    catalogue; the cross-run ledger (``jepsen_tpu.telemetry.ledger``)
+    reuses this machinery with its own catalogue."""
     out: dict = {}
-    for name, _path, direction in METRICS:
+    for name, _path, direction in (metrics if metrics is not None
+                                   else METRICS):
         p, c = prev.get(name), cur.get(name)
         if p is None or c is None:
             continue
@@ -284,12 +295,15 @@ def _fmt(v: Optional[float]) -> str:
     return f"{v:.4g}"
 
 
-def render_table(merged: list[dict]) -> str:
+def render_table(merged: list[dict],
+                 metrics: Optional[list] = None) -> str:
     """Metric-by-round text table (metrics as rows, rounds as
-    columns)."""
+    columns). ``metrics`` defaults to the bench catalogue (the ledger
+    passes its own)."""
     labels = [m["label"] for m in merged]
     rows = []
-    for name, _path, direction in METRICS:
+    for name, _path, direction in (metrics if metrics is not None
+                                   else METRICS):
         vals = [m["metrics"].get(name) for m in merged]
         if all(v is None for v in vals):
             continue

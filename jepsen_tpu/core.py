@@ -410,4 +410,16 @@ def run(test: dict) -> dict:
             except Exception:
                 LOG.warning("telemetry export failed", exc_info=True)
         if persist:
+            # Cross-run perf ledger: one compact record per run (even a
+            # crashed one — verdict None is itself a data point) into
+            # <store root>/ledger.jsonl; `python -m jepsen_tpu.ledger`
+            # renders the trend and gates regressions between runs.
+            try:
+                from .telemetry import ledger as jledger
+
+                jledger.append(
+                    jledger.record_of_run(test),
+                    path=jledger.default_path(test.get("store-root")))
+            except Exception:  # noqa: BLE001 - the ledger never sinks
+                LOG.warning("ledger append failed", exc_info=True)
             store.stop_logging(test)

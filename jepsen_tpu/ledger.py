@@ -1,0 +1,14 @@
+"""CLI shim: ``python -m jepsen_tpu.ledger`` — the cross-run perf
+ledger's trend table and regression gate. The implementation lives in
+``jepsen_tpu.telemetry.ledger`` (next to the utilization and profile
+layers it summarizes); this module only provides the short ``-m``
+entry point docs and CI invoke."""
+
+from __future__ import annotations
+
+import sys
+
+from .telemetry.ledger import main  # noqa: F401 - re-exported entry
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -318,6 +318,34 @@ class OnlineMonitor:
                     "occupancy": (round(bv["active"] / bv["batch"], 4)
                                   if bv.get("batch") else None),
                 }
+            # Device-saturation estimate off the newest stamped chunk
+            # event (O(1) — last_event only, never a full event scan on
+            # the poll path): busy fraction of the window since that
+            # chunk began. The full per-device timeline + gap
+            # attribution is the /utilization page's job, post-run.
+            newest = None
+            for name in ("wgl_sharded_chunk", "wgl_batch_chunk",
+                         "wgl_chunk"):
+                e = reg.last_event(name)
+                if e is not None and e.get("t1") is not None and (
+                        newest is None
+                        or e["t1"] > newest[1].get("t1", 0)):
+                    newest = (name, e)
+            if newest is not None:
+                name, e = newest
+                now = _time.time()
+                span = max(now - float(e.get("t0") or e["t1"]), 1e-9)
+                wall = float(e.get("chunk_wall_s") or e.get("wall_s")
+                             or 0.0)
+                snap["device_busy"] = {
+                    "source": name,
+                    "n_devices": int(e.get("n_shards")
+                                     or e.get("n_devices") or 1),
+                    "last_chunk_age_s": round(
+                        max(now - float(e["t1"]), 0.0), 3),
+                    "busy_frac_recent": round(
+                        min(wall / span, 1.0), 4),
+                }
         if self._detection is not None:
             snap.update(self._detection)
         return snap

@@ -181,11 +181,18 @@ class SegmentScheduler:
                     # computed after release could overwrite the
                     # worker's newer decrement with a stale count and
                     # leave a drained run reporting backlog > 0.
+                    n_bl = sum(self._key_depth.values())
                     self.metrics.gauge(
                         "online_scheduler_backlog",
                         "Segments submitted to the online scheduler "
-                        "and not yet decided").set(
-                            sum(self._key_depth.values()))
+                        "and not yet decided").set(n_bl)
+                    # Stamped transition: the gauge only holds "now",
+                    # but idle-gap attribution (starved vs no-work)
+                    # needs the backlog's value OVER TIME — the
+                    # online_backlog event stream is that timeline.
+                    self.metrics.event(
+                        "online_backlog", t=round(_time.time(), 6),
+                        backlog=n_bl)
             self._inflight += 1
             self._idle.clear()
             self._inbox.put(list(segments))
@@ -707,10 +714,15 @@ class SegmentScheduler:
                 "online_decided_watermark",
                 "Highest history index through which the online verdict "
                 "is decided").set(self._watermark)
+            n_bl = sum(self._key_depth.values())
             self.metrics.gauge(
                 "online_scheduler_backlog",
                 "Segments submitted to the online scheduler and not yet "
-                "decided").set(sum(self._key_depth.values()))
+                "decided").set(n_bl)
+            # Decrement-side timeline point (see submit()): gap
+            # attribution reads backlog-over-time, not just the gauge.
+            self.metrics.event(
+                "online_backlog", t=round(_time.time(), 6), backlog=n_bl)
 
     def _fold_locked(self) -> Any:
         # merge_valid over EVERY decided segment, via counters — the

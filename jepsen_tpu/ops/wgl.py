@@ -1011,8 +1011,13 @@ def _note_chunk_metrics(metrics, lvl_stats, lvl0: int, lvl: int, F: int,
     # active on this thread (the online scheduler's oracle call), the
     # chunk event carries its id — op→segment→oracle→chunk linkage with
     # zero new kernel-driver arguments. {} (shared instance) otherwise.
+    # t0/t1: wall-clock stamps of the chunk (t1 = now, t0 derived from
+    # the measured wall) — the busy-interval seam telemetry.utilization
+    # reconstructs per-device occupancy timelines from.
+    t1 = round(_time.time(), 6)
     metrics.event("wgl_chunk", level0=int(lvl0), level=int(lvl),
                   F=int(F), wall_s=round(chunk_wall, 6), stage=stage,
+                  t0=round(t1 - chunk_wall, 6), t1=t1,
                   **_trace.event_tags())
     if lvl_stats is None:
         return

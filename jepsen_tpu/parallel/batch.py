@@ -46,6 +46,20 @@ from ..ops import wgl
 from ..ops.encode import EncodedHistory, encode_history
 
 
+def _note_host_stack(metrics, F, members: int, wall: float,
+                     overlap: bool) -> None:
+    """One ``wgl_host_stack`` event: the next bucket's static tables
+    being assembled on the host. ``overlap=True`` marks the
+    double-buffered build that runs WHILE the device executes (it then
+    falls inside a busy interval and attributes no gap); ``False``
+    marks a blocking build (rung entry / re-batch) — the
+    "host-stacking" idle class telemetry.utilization reconstructs."""
+    t1 = round(_time.time(), 6)
+    metrics.event("wgl_host_stack", F=int(F), members=int(members),
+                  wall_s=round(wall, 6), overlap=bool(overlap),
+                  t0=round(t1 - wall, 6), t1=t1)
+
+
 def _put(arrs, mesh=None, batch_axis: str = "dp"):
     """device_put a list of [Bk, ...] arrays, dp-sharded when meshed.
     Uploading once per rung (not per chunk) keeps the chunk loop's only
@@ -225,18 +239,27 @@ def check_encoded_batch(
         for r in set(live):
             status[r] = "run"  # rows entering a rung are undecided again
         if ri == 0:
+            t_hs = _time.perf_counter()
             stacked = _stack([padded[r] for r in live], F,
                              (W, KO, S, ND, NO), mesh, batch_axis)
             statics, fr5 = stacked[:9], list(stacked[9:14])
+            if metrics is not None:
+                _note_host_stack(metrics, F, len(live),
+                                 _time.perf_counter() - t_hs,
+                                 overlap=False)
         else:
             # Re-batch: row-select the pre-stacked bucket (planned while
             # the previous rung's device chunk ran), regroup the
             # checkpointed frontiers on device at the new capacity.
+            t_hs = _time.perf_counter()
             rowsel = np.array([prev_live.index(r) for r in live])
             statics = _put([c[rowsel] for c in pending], mesh, batch_axis)
             new_fr = _regroup_program(F)(rowsel, *fr5)
             fr5 = _put(list(new_fr), mesh, batch_axis)
             if metrics is not None:
+                _note_host_stack(metrics, F, len(live),
+                                 _time.perf_counter() - t_hs,
+                                 overlap=False)
                 metrics.counter(
                     "wgl_rebatch_total",
                     "Overflowed members regrouped into a higher-capacity "
@@ -246,8 +269,17 @@ def check_encoded_batch(
                     members=sum(1 for r in live if orig[r] is not None),
                     level_min=int(lvls[live].min()),
                     level_max=int(lvls[live].max()))
+        fresh_rung = False
+        if metrics is not None:
+            misses0 = wgl._build_batch_kernel.cache_info().misses
         kern = wgl._build_batch_kernel(mk, F, W, KO, S, ND, NO, B=B,
                                        donate=True)
+        if metrics is not None:
+            # A build-cache miss means the first chunk at this rung
+            # pays the jit compile — stamped "compile" below so the
+            # utilization layer attributes the idle time honestly.
+            fresh_rung = (wgl._build_batch_kernel.cache_info().misses
+                          > misses0)
         # Chunk budget: the vmapped kernel runs ceil(Bk/dp) members per
         # device SEQUENTIALLY, so the single-program wall-time model
         # must scale the per-member expansion by that factor or an
@@ -264,6 +296,7 @@ def check_encoded_batch(
         stuck_s = np.zeros(Bk, bool)
         calls = 0
         t_rung = _time.perf_counter()
+        t_last = t_rung  # previous chunk boundary (per-chunk stamps)
         pending = None
         prev_live = live
         next_F = rungs[ri + 1] if ri + 1 < len(rungs) else None
@@ -285,7 +318,12 @@ def check_encoded_batch(
             # still overflow) so the re-batch is a row-select by the
             # time the flags arrive.
             if pending is None and next_F is not None:
+                t_hs = _time.perf_counter()
                 pending = _host_stack(live)
+                if metrics is not None:
+                    _note_host_stack(metrics, next_F, len(live),
+                                     _time.perf_counter() - t_hs,
+                                     overlap=True)
             flags = np.asarray(out[0])  # [Bk, 6] — the one blocking read
             fr5 = list(out[-5:])
             if metrics is not None:
@@ -324,11 +362,24 @@ def check_encoded_batch(
                         float(active.sum()) / Bk)
                 # event_tags: trace-context linkage (trace_span of the
                 # dispatching oracle span, if any) — see trace.span_tags.
+                # wall_s stays cumulative-from-rung-start (back compat);
+                # chunk_wall_s + t0/t1 stamp THIS chunk's interval and
+                # n_devices its dp-mesh coverage, for the utilization
+                # layer's per-device busy reconstruction.
+                now_pc = _time.perf_counter()
+                chunk_wall = now_pc - t_last
+                t_last = now_pc
+                t1e = round(_time.time(), 6)
                 metrics.event(
                     "wgl_batch_chunk", F=F, chunk=calls,
                     active=int(active.sum()), batch=Bk,
                     level_max=int(lsub.max()),
-                    wall_s=round(_time.perf_counter() - t_rung, 4),
+                    wall_s=round(now_pc - t_rung, 4),
+                    chunk_wall_s=round(chunk_wall, 6),
+                    n_devices=dp,
+                    stage=("compile" if fresh_rung and calls == 1
+                           else "execute"),
+                    t0=round(t1e - chunk_wall, 6), t1=t1e,
                     **_trace.event_tags())
             if chunk_callback is not None:
                 chunk_callback({

@@ -281,12 +281,20 @@ def check_encoded_sharded(
                                 round(cmax * D / max(int(_cnt), 1), 4))
                 ev_extra = {"allgather_bytes": ex_bytes} \
                     if exchange == "allgather" else {}
+                # stage + wall-clock stamps: the first chunk of a
+                # freshly built sharded kernel carries the jit cost
+                # (the mesh idles while XLA compiles), so utilization
+                # reconstruction classes it "compiling", not busy.
+                stage = ("compile" if fresh and attempt["calls"] == 1
+                         else "execute")
+                t1s = round(_time.time(), 6)
                 metrics.event(
                     "wgl_sharded_chunk", level=int(lvl), F=F,
                     n_shards=D, global_capacity=FT, count=int(_cnt),
                     count_max=cmax, count_min=cmin,
                     frontier_max=fmax_all[0],
-                    wall_s=round(chunk_wall, 4),
+                    wall_s=round(chunk_wall, 4), stage=stage,
+                    t0=round(t1s - chunk_wall, 6), t1=t1s,
                     # Per-chunk interconnect traffic (analytic), so
                     # telemetry.profile can attribute the exchange's
                     # share without re-deriving the byte model; the

@@ -131,7 +131,24 @@ def attribute(registry: Registry, plan=None,
     sharded = _attribute_sharded(registry, plan, byte_floor)
     if sharded is not None:
         out["sharded"] = sharded
+    util = _attribute_utilization(registry)
+    if util is not None:
+        out["utilization"] = util
     return out
+
+
+def _attribute_utilization(registry) -> Optional[dict]:
+    """Per-device busy timelines + idle-gap attribution (see
+    ``telemetry.utilization``). The import is gated on chunk events
+    actually existing: with telemetry disabled (or nothing recorded)
+    the utilization module is never imported — the off-path pin
+    tests/test_telemetry.py holds."""
+    if not any(registry.events(n) for n in
+               ("wgl_chunk", "wgl_batch_chunk", "wgl_sharded_chunk")):
+        return None
+    from . import utilization
+
+    return utilization.reconstruct(registry)
 
 
 def _attribute_device(registry, plan, byte_floor, copy_bw_gbs,

@@ -116,6 +116,12 @@ td, th { border: 1px solid #ccc; padding: 0.3em 0.8em; text-align: left; }
 _TELEMETRY_FILES = ("metrics.jsonl", "metrics.prom", "spans.jsonl",
                     "profile.json", "flightrecord.json", "online.json")
 
+# Jepsen-parity plot/timeline artifacts (checker/perf.py writes the
+# pngs, checker/timeline.py the html) — they existed in the store but
+# nothing linked them; the index row surfaces them when present.
+_PARITY_FILES = ("latency-raw.png", "latency-quantiles.png", "rate.png",
+                 "timeline.html")
+
 
 def _index_page(root: Path) -> str:
     rows = []
@@ -130,7 +136,8 @@ def _index_page(root: Path) -> str:
                   "unknown": "unknown"}.get(v, "—")
             tele = " ".join(
                 f'<a href="/files/{name}/{start}/{fn}">{fn}</a>'
-                for fn in _TELEMETRY_FILES if (run / fn).exists()
+                for fn in _TELEMETRY_FILES + _PARITY_FILES
+                if (run / fn).exists()
             ) or "—"
             rows.append(
                 f'<tr class="{cls}"><td><a href="/files/{name}/{start}/">'
@@ -144,6 +151,8 @@ def _index_page(root: Path) -> str:
         "<body><h1>Jepsen tests</h1>"
         '<p><a href="/metrics">metrics</a> · '
         '<a href="/profile">profile</a> · '
+        '<a href="/utilization">utilization</a> · '
+        '<a href="/runs">runs</a> · '
         '<a href="/online">online</a> · '
         '<a href="/live.html">live</a></p><table>'
         "<tr><th>Test</th><th>Started</th><th>Valid?</th>"
@@ -362,6 +371,122 @@ def _profile_page(root: Path) -> str:
     )
 
 
+def _utilization_section(util: dict) -> str:
+    """One run's utilization block (profile.json): the SVG occupancy
+    Gantt (telemetry.utilization.render_gantt — gap-class colored) plus
+    the per-device summary table."""
+    from .telemetry import utilization as _util
+
+    s = util.get("summary") or {}
+    head = (
+        f"<p>devices: {s.get('n_devices')} · mean utilization "
+        f"{s.get('mean_utilization_pct')}% · makespan "
+        f"{s.get('makespan_s')}s · critical path "
+        f"{s.get('critical_path_pct')}%</p>")
+    shares = s.get("gap_attribution_share") or {}
+    if shares:
+        head += ("<p>idle attribution: " + html.escape(", ".join(
+            f"{k}={round(v * 100, 1)}%"
+            for k, v in sorted(shares.items()))) + "</p>")
+    rows = "".join(
+        f"<tr><td>{d.get('device')}</td><td>{d.get('chunks')}</td>"
+        f"<td>{d.get('busy_s')}</td><td>{d.get('utilization_pct')}</td>"
+        f"<td>{html.escape(', '.join(f'{k}={v}' for k, v in sorted((d.get('gap_s') or {}).items())) or '—')}</td></tr>"
+        for d in util.get("devices") or [])
+    table = (
+        "<table><tr><th>device</th><th>chunks</th><th>busy s</th>"
+        "<th>util %</th><th>idle s by class</th></tr>" + rows
+        + "</table>")
+    try:
+        gantt = _util.render_gantt(util)
+    except Exception:  # noqa: BLE001 - a malformed block still lists
+        gantt = ""
+    return head + gantt + table
+
+
+def _utilization_page(root: Path) -> str:
+    sections = []
+    tests = store.tests(root=root)
+    for name in sorted(tests):
+        for start in sorted(tests[name], reverse=True):
+            run = tests[name][start]
+            doc = _profile_rows(run)
+            if doc is None:
+                continue
+            util = (doc.get("attribution") or {}).get("utilization")
+            if not util:
+                continue
+            sections.append(
+                f'<h2><a href="/files/{name}/{start}/">'
+                f"{html.escape(name)} / {html.escape(start)}</a></h2>"
+                + _utilization_section(util))
+    if not sections:
+        sections.append(
+            "<p>No runs with utilization timelines yet — run a test "
+            "with <code>--profile</code> (utilization is reconstructed "
+            "from the timed chunk events and stored in "
+            "profile.json).</p>")
+    return (
+        f"<html><head><title>Jepsen utilization</title>"
+        f"<style>{_STYLE}</style></head>"
+        "<body><h1>Device saturation</h1>"
+        '<p><a href="/">index</a> · <a href="/profile">profile</a> · '
+        '<a href="/runs">runs</a></p>'
+        + "".join(sections) + "</body></html>"
+    )
+
+
+def _runs_page(root: Path) -> str:
+    """The cross-run perf ledger's trend (store/ledger.jsonl), grouped
+    by comparable (kind, workload, engine) with the newest-vs-previous
+    deltas — regressions highlighted."""
+    from .telemetry import ledger as _ledger
+
+    # default_path honors the JEPSEN_LEDGER_PATH override, matching
+    # every writer — a CI pointing writers elsewhere must see the same
+    # file rendered here.
+    records = _ledger.load(_ledger.default_path(root))
+    sections = []
+    for block in _ledger.trend(records):
+        k = block["key"]
+        cols = block["columns"]
+        names = [n for n, _k, _d in _ledger.LEDGER_METRICS
+                 if any(n in c["metrics"] for c in cols)]
+        head_cells = "".join(f"<th>{html.escape(c['label'])}</th>"
+                             for c in cols)
+        body = ""
+        regressed = set(block.get("regressions") or ())
+        for n in names:
+            cells = "".join(
+                f"<td>{c['metrics'].get(n, '—')}</td>" for c in cols)
+            cls = ' class="valid-false"' if n in regressed else ""
+            body += f"<tr{cls}><td>{html.escape(n)}</td>{cells}</tr>"
+        verd = "".join(f"<td>{html.escape(v)}</td>"
+                       for v in block["verdicts"])
+        body += f"<tr><td>verdict</td>{verd}</tr>"
+        sections.append(
+            f"<h2>{html.escape(k['kind'])} · {html.escape(k['workload'])}"
+            f" <small>[engine={html.escape(k['engine'])}, "
+            f"{block['records']} records]</small></h2>"
+            f"<table><tr><th>metric</th>{head_cells}</tr>{body}</table>"
+            + (("<p class=\"valid-false\">regressions vs previous: "
+                + html.escape(", ".join(sorted(regressed))) + "</p>")
+               if regressed else ""))
+    if not sections:
+        sections.append(
+            "<p>No ledger yet — every run and bench leg appends one "
+            "record to <code>store/ledger.jsonl</code>; gate with "
+            "<code>python -m jepsen_tpu.ledger --check</code>.</p>")
+    return (
+        f"<html><head><title>Jepsen run ledger</title>"
+        f"<style>{_STYLE}</style></head>"
+        "<body><h1>Cross-run perf ledger</h1>"
+        '<p><a href="/">index</a> · '
+        '<a href="/utilization">utilization</a></p>'
+        + "".join(sections) + "</body></html>"
+    )
+
+
 def _online_section(doc: dict) -> str:
     """Render one run's online.json: live watermark + verdict headline,
     detection info when a violation aborted the run, and the decided
@@ -519,6 +644,12 @@ def make_handler(root: Path):
                     return
                 if path in ("/online", "/online/"):
                     self._send(200, _online_page(root).encode())
+                    return
+                if path in ("/utilization", "/utilization/"):
+                    self._send(200, _utilization_page(root).encode())
+                    return
+                if path in ("/runs", "/runs/"):
+                    self._send(200, _runs_page(root).encode())
                     return
                 if path in ("/live", "/live/"):
                     self._send(200, live_ndjson().encode(),

@@ -1,0 +1,219 @@
+"""Cross-run perf ledger: append/load, record builders, the trend CLI,
+the --check regression gate on a synthetic ledger with an injected
+regression, and the per-run append through core.run."""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from jepsen_tpu.telemetry import Registry
+from jepsen_tpu.telemetry import ledger
+
+
+def _rec(ts, workload="cas-register", engine="native", **metrics):
+    return {"ts": ts, "kind": "run", "run": f"{workload}/{ts}",
+            "workload": workload, "engine": engine, "verdict": "True",
+            **metrics}
+
+
+class TestAppendLoad:
+    def test_roundtrip_appends_one_line_per_record(self, tmp_path):
+        p = tmp_path / "ledger.jsonl"
+        assert ledger.append(_rec(1, checker_seconds=0.5), path=p)
+        assert ledger.append(_rec(2, checker_seconds=0.4), path=p)
+        assert len(p.read_text().splitlines()) == 2
+        recs = ledger.load(p)
+        assert [r["ts"] for r in recs] == [1, 2]
+
+    def test_ts_is_stamped_when_absent(self, tmp_path):
+        p = tmp_path / "ledger.jsonl"
+        ledger.append({"kind": "run", "workload": "w", "engine": "h"},
+                      path=p)
+        (r,) = ledger.load(p)
+        assert r["ts"] > 1_700_000_000
+
+    def test_unparseable_lines_are_skipped_not_fatal(self, tmp_path):
+        p = tmp_path / "ledger.jsonl"
+        p.write_text('not json\n' + json.dumps(_rec(5)) + '\n')
+        assert [r["ts"] for r in ledger.load(p)] == [5]
+        assert ledger.load(tmp_path / "missing.jsonl") == []
+
+    def test_append_never_raises(self, tmp_path):
+        # Unwritable target (a directory in the file's place).
+        bad = tmp_path / "dir"
+        bad.mkdir()
+        assert ledger.append(_rec(1), path=bad) is None
+
+    def test_env_override_wins(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("JEPSEN_LEDGER_PATH",
+                           str(tmp_path / "ci.jsonl"))
+        assert ledger.default_path("/elsewhere") == \
+            tmp_path / "ci.jsonl"
+
+
+class TestRecordBuilders:
+    def test_record_of_run_compacts_the_test_map(self):
+        reg = Registry()
+        reg.gauge("checker_seconds", "s", labelnames=("checker",
+                                                      "backend")) \
+            .labels(checker="linearizable", backend="native").set(0.123)
+        test = {
+            "name": "cas-register", "start-time": "2026",
+            "history": [1] * 40,
+            "results": {"valid": True,
+                        "linearizable": {"valid": True,
+                                         "backend": "native"}},
+            "telemetry-registry": reg,
+            "online-results": {"decision_latency": {"p99_s": 0.5}},
+        }
+        r = ledger.record_of_run(test)
+        assert r["kind"] == "run"
+        assert r["workload"] == "cas-register"
+        assert r["engine"] == "native"  # dug out of the nested results
+        assert r["ops"] == 40
+        assert r["verdict"] == "True"
+        assert r["checker_seconds"] == 0.123
+        assert r["p99_decision_latency_s"] == 0.5
+        assert "utilization_pct" not in r  # no chunk events recorded
+
+    def test_record_of_run_without_telemetry_still_records(self):
+        r = ledger.record_of_run({"name": "w", "start-time": "t",
+                                  "results": {"valid": False}})
+        assert r["verdict"] == "False" and r["engine"] == "host"
+
+    def test_records_of_bench_one_per_leg_that_produced_numbers(self):
+        out = {
+            "value": 0.05, "ops_per_s": 200000.0,
+            "invalid_s": 0.4,
+            "online_10k": {"online_s": 1.5, "n_ops": 10000,
+                           "valid": False,
+                           "p99_decision_latency_s": 0.2},
+            "batch_replay_100": {"skipped": "budget"},
+            "batch_replay_large": {
+                "value_s": 3.0,
+                "smoke_8x10k": {"value_s": 60.0, "decided": 4,
+                                "utilization_pct": 41.5}},
+            "mutex_5k": {"error": "boom"},
+        }
+        recs = {r["workload"]: r for r in ledger.records_of_bench(out)}
+        assert recs["headline"]["value_s"] == 0.05
+        assert recs["headline"]["engine"] == "native"
+        assert recs["online_10k"]["p99_decision_latency_s"] == 0.2
+        assert recs["online_10k"]["verdict"] == "False"
+        assert recs["smoke_8x10k"]["utilization_pct"] == 41.5
+        # Skipped/errored legs leave no record.
+        assert "batch_replay_100" not in recs
+        assert "mutex_5k" not in recs
+
+
+class TestTrendAndCheck:
+    def test_groups_compare_only_like_runs(self, tmp_path):
+        p = tmp_path / "l.jsonl"
+        ledger.append(_rec(1, engine="native", checker_seconds=0.4),
+                      path=p)
+        ledger.append(_rec(2, engine="device", checker_seconds=9.0),
+                      path=p)  # different engine: NOT comparable
+        blocks = ledger.trend(ledger.load(p))
+        assert len(blocks) == 2
+        assert all("deltas" not in b for b in blocks)  # 1 record each
+
+    def test_check_flags_an_injected_regression(self, tmp_path):
+        p = tmp_path / "l.jsonl"
+        ledger.append(_rec(1, checker_seconds=0.40,
+                           utilization_pct=80.0), path=p)
+        ledger.append(_rec(2, checker_seconds=0.41,
+                           utilization_pct=79.0), path=p)  # noise, ok
+        assert ledger.check(ledger.load(p)) == []
+        ledger.append(_rec(3, checker_seconds=0.80,
+                           utilization_pct=79.0), path=p)  # 2x slower
+        (flagged,) = ledger.check(ledger.load(p))
+        assert flagged["regressions"] == ["checker_seconds"]
+
+    def test_info_metrics_never_gate(self, tmp_path):
+        p = tmp_path / "l.jsonl"
+        ledger.append(_rec(1, ops=1000), path=p)
+        ledger.append(_rec(2, ops=10), path=p)  # ops is info-only
+        assert ledger.check(ledger.load(p)) == []
+
+
+class TestCli:
+    def test_cli_renders_trend_and_exits_zero_without_check(
+            self, tmp_path, capsys):
+        p = tmp_path / "l.jsonl"
+        ledger.append(_rec(1, checker_seconds=0.4), path=p)
+        ledger.append(_rec(2, checker_seconds=0.9), path=p)
+        assert ledger.main([str(p)]) == 0
+        out = capsys.readouterr().out
+        assert "cas-register" in out and "checker_seconds" in out
+        assert "** REGRESSION" in out  # shown, but not gated
+
+    def test_cli_check_exits_nonzero_on_regression(self, tmp_path,
+                                                   capsys):
+        p = tmp_path / "l.jsonl"
+        ledger.append(_rec(1, checker_seconds=0.4), path=p)
+        ledger.append(_rec(2, checker_seconds=0.9), path=p)
+        assert ledger.main([str(p), "--check"]) == 1
+        assert "REGRESSIONS past 10%" in capsys.readouterr().out
+        # A looser threshold passes the same ledger.
+        assert ledger.main([str(p), "--check", "--threshold", "2"]) == 0
+
+    def test_cli_check_passes_on_a_clean_ledger(self, tmp_path, capsys):
+        p = tmp_path / "l.jsonl"
+        ledger.append(_rec(1, checker_seconds=0.4), path=p)
+        ledger.append(_rec(2, checker_seconds=0.39), path=p)
+        assert ledger.main([str(p), "--check"]) == 0
+        assert "no regressions" in capsys.readouterr().out
+
+    def test_cli_json_and_workload_filter(self, tmp_path, capsys):
+        p = tmp_path / "l.jsonl"
+        ledger.append(_rec(1, checker_seconds=0.4), path=p)
+        ledger.append(_rec(2, workload="other", checker_seconds=1.0),
+                      path=p)
+        assert ledger.main([str(p), "--json", "--workload",
+                            "cas-register"]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        (g,) = doc["groups"]
+        assert g["key"]["workload"] == "cas-register"
+
+    def test_module_shim_is_invocable(self):
+        import subprocess
+        import sys
+
+        r = subprocess.run(
+            [sys.executable, "-m", "jepsen_tpu.ledger", "--help"],
+            capture_output=True, text=True, timeout=60)
+        assert r.returncode == 0
+        assert "--check" in r.stdout
+
+
+class TestCoreRunAppends:
+    def test_every_persisted_run_appends_one_record(self, tmp_path):
+        from jepsen_tpu import checker as jchecker
+        from jepsen_tpu import core
+        from jepsen_tpu import generator as gen
+        from jepsen_tpu.models import CasRegister
+        from jepsen_tpu.workloads import (AtomClient, AtomDB, AtomState,
+                                          noop_test)
+
+        state = AtomState()
+        test = dict(noop_test())
+        test.update(
+            name="ledger-smoke", db=AtomDB(state),
+            client=AtomClient(state), model=CasRegister(init=0),
+            concurrency=2, **{"telemetry?": True},
+            checker=jchecker.linearizable(model=CasRegister(init=0)),
+            generator=gen.clients(gen.limit(20, gen.mix([
+                lambda: {"f": "read"},
+                lambda: {"f": "write", "value": gen.rand_int(5)},
+            ]))))
+        test["store-root"] = str(tmp_path)
+        res = core.run(test)
+        assert res["results"]["valid"] is True
+        (rec,) = ledger.load(tmp_path / "ledger.jsonl")
+        assert rec["kind"] == "run"
+        assert rec["workload"] == "ledger-smoke"
+        assert rec["verdict"] == "True"
+        assert rec["ops"] == len(res["history"])
+        assert rec["checker_seconds"] >= 0

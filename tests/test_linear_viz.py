@@ -1,0 +1,128 @@
+"""Golden tests for checker/linear_viz.py refutation rendering.
+
+The cycle-explanation renders have goldens (tests/test_explain.py) but
+the OTHER witness path — ``failure_report`` / ``render_linear_svg`` on
+a linearizability refutation's ``stuck_configs`` — had none: a
+regression in the per-op reasons or the timeline coloring would ship
+silently into the ``linear.txt`` / ``linear.svg`` store artifacts the
+``linearizable`` checker writes on every invalid run."""
+
+from __future__ import annotations
+
+from jepsen_tpu.checker.linear_viz import (
+    _C_BLOCKED,
+    _C_LIN,
+    _C_OPEN,
+    _C_REJECT,
+    failure_report,
+    render_linear_svg,
+)
+from jepsen_tpu.history import History, Op
+from jepsen_tpu.models import CasRegister
+from jepsen_tpu.ops import wgl_host
+from jepsen_tpu.ops.encode import encode_history
+
+
+def _seeded_invalid():
+    """A minimal seeded-invalid CAS history: the read observes 2, a
+    value nothing ever wrote (the cas would install 3, not 2)."""
+    ops = [
+        {"process": 0, "type": "invoke", "f": "write", "value": 1},
+        {"process": 0, "type": "ok", "f": "write", "value": 1},
+        {"process": 1, "type": "invoke", "f": "read", "value": None},
+        {"process": 1, "type": "ok", "f": "read", "value": 2},
+        {"process": 0, "type": "invoke", "f": "cas", "value": [1, 3]},
+        {"process": 0, "type": "ok", "f": "cas", "value": [1, 3]},
+    ]
+    return History([Op.from_dict(o) for o in ops], reindex=True)
+
+
+GOLDEN_REPORT = """Linearizability refuted.
+  op count:        3
+  max linearized:  1
+  engine:          host
+
+Deepest configurations reached (1 shown):
+
+config 0: state=(1,) (1 ops linearized)
+  pending: read -> 2 [proc 1, ok, idx 2]
+  pending: cas 1 -> 3 [proc 0, ok, idx 4]"""
+
+
+class TestFailureReportGolden:
+    def test_host_oracle_refutation_renders_the_golden_report(self):
+        model = CasRegister(init=0)
+        h = _seeded_invalid()
+        res = wgl_host.check_encoded(encode_history(model, h))
+        assert res["valid"] is False
+        assert failure_report(model, h, res) == GOLDEN_REPORT
+
+    def test_no_witness_degrades_gracefully(self):
+        model = CasRegister(init=0)
+        h = _seeded_invalid()
+        out = failure_report(model, h, {"valid": False, "op_count": 3})
+        assert "(no witness captured)" in out
+
+
+class TestRenderLinearSvg:
+    def test_host_refutation_svg_golden_structure(self, tmp_path):
+        model = CasRegister(init=0)
+        h = _seeded_invalid()
+        res = wgl_host.check_encoded(encode_history(model, h))
+        path = tmp_path / "linear.svg"
+        svg = render_linear_svg(model, h, res, path=str(path))
+        assert path.read_text() == svg
+        # Headline: the stuck state and linearized count.
+        assert ("not linearizable — state (1,), 1 ops linearized "
+                "(showing ops 0..2)") in svg
+        # One lane per process.
+        assert "proc 0" in svg and "proc 1" in svg
+        # The linearized write is green; host-oracle pending entries
+        # are plain strings (no row/why), so the unlinearized ops stay
+        # in the neutral palette.
+        assert svg.count(f'fill="{_C_LIN}" fill-opacity') == 1
+        assert "<title>write 1</title>" in svg
+        assert "<title>read -&gt; 2</title>" in svg
+        assert "<title>cas 1 -&gt; 3</title>" in svg
+        # Legend names every class.
+        for label in ("linearized", "model rejects",
+                      "real-time blocked", "explored", "open (:info)"):
+            assert label in svg
+
+    def test_dict_pending_entries_color_by_reason(self):
+        """Engines that capture per-op reasons (native DFS, device
+        frontier decode) carry {"row", "op", "why"} pending entries —
+        the reason names the rect color."""
+        model = CasRegister(init=0)
+        h = _seeded_invalid()
+        res = {
+            "valid": False, "op_count": 3, "max_linearized": 1,
+            "stuck_configs": [{
+                "linearized": [0], "state": (1,),
+                "pending": [
+                    {"row": 1, "op": "read -> 2",
+                     "why": "model rejects read of 2 in state (1,)"},
+                    {"row": 2, "op": "cas 1 -> 3",
+                     "why": "real-time-blocked behind row 1"},
+                ]}],
+        }
+        svg = render_linear_svg(model, h, res)
+        assert f'fill="{_C_REJECT}"' in svg    # model-rejects red
+        assert f'fill="{_C_BLOCKED}"' in svg   # real-time orange
+        assert "model rejects read of 2" in svg  # why rides the title
+
+    def test_open_info_ops_render_grey(self):
+        model = CasRegister(init=0)
+        ops = [
+            {"process": 0, "type": "invoke", "f": "write", "value": 1},
+            {"process": 0, "type": "ok", "f": "write", "value": 1},
+            {"process": 1, "type": "invoke", "f": "read", "value": None},
+            {"process": 1, "type": "ok", "f": "read", "value": 2},
+            {"process": 2, "type": "invoke", "f": "write", "value": 9},
+            {"process": 2, "type": "info", "f": "write", "value": 9},
+        ]
+        h = History([Op.from_dict(o) for o in ops], reindex=True)
+        res = wgl_host.check_encoded(encode_history(model, h))
+        assert res["valid"] is False
+        svg = render_linear_svg(model, h, res)
+        assert f'fill="{_C_OPEN}"' in svg  # the open :info op

@@ -277,6 +277,61 @@ class TestGating:
         assert s["telemetry?"] is True
 
 
+class TestUtilizationOffPath:
+    """Satellite pin (extending the poisoned-Registry pattern of
+    tests/test_profile.py::TestDisabledPathZeroOverhead): with
+    telemetry disabled the utilization module is NEVER imported, and
+    chunk-event stamping adds zero work — the stamps live inside
+    ``wgl._note_chunk_metrics``, which the disabled driver never calls
+    (poisoned there alongside ``Registry.event``)."""
+
+    def test_package_import_does_not_pull_utilization(self):
+        import subprocess
+        import sys
+
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import sys, jepsen_tpu.telemetry; "
+             "assert 'jepsen_tpu.telemetry.utilization' "
+             "not in sys.modules"],
+            capture_output=True, text=True, timeout=120)
+        assert r.returncode == 0, r.stderr
+
+    def test_disabled_path_never_imports_utilization_or_stamps(
+            self, monkeypatch):
+        import builtins
+
+        from jepsen_tpu.ops import wgl
+        from jepsen_tpu.telemetry import ledger
+
+        real_import = builtins.__import__
+
+        def guard(name, globals=None, locals=None, fromlist=(),
+                  level=0):
+            if "utilization" in name or (
+                    fromlist and "utilization" in fromlist):
+                raise AssertionError(
+                    "utilization imported on the disabled path")
+            return real_import(name, globals, locals, fromlist, level)
+
+        def _boom(*a, **k):
+            raise AssertionError("telemetry touched on disabled path")
+
+        monkeypatch.setattr(builtins, "__import__", guard)
+        monkeypatch.setattr(wgl, "_note_chunk_metrics", _boom)
+        monkeypatch.setattr(Registry, "event", _boom)
+        # Attribution short-circuits on no-chunk-events BEFORE any
+        # utilization import (the gate in profile._attribute_utilization).
+        assert telemetry.attribute(Registry()) == {}
+        # A telemetry-less run's ledger record builds without touching
+        # the registry-side utilization path either.
+        rec = ledger.record_of_run(
+            {"name": "x", "start-time": "t",
+             "results": {"valid": True}})
+        assert rec["verdict"] == "True"
+        assert "utilization_pct" not in rec
+
+
 class TestHeartbeat:
     def test_heartbeat_logs_progress_and_eta(self, caplog):
         log = logging.getLogger("test.heartbeat")

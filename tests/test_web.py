@@ -63,7 +63,8 @@ def get(tmp_path):
     srv.server_close()
 
 
-PAGES = ("/", "/metrics", "/profile", "/online", "/live.html")
+PAGES = ("/", "/metrics", "/profile", "/online", "/utilization",
+         "/runs", "/live.html")
 
 
 class TestEndpointsWithoutTelemetry:
@@ -77,6 +78,8 @@ class TestEndpointsWithoutTelemetry:
         assert "--telemetry" in get("/metrics")[2]
         assert "--profile" in get("/profile")[2]
         assert "--online" in get("/online")[2]
+        assert "--profile" in get("/utilization")[2]
+        assert "ledger.jsonl" in get("/runs")[2]
 
     def test_live_is_wellformed_ndjson_with_no_live_run(self, get):
         status, ctype, body = get("/live")
@@ -123,6 +126,79 @@ class TestEndpointsWithTelemetry:
         # /profile stays well-formed when the run had no --profile.
         status, _ct, body = get("/profile")
         assert status == 200 and "</html>" in body
+
+
+class TestUtilizationAndRunsPages:
+    def test_utilization_page_renders_the_gantt_from_profile_json(
+            self, tmp_path, get):
+        from jepsen_tpu.telemetry import Registry, profile
+
+        B = 1_754_000_000.0
+        reg = Registry()
+        reg.event("wgl_sharded_chunk", level=5, F=16, n_shards=2,
+                  wall_s=1.0, stage="execute", t0=B, t1=B + 1)
+        reg.event("wgl_sharded_chunk", level=9, F=16, n_shards=2,
+                  wall_s=1.0, stage="execute", t0=B + 2, t1=B + 3)
+        test = {"name": "util-web", "start-time": "20260804T000000.000Z",
+                "store-root": str(tmp_path), "telemetry-registry": reg}
+        profile.store_profile(test)
+        status, _ct, body = get("/utilization")
+        assert status == 200
+        assert "util-web" in body
+        assert "<svg" in body          # the occupancy Gantt, inline
+        assert "no-work" in body       # legend names the gap classes
+        assert "mean utilization" in body
+
+    def test_runs_page_renders_the_ledger_trend(self, tmp_path, get):
+        from jepsen_tpu.telemetry import ledger
+
+        p = tmp_path / "ledger.jsonl"
+        ledger.append({"ts": 1, "kind": "run", "run": "w/1",
+                       "workload": "web-ledger", "engine": "native",
+                       "verdict": "True", "checker_seconds": 0.4},
+                      path=p)
+        ledger.append({"ts": 2, "kind": "run", "run": "w/2",
+                       "workload": "web-ledger", "engine": "native",
+                       "verdict": "True", "checker_seconds": 0.9},
+                      path=p)
+        status, _ct, body = get("/runs")
+        assert status == 200
+        assert "web-ledger" in body
+        assert "checker_seconds" in body
+        # The 2.25x slowdown is highlighted as a regression row.
+        assert "regressions vs previous" in body
+
+
+class TestParityArtifactLinks:
+    """checker/perf.py's pngs and checker/timeline.py's timeline.html
+    already landed in the store but were invisible from the index —
+    linked when present, absent rows stay clean."""
+
+    FILES = ("latency-raw.png", "latency-quantiles.png", "rate.png",
+             "timeline.html")
+
+    def _mk_run(self, tmp_path, name, files):
+        run = tmp_path / name / "20260804T000000.000Z"
+        run.mkdir(parents=True)
+        (run / "results.edn").write_text("{:valid? true}\n")
+        for fn in files:
+            (run / fn).write_bytes(b"x")
+        return run
+
+    def test_present_artifacts_are_linked_from_the_index(
+            self, tmp_path, get):
+        self._mk_run(tmp_path, "with-plots", self.FILES)
+        body = get("/")[2]
+        for fn in self.FILES:
+            assert f"/files/with-plots/20260804T000000.000Z/{fn}" \
+                in body, fn
+
+    def test_absent_artifacts_leave_no_links(self, tmp_path, get):
+        self._mk_run(tmp_path, "no-plots", ())
+        body = get("/")[2]
+        assert "no-plots" in body
+        for fn in self.FILES:
+            assert fn not in body, fn
 
 
 class TestMetricsQuantileRendering:
