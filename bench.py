@@ -437,6 +437,72 @@ def main() -> int:
         except Exception as e:  # noqa: BLE001
             out["online_10k"] = {"error": f"{type(e).__name__}: {e}"}
 
+        # Multi-tenant checking service (jepsen_tpu.service): the
+        # ROADMAP item-3 serving bench — N concurrent tenant streams
+        # driven through the in-process submit seam (one feeder thread
+        # per tenant, host engine — no compiles), ONE shared scheduler
+        # co-batching across tenants. Two gated numbers:
+        # `sustained_ops_per_s` (total ops ingested+decided / wall,
+        # higher) and the service-wide `p99_decision_latency_s`
+        # (invoke→watermark-covered, lower). `co_batched_rounds`
+        # evidences the cross-tenant batch fill.
+        _REC.begin("service_streams")
+        try:
+            import threading as _threading
+
+            from jepsen_tpu.service import Service
+            from jepsen_tpu.telemetry import Registry as _SReg
+            from jepsen_tpu.testing import chunked_register_history
+
+            n_t = 4
+            per_tenant = max(N_OPS // n_t, 500)
+            histories = {
+                f"tenant-{i}": chunked_register_history(
+                    random.Random(3100 + i), n_ops=per_tenant,
+                    n_procs=4, chunk_ops=60)
+                for i in range(n_t)}
+            sreg = _SReg()
+            svc = Service(model, engine="host", metrics=sreg,
+                          register_live=False, ledger=False,
+                          name="bench-service")
+            t0 = time.perf_counter()
+
+            def _drive(name):
+                for op in histories[name]:
+                    svc.submit(name, op)
+
+            feeders = [_threading.Thread(target=_drive, args=(n,))
+                       for n in histories]
+            for th in feeders:
+                th.start()
+            for th in feeders:
+                th.join()
+            svc.flush(180.0)
+            fin = svc.drain(timeout=180)
+            t_total = time.perf_counter() - t0
+            n_total = sum(len(h) for h in histories.values())
+            lat = fin.get("decision_latency") or {}
+            rounds = sreg.events("online_round")
+            out["service_streams"] = {
+                "tenants": n_t,
+                "n_ops_total": n_total,
+                "valid_all": all(
+                    fin["tenants"][n]["valid"] is True
+                    for n in histories),
+                "wall_s": round(t_total, 3),
+                "sustained_ops_per_s": round(n_total / t_total, 1),
+                "p50_decision_latency_s": lat.get("p50_s"),
+                "p99_decision_latency_s": lat.get("p99_s"),
+                "decision_latency_count": lat.get("count"),
+                "rounds": len(rounds),
+                "co_batched_rounds": sum(
+                    1 for ev in rounds if len(ev["streams"]) >= 2),
+                "max_tenants_per_round": max(
+                    (len(ev["streams"]) for ev in rounds), default=0),
+            }
+        except Exception as e:  # noqa: BLE001
+            out["service_streams"] = {"error": f"{type(e).__name__}: {e}"}
+
         # --- Device sections, costliest-compile last, each budgeted ----
         # A wedged TPU relay hangs the FIRST jax op forever (not an
         # exception — the per-section try/except can't catch it), which

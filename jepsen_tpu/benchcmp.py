@@ -92,6 +92,16 @@ METRICS: list[tuple[str, str, str]] = [
     # = the scheduler/pipeline got slower at covering ops; lower only.
     ("online_p99_decision_latency_s",
      "online_10k.p99_decision_latency_s", "lower"),
+    # Multi-tenant checking service (ISSUE 8): sustained throughput of
+    # N concurrent tenant streams through the shared co-batching
+    # scheduler, and the service-wide p99 invoke→watermark-covered lag
+    # — the "heavy traffic from millions of users" serving numbers
+    # ROADMAP item 3 benches. Throughput shrinking or tail latency
+    # growing is a regression.
+    ("service_sustained_ops_per_s",
+     "service_streams.sustained_ops_per_s", "higher"),
+    ("service_p99_decision_latency_s",
+     "service_streams.p99_decision_latency_s", "lower"),
 ]
 
 DEFAULT_THRESHOLD = 0.10

@@ -9,7 +9,11 @@ Three layers (see docs/online.md):
 - :mod:`scheduler` — background dispatcher: groups closed segments into
   members of the PR-2 batched device pipeline
   (``jepsen_tpu.parallel.batch``), folds per-segment verdicts, and
-  exposes the monotone ``decided_through_index`` watermark.
+  exposes the monotone ``decided_through_index`` watermark. Since the
+  multi-tenant service (``jepsen_tpu.service``) it is *multi-stream*:
+  ``submit(segments, stream=…)`` namespaces carry/watermark/verdict per
+  stream, and one round co-batches members ACROSS streams (tenants are
+  one more independence axis next to keys).
 - :mod:`monitor` — the public :class:`OnlineMonitor`, wired into
   ``core.run`` behind the ``--online`` CLI flag, with
   ``abort_on_violation`` early-stop, telemetry, and the ``online.json``
@@ -31,7 +35,7 @@ first-accept search decides). See docs/online.md.
 from __future__ import annotations
 
 from .monitor import OnlineMonitor, of_test, store_online  # noqa: F401
-from .scheduler import SegmentScheduler  # noqa: F401
+from .scheduler import DEFAULT_STREAM, SegmentScheduler  # noqa: F401
 from .segmenter import (  # noqa: F401
     SINGLE_KEY,
     KeySegment,
@@ -41,6 +45,7 @@ from .segmenter import (  # noqa: F401
 )
 
 __all__ = [
+    "DEFAULT_STREAM",
     "KeySegment",
     "OnlineMonitor",
     "SINGLE_KEY",

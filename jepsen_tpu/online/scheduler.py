@@ -1,5 +1,6 @@
 """Background segment dispatcher: batched device decisions, verdict fold,
-and the monotone ``decided_through_index`` watermark.
+and the monotone ``decided_through_index`` watermark — shared across
+many independent *streams*.
 
 A worker thread drains the segment queue WHILE the workload runs.
 Each round it collects every *ready* segment — a KeySegment is ready
@@ -7,7 +8,20 @@ when its key's carried initial-state set is known, i.e. the key's
 previous segment has been decided (keys are independent, so distinct
 keys pipeline freely; one key's segments decide strictly in order) —
 encodes each (segment × carried-state) pair as one member, and decides
-the whole group:
+the whole group.
+
+Streams generalize the distinct-keys pipeline one axis further: two
+segments from different streams (the service's *tenants* — independent
+histories with independent models-of-record) are as independent as two
+segments of different keys, so one round legally co-batches members
+from MANY streams into a single device program. The OnlineMonitor uses
+one implicit stream (:data:`DEFAULT_STREAM`); the multi-tenant service
+(``jepsen_tpu.service``) registers one stream per tenant and shares
+ONE scheduler — device batches fill from whoever has work, while each
+stream keeps its own per-key in-order carry chain, its own monotone
+watermark, and its own folded verdict (the co-batching contract: the
+shared batch NEVER changes any stream's verdict, pinned differentially
+in tests/test_service.py).
 
 Deciding is two-stage. Non-terminal members go to the exhaustive host
 enumerator (``segmenter.segment_states``) first: one BFS yields both
@@ -29,20 +43,30 @@ the verdict):
 - ``engine="auto"``: device when the model is device-capable and a
   round hands the oracle more than one member, host otherwise.
 
-Verdict fold (the differential-safety contract): a segment is *valid*
-iff any member (candidate initial state) linearizes — its carried set
-becomes the union of feasible end states over the valid members;
-*invalid* iff every member is refuted (any invalid segment makes the
-folded verdict invalid, with the witness segment + refutation info
-recorded); *unknown* otherwise, and every later segment of that key
-folds unknown too (no initial state to check from). The folded verdict
-therefore equals ``checker.merge_valid`` over segment verdicts, which
-equals the offline ``check_history`` verdict on the full history
-(tests/test_online.py pins this differentially).
+Verdict fold (the differential-safety contract), per stream: a segment
+is *valid* iff any member (candidate initial state) linearizes — its
+carried set becomes the union of feasible end states over the valid
+members; *invalid* iff every member is refuted (any invalid segment
+makes that stream's folded verdict invalid, with the witness segment +
+refutation info recorded); *unknown* otherwise, and every later
+segment of that stream's key folds unknown too (no initial state to
+check from). The folded verdict therefore equals
+``checker.merge_valid`` over the stream's segment verdicts, which
+equals the offline ``check_history`` verdict on that stream's full
+history alone (tests/test_online.py pins this differentially for the
+single-stream monitor, tests/test_service.py for N concurrent
+tenants).
 
-``decided_through_index`` only ever advances: it is the end index of
-the longest prefix of global segments whose KeySegments have all been
-decided.
+Each stream's ``decided_through_index`` only ever advances: it is the
+end index of the longest prefix of that stream's global segments whose
+KeySegments have all been decided.
+
+Fairness: ``max_ready_per_stream`` caps how many segments one stream
+may contribute to a single round. Per-(stream, key) in-order take
+already guarantees every stream with ready work lands at least one
+segment per round (a trickle tenant's watermark advances no matter how
+hard a neighbour floods); the cap additionally stops a flooding
+stream with many distinct keys from monopolizing round latency.
 """
 
 from __future__ import annotations
@@ -66,27 +90,62 @@ from .segmenter import (
 
 LOG = logging.getLogger("jepsen.online")
 
+# The implicit stream the single-tenant OnlineMonitor submits under.
+DEFAULT_STREAM = "__default__"
+
+
+class _StreamState:
+    """Per-stream fold state (all fields guarded by the scheduler's
+    ``_lock`` except the hook references, which are write-once at
+    registration)."""
+
+    __slots__ = ("carry", "seq_outstanding", "seq_end", "next_seq",
+                 "watermark", "n_decided", "n_invalid", "n_unknown",
+                 "violation", "segments", "on_watermark", "on_violation")
+
+    def __init__(self, on_watermark=None, on_violation=None):
+        # key -> carried decoded-state list; absent = model's own init
+        # (None member sentinel); "unknown" = carry lost.
+        self.carry: dict[Any, Any] = {}
+        self.seq_outstanding: dict[int, int] = {}
+        self.seq_end: dict[int, int] = {}
+        self.next_seq = 0  # first seq of this stream not fully decided
+        self.watermark = -1
+        self.n_decided = 0
+        self.n_invalid = 0
+        self.n_unknown = 0
+        self.violation: Optional[dict] = None
+        self.segments: list[dict] = []  # bounded display rows
+        self.on_watermark = on_watermark
+        self.on_violation = on_violation
+
 
 class SegmentScheduler:
-    """Decide a stream of KeySegments concurrently with the workload.
+    """Decide one or more streams of KeySegments concurrently with the
+    workload(s).
 
-    ``on_violation(record)`` fires (once, from the worker thread) when a
-    segment folds invalid — the monitor uses it for abort_on_violation
-    and the detection metrics. ``metrics`` is a telemetry Registry or
+    ``on_violation(record)`` fires (once per stream, from the worker
+    thread) when a segment of the DEFAULT stream folds invalid — the
+    monitor uses it for abort_on_violation and the detection metrics;
+    service tenants register their own hooks via
+    :meth:`register_stream`. ``metrics`` is a telemetry Registry or
     None; series: ``online_segments_total{verdict}``,
-    ``online_decided_watermark``, ``online_scheduler_backlog``.
+    ``online_decided_watermark`` and ``online_scheduler_backlog`` (the
+    latter two carry a ``{tenant}`` label family next to the unlabeled
+    total — existing dashboards and the ``/live`` poll keep reading the
+    total; per-tenant children appear only for non-default streams).
 
     Decision-latency tracing (all optional, all None on the off path):
-    ``on_watermark(index)`` fires from the worker thread whenever the
-    decided watermark advances (called with the scheduler lock held —
-    the callback must not call back into the scheduler); ``collector``
-    is a ``trace.Collector`` receiving linked spans per decided segment
-    (stage ``segment``, children stage ``member``, engine calls stage
-    ``oracle`` whose span id is pushed as ``trace_span`` event tags so
-    kernel chunk events link back); ``flight`` is a FlightRecorder whose
-    ledger gets ``online.drain`` / ``online.dispatch`` / ``online.fold``
-    phase entries, so ``offending_phase`` can blame a stalled or crashed
-    online run.
+    ``on_watermark(index)`` fires from the worker thread whenever a
+    stream's decided watermark advances (called with the scheduler lock
+    held — the callback must not call back into the scheduler);
+    ``collector`` is a ``trace.Collector`` receiving linked spans per
+    decided segment (stage ``segment``, children stage ``member``,
+    engine calls stage ``oracle`` whose span id is pushed as
+    ``trace_span`` event tags so kernel chunk events link back);
+    ``flight`` is a FlightRecorder whose ledger gets ``online.drain`` /
+    ``online.dispatch`` / ``online.fold`` phase entries, so
+    ``offending_phase`` can blame a stalled or crashed online run.
     """
 
     def __init__(
@@ -104,6 +163,7 @@ class SegmentScheduler:
         on_watermark: Optional[Callable[[int], None]] = None,
         collector=None,
         flight=None,
+        max_ready_per_stream: Optional[int] = None,
     ) -> None:
         if engine not in ("auto", "device", "host"):
             raise ValueError(f"unknown online engine {engine!r}")
@@ -112,41 +172,36 @@ class SegmentScheduler:
         self.metrics = metrics
         self.max_configs = max_configs
         self.batch_f = batch_f
-        self.on_violation = on_violation
         self.max_segment_rows = max_segment_rows
-        self.on_watermark = on_watermark
         self.collector = collector
         self.flight = flight
+        if max_ready_per_stream is not None and max_ready_per_stream < 1:
+            raise ValueError("max_ready_per_stream must be >= 1")
+        self.max_ready_per_stream = max_ready_per_stream
 
         self._lock = threading.Lock()
-        self._inbox: "queue.SimpleQueue[Optional[list[KeySegment]]]" = (
+        self._inbox: "queue.SimpleQueue[Optional[tuple]]" = (
             queue.SimpleQueue())
-        self._pending: list[KeySegment] = []  # not yet ready/decided
-        # key -> segments submitted but not yet decided (guarded by
-        # _lock; the /live dashboard's per-key queue-depth view).
-        self._key_depth: dict[Any, int] = {}
-        # key -> carried decoded-state list; absent = model's own init
-        # (None member sentinel); "unknown" = carry lost (budget/overflow).
-        self._carry: dict[Any, Any] = {}
-        self._seq_outstanding: dict[int, int] = {}
-        self._seq_end: dict[int, int] = {}
-        self._next_seq = 0  # first global seq not yet fully decided
-        self._watermark = -1
-        # Display table is bounded by max_segment_rows; the fold runs on
-        # these counters so a verdict past the bound still lands.
-        self._segments: list[dict] = []
-        self._n_decided = 0
-        self._n_invalid = 0
-        self._n_unknown = 0
-        self._violation: Optional[dict] = None
+        self._pending: list[tuple] = []  # (stream, KeySegment)
+        # (stream, key) -> segments submitted but not yet decided
+        # (guarded by _lock; the /live dashboard's queue-depth view).
+        self._key_depth: dict[tuple, int] = {}
+        # stream -> total undecided segments (same increments, kept so
+        # the pump's per-sweep flow-control poll is O(1) instead of a
+        # full _key_depth scan under the hot lock).
+        self._stream_depth: dict[Any, int] = {}
+        self._streams: dict[Any, _StreamState] = {
+            DEFAULT_STREAM: _StreamState(on_watermark, on_violation)}
+        self._violation: Optional[dict] = None  # first, any stream
         self._closed = False
         self._dead = False  # worker thread died; fold can't reach True
         self._idle = threading.Event()
         self._idle.set()
         # Batches submitted but not yet fully decided; guards the idle
         # event so wait_idle can't slip between a submit's clear() and
-        # its put().
+        # its put(). Per-stream counts back each stream's own fold.
         self._inflight = 0
+        self._inflight_by_stream: dict[Any, int] = {}
         self._cnt_lock = threading.Lock()
         self._thread = threading.Thread(
             target=self._run, name="jepsen-online-scheduler", daemon=True)
@@ -154,9 +209,30 @@ class SegmentScheduler:
 
     # -- public surface ------------------------------------------------------
 
-    def submit(self, segments: list[KeySegment]) -> None:
+    def register_stream(self, stream: Any,
+                        on_watermark: Optional[Callable] = None,
+                        on_violation: Optional[Callable] = None) -> None:
+        """Declare a stream (idempotent for hookless re-registration)
+        and attach its watermark/violation hooks. Hooks fire from the
+        worker thread with the scheduler lock held, like the ctor's."""
+        with self._lock:
+            st = self._streams.get(stream)
+            if st is None:
+                self._streams[stream] = _StreamState(on_watermark,
+                                                     on_violation)
+            elif on_watermark is not None or on_violation is not None:
+                if st.n_decided or st.seq_outstanding:
+                    raise RuntimeError(
+                        f"stream {stream!r} already has work; hooks must "
+                        "be registered before the first submit")
+                st.on_watermark = on_watermark or st.on_watermark
+                st.on_violation = on_violation or st.on_violation
+
+    def submit(self, segments: list[KeySegment],
+               stream: Any = DEFAULT_STREAM) -> None:
         """Enqueue all KeySegments of one cut (atomically, so the
-        watermark's per-seq accounting sees the full set)."""
+        watermark's per-seq accounting sees the full set) under
+        ``stream``'s carry chain."""
         if not segments:
             return
         # The closed check, in-flight accounting AND the enqueue share
@@ -167,35 +243,29 @@ class SegmentScheduler:
         with self._cnt_lock:
             if self._closed:
                 raise RuntimeError("scheduler is closed")
+            # In-flight accounting lands BEFORE the depth bump becomes
+            # visible under _lock: a stream_result/stream_stats reader
+            # who sees any trace of this batch must already find the
+            # stream in flight (folding unknown), never a transient
+            # definite True over just-submitted work.
+            self._inflight += 1
+            self._inflight_by_stream[stream] = (
+                self._inflight_by_stream.get(stream, 0) + 1)
             # Depth accounting rides inside the same critical section as
             # the enqueue (lock order: _cnt_lock > _lock, matched
             # nowhere in reverse): the worker cannot decide-and-
             # decrement a segment before its increment lands.
             with self._lock:
+                if stream not in self._streams:
+                    self._streams[stream] = _StreamState()
                 for seg in segments:
-                    self._key_depth[seg.key] = (
-                        self._key_depth.get(seg.key, 0) + 1)
-                if self.metrics is not None:
-                    # Under the SAME lock as the depth bump (mirroring
-                    # _record_locked's decrement-side set): a set
-                    # computed after release could overwrite the
-                    # worker's newer decrement with a stale count and
-                    # leave a drained run reporting backlog > 0.
-                    n_bl = sum(self._key_depth.values())
-                    self.metrics.gauge(
-                        "online_scheduler_backlog",
-                        "Segments submitted to the online scheduler "
-                        "and not yet decided").set(n_bl)
-                    # Stamped transition: the gauge only holds "now",
-                    # but idle-gap attribution (starved vs no-work)
-                    # needs the backlog's value OVER TIME — the
-                    # online_backlog event stream is that timeline.
-                    self.metrics.event(
-                        "online_backlog", t=round(_time.time(), 6),
-                        backlog=n_bl)
-            self._inflight += 1
+                    dk = (stream, seg.key)
+                    self._key_depth[dk] = self._key_depth.get(dk, 0) + 1
+                self._stream_depth[stream] = (
+                    self._stream_depth.get(stream, 0) + len(segments))
+                self._set_backlog_locked(stream)
             self._idle.clear()
-            self._inbox.put(list(segments))
+            self._inbox.put((stream, list(segments)))
 
     def close(self, timeout: Optional[float] = 60.0) -> None:
         """Stop accepting segments and wait for the queue to drain."""
@@ -207,32 +277,74 @@ class SegmentScheduler:
 
     @property
     def decided_through_index(self) -> int:
-        return self._watermark
+        return self._streams[DEFAULT_STREAM].watermark
+
+    def stream_watermark(self, stream: Any) -> int:
+        with self._lock:
+            st = self._streams.get(stream)
+            return st.watermark if st is not None else -1
 
     @property
     def backlog(self) -> int:
-        """Segments submitted and not yet decided."""
+        """Segments submitted and not yet decided (all streams)."""
         with self._lock:
-            return sum(self._key_depth.values())
+            return sum(self._stream_depth.values())
+
+    def stream_backlog(self, stream: Any) -> int:
+        """Undecided segments of one stream — the service's pump polls
+        this every sweep as its flow-control signal (O(1))."""
+        with self._lock:
+            return self._stream_depth.get(stream, 0)
+
+    def streams(self) -> list:
+        with self._lock:
+            return list(self._streams)
 
     def queue_depths(self) -> dict:
         """Per-key undecided-segment counts (keys repr'd for JSON) —
-        the /live dashboard's queue view."""
+        the /live dashboard's queue view. Non-default streams prefix
+        their tenant name."""
+        def _disp(stream, key):
+            k = "(single)" if key == SINGLE_KEY else repr(key)
+            return k if stream == DEFAULT_STREAM else f"{stream}:{k}"
+
         with self._lock:
-            return {("(single)" if k == SINGLE_KEY else repr(k)): v
-                    for k, v in sorted(self._key_depth.items(),
-                                       key=lambda kv: repr(kv[0]))}
+            return {_disp(s, k): v
+                    for (s, k), v in sorted(self._key_depth.items(),
+                                            key=lambda kv: repr(kv[0]))}
 
     def stats(self) -> dict:
-        """One locked snapshot of the fold counters for the live view."""
+        """One locked snapshot of the fold counters for the live view
+        (global counters; the watermark is the default stream's — the
+        monitor's single-stream shape)."""
         with self._lock:
             return {
-                "segments_decided": self._n_decided,
-                "segments_invalid": self._n_invalid,
-                "segments_unknown": self._n_unknown,
-                "decided_through_index": self._watermark,
-                "backlog": sum(self._key_depth.values()),
+                "segments_decided": sum(
+                    st.n_decided for st in self._streams.values()),
+                "segments_invalid": sum(
+                    st.n_invalid for st in self._streams.values()),
+                "segments_unknown": sum(
+                    st.n_unknown for st in self._streams.values()),
+                "decided_through_index":
+                    self._streams[DEFAULT_STREAM].watermark,
+                "backlog": sum(self._stream_depth.values()),
                 "verdict": self._fold_locked(),
+            }
+
+    def stream_stats(self, stream: Any) -> dict:
+        """One locked snapshot of ONE stream's fold counters — the
+        service's per-tenant live row."""
+        with self._lock:
+            st = self._streams.get(stream)
+            if st is None:
+                return {"registered": False}
+            return {
+                "segments_decided": st.n_decided,
+                "segments_invalid": st.n_invalid,
+                "segments_unknown": st.n_unknown,
+                "decided_through_index": st.watermark,
+                "backlog": self._stream_depth.get(stream, 0),
+                "verdict": self._stream_fold_locked(stream, st),
             }
 
     @property
@@ -251,47 +363,73 @@ class SegmentScheduler:
         return self._idle.wait(timeout)
 
     def result(self) -> dict:
+        """The monitor's single-stream result: global fold + the
+        default stream's watermark/rows (identical to the pre-service
+        shape when only the default stream ever submitted)."""
         with self._lock:
-            segs = list(self._segments)
+            st = self._streams[DEFAULT_STREAM]
             out = {
                 "valid": self._fold_locked(),
-                "decided_through_index": self._watermark,
-                "segments_decided": self._n_decided,
-                "segments": segs,
+                "decided_through_index": st.watermark,
+                "segments_decided": sum(
+                    s.n_decided for s in self._streams.values()),
+                "segments": [row for s in self._streams.values()
+                             for row in s.segments],
             }
             if self._violation is not None:
                 out["violation"] = self._violation
             return out
 
+    def stream_result(self, stream: Any) -> dict:
+        """One stream's folded result — what the service's drain
+        returns per tenant. A stream with submitted-but-undecided work
+        folds unknown (a definite True must cover the whole stream)."""
+        with self._lock:
+            st = self._streams.get(stream)
+            if st is None:
+                return {"valid": "unknown", "error": "unknown stream"}
+            out = {
+                "valid": self._stream_fold_locked(stream, st),
+                "decided_through_index": st.watermark,
+                "segments_decided": st.n_decided,
+                "segments_unknown": st.n_unknown,
+                "segments": list(st.segments),
+            }
+            if st.violation is not None:
+                out["violation"] = st.violation
+            return out
+
     # -- worker --------------------------------------------------------------
 
-    def _ingest(self, batch: list[KeySegment]) -> None:
+    def _ingest(self, stream: Any, batch: list[KeySegment]) -> None:
+        st = self._streams[stream]
         for seg in batch:
-            self._seq_outstanding[seg.seq] = (
-                self._seq_outstanding.get(seg.seq, 0) + 1)
-            self._seq_end[seg.seq] = seg.end_index
-            self._pending.append(seg)
+            st.seq_outstanding[seg.seq] = (
+                st.seq_outstanding.get(seg.seq, 0) + 1)
+            st.seq_end[seg.seq] = seg.end_index
+            self._pending.append((stream, seg))
 
     def _run(self) -> None:
         # Top-level guard: an exception anywhere outside _decide_round's
         # own recovery (ingest, bookkeeping, even _record_locked inside
         # the recovery handler) must not kill the worker with _idle
         # cleared — that would wedge wait_idle()/close() (and bench's
-        # pacing loop) forever. Death folds the stream unknown (_dead),
-        # never a definite True over undecided ops.
+        # pacing loop) forever. Death folds every stream unknown
+        # (_dead), never a definite True over undecided ops.
         try:
             self._run_loop()
         except Exception:  # noqa: BLE001 - the monitor must survive
-            LOG.warning("online scheduler worker died; stream folds "
+            LOG.warning("online scheduler worker died; streams fold "
                         "unknown", exc_info=True)
             with self._lock:
                 self._dead = True
-                for seg in self._pending:
-                    self._carry[seg.key] = "unknown"
+                for stream, seg in self._pending:
+                    self._streams[stream].carry[seg.key] = "unknown"
                     try:
                         self._record_locked(
-                            seg, {"valid": "unknown",
-                                  "error": "scheduler worker died"}, None)
+                            stream, seg,
+                            {"valid": "unknown",
+                             "error": "scheduler worker died"}, None)
                     except Exception:  # noqa: BLE001
                         pass
                 self._pending = []
@@ -301,16 +439,17 @@ class SegmentScheduler:
             with self._cnt_lock:
                 self._closed = True
                 self._inflight = 0
+                self._inflight_by_stream.clear()
             self._idle.set()
 
     def _run_loop(self) -> None:
         while True:
-            batch = self._inbox.get()
-            taken = 0
-            closing = batch is None
+            item = self._inbox.get()
+            taken: list = []  # streams of the batches taken this round
+            closing = item is None
             if not closing:
-                self._ingest(batch)
-                taken = 1
+                self._ingest(*item)
+                taken.append(item[0])
                 # Opportunistically drain everything already queued so
                 # one round sees the widest possible batch.
                 while True:
@@ -321,8 +460,8 @@ class SegmentScheduler:
                     if more is None:
                         closing = True
                         break
-                    self._ingest(more)
-                    taken += 1
+                    self._ingest(*more)
+                    taken.append(more[0])
             # The drain phase sits OUTSIDE _drain_ready's recovery
             # catch: a crash inside a round crosses (and errors) only
             # the inner dispatch/fold phases, so offending_phase blames
@@ -330,13 +469,24 @@ class SegmentScheduler:
             with _flight.phase(self.flight, "online.drain"):
                 self._drain_ready()
             # _drain_ready leaves _pending empty (the earliest pending
-            # segment of a key is always ready), so idleness is just
-            # "every submitted batch has been decided". On close,
-            # everything submitted before the marker has now been
-            # decided, so the in-flight count (undecidedness for the
-            # fold) zeros outright.
+            # segment of a (stream, key) is always ready and the
+            # fairness cap only splits rounds, never strands work), so
+            # idleness is just "every submitted batch has been
+            # decided". On close, everything submitted before the
+            # marker has now been decided, so the in-flight count
+            # (undecidedness for the fold) zeros outright.
             with self._cnt_lock:
-                self._inflight = 0 if closing else self._inflight - taken
+                if closing:
+                    self._inflight = 0
+                    self._inflight_by_stream.clear()
+                else:
+                    self._inflight -= len(taken)
+                    for s in taken:
+                        left = self._inflight_by_stream.get(s, 1) - 1
+                        if left <= 0:
+                            self._inflight_by_stream.pop(s, None)
+                        else:
+                            self._inflight_by_stream[s] = left
                 if self._inflight == 0:
                     self._idle.set()
             if closing:
@@ -354,34 +504,46 @@ class SegmentScheduler:
                 LOG.warning("online segment round failed; folding unknown",
                             exc_info=True)
                 with self._lock:
-                    for seg in ready:
+                    for stream, seg in ready:
                         if id(seg) in done:  # recorded before the raise
                             continue
                         # The key's carry is lost with the round: later
                         # segments have no initial state to check from.
-                        self._carry[seg.key] = "unknown"
-                        self._record_locked(seg, {"valid": "unknown",
-                                                  "error": "round failed"},
-                                            None)
+                        self._streams[stream].carry[seg.key] = "unknown"
+                        self._record_locked(
+                            stream, seg,
+                            {"valid": "unknown", "error": "round failed"},
+                            None)
 
-    def _take_ready(self) -> list[KeySegment]:
-        """Pop every pending segment whose key has no earlier pending
-        segment (per-key in-order; ready keys batch together)."""
-        ready: list[KeySegment] = []
-        taken_keys: set = set()
-        rest: list[KeySegment] = []
-        for seg in sorted(self._pending, key=lambda s: s.seq):
-            if seg.key in taken_keys:
-                rest.append(seg)
-            else:
-                taken_keys.add(seg.key)
-                ready.append(seg)
+    def _take_ready(self) -> list[tuple]:
+        """Pop every pending segment whose (stream, key) has no earlier
+        pending segment (per-key in-order; ready keys batch together,
+        across streams). ``max_ready_per_stream`` caps one stream's
+        contribution per round — deferred segments keep strict per-key
+        order (a capped-out key blocks its later segments too)."""
+        ready: list[tuple] = []
+        seen_keys: set = set()   # (stream, key) seen this pass
+        per_stream: dict = {}
+        rest: list[tuple] = []
+        cap = self.max_ready_per_stream
+        for stream, seg in sorted(self._pending,
+                                  key=lambda t: t[1].seq):
+            dk = (stream, seg.key)
+            if dk in seen_keys:
+                rest.append((stream, seg))
+                continue
+            seen_keys.add(dk)
+            if cap is not None and per_stream.get(stream, 0) >= cap:
+                rest.append((stream, seg))
+                continue
+            per_stream[stream] = per_stream.get(stream, 0) + 1
+            ready.append((stream, seg))
         self._pending = rest
         return ready
 
     # -- deciding ------------------------------------------------------------
 
-    def _decide_round(self, ready: list[KeySegment], done: set) -> None:
+    def _decide_round(self, ready: list[tuple], done: set) -> None:
         with _flight.phase(self.flight, "online.dispatch"):
             members, results, durs, oracle_idx, engine, oracle_span = \
                 self._dispatch_round(ready, done)
@@ -390,7 +552,7 @@ class SegmentScheduler:
         oracle_set = set(oracle_idx)
         with _flight.phase(self.flight, "online.fold"):
             i = 0
-            for seg, encs in members:
+            for stream, seg, encs in members:
                 rs = results[i:i + len(encs)]
                 # Segments no member of which reached the oracle were
                 # decided wholly by the stage-1 host enumerator — label
@@ -405,28 +567,30 @@ class SegmentScheduler:
                      oracle_span if k in oracle_set else None)
                     for k in range(i, i + len(encs))]
                 i += len(encs)
-                self._fold_segment(seg, encs, rs, seg_wall, seg_engine,
-                                   member_spans=member_spans)
+                self._fold_segment(stream, seg, encs, rs, seg_wall,
+                                   seg_engine, member_spans=member_spans)
                 done.add(id(seg))
 
-    def _dispatch_round(self, ready: list[KeySegment], done: set):
+    def _dispatch_round(self, ready: list[tuple], done: set):
         # Build members; segments whose carry is lost fold unknown now.
-        members = []  # (seg, [EncodedHistory ...]) in ready order
-        for seg in ready:
-            carried = self._carry.get(seg.key)
+        members = []  # (stream, seg, [EncodedHistory ...]) ready order
+        for stream, seg in ready:
+            carried = self._streams[stream].carry.get(seg.key)
             if carried == "unknown":
                 with self._lock:
                     self._record_locked(
-                        seg, {"valid": "unknown",
-                              "info": "carried state unknown"}, None)
+                        stream, seg,
+                        {"valid": "unknown",
+                         "info": "carried state unknown"}, None)
                 done.add(id(seg))
                 continue
             encs = encode_segment(self.model, seg, carried)
-            members.append((seg, encs))
+            members.append((stream, seg, encs))
         if not members:
             return members, [], [], [], "none", None
-        flat = [e for _seg, encs in members for e in encs]
-        seg_of = [seg for seg, encs in members for _ in encs]
+        flat = [e for _s, _seg, encs in members for e in encs]
+        seg_of = [seg for _s, seg, encs in members for _ in encs]
+        stream_of = [s for s, _seg, encs in members for _ in encs]
         # Stage 1: non-terminal members decide via the exhaustive
         # enumerator — one BFS yields both the verdict and the carried
         # end-state set, so the common valid path never pays a second
@@ -462,9 +626,10 @@ class SegmentScheduler:
             if col is not None:
                 # The oracle span covers the whole engine call (one
                 # batched device program can decide members of MANY
-                # segments); member spans point at it via oracle_span,
-                # and the span id rides as `trace_span` tags on the
-                # kernel chunk events emitted inside the call.
+                # segments, across MANY streams); member spans point at
+                # it via oracle_span, and the span id rides as
+                # `trace_span` tags on the kernel chunk events emitted
+                # inside the call.
                 oracle_span = col.mint_id()
             tag_cm = (jtrace.span_tags(trace_span=oracle_span)
                       if oracle_span is not None
@@ -501,6 +666,24 @@ class SegmentScheduler:
                                 "detail": r}
         else:
             engine = "host" if self.engine == "auto" else self.engine
+        if self.metrics is not None:
+            # One point per dispatch round: the co-batching telemetry
+            # the service's fairness/occupancy assertions (and the
+            # service_streams bench leg) read — which streams shared
+            # this round, and which reached the oracle's single batched
+            # program.
+            per_round: dict[str, int] = {}
+            per_segs: dict[str, int] = {}
+            for s, _seg, encs in members:
+                per_round[str(s)] = per_round.get(str(s), 0) + len(encs)
+                per_segs[str(s)] = per_segs.get(str(s), 0) + 1
+            self.metrics.event(
+                "online_round", t=round(_time.time(), 6),
+                members=len(flat), segments=len(members), engine=engine,
+                streams=per_round, stream_segments=per_segs,
+                oracle_members=len(oracle_idx),
+                oracle_streams=sorted(
+                    {str(stream_of[i]) for i in oracle_idx}))
         return members, results, durs, oracle_idx, engine, oracle_span
 
     def _decide_device(self, encs: list) -> list[dict]:
@@ -518,9 +701,10 @@ class SegmentScheduler:
                                                       metrics=self.metrics)
         return results
 
-    def _fold_segment(self, seg: KeySegment, encs, member_results,
-                      wall_s: float, engine: str,
+    def _fold_segment(self, stream: Any, seg: KeySegment, encs,
+                      member_results, wall_s: float, engine: str,
                       member_spans=None) -> None:
+        st = self._streams[stream]
         valid_states: list = []
         carry_lost = False
         verdicts = []
@@ -552,15 +736,15 @@ class SegmentScheduler:
         else:
             verdict = "unknown"
         refutation = None
-        if verdict is False and self._violation is None:
-            # Witness diagnostics for the FIRST violation only (later
-            # refuted segments just fold; re-deriving a witness per
-            # segment would delay the abort signal the detection
+        if verdict is False and st.violation is None:
+            # Witness diagnostics for the stream's FIRST violation only
+            # (later refuted segments just fold; re-deriving a witness
+            # per segment would delay the abort signal the detection
             # metrics measure). Prefer the oracle detail a refuted
             # member already carries; fall back to one host BFS when
             # the members were stage-1-decided (the enumerator returns
-            # no stuck configs). _violation has a single writer — this
-            # worker thread — so the unlocked read is safe.
+            # no stuck configs). st.violation has a single writer —
+            # this worker thread — so the unlocked read is safe.
             refutation = next(
                 (r.get("detail") for r in member_results
                  if r.get("valid") is False
@@ -601,7 +785,7 @@ class SegmentScheduler:
                     # A lost enumeration on ANY valid member poisons the
                     # whole carry — narrowing to the members that did
                     # enumerate would be unsound.
-                    self._carry[seg.key] = "unknown"
+                    st.carry[seg.key] = "unknown"
                 else:
                     seen = set()
                     uniq = []
@@ -609,19 +793,47 @@ class SegmentScheduler:
                         if s not in seen:
                             seen.add(s)
                             uniq.append(s)
-                    self._carry[seg.key] = uniq
+                    st.carry[seg.key] = uniq
             elif verdict == "unknown":
-                self._carry[seg.key] = "unknown"
-            self._record_locked(seg, {"valid": verdict}, refutation,
-                                wall_s=wall_s, engine=engine,
+                st.carry[seg.key] = "unknown"
+            self._record_locked(stream, seg, {"valid": verdict},
+                                refutation, wall_s=wall_s, engine=engine,
                                 members=len(encs), span_id=sid)
 
     # -- bookkeeping (callers hold the lock) ---------------------------------
 
-    def _record_locked(self, seg: KeySegment, result: dict,
+    def _set_backlog_locked(self, stream: Any) -> None:
+        """Backlog gauge + timeline event after one stream's depth
+        changed (caller holds _lock): the unlabeled total for existing
+        dashboards/the /live poll, THAT stream's {tenant} child (only
+        one stream moves per call — re-setting every tenant's child
+        here would be O(tenants) work under the hot lock), and the
+        stamped online_backlog transition event the idle-gap
+        attribution reads."""
+        if self.metrics is None:
+            return
+        g = self.metrics.gauge(
+            "online_scheduler_backlog",
+            "Segments submitted to the online scheduler and not yet "
+            "decided (unlabeled = all streams; {tenant} children for "
+            "service streams)",
+            labelnames=("tenant",), aggregate=True)
+        n_bl = sum(self._stream_depth.values())
+        g.set(n_bl)
+        if stream != DEFAULT_STREAM:
+            g.labels(tenant=str(stream)).set(
+                self._stream_depth.get(stream, 0))
+        # Stamped transition: the gauge only holds "now", but idle-gap
+        # attribution (starved vs no-work) needs the backlog's value
+        # OVER TIME — the online_backlog event stream is that timeline.
+        self.metrics.event(
+            "online_backlog", t=round(_time.time(), 6), backlog=n_bl)
+
+    def _record_locked(self, stream: Any, seg: KeySegment, result: dict,
                        refutation: Optional[dict], wall_s: float = 0.0,
                        engine: str = "none", members: int = 0,
                        span_id: Optional[str] = None) -> None:
+        st = self._streams[stream]
         row = {
             "seq": seg.seq,
             "key": None if seg.key == SINGLE_KEY else repr(seg.key),
@@ -634,6 +846,8 @@ class SegmentScheduler:
             "members": members,
             "wall_s": round(wall_s, 4),
         }
+        if stream != DEFAULT_STREAM:
+            row["tenant"] = str(stream)
         if result.get("info"):
             row["info"] = result["info"]
         col = self.collector
@@ -647,6 +861,8 @@ class SegmentScheduler:
             # (the collector lock is leaf-level; holding _lock here is
             # safe). See trace.py's module docstring.
             now_ns = _time.monotonic_ns()
+            extra = ({"tenant": str(stream)}
+                     if stream != DEFAULT_STREAM else {})
             col.record(
                 "online.segment", span_id=span_id, stage="segment",
                 start_ns=seg.cut_ns or now_ns, end_ns=now_ns,
@@ -654,17 +870,17 @@ class SegmentScheduler:
                 start_index=seg.start_index, end_index=seg.end_index,
                 terminal=seg.terminal, verdict=str(result.get("valid")),
                 engine=engine, members=members,
-                decide_s=round(wall_s, 6))
+                decide_s=round(wall_s, 6), **extra)
         v = result.get("valid")
-        self._n_decided += 1
+        st.n_decided += 1
         if v is False:
-            self._n_invalid += 1
+            st.n_invalid += 1
         elif v is not True:
-            self._n_unknown += 1
-        if len(self._segments) < self.max_segment_rows:
-            self._segments.append(row)
-        if result.get("valid") is False and self._violation is None:
-            self._violation = {
+            st.n_unknown += 1
+        if len(st.segments) < self.max_segment_rows:
+            st.segments.append(row)
+        if v is False and st.violation is None:
+            st.violation = {
                 "segment": dict(row),
                 "refutation": {
                     k: refutation.get(k)
@@ -672,36 +888,46 @@ class SegmentScheduler:
                               "stuck_configs")
                 } if refutation else None,
             }
-            cb = self.on_violation
+            if stream != DEFAULT_STREAM:
+                st.violation["tenant"] = str(stream)
+            if self._violation is None:
+                self._violation = st.violation
+            cb = st.on_violation
             if cb is not None:
                 try:
-                    cb(self._violation)
+                    cb(st.violation)
                 except Exception:  # noqa: BLE001
                     LOG.warning("on_violation callback failed",
                                 exc_info=True)
         # Per-key queue depth (the /live view): this segment is decided.
-        d = self._key_depth.get(seg.key, 1) - 1
+        dk = (stream, seg.key)
+        d = self._key_depth.get(dk, 1) - 1
         if d <= 0:
-            self._key_depth.pop(seg.key, None)
+            self._key_depth.pop(dk, None)
         else:
-            self._key_depth[seg.key] = d
-        # Watermark: advance over the contiguous fully-decided prefix.
-        before = self._watermark
-        left = self._seq_outstanding.get(seg.seq, 0) - 1
-        self._seq_outstanding[seg.seq] = left
-        while self._seq_outstanding.get(self._next_seq) == 0:
-            self._watermark = max(self._watermark,
-                                  self._seq_end[self._next_seq])
-            del self._seq_outstanding[self._next_seq]
-            del self._seq_end[self._next_seq]
-            self._next_seq += 1
-        if self._watermark > before and self.on_watermark is not None:
+            self._key_depth[dk] = d
+        sd = self._stream_depth.get(stream, 1) - 1
+        if sd <= 0:
+            self._stream_depth.pop(stream, None)
+        else:
+            self._stream_depth[stream] = sd
+        # Watermark: advance over the stream's contiguous fully-decided
+        # prefix.
+        before = st.watermark
+        left = st.seq_outstanding.get(seg.seq, 0) - 1
+        st.seq_outstanding[seg.seq] = left
+        while st.seq_outstanding.get(st.next_seq) == 0:
+            st.watermark = max(st.watermark, st.seq_end[st.next_seq])
+            del st.seq_outstanding[st.next_seq]
+            del st.seq_end[st.next_seq]
+            st.next_seq += 1
+        if st.watermark > before and st.on_watermark is not None:
             # Called with the scheduler lock held (documented in the
-            # ctor): the monitor's handler takes only its own latency
-            # lock, so the op decision-latency histogram observes at
-            # the exact moment coverage lands.
+            # ctor): the monitor's/service's handler takes only its own
+            # latency lock, so the op decision-latency histogram
+            # observes at the exact moment coverage lands.
             try:
-                self.on_watermark(self._watermark)
+                st.on_watermark(st.watermark)
             except Exception:  # noqa: BLE001 - observers never sink us
                 LOG.warning("on_watermark callback failed", exc_info=True)
         if self.metrics is not None:
@@ -710,28 +936,43 @@ class SegmentScheduler:
                 "Segments decided by the online monitor, by verdict",
                 labelnames=("verdict",)).labels(
                     verdict=str(result.get("valid"))).inc()
-            self.metrics.gauge(
+            wg = self.metrics.gauge(
                 "online_decided_watermark",
                 "Highest history index through which the online verdict "
-                "is decided").set(self._watermark)
-            n_bl = sum(self._key_depth.values())
-            self.metrics.gauge(
-                "online_scheduler_backlog",
-                "Segments submitted to the online scheduler and not yet "
-                "decided").set(n_bl)
-            # Decrement-side timeline point (see submit()): gap
-            # attribution reads backlog-over-time, not just the gauge.
-            self.metrics.event(
-                "online_backlog", t=round(_time.time(), 6), backlog=n_bl)
+                "is decided (unlabeled = the monitor's stream; {tenant} "
+                "children for service streams)",
+                labelnames=("tenant",), aggregate=True)
+            if stream == DEFAULT_STREAM:
+                wg.set(st.watermark)
+            else:
+                wg.labels(tenant=str(stream)).set(st.watermark)
+                self.metrics.counter(
+                    "service_segments_total",
+                    "Service-stream segments decided, by tenant and "
+                    "verdict",
+                    labelnames=("tenant", "verdict")).labels(
+                        tenant=str(stream),
+                        verdict=str(result.get("valid"))).inc()
+            self._set_backlog_locked(stream)
+
+    def _stream_fold_locked(self, stream: Any, st: _StreamState) -> Any:
+        if st.n_invalid:
+            return False
+        if (st.n_unknown or st.seq_outstanding or self._dead
+                or self._inflight_by_stream.get(stream)):
+            return "unknown"
+        return True
 
     def _fold_locked(self) -> Any:
-        # merge_valid over EVERY decided segment, via counters — the
-        # display table is bounded, the fold must not be. Submitted but
-        # not-yet-decided segments (a close() that timed out mid-round)
-        # fold unknown: a definite True must cover the whole stream.
-        if self._n_invalid:
+        # merge_valid over EVERY decided segment of EVERY stream, via
+        # counters — the display tables are bounded, the fold must not
+        # be. Submitted but not-yet-decided segments (a close() that
+        # timed out mid-round) fold unknown: a definite True must cover
+        # the whole stream.
+        if any(st.n_invalid for st in self._streams.values()):
             return False
-        if (self._n_unknown or self._inflight or self._seq_outstanding
-                or self._dead):
+        if (self._inflight or self._dead
+                or any(st.n_unknown or st.seq_outstanding
+                       for st in self._streams.values())):
             return "unknown"
         return True

@@ -1,0 +1,46 @@
+"""Always-on multi-tenant checking service (ROADMAP item 3).
+
+A resident :class:`Service` ingests many concurrent tenant streams
+(ndjson-over-HTTP via :mod:`jepsen_tpu.service.http`, or the in-process
+``Service.submit(tenant, op)`` seam), segments each live with one
+``online`` segmenter per tenant, and co-batches ready segments ACROSS
+tenants onto the shared PR-2 batched device pipeline through one
+:class:`~jepsen_tpu.online.scheduler.SegmentScheduler` — P-composition
+makes keys independent, and tenants are one more independence axis, so
+the batch fills from whoever has work while per-tenant verdict, carry,
+and watermark isolation hold (the co-batching contract, pinned
+differentially in tests/test_service.py).
+
+Production controls: admission (``max_tenants``, per-tenant ops/s
+quota), bounded per-tenant ingest queues with blocking or 429-style
+reject backpressure, per-tenant round fairness, per-tenant
+abort-on-violation isolation, and a graceful ``drain`` returning
+per-tenant partial verdicts. CLI: ``python -m jepsen_tpu.service``.
+See docs/service.md.
+"""
+
+from __future__ import annotations
+
+from .service import (  # noqa: F401
+    AdmissionError,
+    IngestQueueFullError,
+    QuotaExceededError,
+    Service,
+    ServiceClosedError,
+    ServiceConfig,
+    ServiceError,
+    TenantAbortedError,
+    TenantLimitError,
+)
+
+__all__ = [
+    "AdmissionError",
+    "IngestQueueFullError",
+    "QuotaExceededError",
+    "Service",
+    "ServiceClosedError",
+    "ServiceConfig",
+    "ServiceError",
+    "TenantAbortedError",
+    "TenantLimitError",
+]

@@ -1,0 +1,142 @@
+"""ndjson-over-HTTP ingestion for the multi-tenant checking service.
+
+Reuses the ``web.py`` server machinery (``ThreadingHTTPServer`` + a
+handler factory closed over the state it serves) for the WRITE side the
+results browser never needed:
+
+- ``POST /submit/<tenant>`` — body is ndjson, one history op per line
+  (the interpreter's scheduler-dict shape: ``{"type": "invoke",
+  "process": 0, "f": "write", "value": 1, "time": ...}``). Ops are fed
+  in order through ``Service.submit``; the response reports how many
+  lines were accepted. A typed rejection maps to its HTTP status
+  (quota/queue-full → 429, draining → 503, aborted tenant → 409) with
+  ``{"error": <code>, "accepted": <n>}`` so the client knows exactly
+  where to resume.
+- ``GET /`` / ``GET /tenants`` — the service's live snapshot (per-tenant
+  watermark, backlog, verdict, decision-latency quantiles) as JSON.
+- ``GET /healthz`` — liveness.
+- ``POST /drain`` — graceful shutdown: folds every tenant's partial
+  verdict and returns the per-tenant results document.
+
+The service also registers itself on the results browser's ``/live``
+feed (``ServiceConfig.register_live``), so the ingestion port carries
+only the ingest API while dashboards keep polling the web server.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import unquote
+
+from .service import Service, ServiceError
+
+LOG = logging.getLogger("jepsen.service")
+
+# Largest POST body accepted (bytes). The per-tenant queue bounds are
+# useless if one request can buffer an arbitrary body in RAM first —
+# a bigger stream is just more requests (the response's `accepted`
+# count is the client's resume cursor anyway).
+MAX_BODY_BYTES = 8 << 20
+
+
+def make_handler(service: Service, max_body: int = MAX_BODY_BYTES):
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, fmt, *args):  # quiet
+            LOG.debug(fmt, *args)
+
+        def _json(self, code: int, doc: dict) -> None:
+            body = json.dumps(doc, sort_keys=True,
+                              default=str).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            path = unquote(self.path)
+            try:
+                if path in ("/", "/tenants", "/tenants/"):
+                    self._json(200, service.live_snapshot())
+                elif path == "/healthz":
+                    self._json(200, {"ok": True,
+                                     "service": service.name})
+                else:
+                    self._json(404, {"error": "not_found"})
+            except Exception as e:  # noqa: BLE001 - never 500 silently
+                LOG.warning("error serving %s", path, exc_info=True)
+                self._json(500, {"error": "internal",
+                                 "detail": f"{type(e).__name__}: {e}"})
+
+        def do_POST(self):
+            path = unquote(self.path)
+            try:
+                if path.startswith("/submit/"):
+                    tenant = path[len("/submit/"):].strip("/")
+                    self._submit(tenant)
+                elif path in ("/drain", "/drain/"):
+                    self._json(200, service.drain())
+                else:
+                    self._json(404, {"error": "not_found"})
+            except Exception as e:  # noqa: BLE001
+                LOG.warning("error serving %s", path, exc_info=True)
+                self._json(500, {"error": "internal",
+                                 "detail": f"{type(e).__name__}: {e}"})
+
+        def _submit(self, tenant: str) -> None:
+            length = int(self.headers.get("Content-Length") or 0)
+            if length > max_body:
+                self._json(413, {
+                    "error": "body_too_large", "tenant": tenant,
+                    "accepted": 0, "max_bytes": max_body,
+                    "detail": "split the stream into smaller POSTs; "
+                              "`accepted` is the resume cursor"})
+                return
+            body = self.rfile.read(length)
+            accepted = 0
+            for line in body.splitlines():
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    op = json.loads(line)
+                except ValueError:
+                    self._json(400, {
+                        "error": "bad_json", "tenant": tenant,
+                        "accepted": accepted,
+                        "detail": "unparseable ndjson line"})
+                    return
+                try:
+                    service.submit(tenant, op)
+                except ServiceError as e:
+                    # Typed rejection: the client resumes after
+                    # `accepted` lines (quota/backpressure are
+                    # retryable 429s; aborted/draining are not).
+                    self._json(e.http_status, {
+                        "error": e.code, "tenant": tenant,
+                        "accepted": accepted, "detail": str(e),
+                        "retryable": e.http_status == 429})
+                    return
+                accepted += 1
+            self._json(200, {"tenant": tenant, "accepted": accepted})
+
+    return Handler
+
+
+def server(service: Service, port: int = 0) -> ThreadingHTTPServer:
+    """Build (without starting) the ingestion server — tests drive
+    this; port 0 binds an ephemeral port."""
+    return ThreadingHTTPServer(("", port), make_handler(service))
+
+
+def serve(service: Service, port: int = 8089) -> None:
+    """Serve forever (the ``jepsen_tpu.service`` CLI's daemon mode)."""
+    srv = server(service, port)
+    LOG.info("Service %s ingesting on http://0.0.0.0:%d",
+             service.name, srv.server_address[1])
+    print(f"Service {service.name} ingesting on "
+          f"http://0.0.0.0:{srv.server_address[1]} "
+          "(POST /submit/<tenant>, POST /drain, GET /tenants)")
+    srv.serve_forever()

@@ -1,0 +1,686 @@
+"""The always-on multi-tenant checking service.
+
+One resident :class:`Service` accepts many concurrent tenant streams
+(ndjson-over-HTTP via ``jepsen_tpu.service.http``, or the in-process
+:meth:`Service.submit` seam tests and the simulated generator use),
+runs one ``online`` segmenter per tenant, and feeds ONE shared
+:class:`~jepsen_tpu.online.scheduler.SegmentScheduler` whose dispatch
+loop co-batches ready (segment × carried-state) members *across
+tenants* into the PR-2 batched device pipeline — the "distinct keys
+pipeline" generalized to distinct tenants, so device batches fill from
+whoever has work while each tenant keeps its own in-order fold,
+watermark, and verdict (the co-batching contract: sharing a batch
+never changes a verdict; tests/test_service.py pins it differentially
+against offline ``check_history`` per tenant).
+
+Production controls:
+
+- **Admission**: at most ``max_tenants`` concurrent streams
+  (:class:`TenantLimitError`), a per-tenant ops/s token bucket
+  (:class:`QuotaExceededError`) — both typed, both HTTP-429-mappable.
+- **Backpressure**: every tenant's ingest queue is BOUNDED
+  (``queue_limit``); when the pump falls behind, ``backpressure=
+  "reject"`` raises :class:`IngestQueueFullError` (the 429 path) and
+  ``"block"`` makes :meth:`submit` wait up to ``block_timeout_s`` —
+  memory never grows unboundedly. The pump additionally stops draining
+  a tenant whose undecided scheduler backlog passed
+  ``max_inflight_segments``, so pressure propagates ingest-ward
+  instead of piling segments behind the device.
+- **Fairness**: per-(tenant, key) in-order dispatch guarantees every
+  tenant with ready work lands in every round; ``max_ready_per_tenant``
+  caps a flooding tenant's share of any single round.
+- **Isolation on violation**: with ``abort_on_violation`` a tenant
+  whose stream folds invalid is ABORTED — further submits raise
+  :class:`TenantAbortedError`, ``ops_to_detection`` /
+  ``seconds_to_detection`` are recorded — while every other tenant's
+  stream keeps deciding undisturbed (``--online-abort`` semantics,
+  scoped to one tenant).
+- **Graceful drain**: :meth:`drain` stops admission, flushes the
+  queues, folds each tenant's terminal segment, and returns per-tenant
+  partial results (verdict, watermark, decision-latency summary,
+  violation witness), appending one ledger record per tenant stream.
+
+Telemetry rides the existing stack: ``online_scheduler_backlog`` /
+``online_decided_watermark`` grow ``{tenant}`` children next to their
+unlabeled totals, ``decision_latency_seconds`` is registered with a
+``{tenant}`` label family plus the aggregate, ``online_round`` events
+carry the per-round stream mix (the co-batching assertion), and
+``live_snapshot()`` feeds the web ``/live`` page one row per tenant.
+See docs/service.md.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+import time as _time
+from collections import deque
+from dataclasses import dataclass, replace
+from typing import Any, Optional
+
+from ..online.scheduler import SegmentScheduler
+from ..online.segmenter import Segmenter
+from ..telemetry.registry import DECISION_LATENCY_BUCKETS, Histogram
+
+LOG = logging.getLogger("jepsen.service")
+
+
+# ---------------------------------------------------------------------------
+# Typed rejections (the ingestion layer maps these to HTTP statuses).
+
+
+class ServiceError(Exception):
+    """Base class of every typed service rejection."""
+
+    http_status = 400
+    code = "service_error"
+
+
+class ServiceClosedError(ServiceError):
+    """The service is draining or closed — no new work is admitted."""
+
+    http_status = 503
+    code = "draining"
+
+
+class AdmissionError(ServiceError):
+    """Admission control rejected the submit (the 429 family)."""
+
+    http_status = 429
+    code = "admission"
+
+
+class TenantLimitError(AdmissionError):
+    code = "tenant_limit"
+
+
+class QuotaExceededError(AdmissionError):
+    code = "quota_exceeded"
+
+
+class IngestQueueFullError(AdmissionError):
+    code = "ingest_queue_full"
+
+
+class TenantAbortedError(ServiceError):
+    """The tenant's stream folded invalid with abort armed."""
+
+    http_status = 409
+    code = "tenant_aborted"
+
+
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class ServiceConfig:
+    """Service-wide policy knobs (every tenant shares them)."""
+
+    engine: str = "auto"
+    max_tenants: int = 64
+    # ops/s admitted per tenant; None = unlimited. The bucket's burst
+    # defaults to two seconds' worth of quota.
+    quota_ops_per_s: Optional[float] = None
+    quota_burst: Optional[float] = None
+    queue_limit: int = 4096
+    backpressure: str = "reject"  # "reject" (429) | "block"
+    block_timeout_s: float = 30.0
+    abort_on_violation: bool = False
+    max_configs: int = 500_000
+    batch_f: int = 256
+    # Fairness: max segments one tenant contributes to a single
+    # scheduler round (see SegmentScheduler.max_ready_per_stream).
+    max_ready_per_tenant: int = 64
+    # Flow control: the pump stops draining a tenant whose undecided
+    # scheduler backlog passed this high-water mark, so the bounded
+    # ingest queue (not the scheduler) absorbs the flood.
+    max_inflight_segments: int = 512
+    register_live: bool = True  # expose live_snapshot on web /live
+    ledger: bool = True  # append one record per tenant stream on drain
+    store_root: Optional[str] = None
+
+    def __post_init__(self):
+        if self.backpressure not in ("reject", "block"):
+            raise ValueError(
+                f"backpressure must be 'reject' or 'block', "
+                f"got {self.backpressure!r}")
+        if self.max_tenants < 1:
+            raise ValueError("max_tenants must be >= 1")
+
+
+class _Tenant:
+    """One tenant stream's service-side state."""
+
+    def __init__(self, name: str, cfg: ServiceConfig):
+        self.name = name
+        self.queue: "queue.Queue" = queue.Queue(maxsize=cfg.queue_limit)
+        self.segmenter = Segmenter()
+        self.aborted = threading.Event()
+        self.lock = threading.Lock()       # counters + token bucket
+        self.lat_lock = threading.Lock()   # leaf: pending-latency deque
+        self.lat_pending: "deque[tuple[int, int]]" = deque()
+        self.ops_ingested = 0   # accepted into the queue
+        self.ops_observed = 0   # fed through the segmenter
+        # Segments the closed scheduler refused (a drain-deadline race):
+        # the ops are observed but their verdict contribution is lost,
+        # so a definite True can no longer cover the stream.
+        self.lost_segments = False
+        self.rejected = {"quota": 0, "queue": 0, "aborted": 0}
+        self.detection: Optional[dict] = None
+        self.t0 = _time.monotonic()
+        self.registered_at = _time.time()
+        # Token bucket (guarded by self.lock).
+        self.allowance = float(cfg.quota_burst
+                               if cfg.quota_burst is not None
+                               else (cfg.quota_ops_per_s or 0) * 2.0)
+        self.last_refill = _time.monotonic()
+
+
+class Service:
+    """The resident daemon: ``submit(tenant, op)`` in, per-tenant
+    verdicts out, one shared device pipeline underneath."""
+
+    def __init__(self, model, config: Optional[ServiceConfig] = None,
+                 *, metrics=None, collector=None, flight=None,
+                 name: str = "service", **overrides) -> None:
+        cfg = config or ServiceConfig()
+        if overrides:
+            cfg = replace(cfg, **overrides)
+        self.model = model
+        self.config = cfg
+        self.metrics = metrics
+        self.name = name
+        self._tenants: dict[str, _Tenant] = {}
+        self._tlock = threading.Lock()
+        self._draining = False
+        self._drain_lock = threading.Lock()
+        self._finished: Optional[dict] = None
+        self._t0 = _time.monotonic()
+        self.scheduler = SegmentScheduler(
+            model, engine=cfg.engine, metrics=metrics,
+            max_configs=cfg.max_configs, batch_f=cfg.batch_f,
+            collector=collector, flight=flight,
+            max_ready_per_stream=cfg.max_ready_per_tenant)
+        # ONE decision-latency histogram family: the aggregate child is
+        # the service-wide summary, {tenant} children the per-tenant
+        # p99s the bench leg and /live rows report.
+        _help = ("Per-op lag from observed invocation to decided-"
+                 "watermark coverage, by tenant (unlabeled = all "
+                 "tenants)")
+        self._lat = (
+            metrics.histogram("decision_latency_seconds", _help,
+                              labelnames=("tenant",),
+                              buckets=DECISION_LATENCY_BUCKETS,
+                              aggregate=True)
+            if metrics is not None else
+            Histogram("decision_latency_seconds", _help,
+                      labelnames=("tenant",),
+                      buckets=DECISION_LATENCY_BUCKETS, aggregate=True))
+        self._wake = threading.Event()
+        self._pump_stop = threading.Event()
+        self._pump_thread = threading.Thread(
+            target=self._pump, name="jepsen-service-pump", daemon=True)
+        self._pump_thread.start()
+        if cfg.register_live:
+            try:
+                from .. import web
+
+                web.register_live_source(self.name, self.live_snapshot)
+            except Exception:  # noqa: BLE001 - observability only
+                LOG.warning("could not register live source",
+                            exc_info=True)
+
+    # -- admission -----------------------------------------------------------
+
+    def register(self, tenant: str) -> None:
+        """Admit a tenant explicitly (submit() auto-admits). Raises
+        :class:`ServiceClosedError` / :class:`TenantLimitError`."""
+        self._admit(tenant)
+
+    def _admit(self, tenant: str) -> _Tenant:
+        if not isinstance(tenant, str) or not tenant:
+            raise ServiceError(f"invalid tenant name {tenant!r}")
+        with self._tlock:
+            if self._draining:
+                raise ServiceClosedError("service is draining")
+            t = self._tenants.get(tenant)
+            if t is not None:
+                return t
+            if len(self._tenants) >= self.config.max_tenants:
+                raise TenantLimitError(
+                    f"max_tenants={self.config.max_tenants} reached; "
+                    f"tenant {tenant!r} rejected")
+            t = self._tenants[tenant] = _Tenant(tenant, self.config)
+            self.scheduler.register_stream(
+                tenant,
+                on_watermark=lambda w, _t=t: self._on_watermark(_t, w),
+                on_violation=lambda v, _t=t: self._on_violation(_t, v))
+            if self.metrics is not None:
+                self.metrics.gauge(
+                    "service_tenants",
+                    "Tenant streams currently admitted").set(
+                        len(self._tenants))
+            return t
+
+    def _take_token(self, t: _Tenant) -> None:
+        rate = self.config.quota_ops_per_s
+        if rate is None:
+            return
+        with t.lock:
+            now = _time.monotonic()
+            burst = (self.config.quota_burst
+                     if self.config.quota_burst is not None
+                     else rate * 2.0)
+            t.allowance = min(burst,
+                              t.allowance + (now - t.last_refill) * rate)
+            t.last_refill = now
+            if t.allowance < 1.0:
+                t.rejected["quota"] += 1
+                self._count_reject(t, "quota")
+                raise QuotaExceededError(
+                    f"tenant {t.name!r} over its {rate} ops/s quota")
+            t.allowance -= 1.0
+
+    def _count_reject(self, t: _Tenant, reason: str) -> None:
+        if self.metrics is not None:
+            self.metrics.counter(
+                "service_rejects_total",
+                "Submits rejected by admission control / backpressure",
+                labelnames=("tenant", "reason")).labels(
+                    tenant=t.name, reason=reason).inc()
+
+    # -- ingestion -----------------------------------------------------------
+
+    def submit(self, tenant: str, op: Any) -> None:
+        """Accept one history op for ``tenant`` (auto-admitting it).
+        Raises the typed rejections documented on the class; an
+        accepted op WILL be fed through the tenant's segmenter (unless
+        drain's deadline truncates the stream — reported per tenant as
+        ``undelivered_ops``)."""
+        t = self._admit(tenant)
+        if t.aborted.is_set():
+            t.rejected["aborted"] += 1
+            self._count_reject(t, "aborted")
+            raise TenantAbortedError(
+                f"tenant {t.name!r} aborted on a linearizability "
+                "violation")
+        self._take_token(t)
+        # The ingest timestamp rides the queue with the op: decision
+        # latency must include queue wait (a flow-controlled tenant's
+        # ops CAN sit here for seconds — a p99 stamped at pump-feed
+        # time would hide exactly the regression the benchcmp gate
+        # watches).
+        item = (op, _time.monotonic_ns())
+        try:
+            if self.config.backpressure == "block":
+                t.queue.put(item, timeout=self.config.block_timeout_s)
+            else:
+                t.queue.put_nowait(item)
+        except queue.Full:
+            t.rejected["queue"] += 1
+            self._count_reject(t, "queue")
+            raise IngestQueueFullError(
+                f"tenant {t.name!r} ingest queue full "
+                f"({self.config.queue_limit} ops)") from None
+        with t.lock:
+            t.ops_ingested += 1
+        self._wake.set()
+
+    # -- the pump ------------------------------------------------------------
+
+    # Ops drained per tenant per sweep: small enough that a flooding
+    # tenant cannot monopolize the pump between a trickle tenant's
+    # visits, large enough to amortize the sweep.
+    PUMP_BATCH = 256
+
+    def _pump(self) -> None:
+        # Single consumer for every tenant queue: offers ops to each
+        # tenant's segmenter IN ORDER and submits closed segments to
+        # the shared scheduler. Exception-guarded — a pump death stops
+        # consumption, which the bounded queues turn into backpressure
+        # rather than silent loss.
+        try:
+            while not self._pump_stop.is_set():
+                if not self._pump_once():
+                    self._wake.wait(0.05)
+                    self._wake.clear()
+        except Exception:  # noqa: BLE001
+            LOG.error("service pump died; ingest queues will fill",
+                      exc_info=True)
+
+    def _pump_once(self) -> bool:
+        """One round-robin sweep over the tenants; returns whether any
+        op moved."""
+        with self._tlock:
+            tenants = list(self._tenants.values())
+        moved = False
+        for t in tenants:
+            # Flow control: a tenant whose undecided segments passed
+            # the high-water mark stops being drained — its bounded
+            # queue fills and submit() pushes back — EXCEPT while
+            # draining, when the goal is to finish what was accepted.
+            if (not self._draining
+                    and self.scheduler.stream_backlog(t.name)
+                    >= self.config.max_inflight_segments):
+                continue
+            for _ in range(self.PUMP_BATCH):
+                try:
+                    item = t.queue.get_nowait()
+                except queue.Empty:
+                    break
+                moved = True
+                self._feed(t, item)
+        return moved
+
+    def _feed(self, t: _Tenant, item: tuple) -> None:
+        op, t_ns = item
+        try:
+            segs = t.segmenter.offer(op)
+        except Exception:  # noqa: BLE001 - one tenant's malformed op
+            # must never kill the shared pump (ingest is an external
+            # surface); the op is counted and dropped, the stream's
+            # already-accepted prefix keeps deciding.
+            LOG.warning("tenant %s: dropping malformed op", t.name,
+                        exc_info=True)
+            with t.lock:
+                t.ops_observed += 1
+                t.rejected["malformed"] = (
+                    t.rejected.get("malformed", 0) + 1)
+            self._count_reject(t, "malformed")
+            return
+        last = t.segmenter.last_op
+        if last is not None and last.is_client and last.is_invoke:
+            # The pump is the single feeder, so appends land in index
+            # order — the watermark pop loop's invariant. Stamped with
+            # the INGEST time carried through the queue, and appended
+            # BEFORE the scheduler submit so a fast decide can't fire
+            # the watermark past an invocation not yet pending.
+            with t.lat_lock:
+                t.lat_pending.append((last.index, t_ns))
+        if segs:
+            try:
+                self.scheduler.submit(segs, stream=t.name)
+            except RuntimeError:
+                # Scheduler closed (worker died / drain raced): these
+                # segments are LOST — mark the stream so drain degrades
+                # a would-be definite True to unknown (it no longer
+                # covers the whole stream); the pump must survive.
+                t.lost_segments = True
+                LOG.warning("scheduler rejected segments of tenant %s",
+                            t.name)
+        # Counted observed only AFTER any segments were submitted:
+        # flush()'s "everything accepted is decided" reads
+        # ops_observed == ops_ingested, then waits for scheduler
+        # idleness — counting earlier would let flush return between
+        # the count and the submit.
+        with t.lock:
+            t.ops_observed += 1
+
+    # -- scheduler hooks (worker thread, scheduler lock held) ----------------
+
+    def _on_watermark(self, t: _Tenant, w: int) -> None:
+        now_ns = _time.monotonic_ns()
+        with t.lat_lock:
+            while t.lat_pending and t.lat_pending[0][0] <= w:
+                _idx, t_ns = t.lat_pending.popleft()
+                lat = max(now_ns - t_ns, 0) / 1e9
+                self._lat.observe(lat)  # aggregate (all tenants)
+                self._lat.labels(tenant=t.name).observe(lat)
+
+    def _on_violation(self, t: _Tenant, violation: dict) -> None:
+        with t.lock:
+            if t.detection is None:
+                t.detection = {
+                    "ops_to_detection": t.ops_observed,
+                    "seconds_to_detection": round(
+                        _time.monotonic() - t.t0, 4),
+                }
+        if self.config.abort_on_violation:
+            LOG.warning(
+                "service tenant %s hit a linearizability violation "
+                "(segment seq %s); aborting that tenant",
+                t.name, violation.get("segment", {}).get("seq"))
+            t.aborted.set()
+
+    # -- observation ---------------------------------------------------------
+
+    def tenants(self) -> list[str]:
+        with self._tlock:
+            return sorted(self._tenants)
+
+    def tenant_snapshot(self, tenant: str) -> Optional[dict]:
+        with self._tlock:
+            t = self._tenants.get(tenant)
+        if t is None:
+            return None
+        ss = self.scheduler.stream_stats(t.name)
+        with t.lat_lock:
+            undecided = len(t.lat_pending)
+        with t.lock:
+            snap = {
+                "ops_ingested": t.ops_ingested,
+                "ops_observed": t.ops_observed,
+                "rejected": dict(t.rejected),
+            }
+        snap.update({
+            "queue_depth": t.queue.qsize(),
+            "watermark": ss.get("decided_through_index"),
+            "backlog": ss.get("backlog"),
+            "segments_decided": ss.get("segments_decided"),
+            "verdict": str(ss.get("verdict")),
+            "undecided_ops": undecided,
+            "aborted": t.aborted.is_set(),
+            "decision_latency": self._lat.stats(
+                labels={"tenant": t.name}),
+        })
+        if t.detection is not None:
+            snap.update(t.detection)
+        return snap
+
+    def live_snapshot(self) -> dict:
+        """One point-in-time operational view — the web ``/live``
+        line: service totals plus one row per tenant (watermark,
+        queue/backlog depths, verdict, per-tenant decision latency).
+        Tenants are listed in REGISTRATION order (stable across
+        polls)."""
+        with self._tlock:
+            items = sorted(self._tenants.items(),
+                           key=lambda kv: kv[1].registered_at)
+        rows = {name: self.tenant_snapshot(name) for name, _t in items}
+        totals_obs = sum((r or {}).get("ops_observed") or 0
+                         for r in rows.values())
+        return {
+            "run": self.name,
+            "service": True,
+            "t": round(_time.time(), 3),
+            "draining": self._draining,
+            "tenant_count": len(rows),
+            "ops_observed": totals_obs,
+            "scheduler_backlog": self.scheduler.backlog,
+            "queue_depths": self.scheduler.queue_depths(),
+            "decision_latency": self._lat.stats(),
+            "tenants": rows,
+        }
+
+    # -- drain / shutdown ----------------------------------------------------
+
+    def flush(self, timeout: Optional[float] = None) -> bool:
+        """Block until every accepted op has been fed through its
+        segmenter AND the scheduler has decided everything submitted —
+        the tests'/bench's sync point (drain() is the terminal one)."""
+        deadline = ((_time.monotonic() + timeout)
+                    if timeout is not None else None)
+        while True:
+            with self._tlock:
+                tenants = list(self._tenants.values())
+            settled = all(t.queue.qsize() == 0 for t in tenants)
+            if settled:
+                for t in tenants:
+                    with t.lock:
+                        if t.ops_observed != t.ops_ingested:
+                            settled = False
+                            break
+            if settled and self.scheduler.wait_idle(0.05):
+                return True
+            self._wake.set()
+            if deadline is not None and _time.monotonic() > deadline:
+                return False
+            _time.sleep(0.002)
+
+    def drain(self, timeout: Optional[float] = 120.0) -> dict:
+        """Graceful shutdown: stop admitting, flush every tenant's
+        queue through its segmenter, fold the terminal segments, close
+        the shared scheduler, and return per-tenant partial results.
+        Idempotent — a second (or concurrent: the CLI's Ctrl-C racing
+        an HTTP POST /drain) call returns the first result."""
+        with self._drain_lock:
+            return self._drain_locked(timeout)
+
+    def _drain_locked(self, timeout: Optional[float]) -> dict:
+        if self._finished is not None:
+            return self._finished
+        deadline = ((_time.monotonic() + timeout)
+                    if timeout is not None else None)
+        with self._tlock:
+            self._draining = True
+            tenants = list(self._tenants.values())
+        # Stop the pump and flush the accepted backlog synchronously:
+        # deterministic in-order feeding per tenant, immune to a
+        # stalled/dead pump, and the scheduler keeps deciding
+        # concurrently underneath. The pump MUST actually be gone
+        # before drain touches the segmenters — two concurrent feeders
+        # would corrupt them — so if it outlives the deadline (a
+        # pathologically slow sweep), the sync flush and the terminal
+        # fold are SKIPPED; the unfed ops surface as undelivered_ops.
+        self._pump_stop.set()
+        self._wake.set()
+        while self._pump_thread.is_alive():
+            self._pump_thread.join(0.1)
+            if deadline is not None and _time.monotonic() > deadline:
+                break
+        pump_gone = not self._pump_thread.is_alive()
+        if not pump_gone:
+            LOG.warning("service pump still running at the drain "
+                        "deadline; skipping the synchronous flush")
+        for t in (tenants if pump_gone else ()):
+            # Anything still queued past the deadline is reported,
+            # never silently dropped.
+            while True:
+                if deadline is not None and _time.monotonic() > deadline:
+                    break
+                try:
+                    item = t.queue.get_nowait()
+                except queue.Empty:
+                    break
+                self._feed(t, item)
+            tail = t.segmenter.finish()
+            if tail:
+                try:
+                    self.scheduler.submit(tail, stream=t.name)
+                except RuntimeError:
+                    LOG.warning("scheduler closed before tenant %s's "
+                                "terminal segment", t.name)
+        left = (max(deadline - _time.monotonic(), 1.0)
+                if deadline is not None else None)
+        self.scheduler.close(timeout=left)
+        wall = _time.monotonic() - self._t0
+        results: dict[str, dict] = {}
+        for t in tenants:
+            res = self.scheduler.stream_result(t.name)
+            lat = self._lat.stats(labels={"tenant": t.name})
+            with t.lat_lock:
+                lat["undecided_ops"] = len(t.lat_pending)
+            with t.lock:
+                out = {
+                    "valid": res["valid"],
+                    "ops_ingested": t.ops_ingested,
+                    "ops_observed": t.ops_observed,
+                    "rejected": dict(t.rejected),
+                }
+                # Count-based, not a queue-size snapshot: an op whose
+                # blocked put() raced past the flush (or one stranded
+                # by a skipped flush) is ACCEPTED-but-unfed and must
+                # surface here, not vanish.
+                undelivered = t.ops_ingested - t.ops_observed
+            out.update({
+                "decided_through_index": res["decided_through_index"],
+                "segments_decided": res["segments_decided"],
+                "aborted": t.aborted.is_set(),
+                "decision_latency": lat,
+                "segments": res["segments"],
+            })
+            if undelivered > 0:
+                out["undelivered_ops"] = undelivered
+                # A queue truncated by the drain deadline means the
+                # verdict covers only the observed prefix.
+                out["info"] = ("drain deadline truncated the stream; "
+                               "verdict covers the observed prefix")
+            if t.lost_segments and out["valid"] is True:
+                # Segments were dropped at a closed scheduler: a
+                # definite True must cover the whole stream, and this
+                # one cannot. (An invalid verdict stands — the
+                # refutation evidence is real regardless.)
+                out["valid"] = "unknown"
+                out["info"] = ("segments lost after scheduler close; "
+                               "verdict degraded to unknown")
+            if t.detection is not None:
+                out.update(t.detection)
+            if res.get("violation") is not None:
+                out["violation"] = res["violation"]
+            results[t.name] = out
+        if self.config.register_live:
+            try:
+                from .. import web
+
+                web.unregister_live_source(self.name)
+            except Exception:  # noqa: BLE001
+                pass
+        fin = {
+            "service": self.name,
+            "tenants": results,
+            "tenant_count": len(results),
+            "wall_s": round(wall, 3),
+            "valid": self._merge(results),
+            # Service-wide latency (the aggregate child): the bench
+            # leg's p99 — per-tenant p99s don't compose into it.
+            "decision_latency": self._lat.stats(),
+        }
+        self._finished = fin
+        if self.config.ledger:
+            self._append_ledger(results, wall)
+        return fin
+
+    def _merge(self, results: dict) -> Any:
+        # The one safety-critical fold, shared with every other path
+        # (checker.clj:33-47 priority: False > unknown > True).
+        from ..checker import merge_valid
+
+        return merge_valid(r.get("valid") for r in results.values())
+
+    def _append_ledger(self, results: dict, wall: float) -> None:
+        """One ledger record per tenant stream (kind="service") — the
+        cross-run trend the /runs page and `ledger --check` gate."""
+        try:
+            from ..telemetry import ledger as jledger
+
+            path = jledger.default_path(self.config.store_root)
+            engine = self.config.engine
+            for tenant, r in results.items():
+                rec = {
+                    "kind": "service",
+                    "run": f"{self.name}/{tenant}",
+                    "workload": "service_stream",
+                    "engine": engine,
+                    "ops": r.get("ops_observed"),
+                    "verdict": str(r.get("valid")),
+                }
+                if wall > 0 and r.get("ops_observed"):
+                    rec["ops_per_s"] = round(
+                        r["ops_observed"] / wall, 1)
+                p99 = (r.get("decision_latency") or {}).get("p99_s")
+                if p99 is not None:
+                    rec["p99_decision_latency_s"] = p99
+                jledger.append(rec, path=path)
+        except Exception:  # noqa: BLE001 - the ledger never sinks drain
+            LOG.warning("service ledger append failed", exc_info=True)

@@ -128,19 +128,33 @@ class _HistogramChild:
 
 
 class Metric:
-    """One named metric; holds a child per label-value tuple."""
+    """One named metric; holds a child per label-value tuple.
+
+    ``aggregate=True`` on a *labeled* metric additionally keeps one
+    unlabeled child (stored under the empty label tuple, so it exports
+    as the plain ``name`` series next to the ``name{label=...}``
+    family) that the metric-level ``inc``/``set``/``observe`` methods
+    operate on — the "keep the unlabeled total for existing
+    dashboards" pattern the per-tenant service metrics use
+    (``online_scheduler_backlog`` et al.)."""
 
     kind = "untyped"
 
     def __init__(self, name: str, help: str = "",
-                 labelnames: Sequence[str] = ()):
+                 labelnames: Sequence[str] = (), aggregate: bool = False):
         self.name = name
         self.help = help
         self.labelnames = tuple(labelnames)
+        self.aggregate = bool(aggregate)
         self._lock = threading.Lock()
         self._children: dict[tuple, Any] = {}
         if not self.labelnames:
             self._default = self.labels()
+        elif self.aggregate:
+            # The empty key sorts (and exports) first; zip(labelnames,
+            # ()) renders it with labels {} — i.e. the unlabeled total.
+            self._default = self._children.setdefault(
+                (), self._make_child())
 
     def _make_child(self):
         raise NotImplementedError
@@ -221,12 +235,13 @@ class Histogram(Metric):
     kind = "histogram"
 
     def __init__(self, name, help="", labelnames=(),
-                 buckets: Sequence[float] = DEFAULT_BUCKETS):
+                 buckets: Sequence[float] = DEFAULT_BUCKETS,
+                 aggregate: bool = False):
         b = tuple(sorted(float(x) for x in buckets if x != float("inf")))
         if not b:
             raise ValueError("histogram needs at least one finite bucket")
         self.buckets = b
-        super().__init__(name, help, labelnames)
+        super().__init__(name, help, labelnames, aggregate=aggregate)
 
     def _make_child(self):
         return _HistogramChild(self._lock, self.buckets)
@@ -238,12 +253,14 @@ class Histogram(Metric):
         """Estimated q-quantile of the (unlabeled) default child."""
         return self._default.quantile(q)
 
-    def stats(self, quantiles: Sequence[float] = (0.5, 0.9, 0.99)
-              ) -> dict:
+    def stats(self, quantiles: Sequence[float] = (0.5, 0.9, 0.99),
+              labels: Optional[dict] = None) -> dict:
         """Count/sum plus interpolated quantiles of the default child —
         the ``{"count", "sum_s", "p50_s", ...}`` summary block
-        online.json and the bench legs embed."""
-        child = self._default
+        online.json and the bench legs embed. ``labels`` selects a
+        specific labeled child instead (the service's per-tenant
+        decision-latency summaries)."""
+        child = self._default if labels is None else self.labels(**labels)
         with child._lock:
             counts = list(child.counts)
             out: dict = {"count": child.count,
@@ -274,32 +291,45 @@ class Registry:
         self._last_by_name: dict[str, dict] = {}
         self.created_at = _time.time()
 
-    def _get_or_make(self, cls, name, help, labelnames, **extra) -> Any:
+    def _get_or_make(self, cls, name, help, labelnames, aggregate=False,
+                     **extra) -> Any:
         with self._lock:
             m = self._metrics.get(name)
             if m is None:
                 m = self._metrics[name] = cls(name, help, labelnames,
-                                              **extra)
+                                              aggregate=aggregate, **extra)
                 return m
         if not isinstance(m, cls) or m.labelnames != tuple(labelnames):
             raise ValueError(
                 f"metric {name} already registered as {m.kind} with "
                 f"labels {m.labelnames}")
+        if aggregate and not m.aggregate:
+            # A labeled metric registered WITHOUT the unlabeled total
+            # cannot grow one later — the already-exported series would
+            # silently change shape mid-run.
+            raise ValueError(
+                f"metric {name} already registered without an "
+                "aggregate child")
         return m
 
     def counter(self, name: str, help: str = "",
-                labelnames: Sequence[str] = ()) -> Counter:
-        return self._get_or_make(Counter, name, help, labelnames)
+                labelnames: Sequence[str] = (),
+                aggregate: bool = False) -> Counter:
+        return self._get_or_make(Counter, name, help, labelnames,
+                                 aggregate=aggregate)
 
     def gauge(self, name: str, help: str = "",
-              labelnames: Sequence[str] = ()) -> Gauge:
-        return self._get_or_make(Gauge, name, help, labelnames)
+              labelnames: Sequence[str] = (),
+              aggregate: bool = False) -> Gauge:
+        return self._get_or_make(Gauge, name, help, labelnames,
+                                 aggregate=aggregate)
 
     def histogram(self, name: str, help: str = "",
                   labelnames: Sequence[str] = (),
-                  buckets: Sequence[float] = DEFAULT_BUCKETS) -> Histogram:
+                  buckets: Sequence[float] = DEFAULT_BUCKETS,
+                  aggregate: bool = False) -> Histogram:
         m = self._get_or_make(Histogram, name, help, labelnames,
-                              buckets=buckets)
+                              aggregate=aggregate, buckets=buckets)
         want = tuple(sorted(float(x) for x in buckets
                             if x != float("inf")))
         if m.buckets != want:

@@ -42,15 +42,28 @@ LOG = logging.getLogger("jepsen.web")
 # the store root) can reach in-flight runs registered by core.run.
 
 _LIVE_LOCK = threading.Lock()
-_LIVE_SOURCES: dict[str, Callable[[], dict]] = {}
+# key -> (registration ordinal, snapshot fn). The ordinal pins a STABLE
+# registration-order listing: with many concurrent runs/services a
+# poller must see the same row order every poll, and re-registering a
+# key (a replaced source) must keep its original slot rather than
+# jump to the end.
+_LIVE_SOURCES: dict[str, tuple[int, Callable[[], dict]]] = {}
+_LIVE_SEQ = 0
 
 
 def register_live_source(key: str, fn: Callable[[], dict]) -> None:
     """Expose ``fn()`` (a dict snapshot, e.g. ``OnlineMonitor.
-    live_snapshot``) as one ``/live`` line under ``key`` until
-    unregistered. Re-registering a key replaces its source."""
+    live_snapshot`` or ``Service.live_snapshot``) as one ``/live`` line
+    under ``key`` until unregistered. Re-registering a key replaces its
+    source in place (the listing slot is the FIRST registration's)."""
+    global _LIVE_SEQ
     with _LIVE_LOCK:
-        _LIVE_SOURCES[key] = fn
+        prev = _LIVE_SOURCES.get(key)
+        if prev is not None:
+            _LIVE_SOURCES[key] = (prev[0], fn)
+        else:
+            _LIVE_SOURCES[key] = (_LIVE_SEQ, fn)
+            _LIVE_SEQ += 1
 
 
 def unregister_live_source(key: str) -> None:
@@ -59,10 +72,13 @@ def unregister_live_source(key: str) -> None:
 
 
 def live_snapshots() -> list[dict]:
-    """One snapshot dict per registered source; a source that raises
-    yields an ``{"error": ...}`` line instead of sinking the poll."""
+    """One snapshot dict per registered source, in registration order;
+    a source that raises yields an ``{"error": ...}`` line instead of
+    sinking the poll."""
     with _LIVE_LOCK:
-        items = list(_LIVE_SOURCES.items())
+        items = [(key, fn) for key, (order, fn)
+                 in sorted(_LIVE_SOURCES.items(),
+                           key=lambda kv: kv[1][0])]
     out = []
     for key, fn in items:
         try:
@@ -580,17 +596,49 @@ async function tick() {
       box.innerHTML = runs.map(r => {
         const lat = r.decision_latency || {};
         const stall = (r.watermark_stall_seconds || 0) > 0;
-        return '<h2>' + (r.run || '?') + '</h2>' +
-          '<p' + (stall ? ' class="stall"' : '') + '>' +
-          'verdict ' + r.verdict +
-          ' · watermark ' + r.decided_through_index +
-          ' / ' + r.ops_observed + ' ops' +
-          ' · backlog ' + r.scheduler_backlog +
-          ' · open ' + r.open_segment_ops + ' ops' +
-          (stall ? ' · STALLED ' + r.watermark_stall_seconds + 's'
-                 : '') +
-          ' · p50/p99 decide ' + lat.p50_s + '/' + lat.p99_s + 's' +
-          '</p><pre>' + JSON.stringify(r, null, 1) + '</pre>';
+        let head;
+        let tenantTable = '';
+        if (r.tenants) {
+          // A multi-tenant service line: per-tenant depth/watermark
+          // rows instead of the single-run monitor fields.
+          head = '<p>' + (r.draining ? 'DRAINING · ' : '') +
+            r.tenant_count + ' tenants' +
+            ' · ' + r.ops_observed + ' ops observed' +
+            ' · backlog ' + r.scheduler_backlog +
+            ' · p50/p99 decide ' + lat.p50_s + '/' + lat.p99_s + 's' +
+            '</p>';
+          tenantTable = '<table><tr><th>tenant</th><th>verdict</th>' +
+            '<th>watermark</th><th>ops</th><th>queue</th>' +
+            '<th>backlog</th><th>undecided</th><th>p99 s</th>' +
+            '<th></th></tr>' +
+            Object.entries(r.tenants).map(([name, t]) => {
+              t = t || {};
+              const tl = t.decision_latency || {};
+              const cls = t.verdict === 'False' ? ' class="stall"' : '';
+              return '<tr' + cls + '><td>' + name + '</td>' +
+                '<td>' + t.verdict + '</td>' +
+                '<td>' + t.watermark + '</td>' +
+                '<td>' + t.ops_observed + '</td>' +
+                '<td>' + t.queue_depth + '</td>' +
+                '<td>' + t.backlog + '</td>' +
+                '<td>' + t.undecided_ops + '</td>' +
+                '<td>' + tl.p99_s + '</td>' +
+                '<td>' + (t.aborted ? 'ABORTED' : '') + '</td></tr>';
+            }).join('') + '</table>';
+        } else {
+          head = '<p' + (stall ? ' class="stall"' : '') + '>' +
+            'verdict ' + r.verdict +
+            ' · watermark ' + r.decided_through_index +
+            ' / ' + r.ops_observed + ' ops' +
+            ' · backlog ' + r.scheduler_backlog +
+            ' · open ' + r.open_segment_ops + ' ops' +
+            (stall ? ' · STALLED ' + r.watermark_stall_seconds + 's'
+                   : '') +
+            ' · p50/p99 decide ' + lat.p50_s + '/' + lat.p99_s + 's' +
+            '</p>';
+        }
+        return '<h2>' + (r.run || '?') + '</h2>' + head + tenantTable +
+          '<pre>' + JSON.stringify(r, null, 1) + '</pre>';
       }).join('');
     }
   } catch (e) { /* server gone: keep polling */ }

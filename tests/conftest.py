@@ -41,6 +41,11 @@ def pytest_configure(config):
         "markers",
         "online: online linearizability monitor tests "
         "(jepsen_tpu.online; select with -m online)")
+    config.addinivalue_line(
+        "markers",
+        "service: multi-tenant checking-service tests "
+        "(jepsen_tpu.service; select with -m service; the device "
+        "co-batch differential is additionally marked slow)")
 
 
 def pytest_addoption(parser):

@@ -110,6 +110,62 @@ class TestRegistry:
         assert evs[-1]["i"] == 24  # newest kept, oldest dropped
 
 
+class TestAggregateMetrics:
+    """Labeled metrics with an unlabeled aggregate child — the
+    per-tenant service families (`online_scheduler_backlog{tenant}`
+    next to the unlabeled total existing dashboards read)."""
+
+    def test_gauge_total_next_to_labeled_children(self):
+        reg = Registry()
+        g = reg.gauge("backlog", "B", labelnames=("tenant",),
+                      aggregate=True)
+        g.set(7)  # the unlabeled total
+        g.labels(tenant="a").set(3)
+        g.labels(tenant="b").set(4)
+        samples = {tuple(sorted(s["labels"].items())): s["value"]
+                   for s in reg.collect() if s["name"] == "backlog"}
+        assert samples == {(): 7.0, (("tenant", "a"),): 3.0,
+                           (("tenant", "b"),): 4.0}
+        # The aggregate sample exports FIRST (stable prom exposition).
+        names = [s["labels"] for s in reg.collect()
+                 if s["name"] == "backlog"]
+        assert names[0] == {}
+
+    def test_histogram_aggregate_and_per_label_stats(self):
+        reg = Registry()
+        h = reg.histogram("lat", "L", labelnames=("tenant",),
+                          buckets=(0.1, 1.0), aggregate=True)
+        for v in (0.05, 0.5):
+            h.observe(v)           # aggregate
+            h.labels(tenant="a").observe(v)
+        assert h.stats()["count"] == 2
+        assert h.stats(labels={"tenant": "a"})["count"] == 2
+        assert h.stats(labels={"tenant": "a"})["p50_s"] is not None
+
+    def test_prometheus_text_renders_both_shapes(self):
+        from jepsen_tpu.telemetry import export
+
+        reg = Registry()
+        g = reg.gauge("backlog", "B", labelnames=("tenant",),
+                      aggregate=True)
+        g.set(5)
+        g.labels(tenant="a").set(5)
+        text = export.prometheus_text(reg)
+        assert "backlog 5\n" in text
+        assert 'backlog{tenant="a"} 5' in text
+        assert text.count("# TYPE backlog gauge") == 1
+
+    def test_re_registering_without_aggregate_is_compatible(self):
+        reg = Registry()
+        g = reg.gauge("x", "X", labelnames=("t",), aggregate=True)
+        assert reg.gauge("x", "X", labelnames=("t",)) is g
+        # ...but a plain labeled metric cannot grow an aggregate child
+        # later (the exported series would change shape mid-run).
+        reg.gauge("y", "Y", labelnames=("t",))
+        with pytest.raises(ValueError):
+            reg.gauge("y", "Y", labelnames=("t",), aggregate=True)
+
+
 class TestExposition:
     def _golden_registry(self):
         reg = Registry()

@@ -259,6 +259,61 @@ class TestMetricsQuantileRendering:
             web.unregister_live_source("bad")
             web.unregister_live_source("ok")
 
+    def test_listing_is_stable_registration_order(self, get):
+        # Many concurrent runs must list in REGISTRATION order on every
+        # poll, and re-registering a key must keep its ORIGINAL slot —
+        # a dashboard's rows may never shuffle under a replace.
+        web.register_live_source("run-b", lambda: {"x": "b"})
+        web.register_live_source("run-a", lambda: {"x": "a"})
+        web.register_live_source("run-c", lambda: {"x": "c"})
+        try:
+            order = [json.loads(l)["run"]
+                     for l in get("/live")[2].splitlines()]
+            assert order == ["run-b", "run-a", "run-c"]
+            web.register_live_source("run-b", lambda: {"x": "b2"})
+            lines = [json.loads(l) for l in get("/live")[2].splitlines()]
+            assert [l["run"] for l in lines] == \
+                ["run-b", "run-a", "run-c"]
+            assert lines[0]["x"] == "b2"  # replaced in place
+        finally:
+            for k in ("run-a", "run-b", "run-c"):
+                web.unregister_live_source(k)
+
+    def test_service_snapshot_serves_per_tenant_rows(self, get):
+        import random
+
+        from jepsen_tpu.service import Service
+        from jepsen_tpu.telemetry import Registry
+
+        svc = Service(CasRegister(init=0), engine="host",
+                      metrics=Registry(), name="live-svc",
+                      ledger=False)  # register_live defaults on
+        try:
+            h = chunked_register_history(random.Random(33), n_ops=60,
+                                         n_procs=2, chunk_ops=30)
+            for op in h:
+                svc.submit("ten-a", op)
+            for op in h:
+                svc.submit("ten-b", op)
+            assert svc.flush(30.0)
+            lines = {json.loads(l)["run"]: json.loads(l)
+                     for l in get("/live")[2].splitlines()}
+            line = lines["live-svc"]
+            assert line["service"] is True
+            assert set(line["tenants"]) == {"ten-a", "ten-b"}
+            row = line["tenants"]["ten-a"]
+            assert row["verdict"] == "True"
+            assert row["watermark"] >= 0
+            assert "queue_depth" in row and "backlog" in row
+            assert "p99_s" in row["decision_latency"]
+            # The dashboard knows how to render the tenant table.
+            body = get("/live.html")[2]
+            assert "tenant" in body and "r.tenants" in body
+        finally:
+            svc.drain(timeout=30)
+        # Drain unregistered the service's live source.
+        assert json.loads(get("/live")[2]) == {"live_runs": 0}
+
     def test_monitor_snapshot_serves_as_live_line(self, get):
         import random
 
