@@ -446,7 +446,21 @@ def main() -> int:
         # higher) and the service-wide `p99_decision_latency_s`
         # (invoke→watermark-covered, lower). `co_batched_rounds`
         # evidences the cross-tenant batch fill.
+        #
+        # Chaos coverage (fault-tolerance PR): the leg ALWAYS runs with
+        # ONE injected transient device fault at the oracle-dispatch
+        # seam — the scheduler retries/fails the round over to host
+        # re-dispatch, so `sustained_ops_per_s` is by construction the
+        # RECOVERED throughput, `failovers` counts the demoted rounds
+        # (benchcmp records `service_failovers_total` as info), and
+        # `valid_all` proves the fault cost latency, never a verdict.
         _REC.begin("service_streams")
+        # Imported OUTSIDE the try: the finally's _chaos.reset() must
+        # be evaluable even when the try fails at its first import —
+        # an unbound _chaos would turn one failed section into a
+        # NameError that kills the whole bench (no JSON line at all).
+        from jepsen_tpu.testing import chaos as _chaos
+
         try:
             import threading as _threading
 
@@ -454,13 +468,27 @@ def main() -> int:
             from jepsen_tpu.telemetry import Registry as _SReg
             from jepsen_tpu.testing import chunked_register_history
 
+            from jepsen_tpu.history import History as _History
+
             n_t = 4
             per_tenant = max(N_OPS // n_t, 500)
-            histories = {
-                f"tenant-{i}": chunked_register_history(
+            histories = {}
+            for i in range(n_t):
+                base = list(chunked_register_history(
                     random.Random(3100 + i), n_ops=per_tenant,
-                    n_procs=4, chunk_ops=60)
-                for i in range(n_t)}
+                    n_procs=4, chunk_ops=60))
+                # Poison quiescence near the end (ok write -> :info, a
+                # crashed-but-applied write — still valid): the tail
+                # becomes a real terminal segment, so the closing round
+                # actually reaches the ORACLE — the seam the injected
+                # fault fires at (a fully quiescent stream is decided
+                # entirely by the stage-1 enumerator and would never
+                # cross it).
+                k = next(j for j in range(int(len(base) * 0.9),
+                                          len(base))
+                         if base[j].is_ok and base[j].f == "write")
+                base[k] = base[k].with_(type="info")
+                histories[f"tenant-{i}"] = _History(base, reindex=True)
             sreg = _SReg()
             svc = Service(model, engine="host", metrics=sreg,
                           register_live=False, ledger=False,
@@ -473,16 +501,25 @@ def main() -> int:
 
             feeders = [_threading.Thread(target=_drive, args=(n,))
                        for n in histories]
-            for th in feeders:
-                th.start()
-            for th in feeders:
-                th.join()
-            svc.flush(180.0)
-            fin = svc.drain(timeout=180)
+            # on_call=1: the FIRST oracle round faults (the host-engine
+            # leg crosses the seam only when members reach the oracle —
+            # terminal segments co-batch into very few rounds, so a
+            # later ordinal might never fire).
+            with _chaos.inject("device.dispatch", mode="raise",
+                               on_call=1):
+                for th in feeders:
+                    th.start()
+                for th in feeders:
+                    th.join()
+                svc.flush(180.0)
+                fin = svc.drain(timeout=180)
             t_total = time.perf_counter() - t0
             n_total = sum(len(h) for h in histories.values())
             lat = fin.get("decision_latency") or {}
             rounds = sreg.events("online_round")
+            failovers = int(sreg.counter(
+                "service_failovers_total",
+                labelnames=("engine",), aggregate=True).value)
             out["service_streams"] = {
                 "tenants": n_t,
                 "n_ops_total": n_total,
@@ -499,9 +536,16 @@ def main() -> int:
                     1 for ev in rounds if len(ev["streams"]) >= 2),
                 "max_tenants_per_round": max(
                     (len(ev["streams"]) for ev in rounds), default=0),
+                "chaos_injected_faults": _chaos.fired(
+                    "device.dispatch"),
+                "failovers": failovers,
+                "failover_rounds": sum(
+                    1 for ev in rounds if ev.get("failover")),
             }
         except Exception as e:  # noqa: BLE001
             out["service_streams"] = {"error": f"{type(e).__name__}: {e}"}
+        finally:
+            _chaos.reset()
 
         # --- Device sections, costliest-compile last, each budgeted ----
         # A wedged TPU relay hangs the FIRST jax op forever (not an

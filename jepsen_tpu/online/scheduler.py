@@ -80,7 +80,9 @@ from typing import Any, Callable, Optional
 
 from .. import trace as jtrace
 from ..models import Model
+from ..parallel import resilience as _resilience
 from ..telemetry import flight as _flight
+from ..testing import chaos as _chaos
 from .segmenter import (
     SINGLE_KEY,
     KeySegment,
@@ -101,9 +103,11 @@ class _StreamState:
 
     __slots__ = ("carry", "seq_outstanding", "seq_end", "next_seq",
                  "watermark", "n_decided", "n_invalid", "n_unknown",
-                 "violation", "segments", "on_watermark", "on_violation")
+                 "violation", "segments", "on_watermark", "on_violation",
+                 "on_segment", "carry_poisoned")
 
-    def __init__(self, on_watermark=None, on_violation=None):
+    def __init__(self, on_watermark=None, on_violation=None,
+                 on_segment=None):
         # key -> carried decoded-state list; absent = model's own init
         # (None member sentinel); "unknown" = carry lost.
         self.carry: dict[Any, Any] = {}
@@ -118,6 +122,16 @@ class _StreamState:
         self.segments: list[dict] = []  # bounded display rows
         self.on_watermark = on_watermark
         self.on_violation = on_violation
+        # on_segment(row, key, carry, watermark): fired under _lock for
+        # EVERY decided segment — the service's crash-safe verdict
+        # journal writes its record here, inside the fold lock, so a
+        # journaled watermark never runs ahead of the fold state.
+        self.on_segment = on_segment
+        # A journal replay that could not round-trip some key's carry
+        # sets this: every future segment of the stream dispatches
+        # with a LOST carry (folds unknown) — checking an unknown key
+        # from the model's init state could wrongly refute.
+        self.carry_poisoned = False
 
 
 class SegmentScheduler:
@@ -203,6 +217,13 @@ class SegmentScheduler:
         self._inflight = 0
         self._inflight_by_stream: dict[Any, int] = {}
         self._cnt_lock = threading.Lock()
+        # Worker self-healing (fault-tolerance PR): one bounded restart
+        # before the terminal _dead fold. _round_taken / _requeue are
+        # the crash-recovery breadcrumbs the restart reconciles.
+        self._restarts_left = 1
+        self._saw_close = False
+        self._round_taken: Optional[list] = None
+        self._requeue: Optional[tuple] = None
         self._thread = threading.Thread(
             target=self._run, name="jepsen-online-scheduler", daemon=True)
         self._thread.start()
@@ -211,22 +232,67 @@ class SegmentScheduler:
 
     def register_stream(self, stream: Any,
                         on_watermark: Optional[Callable] = None,
-                        on_violation: Optional[Callable] = None) -> None:
+                        on_violation: Optional[Callable] = None,
+                        on_segment: Optional[Callable] = None) -> None:
         """Declare a stream (idempotent for hookless re-registration)
-        and attach its watermark/violation hooks. Hooks fire from the
-        worker thread with the scheduler lock held, like the ctor's."""
+        and attach its watermark/violation/segment hooks. Hooks fire
+        from the worker thread with the scheduler lock held, like the
+        ctor's."""
         with self._lock:
             st = self._streams.get(stream)
             if st is None:
                 self._streams[stream] = _StreamState(on_watermark,
-                                                     on_violation)
-            elif on_watermark is not None or on_violation is not None:
+                                                     on_violation,
+                                                     on_segment)
+            elif (on_watermark is not None or on_violation is not None
+                  or on_segment is not None):
                 if st.n_decided or st.seq_outstanding:
                     raise RuntimeError(
                         f"stream {stream!r} already has work; hooks must "
                         "be registered before the first submit")
                 st.on_watermark = on_watermark or st.on_watermark
                 st.on_violation = on_violation or st.on_violation
+                st.on_segment = on_segment or st.on_segment
+
+    def restore_stream(self, stream: Any, *, watermark: int = -1,
+                       next_seq: int = 0,
+                       carry: Optional[dict] = None,
+                       n_decided: int = 0, n_invalid: int = 0,
+                       n_unknown: int = 0,
+                       violation: Optional[dict] = None,
+                       segments: Optional[list] = None,
+                       carry_poisoned: bool = False,
+                       on_watermark: Optional[Callable] = None,
+                       on_violation: Optional[Callable] = None,
+                       on_segment: Optional[Callable] = None) -> None:
+        """Seed one stream's fold state from a replayed verdict journal
+        (service restart): the restored watermark/seq counter resume
+        where the journaled fold left off, ``carry`` maps each key to
+        its journaled end-state list (or ``"unknown"`` where the carry
+        was lost — including keys the journal could not round-trip),
+        and the verdict counters reproduce the journaled fold. Must run
+        before the stream's first submit; the restored fold obeys the
+        same one-sided contract (a restored ``n_unknown`` keeps the
+        stream from ever folding definite-True it didn't earn)."""
+        with self._lock:
+            st = self._streams.get(stream)
+            if st is not None and (st.n_decided or st.seq_outstanding):
+                raise RuntimeError(
+                    f"stream {stream!r} already has work; restore must "
+                    "precede the first submit")
+            st = _StreamState(on_watermark, on_violation, on_segment)
+            st.watermark = watermark
+            st.next_seq = next_seq
+            st.carry = dict(carry or {})
+            st.n_decided = n_decided
+            st.n_invalid = n_invalid
+            st.n_unknown = n_unknown
+            st.violation = violation
+            st.segments = list(segments or [])[:self.max_segment_rows]
+            st.carry_poisoned = bool(carry_poisoned)
+            self._streams[stream] = st
+            if violation is not None and self._violation is None:
+                self._violation = violation
 
     def submit(self, segments: list[KeySegment],
                stream: Any = DEFAULT_STREAM) -> None:
@@ -414,10 +480,27 @@ class SegmentScheduler:
         # own recovery (ingest, bookkeeping, even _record_locked inside
         # the recovery handler) must not kill the worker with _idle
         # cleared — that would wedge wait_idle()/close() (and bench's
-        # pacing loop) forever. Death folds every stream unknown
-        # (_dead), never a definite True over undecided ops.
+        # pacing loop) forever. A first crash is RECOVERED from
+        # (bounded: once — a crash loop must still converge to the
+        # honest unknown): the interrupted round's already-ingested
+        # segments re-drain, a popped-but-uningested batch is requeued,
+        # and the loop re-enters. A second crash — or one mid-shutdown
+        # — is terminal: death folds every stream unknown (_dead),
+        # never a definite True over undecided ops.
         try:
-            self._run_loop()
+            while True:
+                try:
+                    self._run_loop()
+                    return
+                except Exception:  # noqa: BLE001 - recovery below
+                    if self._restarts_left <= 0 or self._saw_close:
+                        raise
+                    self._restarts_left -= 1
+                    LOG.warning(
+                        "online scheduler worker crashed; restarting "
+                        "(%d restart(s) left)", self._restarts_left,
+                        exc_info=True)
+                    self._recover_after_crash()
         except Exception:  # noqa: BLE001 - the monitor must survive
             LOG.warning("online scheduler worker died; streams fold "
                         "unknown", exc_info=True)
@@ -442,13 +525,79 @@ class SegmentScheduler:
                 self._inflight_by_stream.clear()
             self._idle.set()
 
+    def _recover_after_crash(self) -> None:
+        """Reconcile after a worker crash, before re-entering the loop
+        (the bounded-restart satellite). A batch popped from the inbox
+        but not (fully) ingested is ingested NOW — never requeued at
+        the back of the inbox, where a later batch of the same
+        (stream, key) would overtake it and dispatch from the wrong
+        carried state (per-key in-order is a soundness invariant, not
+        a fairness nicety). Segments of it that a PARTIAL ingest
+        already appended to _pending are skipped (identity dedup): a
+        duplicate would be re-dispatched after the first copy's fold
+        replaced the key's carry with its own end states, and
+        re-checking the same ops from their final state can REFUTE a
+        valid history — False outranks unknown in the fold, so this is
+        a verdict flip, not a degradation. Everything the crashed
+        round had ingested re-drains here; the round's taken batches
+        then release their in-flight counts exactly as the round would
+        have."""
+        item, self._requeue = self._requeue, None
+        taken, self._round_taken = self._round_taken or [], None
+        if item is not None:
+            stream, batch = item
+            already = {id(s) for st2, s in self._pending
+                       if st2 == stream}
+            remaining = [s for s in batch if id(s) not in already]
+            if remaining:
+                self._ingest(stream, remaining)
+            taken.append(stream)
+        if self.metrics is not None:
+            self.metrics.counter(
+                "online_worker_restarts_total",
+                "Online scheduler worker threads restarted after a "
+                "crash (bounded; a second crash folds streams "
+                "unknown)").inc()
+        # Re-drain what the crashed round left pending. A crash HERE
+        # propagates to the terminal death path (restarts are spent).
+        with _flight.phase(self.flight, "online.drain"):
+            self._drain_ready()
+        self._release_taken(taken)
+
+    def _release_taken(self, taken: list) -> None:
+        """Release the in-flight counts of one round's taken batches
+        and fire the idle event when everything submitted has been
+        decided — shared by the normal round end and crash recovery
+        (ONE copy of the accounting, so the rarely-exercised recovery
+        path cannot drift)."""
+        with self._cnt_lock:
+            self._inflight -= len(taken)
+            for s in taken:
+                left = self._inflight_by_stream.get(s, 1) - 1
+                if left <= 0:
+                    self._inflight_by_stream.pop(s, None)
+                else:
+                    self._inflight_by_stream[s] = left
+            if self._inflight <= 0:
+                self._inflight = 0
+                self._idle.set()
+
     def _run_loop(self) -> None:
         while True:
             item = self._inbox.get()
             taken: list = []  # streams of the batches taken this round
+            self._round_taken = taken
             closing = item is None
+            if closing:
+                self._saw_close = True
             if not closing:
+                # Crash breadcrumb: until ingest completes, this batch
+                # exists only in this local — a restart must requeue
+                # it, not leak its in-flight count.
+                self._requeue = item
+                _chaos.fire("scheduler.worker")
                 self._ingest(*item)
+                self._requeue = None
                 taken.append(item[0])
                 # Opportunistically drain everything already queued so
                 # one round sees the widest possible batch.
@@ -459,8 +608,11 @@ class SegmentScheduler:
                         break
                     if more is None:
                         closing = True
+                        self._saw_close = True
                         break
+                    self._requeue = more
                     self._ingest(*more)
+                    self._requeue = None
                     taken.append(more[0])
             # The drain phase sits OUTSIDE _drain_ready's recovery
             # catch: a crash inside a round crosses (and errors) only
@@ -475,20 +627,14 @@ class SegmentScheduler:
             # decided". On close, everything submitted before the
             # marker has now been decided, so the in-flight count
             # (undecidedness for the fold) zeros outright.
-            with self._cnt_lock:
-                if closing:
+            if closing:
+                with self._cnt_lock:
                     self._inflight = 0
                     self._inflight_by_stream.clear()
-                else:
-                    self._inflight -= len(taken)
-                    for s in taken:
-                        left = self._inflight_by_stream.get(s, 1) - 1
-                        if left <= 0:
-                            self._inflight_by_stream.pop(s, None)
-                        else:
-                            self._inflight_by_stream[s] = left
-                if self._inflight == 0:
                     self._idle.set()
+            else:
+                self._release_taken(taken)
+            self._round_taken = None
             if closing:
                 return
 
@@ -575,7 +721,9 @@ class SegmentScheduler:
         # Build members; segments whose carry is lost fold unknown now.
         members = []  # (stream, seg, [EncodedHistory ...]) ready order
         for stream, seg in ready:
-            carried = self._streams[stream].carry.get(seg.key)
+            st = self._streams[stream]
+            carried = ("unknown" if st.carry_poisoned
+                       else st.carry.get(seg.key))
             if carried == "unknown":
                 with self._lock:
                     self._record_locked(
@@ -616,11 +764,24 @@ class SegmentScheduler:
             else:
                 results[idx] = r
         oracle_span = None
+        failover = False
         if oracle_idx:
             engine = self.engine
             if engine == "auto":
                 engine = ("device" if self.model.device_capable
                           and len(oracle_idx) > 1 else "host")
+            if (engine == "device"
+                    and not _resilience.failover_disabled()
+                    and _resilience.breaker(
+                        "batch", metrics=self.metrics).engaged()):
+                # The batch pipeline's circuit is OPEN: demote the
+                # round up-front — no doomed device attempt, no retry
+                # ladder. engaged() is read-only, so when the cooldown
+                # elapses the round proceeds and the RETRY LAYER's
+                # allow() admits (and owns) the one half-open probe.
+                failover = True
+                self._count_failover("device")
+                engine = "host"
             oracle_encs = [flat[i] for i in oracle_idx]
             col = self.collector
             if col is not None:
@@ -637,14 +798,27 @@ class SegmentScheduler:
             t1 = _time.perf_counter()
             t1_ns = _time.monotonic_ns()
             with tag_cm:
-                if engine == "device":
-                    decided = self._decide_device(oracle_encs)
-                else:
-                    from ..ops import wgl_host
-
-                    decided = [wgl_host.check_encoded(
-                        e, max_configs=self.max_configs)
-                        for e in oracle_encs]
+                try:
+                    decided = self._oracle_call(engine, oracle_encs)
+                except Exception as e:  # noqa: BLE001 - failover below
+                    if _resilience.failover_disabled():
+                        raise
+                    # The round's oracle failed past its own retries
+                    # (device) or outright (host): demote to per-member
+                    # host re-dispatch. Verdicts are never fabricated —
+                    # every member is genuinely re-decided, and a
+                    # member nobody can decide folds unknown, degrading
+                    # definite-True coverage exactly like
+                    # lost_segments.
+                    LOG.warning(
+                        "%s oracle round failed (%s: %s); failing over "
+                        "to per-member host re-dispatch",
+                        engine, type(e).__name__, e)
+                    failover = True
+                    self._count_failover(engine)
+                    with _flight.phase(self.flight, "online.failover"):
+                        decided = self._host_redispatch(oracle_encs)
+                    engine = "host"
             if col is not None:
                 col.record(
                     "online.oracle", start_ns=t1_ns,
@@ -683,8 +857,53 @@ class SegmentScheduler:
                 streams=per_round, stream_segments=per_segs,
                 oracle_members=len(oracle_idx),
                 oracle_streams=sorted(
-                    {str(stream_of[i]) for i in oracle_idx}))
+                    {str(stream_of[i]) for i in oracle_idx}),
+                failover=failover)
         return members, results, durs, oracle_idx, engine, oracle_span
+
+    def _oracle_call(self, engine: str, encs: list) -> list[dict]:
+        """One engine oracle call for a round's members. The
+        ``device.dispatch`` chaos seam fires here for BOTH engines —
+        the injected-fault path the failover exists for is the same
+        whether the oracle is the batched device pipeline or the host
+        check."""
+        _chaos.fire("device.dispatch")
+        if engine == "device":
+            return self._decide_device(encs)
+        from ..ops import wgl_host
+
+        return [wgl_host.check_encoded(e, max_configs=self.max_configs)
+                for e in encs]
+
+    def _host_redispatch(self, encs: list) -> list[dict]:
+        """Failover target: re-dispatch every member of a failed
+        oracle round to the host oracle, individually guarded — one
+        member's failure costs that member an unknown, not the
+        round."""
+        from ..ops import wgl_host
+
+        out = []
+        for e in encs:
+            try:
+                out.append(wgl_host.check_encoded(
+                    e, max_configs=self.max_configs))
+            except Exception:  # noqa: BLE001 - degrade, never fold round
+                LOG.warning("host re-dispatch failed for one member; "
+                            "folding it unknown", exc_info=True)
+                out.append({"valid": "unknown",
+                            "info": "failover re-dispatch failed"})
+        return out
+
+    def _count_failover(self, engine: str) -> None:
+        if self.metrics is not None:
+            c = self.metrics.counter(
+                "service_failovers_total",
+                "Oracle rounds demoted to host re-dispatch (engine "
+                "failure past its retries, or an open circuit), by "
+                "failed engine (unlabeled = all engines)",
+                labelnames=("engine",), aggregate=True)
+            c.inc()  # the unlabeled total (bench/benchcmp read this)
+            c.labels(engine=engine).inc()
 
     def _decide_device(self, encs: list) -> list[dict]:
         """One vmapped batched-escalation program over all members
@@ -954,6 +1173,27 @@ class SegmentScheduler:
                         tenant=str(stream),
                         verdict=str(result.get("valid"))).inc()
             self._set_backlog_locked(stream)
+        cb_seg = st.on_segment
+        if cb_seg is not None:
+            # Fired under _lock — the one recording seam EVERY fold
+            # path crosses (decided, carry-lost, failed-round,
+            # worker-died), so the verdict journal sees every segment
+            # and its journaled watermark can never run ahead of the
+            # fold. The raw key rides alongside the display row (whose
+            # key is repr'd) so replay can round-trip the carry map. A
+            # POISONED stream journals "unknown", never the stale
+            # st.carry entry its dispatch ignored — the file must
+            # carry the state the fold actually used, not the one it
+            # refused (defense in depth: the poisoning evidence also
+            # persists in the file, but a future compaction must not
+            # be one bug away from resurrecting stale carries).
+            try:
+                cb_seg(dict(row), seg.key,
+                       "unknown" if st.carry_poisoned
+                       else st.carry.get(seg.key),
+                       st.watermark)
+            except Exception:  # noqa: BLE001 - journal never sinks fold
+                LOG.warning("on_segment callback failed", exc_info=True)
 
     def _stream_fold_locked(self, stream: Any, st: _StreamState) -> Any:
         if st.n_invalid:

@@ -98,8 +98,34 @@ class Segmenter:
         self._saw_keyless = False
         # The (index-assigned) Op the last offer() consumed — the
         # monitor reads its index/kind for decision-latency tracking
-        # without re-parsing the raw dict. None before the first offer.
+        # without re-parsing the raw dict. None before the first offer
+        # (and after an offer that DROPPED a journal-covered
+        # resubmission).
         self.last_op: Optional[Op] = None
+        # Journal-restore floor (resume()): pre-indexed ops BELOW it
+        # are already covered by the replayed watermark and are
+        # dropped — re-checking them as fresh ops from the restored
+        # post-state carries could wrongly REFUTE a valid history.
+        self._floor = 0
+        self.dropped_covered = 0
+
+    def resume(self, next_index: int, next_seq: int) -> None:
+        """Restart support (the service's verdict journal): continue
+        index assignment and segment numbering where a journaled
+        stream left off, so a reconnecting client's ops land AFTER the
+        replayed watermark and new cuts extend the journaled seq
+        chain. Pre-indexed ops BELOW ``next_index`` are dropped by
+        :meth:`offer` from here on (counted in ``dropped_covered``): a
+        client that resubmits its covered prefix anyway would
+        otherwise have those ops re-checked from the restored
+        POST-state carries, which can refute a valid history — the
+        server enforces the resume protocol instead of trusting it.
+        Must precede the first :meth:`offer`."""
+        if self._buffer or self.ops_seen:
+            raise RuntimeError("resume() must precede the first offer")
+        self._next_index = max(0, int(next_index))
+        self._seq = max(0, int(next_seq))
+        self._floor = self._next_index
 
     @property
     def open_ops(self) -> int:
@@ -117,6 +143,12 @@ class Segmenter:
     @property
     def segments_emitted(self) -> int:
         return self._seq
+
+    @property
+    def next_index(self) -> int:
+        """The index the next unindexed op would be assigned (the
+        journal-lag telemetry reads it)."""
+        return self._next_index
 
     @property
     def mixed_keys(self) -> bool:
@@ -138,8 +170,22 @@ class Segmenter:
 
     def offer(self, op) -> list[KeySegment]:
         """Consume one history op (Op or plain scheduler dict); returns
-        the KeySegments of a newly closed segment, usually ``[]``."""
+        the KeySegments of a newly closed segment, usually ``[]``.
+        After :meth:`resume`, a pre-indexed op below the restored
+        watermark is a journal-covered duplicate: DROPPED (never
+        buffered — ``last_op`` reads None for it), not re-checked."""
+        if isinstance(op, Op):
+            had_index = op.index >= 0
+        else:
+            # Explicit None check, not `or` — index 0 is falsy but
+            # very much an index (the nemesis_interval lesson).
+            _idx = op.get("index") if isinstance(op, dict) else None
+            had_index = isinstance(_idx, int) and _idx >= 0
         op = self._as_op(op)
+        if had_index and op.index < self._floor:
+            self.dropped_covered += 1
+            self.last_op = None
+            return []
         self.last_op = op
         self.ops_seen += 1
         if not op.is_client:

@@ -44,6 +44,8 @@ from ..history import History
 from ..models import Model
 from ..ops import wgl
 from ..ops.encode import EncodedHistory, encode_history
+from ..testing import chaos as _chaos
+from . import resilience as _resilience
 
 
 def _note_host_stack(metrics, F, members: int, wall: float,
@@ -77,6 +79,7 @@ def _put(arrs, mesh=None, batch_axis: str = "dp"):
 def _stack(plans, f: int, dims, mesh=None, batch_axis: str = "dp"):
     """Stack per-history arg tuples (+ fresh frontiers) along a new leading
     batch axis and shard that axis across the mesh when one is given."""
+    _chaos.fire("host.stack")
     W, KO, S, _ND, _NO = dims
     full = [
         p.args + wgl.initial_frontier(f, W, KO, S, p.init_state)
@@ -120,6 +123,7 @@ def check_encoded_batch(
     levels_per_call: Optional[int] = None,
     metrics=None,
     chunk_callback=None,
+    retries: int = 2,
 ) -> list[dict]:
     """Check a batch of encoded histories (same model family) together.
 
@@ -141,9 +145,41 @@ def check_encoded_batch(
 
     ``metrics``: telemetry registry; records re-batch counts, per-chunk
     batch occupancy, donated-frontier bytes and serial fallbacks.
+
+    ``retries``: transient device failures (XlaRuntimeError / OOM /
+    injected chaos) restart the WHOLE batch this many times — the
+    per-chunk frontier buffers are donated, so a failed chunk's inputs
+    may already be invalidated and the only sound retry unit is the
+    full deterministic recomputation. Failures feed the shared
+    ``"batch"`` circuit breaker (``parallel.resilience``); the
+    ``JEPSEN_NO_FAILOVER=1`` kill-switch restores plain propagation.
     """
     if not encs:
         return []
+    return _resilience.call(
+        lambda: _check_encoded_batch_once(
+            encs, f=f, mesh=mesh, batch_axis=batch_axis,
+            max_open=max_open, window_cap=window_cap, escalate=escalate,
+            f_schedule=f_schedule, levels_per_call=levels_per_call,
+            metrics=metrics, chunk_callback=chunk_callback),
+        retries=retries, reason="batch", metrics=metrics,
+        breaker=_resilience.breaker("batch", metrics=metrics))
+
+
+def _check_encoded_batch_once(
+    encs: Sequence[EncodedHistory],
+    f: int = 256,
+    mesh=None,
+    batch_axis: str = "dp",
+    max_open: int = 128,
+    window_cap: int = 1024,
+    escalate=True,
+    f_schedule: Optional[tuple] = None,
+    levels_per_call: Optional[int] = None,
+    metrics=None,
+    chunk_callback=None,
+) -> list[dict]:
+    """One attempt of :func:`check_encoded_batch` (the retry unit)."""
     t0 = _time.perf_counter()
     model = encs[0].model
     mk = wgl._model_cache_key(model)
@@ -215,6 +251,7 @@ def check_encoded_batch(
     pending = None  # host-stacked tables for the NEXT bucket (overlap)
 
     def _host_stack(rows):
+        _chaos.fire("host.stack")
         cols = list(zip(*[padded[r].args for r in rows]))
         return [np.stack(c, axis=0) for c in cols]
 
@@ -309,6 +346,7 @@ def check_encoded_batch(
                 [budgets, lsub,
                  np.full(Bk, int(lossy_rung), np.int32)],
                 mesh, batch_axis)
+            _chaos.fire("device.dispatch")
             out = kern(statics[0], statics[1], budgets_d, *statics[3:9],
                        *fr5, lvl0_d, lossy_d)
             calls += 1

@@ -47,7 +47,9 @@ import numpy as np
 from .. import trace as _trace
 from ..ops import wgl
 from ..ops.encode import EncodedHistory
+from ..testing import chaos as _chaos
 from . import make_mesh
+from . import resilience as _resilience
 
 
 def _resolve_exchange(exchange: Optional[str]) -> str:
@@ -219,8 +221,20 @@ def check_encoded_sharded(
             lvl0 = int(fr[-1])
             budget = np.int32(min(total_levels, lvl0 + lpc))
             call_args = dev_args[:2] + (budget,) + dev_args[3:]
-            out = sharded(*call_args, *fr[:-1], np.int32(lvl0),
-                          np.int32(0))
+
+            # The sharded kernel does NOT donate its frontier buffers,
+            # so a transient device failure (relay drop, OOM, injected
+            # chaos) can retry THIS chunk with the same inputs —
+            # resumable mid-search, unlike the donated batch pipeline
+            # whose retry unit is the whole batch.
+            def _chunk():
+                _chaos.fire("device.dispatch")
+                return sharded(*call_args, *fr[:-1], np.int32(lvl0),
+                               np.int32(0))
+
+            out = _resilience.call(
+                _chunk, reason="sharded", metrics=metrics,
+                breaker=_resilience.breaker("sharded", metrics=metrics))
             # ONE packed device->host read per chunk (see wgl kernel);
             # the sharded flags vector carries the per-shard max/min
             # live counts after the global scalars.

@@ -1,0 +1,299 @@
+"""Device-dispatch resilience: bounded retry + per-device circuit
+breaking.
+
+One ``XlaRuntimeError`` (a wedged relay, a transient OOM, a preempted
+device) used to propagate straight out of the batched pipeline and fold
+a whole scheduler round unknown. This module is the containment layer
+between "the device hiccuped" and "the verdict degraded":
+
+- :func:`call` — run a device thunk with BOUNDED retries and
+  exponential backoff for *transient* errors (the XlaRuntimeError /
+  RESOURCE_EXHAUSTED / chaos-injected family; a deterministic bug —
+  TypeError, ValueError, assertion — is never retried: retrying it
+  would just triple the time to the same crash).
+- :class:`CircuitBreaker` — after ``failure_threshold`` consecutive
+  transient failures the breaker OPENS: callers stop dispatching to
+  the device at all (the scheduler demotes rounds to the host oracle)
+  until ``cooldown_s`` passes, when ONE half-open probe is let through;
+  success closes the breaker, failure re-opens it. This is what keeps
+  a dead device from charging every round a full retry ladder.
+
+The safety contract is inherited, not invented: a retry re-runs a
+deterministic pure function (same verdict or a fresh failure), and a
+failover caller re-dispatches members to the host oracle — verdicts
+are never fabricated, and a member nobody could decide folds unknown,
+degrading definite-True coverage exactly like the service's existing
+``lost_segments`` path.
+
+``JEPSEN_NO_FAILOVER=1`` is the operational kill-switch (same contract
+as ``JEPSEN_WGL_EXCHANGE`` / ``JEPSEN_WGL_NO_DONATE``: it must win
+everywhere, including over code paths that pass explicit options):
+retries, breakers and failovers all disable, restoring the pre-PR
+propagate-and-fold-unknown behavior.
+
+Telemetry: ``wgl_retry_total{reason}`` (every retried attempt),
+``circuit_state{device}`` (0 closed / 1 half-open / 2 open),
+``circuit_transitions_total{device,state}``. The scheduler layers
+``service_failovers_total{engine}`` on top when a round is demoted.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time as _time
+from typing import Callable, Optional
+
+LOG = logging.getLogger("jepsen.resilience")
+
+# Substrings of transient device-runtime failures (jaxlib surfaces
+# XlaRuntimeError with a gRPC-style status prefix; a relay drop shows
+# up as UNAVAILABLE, device OOM as RESOURCE_EXHAUSTED).
+_TRANSIENT_MARKERS = (
+    "RESOURCE_EXHAUSTED",
+    "UNAVAILABLE",
+    "DEADLINE_EXCEEDED",
+    "ABORTED",
+    "INTERNAL",
+    "out of memory",
+    "Out of memory",
+)
+
+# Exception type NAMES treated as transient (name-matched so this
+# module never imports jaxlib — or the chaos harness — eagerly).
+_TRANSIENT_TYPES = ("XlaRuntimeError", "ChaosError")
+
+
+class CircuitOpenError(RuntimeError):
+    """Raised by :func:`call` when the breaker is open and no probe is
+    due — the caller should fail over immediately (no device attempt
+    was made, so there is nothing to retry)."""
+
+
+def failover_disabled() -> bool:
+    """The ``JEPSEN_NO_FAILOVER=1`` kill-switch (checked per call, so
+    flipping the env mid-process takes effect — the rollback story)."""
+    return os.environ.get("JEPSEN_NO_FAILOVER", "") == "1"
+
+
+def is_transient(exc: BaseException) -> bool:
+    """Transient = worth retrying / failing over. Deterministic bugs
+    (TypeError, ValueError, KeyError, assertion failures) are NOT —
+    they reproduce identically on the host path too, and retrying them
+    only delays the honest unknown."""
+    for t in type(exc).__mro__:
+        if t.__name__ in _TRANSIENT_TYPES:
+            return True
+    msg = str(exc)
+    return any(m in msg for m in _TRANSIENT_MARKERS)
+
+
+_STATE_VALUE = {"closed": 0, "half_open": 1, "open": 2}
+
+
+class CircuitBreaker:
+    """Per-device-path breaker: closed → (``failure_threshold``
+    consecutive transient failures) → open → (``cooldown_s``) →
+    half-open probe → closed on success / open on failure."""
+
+    def __init__(self, key: str, failure_threshold: int = 3,
+                 cooldown_s: float = 30.0, metrics=None):
+        self.key = key
+        self.failure_threshold = failure_threshold
+        self.cooldown_s = cooldown_s
+        self.metrics = metrics
+        self._lock = threading.Lock()
+        self._state = "closed"
+        self._failures = 0
+        self._opened_at = 0.0
+
+    # -- observation ---------------------------------------------------------
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"key": self.key, "state": self._state,
+                    "consecutive_failures": self._failures}
+
+    # -- the protocol --------------------------------------------------------
+
+    def engaged(self) -> bool:
+        """Read-only: would :meth:`allow` refuse right now? Unlike
+        ``allow`` this never transitions state nor consumes the
+        half-open probe — callers that only want to DEMOTE up-front
+        (the scheduler's engine selection) use this, and the
+        dispatching :func:`call` still gates through ``allow`` so
+        exactly one gate decides the probe."""
+        if failover_disabled():
+            return False
+        with self._lock:
+            if self._state == "closed":
+                return False
+            if self._state == "open":
+                return (_time.monotonic() - self._opened_at
+                        < self.cooldown_s)
+            return True  # half_open: a probe is already in flight
+
+    def allow(self) -> bool:
+        """May the caller dispatch to this device path right now?
+        Open + cooldown elapsed transitions to half-open and admits ONE
+        probe call; open otherwise refuses (callers demote to host
+        without paying a doomed device attempt)."""
+        if failover_disabled():
+            return True
+        with self._lock:
+            if self._state == "closed":
+                return True
+            if self._state == "open":
+                if (_time.monotonic() - self._opened_at
+                        >= self.cooldown_s):
+                    self._set_locked("half_open")
+                    return True
+                return False
+            # half_open: one probe is already in flight; further
+            # callers keep demoting until it reports back.
+            return False
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._failures = 0
+            if self._state != "closed":
+                self._set_locked("closed")
+
+    def record_failure(self) -> None:
+        with self._lock:
+            self._failures += 1
+            if self._state == "half_open":
+                # The probe failed: straight back to open, fresh
+                # cooldown.
+                self._opened_at = _time.monotonic()
+                self._set_locked("open")
+            elif (self._state == "closed"
+                  and self._failures >= self.failure_threshold):
+                self._opened_at = _time.monotonic()
+                self._set_locked("open")
+
+    def _set_locked(self, state: str) -> None:
+        self._state = state
+        if state == "closed":
+            self._failures = 0
+        m = self.metrics
+        if m is not None:
+            try:
+                m.gauge(
+                    "circuit_state",
+                    "Per-device-path circuit breaker state "
+                    "(0 closed, 1 half-open, 2 open)",
+                    labelnames=("device",)).labels(
+                        device=self.key).set(_STATE_VALUE[state])
+                m.counter(
+                    "circuit_transitions_total",
+                    "Circuit breaker state transitions",
+                    labelnames=("device", "state")).labels(
+                        device=self.key, state=state).inc()
+            except Exception:  # noqa: BLE001 - observability only
+                LOG.warning("circuit gauge update failed", exc_info=True)
+
+
+# Process-global breaker registry: one breaker per device path
+# ("batch", "serial", "sharded"), shared by every caller that
+# dispatches to it — repeated failures anywhere open it for everyone.
+_breakers: dict[str, CircuitBreaker] = {}
+_breakers_lock = threading.Lock()
+
+
+def breaker(key: str, metrics=None, **kw) -> CircuitBreaker:
+    """The shared breaker for one device path (created on first use).
+    ``metrics`` attaches lazily — the first caller with a registry
+    wins, so the gauge lands wherever telemetry is actually on."""
+    with _breakers_lock:
+        b = _breakers.get(key)
+        if b is None:
+            b = _breakers[key] = CircuitBreaker(key, metrics=metrics,
+                                                **kw)
+        elif metrics is not None and b.metrics is None:
+            b.metrics = metrics
+        return b
+
+
+def reset_breakers() -> None:
+    """Forget every breaker (test isolation)."""
+    with _breakers_lock:
+        _breakers.clear()
+
+
+def call(
+    fn: Callable,
+    *,
+    retries: int = 2,
+    base_delay_s: float = 0.05,
+    max_delay_s: float = 2.0,
+    reason: str = "device",
+    metrics=None,
+    breaker: Optional[CircuitBreaker] = None,
+) -> object:
+    """Run ``fn()`` with bounded transient-error retries and optional
+    circuit breaking.
+
+    Retries only :func:`is_transient` failures, at most ``retries``
+    times, sleeping ``base_delay_s * 2^attempt`` (capped at
+    ``max_delay_s``) between attempts; every retried attempt counts in
+    ``wgl_retry_total{reason}``. A breaker, when given, gates the FIRST
+    attempt (:class:`CircuitOpenError` when open — the caller fails
+    over without a device attempt) and is fed every outcome. With
+    ``JEPSEN_NO_FAILOVER=1`` this is a plain ``fn()`` call.
+    """
+    if failover_disabled():
+        return fn()
+    if breaker is not None and not breaker.allow():
+        raise CircuitOpenError(
+            f"circuit {breaker.key!r} is open; not dispatching")
+    attempt = 0
+    while True:
+        try:
+            result = fn()
+        except Exception as e:  # noqa: BLE001 - classified below
+            transient = is_transient(e)
+            if breaker is not None and (transient
+                                        or breaker.state == "half_open"):
+                # Transient failures feed the breaker; additionally, a
+                # HALF-OPEN probe that fails for any reason must
+                # resolve the probe (back to open, fresh cooldown) —
+                # otherwise the breaker wedges in half_open forever:
+                # every later allow() refuses, so no call can ever
+                # record an outcome again.
+                breaker.record_failure()
+            if not transient or attempt >= retries:
+                raise
+            if metrics is not None:
+                try:
+                    metrics.counter(
+                        "wgl_retry_total",
+                        "Transient device-dispatch failures retried, "
+                        "by reason",
+                        labelnames=("reason",)).labels(
+                            reason=reason).inc()
+                except Exception:  # noqa: BLE001
+                    pass
+            delay = min(base_delay_s * (2 ** attempt), max_delay_s)
+            LOG.warning(
+                "transient %s failure (%s: %s); retry %d/%d in %.2fs",
+                reason, type(e).__name__, e, attempt + 1, retries,
+                delay)
+            _time.sleep(delay)
+            attempt += 1
+            # Between retries the breaker may have opened (e.g. a
+            # concurrent caller's failures crossed the threshold).
+            if breaker is not None and not breaker.allow():
+                raise CircuitOpenError(
+                    f"circuit {breaker.key!r} opened mid-retry") \
+                    from e
+            continue
+        if breaker is not None:
+            breaker.record_success()
+        return result

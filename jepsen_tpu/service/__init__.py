@@ -21,6 +21,10 @@ See docs/service.md.
 
 from __future__ import annotations
 
+from .journal import (  # noqa: F401
+    JournalError,
+    JournalModelMismatchError,
+)
 from .service import (  # noqa: F401
     AdmissionError,
     IngestQueueFullError,
@@ -36,6 +40,8 @@ from .service import (  # noqa: F401
 __all__ = [
     "AdmissionError",
     "IngestQueueFullError",
+    "JournalError",
+    "JournalModelMismatchError",
     "QuotaExceededError",
     "Service",
     "ServiceClosedError",

@@ -7,7 +7,12 @@ Two modes:
   run until interrupted; Ctrl-C drains gracefully and prints the
   per-tenant results document. ``--live-port`` additionally serves the
   results browser in-process so ``/live.html`` shows the per-tenant
-  rows while the service runs.
+  rows while the service runs. ``--journal-dir`` makes verdicts
+  crash-safe: every decided segment is journaled, and a restarted
+  daemon pointed at the same directory replays it — reconnecting
+  tenants resume from their journaled watermark (reported under
+  ``resumed_from_journal`` on ``GET /tenants``) instead of
+  resubmitting history.
 - **Simulation** (``--simulate N``): drive N synthetic tenant streams
   through the in-process ``Service.submit`` seam (the same seam the
   tests and bench use), drain, and print per-tenant results. Exit code
@@ -54,6 +59,8 @@ def build_service(ns: argparse.Namespace,
         abort_on_violation=ns.abort_on_violation,
         max_configs=ns.max_configs,
         store_root=ns.store_root,
+        journal_dir=ns.journal_dir,
+        journal_fsync=ns.journal_fsync,
     )
     return Service(model, cfg, metrics=metrics, name=ns.name)
 
@@ -122,6 +129,15 @@ def main(argv: Optional[list] = None) -> int:
                         "its first invalid segment")
     p.add_argument("--max-configs", type=int, default=500_000)
     p.add_argument("--store-root", default=None)
+    p.add_argument("--journal-dir", default=None,
+                   help="crash-safe per-tenant verdict journal "
+                        "directory; a restart replays it and "
+                        "reconnecting tenants resume from their "
+                        "journaled watermark (GET /tenants reports "
+                        "resumed_from_journal)")
+    p.add_argument("--journal-fsync", action="store_true",
+                   help="fsync every journal record (kill-safe, "
+                        "slower)")
     p.add_argument("--live-port", type=int, default=None,
                    help="also serve the results browser (incl. the "
                         "/live per-tenant dashboard) on this port")

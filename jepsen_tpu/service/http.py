@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import json
 import logging
+import math
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import unquote
 
@@ -46,12 +47,20 @@ def make_handler(service: Service, max_body: int = MAX_BODY_BYTES):
         def log_message(self, fmt, *args):  # quiet
             LOG.debug(fmt, *args)
 
-        def _json(self, code: int, doc: dict) -> None:
+        def _json(self, code: int, doc: dict,
+                  retry_after_s=None) -> None:
             body = json.dumps(doc, sort_keys=True,
                               default=str).encode()
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
+            if retry_after_s is not None:
+                # The standard backoff hint, integral seconds, never
+                # zero (clients treat 0 as "immediately", defeating
+                # the point): quota refill estimate on 429s, the fixed
+                # drain hint on 503s.
+                self.send_header("Retry-After",
+                                 str(max(1, math.ceil(retry_after_s))))
             self.end_headers()
             self.wfile.write(body)
 
@@ -113,11 +122,20 @@ def make_handler(service: Service, max_body: int = MAX_BODY_BYTES):
                 except ServiceError as e:
                     # Typed rejection: the client resumes after
                     # `accepted` lines (quota/backpressure are
-                    # retryable 429s; aborted/draining are not).
-                    self._json(e.http_status, {
+                    # retryable 429s; aborted/draining are not). 429s
+                    # and 503s additionally carry Retry-After — the
+                    # token bucket's refill estimate / the drain hint
+                    # — so a well-behaved client backs off by exactly
+                    # the server's own estimate.
+                    doc = {
                         "error": e.code, "tenant": tenant,
                         "accepted": accepted, "detail": str(e),
-                        "retryable": e.http_status == 429})
+                        "retryable": e.http_status == 429}
+                    ra = (e.retry_after_s
+                          if e.http_status in (429, 503) else None)
+                    if ra is not None:
+                        doc["retry_after_s"] = ra
+                    self._json(e.http_status, doc, retry_after_s=ra)
                     return
                 accepted += 1
             self._json(200, {"tenant": tenant, "accepted": accepted})

@@ -61,7 +61,9 @@ from typing import Any, Optional
 
 from ..online.scheduler import SegmentScheduler
 from ..online.segmenter import Segmenter
+from ..telemetry import flight as _flight
 from ..telemetry.registry import DECISION_LATENCY_BUCKETS, Histogram
+from ..testing import chaos as _chaos
 
 LOG = logging.getLogger("jepsen.service")
 
@@ -71,10 +73,25 @@ LOG = logging.getLogger("jepsen.service")
 
 
 class ServiceError(Exception):
-    """Base class of every typed service rejection."""
+    """Base class of every typed service rejection.
+
+    ``retry_after_s`` (instance attribute, set at raise time where the
+    raiser can estimate it) rides to the HTTP layer as a standard
+    ``Retry-After`` header next to the ``retryable`` flag: a quota
+    rejection carries the token bucket's refill estimate, a full-queue
+    rejection a short drain hint, a draining 503 the fixed restart
+    hint."""
 
     http_status = 400
     code = "service_error"
+    retry_after_s: Optional[float] = None
+
+
+# Fixed Retry-After hints where no live estimate exists: a full ingest
+# queue usually drains within a pump sweep or two; a draining service
+# needs a deploy-scale pause before the replacement listens.
+QUEUE_RETRY_AFTER_S = 1.0
+DRAIN_RETRY_AFTER_S = 30.0
 
 
 class ServiceClosedError(ServiceError):
@@ -82,6 +99,7 @@ class ServiceClosedError(ServiceError):
 
     http_status = 503
     code = "draining"
+    retry_after_s = DRAIN_RETRY_AFTER_S
 
 
 class AdmissionError(ServiceError):
@@ -93,6 +111,7 @@ class AdmissionError(ServiceError):
 
 class TenantLimitError(AdmissionError):
     code = "tenant_limit"
+    retry_after_s = 30.0  # capacity frees on another tenant's drain
 
 
 class QuotaExceededError(AdmissionError):
@@ -139,6 +158,13 @@ class ServiceConfig:
     register_live: bool = True  # expose live_snapshot on web /live
     ledger: bool = True  # append one record per tenant stream on drain
     store_root: Optional[str] = None
+    # Crash safety: when set, every decided segment appends one record
+    # to <journal_dir>/<tenant>.jsonl under the fold lock, and a
+    # restarted service REPLAYS the directory — reconnecting clients
+    # resume from their journaled watermark instead of resubmitting
+    # history (docs/service.md "Crash-safe verdict journal").
+    journal_dir: Optional[str] = None
+    journal_fsync: bool = False  # fsync every record (slow, kill-safe)
 
     def __post_init__(self):
         if self.backpressure not in ("reject", "block"):
@@ -168,6 +194,8 @@ class _Tenant:
         self.lost_segments = False
         self.rejected = {"quota": 0, "queue": 0, "aborted": 0}
         self.detection: Optional[dict] = None
+        self.journal = None           # TenantJournal when journaling
+        self.resumed: Optional[dict] = None  # journal replay summary
         self.t0 = _time.monotonic()
         self.registered_at = _time.time()
         # Token bucket (guarded by self.lock).
@@ -217,6 +245,20 @@ class Service:
             Histogram("decision_latency_seconds", _help,
                       labelnames=("tenant",),
                       buckets=DECISION_LATENCY_BUCKETS, aggregate=True))
+        self.flight = flight
+        # Journal replay runs BEFORE the pump thread exists: a raising
+        # replay (model mismatch, unreadable dir) fails the ctor
+        # without leaking a thread — including the scheduler's worker,
+        # which already started above and must be closed on the way
+        # out — and no submit can race the restore (restore_stream
+        # requires a work-free stream).
+        if cfg.journal_dir:
+            try:
+                with _flight.phase(flight, "service.replay"):
+                    self._replay_journals(cfg.journal_dir)
+            except BaseException:
+                self.scheduler.close(timeout=10.0)
+                raise
         self._wake = threading.Event()
         self._pump_stop = threading.Event()
         self._pump_thread = threading.Thread(
@@ -230,6 +272,120 @@ class Service:
             except Exception:  # noqa: BLE001 - observability only
                 LOG.warning("could not register live source",
                             exc_info=True)
+
+    # -- the crash-safe verdict journal ---------------------------------------
+
+    def _replay_journals(self, journal_dir: str) -> None:
+        """Service restart: rebuild every journaled tenant's fold
+        state (watermark, verdict counters, per-key carries, violation
+        witness) and reopen its journal for appends. Raises the typed
+        :class:`journal.JournalModelMismatchError` when a journal was
+        written for a different model family — carried states must
+        never cross folds."""
+        from . import journal as _journal
+
+        for tenant, path in _journal.scan(journal_dir).items():
+            rep = _journal.replay(path, self.model)
+            with self._tlock:
+                if len(self._tenants) >= self.config.max_tenants:
+                    raise TenantLimitError(
+                        f"journal dir holds more tenants than "
+                        f"max_tenants={self.config.max_tenants}")
+                t = self._tenants[tenant] = _Tenant(tenant, self.config)
+            if rep.get("fresh"):
+                # Empty journal / torn header (a crash inside the very
+                # first write): nothing to restore — admit the tenant
+                # fresh and REWRITE the header so the reopened file is
+                # replayable next time.
+                self.scheduler.register_stream(
+                    tenant, **self._stream_hooks(t))
+                t.journal = _journal.TenantJournal(
+                    path, tenant, self.model,
+                    fsync=self.config.journal_fsync, fresh_header=True,
+                    truncate=True)
+                LOG.warning("tenant %s: journal was empty/torn; "
+                            "admitted fresh", tenant)
+                continue
+            t.resumed = {
+                "records": rep["records"],
+                "watermark": rep["watermark"],
+                "torn_tail": rep["torn_tail"],
+            }
+            if rep.get("degraded"):
+                # Swallowed-append gap: the restored fold is pinned
+                # unknown and carries are poisoned (journal.replay);
+                # surface it on the tenant row too.
+                t.resumed["degraded"] = True
+            t.segmenter.resume(rep["watermark"] + 1, rep["next_seq"])
+            if rep["violation"] is not None:
+                t.detection = {}  # detection clock predates this run
+                if self.config.abort_on_violation:
+                    t.aborted.set()
+            self.scheduler.restore_stream(
+                tenant,
+                watermark=rep["watermark"],
+                next_seq=rep["next_seq"],
+                carry=rep["carry"],
+                carry_poisoned=rep["carry_poisoned"],
+                n_decided=rep["n_decided"],
+                n_invalid=rep["n_invalid"],
+                n_unknown=rep["n_unknown"],
+                violation=rep["violation"],
+                segments=rep["segments"],
+                **self._stream_hooks(t))
+            t.journal = _journal.TenantJournal(
+                path, tenant, self.model,
+                fsync=self.config.journal_fsync, fresh_header=False,
+                truncate_to=(rep["consistent_bytes"]
+                             if rep["torn_tail"] else None))
+            self._set_journal_lag(t, rep["watermark"])
+            LOG.info("tenant %s resumed from journal: watermark %d, "
+                     "%d records%s", tenant, rep["watermark"],
+                     rep["records"],
+                     " (torn tail)" if rep["torn_tail"] else "")
+        if self.metrics is not None and self._tenants:
+            self.metrics.gauge(
+                "service_tenants",
+                "Tenant streams currently admitted").set(
+                    len(self._tenants))
+
+    def _stream_hooks(self, t: _Tenant) -> dict:
+        """The one hook triple every stream registration path
+        (fresh admit, journal restore, empty-journal re-admit) wires —
+        kept in one place so the paths cannot drift."""
+        return {
+            "on_watermark": lambda w, _t=t: self._on_watermark(_t, w),
+            "on_violation": lambda v, _t=t: self._on_violation(_t, v),
+            "on_segment": (lambda row, key, carry, w, _t=t:
+                           self._on_segment(_t, row, key, carry, w)),
+        }
+
+    def _on_segment(self, t: _Tenant, row: dict, key: Any, carry: Any,
+                    watermark: int) -> None:
+        # Scheduler worker thread, fold lock held: the journal record
+        # lands before any reader can observe the new fold state, so a
+        # journaled watermark never runs ahead of it. Append failures
+        # are swallowed inside append_segment (durability lost, verdict
+        # unaffected).
+        if t.journal is not None:
+            t.journal.append_segment(row, key, carry, watermark)
+        self._set_journal_lag(t, watermark)
+
+    def _set_journal_lag(self, t: _Tenant, watermark: int) -> None:
+        """``journal_lag_ops{tenant}``: ops this tenant has observed
+        (by index) that a journaled watermark does not yet cover —
+        what a crash right now would force the client to resubmit.
+        Only meaningful WITH a journal: without one the gauge would
+        imply a bounded loss that does not exist."""
+        if self.metrics is None or t.journal is None:
+            return
+        lag = max(t.segmenter.next_index - (watermark + 1), 0)
+        self.metrics.gauge(
+            "journal_lag_ops",
+            "Observed ops not yet covered by the journaled watermark, "
+            "by tenant (what a crash would lose)",
+            labelnames=("tenant",), aggregate=True).labels(
+                tenant=t.name).set(lag)
 
     # -- admission -----------------------------------------------------------
 
@@ -253,9 +409,19 @@ class Service:
                     f"tenant {tenant!r} rejected")
             t = self._tenants[tenant] = _Tenant(tenant, self.config)
             self.scheduler.register_stream(
-                tenant,
-                on_watermark=lambda w, _t=t: self._on_watermark(_t, w),
-                on_violation=lambda v, _t=t: self._on_violation(_t, v))
+                tenant, **self._stream_hooks(t))
+            if self.config.journal_dir:
+                from . import journal as _journal
+
+                try:
+                    t.journal = _journal.TenantJournal(
+                        _journal.tenant_path(self.config.journal_dir,
+                                             tenant),
+                        tenant, self.model,
+                        fsync=self.config.journal_fsync)
+                except Exception:  # noqa: BLE001 - durability only
+                    LOG.warning("could not open journal for tenant %s",
+                                tenant, exc_info=True)
             if self.metrics is not None:
                 self.metrics.gauge(
                     "service_tenants",
@@ -278,8 +444,12 @@ class Service:
             if t.allowance < 1.0:
                 t.rejected["quota"] += 1
                 self._count_reject(t, "quota")
-                raise QuotaExceededError(
+                err = QuotaExceededError(
                     f"tenant {t.name!r} over its {rate} ops/s quota")
+                # Refill estimate: seconds until the bucket holds one
+                # whole token again — the HTTP Retry-After value.
+                err.retry_after_s = round((1.0 - t.allowance) / rate, 3)
+                raise err
             t.allowance -= 1.0
 
     def _count_reject(self, t: _Tenant, reason: str) -> None:
@@ -320,9 +490,11 @@ class Service:
         except queue.Full:
             t.rejected["queue"] += 1
             self._count_reject(t, "queue")
-            raise IngestQueueFullError(
+            err = IngestQueueFullError(
                 f"tenant {t.name!r} ingest queue full "
-                f"({self.config.queue_limit} ops)") from None
+                f"({self.config.queue_limit} ops)")
+            err.retry_after_s = QUEUE_RETRY_AFTER_S
+            raise err from None
         with t.lock:
             t.ops_ingested += 1
         self._wake.set()
@@ -352,6 +524,12 @@ class Service:
     def _pump_once(self) -> bool:
         """One round-robin sweep over the tenants; returns whether any
         op moved."""
+        # Chaos seam, BEFORE any op is popped: an injected raise kills
+        # the pump with every accepted op still queued — the bounded
+        # queues turn the death into backpressure, and drain's
+        # synchronous flush feeds everything in order, so the fault
+        # costs latency, never a verdict (tests/test_chaos.py).
+        _chaos.fire("service.pump")
         with self._tlock:
             tenants = list(self._tenants.values())
         moved = False
@@ -471,9 +649,29 @@ class Service:
             "verdict": str(ss.get("verdict")),
             "undecided_ops": undecided,
             "aborted": t.aborted.is_set(),
+            # Degraded = this tenant's definite-True coverage is
+            # already compromised (lost segments at a closed
+            # scheduler, unknown-folded segments from a crashed round
+            # / failover that couldn't decide) — the /live row flag.
+            "degraded": bool(t.lost_segments
+                             or ss.get("segments_unknown")),
             "decision_latency": self._lat.stats(
                 labels={"tenant": t.name}),
         })
+        if t.resumed is not None:
+            snap["resumed_from_journal"] = dict(t.resumed)
+            if t.segmenter.dropped_covered:
+                # Resubmitted ops at/below the journaled watermark the
+                # server dropped (re-checking them from the restored
+                # carries could flip a verdict — the resume protocol
+                # is enforced, not trusted).
+                snap["resubmitted_ops_dropped"] = \
+                    t.segmenter.dropped_covered
+        if t.journal is not None and t.journal.append_failures:
+            # Durability (not verdict) is compromised: a crash now
+            # would lose more than the journaled watermark admits.
+            snap["journal_append_failures"] = t.journal.append_failures
+            snap["degraded"] = True
         if t.detection is not None:
             snap.update(t.detection)
         return snap
@@ -624,6 +822,16 @@ class Service:
                 out["valid"] = "unknown"
                 out["info"] = ("segments lost after scheduler close; "
                                "verdict degraded to unknown")
+            if t.resumed is not None:
+                out["resumed_from_journal"] = dict(t.resumed)
+                if t.segmenter.dropped_covered:
+                    out["resubmitted_ops_dropped"] = \
+                        t.segmenter.dropped_covered
+            if t.journal is not None:
+                if t.journal.append_failures:
+                    out["journal_append_failures"] = \
+                        t.journal.append_failures
+                t.journal.close()
             if t.detection is not None:
                 out.update(t.detection)
             if res.get("violation") is not None:

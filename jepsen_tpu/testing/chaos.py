@@ -1,0 +1,188 @@
+"""Self-chaos harness: named injectable fault points at the checking
+pipeline's real seams.
+
+Jepsen's core lesson applies to our own stack: partial failure must
+degrade a verdict to *unknown*, never flip it. This module is how we
+prove it. Production code crosses a handful of named **fault points**
+(one `chaos.fire(point)` call each, a dict lookup on the off path);
+tests arm a point with :func:`inject` and the next crossing raises,
+sleeps, or kills the process — at exactly the seam a real fault would
+hit. The chaos differential suite (tests/test_chaos.py) then pins, for
+every point × mode, that each tenant's folded verdict equals its
+offline ``check_history`` verdict or "unknown" — never the opposite
+definite verdict.
+
+Fault points (the seams, in pipeline order):
+
+- ``service.pump`` — the service's pump sweep, before an op is popped
+  from a tenant queue (jepsen_tpu/service/service.py `_pump_once`). A
+  raise kills the pump thread; bounded queues turn that into
+  backpressure, and drain's synchronous flush still feeds everything
+  accepted — the verdict is unchanged.
+- ``scheduler.worker`` — the online scheduler's worker loop, after a
+  batch is taken from the inbox (online/scheduler.py `_run_loop`). A
+  raise escapes the per-round recovery and kills the worker — the
+  bounded-restart path (`online_worker_restarts_total`) folds the
+  in-flight segments unknown and keeps the stream deciding.
+- ``device.dispatch`` — the oracle dispatch seam (scheduler
+  `_dispatch_round`) and every batched device kernel chunk
+  (parallel/batch.py). A raise models an ``XlaRuntimeError``/OOM; the
+  resilience layer (parallel/resilience.py) retries, then the
+  scheduler fails the round over to per-member host re-dispatch.
+- ``host.stack`` — the batch pipeline's host-side table stacking
+  (rung entry and the double-buffered build). A raise surfaces as a
+  failed device call and rides the same retry/failover path.
+- ``journal.fsync`` — the verdict journal's append/flush
+  (service/journal.py). A raise loses durability, never a verdict
+  (append failures are counted and swallowed); ``crash`` mode here is
+  the kill-9 test — the journal's torn-line tolerance and replay are
+  exercised by restarting the process.
+
+Modes: ``raise`` (raise ``exc`` on the Nth crossing, ``times`` times),
+``delay`` (sleep ``delay_s``; models a slow device/disk), ``crash``
+(``os._exit(exit_code)``; the kill-9 process test — never use in
+in-process tests).
+
+The harness is inert unless armed: ``fire`` is one module-dict
+membership test on the hot path, the module imports nothing heavy, and
+production seams import it unconditionally (the off-path cost the
+telemetry stack already set the precedent for).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+import time
+from typing import Optional, Type
+
+# The registered fault points (documentation + validation; `inject`
+# refuses a typo'd point so a chaos test can't silently test nothing).
+POINTS = (
+    "service.pump",
+    "scheduler.worker",
+    "device.dispatch",
+    "host.stack",
+    "journal.fsync",
+)
+
+MODES = ("raise", "delay", "crash")
+
+
+class ChaosError(RuntimeError):
+    """The default injected fault. Classified TRANSIENT by
+    ``parallel.resilience.is_transient`` — it stands in for the
+    XlaRuntimeError/OOM family the retry/failover path exists for."""
+
+
+class _Fault:
+    __slots__ = ("point", "mode", "on_call", "times", "exc", "delay_s",
+                 "exit_code")
+
+    def __init__(self, point: str, mode: str, on_call: int, times: int,
+                 exc: Optional[Type[BaseException]], delay_s: float,
+                 exit_code: int):
+        self.point = point
+        self.mode = mode
+        self.on_call = on_call
+        self.times = times
+        self.exc = exc or ChaosError
+        self.delay_s = delay_s
+        self.exit_code = exit_code
+
+    def trigger(self, n: int) -> None:
+        """Fire the fault on crossings [on_call, on_call+times)."""
+        if n < self.on_call or n >= self.on_call + self.times:
+            return
+        if self.mode == "delay":
+            time.sleep(self.delay_s)
+            return
+        if self.mode == "crash":
+            # The kill-9 stand-in: no atexit, no finally, no flush —
+            # exactly what a SIGKILL'd service leaves behind (a torn
+            # journal line, an unflushed queue).
+            os._exit(self.exit_code)
+        raise self.exc(
+            f"chaos: injected fault at {self.point!r} (call {n})")
+
+
+_lock = threading.Lock()
+_active: dict[str, _Fault] = {}
+_calls: dict[str, int] = {}
+_fired: dict[str, int] = {}
+
+
+def fire(point: str) -> None:
+    """The production seam hook. Near-free when nothing is armed (one
+    dict membership test); when ``point`` is armed, counts the crossing
+    and lets the fault decide whether this is the Nth call."""
+    if point not in _active:
+        return
+    with _lock:
+        f = _active.get(point)
+        if f is None:
+            return
+        n = _calls[point] = _calls.get(point, 0) + 1
+        will = f.on_call <= n < f.on_call + f.times
+        if will:
+            _fired[point] = _fired.get(point, 0) + 1
+    if will:
+        f.trigger(n)
+
+
+def calls(point: str) -> int:
+    """Crossings of ``point`` while it was armed (test assertions)."""
+    with _lock:
+        return _calls.get(point, 0)
+
+
+def fired(point: str) -> int:
+    """Times ``point`` actually triggered its fault."""
+    with _lock:
+        return _fired.get(point, 0)
+
+
+def reset() -> None:
+    """Disarm everything (test teardown)."""
+    with _lock:
+        _active.clear()
+        _calls.clear()
+        _fired.clear()
+
+
+@contextlib.contextmanager
+def inject(point: str, mode: str = "raise", *, on_call: int = 1,
+           times: int = 1, exc: Optional[Type[BaseException]] = None,
+           delay_s: float = 0.05, exit_code: int = 9):
+    """Arm ``point`` with one fault for the duration of the block.
+
+    ``on_call``: 1-based crossing index the fault first triggers on;
+    ``times``: how many consecutive crossings trigger (raise-once is
+    the default); ``exc``: exception class for ``raise`` mode
+    (default :class:`ChaosError`, which the resilience layer treats as
+    transient). Re-arming an already-armed point is a test bug and
+    raises. Counters clear on ENTRY and stay readable after exit
+    (``calls``/``fired`` — bench.py and the graft smoke assert on
+    them post-block) until the next arm of the same point or
+    :func:`reset`.
+    """
+    if point not in POINTS:
+        raise ValueError(
+            f"unknown chaos point {point!r}; known: {POINTS}")
+    if mode not in MODES:
+        raise ValueError(f"unknown chaos mode {mode!r}; known: {MODES}")
+    if on_call < 1 or times < 1:
+        raise ValueError("on_call and times must be >= 1")
+    f = _Fault(point, mode, on_call, times, exc, delay_s, exit_code)
+    with _lock:
+        if point in _active:
+            raise RuntimeError(f"chaos point {point!r} already armed")
+        _active[point] = f
+        _calls.pop(point, None)
+        _fired.pop(point, None)
+    try:
+        yield f
+    finally:
+        with _lock:
+            _active.pop(point, None)

@@ -614,7 +614,16 @@ async function tick() {
             Object.entries(r.tenants).map(([name, t]) => {
               t = t || {};
               const tl = t.decision_latency || {};
-              const cls = t.verdict === 'False' ? ' class="stall"' : '';
+              // Red row: a refuted stream OR a degraded one (lost
+              // segments / unknown folds / journal append failures —
+              // definite-True coverage is already compromised).
+              const cls = (t.verdict === 'False' || t.degraded)
+                ? ' class="stall"' : '';
+              const flags = [
+                t.aborted ? 'ABORTED' : '',
+                t.degraded ? 'DEGRADED' : '',
+                t.resumed_from_journal ? 'resumed' : '',
+              ].filter(Boolean).join(' ');
               return '<tr' + cls + '><td>' + name + '</td>' +
                 '<td>' + t.verdict + '</td>' +
                 '<td>' + t.watermark + '</td>' +
@@ -623,7 +632,7 @@ async function tick() {
                 '<td>' + t.backlog + '</td>' +
                 '<td>' + t.undecided_ops + '</td>' +
                 '<td>' + tl.p99_s + '</td>' +
-                '<td>' + (t.aborted ? 'ABORTED' : '') + '</td></tr>';
+                '<td>' + flags + '</td></tr>';
             }).join('') + '</table>';
         } else {
           head = '<p' + (stall ? ' class="stall"' : '') + '>' +

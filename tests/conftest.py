@@ -46,6 +46,12 @@ def pytest_configure(config):
         "service: multi-tenant checking-service tests "
         "(jepsen_tpu.service; select with -m service; the device "
         "co-batch differential is additionally marked slow)")
+    config.addinivalue_line(
+        "markers",
+        "chaos: fault-injection tests (jepsen_tpu.testing.chaos; "
+        "select with -m chaos). Fast host-engine chaos tests stay "
+        "tier-1; process-kill and device-engine chaos tests are "
+        "additionally marked slow")
 
 
 def pytest_addoption(parser):

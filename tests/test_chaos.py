@@ -1,0 +1,431 @@
+"""Self-chaos differential suite (jepsen_tpu.testing.chaos).
+
+THE acceptance contract of the fault-tolerance PR: under every
+injected fault — at every named seam, in every mode — each tenant's
+folded verdict is its offline ``check_history`` verdict or
+``unknown``, NEVER the opposite definite verdict. Partial failure
+degrades coverage; it does not flip verdicts.
+
+Layout:
+
+- harness unit tests (arming rules, counters, modes);
+- one dedicated recovery test per seam, asserting the STRONG
+  property where the design guarantees it (pump death and worker
+  restart lose nothing; an oracle fault fails over to host
+  re-dispatch; journal faults cost durability only);
+- the differential matrix over (seam × tenant-verdict);
+- `slow`-marked: the kill-9 → restart → journaled-verdict process
+  test (the ISSUE's acceptance pin) and the device-engine chaos runs
+  (compiles).
+
+Fast tests run the compile-free host engine with quiescence poisoned
+near the stream end (an ok write → :info — a crashed-but-applied
+write, still valid) so the closing round genuinely crosses the oracle
+seam."""
+
+import json
+import os
+import random
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from jepsen_tpu.history import History
+from jepsen_tpu.models import CasRegister
+from jepsen_tpu.ops import wgl
+from jepsen_tpu.parallel import resilience
+from jepsen_tpu.service import Service
+from jepsen_tpu.telemetry import Registry
+from jepsen_tpu.testing import (
+    chaos,
+    chunked_register_history,
+    perturb_history,
+)
+
+pytestmark = pytest.mark.chaos
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _isolate():
+    chaos.reset()
+    resilience.reset_breakers()
+    yield
+    chaos.reset()
+    resilience.reset_breakers()
+
+
+def model():
+    return CasRegister(init=0)
+
+
+def offline(history):
+    return wgl.check_history(model(), history, backend="host")
+
+
+def mk(**kw):
+    kw.setdefault("engine", "host")
+    kw.setdefault("register_live", False)
+    kw.setdefault("ledger", False)
+    return Service(model(), **kw)
+
+
+def poisoned_valid_history(seed, n_ops=160):
+    """Valid by construction, with quiescence poisoned near the end
+    (ok write → :info) so the tail is a real TERMINAL segment — the
+    oracle (and therefore the ``device.dispatch`` seam) is actually
+    crossed on the host engine."""
+    base = list(chunked_register_history(
+        random.Random(seed), n_ops=n_ops, n_procs=2, chunk_ops=20))
+    k = next(j for j in range(int(len(base) * 0.8), len(base))
+             if base[j].is_ok and base[j].f == "write")
+    base[k] = base[k].with_(type="info")
+    return History(base, reindex=True)
+
+
+def invalid_history(seed, n_ops=160):
+    return perturb_history(
+        random.Random(seed),
+        chunked_register_history(random.Random(seed + 1), n_ops=n_ops,
+                                 n_procs=2, chunk_ops=20),
+        within=0.5)
+
+
+# ---------------------------------------------------------------------------
+
+
+class TestHarness:
+    def test_unknown_point_or_mode_refused(self):
+        with pytest.raises(ValueError):
+            with chaos.inject("no.such.seam"):
+                pass
+        with pytest.raises(ValueError):
+            with chaos.inject("service.pump", mode="meteor"):
+                pass
+
+    def test_fires_on_nth_call_only(self):
+        with chaos.inject("service.pump", on_call=2):
+            chaos.fire("service.pump")  # call 1: armed, not yet due
+            with pytest.raises(chaos.ChaosError):
+                chaos.fire("service.pump")
+            chaos.fire("service.pump")  # call 3: spent
+            assert chaos.calls("service.pump") == 3
+            assert chaos.fired("service.pump") == 1
+
+    def test_inert_when_unarmed(self):
+        chaos.fire("service.pump")
+        assert chaos.calls("service.pump") == 0
+
+    def test_double_arm_is_a_test_bug(self):
+        with chaos.inject("service.pump"):
+            with pytest.raises(RuntimeError):
+                with chaos.inject("service.pump"):
+                    pass
+
+    def test_delay_mode_sleeps(self):
+        with chaos.inject("service.pump", mode="delay", delay_s=0.05):
+            t0 = time.perf_counter()
+            chaos.fire("service.pump")
+            assert time.perf_counter() - t0 >= 0.05
+
+    def test_custom_exception(self):
+        class Boom(Exception):
+            pass
+
+        with chaos.inject("journal.fsync", exc=Boom):
+            with pytest.raises(Boom):
+                chaos.fire("journal.fsync")
+
+
+# ---------------------------------------------------------------------------
+# Dedicated per-seam recovery tests (strong properties).
+
+
+class TestPumpDeath:
+    def test_dead_pump_costs_latency_never_a_verdict(self):
+        # The seam fires BEFORE any op is popped: the pump dies with
+        # every accepted op still queued, bounded queues back-pressure,
+        # and drain's synchronous flush feeds everything in order —
+        # the verdict is EXACTLY offline's.
+        h = poisoned_valid_history(41)
+        svc = mk(queue_limit=10_000)
+        with chaos.inject("service.pump", on_call=1):
+            for op in h:
+                svc.submit("t", op)
+            # Let the pump actually reach its (armed) next sweep — a
+            # fast drain() would otherwise stop it before the seam is
+            # crossed and the test would prove nothing.
+            for _ in range(400):
+                if chaos.fired("service.pump"):
+                    break
+                time.sleep(0.005)
+            fin = svc.drain(timeout=60)
+        assert chaos.fired("service.pump") == 1
+        assert fin["tenants"]["t"]["valid"] is \
+            offline(h)["valid"] is True
+        assert "undelivered_ops" not in fin["tenants"]["t"]
+
+
+class TestWorkerRestart:
+    def test_raise_once_restarts_worker_and_loses_nothing(self):
+        # The satellite's regression pin: a dead worker thread used to
+        # poison the stream forever via _dead; now it restarts ONCE
+        # (counted), the crashed round's batch is requeued, and the
+        # verdict still equals offline.
+        reg = Registry()
+        h = poisoned_valid_history(42)
+        svc = mk(metrics=reg)
+        with chaos.inject("scheduler.worker", on_call=1):
+            for op in h:
+                svc.submit("t", op)
+            fin = svc.drain(timeout=60)
+        assert chaos.fired("scheduler.worker") == 1
+        assert reg.counter("online_worker_restarts_total").value == 1
+        assert fin["tenants"]["t"]["valid"] is \
+            offline(h)["valid"] is True
+
+    def test_second_crash_is_terminal_and_one_sided(self):
+        # Restarts are bounded: a crash LOOP converges to the honest
+        # unknown (never a definite verdict over undecided ops), and
+        # the service survives to drain.
+        reg = Registry()
+        h = poisoned_valid_history(43)
+        svc = mk(metrics=reg)
+        with chaos.inject("scheduler.worker", on_call=1, times=2):
+            for op in h:
+                svc.submit("t", op)
+            fin = svc.drain(timeout=60)
+        assert chaos.fired("scheduler.worker") == 2
+        assert reg.counter("online_worker_restarts_total").value == 1
+        assert fin["tenants"]["t"]["valid"] == "unknown"
+
+
+class TestOracleFailover:
+    def test_injected_fault_fails_over_to_host_redispatch(self):
+        reg = Registry()
+        h = poisoned_valid_history(44)
+        svc = mk(metrics=reg)
+        with chaos.inject("device.dispatch", on_call=1):
+            for op in h:
+                svc.submit("t", op)
+            fin = svc.drain(timeout=60)
+        assert chaos.fired("device.dispatch") == 1
+        c = reg.counter("service_failovers_total",
+                        labelnames=("engine",), aggregate=True)
+        assert c.value == 1
+        assert any(ev.get("failover")
+                   for ev in reg.events("online_round"))
+        assert fin["tenants"]["t"]["valid"] is \
+            offline(h)["valid"] is True
+
+    def test_kill_switch_restores_unknown_fold(self, monkeypatch):
+        # JEPSEN_NO_FAILOVER=1: the pre-PR behavior — the fault
+        # propagates, the round folds unknown (still one-sided),
+        # nothing retries or fails over.
+        monkeypatch.setenv("JEPSEN_NO_FAILOVER", "1")
+        reg = Registry()
+        h = poisoned_valid_history(45)
+        svc = mk(metrics=reg)
+        with chaos.inject("device.dispatch", on_call=1):
+            for op in h:
+                svc.submit("t", op)
+            fin = svc.drain(timeout=60)
+        c = reg.counter("service_failovers_total",
+                        labelnames=("engine",), aggregate=True)
+        assert c.value == 0
+        assert fin["tenants"]["t"]["valid"] == "unknown"
+
+    def test_open_circuit_demotes_rounds_preemptively(self):
+        # A breaker already opened by repeated failures demotes rounds
+        # WITHOUT a doomed device attempt; verdicts still equal
+        # offline (host re-dispatch decides them).
+        reg = Registry()
+        br = resilience.breaker("batch", metrics=reg,
+                                failure_threshold=1, cooldown_s=600.0)
+        br.record_failure()
+        assert br.state == "open"
+        h = poisoned_valid_history(46)
+        svc = mk(metrics=reg, engine="device")
+        for op in h:
+            svc.submit("t", op)
+        fin = svc.drain(timeout=60)
+        assert fin["tenants"]["t"]["valid"] is \
+            offline(h)["valid"] is True
+        c = reg.counter("service_failovers_total",
+                        labelnames=("engine",), aggregate=True)
+        assert c.labels(engine="device").value >= 1
+
+
+class TestJournalFault:
+    def test_append_failures_cost_durability_not_verdicts(self,
+                                                          tmp_path):
+        reg = Registry()
+        h = poisoned_valid_history(47)
+        svc = mk(metrics=reg, journal_dir=str(tmp_path))
+        # Skip the header (call 1), fail three segment appends.
+        with chaos.inject("journal.fsync", on_call=2, times=3):
+            for op in h:
+                svc.submit("t", op)
+            fin = svc.drain(timeout=60)
+        assert chaos.fired("journal.fsync") == 3
+        assert fin["tenants"]["t"]["valid"] is \
+            offline(h)["valid"] is True
+        assert fin["tenants"]["t"]["journal_append_failures"] == 3
+        # The flag a reconnecting client sees: durability degraded.
+        snap_degraded = None
+        for t in (svc.tenant_snapshot("t"),):
+            snap_degraded = t["degraded"]
+        assert snap_degraded is True
+
+
+# ---------------------------------------------------------------------------
+# The differential matrix: every fast seam × {valid, invalid} tenant.
+# (host.stack only exists inside the batched device pipeline and is
+# covered by the slow device-engine test below.)
+
+
+class TestChaosDifferential:
+    FAST_POINTS = ("service.pump", "scheduler.worker",
+                   "device.dispatch", "journal.fsync")
+
+    @pytest.mark.parametrize("point", FAST_POINTS)
+    @pytest.mark.parametrize("mode", ("raise", "delay"))
+    def test_verdicts_degrade_never_flip(self, point, mode, tmp_path):
+        hs = {"good": poisoned_valid_history(48),
+              "bad": invalid_history(49)}
+        want = {name: offline(h)["valid"] for name, h in hs.items()}
+        assert want == {"good": True, "bad": False}
+        svc = mk(queue_limit=10_000, journal_dir=str(tmp_path))
+        with chaos.inject(point, mode=mode, on_call=1, times=2,
+                          delay_s=0.02):
+            errs = []
+
+            def drive(name):
+                try:
+                    for op in hs[name]:
+                        svc.submit(name, op)
+                except Exception as e:  # noqa: BLE001
+                    errs.append((name, e))
+
+            ts = [threading.Thread(target=drive, args=(n,))
+                  for n in hs]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            assert not errs
+            fin = svc.drain(timeout=90)
+        for name in hs:
+            got = fin["tenants"][name]["valid"]
+            # THE contract: the offline verdict or unknown — never
+            # the opposite definite verdict.
+            assert got in (want[name], "unknown"), (point, mode, name,
+                                                    got, want[name])
+        # Delay mode must not degrade at all (it is only slow).
+        if mode == "delay":
+            for name in hs:
+                assert fin["tenants"][name]["valid"] == want[name]
+
+
+# ---------------------------------------------------------------------------
+# Process-kill and device-engine chaos (slow tier).
+
+
+_KILL9_CHILD = r"""
+import json, os, random, sys
+from jepsen_tpu.devices import force_cpu_devices
+force_cpu_devices(1)
+from jepsen_tpu.models import CasRegister
+from jepsen_tpu.service import Service
+from jepsen_tpu.testing import chunked_register_history
+
+d = sys.argv[1]
+svc = Service(CasRegister(init=0), engine="host", register_live=False,
+              ledger=False, journal_dir=d)
+h = chunked_register_history(random.Random(7), n_ops=200, n_procs=2,
+                             chunk_ops=25)
+for op in h:
+    svc.submit("t", op)
+assert svc.flush(60.0)
+snap = svc.tenant_snapshot("t")
+print(json.dumps({"watermark": snap["watermark"],
+                  "verdict": snap["verdict"],
+                  "n_ops": len(h)}), flush=True)
+os.kill(os.getpid(), 9)  # kill -9: no drain, no atexit, no flush
+"""
+
+
+class TestKillNine:
+    @pytest.mark.slow
+    def test_kill9_restart_returns_journaled_verdicts(self, tmp_path):
+        # The ISSUE's acceptance pin: a kill-9'd service restarted
+        # with --journal-dir returns the journaled verdicts and
+        # watermark for a reconnecting tenant WITHOUT resubmission.
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   PYTHONPATH=REPO_ROOT)
+        proc = subprocess.run(
+            [sys.executable, "-c", _KILL9_CHILD, str(tmp_path)],
+            capture_output=True, text=True, timeout=240, env=env,
+            cwd=REPO_ROOT)
+        assert proc.returncode == -9, proc.stderr
+        child = json.loads(proc.stdout.strip().splitlines()[-1])
+        assert child["verdict"] == "True"
+
+        svc = mk(journal_dir=str(tmp_path))
+        try:
+            snap = svc.tenant_snapshot("t")
+            # The journaled fold is back, without ONE op resubmitted.
+            assert snap["resumed_from_journal"]
+            assert snap["watermark"] == child["watermark"]
+            assert snap["verdict"] == "True"
+            assert snap["ops_ingested"] == 0
+        finally:
+            svc.drain(timeout=30)
+
+
+class TestDeviceChaos:
+    @pytest.mark.slow
+    def test_host_stack_fault_retries_batch_to_same_verdicts(self):
+        # host.stack fires inside the batched pipeline's table
+        # stacking; the transient raise is retried at the whole-batch
+        # level and the verdicts are identical to the clean run.
+        from jepsen_tpu.parallel.batch import check_batch
+        from jepsen_tpu.testing import random_register_history
+
+        rng = random.Random(17)
+        m = model()
+        hists = [random_register_history(rng, n_ops=12, n_procs=3,
+                                         crash_p=0.1)
+                 for _ in range(4)]
+        clean = check_batch(m, hists, f=64)
+        with chaos.inject("host.stack", on_call=1):
+            chaotic = check_batch(m, hists, f=64)
+        assert chaos.fired("host.stack") == 1
+        assert [r["valid"] for r in chaotic] == \
+            [r["valid"] for r in clean]
+
+    @pytest.mark.slow
+    def test_device_engine_fault_fails_over_to_host(self):
+        # The full stack on the device engine: the injected fault hits
+        # the real vmapped pipeline's dispatch; the round fails over
+        # to host re-dispatch and every tenant's verdict equals
+        # offline.
+        reg = Registry()
+        hs = {"a": poisoned_valid_history(51, n_ops=100),
+              "b": poisoned_valid_history(52, n_ops=100)}
+        svc = mk(engine="device", batch_f=64, metrics=reg)
+        with chaos.inject("device.dispatch", on_call=1, times=2):
+            for name, h in hs.items():
+                for op in h:
+                    svc.submit(name, op)
+            fin = svc.drain(timeout=120)
+        for name, h in hs.items():
+            assert fin["tenants"][name]["valid"] is \
+                offline(h)["valid"] is True
+        c = reg.counter("service_failovers_total",
+                        labelnames=("engine",), aggregate=True)
+        assert c.value >= 1

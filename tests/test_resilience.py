@@ -1,0 +1,213 @@
+"""Device-dispatch resilience layer (jepsen_tpu.parallel.resilience).
+
+Unit contract: transient classification, bounded retry with backoff,
+the circuit breaker protocol (closed → open → half-open probe →
+closed/open), the shared breaker registry, and the
+``JEPSEN_NO_FAILOVER`` kill-switch. Everything here is pure host-side
+logic — no jax, no compiles."""
+
+import pytest
+
+from jepsen_tpu.parallel import resilience
+from jepsen_tpu.telemetry import Registry
+from jepsen_tpu.testing.chaos import ChaosError
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _isolate():
+    resilience.reset_breakers()
+    yield
+    resilience.reset_breakers()
+
+
+class TestTransientClassification:
+    def test_chaos_error_is_transient(self):
+        assert resilience.is_transient(ChaosError("injected"))
+
+    def test_xla_like_name_is_transient(self):
+        XlaRuntimeError = type("XlaRuntimeError", (RuntimeError,), {})
+        assert resilience.is_transient(XlaRuntimeError("boom"))
+
+    def test_status_markers_are_transient(self):
+        assert resilience.is_transient(
+            RuntimeError("RESOURCE_EXHAUSTED: out of HBM"))
+        assert resilience.is_transient(
+            RuntimeError("UNAVAILABLE: relay dropped"))
+
+    def test_deterministic_bugs_are_not(self):
+        assert not resilience.is_transient(ValueError("bad model mix"))
+        assert not resilience.is_transient(TypeError("nope"))
+        assert not resilience.is_transient(AssertionError("x"))
+
+
+class TestCall:
+    def test_retries_transient_then_succeeds(self):
+        reg = Registry()
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) < 3:
+                raise ChaosError("transient")
+            return "ok"
+
+        out = resilience.call(flaky, retries=3, base_delay_s=0.001,
+                              metrics=reg, reason="unit")
+        assert out == "ok" and len(calls) == 3
+        c = reg.counter("wgl_retry_total", labelnames=("reason",))
+        assert c.labels(reason="unit").value == 2
+
+    def test_nontransient_raises_immediately(self):
+        calls = []
+
+        def bug():
+            calls.append(1)
+            raise ValueError("deterministic")
+
+        with pytest.raises(ValueError):
+            resilience.call(bug, retries=5, base_delay_s=0.001)
+        assert len(calls) == 1
+
+    def test_retries_exhausted_reraises(self):
+        calls = []
+
+        def dead():
+            calls.append(1)
+            raise ChaosError("always")
+
+        with pytest.raises(ChaosError):
+            resilience.call(dead, retries=2, base_delay_s=0.001)
+        assert len(calls) == 3  # 1 attempt + 2 retries
+
+    def test_kill_switch_disables_retry(self, monkeypatch):
+        monkeypatch.setenv("JEPSEN_NO_FAILOVER", "1")
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            raise ChaosError("transient")
+
+        with pytest.raises(ChaosError):
+            resilience.call(flaky, retries=5, base_delay_s=0.001)
+        assert len(calls) == 1  # no retry at all
+
+
+class TestCircuitBreaker:
+    def test_opens_after_threshold_and_refuses(self):
+        b = resilience.CircuitBreaker("t", failure_threshold=3,
+                                      cooldown_s=60.0)
+        for _ in range(2):
+            b.record_failure()
+        assert b.state == "closed" and b.allow()
+        b.record_failure()
+        assert b.state == "open"
+        assert not b.allow()
+
+    def test_half_open_probe_after_cooldown(self):
+        b = resilience.CircuitBreaker("t", failure_threshold=1,
+                                      cooldown_s=0.02)
+        b.record_failure()
+        assert b.state == "open" and not b.allow()
+        import time
+
+        time.sleep(0.03)
+        assert b.allow()  # the ONE half-open probe
+        assert b.state == "half_open"
+        assert not b.allow()  # a second caller keeps demoting
+        b.record_success()
+        assert b.state == "closed" and b.allow()
+
+    def test_engaged_is_read_only_and_preserves_the_probe(self):
+        # The up-front demotion check must not consume the half-open
+        # probe: engaged() never transitions; after the cooldown it
+        # reads False and the NEXT allow() still owns the one probe.
+        b = resilience.CircuitBreaker("t", failure_threshold=1,
+                                      cooldown_s=0.02)
+        b.record_failure()
+        assert b.engaged() and b.state == "open"
+        import time
+
+        time.sleep(0.03)
+        assert not b.engaged()
+        assert b.state == "open"  # unchanged: read-only
+        assert b.allow()  # the probe is still available
+        assert b.state == "half_open"
+        assert b.engaged()  # probe in flight: others demote
+
+    def test_failed_probe_reopens(self):
+        b = resilience.CircuitBreaker("t", failure_threshold=1,
+                                      cooldown_s=0.02)
+        b.record_failure()
+        import time
+
+        time.sleep(0.03)
+        assert b.allow()
+        b.record_failure()
+        assert b.state == "open" and not b.allow()
+
+    def test_state_gauge_and_transitions(self):
+        reg = Registry()
+        b = resilience.CircuitBreaker("dev0", failure_threshold=1,
+                                      cooldown_s=60.0, metrics=reg)
+        b.record_failure()
+        g = reg.gauge("circuit_state", labelnames=("device",))
+        assert g.labels(device="dev0").value == 2  # open
+        c = reg.counter("circuit_transitions_total",
+                        labelnames=("device", "state"))
+        assert c.labels(device="dev0", state="open").value == 1
+
+    def test_nontransient_probe_failure_reopens_not_wedges(self):
+        # A half-open probe that fails NON-transiently must still
+        # resolve the probe (back to open, fresh cooldown) — leaving
+        # the breaker in half_open would refuse every later caller
+        # forever, with no call left to ever record an outcome.
+        b = resilience.CircuitBreaker("t", failure_threshold=1,
+                                      cooldown_s=0.02)
+        b.record_failure()
+        import time
+
+        time.sleep(0.03)
+
+        def probe_bug():
+            raise ValueError("deterministic probe failure")
+
+        with pytest.raises(ValueError):
+            resilience.call(probe_bug, retries=2, base_delay_s=0.001,
+                            breaker=b)
+        assert b.state == "open"  # resolved, not wedged half_open
+        time.sleep(0.03)
+        assert resilience.call(lambda: "ok", breaker=b) == "ok"
+        assert b.state == "closed"
+
+    def test_call_raises_circuit_open_without_attempt(self):
+        b = resilience.CircuitBreaker("t", failure_threshold=1,
+                                      cooldown_s=60.0)
+        b.record_failure()
+        calls = []
+        with pytest.raises(resilience.CircuitOpenError):
+            resilience.call(lambda: calls.append(1), breaker=b)
+        assert not calls  # no doomed dispatch
+
+    def test_kill_switch_bypasses_open_breaker(self, monkeypatch):
+        b = resilience.CircuitBreaker("t", failure_threshold=1,
+                                      cooldown_s=60.0)
+        b.record_failure()
+        monkeypatch.setenv("JEPSEN_NO_FAILOVER", "1")
+        assert b.allow()  # rollback semantics: breaker inert
+        assert resilience.call(lambda: "ran", breaker=b) == "ran"
+
+
+class TestRegistry:
+    def test_breaker_is_shared_by_key(self):
+        a = resilience.breaker("batch")
+        b = resilience.breaker("batch")
+        assert a is b
+        assert resilience.breaker("sharded") is not a
+
+    def test_metrics_attach_lazily(self):
+        b = resilience.breaker("batch")
+        assert b.metrics is None
+        reg = Registry()
+        assert resilience.breaker("batch", metrics=reg).metrics is reg

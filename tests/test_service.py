@@ -449,11 +449,16 @@ class TestHTTPIngestion:
         assert st == 200
         assert fin["tenants"]["alpha"]["valid"] is True
         assert fin["tenants"]["beta"]["valid"] is True
-        # Post-drain ingest answers the typed 503.
+        # Post-drain ingest answers the typed 503, with the fixed
+        # drain hint in Retry-After (satellite: 429/503 responses
+        # carry the standard backoff header).
         with pytest.raises(urllib.error.HTTPError) as e:
             post("/submit/alpha", self.ndjson(ha[:2]))
         assert e.value.code == 503
-        assert json.loads(e.value.read().decode())["error"] == "draining"
+        assert int(e.value.headers.get("Retry-After")) >= 1
+        doc = json.loads(e.value.read().decode())
+        assert doc["error"] == "draining"
+        assert doc["retry_after_s"] >= 1
 
     def test_over_quota_maps_to_429_with_resume_point(self):
         svc = mk(quota_ops_per_s=50.0, quota_burst=4.0)
@@ -470,10 +475,17 @@ class TestHTTPIngestion:
             with pytest.raises(urllib.error.HTTPError) as e:
                 urllib.request.urlopen(req, timeout=10)
             assert e.value.code == 429
+            # Retry-After rides the 429: the token bucket's own refill
+            # estimate (integral seconds, never 0), next to the
+            # retryable flag — a well-behaved client backs off by the
+            # server's estimate instead of guessing.
+            ra = e.value.headers.get("Retry-After")
+            assert ra is not None and int(ra) >= 1
             doc = json.loads(e.value.read().decode())
             assert doc["error"] == "quota_exceeded"
             assert doc["accepted"] == 4  # the client's resume point
             assert doc["retryable"] is True
+            assert doc["retry_after_s"] >= 0
         finally:
             srv.shutdown()
             srv.server_close()
