@@ -46,6 +46,21 @@ import time
 
 N_OPS = int(os.environ.get("BENCH_N_OPS", "10000"))
 BASELINE_S = 300.0
+# Device-slow guard (r13): on a CPU-only dev box the device legs run
+# the same XLA programs at 10-100x their TPU wall (the smoke's 8x10k
+# escalation ladder alone would eat the whole budget deciding
+# nothing). Setting BENCH_DEVICE_SLOW_S=<seconds> skips every device
+# leg whose WORST-CASE cost (the same per-leg estimate the budget
+# checks use) exceeds it, recording {"skipped": "device_slow_guard"}
+# so the round — and the advisor — show WHY the device columns are
+# holes. 0 (the default) disables the guard; TPU boxes never set it.
+DEVICE_SLOW_S = float(os.environ.get("BENCH_DEVICE_SLOW_S", "0") or 0)
+
+
+def _device_slow(worst_case_s: float) -> bool:
+    return 0 < DEVICE_SLOW_S < worst_case_s
+
+
 # r6: the device scale metric runs under the SAME 300 s definition as
 # the native one (it had a 160 s sub-budget before), and a
 # frontier-sharded entry joins it — the default budget grows to hold
@@ -434,6 +449,13 @@ def main() -> int:
                     fin2["ops_to_detection"] / len(obad), 4)
                 if fin2.get("ops_to_detection") else None,
             }
+            # Why-unknown provenance (docs/verdicts.md): the monitored
+            # pass's cause Pareto, when anything degraded — the
+            # advisor's first input.
+            for src, key in ((fin, "provenance"),
+                             (fin2, "detected_provenance")):
+                if src.get("provenance"):
+                    out["online_10k"][key] = src["provenance"]
         except Exception as e:  # noqa: BLE001
             out["online_10k"] = {"error": f"{type(e).__name__}: {e}"}
 
@@ -542,6 +564,9 @@ def main() -> int:
                 "failover_rounds": sum(
                     1 for ev in rounds if ev.get("failover")),
             }
+            if fin.get("provenance"):
+                # Service-wide why-unknown Pareto (docs/verdicts.md).
+                out["service_streams"]["provenance"] = fin["provenance"]
         except Exception as e:  # noqa: BLE001
             out["service_streams"] = {"error": f"{type(e).__name__}: {e}"}
         finally:
@@ -575,7 +600,10 @@ def main() -> int:
         # (BASELINE config 5). Worst case ~90 s (compile + 2 runs).
         _REC.begin("batch_replay_100")
         try:
-            if _left() < 100 or not devices_ok:
+            if _device_slow(100):
+                out["batch_replay_100"] = {
+                    "skipped": "device_slow_guard"}
+            elif _left() < 100 or not devices_ok:
                 out["batch_replay_100"] = {"skipped": "budget"}
             else:
                 from jepsen_tpu.parallel import check_batch
@@ -616,7 +644,10 @@ def main() -> int:
         # smoke bounds memory, not verdicts).
         _REC.begin("batch_replay_large")
         try:
-            if _left() < 150 or not devices_ok:
+            if _device_slow(150):
+                out["batch_replay_large"] = {
+                    "skipped": "device_slow_guard"}
+            elif _left() < 150 or not devices_ok:
                 out["batch_replay_large"] = {"skipped": "budget"}
             else:
                 from jepsen_tpu.parallel import check_batch
@@ -722,6 +753,22 @@ def main() -> int:
                                 (r["rungs"] for r in rsS
                                  if r.get("rungs")), None),
                         }
+                        try:
+                            from jepsen_tpu.checker import \
+                                provenance as _sprov
+
+                            cc: dict = {}
+                            for r in rsS:
+                                if r.get("valid") == "unknown":
+                                    _sprov.add_counts(
+                                        cc, _sprov.ensure(
+                                            _sprov.of(r)))
+                            if cc:
+                                # Why the undecided members stayed
+                                # unknown (the advisor reads this).
+                                smoke["provenance"] = _sprov.block(cc)
+                        except Exception:  # noqa: BLE001
+                            pass
                     except _Deadline as dl:
                         smoke = {
                             "value_s": round(
@@ -766,7 +813,7 @@ def main() -> int:
                         # path — lexical order misplaces r10 vs r9.
                         _prev_files = sorted(_glob.glob(os.path.join(
                             os.path.dirname(os.path.abspath(__file__)),
-                            "BENCH_r*.json")), key=_bc.round_label)
+                            "BENCH_r*.json")), key=_bc.round_sort_key)
                         if _prev_files:
                             _prev = _bc.extract(_bc.load_round(
                                 _prev_files[-1])["data"])
@@ -793,7 +840,9 @@ def main() -> int:
         # ~60 s.
         _REC.begin("elle_txn")
         try:
-            if _left() < 70 or not devices_ok:
+            if _device_slow(70):
+                out["elle_txn"] = {"skipped": "device_slow_guard"}
+            elif _left() < 70 or not devices_ok:
                 out["elle_txn"] = {"skipped": "budget"}
             else:
                 from jepsen_tpu import txn as jtxn
@@ -856,7 +905,9 @@ def main() -> int:
         # ~120 s (two BFS passes of ~3.6k levels).
         _REC.begin("mutex_5k")
         try:
-            if _left() < 130 or not devices_ok:
+            if _device_slow(130):
+                out["mutex_5k"] = {"skipped": "device_slow_guard"}
+            elif _left() < 130 or not devices_ok:
                 out["mutex_5k"] = {"skipped": "budget"}
             else:
                 from jepsen_tpu.models import OwnerAwareMutex
@@ -881,7 +932,10 @@ def main() -> int:
         # warm pass; a steady-state second pass only if budget remains.
         _REC.begin("device_kernel")
         try:
-            if _left() < 110 or not devices_ok:
+            if _device_slow(110):
+                out["device_kernel_s"] = None
+                out["device_kernel_note"] = "skipped: device_slow_guard"
+            elif _left() < 110 or not devices_ok:
                 out["device_kernel_s"] = None
                 out["device_kernel_note"] = "skipped: budget"
             else:
@@ -1050,7 +1104,10 @@ def main() -> int:
         # running out of room) is reported as cap_s.
         _REC.begin("max_verified_ops_device")
         try:
-            if _left() < 260 or not devices_ok:
+            if _device_slow(260):
+                out["max_verified_ops_device"] = {
+                    "skipped": "device_slow_guard"}
+            elif _left() < 260 or not devices_ok:
                 out["max_verified_ops_device"] = {"skipped": "budget"}
             else:
                 leg_end = time.monotonic() + min(420, _left() - 130)
@@ -1124,7 +1181,10 @@ def main() -> int:
         # driver's chunk callback.
         _REC.begin("max_verified_ops_device_sharded")
         try:
-            if _left() < 180 or not devices_ok:
+            if _device_slow(180):
+                out["max_verified_ops_device_sharded"] = {
+                    "skipped": "device_slow_guard"}
+            elif _left() < 180 or not devices_ok:
                 out["max_verified_ops_device_sharded"] = {
                     "skipped": "budget"}
             else:

@@ -1,0 +1,488 @@
+"""Config advisor: turn the observability stack's data into concrete
+configuration recommendations.
+
+``python -m jepsen_tpu.advisor [BENCH_r*.json ...]`` joins four data
+sources the repo already produces —
+
+- **verdict provenance** (``provenance`` blocks / cause Paretos — the
+  PR-13 why-unknown taxonomy, docs/verdicts.md),
+- **roofline attribution** (``device_attribution`` — which chunks were
+  latency- vs bandwidth-bound, docs/profiling.md),
+- **utilization gap classes** (``gap_share`` — no-work / starved /
+  host-stacking / compiling idle attribution),
+- **trajectory trends** (the committed ``BENCH_r*.json`` rounds via
+  ``jepsen_tpu.benchcmp`` and ``store/ledger.jsonl`` via
+  ``jepsen_tpu.telemetry.ledger``)
+
+— and emits recommendations like "83% of unknowns are
+``overflow_top_rung`` → extend ``f_schedule``" or "idle gaps classify
+as host-stacking → grow ``batch_f``". Every rule is a pure function
+over those inputs, pinned closed-form in tests/test_advisor.py
+(synthetic provenance + utilization inputs → known advice), and the
+whole CLI is read-only: it never mutates a store or a config. This is
+exactly the data seam the ROADMAP-item-5 self-tuning policy will later
+automate — the advisor prints what that policy would do.
+
+Severity: ``high`` = verdicts are being lost to a tunable budget,
+``medium`` = throughput/latency is being left on the table, ``info`` =
+hygiene (baseline gaps, cadence).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob as _glob
+import json
+import os
+import sys
+from typing import Any, Callable, Optional
+
+from .checker import provenance as _prov
+
+# Gap-attribution share past which an idle class is "dominating" a
+# leg's device timeline and worth acting on.
+GAP_SHARE_THRESHOLD = 0.25
+# Provenance share past which one cause code dominates the unknowns.
+CAUSE_SHARE_THRESHOLD = 0.5
+# p99/p50 decision-latency ratio past which the tail is pathological.
+TAIL_RATIO_THRESHOLD = 20.0
+
+
+# ---------------------------------------------------------------------------
+# Input gathering (pure walks over the bench/round dicts).
+
+
+def collect_provenance(doc: Any) -> dict[str, int]:
+    """Union every ``provenance`` block's cause counts found anywhere
+    in a bench/result document."""
+    counts: dict[str, int] = {}
+
+    def walk(d: Any) -> None:
+        if isinstance(d, dict):
+            prov = d.get("provenance")
+            if isinstance(prov, dict) and isinstance(
+                    prov.get("causes"), dict):
+                for code, n in prov["causes"].items():
+                    if isinstance(n, (int, float)):
+                        counts[code] = counts.get(code, 0) + int(n)
+            for k, v in d.items():
+                if k != "provenance":
+                    walk(v)
+        elif isinstance(d, list):
+            for v in d:
+                walk(v)
+
+    walk(doc)
+    return counts
+
+
+def collect_gap_shares(doc: Any) -> dict[str, float]:
+    """Max share per idle-gap class across every ``gap_share`` /
+    ``gap_attribution_share`` block in the document (max, not mean: one
+    leg's pathology should not be averaged away by quiet legs)."""
+    shares: dict[str, float] = {}
+
+    def walk(d: Any) -> None:
+        if isinstance(d, dict):
+            for key in ("gap_share", "device_gap_share",
+                        "gap_attribution_share"):
+                g = d.get(key)
+                if isinstance(g, dict):
+                    for cls, v in g.items():
+                        if isinstance(v, (int, float)):
+                            shares[cls] = max(shares.get(cls, 0.0),
+                                              float(v))
+            for v in d.values():
+                walk(v)
+        elif isinstance(d, list):
+            for v in d:
+                walk(v)
+
+    walk(doc)
+    return shares
+
+
+def collect_skipped_legs(doc: Any) -> list[str]:
+    """Leg names whose section reports ``{"skipped": ...}`` (budget,
+    device_slow_guard, unreachable backend)."""
+    out = []
+    for name, v in (doc.items() if isinstance(doc, dict) else ()):
+        if isinstance(v, dict) and v.get("skipped"):
+            out.append(f"{name} ({v['skipped']})")
+        elif isinstance(v, dict):
+            out.extend(f"{name}.{s}" for s in collect_skipped_legs(v))
+    return out
+
+
+def _latency_tails(doc: Any) -> list[tuple[str, float, float]]:
+    """(leg, p50, p99) for every decision-latency summary present."""
+    out = []
+    for leg in ("online_10k", "service_streams"):
+        d = doc.get(leg) if isinstance(doc, dict) else None
+        if not isinstance(d, dict):
+            continue
+        p50 = d.get("p50_decision_latency_s")
+        p99 = d.get("p99_decision_latency_s")
+        if isinstance(p50, (int, float)) and isinstance(
+                p99, (int, float)) and p50 > 0:
+            out.append((leg, float(p50), float(p99)))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Rules: each is (id, fn(ctx) -> Optional[recommendation dict]).
+# ctx = {"bench": newest round dict, "rounds": benchcmp merged rounds,
+#        "comparisons": newest adjacent benchcmp delta block or None,
+#        "ledger": ledger records}.
+
+
+def _share(counts: dict[str, int], *codes: str) -> float:
+    total = sum(counts.values())
+    return (sum(counts.get(c, 0) for c in codes) / total) if total else 0.0
+
+
+def rule_extend_f_schedule(ctx: dict) -> Optional[dict]:
+    counts = ctx["provenance"]
+    share = _share(counts, "overflow_top_rung", "beam_loss",
+                   "escalation_budget")
+    if share < CAUSE_SHARE_THRESHOLD or not counts:
+        return None
+    return {
+        "severity": "high",
+        "title": "unknowns are capacity-bound — extend the frontier "
+                 "schedule",
+        "advice": "the dominant unknown causes are frontier-capacity "
+                  "exhaustion (overflow_top_rung / beam_loss / "
+                  "escalation_budget): extend `f_schedule` past its "
+                  "top rung (or raise `f_total` / `max_escalations` "
+                  "for the sharded driver) so the search can keep "
+                  "escalating losslessly instead of giving up",
+        "evidence": {"share_pct": round(share * 100, 1),
+                     "causes": counts},
+    }
+
+
+def rule_raise_max_configs(ctx: dict) -> Optional[dict]:
+    counts = ctx["provenance"]
+    share = _share(counts, "max_configs", "carry_lost")
+    if share < CAUSE_SHARE_THRESHOLD or not (
+            counts.get("max_configs") or counts.get("carry_lost")):
+        return None
+    # carry_lost cascades from an initial enumeration-budget trip: the
+    # root fix is the same knob.
+    return {
+        "severity": "high",
+        "title": "unknowns are enumeration-budget-bound — raise "
+                 "max_configs",
+        "advice": "the dominant unknown causes are `max_configs` trips "
+                  "and the `carry_lost` cascade they trigger (a key "
+                  "whose carry is lost folds every later segment "
+                  "unknown): raise `max_configs` on the "
+                  "checker/monitor/service so enumeration completes "
+                  "and carries survive",
+        "evidence": {"share_pct": round(share * 100, 1),
+                     "causes": counts},
+    }
+
+
+def rule_grow_batch_f(ctx: dict) -> Optional[dict]:
+    shares = ctx["gap_shares"]
+    v = shares.get("host-stacking", 0.0)
+    if v <= GAP_SHARE_THRESHOLD:
+        return None
+    return {
+        "severity": "medium",
+        "title": "idle gaps classify as host-stacking — grow batch_f",
+        "advice": "devices idle while the host stacks the next "
+                  "bucket's tables: grow `batch_f` (fewer, larger "
+                  "rungs amortize the stacking) or widen the "
+                  "double-buffered build window",
+        "evidence": {"host_stacking_share": v, "gap_shares": shares},
+    }
+
+
+def rule_feed_starved(ctx: dict) -> Optional[dict]:
+    shares = ctx["gap_shares"]
+    v = shares.get("starved", 0.0)
+    if v <= GAP_SHARE_THRESHOLD:
+        return None
+    return {
+        "severity": "medium",
+        "title": "devices starve with backlog present — feed wider "
+                 "rounds",
+        "advice": "devices sat idle while undecided segments were "
+                  "backlogged: raise `max_inflight_segments` / "
+                  "`max_ready_per_tenant` so dispatch rounds fill, or "
+                  "add tenants/keys so the co-batching scheduler has "
+                  "independent members to pack",
+        "evidence": {"starved_share": v, "gap_shares": shares},
+    }
+
+
+def rule_prewarm_compiles(ctx: dict) -> Optional[dict]:
+    shares = ctx["gap_shares"]
+    v = shares.get("compiling", 0.0)
+    if v <= GAP_SHARE_THRESHOLD:
+        return None
+    return {
+        "severity": "medium",
+        "title": "idle gaps classify as compiling — pre-warm the "
+                 "kernel cache",
+        "advice": "a large idle share is jit compiles: pre-warm the "
+                  "capacity buckets the workload actually uses (run a "
+                  "tiny history through each rung first) and keep the "
+                  "persistent XLA compile cache across runs",
+        "evidence": {"compiling_share": v, "gap_shares": shares},
+    }
+
+
+def rule_device_baseline_missing(ctx: dict) -> Optional[dict]:
+    skipped = ctx["skipped_legs"]
+    dev = [s for s in skipped if "device_slow_guard" in s
+           or "budget" in s]
+    if not dev:
+        return None
+    return {
+        "severity": "info",
+        "title": "device legs skipped — the round has no device "
+                 "baseline",
+        "advice": "this round's device legs were skipped (CPU-only box "
+                  "behind `BENCH_DEVICE_SLOW_S`, or budget): run one "
+                  "round on TPU hardware with the guard unset so "
+                  "benchcmp and the ledger regain device/utilization "
+                  "baselines",
+        "evidence": {"skipped": dev},
+    }
+
+
+def rule_round_cadence(ctx: dict) -> Optional[dict]:
+    rounds = ctx["rounds"]
+    if len(rounds) < 2:
+        return None
+    import re
+
+    nums = []
+    for r in rounds:
+        m = re.match(r"r(\d+)$", r.get("label") or "")
+        if m:
+            nums.append(int(m.group(1)))
+    if len(nums) < 2 or nums[-1] - nums[-2] <= 1:
+        return None
+    return {
+        "severity": "info",
+        "title": "bench-round cadence gap — intermediate rounds were "
+                 "never committed",
+        "advice": f"the committed trajectory jumps r{nums[-2]:02d} → "
+                  f"r{nums[-1]:02d}: commit a BENCH round with each "
+                  "PR so benchcmp and the ledger gate regressions at "
+                  "PR granularity instead of epoch granularity",
+        "evidence": {"labels": [r["label"] for r in rounds]},
+    }
+
+
+def rule_trend_regressions(ctx: dict) -> Optional[dict]:
+    cmpb = ctx["comparison"]
+    if not cmpb or not cmpb.get("regressions"):
+        return None
+    return {
+        "severity": "medium",
+        "title": "trajectory regressions vs the previous committed "
+                 "round",
+        "advice": "metrics regressed past the gate threshold between "
+                  f"{cmpb.get('from')} and {cmpb.get('to')}: "
+                  + ", ".join(cmpb["regressions"])
+                  + " — bisect with `python -m jepsen_tpu.benchcmp` "
+                    "and the per-leg ledger trend "
+                    "(`python -m jepsen_tpu.ledger`)",
+        "evidence": {k: cmpb.get(k)
+                     for k in ("from", "to", "regressions")},
+    }
+
+
+def rule_failover_review(ctx: dict) -> Optional[dict]:
+    counts = ctx["provenance"]
+    hit = {c: counts[c] for c in
+           ("failover_exhausted", "worker_died", "round_failed")
+           if counts.get(c)}
+    if not hit:
+        return None
+    return {
+        "severity": "high",
+        "title": "verdicts lost to pipeline faults, not budgets",
+        "advice": "unknowns were caused by failed rounds / exhausted "
+                  "failover / a dead worker — these are infrastructure "
+                  "faults, not tuning: check device health and the "
+                  "circuit-breaker counters (`circuit_state`, "
+                  "`wgl_retry_total`), and confirm "
+                  "`JEPSEN_NO_FAILOVER` is unset",
+        "evidence": {"causes": hit},
+    }
+
+
+def rule_journal_durability(ctx: dict) -> Optional[dict]:
+    counts = ctx["provenance"]
+    if not counts.get("journal_gap"):
+        return None
+    return {
+        "severity": "high",
+        "title": "journal gaps detected — durability is losing "
+                 "verdicts across restarts",
+        "advice": "replay found swallowed journal appends "
+                  "(journal_gap): the restored folds are pinned off "
+                  "definite-True. Check disk space/health under "
+                  "--journal-dir and consider --journal-fsync",
+        "evidence": {"journal_gap": counts["journal_gap"]},
+    }
+
+
+def rule_latency_tail(ctx: dict) -> Optional[dict]:
+    tails = [(leg, p50, p99) for leg, p50, p99 in ctx["latency_tails"]
+             if p99 / p50 > TAIL_RATIO_THRESHOLD]
+    if not tails:
+        return None
+    return {
+        "severity": "medium",
+        "title": "decision-latency tail is pathological "
+                 f"(p99/p50 > {TAIL_RATIO_THRESHOLD:g}x)",
+        "advice": "a small fraction of ops waits orders of magnitude "
+                  "longer for coverage: check the watermark-stall "
+                  "detector and the starved/host-stacking gap shares, "
+                  "and bound per-round work with "
+                  "`max_ready_per_tenant` so one flood cannot hold "
+                  "every tenant's tail hostage",
+        "evidence": {leg: {"p50_s": p50, "p99_s": p99,
+                           "ratio": round(p99 / p50, 1)}
+                     for leg, p50, p99 in tails},
+    }
+
+
+RULES: list[tuple[str, Callable[[dict], Optional[dict]]]] = [
+    ("extend_f_schedule", rule_extend_f_schedule),
+    ("raise_max_configs", rule_raise_max_configs),
+    ("failover_review", rule_failover_review),
+    ("journal_durability", rule_journal_durability),
+    ("grow_batch_f", rule_grow_batch_f),
+    ("feed_starved", rule_feed_starved),
+    ("prewarm_compiles", rule_prewarm_compiles),
+    ("trend_regressions", rule_trend_regressions),
+    ("latency_tail", rule_latency_tail),
+    ("device_baseline_missing", rule_device_baseline_missing),
+    ("round_cadence", rule_round_cadence),
+]
+
+_SEV_ORDER = {"high": 0, "medium": 1, "info": 2}
+
+
+def advise(bench: dict, rounds: Optional[list] = None,
+           comparison: Optional[dict] = None,
+           ledger_records: Optional[list] = None) -> list[dict]:
+    """Run every rule over one bench/result document (+ optional
+    trajectory context); returns recommendations sorted most severe
+    first. Pure — safe to pin closed-form in tests."""
+    ctx = {
+        "bench": bench or {},
+        "rounds": rounds or [],
+        "comparison": comparison,
+        "ledger": ledger_records or [],
+        "provenance": collect_provenance(bench or {}),
+        "gap_shares": collect_gap_shares(bench or {}),
+        "skipped_legs": collect_skipped_legs(bench or {}),
+        "latency_tails": _latency_tails(bench or {}),
+    }
+    out = []
+    for rid, fn in RULES:
+        rec = fn(ctx)
+        if rec is not None:
+            rec["id"] = rid
+            out.append(rec)
+    out.sort(key=lambda r: (_SEV_ORDER.get(r["severity"], 9), r["id"]))
+    return out
+
+
+def render(recs: list[dict]) -> str:
+    if not recs:
+        return ("no recommendations — no degraded verdicts, idle "
+                "pathologies or trajectory regressions in the inputs")
+    lines = []
+    for i, r in enumerate(recs, 1):
+        lines.append(f"{i}. [{r['severity']}] {r['title']}  "
+                     f"(id: {r['id']})")
+        lines.append(f"   {r['advice']}")
+        ev = json.dumps(r.get("evidence") or {}, sort_keys=True,
+                        default=str)
+        if len(ev) > 300:
+            ev = ev[:297] + "..."
+        lines.append(f"   evidence: {ev}")
+    return "\n".join(lines)
+
+
+def main(argv: Optional[list] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m jepsen_tpu.advisor",
+        description="Join verdict provenance, roofline attribution, "
+                    "utilization gap classes and bench/ledger trends "
+                    "into concrete config recommendations.")
+    p.add_argument("artifacts", nargs="*",
+                   help="BENCH_r*.json round files (default: the "
+                        "repo's committed rounds; the newest round is "
+                        "advised, the rest provide trend context)")
+    p.add_argument("--ledger", default=None,
+                   help="ledger.jsonl path (default: the store's)")
+    p.add_argument("--json", action="store_true", dest="as_json")
+    ns = p.parse_args(argv)
+
+    from . import benchcmp as _bc
+
+    paths = ns.artifacts or sorted(
+        _glob.glob(os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "..",
+            "BENCH_r*.json")), key=_bc.round_sort_key)
+    if not paths:
+        print("advisor: no bench artifacts found — pass BENCH_r*.json "
+              "paths (or run from the repo)", file=sys.stderr)
+        return 2
+    try:
+        rounds = [_bc.load_round(a) for a in
+                  sorted(paths, key=_bc.round_sort_key)]
+    except (OSError, ValueError) as e:
+        print(f"advisor: cannot read artifacts: {e}", file=sys.stderr)
+        return 2
+    merged = _bc._merge_rounds(rounds)
+    # Advise over the newest BENCH artifact: a same-round MULTICHIP
+    # wrapper sorts after it lexically but carries no provenance /
+    # gap-share / leg data — advising over it would silently blank
+    # every rule.
+    newest = next((r for r in reversed(rounds) if r["kind"] == "bench"),
+                  rounds[-1])
+    comparison = None
+    if len(merged) >= 2:
+        block = _bc.deltas(merged[-2]["metrics"], merged[-1]["metrics"])
+        comparison = {"from": merged[-2]["label"],
+                      "to": merged[-1]["label"], "deltas": block,
+                      "regressions": _bc.regressions(block)}
+    try:
+        from .telemetry import ledger as _ledger
+
+        ledger_records = _ledger.load(ns.ledger) if ns.ledger \
+            else _ledger.load()
+    except Exception:  # noqa: BLE001 - the ledger is optional context
+        ledger_records = []
+    recs = advise(newest["data"], rounds=merged, comparison=comparison,
+                  ledger_records=ledger_records)
+    if ns.as_json:
+        print(json.dumps({
+            "round": newest["label"],
+            "recommendations": recs,
+            "provenance": collect_provenance(newest["data"]),
+            "gap_shares": collect_gap_shares(newest["data"]),
+        }, indent=1, sort_keys=True, default=str))
+    else:
+        print(f"== advisor over {newest['label']} "
+              f"({os.path.basename(newest['path'])}; "
+              f"{len(merged)} round(s) of context)")
+        print(render(recs))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -185,6 +185,15 @@ def round_label(path: str) -> str:
     return os.path.splitext(os.path.basename(path))[0]
 
 
+def round_sort_key(path: str) -> tuple:
+    """NUMERIC ordering key for round artifacts — the label string is
+    only 2-padded, so sorting by it (or by raw path) misplaces r100
+    vs r99; every 'newest round' lookup must sort with this."""
+    m = re.search(r"_r(\d+)", os.path.basename(path))
+    return ((int(m.group(1)) if m else 10 ** 9),
+            os.path.basename(path))
+
+
 def load_round(path: str) -> dict:
     """One artifact -> {"label", "path", "data", "kind"}; ``data`` is
     the flat bench dict (possibly recovered), ``{}`` when nothing in the
@@ -231,7 +240,9 @@ def extract(data: dict) -> dict:
 
 def _merge_rounds(rounds: list[dict]) -> list[dict]:
     """Merge same-label artifacts (BENCH + MULTICHIP of one round) into
-    one column, sorted by label."""
+    one column, in NUMERIC round order (the 2-padded label sorts r100
+    before r99 lexically — the gate would compare the newest pair
+    backwards)."""
     by_label: dict[str, dict] = {}
     for r in rounds:
         tgt = by_label.setdefault(
@@ -239,7 +250,8 @@ def _merge_rounds(rounds: list[dict]) -> list[dict]:
                          "paths": []})
         tgt["paths"].append(r["path"])
         tgt["metrics"].update(extract(r["data"]))
-    return [by_label[k] for k in sorted(by_label)]
+    return sorted(by_label.values(),
+                  key=lambda m: round_sort_key(m["paths"][0]))
 
 
 def deltas(prev: dict, cur: dict,
@@ -286,7 +298,10 @@ def vs_previous(current: dict, artifact_glob: str = "BENCH_r*.json",
     regression is self-reported inside the new round's own JSON line.
     None when no prior artifact exists or none parses."""
     root = root or os.path.dirname(os.path.abspath(__file__)) + "/.."
-    paths = sorted(glob.glob(os.path.join(root, artifact_glob)))
+    # Numeric round order — lexical path (or 2-padded label) order
+    # misplaces r9 vs r10 (and r99 vs r100).
+    paths = sorted(glob.glob(os.path.join(root, artifact_glob)),
+                   key=round_sort_key)
     if not paths:
         return None
     prev = load_round(paths[-1])
@@ -342,7 +357,7 @@ def main(argv: Optional[list] = None) -> int:
         prog="python -m jepsen_tpu.benchcmp",
         description="Render the bench-round trajectory and gate on "
                     "regressions.")
-    p.add_argument("artifacts", nargs="+",
+    p.add_argument("artifacts", nargs="*",
                    help="BENCH_r*.json / MULTICHIP_r*.json round files")
     p.add_argument("--threshold", type=float, default=DEFAULT_THRESHOLD,
                    help="regression threshold as a fraction "
@@ -360,9 +375,16 @@ def main(argv: Optional[list] = None) -> int:
         print(f"benchcmp: cannot read artifacts: {e}", file=sys.stderr)
         return 2
     merged = _merge_rounds(rounds)
-    if len(merged) == 0:
-        print("benchcmp: no rounds", file=sys.stderr)
-        return 2
+    if len(merged) < 2:
+        # A fresh repo (or a CI invocation before the second committed
+        # round) has nothing to gate: that is a clean no-op, not a
+        # failure — exit 0 so pipelines can call benchcmp
+        # unconditionally.
+        print(f"benchcmp: nothing to compare — {len(merged)} round(s) "
+              "given, need at least 2 committed rounds")
+        if merged:
+            print(render_table(merged))
+        return 0
 
     comparisons = []
     for prev, cur in zip(merged, merged[1:]):

@@ -1,0 +1,270 @@
+"""Verdict provenance: a CLOSED taxonomy of machine-readable causes for
+every degraded verdict in the checking pipeline.
+
+The checker's product is a verdict and its failure mode is ``unknown``
+— after the escalation pipeline, the online fold, the multi-tenant
+service and the fault-tolerance layer there are a dozen distinct
+degradation paths, and each used to record its cause as a free-text
+``info`` string no policy could consume. This module replaces that
+prose with typed *causes*: every site that degrades a verdict attaches
+``cause(code, **params)`` (a dict: ``code`` from :data:`TAXONOMY`,
+``layer``, ``params`` — including the PR-6 trace ids where the fold has
+them), and the scheduler / service folds union causes up to per-key,
+per-segment, per-tenant and per-run *provenance* blocks
+(``{"causes": {code: count}, "dominant": code, "total": n}``).
+
+Consumers:
+
+- ``verdict_causes_total{code,tenant}`` — one counter family (aggregate
+  unlabeled total; ``tenant=""`` for non-service paths) every fold
+  layer increments, so a dashboard sees the cause Pareto live;
+- results / ``online.json`` / tenant snapshots / ledger records embed
+  the ``provenance`` block; the web ``/verdicts`` page renders the
+  Pareto with deep links into the op→segment→member→chunk trace chain;
+- ``python -m jepsen_tpu.advisor`` joins provenance with the roofline
+  attribution, utilization gap classes and ledger trends to emit
+  concrete configuration recommendations — the data seam the
+  ROADMAP-item-5 self-tuning policy will automate.
+
+The taxonomy is CLOSED: :func:`cause` refuses unknown codes, so a new
+degradation path must register its code (and document it in
+docs/verdicts.md) before it can ship an unknown. ``unattributed``
+exists as the mechanical backstop for a fold that received an unknown
+with no structured cause — the chaos matrix asserts it never actually
+appears (no pipeline path may produce a free-text-only unknown).
+
+See docs/verdicts.md for the full taxonomy table and fold semantics.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterable, Optional
+
+# code -> (layer, description). Layers name the subsystem that OWNS the
+# degradation (where the advisor's fix applies), not where it was
+# observed.
+TAXONOMY: dict[str, tuple[str, str]] = {
+    # -- kernel / device search -------------------------------------------
+    "overflow_top_rung": (
+        "kernel",
+        "frontier overflowed the capacity schedule's top rung (or "
+        "escalation was disabled at the shared batch capacity)"),
+    "escalation_budget": (
+        "kernel",
+        "lossless capacity escalations exhausted (sharded "
+        "max_escalations spent without a verdict)"),
+    "beam_loss": (
+        "kernel",
+        "lossy beam exhausted after truncation — configs were dropped, "
+        "so exhaustion is not a refutation"),
+    "level_budget": (
+        "kernel",
+        "level budget exhausted without a verdict"),
+    # -- host / native enumeration ----------------------------------------
+    "max_configs": (
+        "host",
+        "host/native enumeration config budget exhausted"),
+    "oom": (
+        "host",
+        "native engine out of memory"),
+    # -- encoding ----------------------------------------------------------
+    "encoding_unsupported": (
+        "encode",
+        "history/model does not fit the device encoding (plan "
+        "rejected, unreadable archive, or model mismatch)"),
+    # -- online fold --------------------------------------------------------
+    "carry_lost": (
+        "online",
+        "carried initial-state set lost (budget-tripped enumeration, "
+        "or an unknown upstream segment of the same key)"),
+    "poisoned_key": (
+        "online",
+        "the stream's carries are poisoned (unaddressable journal key "
+        "or replay poison): every later segment folds unknown"),
+    "mixed_keys": (
+        "online",
+        "mixed keyed/keyless stream: the online split cannot match "
+        "independent.subhistory, no definite verdict is safe"),
+    # -- scheduler / failover ----------------------------------------------
+    "round_failed": (
+        "scheduler",
+        "a dispatch round raised; its segments fold unknown and their "
+        "keys' carries are lost"),
+    "worker_died": (
+        "scheduler",
+        "the scheduler worker died past its bounded restart; streams "
+        "fold unknown"),
+    "failover_exhausted": (
+        "scheduler",
+        "the failover host re-dispatch also failed for this member"),
+    # -- service ------------------------------------------------------------
+    "lost_segments": (
+        "service",
+        "segments refused by a closed scheduler; a definite True can "
+        "no longer cover the stream"),
+    "undelivered_ops": (
+        "service",
+        "accepted ops never fed through the segmenter (drain deadline "
+        "truncated the stream)"),
+    "deadline": (
+        "service",
+        "a deadline truncated decision coverage (close/drain timed "
+        "out with work in flight)"),
+    # -- journal ------------------------------------------------------------
+    "journal_gap": (
+        "journal",
+        "journal replay detected swallowed appends (seq gap); the "
+        "restored fold is pinned off definite-True"),
+    # -- testing ------------------------------------------------------------
+    "chaos": (
+        "testing",
+        "an injected chaos fault was the proximate cause"),
+    # -- backstop ------------------------------------------------------------
+    "unattributed": (
+        "unknown",
+        "an unknown reached the fold with no structured cause — a "
+        "taxonomy hole (file it; the chaos matrix asserts this never "
+        "appears)"),
+}
+
+# Bounded per-row cause list (the per-stream counts stay exact).
+MAX_CAUSES_PER_ROW = 8
+
+METRIC_NAME = "verdict_causes_total"
+_METRIC_HELP = ("Degraded-verdict causes by taxonomy code (see "
+                "docs/verdicts.md); tenant=\"\" for non-service paths, "
+                "unlabeled = all codes and tenants")
+
+
+def cause(code: str, **params: Any) -> dict:
+    """One typed cause. ``code`` must be in the closed
+    :data:`TAXONOMY`; ``params`` are JSON-scalar diagnostics (capacity
+    F, budget, seq, trace_span, …)."""
+    try:
+        layer, _desc = TAXONOMY[code]
+    except KeyError:
+        raise ValueError(
+            f"unknown provenance code {code!r}; the taxonomy is closed "
+            f"— register it in provenance.TAXONOMY (known: "
+            f"{sorted(TAXONOMY)})") from None
+    c: dict = {"code": code, "layer": layer}
+    if params:
+        c["params"] = params
+    return c
+
+
+def attach(result: dict, code: str, **params: Any) -> dict:
+    """Attach one cause to a result dict (under ``"causes"``) and
+    return it — the one-liner every degradation seam calls next to its
+    human-readable ``info`` string."""
+    result.setdefault("causes", []).append(cause(code, **params))
+    return result
+
+
+def of(result: Optional[dict]) -> list[dict]:
+    """The causes attached to a result dict (never None)."""
+    if not isinstance(result, dict):
+        return []
+    cs = result.get("causes")
+    return list(cs) if isinstance(cs, list) else []
+
+
+def annotate(causes: Iterable[dict], **params: Any) -> list[dict]:
+    """Copies of ``causes`` with ``params`` merged into each cause's
+    params (the fold layer stamps seq / trace_span here — copies,
+    because cause dicts are shared through member result dicts)."""
+    out = []
+    for c in causes:
+        if not isinstance(c, dict):
+            continue
+        merged = dict(c.get("params") or {})
+        for k, v in params.items():
+            merged.setdefault(k, v)
+        c2 = {k: v for k, v in c.items() if k != "params"}
+        if merged:
+            c2["params"] = merged
+        out.append(c2)
+    return out
+
+
+def add_counts(counts: dict, causes: Iterable[Any]) -> dict:
+    """Fold causes (dicts or bare codes) into a ``{code: n}`` counter
+    map — the per-stream/per-tenant union the fold layers keep."""
+    for c in causes:
+        code = c.get("code") if isinstance(c, dict) else c
+        if isinstance(code, str):
+            counts[code] = counts.get(code, 0) + 1
+    return counts
+
+
+def merge_counts(*maps: Optional[dict]) -> dict:
+    out: dict = {}
+    for m in maps:
+        for code, n in (m or {}).items():
+            if isinstance(n, (int, float)):
+                out[code] = out.get(code, 0) + int(n)
+    return out
+
+
+def dominant(counts: Optional[dict]) -> Optional[str]:
+    """The most frequent cause code (ties break lexically, so the
+    answer is deterministic), or None."""
+    if not counts:
+        return None
+    return min(counts, key=lambda c: (-counts[c], c))
+
+
+def block(counts: Optional[dict]) -> Optional[dict]:
+    """The ``provenance`` block results/snapshots embed, or None when
+    nothing degraded (the common all-valid case stays clean)."""
+    if not counts:
+        return None
+    return {
+        "causes": {c: int(n) for c, n in sorted(counts.items())},
+        "dominant": dominant(counts),
+        "total": int(sum(counts.values())),
+    }
+
+
+def pareto(counts: Optional[dict]) -> list[dict]:
+    """Sorted display rows for the ``/verdicts`` page: code, layer,
+    count, share."""
+    counts = counts or {}
+    total = sum(counts.values()) or 1
+    rows = []
+    for code in sorted(counts, key=lambda c: (-counts[c], c)):
+        layer, desc = TAXONOMY.get(code, ("?", "(unregistered code)"))
+        rows.append({"code": code, "layer": layer, "count": counts[code],
+                     "share": round(counts[code] / total, 4),
+                     "description": desc})
+    return rows
+
+
+def count_metric(metrics, causes: Iterable[Any],
+                 tenant: str = "") -> None:
+    """Increment ``verdict_causes_total{code,tenant}`` (+ the
+    aggregate unlabeled total) for each cause. No-op without a
+    registry; never raises into a fold."""
+    if metrics is None:
+        return
+    try:
+        # Literal name (not METRIC_NAME) so the doc-drift guard's
+        # static scan sees the family like every other registration.
+        c = metrics.counter("verdict_causes_total", _METRIC_HELP,
+                            labelnames=("code", "tenant"),
+                            aggregate=True)
+        for item in causes:
+            code = item.get("code") if isinstance(item, dict) else item
+            if not isinstance(code, str):
+                continue
+            c.inc()  # the unlabeled total
+            c.labels(code=code, tenant=str(tenant)).inc()
+    except Exception:  # noqa: BLE001 - observability never sinks a fold
+        pass
+
+
+def ensure(causes: list[dict], **params: Any) -> list[dict]:
+    """The mechanical backstop: an unknown that reached the fold with
+    no structured cause gets ``unattributed`` (the chaos matrix
+    asserts this never actually fires)."""
+    return causes if causes else [cause("unattributed", **params)]

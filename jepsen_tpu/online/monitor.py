@@ -392,6 +392,10 @@ class OnlineMonitor:
             out.update(self._detection)
         if res.get("violation") is not None:
             out["violation"] = res["violation"]
+        from ..checker import provenance as _prov
+
+        prov_counts = dict(
+            (res.get("provenance") or {}).get("causes") or {})
         if self.segmenter.mixed_keys:
             # Streaming cannot reproduce independent.subhistory's
             # broadcast of keyless ops into every key (including keys
@@ -401,6 +405,13 @@ class OnlineMonitor:
             out["info"] = ("mixed keyed/keyless stream: online split "
                            "cannot match independent.subhistory; "
                            "verdict degraded to unknown")
+            _prov.add_counts(prov_counts, ["mixed_keys"])
+            _prov.count_metric(self.metrics,
+                               [_prov.cause("mixed_keys")])
+        if prov_counts:
+            # The online.json provenance block: the scheduler's cause
+            # union plus the monitor-level degradations above.
+            out["provenance"] = _prov.block(prov_counts)
         out["segments"] = res["segments"]
         self._finished = out
         return out

@@ -79,6 +79,7 @@ import time as _time
 from typing import Any, Callable, Optional
 
 from .. import trace as jtrace
+from ..checker import provenance as _prov
 from ..models import Model
 from ..parallel import resilience as _resilience
 from ..telemetry import flight as _flight
@@ -104,7 +105,7 @@ class _StreamState:
     __slots__ = ("carry", "seq_outstanding", "seq_end", "next_seq",
                  "watermark", "n_decided", "n_invalid", "n_unknown",
                  "violation", "segments", "on_watermark", "on_violation",
-                 "on_segment", "carry_poisoned")
+                 "on_segment", "carry_poisoned", "cause_counts")
 
     def __init__(self, on_watermark=None, on_violation=None,
                  on_segment=None):
@@ -132,6 +133,10 @@ class _StreamState:
         # with a LOST carry (folds unknown) — checking an unknown key
         # from the model's init state could wrongly refute.
         self.carry_poisoned = False
+        # Why-unknown union over every decided segment: {code: count}
+        # per the closed provenance taxonomy (docs/verdicts.md). The
+        # display rows are bounded; this map is the exact fold.
+        self.cause_counts: dict[str, int] = {}
 
 
 class SegmentScheduler:
@@ -262,6 +267,7 @@ class SegmentScheduler:
                        violation: Optional[dict] = None,
                        segments: Optional[list] = None,
                        carry_poisoned: bool = False,
+                       cause_counts: Optional[dict] = None,
                        on_watermark: Optional[Callable] = None,
                        on_violation: Optional[Callable] = None,
                        on_segment: Optional[Callable] = None) -> None:
@@ -290,6 +296,7 @@ class SegmentScheduler:
             st.violation = violation
             st.segments = list(segments or [])[:self.max_segment_rows]
             st.carry_poisoned = bool(carry_poisoned)
+            st.cause_counts = dict(cause_counts or {})
             self._streams[stream] = st
             if violation is not None and self._violation is None:
                 self._violation = violation
@@ -404,7 +411,7 @@ class SegmentScheduler:
             st = self._streams.get(stream)
             if st is None:
                 return {"registered": False}
-            return {
+            out = {
                 "segments_decided": st.n_decided,
                 "segments_invalid": st.n_invalid,
                 "segments_unknown": st.n_unknown,
@@ -412,6 +419,10 @@ class SegmentScheduler:
                 "backlog": self._stream_depth.get(stream, 0),
                 "verdict": self._stream_fold_locked(stream, st),
             }
+            prov = _prov.block(self._prov_counts_locked(stream, st))
+            if prov is not None:
+                out["provenance"] = prov
+            return out
 
     @property
     def verdict(self) -> Any:
@@ -442,6 +453,11 @@ class SegmentScheduler:
                 "segments": [row for s in self._streams.values()
                              for row in s.segments],
             }
+            prov = _prov.block(_prov.merge_counts(
+                *(self._prov_counts_locked(s, stv)
+                  for s, stv in self._streams.items())))
+            if prov is not None:
+                out["provenance"] = prov
             if self._violation is not None:
                 out["violation"] = self._violation
             return out
@@ -461,9 +477,23 @@ class SegmentScheduler:
                 "segments_unknown": st.n_unknown,
                 "segments": list(st.segments),
             }
+            prov = _prov.block(self._prov_counts_locked(stream, st))
+            if prov is not None:
+                out["provenance"] = prov
             if st.violation is not None:
                 out["violation"] = st.violation
             return out
+
+    def _prov_counts_locked(self, stream: Any, st: _StreamState) -> dict:
+        """A stream's cause counts, plus the process-level degradation
+        a dead worker imposes on every stream it left unknown (a
+        stream can fold unknown off `_dead` alone, with no segment of
+        its own recorded — its provenance must still answer why)."""
+        counts = st.cause_counts
+        if (self._dead and not counts.get("worker_died")
+                and self._stream_fold_locked(stream, st) == "unknown"):
+            counts = _prov.merge_counts(counts, {"worker_died": 1})
+        return counts
 
     # -- worker --------------------------------------------------------------
 
@@ -506,16 +536,60 @@ class SegmentScheduler:
                         "unknown", exc_info=True)
             with self._lock:
                 self._dead = True
+                # Every submitted-but-undecided segment gets a
+                # worker_died record — not just the ingested ones: the
+                # in-hand batch (_requeue) and anything still in the
+                # inbox were submitted too, and dropping them silently
+                # would leave their streams unknown with no provenance.
+                # Each goes through _ingest (identity-deduped against a
+                # partial ingest, like _recover_after_crash) so its seq
+                # accounting is registered BEFORE any record decrements
+                # it — recording an unregistered segment would drive
+                # seq_outstanding negative and could advance the
+                # watermark over a cut whose siblings are not yet
+                # recorded.
+                seen = {id(s) for _st, s in self._pending}
+                if self._requeue is not None:
+                    stream, batch = self._requeue
+                    remaining = [s for s in batch if id(s) not in seen]
+                    if remaining:
+                        self._ingest(stream, remaining)
+                    self._requeue = None
+                while True:
+                    try:
+                        more = self._inbox.get_nowait()
+                    except queue.Empty:
+                        break
+                    if more is not None:
+                        self._ingest(more[0], list(more[1]))
                 for stream, seg in self._pending:
                     self._streams[stream].carry[seg.key] = "unknown"
                     try:
                         self._record_locked(
                             stream, seg,
                             {"valid": "unknown",
-                             "error": "scheduler worker died"}, None)
+                             "error": "scheduler worker died",
+                             "causes": [_prov.cause("worker_died")]},
+                            None)
                     except Exception:  # noqa: BLE001
                         pass
                 self._pending = []
+                # Streams the death folds unknown WITHOUT a segment of
+                # their own (all-decided streams, or ones whose causes
+                # the loop above already recorded) materialize the
+                # worker_died cause NOW, so verdict_causes_total and
+                # the snapshot provenance blocks agree — the /verdicts
+                # page treats the two as interchangeable.
+                for s2, st2 in self._streams.items():
+                    if (not st2.cause_counts.get("worker_died")
+                            and self._stream_fold_locked(s2, st2)
+                            == "unknown"):
+                        _prov.add_counts(st2.cause_counts,
+                                         ["worker_died"])
+                        _prov.count_metric(
+                            self.metrics, [_prov.cause("worker_died")],
+                            tenant="" if s2 == DEFAULT_STREAM
+                            else str(s2))
         finally:
             # However the worker exits, nothing may wait on it again:
             # further submits must raise, and the idle event must fire.
@@ -646,7 +720,7 @@ class SegmentScheduler:
             done: set = set()  # id() of segments _decide_round recorded
             try:
                 self._decide_round(ready, done)
-            except Exception:  # noqa: BLE001 - the monitor must survive
+            except Exception as e:  # noqa: BLE001 - monitor must survive
                 LOG.warning("online segment round failed; folding unknown",
                             exc_info=True)
                 with self._lock:
@@ -658,7 +732,10 @@ class SegmentScheduler:
                         self._streams[stream].carry[seg.key] = "unknown"
                         self._record_locked(
                             stream, seg,
-                            {"valid": "unknown", "error": "round failed"},
+                            {"valid": "unknown", "error": "round failed",
+                             "causes": [_prov.cause(
+                                 "round_failed",
+                                 error=type(e).__name__)]},
                             None)
 
     def _take_ready(self) -> list[tuple]:
@@ -729,7 +806,13 @@ class SegmentScheduler:
                     self._record_locked(
                         stream, seg,
                         {"valid": "unknown",
-                         "info": "carried state unknown"}, None)
+                         "info": "carried state unknown",
+                         # poisoned_key = the whole stream's carries are
+                         # poisoned (journal replay); carry_lost = this
+                         # key's carry was lost upstream.
+                         "causes": [_prov.cause(
+                             "poisoned_key" if st.carry_poisoned
+                             else "carry_lost")]}, None)
                 done.add(id(seg))
                 continue
             encs = encode_segment(self.model, seg, carried)
@@ -837,7 +920,10 @@ class SegmentScheduler:
                 results[idx] = {"valid": r.get("valid"),
                                 "end_states": None,
                                 "enumeration_exhausted": True,
-                                "detail": r}
+                                "detail": r,
+                                # Lift the engine's structured causes
+                                # so the fold unions them per segment.
+                                "causes": _prov.of(r)}
         else:
             engine = "host" if self.engine == "auto" else self.engine
         if self.metrics is not None:
@@ -887,11 +973,13 @@ class SegmentScheduler:
             try:
                 out.append(wgl_host.check_encoded(
                     e, max_configs=self.max_configs))
-            except Exception:  # noqa: BLE001 - degrade, never fold round
+            except Exception as ex:  # noqa: BLE001 - degrade, not round
                 LOG.warning("host re-dispatch failed for one member; "
                             "folding it unknown", exc_info=True)
-                out.append({"valid": "unknown",
-                            "info": "failover re-dispatch failed"})
+                out.append(_prov.attach(
+                    {"valid": "unknown",
+                     "info": "failover re-dispatch failed"},
+                    "failover_exhausted", error=type(ex).__name__))
         return out
 
     def _count_failover(self, engine: str) -> None:
@@ -1015,7 +1103,18 @@ class SegmentScheduler:
                     st.carry[seg.key] = uniq
             elif verdict == "unknown":
                 st.carry[seg.key] = "unknown"
-            self._record_locked(stream, seg, {"valid": verdict},
+            rec: dict = {"valid": verdict}
+            if verdict == "unknown":
+                # Union of the undecided members' structured causes —
+                # the per-segment provenance the fold carries upward
+                # (per-key via the lost carry, per-stream via the
+                # cause-count union in _record_locked).
+                seg_causes: list = []
+                for r in member_results:
+                    if r.get("valid") not in (True, False):
+                        seg_causes.extend(_prov.of(r))
+                rec["causes"] = _prov.ensure(seg_causes, stage="fold")
+            self._record_locked(stream, seg, rec,
                                 refutation, wall_s=wall_s, engine=engine,
                                 members=len(encs), span_id=sid)
 
@@ -1069,6 +1168,30 @@ class SegmentScheduler:
             row["tenant"] = str(stream)
         if result.get("info"):
             row["info"] = result["info"]
+        causes = list(result.get("causes") or [])
+        if result.get("valid") not in (True, False):
+            # EVERY degraded record carries at least one taxonomy cause
+            # (the backstop is `unattributed`, which the chaos matrix
+            # asserts never actually appears).
+            causes = _prov.ensure(causes, stage="record")
+        if causes:
+            # Stamp the fold's own context — seq plus the PR-6 segment
+            # span id — into copies (cause dicts are shared through the
+            # member result dicts).
+            extra = {"seq": seg.seq}
+            if span_id is not None:
+                extra["trace_span"] = span_id
+            causes = _prov.annotate(causes, **extra)
+            row["causes"] = causes[:_prov.MAX_CAUSES_PER_ROW]
+            if len(causes) > _prov.MAX_CAUSES_PER_ROW:
+                # The display list is bounded; the EXACT counts ride
+                # alongside so the journal (and a restart's rebuilt
+                # Pareto) never undercount a many-member segment.
+                row["cause_counts"] = _prov.add_counts({}, causes)
+            _prov.add_counts(st.cause_counts, causes)
+            _prov.count_metric(
+                self.metrics, causes,
+                tenant="" if stream == DEFAULT_STREAM else str(stream))
         col = self.collector
         if col is not None:
             # Segment span: cut → decided (queue wait included), member

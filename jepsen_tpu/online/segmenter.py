@@ -305,9 +305,14 @@ def segment_states(enc: EncodedHistory,
         for linearized, state in frontier:
             explored += 1
             if explored > max_configs:
-                return {"valid": "unknown", "end_states": None,
-                        "configs_explored": explored,
-                        "info": f"config budget {max_configs} exhausted"}
+                from ..checker import provenance as _prov
+
+                return _prov.attach(
+                    {"valid": "unknown", "end_states": None,
+                     "configs_explored": explored,
+                     "info": f"config budget {max_configs} exhausted"},
+                    "max_configs", budget=max_configs,
+                    engine="enumerator")
             # Successor rule shared with the first-accept oracle
             # (wgl_host.expand) — the differential contract depends on
             # the two searches agreeing.

@@ -56,6 +56,7 @@ import numpy as np
 
 from .encode import EncodedHistory, OPEN, encode_history
 from .. import trace as _trace
+from ..checker import provenance as _prov
 from ..history import History
 from ..models import Model
 
@@ -1494,7 +1495,9 @@ def check_encoded_device(
         return {"valid": True, "op_count": n, "device": True, "levels": 0}
     if not plan.ok or not f_schedule:
         info = plan.reason or "empty frontier-capacity schedule"
-        return {"valid": "unknown", "op_count": n, "device": True, "info": info}
+        return _prov.attach(
+            {"valid": "unknown", "op_count": n, "device": True,
+             "info": info}, "encoding_unsupported", reason=info)
 
     schedule = sorted(set(f_schedule))
     if optimistic is None:
@@ -1798,11 +1801,11 @@ def _device_search(enc: EncodedHistory, plan: DevicePlan, schedule: list,
             if truncated:
                 # A beam exhaustion is NOT a refutation — configs were
                 # dropped along the way.
-                return result(
+                return _prov.attach(result(
                     "unknown", lvl,
                     info=f"beam (lossy frontier, capacity {F}) exhausted",
                     beam=True,
-                )
+                ), "beam_loss", F=int(F))
             # Refutation witness: the search's final configurations —
             # what the reference renders as linear.svg
             # (checker.clj:202-209). The kernel holds the last
@@ -1813,9 +1816,9 @@ def _device_search(enc: EncodedHistory, plan: DevicePlan, schedule: list,
                           stuck_configs=_returned_stuck_configs(
                               enc, plan, fr))
         if lvl >= total_levels:
-            return result(
+            return _prov.attach(result(
                 "unknown", lvl, info="level budget exhausted without verdict"
-            )
+            ), "level_budget", levels=int(lvl), F=int(F))
         if ovf and not lossy:
             # Escalate, resuming losslessly from the kept frontier. (At the
             # top capacity the kernel already continued past the overflow
@@ -2043,6 +2046,11 @@ def check_encoded_competition(enc: EncodedHistory,
     out = dev or native_res or {"valid": "unknown", "op_count": enc.n}
     out["backend"] = "competition"
     out.setdefault("info", "neither engine reached a definite verdict")
+    if out.get("valid") == "unknown":
+        # Both engines' own causes ride `out` already; the bare
+        # fallback (device raised AND native never answered) gets the
+        # backstop so no unknown leaves here cause-free.
+        out["causes"] = _prov.ensure(_prov.of(out), stage="competition")
     return out
 
 

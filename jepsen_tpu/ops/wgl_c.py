@@ -20,6 +20,7 @@ import numpy as np
 
 from .encode import EncodedHistory, encode_history
 from .. import native
+from ..checker import provenance as _prov
 from ..history import History
 from ..models import (
     CasRegister,
@@ -161,11 +162,15 @@ def check_encoded_native(
                 enc, wit_buf, int(wit_len.value), stride, S)
         return res
     if verdict == -1:
-        return {"valid": "unknown",
-                "info": f"config budget {max_configs} exhausted", **base}
+        return _prov.attach(
+            {"valid": "unknown",
+             "info": f"config budget {max_configs} exhausted", **base},
+            "max_configs", budget=max_configs, engine="native")
     if verdict == -3:
-        return {"valid": "unknown",
-                "info": "native engine out of memory", **base}
+        return _prov.attach(
+            {"valid": "unknown",
+             "info": "native engine out of memory", **base},
+            "oom", engine="native")
     return None  # unsupported shape
 
 

@@ -25,6 +25,7 @@ from __future__ import annotations
 from typing import Optional
 
 from .encode import EncodedHistory, OPEN, encode_history
+from ..checker import provenance as _prov
 from ..history import History
 from ..models import Model
 
@@ -104,13 +105,13 @@ def check_encoded(
             linearized, state = cfg
             explored += 1
             if explored > max_configs:
-                return {
+                return _prov.attach({
                     "valid": "unknown",
                     "op_count": n,
                     "configs_explored": explored,
                     "frontier_max": frontier_max,
                     "info": f"config budget {max_configs} exhausted",
-                }
+                }, "max_configs", budget=max_configs, engine="host")
             for j, state2 in expand(enc, linearized, state, ret_order):
                 cfg2 = (linearized | {j}, state2)
                 if cfg2 not in parents:

@@ -40,6 +40,7 @@ from typing import Optional, Sequence
 import numpy as np
 
 from .. import trace as _trace
+from ..checker import provenance as _prov
 from ..history import History
 from ..models import Model
 from ..ops import wgl
@@ -197,9 +198,10 @@ def _check_encoded_batch_once(
         if p.nD == 0:
             results[i] = {"valid": True, "op_count": e.n, "device": True, "levels": 0}
         elif not p.ok:
-            results[i] = {
-                "valid": "unknown", "op_count": e.n, "device": True, "info": p.reason,
-            }
+            results[i] = _prov.attach({
+                "valid": "unknown", "op_count": e.n, "device": True,
+                "info": p.reason,
+            }, "encoding_unsupported", reason=p.reason)
         else:
             idx.append(i)
     if not idx:
@@ -510,8 +512,10 @@ def _check_encoded_batch_once(
                 except Exception:  # noqa: BLE001 - diagnostics only
                     pass
             else:
-                results[i] = {"valid": "unknown",
-                              "info": "level budget exhausted", **base}
+                results[i] = _prov.attach(
+                    {"valid": "unknown",
+                     "info": "level budget exhausted", **base},
+                    "level_budget", levels=int(lvls[r]), F=int(F))
         if not overflowed:
             live = []
             break
@@ -528,10 +532,10 @@ def _check_encoded_batch_once(
     for r in serial_rows:
         i = orig[r]
         if escalate is False:
-            results[i] = {
+            results[i] = _prov.attach({
                 "valid": "unknown", "op_count": encs[i].n, "device": True,
                 "info": f"frontier overflow at shared capacity {f}",
-            }
+            }, "overflow_top_rung", F=int(f), escalate=False)
             continue
         if escalate == "serial":
             serial_sched = tuple(x for x in sched if x > f) or (f,)
@@ -556,6 +560,13 @@ def _check_encoded_batch_once(
             labelnames=("result",))
         for i in idx:
             c.labels(result=str(results[i].get("valid"))).inc()
+    # Provenance rides the result maps (`causes` on every unknown);
+    # the verdict_causes_total metric is counted by the CONSUMING fold
+    # layer (scheduler/_record_locked, service drain, monitor) — a
+    # count here would double-tally the online device path, and the
+    # scheduler re-checks unknown members individually, so a
+    # batch-level count could even tally causes for members later
+    # decided definitively.
     return results  # type: ignore[return-value]
 
 

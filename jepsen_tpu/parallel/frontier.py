@@ -45,6 +45,7 @@ from typing import Optional
 import numpy as np
 
 from .. import trace as _trace
+from ..checker import provenance as _prov
 from ..ops import wgl
 from ..ops.encode import EncodedHistory
 from ..testing import chaos as _chaos
@@ -169,9 +170,11 @@ def check_encoded_sharded(
         return {"valid": True, "op_count": n, "device": True, "levels": 0,
                 "sharded": True, "n_shards": D, "exchange": exchange}
     if not plan.ok:
-        return {"valid": "unknown", "op_count": n, "device": True,
-                "info": plan.reason, "sharded": True, "n_shards": D,
-                "exchange": exchange}
+        return _prov.attach(
+            {"valid": "unknown", "op_count": n, "device": True,
+             "info": plan.reason, "sharded": True, "n_shards": D,
+             "exchange": exchange},
+            "encoding_unsupported", reason=plan.reason)
     W, KO, S, ND, NO = plan.dims
     mk = wgl._model_cache_key(enc.model)
     total_levels = int(plan.args[2])
@@ -348,8 +351,9 @@ def check_encoded_sharded(
                     stuck_configs=wgl._returned_stuck_configs(
                         enc, plan, fr)), fr
             if int(lvl) >= total_levels:
-                return result("unknown",
-                              info="level budget exhausted"), fr
+                return _prov.attach(
+                    result("unknown", info="level budget exhausted"),
+                    "level_budget", levels=int(lvl), F=F), fr
 
     fingerprint = wgl._enc_fingerprint(enc, plan) if checkpoint_path \
         else None
@@ -395,11 +399,13 @@ def check_encoded_sharded(
                 "Lossless frontier-capacity escalations").inc()
         FT = capacities(FT * 4)
         fr = wgl._pad_frontier(fr, FT)
-    return {"valid": "unknown", "op_count": n, "device": True,
-            "sharded": True, "n_shards": D, "exchange": exchange,
-            "info": f"frontier capacity schedule exhausted at {FT // 4}",
-            "attempts": attempts,
-            "wall_s": _time.perf_counter() - t0}
+    return _prov.attach(
+        {"valid": "unknown", "op_count": n, "device": True,
+         "sharded": True, "n_shards": D, "exchange": exchange,
+         "info": f"frontier capacity schedule exhausted at {FT // 4}",
+         "attempts": attempts,
+         "wall_s": _time.perf_counter() - t0},
+        "escalation_budget", F=FT // 4, max_escalations=max_escalations)
 
 
 def check_history_sharded(model, history, **kw) -> dict:

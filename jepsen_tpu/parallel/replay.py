@@ -14,6 +14,7 @@ from pathlib import Path
 from typing import Any, Optional, Sequence
 
 from .. import store
+from ..checker import provenance as _prov
 from ..history import History
 from ..models import Model, model_by_name
 from .batch import check_batch
@@ -66,21 +67,25 @@ def replay(model: Model, paths: Sequence[Path], mesh=None, f: int = 256,
     encs = []
     for i, h in enumerate(histories):
         if h is None:
-            results.append({"valid": "unknown",
-                            "info": "unreadable history"})
+            results.append(_prov.attach(
+                {"valid": "unknown", "info": "unreadable history"},
+                "encoding_unsupported", reason="unreadable history"))
             continue
         client_ops = h.client_ops()
         try:
             enc = encode_history(model, client_ops)
         except Exception as e:  # model can't interpret these ops at all
-            results.append({"valid": "unknown",
-                            "info": f"not a {model.name} history: {e}"})
+            results.append(_prov.attach(
+                {"valid": "unknown",
+                 "info": f"not a {model.name} history: {e}"},
+                "encoding_unsupported", reason="model mismatch"))
             continue
         if len(client_ops) and enc.n == 0:
-            results.append({
-                "valid": "unknown",
-                "info": f"no ops matched model {model.name}; wrong "
-                        "--model for this run?"})
+            results.append(_prov.attach(
+                {"valid": "unknown",
+                 "info": f"no ops matched model {model.name}; wrong "
+                         "--model for this run?"},
+                "encoding_unsupported", reason="no ops matched model"))
             continue
         results.append(None)
         idx.append(i)

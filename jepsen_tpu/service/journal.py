@@ -48,6 +48,7 @@ import os
 from typing import Any, Optional
 from urllib.parse import quote, unquote
 
+from ..checker import provenance as _prov
 from ..models import Model
 from ..online.segmenter import SINGLE_KEY
 from ..testing import chaos as _chaos
@@ -189,6 +190,14 @@ class TenantJournal:
         }
         if row.get("info"):
             rec["info"] = row["info"]
+        if row.get("causes"):
+            # The structured why-unknown provenance rides the journal,
+            # so a restart restores the cause Pareto (cause params are
+            # JSON scalars by construction). `cause_counts` carries
+            # the EXACT counts when the display list was truncated.
+            rec["causes"] = row["causes"]
+            if row.get("cause_counts"):
+                rec["cause_counts"] = row["cause_counts"]
         if self.append_failures:
             # A prior append was swallowed: every later record admits
             # it, so replay can tell a mid-stream GAP (stale carries,
@@ -282,6 +291,7 @@ def replay(path: str, model: Model) -> dict:
     next_seq = 0
     carry: dict[Any, Any] = {}
     carry_poisoned = False
+    cause_counts: dict[str, int] = {}
     degraded = False  # swallowed append failures / seq gaps
     seen_seqs: set = set()
     n_decided = n_invalid = n_unknown = 0
@@ -310,6 +320,22 @@ def replay(path: str, model: Model) -> dict:
                 "terminal", "valid")}
         row.update(engine="journal", members=0, wall_s=0.0,
                    info="replayed from journal")
+        if rec.get("causes"):
+            row["causes"] = rec["causes"]
+            if rec.get("cause_counts"):
+                # Exact counts outrank the bounded display list (a
+                # many-member segment journals both).
+                for code, cnt in rec["cause_counts"].items():
+                    if isinstance(cnt, (int, float)):
+                        cause_counts[code] = (cause_counts.get(code, 0)
+                                              + int(cnt))
+            else:
+                _prov.add_counts(cause_counts, rec["causes"])
+        elif v is not True and v is not False:
+            # A pre-provenance journal (or a record written by a
+            # taxonomy hole): the restored Pareto still accounts for
+            # the unknown.
+            _prov.add_counts(cause_counts, ["unattributed"])
         if len(segments) < MAX_REPLAY_ROWS:
             segments.append(row)
         if v is False and violation is None:
@@ -441,6 +467,7 @@ def replay(path: str, model: Model) -> dict:
             "tenant": "", "watermark": -1, "next_seq": 0, "carry": {},
             "carry_poisoned": False, "n_decided": 0, "n_invalid": 0,
             "n_unknown": 0, "violation": None, "segments": [],
+            "cause_counts": {},
             "records": 0, "torn_tail": torn, "degraded": False,
             "consistent_bytes": 0, "fresh": True,
         }
@@ -485,11 +512,13 @@ def replay(path: str, model: Model) -> dict:
         # One-sided restore: carries may be stale (poison them all)
         # and a lost record could have been invalid, so the restored
         # fold must never report a definite True — one phantom
-        # unknown pins it. Journaled invalid verdicts still stand
-        # (their refutation evidence is real regardless).
+        # unknown pins it (provenance: journal_gap). Journaled invalid
+        # verdicts still stand (their refutation evidence is real
+        # regardless).
         carry_poisoned = True
         n_unknown += 1
         n_decided += 1
+        _prov.add_counts(cause_counts, [_prov.cause("journal_gap")])
         LOG.warning("journal %s: append-failure gap detected; "
                     "restoring with poisoned carries and an unknown "
                     "fold", path)
@@ -504,6 +533,7 @@ def replay(path: str, model: Model) -> dict:
         "n_unknown": n_unknown,
         "violation": violation,
         "segments": segments,
+        "cause_counts": cause_counts,
         "records": n_records,
         "torn_tail": torn,
         "degraded": degraded,

@@ -59,6 +59,7 @@ from collections import deque
 from dataclasses import dataclass, replace
 from typing import Any, Optional
 
+from ..checker import provenance as _prov
 from ..online.scheduler import SegmentScheduler
 from ..online.segmenter import Segmenter
 from ..telemetry import flight as _flight
@@ -332,6 +333,7 @@ class Service:
                 n_unknown=rep["n_unknown"],
                 violation=rep["violation"],
                 segments=rep["segments"],
+                cause_counts=rep.get("cause_counts"),
                 **self._stream_hooks(t))
             t.journal = _journal.TenantJournal(
                 path, tenant, self.model,
@@ -658,6 +660,16 @@ class Service:
             "decision_latency": self._lat.stats(
                 labels={"tenant": t.name}),
         })
+        # Why-unknown provenance: the scheduler's per-stream cause
+        # union plus the service-layer degradations this tenant hit.
+        prov_counts = dict(
+            (ss.get("provenance") or {}).get("causes") or {})
+        if t.lost_segments:
+            _prov.add_counts(prov_counts, ["lost_segments"])
+        if prov_counts:
+            snap["provenance"] = _prov.block(prov_counts)
+            # The /live row's one-glance answer to "why unknown".
+            snap["dominant_unknown_cause"] = _prov.dominant(prov_counts)
         if t.resumed is not None:
             snap["resumed_from_journal"] = dict(t.resumed)
             if t.segmenter.dropped_covered:
@@ -808,12 +820,15 @@ class Service:
                 "decision_latency": lat,
                 "segments": res["segments"],
             })
+            svc_causes: list = []
             if undelivered > 0:
                 out["undelivered_ops"] = undelivered
                 # A queue truncated by the drain deadline means the
                 # verdict covers only the observed prefix.
                 out["info"] = ("drain deadline truncated the stream; "
                                "verdict covers the observed prefix")
+                svc_causes.append(_prov.cause("undelivered_ops",
+                                              count=undelivered))
             if t.lost_segments and out["valid"] is True:
                 # Segments were dropped at a closed scheduler: a
                 # definite True must cover the whole stream, and this
@@ -822,6 +837,26 @@ class Service:
                 out["valid"] = "unknown"
                 out["info"] = ("segments lost after scheduler close; "
                                "verdict degraded to unknown")
+            if t.lost_segments:
+                svc_causes.append(_prov.cause("lost_segments"))
+            # Per-tenant provenance: the scheduler's per-stream cause
+            # union plus the service-layer degradations above.
+            prov_counts = _prov.add_counts(dict(
+                (res.get("provenance") or {}).get("causes") or {}),
+                svc_causes)
+            if out["valid"] not in (True, False) and not prov_counts:
+                # The one unknown no segment record explains: work
+                # still in flight when the drain deadline closed the
+                # scheduler (undecided ≠ degraded, but the tenant's
+                # answer is still unknown and must say why).
+                dl = _prov.cause("deadline")
+                svc_causes.append(dl)
+                _prov.add_counts(prov_counts, [dl])
+            if svc_causes:
+                _prov.count_metric(self.metrics, svc_causes,
+                                   tenant=t.name)
+            if prov_counts:
+                out["provenance"] = _prov.block(prov_counts)
             if t.resumed is not None:
                 out["resumed_from_journal"] = dict(t.resumed)
                 if t.segmenter.dropped_covered:
@@ -854,6 +889,11 @@ class Service:
             # leg's p99 — per-tenant p99s don't compose into it.
             "decision_latency": self._lat.stats(),
         }
+        run_prov = _prov.block(_prov.merge_counts(
+            *((r.get("provenance") or {}).get("causes")
+              for r in results.values())))
+        if run_prov is not None:
+            fin["provenance"] = run_prov
         self._finished = fin
         if self.config.ledger:
             self._append_ledger(results, wall)
@@ -889,6 +929,12 @@ class Service:
                 p99 = (r.get("decision_latency") or {}).get("p99_s")
                 if p99 is not None:
                     rec["p99_decision_latency_s"] = p99
+                prov = r.get("provenance")
+                if prov:
+                    # The cross-run trend's why-unknown column: the
+                    # advisor joins this with the perf metrics.
+                    rec["dominant_cause"] = prov.get("dominant")
+                    rec["causes"] = prov.get("causes")
                 jledger.append(rec, path=path)
         except Exception:  # noqa: BLE001 - the ledger never sinks drain
             LOG.warning("service ledger append failed", exc_info=True)

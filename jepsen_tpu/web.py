@@ -170,6 +170,7 @@ def _index_page(root: Path) -> str:
         '<a href="/utilization">utilization</a> · '
         '<a href="/runs">runs</a> · '
         '<a href="/online">online</a> · '
+        '<a href="/verdicts">verdicts</a> · '
         '<a href="/live.html">live</a></p><table>'
         "<tr><th>Test</th><th>Started</th><th>Valid?</th>"
         "<th>Telemetry</th><th></th></tr>"
@@ -503,6 +504,125 @@ def _runs_page(root: Path) -> str:
     )
 
 
+def _run_cause_counts(run_dir: Path) -> dict[str, dict[str, int]]:
+    """Per-tenant ``{code: count}`` maps for one run, joined from the
+    ``verdict_causes_total{code,tenant}`` samples in metrics.jsonl and
+    the ``provenance`` block in online.json (tenant ``""`` = the run's
+    own stream). Either source alone suffices — a run with only one of
+    the two artifacts still renders."""
+    out: dict[str, dict[str, int]] = {}
+    f = run_dir / "metrics.jsonl"
+    if f.exists():
+        try:
+            with open(f) as fh:
+                for line in fh:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    s = json.loads(line)
+                    if s.get("name") != "verdict_causes_total":
+                        continue
+                    labels = s.get("labels") or {}
+                    code = labels.get("code")
+                    if not code:  # the aggregate unlabeled total
+                        continue
+                    t = out.setdefault(labels.get("tenant") or "", {})
+                    t[code] = t.get(code, 0) + int(s.get("value") or 0)
+        except Exception:  # noqa: BLE001 - a bad artifact still lists
+            pass
+    f = run_dir / "online.json"
+    if f.exists() and not out:
+        try:
+            doc = json.loads(f.read_text())
+            causes = (doc.get("provenance") or {}).get("causes") or {}
+            if causes:
+                out[""] = {k: int(v) for k, v in causes.items()}
+        except Exception:  # noqa: BLE001
+            pass
+    return out
+
+
+def _verdicts_section(name: str, start: str,
+                      tenants: dict[str, dict[str, int]]) -> str:
+    """One run's cause Pareto: per-tenant tables with deep links into
+    the trace chain (online.json segment table → spans.jsonl ids)."""
+    from .checker import provenance as _prov
+
+    parts = []
+    links = " · ".join(
+        [f'<a href="/files/{name}/{start}/online.json">online.json</a>',
+         f'<a href="/files/{name}/{start}/metrics.jsonl">'
+         "metrics.jsonl</a>",
+         f'<a href="/files/{name}/{start}/spans.jsonl">spans.jsonl</a>',
+         '<a href="/online">online</a>',
+         '<a href="/utilization">utilization</a>',
+         '<a href="/live.html">live</a>'])
+    parts.append(f"<p>{links}</p>")
+    for tenant in sorted(tenants):
+        counts = tenants[tenant]
+        label = (f"tenant <b>{html.escape(tenant)}</b>" if tenant
+                 else "run stream")
+        rows = "".join(
+            f"<tr><td><code>{html.escape(r['code'])}</code></td>"
+            f"<td>{html.escape(r['layer'])}</td>"
+            f"<td>{r['count']}</td>"
+            f"<td>{round(r['share'] * 100, 1)}%</td>"
+            f"<td>{html.escape(r['description'])}</td></tr>"
+            for r in _prov.pareto(counts))
+        parts.append(
+            f"<h3>{label} — {sum(counts.values())} attributed "
+            "cause(s)</h3>"
+            "<table><tr><th>cause</th><th>layer</th><th>count</th>"
+            "<th>share</th><th>meaning</th></tr>" + rows + "</table>")
+    return "".join(parts)
+
+
+def _verdicts_page(root: Path) -> str:
+    """The verdict-provenance browser: per-run / per-tenant cause
+    Paretos (why did verdicts degrade to unknown), joined from the
+    `verdict_causes_total` metric family and online.json provenance
+    blocks, with the closed taxonomy reference at the bottom. See
+    docs/verdicts.md."""
+    from .checker import provenance as _prov
+
+    sections = []
+    tests = store.tests(root=root)
+    for name in sorted(tests):
+        for start in sorted(tests[name], reverse=True):
+            run = tests[name][start]
+            tenants = _run_cause_counts(run)
+            if not tenants:
+                continue
+            sections.append(
+                f'<h2><a href="/files/{name}/{start}/">'
+                f"{html.escape(name)} / {html.escape(start)}</a></h2>"
+                + _verdicts_section(name, start, tenants))
+    if not sections:
+        sections.append(
+            "<p>No degraded verdicts recorded — every checked stream "
+            "decided definitively (or no telemetry/online artifacts "
+            "exist yet). Causes appear here the moment any verdict "
+            "degrades to unknown.</p>")
+    taxonomy = "".join(
+        f"<tr><td><code>{html.escape(code)}</code></td>"
+        f"<td>{html.escape(layer)}</td>"
+        f"<td>{html.escape(desc)}</td></tr>"
+        for code, (layer, desc) in sorted(_prov.TAXONOMY.items()))
+    return (
+        f"<html><head><title>Jepsen verdicts</title>"
+        f"<style>{_STYLE}</style></head>"
+        "<body><h1>Verdict provenance</h1>"
+        '<p><a href="/">index</a> · <a href="/online">online</a> · '
+        '<a href="/metrics">metrics</a> · '
+        '<a href="/live.html">live</a> · advisor: '
+        "<code>python -m jepsen_tpu.advisor</code></p>"
+        + "".join(sections)
+        + "<h2>Cause taxonomy (closed)</h2>"
+        "<table><tr><th>code</th><th>layer</th><th>meaning</th></tr>"
+        + taxonomy + "</table></body></html>"
+    )
+
+
 def _online_section(doc: dict) -> str:
     """Render one run's online.json: live watermark + verdict headline,
     detection info when a violation aborted the run, and the decided
@@ -580,7 +700,8 @@ _LIVE_HTML = """<html><head><title>Jepsen live</title>
 pre { background: #f6f6f6; padding: 0.6em; }</style></head>
 <body><h1>Live runs</h1>
 <p><a href="/">index</a> · <a href="/metrics">metrics</a> ·
-<a href="/online">online</a> · raw feed: <a href="/live">/live</a>
+<a href="/online">online</a> · <a href="/verdicts">verdicts</a> ·
+raw feed: <a href="/live">/live</a>
 (ndjson poll)</p>
 <div id="runs"><p id="none">polling /live…</p></div>
 <script>
@@ -621,7 +742,11 @@ async function tick() {
                 ? ' class="stall"' : '';
               const flags = [
                 t.aborted ? 'ABORTED' : '',
-                t.degraded ? 'DEGRADED' : '',
+                // Why-unknown at a glance: the dominant taxonomy code
+                // rides next to the DEGRADED flag (docs/verdicts.md).
+                t.degraded ? ('DEGRADED' +
+                  (t.dominant_unknown_cause
+                    ? ' [' + t.dominant_unknown_cause + ']' : '')) : '',
                 t.resumed_from_journal ? 'resumed' : '',
               ].filter(Boolean).join(' ');
               return '<tr' + cls + '><td>' + name + '</td>' +
@@ -701,6 +826,9 @@ def make_handler(root: Path):
                     return
                 if path in ("/online", "/online/"):
                     self._send(200, _online_page(root).encode())
+                    return
+                if path in ("/verdicts", "/verdicts/"):
+                    self._send(200, _verdicts_page(root).encode())
                     return
                 if path in ("/utilization", "/utilization/"):
                     self._send(200, _utilization_page(root).encode())

@@ -1,0 +1,182 @@
+"""Config advisor (python -m jepsen_tpu.advisor, ISSUE 13).
+
+Every rule is pinned CLOSED-FORM: synthetic provenance / utilization /
+trend inputs → the exact recommendation ids. The committed-artifact
+test then pins the acceptance criterion — the advisor over the repo's
+committed BENCH rounds (newest: the r13 CPU-box round) produces at
+least three distinct recommendations.
+"""
+
+import glob
+import json
+import os
+
+from jepsen_tpu import advisor, benchcmp
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def ids(recs):
+    return [r["id"] for r in recs]
+
+
+class TestInputGathering:
+    def test_collect_provenance_unions_nested_blocks(self):
+        doc = {
+            "provenance": {"causes": {"max_configs": 2}},
+            "service_streams": {
+                "provenance": {"causes": {"max_configs": 1,
+                                          "carry_lost": 4}}},
+        }
+        assert advisor.collect_provenance(doc) == {
+            "max_configs": 3, "carry_lost": 4}
+        assert advisor.collect_provenance({}) == {}
+
+    def test_collect_gap_shares_takes_max_per_class(self):
+        doc = {
+            "device_gap_share": {"starved": 0.1},
+            "batch_replay_large": {
+                "smoke_8x10k": {"gap_share": {"starved": 0.6,
+                                              "compiling": 0.2}}},
+        }
+        assert advisor.collect_gap_shares(doc) == {
+            "starved": 0.6, "compiling": 0.2}
+
+    def test_collect_skipped_legs(self):
+        doc = {"mutex_5k": {"skipped": "device_slow_guard"},
+               "elle_txn": {"value_s": 1.0},
+               "batch_replay_large": {"skipped": "budget"}}
+        got = advisor.collect_skipped_legs(doc)
+        assert "mutex_5k (device_slow_guard)" in got
+        assert "batch_replay_large (budget)" in got
+        assert not any(s.startswith("elle") for s in got)
+
+
+class TestRulesClosedForm:
+    def test_capacity_bound_provenance_extends_schedule(self):
+        recs = advisor.advise({"provenance": {
+            "causes": {"overflow_top_rung": 8, "beam_loss": 2,
+                       "max_configs": 3}}})
+        assert ids(recs) == ["extend_f_schedule"]
+        assert recs[0]["severity"] == "high"
+        assert "f_schedule" in recs[0]["advice"]
+
+    def test_budget_bound_provenance_raises_max_configs(self):
+        recs = advisor.advise({"provenance": {
+            "causes": {"max_configs": 2, "carry_lost": 9,
+                       "overflow_top_rung": 1}}})
+        assert ids(recs) == ["raise_max_configs"]
+        assert "max_configs" in recs[0]["advice"]
+
+    def test_fault_provenance_flags_infrastructure(self):
+        recs = advisor.advise({"provenance": {
+            "causes": {"worker_died": 3, "journal_gap": 1}}})
+        assert set(ids(recs)) == {"failover_review",
+                                  "journal_durability"}
+        assert all(r["severity"] == "high" for r in recs)
+
+    def test_gap_share_rules(self):
+        recs = advisor.advise({"gap_share": {
+            "host-stacking": 0.4, "starved": 0.3, "compiling": 0.26,
+            "no-work": 0.04}})
+        assert set(ids(recs)) == {"grow_batch_f", "feed_starved",
+                                  "prewarm_compiles"}
+        # Shares at/below the threshold never fire.
+        assert advisor.advise({"gap_share": {"starved": 0.25}}) == []
+
+    def test_latency_tail_rule(self):
+        doc = {"online_10k": {"p50_decision_latency_s": 0.01,
+                              "p99_decision_latency_s": 1.0}}
+        recs = advisor.advise(doc)
+        assert ids(recs) == ["latency_tail"]
+        ev = recs[0]["evidence"]["online_10k"]
+        assert ev["ratio"] == 100.0
+        # A healthy tail is quiet.
+        assert advisor.advise({"online_10k": {
+            "p50_decision_latency_s": 0.01,
+            "p99_decision_latency_s": 0.05}}) == []
+
+    def test_device_baseline_and_cadence_rules(self):
+        recs = advisor.advise(
+            {"mutex_5k": {"skipped": "device_slow_guard"}},
+            rounds=[{"label": "r05", "metrics": {}},
+                    {"label": "r13", "metrics": {}}])
+        assert set(ids(recs)) == {"device_baseline_missing",
+                                  "round_cadence"}
+        # Adjacent rounds: no cadence complaint.
+        recs2 = advisor.advise({}, rounds=[
+            {"label": "r04", "metrics": {}},
+            {"label": "r05", "metrics": {}}])
+        assert recs2 == []
+
+    def test_trend_regressions_rule(self):
+        recs = advisor.advise({}, comparison={
+            "from": "r12", "to": "r13",
+            "regressions": ["value_s"]})
+        assert ids(recs) == ["trend_regressions"]
+        assert "value_s" in recs[0]["advice"]
+        assert advisor.advise({}, comparison={
+            "from": "a", "to": "b", "regressions": []}) == []
+
+    def test_severity_ordering(self):
+        recs = advisor.advise({
+            "provenance": {"causes": {"journal_gap": 1}},
+            "gap_share": {"starved": 0.5},
+            "mutex_5k": {"skipped": "budget"},
+        })
+        sevs = [r["severity"] for r in recs]
+        assert sevs == sorted(
+            sevs, key=lambda s: {"high": 0, "medium": 1, "info": 2}[s])
+
+    def test_clean_inputs_give_no_recommendations(self):
+        assert advisor.advise({}) == []
+        assert "no recommendations" in advisor.render([])
+
+
+class TestCli:
+    def test_main_over_synthetic_artifact(self, tmp_path, capsys):
+        art = tmp_path / "BENCH_r98.json"
+        art.write_text(json.dumps({
+            "provenance": {"causes": {"overflow_top_rung": 10}},
+            "mutex_5k": {"skipped": "device_slow_guard"},
+        }))
+        rc = advisor.main([str(art)])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "extend_f_schedule" in out
+        assert "device_baseline_missing" in out
+
+    def test_main_json_mode(self, tmp_path, capsys):
+        art = tmp_path / "BENCH_r99.json"
+        art.write_text(json.dumps({
+            "provenance": {"causes": {"max_configs": 1,
+                                      "carry_lost": 5}}}))
+        rc = advisor.main([str(art), "--json"])
+        doc = json.loads(capsys.readouterr().out)
+        assert rc == 0
+        assert doc["round"] == "r99"
+        assert [r["id"] for r in doc["recommendations"]] == \
+            ["raise_max_configs"]
+
+    def test_main_refuses_nothing(self, tmp_path, capsys, monkeypatch):
+        monkeypatch.chdir(tmp_path)
+        rc = advisor.main([str(tmp_path / "missing.json")])
+        assert rc == 2
+
+
+class TestCommittedArtifacts:
+    def test_committed_rounds_yield_three_recommendations(self, capsys):
+        """The ISSUE-13 acceptance pin: `python -m jepsen_tpu.advisor`
+        over the repo's committed BENCH rounds (newest = the r13
+        CPU-box round: device legs behind BENCH_DEVICE_SLOW_S, a
+        cadence gap vs r05, and a CPU-vs-TPU trend break) produces at
+        least 3 DISTINCT recommendations."""
+        paths = sorted(glob.glob(os.path.join(REPO, "BENCH_r*.json")),
+                       key=benchcmp.round_sort_key)
+        assert paths, "no committed BENCH rounds in the repo"
+        rc = advisor.main(paths)
+        out = capsys.readouterr().out
+        assert rc == 0
+        rec_ids = {line.split("(id: ")[1].rstrip(")")
+                   for line in out.splitlines() if "(id: " in line}
+        assert len(rec_ids) >= 3, (rec_ids, out)

@@ -7,6 +7,7 @@ computed, and an injected >10% regression exits nonzero."""
 from __future__ import annotations
 
 import json
+import os
 import pathlib
 
 import pytest
@@ -155,6 +156,20 @@ class TestMainGate:
         assert benchcmp.main([str(tmp_path / "nope.json")]) == 2
         capsys.readouterr()
 
+    def test_single_round_is_nothing_to_compare_not_a_failure(
+            self, capsys):
+        # ISSUE-13 satellite: a CI step calling benchcmp before the
+        # second committed round must get a clean 0, with the one
+        # round's table still rendered.
+        rc = benchcmp.main([BENCH[0]])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "nothing to compare" in out
+
+    def test_zero_artifacts_exit_zero(self, capsys):
+        assert benchcmp.main([]) == 0
+        assert "nothing to compare" in capsys.readouterr().out
+
 
 class TestMultichipExchangeMetric:
     """ISSUE 4 CI satellite: benchcmp knows the new MULTICHIP
@@ -205,18 +220,31 @@ class TestMultichipExchangeMetric:
 
 
 class TestVsPrevious:
+    @staticmethod
+    def _newest():
+        import glob as _glob
+
+        paths = sorted(
+            _glob.glob(str(ROOT / "BENCH_r*.json")),
+            key=benchcmp.round_sort_key)
+        prev = benchcmp.extract(benchcmp.load_round(paths[-1])["data"])
+        return paths[-1], prev
+
     def test_embeds_delta_block_against_newest_round(self):
-        current = {"value": 0.03, "invalid_s": 0.35,
-                   "device_kernel_s": 3.0, "bench_wall_s": 100.0}
+        newest, prev = self._newest()
+        assert prev.get("invalid_s")
+        # 10% better than the newest committed round: no flag.
+        current = {"invalid_s": round(prev["invalid_s"] * 0.9, 4),
+                   "bench_wall_s": 100.0}
         vp = benchcmp.vs_previous(current, root=str(ROOT))
-        assert vp["round"] == "r05"
-        assert vp["path"] == "BENCH_r05.json"
-        # invalid_s 0.398 -> 0.35: improvement, no flag.
+        assert vp["round"] == benchcmp.round_label(newest)
+        assert vp["path"] == os.path.basename(newest)
         assert vp["deltas"]["invalid_s"]["regression"] is False
         assert "invalid_s" not in vp["regressions"]
 
     def test_flags_regression_in_current_run(self):
-        current = {"invalid_s": 0.398 * 1.5, "device_kernel_s": 3.785}
+        _newest, prev = self._newest()
+        current = {"invalid_s": prev["invalid_s"] * 1.5}
         vp = benchcmp.vs_previous(current, root=str(ROOT))
         assert "invalid_s" in vp["regressions"]
         assert vp["deltas"]["invalid_s"]["regression"] is True

@@ -292,6 +292,30 @@ class TestChaosDifferential:
     FAST_POINTS = ("service.pump", "scheduler.worker",
                    "device.dispatch", "journal.fsync")
 
+    # Provenance pin (ISSUE 13): every injected fault whose outcome is
+    # unknown must carry ONLY taxonomy codes from its seam's expected
+    # set — never a free-text-only unknown, never the `unattributed`
+    # backstop (see docs/verdicts.md).
+    EXPECTED_UNKNOWN_CAUSES = {
+        # a dead pump is pure backpressure; only the drain edge can
+        # degrade (truncated/unfed queue, late segments at close)
+        "service.pump": {"lost_segments", "undelivered_ops",
+                         "deadline"},
+        # a double worker crash is terminal: pending segments fold
+        # worker_died, later segments are refused at the closed
+        # scheduler; the first crash's round may fold round_failed and
+        # carry losses cascade per key
+        "scheduler.worker": {"worker_died", "round_failed",
+                             "carry_lost", "lost_segments"},
+        # an oracle fault fails over to host re-dispatch; only an
+        # exhausted failover (or a round lost with it) degrades
+        "device.dispatch": {"failover_exhausted", "round_failed",
+                            "carry_lost"},
+        # journal faults cost durability, never a verdict — an unknown
+        # here would be a bug (empty set: no cause is acceptable)
+        "journal.fsync": set(),
+    }
+
     @pytest.mark.parametrize("point", FAST_POINTS)
     @pytest.mark.parametrize("mode", ("raise", "delay"))
     def test_verdicts_degrade_never_flip(self, point, mode, tmp_path):
@@ -325,6 +349,23 @@ class TestChaosDifferential:
             # the opposite definite verdict.
             assert got in (want[name], "unknown"), (point, mode, name,
                                                     got, want[name])
+            # The provenance contract: every unknown is attributed to
+            # the seam's expected taxonomy codes — structurally, not
+            # as free text, and never via the `unattributed` backstop.
+            tenant = fin["tenants"][name]
+            if got == "unknown":
+                prov = tenant.get("provenance")
+                assert prov and prov.get("causes"), (point, mode, name,
+                                                     tenant)
+                codes = set(prov["causes"])
+                allowed = self.EXPECTED_UNKNOWN_CAUSES[point]
+                assert codes and codes <= allowed, (point, mode, name,
+                                                    codes, allowed)
+            for row in tenant.get("segments") or []:
+                if row.get("valid") not in (True, False):
+                    assert row.get("causes"), (point, mode, name, row)
+                    assert all(c.get("code") != "unattributed"
+                               for c in row["causes"]), row
         # Delay mode must not degrade at all (it is only slow).
         if mode == "delay":
             for name in hs:

@@ -1,0 +1,85 @@
+"""Telemetry doc-drift guard (ISSUE 13 satellite).
+
+Statically enumerates every metric/event family the package emits
+(registry ``.counter/.gauge/.histogram/.event`` registrations plus
+direct ``Histogram(...)`` constructions) and cross-checks each name
+against the tables in docs/telemetry.md — so a new PR cannot silently
+add an unnamed series. Intentionally-undocumented internals go on the
+explicit allowlist below; a stale allowlist entry (name no longer
+emitted) fails too, so the list can only shrink honestly.
+"""
+
+import re
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+PKG = REPO / "jepsen_tpu"
+DOC = REPO / "docs" / "telemetry.md"
+
+# Metric/event names that are deliberately NOT documented in
+# docs/telemetry.md. Add here ONLY with a reason; anything else
+# missing from the doc is a failure.
+ALLOWLIST: dict[str, str] = {
+    # (empty — every currently-emitted family is documented)
+}
+
+_REG_PAT = re.compile(
+    r'\.(?:counter|gauge|histogram|event)\(\s*\n?\s*["\']'
+    r"([a-z_0-9]+)[\"']")
+_CTOR_PAT = re.compile(r'\bHistogram\(\s*\n?\s*["\']([a-z_0-9]+)["\']')
+
+
+def emitted_families() -> dict[str, list[str]]:
+    """name -> source files that emit it, across the whole package."""
+    out: dict[str, list[str]] = {}
+    for p in sorted(PKG.rglob("*.py")):
+        s = p.read_text()
+        for pat in (_REG_PAT, _CTOR_PAT):
+            for m in pat.finditer(s):
+                out.setdefault(m.group(1), []).append(
+                    str(p.relative_to(REPO)))
+    return out
+
+
+def test_scan_finds_known_families():
+    """The scanner itself must keep working: families registered at
+    very different call shapes all appear."""
+    fams = emitted_families()
+    for known in ("wgl_level", "online_scheduler_backlog",
+                  "decision_latency_seconds", "verdict_causes_total",
+                  "service_rejects_total", "jepsen_op_latency_seconds"):
+        assert known in fams, f"scanner lost {known}"
+    assert len(fams) > 40
+
+
+def test_every_emitted_family_is_documented():
+    doc = DOC.read_text()
+    fams = emitted_families()
+    undocumented = {
+        name: files for name, files in sorted(fams.items())
+        if name not in doc and name not in ALLOWLIST
+    }
+    assert not undocumented, (
+        "metric/event families emitted by jepsen_tpu but absent from "
+        f"docs/telemetry.md (document them or allowlist with a "
+        f"reason): {undocumented}")
+
+
+def test_allowlist_is_not_stale():
+    fams = emitted_families()
+    stale = [n for n in ALLOWLIST if n not in fams]
+    assert not stale, (
+        f"allowlisted families no longer emitted anywhere: {stale}")
+
+
+def test_documented_provenance_metric_matches_taxonomy_doc():
+    """The new family is documented in BOTH docs: telemetry.md (the
+    series) and verdicts.md (the taxonomy it labels by)."""
+    assert "verdict_causes_total" in DOC.read_text()
+    verdicts = (REPO / "docs" / "verdicts.md").read_text()
+    assert "verdict_causes_total" in verdicts
+    from jepsen_tpu.checker import provenance as prov
+
+    for code in prov.TAXONOMY:
+        assert code in verdicts, (
+            f"taxonomy code {code} missing from docs/verdicts.md")

@@ -1,0 +1,370 @@
+"""Verdict provenance (jepsen_tpu.checker.provenance, ISSUE 13).
+
+Pins the closed taxonomy, the attach sites at every engine's
+degradation seam, the scheduler/service fold union (per-segment →
+per-key → per-tenant → per-run), the journal roundtrip of causes, the
+`verdict_causes_total{code,tenant}` metric family, and the /live
+dominant-cause surface. The chaos matrix (tests/test_chaos.py) pins
+fault → expected code end to end; this file pins the structure.
+"""
+
+import random
+
+import pytest
+
+from jepsen_tpu.checker import provenance as prov
+from jepsen_tpu.history import History
+from jepsen_tpu.models import CasRegister
+from jepsen_tpu.online import OnlineMonitor
+from jepsen_tpu.service import Service
+from jepsen_tpu.telemetry import Registry
+from jepsen_tpu.testing import (
+    chunked_register_history,
+    random_register_history,
+)
+
+
+def model():
+    return CasRegister(init=0)
+
+
+# ---------------------------------------------------------------------------
+# The taxonomy and helpers.
+
+
+class TestTaxonomy:
+    def test_taxonomy_is_closed(self):
+        with pytest.raises(ValueError):
+            prov.cause("not_a_code")
+
+    def test_cause_carries_layer_and_params(self):
+        c = prov.cause("max_configs", budget=100, engine="host")
+        assert c["code"] == "max_configs"
+        assert c["layer"] == "host"
+        assert c["params"] == {"budget": 100, "engine": "host"}
+
+    def test_attach_and_of(self):
+        r = prov.attach({"valid": "unknown"}, "carry_lost")
+        prov.attach(r, "max_configs", budget=2)
+        assert [c["code"] for c in prov.of(r)] == ["carry_lost",
+                                                   "max_configs"]
+        assert prov.of(None) == [] and prov.of({}) == []
+
+    def test_counts_dominant_block(self):
+        counts = prov.add_counts({}, [prov.cause("carry_lost"),
+                                      prov.cause("carry_lost"),
+                                      prov.cause("max_configs")])
+        assert counts == {"carry_lost": 2, "max_configs": 1}
+        assert prov.dominant(counts) == "carry_lost"
+        b = prov.block(counts)
+        assert b["total"] == 3 and b["dominant"] == "carry_lost"
+        assert prov.block({}) is None and prov.block(None) is None
+
+    def test_dominant_tie_breaks_deterministically(self):
+        assert prov.dominant({"b_code": 2, "a_code": 2}) == "a_code"
+
+    def test_annotate_copies_and_merges_params(self):
+        orig = prov.cause("carry_lost", seq=1)
+        out = prov.annotate([orig], seq=9, trace_span="s1")
+        assert out[0]["params"] == {"seq": 1, "trace_span": "s1"}
+        assert orig["params"] == {"seq": 1}  # shared dict untouched
+
+    def test_ensure_backstop(self):
+        assert prov.ensure([])[0]["code"] == "unattributed"
+        kept = [prov.cause("carry_lost")]
+        assert prov.ensure(kept) is kept
+
+    def test_pareto_sorted_with_descriptions(self):
+        rows = prov.pareto({"max_configs": 1, "carry_lost": 3})
+        assert [r["code"] for r in rows] == ["carry_lost", "max_configs"]
+        assert rows[0]["share"] == 0.75
+        assert rows[0]["layer"] == "online" and rows[0]["description"]
+
+    def test_metric_family_shape(self):
+        reg = Registry()
+        prov.count_metric(reg, [prov.cause("carry_lost")], tenant="t")
+        prov.count_metric(reg, ["max_configs"])
+        s = reg.summary()
+        assert s["verdict_causes_total"] == 2  # aggregate total
+        assert s["verdict_causes_total{code=carry_lost,tenant=t}"] == 1
+        assert s["verdict_causes_total{code=max_configs,tenant=}"] == 1
+
+
+# ---------------------------------------------------------------------------
+# Engine attach sites.
+
+
+class TestEngineSeams:
+    def test_host_oracle_max_configs(self):
+        from jepsen_tpu.ops import wgl_host
+        from jepsen_tpu.ops.encode import encode_history
+
+        h = random_register_history(random.Random(0), n_ops=200,
+                                    n_procs=6, cas=True)
+        res = wgl_host.check_encoded(encode_history(model(), h),
+                                     max_configs=3)
+        assert res["valid"] == "unknown"
+        (c,) = prov.of(res)
+        assert c["code"] == "max_configs" and c["params"]["budget"] == 3
+
+    def test_enumerator_max_configs(self):
+        from jepsen_tpu.online.segmenter import segment_states
+        from jepsen_tpu.ops.encode import encode_history
+
+        h = random_register_history(random.Random(1), n_ops=120,
+                                    n_procs=6, cas=True)
+        res = segment_states(encode_history(model(), h), max_configs=2)
+        assert res["valid"] == "unknown"
+        assert prov.of(res)[0]["code"] == "max_configs"
+
+    def test_native_max_configs_when_available(self):
+        from jepsen_tpu.ops.encode import encode_history
+        from jepsen_tpu.ops.wgl_c import check_encoded_native
+
+        h = random_register_history(random.Random(2), n_ops=300,
+                                    n_procs=8, cas=True)
+        res = check_encoded_native(encode_history(model(), h),
+                                   max_configs=5)
+        if res is None:
+            pytest.skip("native engine unavailable")
+        assert res["valid"] == "unknown"
+        assert prov.of(res)[0]["code"] == "max_configs"
+
+    def test_valid_results_carry_no_causes(self):
+        from jepsen_tpu.ops import wgl_host
+        from jepsen_tpu.ops.encode import encode_history
+
+        h = random_register_history(random.Random(3), n_ops=60,
+                                    n_procs=3, cas=True)
+        res = wgl_host.check_encoded(encode_history(model(), h))
+        assert res["valid"] is True and prov.of(res) == []
+
+
+# ---------------------------------------------------------------------------
+# The online fold union.
+
+
+class TestOnlineFold:
+    def _stream(self, max_configs):
+        reg = Registry()
+        mon = OnlineMonitor(model(), engine="host", metrics=reg,
+                            max_configs=max_configs)
+        for op in chunked_register_history(random.Random(5), n_ops=400,
+                                           n_procs=4, chunk_ops=40):
+            mon.observe(op)
+        return reg, mon.finish()
+
+    def test_clean_stream_has_no_provenance(self):
+        reg, fin = self._stream(500_000)
+        assert fin["valid"] is True
+        assert "provenance" not in fin
+        assert "verdict_causes_total" not in reg.summary()
+
+    def test_budget_trip_cascades_with_causes(self):
+        reg, fin = self._stream(2)
+        assert fin["valid"] == "unknown"
+        causes = fin["provenance"]["causes"]
+        # The root trip plus the carry-loss cascade; no taxonomy hole.
+        assert causes.get("max_configs")
+        assert causes.get("carry_lost")
+        assert "unattributed" not in causes
+        # Every unknown segment row is attributed, with seq params.
+        unknown_rows = [s for s in fin["segments"]
+                        if s["valid"] not in (True, False)]
+        assert unknown_rows
+        for row in unknown_rows:
+            assert row["causes"]
+            assert row["causes"][0]["params"]["seq"] == row["seq"]
+        # The metric family mirrors the fold.
+        s = reg.summary()
+        assert s["verdict_causes_total{code=carry_lost,tenant=}"] == \
+            causes["carry_lost"]
+
+    def test_mixed_keys_cause(self):
+        from jepsen_tpu import independent as ind
+        from jepsen_tpu.history import History, Op
+
+        specs = [("invoke", 0, "write", ind.KV("a", 1)),
+                 ("ok", 0, "write", ind.KV("a", 1)),
+                 ("invoke", 0, "write", 9), ("ok", 0, "write", 9)]
+        h = History([Op(t, p, f, v, time=i)
+                     for i, (t, p, f, v) in enumerate(specs)],
+                    reindex=True)
+        mon = OnlineMonitor(model(), engine="host")
+        for op in h:
+            mon.observe(op)
+        fin = mon.finish()
+        assert fin["valid"] == "unknown"
+        assert fin["provenance"]["causes"].get("mixed_keys") == 1
+
+
+# ---------------------------------------------------------------------------
+# Service + journal roundtrip.
+
+
+class TestServiceProvenance:
+    def _history(self, seed, n_ops=300):
+        return chunked_register_history(random.Random(seed),
+                                        n_ops=n_ops, n_procs=4,
+                                        chunk_ops=30)
+
+    def test_tenant_and_run_provenance(self, tmp_path):
+        reg = Registry()
+        svc = Service(model(), engine="host", metrics=reg,
+                      register_live=False, ledger=False, max_configs=2)
+        for op in self._history(7):
+            svc.submit("t1", op)
+        for op in self._history(8):
+            svc.submit("t2", op)
+        assert svc.flush(60)
+        snap = svc.tenant_snapshot("t1")
+        assert snap["dominant_unknown_cause"] in ("carry_lost",
+                                                  "max_configs")
+        assert snap["provenance"]["causes"]
+        fin = svc.drain(timeout=60)
+        for t in ("t1", "t2"):
+            tp = fin["tenants"][t]["provenance"]
+            assert tp["causes"] and "unattributed" not in tp["causes"]
+        # Run-level = union of the tenants.
+        run_causes = fin["provenance"]["causes"]
+        for code in ("carry_lost", "max_configs"):
+            assert run_causes[code] == sum(
+                fin["tenants"][t]["provenance"]["causes"].get(code, 0)
+                for t in ("t1", "t2"))
+        # Per-tenant metric children exist.
+        s = reg.summary()
+        assert any(k.startswith("verdict_causes_total{")
+                   and "tenant=t1" in k for k in s)
+
+    def test_journal_roundtrips_provenance(self, tmp_path):
+        d = str(tmp_path)
+        svc = Service(model(), engine="host", register_live=False,
+                      ledger=False, journal_dir=d, max_configs=2)
+        for op in self._history(9):
+            svc.submit("t", op)
+        fin = svc.drain(timeout=60)
+        want = fin["tenants"]["t"]["provenance"]
+        assert want["causes"]
+        svc2 = Service(model(), engine="host", register_live=False,
+                       ledger=False, journal_dir=d, max_configs=2)
+        try:
+            snap = svc2.tenant_snapshot("t")
+            assert snap["provenance"]["causes"] == want["causes"]
+            assert snap["dominant_unknown_cause"] == want["dominant"]
+        finally:
+            svc2.drain(timeout=30)
+
+    def test_journal_gap_cause_on_degraded_replay(self, tmp_path):
+        import json
+
+        from jepsen_tpu.service import journal as jj
+
+        d = str(tmp_path)
+        svc = Service(model(), engine="host", register_live=False,
+                      ledger=False, journal_dir=d)
+        for op in self._history(10, n_ops=200):
+            svc.submit("t", op)
+        svc.drain(timeout=60)
+        # Punch a committed-seq hole — the swallowed-append signature.
+        path = jj.tenant_path(d, "t")
+        lines = open(path).read().splitlines()
+        segs = [i for i, ln in enumerate(lines)
+                if json.loads(ln).get("kind") == "segment"]
+        assert len(segs) >= 3
+        del lines[segs[1]]
+        open(path, "w").write("\n".join(lines) + "\n")
+        svc2 = Service(model(), engine="host", register_live=False,
+                       ledger=False, journal_dir=d)
+        try:
+            snap = svc2.tenant_snapshot("t")
+            assert snap["verdict"] == "unknown"
+            assert snap["provenance"]["causes"].get("journal_gap") == 1
+        finally:
+            svc2.drain(timeout=30)
+
+    def test_lost_segments_cause_on_drain(self):
+        svc = Service(model(), engine="host", register_live=False,
+                      ledger=False)
+        h = list(self._history(11, n_ops=120))
+        # Close the scheduler under the service, then feed: the pump
+        # hits the closed scheduler and marks segments lost.
+        for op in h[:60]:
+            svc.submit("t", op)
+        svc.flush(30)
+        svc.scheduler.close(timeout=30)
+        for op in h[60:]:
+            svc.submit("t", op)
+        fin = svc.drain(timeout=30)
+        t = fin["tenants"]["t"]
+        assert t["valid"] == "unknown"
+        assert t["provenance"]["causes"].get("lost_segments")
+
+
+# ---------------------------------------------------------------------------
+# Scheduler restore + web surfaces.
+
+
+class TestSurfaces:
+    def test_restore_stream_seeds_cause_counts(self):
+        from jepsen_tpu.online.scheduler import SegmentScheduler
+
+        sched = SegmentScheduler(model(), engine="host")
+        try:
+            sched.restore_stream(
+                "t", watermark=5, next_seq=1,
+                cause_counts={"max_configs": 2, "carry_lost": 1})
+            res = sched.stream_result("t")
+            assert res["provenance"]["causes"] == {"max_configs": 2,
+                                                   "carry_lost": 1}
+            assert res["provenance"]["dominant"] == "max_configs"
+        finally:
+            sched.close(timeout=10)
+
+    def test_live_html_renders_dominant_cause(self):
+        from jepsen_tpu import web
+
+        page = web._live_page()
+        assert "dominant_unknown_cause" in page
+
+    def test_verdicts_page_lists_taxonomy(self, tmp_path):
+        from jepsen_tpu import web
+
+        page = web._verdicts_page(tmp_path)
+        assert "Verdict provenance" in page
+        for code in prov.TAXONOMY:
+            assert code in page
+
+    def test_verdicts_page_renders_run_pareto(self, tmp_path):
+        import json
+
+        from jepsen_tpu import web
+
+        run = tmp_path / "demo" / "20260804T000000.000Z"
+        run.mkdir(parents=True)
+        (run / "online.json").write_text(json.dumps({
+            "valid": "unknown",
+            "provenance": {"causes": {"max_configs": 4,
+                                      "carry_lost": 1},
+                           "dominant": "max_configs", "total": 5},
+        }))
+        page = web._verdicts_page(tmp_path)
+        assert "demo" in page and "max_configs" in page
+        assert "80.0%" in page  # 4/5 share
+
+    def test_verdicts_page_reads_metric_samples(self, tmp_path):
+        import json
+
+        from jepsen_tpu import web
+
+        run = tmp_path / "m" / "20260804T000001.000Z"
+        run.mkdir(parents=True)
+        with open(run / "metrics.jsonl", "w") as f:
+            f.write(json.dumps({
+                "name": "verdict_causes_total", "type": "counter",
+                "labels": {"code": "overflow_top_rung",
+                           "tenant": "t9"}, "value": 7}) + "\n")
+            f.write(json.dumps({
+                "name": "verdict_causes_total", "type": "counter",
+                "labels": {}, "value": 7}) + "\n")
+        page = web._verdicts_page(tmp_path)
+        assert "overflow_top_rung" in page and "t9" in page

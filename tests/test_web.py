@@ -64,7 +64,7 @@ def get(tmp_path):
 
 
 PAGES = ("/", "/metrics", "/profile", "/online", "/utilization",
-         "/runs", "/live.html")
+         "/runs", "/verdicts", "/live.html")
 
 
 class TestEndpointsWithoutTelemetry:
@@ -80,6 +80,8 @@ class TestEndpointsWithoutTelemetry:
         assert "--online" in get("/online")[2]
         assert "--profile" in get("/utilization")[2]
         assert "ledger.jsonl" in get("/runs")[2]
+        # /verdicts lists the closed taxonomy even on an empty store.
+        assert "overflow_top_rung" in get("/verdicts")[2]
 
     def test_live_is_wellformed_ndjson_with_no_live_run(self, get):
         status, ctype, body = get("/live")
