@@ -56,9 +56,21 @@ BASELINE_S = 300.0
 # holes. 0 (the default) disables the guard; TPU boxes never set it.
 DEVICE_SLOW_S = float(os.environ.get("BENCH_DEVICE_SLOW_S", "0") or 0)
 
+# Router-leg guard (same pattern as BENCH_DEVICE_SLOW_S): the
+# service_router leg spawns 2 real backend processes and drives HTTP
+# through a kill-9 + migration; on a starved CI/CPU box that can blow
+# the leg deadline. BENCH_ROUTER_SLOW_S=<seconds> skips it with a
+# TYPED {"skipped": "router_slow_guard"} record instead of timing out.
+# 0 (the default) disables the guard.
+ROUTER_SLOW_S = float(os.environ.get("BENCH_ROUTER_SLOW_S", "0") or 0)
+
 
 def _device_slow(worst_case_s: float) -> bool:
     return 0 < DEVICE_SLOW_S < worst_case_s
+
+
+def _router_slow(worst_case_s: float) -> bool:
+    return 0 < ROUTER_SLOW_S < worst_case_s
 
 
 # r6: the device scale metric runs under the SAME 300 s definition as
@@ -517,9 +529,14 @@ def main() -> int:
                           name="bench-service")
             t0 = time.perf_counter()
 
+            # The resume-aware client (jepsen_tpu/service/client.py)
+            # replaces the old ad-hoc submit loop: typed 429s retry
+            # with the server's own Retry-After estimate instead of
+            # dying on the first rejection.
+            from jepsen_tpu.service.client import InProcessServiceClient
+
             def _drive(name):
-                for op in histories[name]:
-                    svc.submit(name, op)
+                InProcessServiceClient(svc, name).feed(histories[name])
 
             feeders = [_threading.Thread(target=_drive, args=(n,))
                        for n in histories]
@@ -569,6 +586,159 @@ def main() -> int:
                 out["service_streams"]["provenance"] = fin["provenance"]
         except Exception as e:  # noqa: BLE001
             out["service_streams"] = {"error": f"{type(e).__name__}: {e}"}
+        finally:
+            _chaos.reset()
+
+        # Horizontal service resilience (router PR): 2 real backend
+        # PROCESSES × 4 tenants behind the tenant router, host engine,
+        # ndjson over real HTTP via the resume-aware client. Mid-run
+        # the `backend.process` chaos seam kill-9s one backend; the
+        # router migrates its tenants from their verdict journals and
+        # the clients resume from the journaled watermark — so
+        # `sustained_ops_per_s` and the p99 are BY CONSTRUCTION the
+        # recovered-after-migration numbers, and `migration_seconds`
+        # (benchcmp: `router_migration_seconds`, lower) prices the
+        # outage window itself.
+        _REC.begin("service_router")
+        try:
+            if _router_slow(120):
+                out["service_router"] = {"skipped": "router_slow_guard"}
+            elif _left() < 120:
+                out["service_router"] = {"skipped": "budget"}
+            else:
+                import tempfile
+                import threading as _threading
+
+                from jepsen_tpu.service import router as _jrouter
+                from jepsen_tpu.service.client import HttpServiceClient
+                from jepsen_tpu.telemetry import Registry as _SReg
+                from jepsen_tpu.testing import chunked_register_history
+
+                rreg = _SReg()
+                tmpd = tempfile.mkdtemp(prefix="jepsen-router-bench-")
+                env = dict(os.environ, JAX_PLATFORMS="cpu")
+                backends = _jrouter.spawn_backends(
+                    2, journal_root=tmpd, engine="host", metrics=rreg,
+                    failure_threshold=2, cooldown_s=60.0, env=env)
+                router = _jrouter.Router(
+                    backends, metrics=rreg, name="bench-router",
+                    register_live=False, probe_interval_s=0.1,
+                    failure_threshold=2, migrate_retry_after_s=0.1,
+                    rebalance=False)
+                rsrv = _jrouter.server(router, port=0)
+                _threading.Thread(target=rsrv.serve_forever,
+                                  daemon=True).start()
+                rurl = f"http://127.0.0.1:{rsrv.server_address[1]}"
+                n_t = 4
+                per_tenant = max(N_OPS // 8, 400)
+                hists = {
+                    f"tenant-{i}": chunked_register_history(
+                        random.Random(4200 + i), n_ops=per_tenant,
+                        n_procs=4, chunk_ops=60)
+                    for i in range(n_t)}
+                total_rows = sum(len(h) for h in hists.values())
+                clients = {
+                    n: HttpServiceClient(rurl, n, chunk_ops=64,
+                                         max_retries=200,
+                                         max_backoff_s=0.25)
+                    for n in hists}
+                reports: dict = {}
+                t0 = time.perf_counter()
+
+                def _drive_http(name):
+                    reports[name] = clients[name].feed(hists[name])
+
+                feeders = [_threading.Thread(target=_drive_http,
+                                             args=(n,))
+                           for n in hists]
+                try:
+                    for th in feeders:
+                        th.start()
+                    # Arm the kill once ~25% of the rows are observed,
+                    # so it lands mid-stream (a pre-feed kill would
+                    # measure a cold migration, a post-feed one none).
+                    arm_by = time.monotonic() + 60
+                    while time.monotonic() < arm_by:
+                        snap = router.tenants_snapshot()
+                        obs = sum((r or {}).get("ops_observed") or 0
+                                  for r in snap["tenants"].values())
+                        if obs >= total_rows // 4:
+                            break
+                        time.sleep(0.05)
+                    with _chaos.inject("backend.process", on_call=1):
+                        kill_by = time.monotonic() + 30
+                        while (_chaos.fired("backend.process") == 0
+                               and time.monotonic() < kill_by):
+                            time.sleep(0.05)
+                    for th in feeders:
+                        th.join()
+
+                    # Let EVERY victim tenant's migration land before
+                    # draining: the audit list fills per tenant (and
+                    # includes failed attempts), so "non-empty" would
+                    # let drain interrupt the second tenant's adopt
+                    # and flake the leg with a spurious orphan.
+                    def _settled():
+                        down = {b.name for b in backends if b.down}
+                        if not down:
+                            return False  # kill not yet detected
+                        st = router.stats()
+                        return all(bk not in down or t in st["orphaned"]
+                                   for t, bk in st["placement"].items())
+
+                    settle_by = time.monotonic() + 30
+                    while (time.monotonic() < settle_by
+                           and not _settled()):
+                        time.sleep(0.05)
+                    fin = router.drain(timeout=120)
+                    t_total = time.perf_counter() - t0
+                finally:
+                    router.close()
+                    rsrv.shutdown()
+                    rsrv.server_close()
+                mig_ok = [m for m in router.stats()["migrations"]
+                          if m.get("ok")]
+                verdicts = {n: str((fin["tenants"].get(n) or {})
+                                   .get("valid"))
+                            for n in hists}
+                out["service_router"] = {
+                    "backends": 2,
+                    "tenants": n_t,
+                    "n_ops_total": total_rows,
+                    "wall_s": round(t_total, 3),
+                    "sustained_ops_per_s": round(
+                        total_rows / t_total, 1),
+                    "p99_decision_latency_s":
+                        fin.get("p99_decision_latency_s"),
+                    "migrations": len(mig_ok),
+                    "migration_seconds": (round(
+                        max(m["seconds"] for m in mig_ok), 4)
+                        if mig_ok else None),
+                    "migrated_tenants": sorted(
+                        m["tenant"] for m in mig_ok),
+                    "chaos_injected_kills": _chaos.fired(
+                        "backend.process"),
+                    "client_retries": sum(
+                        r.get("retries", 0)
+                        for r in reports.values()),
+                    "client_resubmitted_ops": sum(
+                        r.get("resubmitted_ops", 0)
+                        for r in reports.values()),
+                    "resubmitted_ops_dropped": sum(
+                        (fin["tenants"].get(n) or {}).get(
+                            "resubmitted_ops_dropped") or 0
+                        for n in hists),
+                    "verdicts": verdicts,
+                    "valid_all": all(v == "True"
+                                     for v in verdicts.values()),
+                    "backend_loads":
+                        router.stats()["backend_loads"],
+                }
+                if fin.get("provenance"):
+                    out["service_router"]["provenance"] = \
+                        fin["provenance"]
+        except Exception as e:  # noqa: BLE001
+            out["service_router"] = {"error": f"{type(e).__name__}: {e}"}
         finally:
             _chaos.reset()
 

@@ -46,6 +46,12 @@ GAP_SHARE_THRESHOLD = 0.25
 CAUSE_SHARE_THRESHOLD = 0.5
 # p99/p50 decision-latency ratio past which the tail is pathological.
 TAIL_RATIO_THRESHOLD = 20.0
+# Per-backend load skew (router scale-out): the loaded backend must
+# exceed BOTH an absolute floor and this ratio × the least-loaded one
+# before a rebalance migration is worth its outage window — the same
+# thresholds service/router.py's plan_rebalance defaults to.
+REBALANCE_MIN_LOAD = 256.0
+REBALANCE_SKEW_RATIO = 4.0
 
 
 # ---------------------------------------------------------------------------
@@ -112,6 +118,41 @@ def collect_skipped_legs(doc: Any) -> list[str]:
         elif isinstance(v, dict):
             out.extend(f"{name}.{s}" for s in collect_skipped_legs(v))
     return out
+
+
+def collect_backend_loads(doc: Any) -> dict[str, float]:
+    """Per-backend load from every ``backend_loads`` block in the
+    document (the router bench leg / Router.stats() embed them):
+    backend -> load in scheduler-backlog units (max across
+    occurrences — one leg's skew must not be averaged away)."""
+    loads: dict[str, float] = {}
+
+    def _load_of(v: Any) -> Optional[float]:
+        if isinstance(v, (int, float)):
+            return float(v)
+        if isinstance(v, dict):
+            x = v.get("load")
+            if isinstance(x, (int, float)):
+                return float(x)
+        return None
+
+    def walk(d: Any) -> None:
+        if isinstance(d, dict):
+            bl = d.get("backend_loads")
+            if isinstance(bl, dict):
+                for name, v in bl.items():
+                    x = _load_of(v)
+                    if x is not None:
+                        loads[name] = max(loads.get(name, 0.0), x)
+            for k, v in d.items():
+                if k != "backend_loads":
+                    walk(v)
+        elif isinstance(d, list):
+            for v in d:
+                walk(v)
+
+    walk(doc)
+    return loads
 
 
 def _latency_tails(doc: Any) -> list[tuple[str, float, float]]:
@@ -335,6 +376,33 @@ def rule_journal_durability(ctx: dict) -> Optional[dict]:
     }
 
 
+def rule_rebalance_tenants(ctx: dict) -> Optional[dict]:
+    loads = ctx["backend_loads"]
+    if len(loads) < 2:
+        return None
+    names = sorted(loads)
+    src = max(names, key=lambda n: loads[n])
+    dst = min(names, key=lambda n: loads[n])
+    mx, mn = loads[src], loads[dst]
+    if src == dst or mx < REBALANCE_MIN_LOAD \
+            or mx < REBALANCE_SKEW_RATIO * (mn + 1.0):
+        return None
+    return {
+        "severity": "medium",
+        "title": "per-backend load skew — rebalance tenants across "
+                 "backends",
+        "advice": f"backend {src!r} carries {mx:.0f} load units "
+                  f"(backlog + queued ops + weighted journal lag) vs "
+                  f"{mn:.0f} on {dst!r}: enable the router's "
+                  "load-adaptive rebalancing (RouterConfig.rebalance) "
+                  "or migrate the heaviest tenant off the hot backend "
+                  "(`POST /migrate/<tenant>?target=…`) — the verdict "
+                  "journal makes the move lossless",
+        "evidence": {"loads": loads, "src": src, "dst": dst,
+                     "ratio": round(mx / (mn + 1.0), 1)},
+    }
+
+
 def rule_latency_tail(ctx: dict) -> Optional[dict]:
     tails = [(leg, p50, p99) for leg, p50, p99 in ctx["latency_tails"]
              if p99 / p50 > TAIL_RATIO_THRESHOLD]
@@ -363,6 +431,7 @@ RULES: list[tuple[str, Callable[[dict], Optional[dict]]]] = [
     ("journal_durability", rule_journal_durability),
     ("grow_batch_f", rule_grow_batch_f),
     ("feed_starved", rule_feed_starved),
+    ("rebalance_tenants", rule_rebalance_tenants),
     ("prewarm_compiles", rule_prewarm_compiles),
     ("trend_regressions", rule_trend_regressions),
     ("latency_tail", rule_latency_tail),
@@ -388,6 +457,7 @@ def advise(bench: dict, rounds: Optional[list] = None,
         "gap_shares": collect_gap_shares(bench or {}),
         "skipped_legs": collect_skipped_legs(bench or {}),
         "latency_tails": _latency_tails(bench or {}),
+        "backend_loads": collect_backend_loads(bench or {}),
     }
     out = []
     for rid, fn in RULES:

@@ -110,6 +110,17 @@ METRICS: list[tuple[str, str, str]] = [
     # trajectory — more or fewer failovers is a configuration fact,
     # not a regression.
     ("service_failovers_total", "service_streams.failovers", "info"),
+    # Horizontal service resilience (router PR): 2 backend processes ×
+    # 4 tenants behind the tenant router with one injected kill-9
+    # mid-run — the sustained throughput is the RECOVERED-after-
+    # migration number (shrinking = the outage window or the proxy
+    # overhead grew), and `router_migration_seconds` prices the
+    # journal-backed migration itself (checkpoint handover + adopt
+    # replay + placement flip; growing = recovery got slower).
+    ("router_sustained_ops_per_s",
+     "service_router.sustained_ops_per_s", "higher"),
+    ("router_migration_seconds",
+     "service_router.migration_seconds", "lower"),
 ]
 
 DEFAULT_THRESHOLD = 0.10

@@ -115,6 +115,18 @@ TAXONOMY: dict[str, tuple[str, str]] = {
         "journal",
         "journal replay detected swallowed appends (seq gap); the "
         "restored fold is pinned off definite-True"),
+    # -- router / scale-out --------------------------------------------------
+    "backend_lost": (
+        "router",
+        "a backend service process was lost; the tenant restored from "
+        "its journal checkpoint (anything undecided and unjournaled "
+        "degrades to unknown — with no usable journal the whole "
+        "stream does)"),
+    "migration_interrupted": (
+        "router",
+        "a tenant migration failed partway (adopt refused, target "
+        "unreachable, or JEPSEN_NO_MIGRATION); the tenant is orphaned "
+        "and folds unknown until a later migration succeeds"),
     # -- testing ------------------------------------------------------------
     "chaos": (
         "testing",

@@ -301,6 +301,33 @@ class SegmentScheduler:
             if violation is not None and self._violation is None:
                 self._violation = violation
 
+    def remove_stream(self, stream: Any) -> bool:
+        """Drop one stream's fold state (the service's live-migration
+        release: after the tenant's journal was handed to the target
+        backend, keeping the old fold would double-count it in
+        ``result()``/``stats()``). Refuses — returning False, state
+        untouched — while the stream still has submitted-but-undecided
+        work: discarding an in-flight fold could lose an invalid
+        verdict the journal never saw. The DEFAULT stream is never
+        removable (the monitor's watermark property reads it)."""
+        if stream == DEFAULT_STREAM:
+            return False
+        with self._cnt_lock:
+            with self._lock:
+                st = self._streams.get(stream)
+                if st is None:
+                    return True
+                if (st.seq_outstanding
+                        or self._inflight_by_stream.get(stream)
+                        or self._stream_depth.get(stream)):
+                    return False
+                del self._streams[stream]
+                self._stream_depth.pop(stream, None)
+                for dk in [k for k in self._key_depth
+                           if k[0] == stream]:
+                    del self._key_depth[dk]
+                return True
+
     def submit(self, segments: list[KeySegment],
                stream: Any = DEFAULT_STREAM) -> None:
         """Enqueue all KeySegments of one cut (atomically, so the

@@ -171,9 +171,18 @@ class Segmenter:
     def offer(self, op) -> list[KeySegment]:
         """Consume one history op (Op or plain scheduler dict); returns
         the KeySegments of a newly closed segment, usually ``[]``.
-        After :meth:`resume`, a pre-indexed op below the restored
-        watermark is a journal-covered duplicate: DROPPED (never
-        buffered — ``last_op`` reads None for it), not re-checked."""
+        A pre-indexed op BELOW the stream's high-water mark — the
+        restored-journal floor after :meth:`resume`, or simply an
+        index this segmenter has already observed — is a covered
+        duplicate: DROPPED (never buffered — ``last_op`` reads None
+        for it), not re-checked. The live-stream half matters as much
+        as the restore half: a client whose POST was ingested but
+        whose response was lost (or whose reconnect rewind overlaps
+        the watermark) resubmits ops this stream already consumed, and
+        re-checking them from the CURRENT carries could refute a valid
+        history — a flip, not a degradation. Indexed streams are
+        in-order by contract, so index < already-observed is always a
+        duplicate, never new work."""
         if isinstance(op, Op):
             had_index = op.index >= 0
         else:
@@ -181,8 +190,9 @@ class Segmenter:
             # very much an index (the nemesis_interval lesson).
             _idx = op.get("index") if isinstance(op, dict) else None
             had_index = isinstance(_idx, int) and _idx >= 0
+        seen_through = self._next_index  # BEFORE _as_op advances it
         op = self._as_op(op)
-        if had_index and op.index < self._floor:
+        if had_index and op.index < max(self._floor, seen_through):
             self.dropped_covered += 1
             self.last_op = None
             return []

@@ -27,6 +27,7 @@ from .journal import (  # noqa: F401
 )
 from .service import (  # noqa: F401
     AdmissionError,
+    AdoptUnsupportedError,
     IngestQueueFullError,
     QuotaExceededError,
     Service,
@@ -34,11 +35,16 @@ from .service import (  # noqa: F401
     ServiceConfig,
     ServiceError,
     TenantAbortedError,
+    TenantAdoptConflictError,
     TenantLimitError,
+    TenantMigratedError,
+    TenantMigratingError,
+    UnknownTenantError,
 )
 
 __all__ = [
     "AdmissionError",
+    "AdoptUnsupportedError",
     "IngestQueueFullError",
     "JournalError",
     "JournalModelMismatchError",
@@ -48,5 +54,9 @@ __all__ = [
     "ServiceConfig",
     "ServiceError",
     "TenantAbortedError",
+    "TenantAdoptConflictError",
     "TenantLimitError",
+    "TenantMigratedError",
+    "TenantMigratingError",
+    "UnknownTenantError",
 ]

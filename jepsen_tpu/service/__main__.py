@@ -37,8 +37,9 @@ from typing import Optional
 
 from ..models import known_models, model_by_name
 from ..telemetry import Registry
-from . import Service, ServiceConfig, ServiceError
+from . import Service, ServiceConfig
 from . import http as shttp
+from .client import InProcessServiceClient
 
 LOG = logging.getLogger("jepsen.service")
 
@@ -82,12 +83,13 @@ def simulate(service: Service, n_tenants: int, n_ops: int,
             h = perturb_history(random.Random(seed + 1000 + i), h,
                                 within=0.5)
         name = f"tenant-{i}"
-        for op in h:
-            try:
-                service.submit(name, op)
-            except ServiceError as e:
-                LOG.info("tenant %s: %s (%s)", name, e.code, e)
-                break
+        # The resume-aware client replaces the old ad-hoc loop: typed
+        # 429s are retried with the server's own Retry-After estimate,
+        # terminal rejections (aborted tenant) stop the feed cleanly.
+        rep = InProcessServiceClient(service, name).feed(h)
+        if rep["error"]:
+            LOG.info("tenant %s: stopped at op %d (%s)", name,
+                     rep["sent"], rep["error"])
 
     threads = [threading.Thread(target=run_one, args=(i,))
                for i in range(n_tenants)]
@@ -96,6 +98,64 @@ def simulate(service: Service, n_tenants: int, n_ops: int,
     for t in threads:
         t.join()
     return service.drain()
+
+
+def _run_router(ns: argparse.Namespace, metrics: Registry) -> int:
+    """``--router``: front a fleet of backend service processes."""
+    from . import router as jrouter
+
+    if ns.backend_urls:
+        backends = []
+        for i, spec in enumerate(ns.backend_urls.split(",")):
+            url, _, jdir = spec.strip().partition("=")
+            backends.append(jrouter.Backend(
+                f"backend-{i}", url, journal_dir=jdir or None,
+                metrics=metrics,
+                failure_threshold=ns.failure_threshold))
+    else:
+        if not ns.journal_dir:
+            print("--router needs --journal-dir (per-backend journal "
+                  "roots) or --backend-urls", file=sys.stderr)
+            return 2
+        backends = jrouter.spawn_backends(
+            ns.router_backends, journal_root=ns.journal_dir,
+            model=ns.model, engine=ns.engine,
+            max_configs=ns.max_configs, metrics=metrics,
+            failure_threshold=ns.failure_threshold,
+            extra_args=(("--abort-on-violation",)
+                        if ns.abort_on_violation else ()))
+    router = jrouter.Router(
+        backends, metrics=metrics, name=ns.name,
+        probe_interval_s=ns.probe_interval,
+        failure_threshold=ns.failure_threshold)
+    web_srv = None
+    if ns.live_port is not None:
+        from .. import web
+
+        web_srv = web.server(root=ns.store_root, port=ns.live_port)
+        threading.Thread(target=web_srv.serve_forever,
+                         name="jepsen-live-web", daemon=True).start()
+        print(f"live dashboard on http://0.0.0.0:"
+              f"{web_srv.server_address[1]}/live.html")
+    try:
+        try:
+            jrouter.serve(router, port=ns.port)
+            fin = router.drain()
+        except KeyboardInterrupt:
+            print("draining backends…", file=sys.stderr)
+            fin = router.drain()
+    finally:
+        router.close()
+        if web_srv is not None:
+            web_srv.shutdown()
+            web_srv.server_close()
+    print(json.dumps(fin, indent=1, sort_keys=True, default=str))
+    valid = fin.get("valid")
+    if valid is False:
+        return 1
+    if valid is not True:
+        return 2
+    return 0
 
 
 def main(argv: Optional[list] = None) -> int:
@@ -141,6 +201,27 @@ def main(argv: Optional[list] = None) -> int:
     p.add_argument("--live-port", type=int, default=None,
                    help="also serve the results browser (incl. the "
                         "/live per-tenant dashboard) on this port")
+    p.add_argument("--router", action="store_true",
+                   help="run as the scale-out FRONT-END instead of a "
+                        "backend: place tenants across N backend "
+                        "service processes, health-check them, and "
+                        "live-migrate tenants via their verdict "
+                        "journals (docs/service.md "
+                        "'Scale-out & migration')")
+    p.add_argument("--router-backends", type=int, default=2,
+                   metavar="N",
+                   help="spawn N backend processes (each gets its own "
+                        "port and <journal-dir>/backend-i; requires "
+                        "--journal-dir)")
+    p.add_argument("--backend-urls", default=None,
+                   help="attach to EXISTING backends instead of "
+                        "spawning: comma-separated url[=journal_dir] "
+                        "pairs")
+    p.add_argument("--probe-interval", type=float, default=1.0,
+                   help="router health-probe period (seconds)")
+    p.add_argument("--failure-threshold", type=int, default=3,
+                   help="consecutive failed probes before a backend "
+                        "is declared lost and its tenants migrate")
     p.add_argument("--simulate", type=int, default=None, metavar="N",
                    help="run N synthetic tenant streams through the "
                         "in-process seam instead of serving HTTP")
@@ -156,6 +237,8 @@ def main(argv: Optional[list] = None) -> int:
         format="%(asctime)s{%(threadName)s} %(levelname)s %(name)s - "
                "%(message)s")
     metrics = Registry()
+    if ns.router:
+        return _run_router(ns, metrics)
     service = build_service(ns, metrics=metrics)
 
     web_srv = None

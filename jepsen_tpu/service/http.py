@@ -29,8 +29,10 @@ import json
 import logging
 import math
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from urllib.parse import unquote
+from typing import Optional
+from urllib.parse import parse_qs, unquote, urlsplit
 
+from .journal import JournalError, JournalModelMismatchError
 from .service import Service, ServiceError
 
 LOG = logging.getLogger("jepsen.service")
@@ -40,6 +42,12 @@ LOG = logging.getLogger("jepsen.service")
 # a bigger stream is just more requests (the response's `accepted`
 # count is the client's resume cursor anyway).
 MAX_BODY_BYTES = 8 << 20
+# Adopt bodies are WHOLE journals and have no chunked resume protocol
+# (the replay needs the complete file) — a long-lived tenant's journal
+# easily exceeds the submit cap, and refusing it would permanently
+# orphan exactly the tenants with the most decided state to protect.
+# Still bounded: one adopt buffers at most this much.
+MAX_ADOPT_BODY_BYTES = 256 << 20
 
 
 def make_handler(service: Service, max_body: int = MAX_BODY_BYTES):
@@ -70,8 +78,11 @@ def make_handler(service: Service, max_body: int = MAX_BODY_BYTES):
                 if path in ("/", "/tenants", "/tenants/"):
                     self._json(200, service.live_snapshot())
                 elif path == "/healthz":
-                    self._json(200, {"ok": True,
-                                     "service": service.name})
+                    # Liveness PLUS the per-tenant overload signals
+                    # (backlog, journal_lag_ops, degraded) the router /
+                    # an external LB makes placement decisions from —
+                    # no /metrics scrape needed.
+                    self._json(200, service.health_snapshot())
                 else:
                     self._json(404, {"error": "not_found"})
             except Exception as e:  # noqa: BLE001 - never 500 silently
@@ -80,11 +91,18 @@ def make_handler(service: Service, max_body: int = MAX_BODY_BYTES):
                                  "detail": f"{type(e).__name__}: {e}"})
 
         def do_POST(self):
-            path = unquote(self.path)
+            parts = urlsplit(self.path)
+            path = unquote(parts.path)
+            query = parse_qs(parts.query)
             try:
                 if path.startswith("/submit/"):
                     tenant = path[len("/submit/"):].strip("/")
                     self._submit(tenant)
+                elif path.startswith("/adopt/"):
+                    self._adopt(path[len("/adopt/"):].strip("/"),
+                                query)
+                elif path.startswith("/release/"):
+                    self._release(path[len("/release/"):].strip("/"))
                 elif path in ("/drain", "/drain/"):
                     self._json(200, service.drain())
                 else:
@@ -94,16 +112,73 @@ def make_handler(service: Service, max_body: int = MAX_BODY_BYTES):
                 self._json(500, {"error": "internal",
                                  "detail": f"{type(e).__name__}: {e}"})
 
-        def _submit(self, tenant: str) -> None:
+        def _read_body(self, tenant: str, limit: Optional[int] = None):
+            """Bounded body read shared by submit and adopt; None when
+            the 413 was already sent."""
+            cap = limit if limit is not None else max_body
             length = int(self.headers.get("Content-Length") or 0)
-            if length > max_body:
+            if length > cap:
                 self._json(413, {
                     "error": "body_too_large", "tenant": tenant,
-                    "accepted": 0, "max_bytes": max_body,
+                    "accepted": 0, "max_bytes": cap,
                     "detail": "split the stream into smaller POSTs; "
                               "`accepted` is the resume cursor"})
+                return None
+            return self.rfile.read(length)
+
+        def _adopt(self, tenant: str, query: dict) -> None:
+            # The migration seam: body = the tenant's journal (the
+            # router's handover), ?cause= the typed migration reason
+            # (backend_lost). Typed refusals map like /submit's; a
+            # journal written for another model family is the 409 the
+            # PR-10 replay already types. The cap is the ADOPT cap —
+            # journals have no chunked resume protocol, and the
+            # submit-sized bound would orphan big tenants forever.
+            body = self._read_body(tenant, limit=MAX_ADOPT_BODY_BYTES)
+            if body is None:
                 return
-            body = self.rfile.read(length)
+            cause = (query.get("cause") or [None])[0]
+            try:
+                doc = service.adopt(tenant, body, cause=cause)
+            except ServiceError as e:
+                self._json(e.http_status,
+                           {"error": e.code, "tenant": tenant,
+                            "detail": str(e)},
+                           retry_after_s=(e.retry_after_s
+                                          if e.http_status in (429, 503)
+                                          else None))
+                return
+            except JournalModelMismatchError as e:
+                self._json(409, {"error": "journal_model_mismatch",
+                                 "tenant": tenant, "detail": str(e)})
+                return
+            except JournalError as e:
+                self._json(409, {"error": "journal_error",
+                                 "tenant": tenant, "detail": str(e)})
+                return
+            except ValueError as e:  # unknown provenance cause code
+                self._json(400, {"error": "bad_cause",
+                                 "tenant": tenant, "detail": str(e)})
+                return
+            self._json(200, doc)
+
+        def _release(self, tenant: str) -> None:
+            try:
+                doc = service.release(tenant)
+            except ServiceError as e:
+                self._json(e.http_status,
+                           {"error": e.code, "tenant": tenant,
+                            "detail": str(e)},
+                           retry_after_s=(e.retry_after_s
+                                          if e.http_status in (429, 503)
+                                          else None))
+                return
+            self._json(200, doc)
+
+        def _submit(self, tenant: str) -> None:
+            body = self._read_body(tenant)
+            if body is None:
+                return
             accepted = 0
             for line in body.splitlines():
                 line = line.strip()
@@ -130,7 +205,12 @@ def make_handler(service: Service, max_body: int = MAX_BODY_BYTES):
                     doc = {
                         "error": e.code, "tenant": tenant,
                         "accepted": accepted, "detail": str(e),
-                        "retryable": e.http_status == 429}
+                        # Migration 503s override the status-derived
+                        # default: the tenant comes back (elsewhere),
+                        # so the client retries through the router.
+                        "retryable": (e.retryable
+                                      if e.retryable is not None
+                                      else e.http_status == 429)}
                     ra = (e.retry_after_s
                           if e.http_status in (429, 503) else None)
                     if ra is not None:

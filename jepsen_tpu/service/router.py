@@ -1,0 +1,1190 @@
+"""Tenant router: horizontal scale-out for the checking service.
+
+One :class:`~jepsen_tpu.service.service.Service` process lives or dies
+as a unit — PR 10 made a *restart* of that unit lossless (the
+per-tenant verdict journal is the tenant's complete checkpoint), and
+this module cashes that enabler in for *horizontal* resilience
+(ROADMAP item 3): a front-end that places tenants across N backend
+service processes (each with its own scheduler/mesh slice and its own
+``--journal-dir``) and survives losing an ENTIRE backend the same way
+the single process survives a restart — by journal replay, one-sided,
+never a flipped verdict.
+
+The pieces:
+
+- **Sticky placement** — a tenant's first submit places it on the
+  least-loaded live backend; every later submit proxies to the same
+  backend (the fold is stateful; bouncing a tenant would fork it).
+- **Health checking** — a probe loop GETs each backend's ``/healthz``
+  (now carrying per-tenant backlog / ``journal_lag_ops`` / degraded
+  flags) under a deadline, feeding a per-backend
+  :class:`~jepsen_tpu.parallel.resilience.CircuitBreaker`:
+  ``failure_threshold`` consecutive failures open the circuit and the
+  backend is declared LOST (a spawned child's exit is detected
+  directly).
+- **Journal-backed migration** — losing a backend (or an overload
+  rebalance) moves each of its tenants: quiesce + ``POST
+  /release/<tenant>`` on a live source (the journal handover), or —
+  when the backend is dead — read the journal straight from its
+  ``--journal-dir`` (the journal IS the checkpoint; there is nothing
+  else to save), then ``POST /adopt/<tenant>`` on the target (replay
+  behind admission) and atomically flip placement. Clients mid-stream
+  get 503 + ``Retry-After`` and resume from the journaled watermark
+  exactly as after a PR-10 restart; resubmitted covered ops are
+  dropped server-side. Soundness is the PR-5/PR-10 quiescent-cut
+  argument: every journal record ends at a cut carrying the exact
+  feasible end-state set, so the target re-decides nothing that was
+  covered and checks everything above the watermark from the carried
+  states.
+- **Load-adaptive rebalancing** — :func:`plan_rebalance` is a pure
+  function over the ``/healthz`` overload signals (scheduler backlog,
+  queue depths, ``journal_lag_ops``); when one backend's load exceeds
+  the least-loaded's by ``rebalance_ratio`` (and an absolute floor),
+  the heaviest tenant is live-migrated off it.
+- **Failure attribution** — a tenant that cannot be migrated (no
+  target, no checkpoint, adopt refused, ``JEPSEN_NO_MIGRATION=1``) is
+  ORPHANED: its router-level row folds ``unknown`` with the typed
+  ``backend_lost`` / ``migration_interrupted`` causes
+  (checker/provenance.py) — degraded one-sidedly, never flipped.
+- **Chaos seams** — ``router.probe`` (an injected raise counts as a
+  failed health probe: the false-positive path) and
+  ``backend.process`` (the router SIGKILLs one of its own spawned
+  backend children: a real kill-9 of a real process).
+
+``JEPSEN_NO_MIGRATION=1`` is the operational kill-switch: no
+migrations, no rebalancing — dead backends simply orphan their
+tenants (checked per attempt, like every other kill-switch).
+
+Telemetry: ``router_placements_total{backend}``,
+``router_migrations_total{reason}``,
+``router_failed_probes_total{backend}``, ``router_orphaned_tenants``,
+``router_migration_seconds``. The router registers on the web
+``/live`` feed and aggregates ``/tenants`` across backends. See
+docs/service.md "Scale-out & migration".
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time as _time
+from dataclasses import dataclass, replace
+from typing import Any, Optional
+from urllib import error as _uerror
+from urllib import request as _urequest
+from urllib.parse import parse_qs, quote, unquote, urlsplit
+
+from ..checker import provenance as _prov
+from ..parallel import resilience as _resilience
+from ..testing import chaos as _chaos
+from . import journal as _journal
+
+LOG = logging.getLogger("jepsen.router")
+
+MIGRATION_SECONDS_BUCKETS = (0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+                             10.0, 30.0, 60.0)
+
+
+def migration_disabled() -> bool:
+    """``JEPSEN_NO_MIGRATION=1`` — checked per attempt, so flipping the
+    env in a live router takes effect (the kill-switch contract)."""
+    return os.environ.get("JEPSEN_NO_MIGRATION", "") == "1"
+
+
+class NoBackendError(RuntimeError):
+    """No live backend is available to place a tenant on."""
+
+
+@dataclass(frozen=True)
+class RouterConfig:
+    """Router policy knobs."""
+
+    probe_interval_s: float = 1.0
+    probe_timeout_s: float = 2.0
+    # Consecutive probe failures before a backend's circuit opens and
+    # it is declared lost (resilience.CircuitBreaker semantics; the
+    # cooldown paces half-open re-probes of a backend that may heal).
+    failure_threshold: int = 3
+    probe_cooldown_s: float = 30.0
+    http_timeout_s: float = 10.0
+    release_timeout_s: float = 30.0
+    drain_timeout_s: float = 120.0
+    # Retry-After hint on migration/unreachable 503s: a migration is a
+    # release+replay+flip, normally sub-second at bench scale.
+    migrate_retry_after_s: float = 1.0
+    # Load-adaptive rebalancing off the /healthz overload signals.
+    rebalance: bool = True
+    rebalance_min_load: float = 256.0
+    rebalance_ratio: float = 4.0
+    # journal_lag_ops (ops) -> load units (undecided segments are the
+    # base unit; ~100 ops of journal lag weigh like one segment).
+    lag_weight: float = 0.01
+    register_live: bool = True
+
+
+class Backend:
+    """One backend service process as the router sees it."""
+
+    def __init__(self, name: str, url: str,
+                 journal_dir: Optional[str] = None,
+                 proc: Optional[subprocess.Popen] = None,
+                 metrics=None, failure_threshold: int = 3,
+                 cooldown_s: float = 30.0) -> None:
+        self.name = name
+        self.url = url.rstrip("/")
+        self.journal_dir = journal_dir
+        self.proc = proc
+        # One breaker per backend: the consecutive-failure /
+        # cooldown / half-open-probe protocol is exactly the device
+        # path's (parallel/resilience.py) with "device" = "backend".
+        self.breaker = _resilience.CircuitBreaker(
+            f"router:{name}", failure_threshold=failure_threshold,
+            cooldown_s=cooldown_s, metrics=metrics)
+        self.health: Optional[dict] = None  # last good /healthz doc
+        self.down = False  # declared lost; tenants migrated away
+
+    def snapshot(self) -> dict:
+        out = {
+            "url": self.url,
+            "state": "lost" if self.down else self.breaker.state,
+            "down": self.down,
+        }
+        if self.proc is not None:
+            out["pid"] = self.proc.pid
+            out["exited"] = self.proc.poll()
+        if self.health is not None:
+            out["tenant_count"] = self.health.get("tenant_count")
+            out["scheduler_backlog"] = self.health.get(
+                "scheduler_backlog")
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Pure rebalance planning (closed-form-testable; the advisor's
+# rebalance_tenants rule applies the same load model to bench rounds).
+
+
+def backend_load(health: Optional[dict],
+                 lag_weight: float = 0.01) -> float:
+    """One backend's load in scheduler-backlog units from its
+    ``/healthz`` doc: undecided segments + queued ops + weighted
+    journal lag (what a migration NOW would force clients to
+    resubmit)."""
+    h = health or {}
+    tenants = h.get("tenants") or {}
+    load = float(h.get("scheduler_backlog") or 0)
+    for row in tenants.values():
+        row = row or {}
+        load += float(row.get("queue_depth") or 0)
+        load += lag_weight * float(row.get("journal_lag_ops") or 0)
+    return load
+
+
+def tenant_load(row: Optional[dict], lag_weight: float = 0.01) -> float:
+    r = row or {}
+    return (float(r.get("backlog") or 0)
+            + float(r.get("queue_depth") or 0)
+            + lag_weight * float(r.get("journal_lag_ops") or 0))
+
+
+def plan_rebalance(health_by_backend: dict, placement: dict, *,
+                   min_load: float = 256.0, ratio: float = 4.0,
+                   lag_weight: float = 0.01
+                   ) -> Optional[tuple[str, str, str]]:
+    """Pick at most ONE (tenant, src, dst) live migration: fires only
+    when the loaded backend exceeds both an absolute floor and
+    ``ratio``× the least-loaded backend, and moves the heaviest tenant
+    (deterministic tie-break). Pure — pinned closed-form in
+    tests/test_router.py and mirrored by the advisor's
+    ``rebalance_tenants`` rule."""
+    if len(health_by_backend) < 2:
+        return None
+    loads = {n: backend_load(h, lag_weight)
+             for n, h in health_by_backend.items()}
+    src = max(sorted(loads), key=lambda n: loads[n])
+    dst = min(sorted(loads), key=lambda n: loads[n])
+    if src == dst:
+        return None
+    if loads[src] < min_load or loads[src] < ratio * (loads[dst] + 1.0):
+        return None
+    rows = (health_by_backend[src] or {}).get("tenants") or {}
+    cands = [t for t, n in placement.items()
+             if n == src and t in rows]
+    if not cands:
+        return None
+    tenant = max(sorted(cands),
+                 key=lambda t: tenant_load(rows[t], lag_weight))
+    if tenant_load(rows[tenant], lag_weight) <= 0:
+        return None
+    return tenant, src, dst
+
+
+# ---------------------------------------------------------------------------
+
+
+class Router:
+    """The scale-out front-end: sticky tenant placement over N backend
+    service processes, health-checked, with journal-backed live
+    migration. See the module docstring."""
+
+    def __init__(self, backends: list[Backend],
+                 config: Optional[RouterConfig] = None, *,
+                 metrics=None, name: str = "router",
+                 **overrides) -> None:
+        cfg = config or RouterConfig()
+        if overrides:
+            cfg = replace(cfg, **overrides)
+        if not backends:
+            raise ValueError("router needs at least one backend")
+        self.config = cfg
+        self.metrics = metrics
+        self.name = name
+        self._backends: dict[str, Backend] = {}
+        for b in backends:
+            if b.name in self._backends:
+                raise ValueError(f"duplicate backend name {b.name!r}")
+            self._backends[b.name] = b
+            # ONE source of truth for the probe-circuit policy: the
+            # router's config re-arms every backend breaker, so a
+            # Backend constructed with different defaults cannot
+            # silently diverge from what the router believes (and
+            # logs) about its own thresholds.
+            b.breaker.failure_threshold = cfg.failure_threshold
+            b.breaker.cooldown_s = cfg.probe_cooldown_s
+        self._lock = threading.RLock()
+        self._placement: dict[str, str] = {}  # tenant -> backend name
+        self._migrating: set[str] = set()
+        # tenant -> {"from": backend, "causes": {code: n}, "note": …}:
+        # tenants the router could NOT move — their router-level rows
+        # fold unknown with these causes, never a definite verdict.
+        self._orphans: dict[str, dict] = {}
+        self.migrations: list[dict] = []  # bounded audit trail
+        self._draining = False
+        self._finished: Optional[dict] = None
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._health_loop, name="jepsen-router-health",
+            daemon=True)
+        self._thread.start()
+        if cfg.register_live:
+            try:
+                from .. import web
+
+                web.register_live_source(self.name, self.live_snapshot)
+            except Exception:  # noqa: BLE001 - observability only
+                LOG.warning("could not register router live source",
+                            exc_info=True)
+
+    # -- metrics -------------------------------------------------------------
+
+    def _count_placement(self, backend: str) -> None:
+        if self.metrics is not None:
+            self.metrics.counter(
+                "router_placements_total",
+                "Tenant placements decided by the router (first "
+                "placement + every migration flip), by backend",
+                labelnames=("backend",)).labels(backend=backend).inc()
+
+    def _count_failed_probe(self, backend: str) -> None:
+        if self.metrics is not None:
+            self.metrics.counter(
+                "router_failed_probes_total",
+                "Backend health probes that failed (timeout, refused, "
+                "unhealthy, chaos-injected), by backend",
+                labelnames=("backend",)).labels(backend=backend).inc()
+
+    def _count_migration(self, reason: str, seconds: float) -> None:
+        if self.metrics is not None:
+            self.metrics.counter(
+                "router_migrations_total",
+                "Journal-backed tenant migrations completed, by reason "
+                "(backend_lost / rebalance)",
+                labelnames=("reason",)).labels(reason=reason).inc()
+            self.metrics.histogram(
+                "router_migration_seconds",
+                "Wall seconds per tenant migration (checkpoint "
+                "handover + adopt replay + placement flip)",
+                buckets=MIGRATION_SECONDS_BUCKETS).observe(seconds)
+
+    def _set_orphans_gauge(self) -> None:
+        if self.metrics is not None:
+            self.metrics.gauge(
+                "router_orphaned_tenants",
+                "Tenants whose backend was lost and whose migration "
+                "could not complete — their verdicts fold unknown "
+                "(backend_lost / migration_interrupted)").set(
+                    len(self._orphans))
+
+    # -- backend HTTP --------------------------------------------------------
+
+    def _request(self, b: Backend, path: str,
+                 data: Optional[bytes] = None,
+                 timeout: Optional[float] = None) -> tuple[int, dict]:
+        """One backend call; never raises. status 0 = unreachable."""
+        req = _urequest.Request(
+            b.url + path, data=data,
+            method="POST" if data is not None else "GET")
+        try:
+            with _urequest.urlopen(
+                    req, timeout=timeout
+                    or self.config.http_timeout_s) as r:
+                doc = json.loads(r.read().decode() or "{}")
+                return r.status, doc if isinstance(doc, dict) else {}
+        except _uerror.HTTPError as e:
+            try:
+                doc = json.loads(e.read().decode() or "{}")
+            except ValueError:
+                doc = {}
+            return e.code, doc if isinstance(doc, dict) else {}
+        except Exception as e:  # noqa: BLE001 - dead socket, timeout
+            return 0, {"error": "unreachable", "detail": str(e)}
+
+    # -- placement + ingestion proxy -----------------------------------------
+
+    def _place(self, tenant: str) -> Backend:
+        with self._lock:
+            name = self._placement.get(tenant)
+            if name is not None:
+                b = self._backends.get(name)
+                if b is not None:
+                    return b
+            cands = [b for b in self._backends.values() if not b.down]
+            if not cands:
+                raise NoBackendError("no live backend to place on")
+            # Prefer backends whose probe circuit is quiet: a breaker
+            # opened by submit-path failures marks a backend the
+            # supervision tick has not yet declared lost — placing a
+            # NEW tenant there would just bounce. Fall back to any
+            # not-down backend when every circuit is engaged.
+            quiet = [b for b in cands if not b.breaker.engaged()]
+            counts: dict[str, int] = {}
+            for _t, n in self._placement.items():
+                counts[n] = counts.get(n, 0) + 1
+            b = min(quiet or cands,
+                    key=lambda bb: (counts.get(bb.name, 0), bb.name))
+            self._placement[tenant] = b.name
+        self._count_placement(b.name)
+        LOG.info("placed tenant %s on backend %s", tenant, b.name)
+        return b
+
+    def placement(self) -> dict[str, str]:
+        with self._lock:
+            return dict(self._placement)
+
+    def submit(self, tenant: str, body: bytes) -> tuple[int, dict]:
+        """Proxy one ndjson POST to the tenant's backend. Returns
+        (status, response doc); 503s carry ``retry_after_s`` +
+        ``retryable`` so the resume-aware client backs off and
+        re-anchors on the journaled watermark."""
+        cfg = self.config
+        with self._lock:
+            if self._draining:
+                return 503, {"error": "draining", "tenant": tenant,
+                             "accepted": 0, "retryable": False}
+            migrating = tenant in self._migrating
+            orphan = self._orphans.get(tenant)
+        if orphan is not None:
+            # The tenant's state is unrecoverable: the honest answer
+            # is a terminal refusal, not a silent fresh stream that
+            # would fork its history.
+            return 503, {"error": "orphaned", "tenant": tenant,
+                         "accepted": 0, "retryable": False,
+                         "causes": dict(orphan.get("causes") or {})}
+        if migrating:
+            return 503, {"error": "migrating", "tenant": tenant,
+                         "accepted": 0, "retryable": True,
+                         "retry_after_s": cfg.migrate_retry_after_s}
+        try:
+            b = self._place(tenant)
+        except NoBackendError:
+            return 503, {"error": "no_backend", "tenant": tenant,
+                         "accepted": 0, "retryable": True,
+                         "retry_after_s": cfg.migrate_retry_after_s}
+        status, doc = self._request(
+            b, f"/submit/{quote(tenant, safe='')}", data=body)
+        if status == 0:
+            # Fast-path death detection: the proxy saw the dead socket
+            # before the probe loop did. Feed the breaker and let the
+            # supervision tick decide; the client retries against the
+            # migrated placement.
+            b.breaker.record_failure()
+            self._count_failed_probe(b.name)
+            return 503, {"error": "backend_unreachable",
+                         "tenant": tenant, "accepted": 0,
+                         "retryable": True,
+                         "retry_after_s": cfg.migrate_retry_after_s}
+        doc.setdefault("backend", b.name)
+        return status, doc
+
+    # -- health / supervision ------------------------------------------------
+
+    def _probe(self, b: Backend) -> dict:
+        # Chaos seam INSIDE the probe's failure domain: an injected
+        # raise is indistinguishable from a timed-out /healthz — the
+        # false-positive migration path under test.
+        _chaos.fire("router.probe")
+        with _urequest.urlopen(b.url + "/healthz",
+                               timeout=self.config.probe_timeout_s) as r:
+            doc = json.loads(r.read().decode() or "{}")
+        if not isinstance(doc, dict) or not doc.get("ok"):
+            raise RuntimeError(f"backend {b.name} unhealthy: {doc!r}")
+        return doc
+
+    def _chaos_kill_tick(self) -> None:
+        """``backend.process``: an armed raise is the KILL ORDER — the
+        router SIGKILLs one live spawned backend child (a real kill-9:
+        torn journal line, dead socket) and then recovers through its
+        own probe/migration machinery."""
+        try:
+            _chaos.fire("backend.process")
+        except Exception:  # noqa: BLE001 - the armed fault
+            victim = next(
+                (b for b in self._backends.values()
+                 if b.proc is not None and b.proc.poll() is None
+                 and not b.down), None)
+            if victim is None:
+                LOG.warning("chaos backend.process fired with no live "
+                            "spawned backend to kill")
+                return
+            LOG.warning("chaos: kill -9 backend %s (pid %d)",
+                        victim.name, victim.proc.pid)
+            victim.proc.kill()
+
+    def _health_loop(self) -> None:
+        while not self._stop.wait(self.config.probe_interval_s):
+            try:
+                self._tick()
+            except Exception:  # noqa: BLE001 - supervision must survive
+                LOG.warning("router health tick failed", exc_info=True)
+
+    def _tick(self) -> None:
+        self._chaos_kill_tick()
+        for b in list(self._backends.values()):
+            if b.down:
+                continue
+            if b.proc is not None and b.proc.poll() is not None:
+                # A spawned child's exit needs no probe quorum.
+                self._on_backend_down(
+                    b, f"process exited rc={b.proc.poll()}")
+                continue
+            if b.breaker.state == "open":
+                # The circuit can open BETWEEN ticks off submit-path
+                # failures (the --backend-urls case with no child to
+                # poll): without this, the tick would silently skip
+                # the backend for a whole cooldown while clients
+                # exhaust their retries against a dead placement.
+                self._on_backend_down(
+                    b, "circuit open (consecutive submit/probe "
+                       "failures)")
+                continue
+            if not b.breaker.allow():
+                continue  # open, cooldown pending: skip doomed probes
+            try:
+                doc = self._probe(b)
+            except Exception as e:  # noqa: BLE001 - probe failure
+                b.breaker.record_failure()
+                self._count_failed_probe(b.name)
+                LOG.warning("probe of backend %s failed (%s: %s)",
+                            b.name, type(e).__name__, e)
+                if b.breaker.state == "open":
+                    self._on_backend_down(
+                        b, "probe circuit open "
+                        f"({self.config.failure_threshold} consecutive "
+                        "failures)")
+                continue
+            b.breaker.record_success()
+            b.health = doc
+        if (self.config.rebalance and not self._draining
+                and not migration_disabled()):
+            self._maybe_rebalance()
+
+    def _on_backend_down(self, b: Backend, why: str) -> None:
+        if b.down:
+            return
+        b.down = True
+        b.breaker.record_failure()
+        LOG.warning("backend %s declared LOST (%s); migrating its "
+                    "tenants", b.name, why)
+        with self._lock:
+            tenants = sorted(t for t, n in self._placement.items()
+                             if n == b.name)
+            self._migrating.update(tenants)
+        for t in tenants:
+            self._migrate(t, b, reason="backend_lost")
+
+    # -- migration -----------------------------------------------------------
+
+    def migrate(self, tenant: str, target: Optional[str] = None,
+                reason: str = "manual") -> bool:
+        """Operator/rebalance entry point: live-migrate one tenant off
+        its current backend (release → adopt → flip)."""
+        # Resolve and validate EVERYTHING before marking the tenant
+        # migrating: a raise after the mark (with _migrate's finally
+        # never entered) would wedge the tenant in 503-migrating
+        # forever and stall rebalancing router-wide.
+        with self._lock:
+            src_name = self._placement.get(tenant)
+            if src_name is None:
+                raise KeyError(f"tenant {tenant!r} is not placed")
+            src = self._backends[src_name]
+            dst = None
+            if target is not None:
+                dst = self._backends.get(target)
+                if dst is None:
+                    raise KeyError(
+                        f"unknown target backend {target!r}")
+            if tenant in self._migrating:
+                return False
+            self._migrating.add(tenant)
+        return self._migrate(tenant, src, reason=reason, target=dst)
+
+    def _pick_target(self, exclude: Backend) -> Optional[Backend]:
+        with self._lock:
+            cands = [b for b in self._backends.values()
+                     if not b.down and b.name != exclude.name]
+            if not cands:
+                return None
+            counts: dict[str, int] = {}
+            for _t, n in self._placement.items():
+                counts[n] = counts.get(n, 0) + 1
+            return min(cands,
+                       key=lambda bb: (counts.get(bb.name, 0), bb.name))
+
+    def _checkpoint(self, tenant: str, src: Backend
+                    ) -> tuple[Optional[str], Optional[str]]:
+        """Obtain the tenant's journal checkpoint: live release first
+        (also the recovery from a FALSE-POSITIVE probe death — a
+        healthy backend answers and quiesces), else off the source's
+        journal_dir. Returns (journal_text, adopt_cause)."""
+        # Socket timeout strictly ABOVE the backend's own quiesce
+        # deadline: a release that takes the full quiesce window must
+        # not be abandoned on the wire just as it completes.
+        status, doc = self._request(
+            src, f"/release/{quote(tenant, safe='')}", data=b"",
+            timeout=self.config.release_timeout_s + 15.0)
+        if status == 200 and isinstance(doc.get("journal"), str):
+            return doc["journal"], None
+        dead = src.down or (src.proc is not None
+                            and src.proc.poll() is not None)
+        path = (_journal.tenant_path(src.journal_dir, tenant)
+                if src.journal_dir else None)
+        if path and dead:
+            # The backend is demonstrably gone: its journal file IS
+            # the checkpoint (PR 10's whole point). Renamed after
+            # reading so a RESTARTED backend on the same dir cannot
+            # re-own a tenant that now lives elsewhere. NEVER taken
+            # from a live backend (a transient connect blip must not
+            # seize the file from under the owner's open fd — split
+            # ownership).
+            try:
+                with open(path, "rb") as f:
+                    data = f.read()
+                try:
+                    os.replace(path, path + ".migrated")
+                except OSError:
+                    pass
+                return data.decode("utf-8", "replace"), "backend_lost"
+            except OSError:
+                pass
+        if path:
+            # Release may have COMPLETED server-side with the response
+            # lost on the wire: the source then already renamed the
+            # file `.migrated` and tombstoned the tenant — the renamed
+            # file is a complete checkpoint nobody owns, safe to adopt
+            # whether or not the process is alive. (A successful adopt
+            # back onto this backend deletes the stale artifact, so a
+            # leftover here always describes the LATEST release.)
+            try:
+                with open(path + ".migrated", "rb") as f:
+                    return (f.read().decode("utf-8", "replace"),
+                            "backend_lost" if dead else None)
+            except OSError:
+                pass
+        return None, None
+
+    def _migrate(self, tenant: str, src: Backend, reason: str,
+                 target: Optional[Backend] = None) -> bool:
+        t0 = _time.monotonic()
+        entry: dict = {"tenant": tenant, "from": src.name,
+                       "reason": reason, "ok": False}
+        # Orphaning is for tenants whose SOURCE is gone (reason
+        # backend_lost): a refused migration off a LIVE backend —
+        # kill-switch, typo'd target, transient checkpoint failure —
+        # must leave the tenant serving where it is, not destroy a
+        # healthy stream behind a terminal 503 (review finding).
+        lost = reason == "backend_lost"
+        try:
+            if migration_disabled():
+                entry["error"] = "migration_disabled"
+                if lost:
+                    self._orphan(tenant, src,
+                                 ["backend_lost",
+                                  "migration_interrupted"],
+                                 note="JEPSEN_NO_MIGRATION=1")
+                return False
+            dst = target if target is not None \
+                else self._pick_target(exclude=src)
+            if dst is None or dst.down:
+                entry["error"] = "no_target"
+                if lost:
+                    self._orphan(tenant, src, ["backend_lost"],
+                                 note="no live target backend")
+                return False
+            entry["to"] = dst.name
+            jtext, cause = self._checkpoint(tenant, src)
+            if jtext is None:
+                entry["error"] = "no_checkpoint"
+                if lost:
+                    self._orphan(tenant, src, ["backend_lost"],
+                                 note="no journal checkpoint "
+                                      "recoverable")
+                return False
+            path = f"/adopt/{quote(tenant, safe='')}"
+            if cause:
+                path += f"?cause={quote(cause, safe='')}"
+            status, doc = self._request(dst, path,
+                                        data=jtext.encode("utf-8"))
+            if status != 200:
+                entry["error"] = (f"adopt_{status}_"
+                                  f"{doc.get('error') or 'failed'}")
+                # A live release already made the SOURCE forget the
+                # tenant — the checkpoint now exists only in this
+                # router's memory. Spill it next to the source's
+                # journals so an operator can re-adopt by hand instead
+                # of losing a recoverable stream.
+                self._spill_checkpoint(tenant, src, jtext)
+                self._orphan(
+                    tenant, src,
+                    ["backend_lost", "migration_interrupted"]
+                    if reason == "backend_lost"
+                    else ["migration_interrupted"],
+                    note=f"adopt on {dst.name} failed: {status} "
+                         f"{doc.get('error')}")
+                return False
+            with self._lock:
+                self._placement[tenant] = dst.name
+                # "Orphaned ... until a later migration succeeds"
+                # (docs/verdicts.md): this IS the later migration — a
+                # recovered tenant must serve again, not stay bricked
+                # behind the stale orphan record.
+                if self._orphans.pop(tenant, None) is not None:
+                    self._set_orphans_gauge()
+            self._count_placement(dst.name)
+            entry["ok"] = True
+            entry["watermark"] = doc.get("watermark")
+            LOG.info("migrated tenant %s %s -> %s (%s, watermark %s)",
+                     tenant, src.name, dst.name, reason,
+                     doc.get("watermark"))
+            return True
+        finally:
+            seconds = _time.monotonic() - t0
+            entry["seconds"] = round(seconds, 4)
+            with self._lock:
+                self.migrations.append(entry)
+                if len(self.migrations) > 1000:
+                    del self.migrations[:-1000]
+                self._migrating.discard(tenant)
+            if entry["ok"]:
+                self._count_migration(reason, seconds)
+
+    def _spill_checkpoint(self, tenant: str, src: Backend,
+                          jtext: str) -> None:
+        if not src.journal_dir:
+            return
+        try:
+            path = (_journal.tenant_path(src.journal_dir, tenant)
+                    + ".orphaned")
+            with open(path, "w", encoding="utf-8") as f:
+                f.write(jtext)
+            LOG.warning("spilled tenant %s's checkpoint to %s",
+                        tenant, path)
+        except OSError:
+            LOG.warning("could not spill tenant %s's checkpoint",
+                        tenant, exc_info=True)
+
+    def _orphan(self, tenant: str, src: Backend, codes: list,
+                note: str = "") -> None:
+        with self._lock:
+            o = self._orphans.setdefault(
+                tenant, {"from": src.name, "causes": {}})
+            _prov.add_counts(o["causes"], codes)
+            if note:
+                o["note"] = note
+            self._set_orphans_gauge()
+        _prov.count_metric(self.metrics,
+                           [_prov.cause(c) for c in codes],
+                           tenant=tenant)
+        LOG.warning("tenant %s ORPHANED (%s): %s — verdict folds "
+                    "unknown", tenant, "/".join(codes), note)
+
+    # -- rebalancing ---------------------------------------------------------
+
+    def _maybe_rebalance(self) -> None:
+        cfg = self.config
+        with self._lock:
+            if self._migrating:
+                return  # one migration at a time keeps causality easy
+            health = {n: b.health for n, b in self._backends.items()
+                      if not b.down and b.health is not None}
+            placement = dict(self._placement)
+        plan = plan_rebalance(health, placement,
+                              min_load=cfg.rebalance_min_load,
+                              ratio=cfg.rebalance_ratio,
+                              lag_weight=cfg.lag_weight)
+        if plan is None:
+            return
+        tenant, src, dst = plan
+        LOG.info("rebalance: migrating tenant %s %s -> %s",
+                 tenant, src, dst)
+        try:
+            self.migrate(tenant, target=dst, reason="rebalance")
+        except KeyError:
+            pass  # placement changed under us; next tick re-plans
+
+    # -- aggregation ---------------------------------------------------------
+
+    def tenants_snapshot(self) -> dict:
+        """Router-level ``GET /tenants``: every tenant's row from its
+        OWN backend, plus synthesized unknown rows for orphans — the
+        one place a reconnecting client reads its watermark from,
+        wherever the tenant lives now."""
+        with self._lock:
+            placement = dict(self._placement)
+            orphans = {t: dict(o) for t, o in self._orphans.items()}
+        rows: dict[str, dict] = {}
+        backends_doc: dict[str, dict] = {}
+        for b in self._backends.values():
+            backends_doc[b.name] = b.snapshot()
+            if b.down:
+                continue
+            # Probe-class timeout, not the proxy one: this aggregation
+            # backs every /live tick and every reconnecting client's
+            # watermark read — one slow backend must not freeze it for
+            # N × http_timeout_s.
+            status, doc = self._request(
+                b, "/tenants",
+                timeout=max(self.config.probe_timeout_s, 2.0))
+            if status != 200:
+                backends_doc[b.name]["unreachable"] = True
+                continue
+            for t, row in (doc.get("tenants") or {}).items():
+                if placement.get(t) == b.name and t not in orphans:
+                    row = dict(row or {})
+                    row["backend"] = b.name
+                    rows[t] = row
+        for t, o in orphans.items():
+            causes = dict(o.get("causes") or {})
+            rows[t] = {
+                "verdict": "unknown",
+                "orphaned": True,
+                "degraded": True,
+                "backend": o.get("from"),
+                "provenance": _prov.block(causes),
+                "dominant_unknown_cause": _prov.dominant(causes),
+            }
+        return {
+            "router": self.name,
+            "t": round(_time.time(), 3),
+            "tenant_count": len(rows),
+            "tenants": rows,
+            "backends": backends_doc,
+            "migrations": len(self.migrations),
+        }
+
+    def health_snapshot(self) -> dict:
+        """Router ``GET /healthz``: router liveness + the backend
+        table (state, last-known load)."""
+        with self._lock:
+            n_orphans = len(self._orphans)
+            n_migrating = len(self._migrating)
+        return {
+            "ok": True,
+            "router": self.name,
+            "draining": self._draining,
+            "backends": {n: b.snapshot()
+                         for n, b in self._backends.items()},
+            "orphaned_tenants": n_orphans,
+            "migrating_tenants": n_migrating,
+        }
+
+    def live_snapshot(self) -> dict:
+        """The web ``/live`` row: the service-shaped tenant table (the
+        dashboard renders it unchanged) plus the backend table."""
+        snap = self.tenants_snapshot()
+        rows = snap["tenants"]
+        return {
+            "run": self.name,
+            "service": True,
+            "router": True,
+            "t": snap["t"],
+            "draining": self._draining,
+            "tenant_count": len(rows),
+            "ops_observed": sum((r or {}).get("ops_observed") or 0
+                                for r in rows.values()),
+            "scheduler_backlog": sum(
+                (b.health or {}).get("scheduler_backlog") or 0
+                for b in self._backends.values() if not b.down),
+            "decision_latency": {},
+            "tenants": rows,
+            "backends": snap["backends"],
+        }
+
+    def stats(self) -> dict:
+        """Router counters for bench/tests (migration audit included;
+        ``backend_loads`` feeds the advisor's rebalance rule)."""
+        with self._lock:
+            migrations = [dict(m) for m in self.migrations]
+            orphans = {t: dict(o) for t, o in self._orphans.items()}
+            placement = dict(self._placement)
+        return {
+            "placement": placement,
+            "migrations": migrations,
+            "orphaned": orphans,
+            # LIVE backends only (like _maybe_rebalance): a lost
+            # backend's last-good health doc is stale — feeding it to
+            # the advisor would compute skew against (and point advice
+            # at) a backend that no longer exists.
+            "backend_loads": {
+                n: {
+                    "load": backend_load(b.health,
+                                         self.config.lag_weight),
+                    "scheduler_backlog": (b.health or {}).get(
+                        "scheduler_backlog") or 0,
+                    "journal_lag_ops": sum(
+                        (r or {}).get("journal_lag_ops") or 0
+                        for r in ((b.health or {}).get("tenants")
+                                  or {}).values()),
+                }
+                for n, b in self._backends.items() if not b.down
+            },
+        }
+
+    # -- drain / shutdown ----------------------------------------------------
+
+    def drain(self, timeout: Optional[float] = None) -> dict:
+        """Drain every live backend, merge the per-tenant results
+        (orphans fold unknown with their causes), stop supervision and
+        reap spawned children. Idempotent."""
+        with self._lock:
+            if self._finished is not None:
+                return self._finished
+            self._draining = True
+        timeout = timeout if timeout is not None \
+            else self.config.drain_timeout_s
+        self._stop.set()
+        # Let an in-flight supervision tick (and its migrations)
+        # finish before draining the backends: a /drain racing a
+        # mid-tick adopt would 503 it and spuriously orphan a tenant
+        # whose migration had every right to complete.
+        if threading.current_thread() is not self._thread:
+            self._thread.join(timeout=min(timeout, 60.0))
+        results: dict[str, dict] = {}
+        per_backend: dict[str, dict] = {}
+        p99s: list[float] = []
+        with self._lock:
+            placement = dict(self._placement)
+            orphans = {t: dict(o) for t, o in self._orphans.items()}
+        for b in self._backends.values():
+            if b.down:
+                per_backend[b.name] = {"error": "lost"}
+                continue
+            status, doc = self._request(b, "/drain", data=b"",
+                                        timeout=timeout)
+            if status != 200:
+                per_backend[b.name] = {
+                    "error": f"drain_{status}_"
+                             f"{doc.get('error') or 'failed'}"}
+                # Its tenants' verdicts are unrecoverable now.
+                for t, n in placement.items():
+                    if n == b.name and t not in orphans:
+                        orphans[t] = {"from": b.name,
+                                      "causes": {"backend_lost": 1}}
+                continue
+            per_backend[b.name] = {
+                "valid": doc.get("valid"),
+                "wall_s": doc.get("wall_s"),
+                "tenant_count": doc.get("tenant_count"),
+            }
+            lat = doc.get("decision_latency") or {}
+            if isinstance(lat.get("p99_s"), (int, float)):
+                p99s.append(float(lat["p99_s"]))
+            for t, r in (doc.get("tenants") or {}).items():
+                if placement.get(t) == b.name and t not in orphans:
+                    r = dict(r or {})
+                    r["backend"] = b.name
+                    results[t] = r
+        for t, o in orphans.items():
+            causes = dict(o.get("causes") or {})
+            results[t] = {
+                "valid": "unknown",
+                "orphaned": True,
+                "backend": o.get("from"),
+                "provenance": _prov.block(causes),
+                "info": "tenant orphaned by a lost backend; verdict "
+                        "degraded to unknown",
+            }
+        # A tenant whose backend died between the last probe and this
+        # drain (or whose migration the drain interrupted) has no row
+        # anywhere — it must surface as an honest unknown, never
+        # vanish from the results document.
+        with self._lock:
+            interrupted = set(self._migrating)
+        for t, n in placement.items():
+            if t in results:
+                continue
+            causes = {"migration_interrupted": 1} if t in interrupted \
+                else {"backend_lost": 1}
+            _prov.count_metric(self.metrics,
+                               [_prov.cause(c) for c in causes],
+                               tenant=t)
+            results[t] = {
+                "valid": "unknown",
+                "backend": n,
+                "provenance": _prov.block(causes),
+                "info": "tenant unreachable at drain (backend lost / "
+                        "migration interrupted); verdict degraded to "
+                        "unknown",
+            }
+        from ..checker import merge_valid
+
+        with self._lock:
+            migrations = [dict(m) for m in self.migrations]
+        fin = {
+            "router": self.name,
+            "tenants": results,
+            "tenant_count": len(results),
+            "backends": per_backend,
+            "valid": merge_valid(r.get("valid")
+                                 for r in results.values()),
+            # Per-tenant p99s don't compose into one histogram across
+            # processes; the conservative router-level number is the
+            # worst backend's aggregate p99.
+            "p99_decision_latency_s": max(p99s) if p99s else None,
+            "migrations": migrations,
+        }
+        run_prov = _prov.block(_prov.merge_counts(
+            *(((r.get("provenance") or {}).get("causes"))
+              for r in results.values())))
+        if run_prov is not None:
+            fin["provenance"] = run_prov
+        self._finished = fin
+        self._shutdown_children()
+        if self.config.register_live:
+            try:
+                from .. import web
+
+                web.unregister_live_source(self.name)
+            except Exception:  # noqa: BLE001
+                pass
+        return fin
+
+    def _shutdown_children(self) -> None:
+        for b in self._backends.values():
+            p = b.proc
+            if p is None or p.poll() is not None:
+                continue
+            try:
+                p.terminate()
+                p.wait(timeout=10)
+            except Exception:  # noqa: BLE001
+                try:
+                    p.kill()
+                    p.wait(timeout=5)
+                except Exception:  # noqa: BLE001
+                    pass
+
+    def close(self) -> None:
+        """Stop supervision without draining (test teardown)."""
+        self._stop.set()
+        self._thread.join(timeout=5)
+        self._shutdown_children()
+        if self.config.register_live:
+            try:
+                from .. import web
+
+                web.unregister_live_source(self.name)
+            except Exception:  # noqa: BLE001
+                pass
+
+
+# ---------------------------------------------------------------------------
+# Spawning real backend processes (the router CLI / bench / e2e tests).
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def spawn_backends(n: int, *, journal_root: str,
+                   model: str = "cas-register", engine: str = "host",
+                   max_configs: int = 500_000,
+                   name_prefix: str = "backend",
+                   extra_args: tuple = (), env: Optional[dict] = None,
+                   metrics=None, failure_threshold: int = 3,
+                   cooldown_s: float = 30.0,
+                   wait_ready_s: float = 120.0) -> list[Backend]:
+    """Spawn N backend service processes (``python -m
+    jepsen_tpu.service``), each with its own port and
+    ``--journal-dir`` under ``journal_root``, and wait for their
+    ``/healthz``. The returned Backends carry the child handles so the
+    router can detect exits and the ``backend.process`` chaos seam has
+    real processes to kill."""
+    backends: list[Backend] = []
+    try:
+        for i in range(n):
+            port = _free_port()
+            name = f"{name_prefix}-{i}"
+            jdir = os.path.join(journal_root, name)
+            cmd = [sys.executable, "-m", "jepsen_tpu.service",
+                   "--port", str(port), "--model", model,
+                   "--engine", engine, "--max-configs",
+                   str(max_configs), "--journal-dir", jdir,
+                   "--name", name, *extra_args]
+            proc = subprocess.Popen(cmd, env=env,
+                                    stdout=subprocess.DEVNULL,
+                                    stderr=subprocess.DEVNULL)
+            backends.append(Backend(
+                name, f"http://127.0.0.1:{port}", journal_dir=jdir,
+                proc=proc, metrics=metrics,
+                failure_threshold=failure_threshold,
+                cooldown_s=cooldown_s))
+        deadline = _time.monotonic() + wait_ready_s
+        for b in backends:
+            while True:
+                try:
+                    with _urequest.urlopen(b.url + "/healthz",
+                                           timeout=2) as r:
+                        if r.status == 200:
+                            break
+                except Exception:  # noqa: BLE001 - not up yet
+                    pass
+                if b.proc.poll() is not None:
+                    raise RuntimeError(
+                        f"backend {b.name} exited rc={b.proc.poll()} "
+                        "before becoming healthy")
+                if _time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"backend {b.name} not healthy after "
+                        f"{wait_ready_s}s")
+                _time.sleep(0.1)
+        return backends
+    except BaseException:
+        for b in backends:
+            if b.proc is not None and b.proc.poll() is None:
+                b.proc.kill()
+        raise
+
+
+# ---------------------------------------------------------------------------
+# The router's own HTTP front door (same machinery as service/http.py).
+
+
+def make_router_handler(router: Router):
+    from http.server import BaseHTTPRequestHandler
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, fmt, *args):  # quiet
+            LOG.debug(fmt, *args)
+
+        def _json(self, code: int, doc: dict) -> None:
+            import math
+
+            body = json.dumps(doc, sort_keys=True,
+                              default=str).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            ra = doc.get("retry_after_s")
+            if code in (429, 503) and isinstance(ra, (int, float)):
+                self.send_header("Retry-After",
+                                 str(max(1, math.ceil(ra))))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            path = unquote(urlsplit(self.path).path)
+            try:
+                if path in ("/", "/tenants", "/tenants/"):
+                    self._json(200, router.tenants_snapshot())
+                elif path == "/healthz":
+                    self._json(200, router.health_snapshot())
+                elif path in ("/live", "/live/"):
+                    self._json(200, router.live_snapshot())
+                elif path in ("/backends", "/backends/"):
+                    self._json(200, router.health_snapshot())
+                else:
+                    self._json(404, {"error": "not_found"})
+            except Exception as e:  # noqa: BLE001
+                LOG.warning("router error serving %s", path,
+                            exc_info=True)
+                self._json(500, {"error": "internal",
+                                 "detail": f"{type(e).__name__}: {e}"})
+
+        def do_POST(self):
+            parts = urlsplit(self.path)
+            path = unquote(parts.path)
+            query = parse_qs(parts.query)
+            try:
+                if path.startswith("/submit/"):
+                    tenant = path[len("/submit/"):].strip("/")
+                    length = int(self.headers.get("Content-Length")
+                                 or 0)
+                    # Same bounded-memory contract as the backend's
+                    # transport layer: the proxy must not buffer what
+                    # the backend would refuse anyway.
+                    from .http import MAX_BODY_BYTES
+
+                    if length > MAX_BODY_BYTES:
+                        self._json(413, {
+                            "error": "body_too_large",
+                            "tenant": tenant, "accepted": 0,
+                            "max_bytes": MAX_BODY_BYTES})
+                        return
+                    body = self.rfile.read(length)
+                    status, doc = router.submit(tenant, body)
+                    self._json(status, doc)
+                elif path.startswith("/migrate/"):
+                    tenant = path[len("/migrate/"):].strip("/")
+                    target = (query.get("target") or [None])[0]
+                    ok = router.migrate(tenant, target=target)
+                    self._json(200 if ok else 409,
+                               {"tenant": tenant, "migrated": ok})
+                elif path in ("/drain", "/drain/"):
+                    self._json(200, router.drain())
+                else:
+                    self._json(404, {"error": "not_found"})
+            except KeyError as e:
+                self._json(404, {"error": "unknown_tenant",
+                                 "detail": str(e)})
+            except Exception as e:  # noqa: BLE001
+                LOG.warning("router error serving %s", path,
+                            exc_info=True)
+                self._json(500, {"error": "internal",
+                                 "detail": f"{type(e).__name__}: {e}"})
+
+    return Handler
+
+
+def server(router: Router, port: int = 0):
+    from http.server import ThreadingHTTPServer
+
+    return ThreadingHTTPServer(("", port), make_router_handler(router))
+
+
+def serve(router: Router, port: int = 8088) -> None:
+    srv = server(router, port)
+    LOG.info("Router %s fronting %d backend(s) on http://0.0.0.0:%d",
+             router.name, len(router._backends),
+             srv.server_address[1])
+    print(f"Router {router.name} fronting "
+          f"{len(router._backends)} backend(s) on "
+          f"http://0.0.0.0:{srv.server_address[1]} "
+          "(POST /submit/<tenant>, GET /tenants, POST /drain)")
+    srv.serve_forever()

@@ -52,6 +52,7 @@ See docs/service.md.
 from __future__ import annotations
 
 import logging
+import os
 import queue
 import threading
 import time as _time
@@ -86,6 +87,10 @@ class ServiceError(Exception):
     http_status = 400
     code = "service_error"
     retry_after_s: Optional[float] = None
+    # None = derive from status (429 retryable, everything else not);
+    # a migration 503 overrides to True — the tenant comes back on
+    # another backend, and the client must keep retrying through it.
+    retryable: Optional[bool] = None
 
 
 # Fixed Retry-After hints where no live estimate exists: a full ingest
@@ -128,6 +133,52 @@ class TenantAbortedError(ServiceError):
 
     http_status = 409
     code = "tenant_aborted"
+
+
+class UnknownTenantError(ServiceError):
+    """The named tenant does not live on this backend."""
+
+    http_status = 404
+    code = "unknown_tenant"
+
+
+class TenantMigratingError(ServiceError):
+    """The tenant is mid-migration (released, or a second concurrent
+    release): the client should back off briefly and resume against
+    the router, which will hold the new placement."""
+
+    http_status = 503
+    code = "migrating"
+    retry_after_s = 1.0
+    retryable = True
+
+
+class TenantAdoptConflictError(ServiceError):
+    """Double-adopt refusal: the tenant (or its journal) already lives
+    on this backend — adopting it again would fork the fold."""
+
+    http_status = 409
+    code = "already_adopted"
+
+
+class TenantMigratedError(ServiceError):
+    """The tenant was released to another backend: this backend must
+    never silently re-admit it as a fresh stream (the fork would check
+    its tail from the model's init state — a potential flip). Clients
+    go through the router, which holds the new placement; only an
+    explicit ``adopt`` (journal in hand) may re-own the name here."""
+
+    http_status = 410
+    code = "migrated"
+    retryable = False
+
+
+class AdoptUnsupportedError(ServiceError):
+    """Adopt/release need a journal: without ``journal_dir`` this
+    backend has no checkpoint to restore from or hand over."""
+
+    http_status = 400
+    code = "no_journal"
 
 
 # ---------------------------------------------------------------------------
@@ -197,6 +248,13 @@ class _Tenant:
         self.detection: Optional[dict] = None
         self.journal = None           # TenantJournal when journaling
         self.resumed: Optional[dict] = None  # journal replay summary
+        # Highest watermark a SUCCESSFUL journal append has recorded —
+        # the resume point a release/crash hands over (a swallowed
+        # append must not advance it; the /healthz lag reads it).
+        self.journaled_watermark = -1
+        # release() flips this: the tenant is mid-migration, submits
+        # 503 with a short Retry-After while the router flips placement.
+        self.released = threading.Event()
         self.t0 = _time.monotonic()
         self.registered_at = _time.time()
         # Token bucket (guarded by self.lock).
@@ -221,6 +279,11 @@ class Service:
         self.metrics = metrics
         self.name = name
         self._tenants: dict[str, _Tenant] = {}
+        # Tombstones of tenants released to another backend: _admit
+        # refuses them (TenantMigratedError) so a stray direct-to-
+        # backend retry can't fork the stream as a fresh tenant; an
+        # explicit adopt (journal in hand) clears the tombstone.
+        self._released_tenants: set[str] = set()
         self._tlock = threading.Lock()
         self._draining = False
         self._drain_lock = threading.Lock()
@@ -285,6 +348,20 @@ class Service:
         never cross folds."""
         from . import journal as _journal
 
+        from urllib.parse import unquote as _unquote
+
+        # Tombstones survive restarts: a `.jsonl.migrated` file marks
+        # a tenant released to another backend — re-admitting it fresh
+        # here would fork its history (the TenantMigratedError class
+        # docstring's flip). An adopt (journal in hand) still clears
+        # the tombstone.
+        try:
+            for name in os.listdir(journal_dir):
+                if name.endswith(".jsonl.migrated"):
+                    self._released_tenants.add(
+                        _unquote(name[:-len(".jsonl.migrated")]))
+        except FileNotFoundError:
+            pass
         for tenant, path in _journal.scan(journal_dir).items():
             rep = _journal.replay(path, self.model)
             with self._tlock:
@@ -293,63 +370,99 @@ class Service:
                         f"journal dir holds more tenants than "
                         f"max_tenants={self.config.max_tenants}")
                 t = self._tenants[tenant] = _Tenant(tenant, self.config)
-            if rep.get("fresh"):
-                # Empty journal / torn header (a crash inside the very
-                # first write): nothing to restore — admit the tenant
-                # fresh and REWRITE the header so the reopened file is
-                # replayable next time.
-                self.scheduler.register_stream(
-                    tenant, **self._stream_hooks(t))
-                t.journal = _journal.TenantJournal(
-                    path, tenant, self.model,
-                    fsync=self.config.journal_fsync, fresh_header=True,
-                    truncate=True)
-                LOG.warning("tenant %s: journal was empty/torn; "
-                            "admitted fresh", tenant)
-                continue
-            t.resumed = {
-                "records": rep["records"],
-                "watermark": rep["watermark"],
-                "torn_tail": rep["torn_tail"],
-            }
-            if rep.get("degraded"):
-                # Swallowed-append gap: the restored fold is pinned
-                # unknown and carries are poisoned (journal.replay);
-                # surface it on the tenant row too.
-                t.resumed["degraded"] = True
-            t.segmenter.resume(rep["watermark"] + 1, rep["next_seq"])
-            if rep["violation"] is not None:
-                t.detection = {}  # detection clock predates this run
-                if self.config.abort_on_violation:
-                    t.aborted.set()
-            self.scheduler.restore_stream(
-                tenant,
-                watermark=rep["watermark"],
-                next_seq=rep["next_seq"],
-                carry=rep["carry"],
-                carry_poisoned=rep["carry_poisoned"],
-                n_decided=rep["n_decided"],
-                n_invalid=rep["n_invalid"],
-                n_unknown=rep["n_unknown"],
-                violation=rep["violation"],
-                segments=rep["segments"],
-                cause_counts=rep.get("cause_counts"),
-                **self._stream_hooks(t))
-            t.journal = _journal.TenantJournal(
-                path, tenant, self.model,
-                fsync=self.config.journal_fsync, fresh_header=False,
-                truncate_to=(rep["consistent_bytes"]
-                             if rep["torn_tail"] else None))
-            self._set_journal_lag(t, rep["watermark"])
-            LOG.info("tenant %s resumed from journal: watermark %d, "
-                     "%d records%s", tenant, rep["watermark"],
-                     rep["records"],
-                     " (torn tail)" if rep["torn_tail"] else "")
+            self._restore_tenant(t, path, rep)
         if self.metrics is not None and self._tenants:
             self.metrics.gauge(
                 "service_tenants",
                 "Tenant streams currently admitted").set(
                     len(self._tenants))
+
+    def _restore_tenant(self, t: _Tenant, path: str, rep: dict,
+                        adopt_cause: Optional[str] = None) -> None:
+        """Restore ONE tenant's fold state from a replayed journal —
+        the one seam the ctor replay AND the router's ``adopt`` share
+        (the two registration paths must not drift). The caller has
+        already inserted ``t`` into ``_tenants``; ``adopt_cause`` is
+        the migration reason the router passes (``backend_lost``)."""
+        from . import journal as _journal
+
+        tenant = t.name
+        if rep.get("fresh"):
+            # Empty journal / torn header (a crash inside the very
+            # first write): nothing to restore — admit the tenant
+            # fresh and REWRITE the header so the reopened file is
+            # replayable next time. An ADOPT that lands here is
+            # different: the router migrated a tenant it knows existed
+            # on a lost backend, so the stream has a decided past no
+            # carry enumerates — checking anything from the model's
+            # init state could wrongly refute. Pin the stream unknown
+            # with the migration cause (poisoned carries): strictly
+            # one-sided, never a flip.
+            if adopt_cause is None:
+                self.scheduler.register_stream(
+                    tenant, **self._stream_hooks(t))
+            else:
+                cc = _prov.add_counts({}, [adopt_cause])
+                self.scheduler.restore_stream(
+                    tenant, watermark=-1, next_seq=0, carry={},
+                    carry_poisoned=True, n_decided=1, n_unknown=1,
+                    cause_counts=cc, **self._stream_hooks(t))
+                _prov.count_metric(self.metrics,
+                                   [_prov.cause(adopt_cause)],
+                                   tenant=tenant)
+                t.resumed = {"records": 0, "watermark": -1,
+                             "torn_tail": bool(rep.get("torn_tail")),
+                             "degraded": True, "cause": adopt_cause}
+            t.journal = _journal.TenantJournal(
+                path, tenant, self.model,
+                fsync=self.config.journal_fsync, fresh_header=True,
+                truncate=True)
+            LOG.warning("tenant %s: journal was empty/torn; "
+                        "admitted %s", tenant,
+                        "fresh" if adopt_cause is None
+                        else f"pinned unknown ({adopt_cause})")
+            return
+        t.resumed = {
+            "records": rep["records"],
+            "watermark": rep["watermark"],
+            "torn_tail": rep["torn_tail"],
+        }
+        if adopt_cause is not None:
+            t.resumed["cause"] = adopt_cause
+        if rep.get("degraded"):
+            # Swallowed-append gap: the restored fold is pinned
+            # unknown and carries are poisoned (journal.replay);
+            # surface it on the tenant row too.
+            t.resumed["degraded"] = True
+        t.segmenter.resume(rep["watermark"] + 1, rep["next_seq"])
+        if rep["violation"] is not None:
+            t.detection = {}  # detection clock predates this run
+            if self.config.abort_on_violation:
+                t.aborted.set()
+        self.scheduler.restore_stream(
+            tenant,
+            watermark=rep["watermark"],
+            next_seq=rep["next_seq"],
+            carry=rep["carry"],
+            carry_poisoned=rep["carry_poisoned"],
+            n_decided=rep["n_decided"],
+            n_invalid=rep["n_invalid"],
+            n_unknown=rep["n_unknown"],
+            violation=rep["violation"],
+            segments=rep["segments"],
+            cause_counts=rep.get("cause_counts"),
+            **self._stream_hooks(t))
+        t.journal = _journal.TenantJournal(
+            path, tenant, self.model,
+            fsync=self.config.journal_fsync, fresh_header=False,
+            truncate_to=(rep["consistent_bytes"]
+                         if rep["torn_tail"] else None))
+        t.journaled_watermark = rep["watermark"]
+        self._set_journal_lag(t, rep["watermark"])
+        LOG.info("tenant %s resumed from journal: watermark %d, "
+                 "%d records%s", tenant, rep["watermark"],
+                 rep["records"],
+                 " (torn tail)" if rep["torn_tail"] else "")
 
     def _stream_hooks(self, t: _Tenant) -> dict:
         """The one hook triple every stream registration path
@@ -370,7 +483,12 @@ class Service:
         # are swallowed inside append_segment (durability lost, verdict
         # unaffected).
         if t.journal is not None:
-            t.journal.append_segment(row, key, carry, watermark)
+            if t.journal.append_segment(row, key, carry, watermark):
+                # Only a SUCCESSFUL append advances the durable resume
+                # point — a swallowed append's watermark was never
+                # written, and handing it over would promise coverage
+                # the file cannot deliver.
+                t.journaled_watermark = watermark
         self._set_journal_lag(t, watermark)
 
     def _set_journal_lag(self, t: _Tenant, watermark: int) -> None:
@@ -405,6 +523,10 @@ class Service:
             t = self._tenants.get(tenant)
             if t is not None:
                 return t
+            if tenant in self._released_tenants:
+                raise TenantMigratedError(
+                    f"tenant {tenant!r} was migrated off this "
+                    "backend; submit through the router")
             if len(self._tenants) >= self.config.max_tenants:
                 raise TenantLimitError(
                     f"max_tenants={self.config.max_tenants} reached; "
@@ -462,6 +584,268 @@ class Service:
                 labelnames=("tenant", "reason")).labels(
                     tenant=t.name, reason=reason).inc()
 
+    # -- live migration (the router's adopt/release seams) -------------------
+
+    def adopt(self, tenant: str, journal_text: Any,
+              cause: Optional[str] = None) -> dict:
+        """Adopt one migrated tenant: write its journal (handed over
+        by the router — the tenant's complete checkpoint) under this
+        backend's ``journal_dir`` and replay it behind ADMISSION —
+        draining, double-adopt (typed 409) and ``max_tenants`` all
+        refuse before a byte of fold state lands. On success the
+        tenant is live here exactly as after a PR-10 restart: the
+        reconnecting client resumes from the returned watermark, and
+        resubmitted covered ops are dropped server-side. ``cause``
+        (``backend_lost``) pins a journal that restores NOTHING to an
+        unknown fold — the tenant demonstrably had a past this backend
+        cannot check from. A failed adopt removes the written file so
+        the NEXT restart's ctor replay cannot trip over it."""
+        from . import journal as _journal
+
+        if not self.config.journal_dir:
+            raise AdoptUnsupportedError(
+                "this backend runs without --journal-dir; it cannot "
+                "adopt a migrated tenant")
+        if not isinstance(tenant, str) or not tenant:
+            raise ServiceError(f"invalid tenant name {tenant!r}")
+        if cause is not None:
+            _prov.cause(cause)  # closed-taxonomy validation, up front
+        data = (journal_text.encode("utf-8")
+                if isinstance(journal_text, str) else bytes(journal_text))
+        path = _journal.tenant_path(self.config.journal_dir, tenant)
+        # Phase 1, under _tlock: admission checks + a GATED
+        # placeholder (released ⇒ submits 503 with Retry-After while
+        # the restore runs). The expensive replay happens OUTSIDE the
+        # lock — _admit shares _tlock, and holding it through a
+        # 100k-record replay would freeze every OTHER tenant's
+        # ingestion on this backend.
+        with self._tlock:
+            if self._draining:
+                raise ServiceClosedError("service is draining")
+            if tenant in self._tenants:
+                raise TenantAdoptConflictError(
+                    f"tenant {tenant!r} already lives on this backend")
+            if len(self._tenants) >= self.config.max_tenants:
+                raise TenantLimitError(
+                    f"max_tenants={self.config.max_tenants} reached; "
+                    f"cannot adopt tenant {tenant!r}")
+            if os.path.exists(path):
+                raise TenantAdoptConflictError(
+                    f"a journal for tenant {tenant!r} already exists "
+                    "on this backend")
+            t = self._tenants[tenant] = _Tenant(tenant, self.config)
+            t.released.set()  # gate: not ready until phase 3
+            # An adopt legitimately re-owns a name this backend once
+            # released (a rebalance round-trip): clear the tombstone —
+            # but remember it, so a FAILED adopt restores it (dropping
+            # it would re-open the fresh-stream fork the tombstone
+            # exists to prevent, until the next restart re-scans the
+            # .migrated file).
+            was_tombstoned = tenant in self._released_tenants
+            self._released_tenants.discard(tenant)
+
+        def _undo():
+            with self._tlock:
+                self._tenants.pop(tenant, None)
+                if was_tombstoned:
+                    self._released_tenants.add(tenant)
+            for p in (tmp, path):
+                try:
+                    os.remove(p)
+                except OSError:
+                    pass
+
+        # Phase 2, no lock: write the journal and replay it.
+        tmp = f"{path}.{os.getpid()}.adopt"
+        try:
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+            with open(tmp, "wb") as f:
+                f.write(data)
+            os.replace(tmp, path)
+            rep = _journal.replay(path, self.model)
+        except BaseException:
+            _undo()
+            raise
+        # Phase 3, under _tlock: wire the restored fold in and open
+        # the gate. restore_stream requires a work-free stream — the
+        # gate guaranteed no submit touched the placeholder.
+        with self._tlock:
+            try:
+                if self._draining:
+                    raise ServiceClosedError("service is draining")
+                self._restore_tenant(t, path, rep, adopt_cause=cause)
+            except BaseException:
+                self._tenants.pop(tenant, None)
+                if was_tombstoned:
+                    self._released_tenants.add(tenant)
+                try:
+                    os.remove(path)
+                except OSError:
+                    pass
+                raise
+            t.released.clear()
+            # A re-adopt back onto a backend that once released this
+            # tenant: the old `.migrated` artifact is now stale — the
+            # fresh journal is authoritative — and leaving it would
+            # let a FUTURE migration's rescue path hand out an ancient
+            # checkpoint.
+            try:
+                os.remove(path + ".migrated")
+            except OSError:
+                pass
+            if self.metrics is not None:
+                self.metrics.gauge(
+                    "service_tenants",
+                    "Tenant streams currently admitted").set(
+                        len(self._tenants))
+        LOG.info("adopted tenant %s (watermark %d, %d records%s)",
+                 tenant, rep.get("watermark", -1),
+                 rep.get("records", 0),
+                 f", cause={cause}" if cause else "")
+        return {
+            "tenant": tenant,
+            "watermark": rep.get("watermark", -1),
+            "records": rep.get("records", 0),
+            "fresh": bool(rep.get("fresh")),
+            "torn_tail": bool(rep.get("torn_tail")),
+            "resumed": dict(t.resumed) if t.resumed is not None else None,
+        }
+
+    def release(self, tenant: str,
+                timeout: Optional[float] = 30.0) -> dict:
+        """Live-migration handover of one tenant: stop admitting its
+        ops (submits 503 with ``Retry-After`` — the router holds the
+        client off while placement flips), QUIESCE it (queue drained,
+        every accepted op fed, no undecided segments — so the journal
+        is a complete checkpoint through the fold watermark), then
+        close the journal, hand its content back, rename the file
+        (``.migrated`` — a restart of THIS backend must not re-replay
+        a tenant that now lives elsewhere) and forget the tenant. A
+        quiesce that outlives ``timeout`` still hands over the journal
+        — the un-fed tail sits above the journaled watermark, so the
+        client's resume re-submits it on the target: coverage lost,
+        never a verdict flipped."""
+        from . import journal as _journal
+
+        with self._tlock:
+            if self._draining:
+                raise ServiceClosedError("service is draining")
+            t = self._tenants.get(tenant)
+            if t is None:
+                raise UnknownTenantError(
+                    f"tenant {tenant!r} does not live on this backend")
+            if t.journal is None:
+                raise AdoptUnsupportedError(
+                    f"tenant {tenant!r} has no journal; there is no "
+                    "checkpoint to hand over")
+            if t.released.is_set():
+                raise TenantMigratingError(
+                    f"tenant {tenant!r} is already being released")
+            t.released.set()
+        deadline = ((_time.monotonic() + timeout)
+                    if timeout is not None else None)
+        quiesced = False
+        while True:
+            with t.lock:
+                fed = t.ops_observed == t.ops_ingested
+            if (fed and t.queue.qsize() == 0
+                    and self.scheduler.stream_backlog(tenant) == 0):
+                quiesced = True
+                break
+            self._wake.set()
+            if deadline is not None and _time.monotonic() > deadline:
+                break
+            _time.sleep(0.002)
+        # After quiesce no appender is left (on_segment fires under the
+        # fold lock BEFORE the backlog reaches 0), so the file content
+        # IS the checkpoint. Close first: a post-handover append must
+        # fail (counted, swallowed), never extend a handed-over file.
+        t.journal.close()
+        path = _journal.tenant_path(self.config.journal_dir, tenant)
+        try:
+            with open(path, "rb") as f:
+                data = f.read()
+        except OSError as e:
+            # The handover failed BEFORE anything moved: un-release so
+            # the tenant is not wedged behind a permanent 503 — reopen
+            # the journal for appends where the file still exists
+            # (where it vanished, appends stay swallowed-and-counted:
+            # rewriting a fresh header over a stream with a decided
+            # past would make the NEXT restart check it from init).
+            if os.path.exists(path):
+                try:
+                    t.journal = _journal.TenantJournal(
+                        path, tenant, self.model,
+                        fsync=self.config.journal_fsync,
+                        fresh_header=False)
+                except Exception:  # noqa: BLE001 - durability only
+                    LOG.warning("could not reopen journal for tenant "
+                                "%s after a failed release", tenant,
+                                exc_info=True)
+            t.released.clear()
+            raise ServiceError(
+                f"journal for tenant {tenant!r} unreadable: {e}")
+        try:
+            os.replace(path, path + ".migrated")
+        except OSError:
+            pass
+        with self._tlock:
+            self._tenants.pop(tenant, None)
+            # Tombstone: a stray direct-to-backend retry must get a
+            # typed 410, never a silent fresh stream (fork).
+            self._released_tenants.add(tenant)
+            if self.metrics is not None:
+                self.metrics.gauge(
+                    "service_tenants",
+                    "Tenant streams currently admitted").set(
+                        len(self._tenants))
+        removed = self.scheduler.remove_stream(tenant)
+        LOG.info("released tenant %s (journaled watermark %d, "
+                 "quiesced=%s)", tenant, t.journaled_watermark,
+                 quiesced)
+        return {
+            "tenant": tenant,
+            "watermark": t.journaled_watermark,
+            "quiesced": quiesced,
+            "stream_removed": removed,
+            "journal": data.decode("utf-8", "replace"),
+        }
+
+    def health_snapshot(self) -> dict:
+        """The enriched ``GET /healthz`` document: liveness plus the
+        per-tenant overload signals (undecided-segment backlog,
+        ``journal_lag_ops``, ``degraded``) the router — or any
+        external load balancer — needs for placement and rebalancing
+        decisions without scraping ``/metrics``."""
+        with self._tlock:
+            items = list(self._tenants.items())
+            draining = self._draining
+        tenants: dict[str, dict] = {}
+        for name, t in items:
+            ss = self.scheduler.stream_stats(name)
+            row: dict = {
+                "backlog": ss.get("backlog", 0) or 0,
+                "queue_depth": t.queue.qsize(),
+                "watermark": ss.get("decided_through_index"),
+                "degraded": bool(
+                    t.lost_segments or ss.get("segments_unknown")
+                    or (t.journal is not None
+                        and t.journal.append_failures)),
+            }
+            if t.journal is not None:
+                row["journal_lag_ops"] = max(
+                    t.segmenter.next_index
+                    - (t.journaled_watermark + 1), 0)
+            tenants[name] = row
+        return {
+            "ok": True,
+            "service": self.name,
+            "draining": draining,
+            "tenant_count": len(items),
+            "scheduler_backlog": self.scheduler.backlog,
+            "tenants": tenants,
+        }
+
     # -- ingestion -----------------------------------------------------------
 
     def submit(self, tenant: str, op: Any) -> None:
@@ -471,6 +855,10 @@ class Service:
         drain's deadline truncates the stream — reported per tenant as
         ``undelivered_ops``)."""
         t = self._admit(tenant)
+        if t.released.is_set():
+            raise TenantMigratingError(
+                f"tenant {t.name!r} is being migrated to another "
+                "backend; retry against the router")
         if t.aborted.is_set():
             t.rejected["aborted"] += 1
             self._count_reject(t, "aborted")
@@ -672,13 +1060,14 @@ class Service:
             snap["dominant_unknown_cause"] = _prov.dominant(prov_counts)
         if t.resumed is not None:
             snap["resumed_from_journal"] = dict(t.resumed)
-            if t.segmenter.dropped_covered:
-                # Resubmitted ops at/below the journaled watermark the
-                # server dropped (re-checking them from the restored
-                # carries could flip a verdict — the resume protocol
-                # is enforced, not trusted).
-                snap["resubmitted_ops_dropped"] = \
-                    t.segmenter.dropped_covered
+        if t.segmenter.dropped_covered:
+            # Resubmitted ops at/below the stream's high-water mark
+            # the server dropped — the restored-journal floor, or a
+            # LIVE stream's lost-response/rewind overlap (re-checking
+            # either from the current carries could flip a verdict:
+            # the resume protocol is enforced, not trusted).
+            snap["resubmitted_ops_dropped"] = \
+                t.segmenter.dropped_covered
         if t.journal is not None and t.journal.append_failures:
             # Durability (not verdict) is compromised: a crash now
             # would lose more than the journaled watermark admits.
@@ -859,9 +1248,9 @@ class Service:
                 out["provenance"] = _prov.block(prov_counts)
             if t.resumed is not None:
                 out["resumed_from_journal"] = dict(t.resumed)
-                if t.segmenter.dropped_covered:
-                    out["resubmitted_ops_dropped"] = \
-                        t.segmenter.dropped_covered
+            if t.segmenter.dropped_covered:
+                out["resubmitted_ops_dropped"] = \
+                    t.segmenter.dropped_covered
             if t.journal is not None:
                 if t.journal.append_failures:
                     out["journal_append_failures"] = \

@@ -227,6 +227,10 @@ _BENCH_LEGS: list[tuple[str, Optional[str], str, dict]] = [
      {"value_s": "wall_s", "ops_per_s": "sustained_ops_per_s",
       "p99_decision_latency_s": "p99_decision_latency_s",
       "ops": "n_ops_total", "verdict": "valid_all"}),
+    ("service_router", "service_router", "host",
+     {"value_s": "wall_s", "ops_per_s": "sustained_ops_per_s",
+      "p99_decision_latency_s": "p99_decision_latency_s",
+      "ops": "n_ops_total", "verdict": "valid_all"}),
     ("batch_replay_100", "batch_replay_100", "device",
      {"value_s": "value_s"}),
     ("batch_replay_large", "batch_replay_large", "device",

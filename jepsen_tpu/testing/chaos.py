@@ -37,6 +37,21 @@ Fault points (the seams, in pipeline order):
   (append failures are counted and swallowed); ``crash`` mode here is
   the kill-9 test — the journal's torn-line tolerance and replay are
   exercised by restarting the process.
+- ``router.probe`` — the tenant router's backend health probe
+  (service/router.py ``_probe``), fired inside the probe's own
+  failure guard. A raise counts exactly like a timed-out/refused
+  ``/healthz``: ``times >= failure_threshold`` consecutive raises open
+  the backend's circuit and trigger journal-backed migration of its
+  tenants — against a backend process that is actually healthy, which
+  is precisely the false-positive the migration protocol must stay
+  one-sided under.
+- ``backend.process`` — the router's supervision tick
+  (service/router.py ``_chaos_kill_tick``). An armed raise is the
+  KILL ORDER: the router SIGKILLs one live *spawned backend child
+  process* (a real kill-9 of a real process — torn journal line,
+  unflushed queues, dead TCP socket) and then observes the death
+  through its normal probe/migration machinery. Routers with no
+  spawned children cross the seam but have nothing to kill.
 
 Modes: ``raise`` (raise ``exc`` on the Nth crossing, ``times`` times),
 ``delay`` (sleep ``delay_s``; models a slow device/disk), ``crash``
@@ -65,6 +80,8 @@ POINTS = (
     "device.dispatch",
     "host.stack",
     "journal.fsync",
+    "router.probe",
+    "backend.process",
 )
 
 MODES = ("raise", "delay", "crash")

@@ -721,13 +721,30 @@ async function tick() {
         let tenantTable = '';
         if (r.tenants) {
           // A multi-tenant service line: per-tenant depth/watermark
-          // rows instead of the single-run monitor fields.
+          // rows instead of the single-run monitor fields. A ROUTER
+          // line (r.router) is service-shaped but spans backends, so
+          // it adds a backend-state strip and may have no aggregate
+          // latency histogram of its own.
+          const p50 = lat.p50_s === undefined ? '-' : lat.p50_s;
+          const p99 = lat.p99_s === undefined ? '-' : lat.p99_s;
           head = '<p>' + (r.draining ? 'DRAINING · ' : '') +
+            (r.router ? 'ROUTER · ' : '') +
             r.tenant_count + ' tenants' +
             ' · ' + r.ops_observed + ' ops observed' +
             ' · backlog ' + r.scheduler_backlog +
-            ' · p50/p99 decide ' + lat.p50_s + '/' + lat.p99_s + 's' +
+            ' · p50/p99 decide ' + p50 + '/' + p99 + 's' +
             '</p>';
+          if (r.backends) {
+            head += '<p>backends: ' +
+              Object.entries(r.backends).map(([n, b]) => {
+                b = b || {};
+                const bad = b.down || b.state === 'lost' ||
+                  b.state === 'open';
+                return (bad ? '<span class="stall">' : '') + n +
+                  ' [' + (b.state || '?') + ']' +
+                  (bad ? '</span>' : '');
+              }).join(' · ') + '</p>';
+          }
           tenantTable = '<table><tr><th>tenant</th><th>verdict</th>' +
             '<th>watermark</th><th>ops</th><th>queue</th>' +
             '<th>backlog</th><th>undecided</th><th>p99 s</th>' +

