@@ -677,16 +677,28 @@ def main() -> int:
                     # draining: the audit list fills per tenant (and
                     # includes failed attempts), so "non-empty" would
                     # let drain interrupt the second tenant's adopt
-                    # and flake the leg with a spurious orphan.
+                    # and flake the leg with a spurious orphan. With
+                    # the supervision layer on, "settled" also means
+                    # the FULL kill→respawn→re-adopt cycle finished:
+                    # the victim's replacement child passed /healthz
+                    # and the fleet is back at N — so the leg's
+                    # sustained ops/s is the fully-recovered number
+                    # and `respawn_seconds` prices the repair.
                     def _settled():
-                        down = {b.name for b in backends if b.down}
-                        if not down:
-                            return False  # kill not yet detected
                         st = router.stats()
-                        return all(bk not in down or t in st["orphaned"]
-                                   for t, bk in st["placement"].items())
+                        fl = st["fleet"]
+                        if fl["respawns"] < 1:
+                            return False  # repair not yet complete
+                        if fl["live_backends"] < \
+                                fl["configured_backends"]:
+                            return False
+                        down = {b.name for b in backends if b.down}
+                        return all(bk not in down
+                                   or t in st["orphaned"]
+                                   for t, bk in
+                                   st["placement"].items())
 
-                    settle_by = time.monotonic() + 30
+                    settle_by = time.monotonic() + 90
                     while (time.monotonic() < settle_by
                            and not _settled()):
                         time.sleep(0.05)
@@ -696,7 +708,8 @@ def main() -> int:
                     router.close()
                     rsrv.shutdown()
                     rsrv.server_close()
-                mig_ok = [m for m in router.stats()["migrations"]
+                r_stats = router.stats()
+                mig_ok = [m for m in r_stats["migrations"]
                           if m.get("ok")]
                 verdicts = {n: str((fin["tenants"].get(n) or {})
                                    .get("valid"))
@@ -731,8 +744,19 @@ def main() -> int:
                     "verdicts": verdicts,
                     "valid_all": all(v == "True"
                                      for v in verdicts.values()),
-                    "backend_loads":
-                        router.stats()["backend_loads"],
+                    "backend_loads": r_stats["backend_loads"],
+                    # The self-healing cycle (supervision PR): how
+                    # long spawn → /healthz took (benchcmp:
+                    # router_respawn_seconds, lower; the ledger
+                    # records it). The fleet block carries the rest
+                    # (respawns, give-ups) for the advisor's
+                    # respawn_backend rule.
+                    "respawn_seconds":
+                        r_stats["fleet"]["respawn_seconds"],
+                    "readopt_migrations": sum(
+                        1 for m in mig_ok
+                        if m.get("reason") == "readopt"),
+                    "fleet": r_stats["fleet"],
                 }
                 if fin.get("provenance"):
                     out["service_router"]["provenance"] = \

@@ -155,6 +155,38 @@ def collect_backend_loads(doc: Any) -> dict[str, float]:
     return loads
 
 
+def collect_fleet(doc: Any) -> dict:
+    """The worst fleet-capacity block (``fleet``) found anywhere in
+    the document — the router bench leg / ``Router.stats()`` embed
+    them: configured vs live backend counts plus the supervision
+    state (respawn disabled / gave up). "Worst" = the largest
+    capacity deficit; a healthy block must not average away a
+    degraded one."""
+    worst: dict = {}
+
+    def _deficit(f: dict) -> int:
+        c, l = f.get("configured_backends"), f.get("live_backends")
+        if isinstance(c, int) and isinstance(l, int):
+            return c - l
+        return -1
+
+    def walk(d: Any) -> None:
+        nonlocal worst
+        if isinstance(d, dict):
+            f = d.get("fleet")
+            if isinstance(f, dict) and _deficit(f) > _deficit(worst):
+                worst = dict(f)
+            for k, v in d.items():
+                if k != "fleet":
+                    walk(v)
+        elif isinstance(d, list):
+            for v in d:
+                walk(v)
+
+    walk(doc)
+    return worst
+
+
 def _latency_tails(doc: Any) -> list[tuple[str, float, float]]:
     """(leg, p50, p99) for every decision-latency summary present."""
     out = []
@@ -403,6 +435,49 @@ def rule_rebalance_tenants(ctx: dict) -> Optional[dict]:
     }
 
 
+def rule_respawn_backend(ctx: dict) -> Optional[dict]:
+    """Fleet running below its configured N with the self-healing
+    layer out of play (respawn disabled, or the flap circuit gave up)
+    — mirrored against the router's own supervision policy the way
+    `rebalance_tenants` mirrors `plan_rebalance`: while the
+    supervisor is still working on a respawn the advisor stays quiet
+    (the fleet is healing itself), exactly as the router does."""
+    fleet = ctx["fleet"]
+    conf = fleet.get("configured_backends")
+    live = fleet.get("live_backends")
+    if not isinstance(conf, int) or not isinstance(live, int) \
+            or live >= conf:
+        return None
+    disabled = bool(fleet.get("respawn_disabled"))
+    gave_up = list(fleet.get("respawn_gave_up") or [])
+    if not disabled and not gave_up:
+        return None  # the supervisor is on it; no operator action yet
+    what = []
+    if disabled:
+        what.append("respawn is disabled (JEPSEN_NO_RESPAWN / "
+                    "RouterConfig.respawn=False)")
+    if gave_up:
+        what.append("the flap-damping circuit gave up on "
+                    + ", ".join(repr(n) for n in gave_up))
+    return {
+        "severity": "high",
+        "title": "fleet below configured capacity — respawn is not "
+                 "going to restore it",
+        "advice": f"the fleet runs {live}/{conf} backends and "
+                  + "; ".join(what)
+                  + " — investigate why the backend keeps dying "
+                    "(its journal dir is intact; a respawn re-binds "
+                    "it), then re-enable respawn or restart the "
+                    "router so the supervisor re-arms; until then "
+                    "every verdict rides the survivors at reduced "
+                    "capacity",
+        "evidence": {"configured_backends": conf,
+                     "live_backends": live,
+                     "respawn_disabled": disabled,
+                     "respawn_gave_up": gave_up},
+    }
+
+
 def rule_latency_tail(ctx: dict) -> Optional[dict]:
     tails = [(leg, p50, p99) for leg, p50, p99 in ctx["latency_tails"]
              if p99 / p50 > TAIL_RATIO_THRESHOLD]
@@ -429,6 +504,7 @@ RULES: list[tuple[str, Callable[[dict], Optional[dict]]]] = [
     ("raise_max_configs", rule_raise_max_configs),
     ("failover_review", rule_failover_review),
     ("journal_durability", rule_journal_durability),
+    ("respawn_backend", rule_respawn_backend),
     ("grow_batch_f", rule_grow_batch_f),
     ("feed_starved", rule_feed_starved),
     ("rebalance_tenants", rule_rebalance_tenants),
@@ -458,6 +534,7 @@ def advise(bench: dict, rounds: Optional[list] = None,
         "skipped_legs": collect_skipped_legs(bench or {}),
         "latency_tails": _latency_tails(bench or {}),
         "backend_loads": collect_backend_loads(bench or {}),
+        "fleet": collect_fleet(bench or {}),
     }
     out = []
     for rid, fn in RULES:

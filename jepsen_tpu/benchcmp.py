@@ -121,6 +121,12 @@ METRICS: list[tuple[str, str, str]] = [
      "service_router.sustained_ops_per_s", "higher"),
     ("router_migration_seconds",
      "service_router.migration_seconds", "lower"),
+    # Self-healing fleet (supervision PR): the leg's kill now runs a
+    # FULL kill→respawn→re-adopt cycle; this prices the repair half
+    # (spawn → /healthz on the replacement child; growing = recovery
+    # to N capacity got slower).
+    ("router_respawn_seconds",
+     "service_router.respawn_seconds", "lower"),
 ]
 
 DEFAULT_THRESHOLD = 0.10

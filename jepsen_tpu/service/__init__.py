@@ -34,6 +34,7 @@ from .service import (  # noqa: F401
     ServiceClosedError,
     ServiceConfig,
     ServiceError,
+    StaleEpochError,
     TenantAbortedError,
     TenantAdoptConflictError,
     TenantLimitError,
@@ -53,6 +54,7 @@ __all__ = [
     "ServiceClosedError",
     "ServiceConfig",
     "ServiceError",
+    "StaleEpochError",
     "TenantAbortedError",
     "TenantAdoptConflictError",
     "TenantLimitError",

@@ -100,6 +100,33 @@ def simulate(service: Service, n_tenants: int, n_ops: int,
     return service.drain()
 
 
+def _run_roll(router_url: str) -> int:
+    """``--roll ROUTER_URL``: ask a running router for a rolling
+    restart and report the per-backend outcome."""
+    from urllib import error as _uerror
+    from urllib import request as _urequest
+
+    req = _urequest.Request(router_url.rstrip("/") + "/roll",
+                            data=b"", method="POST")
+    try:
+        with _urequest.urlopen(req, timeout=600) as r:
+            doc = json.loads(r.read().decode() or "{}")
+    except _uerror.HTTPError as e:
+        # A partial roll answers 409 WITH the structured per-backend
+        # report (which backend failed to drain, which rolled) — the
+        # operator needs that body, not just the status line.
+        try:
+            doc = json.loads(e.read().decode() or "{}")
+        except ValueError:
+            doc = {"ok": False, "error": f"http_{e.code}"}
+    except Exception as e:  # noqa: BLE001 - router down / refused
+        print(f"roll failed: {type(e).__name__}: {e}",
+              file=sys.stderr)
+        return 2
+    print(json.dumps(doc, indent=1, sort_keys=True, default=str))
+    return 0 if doc.get("ok") else 1
+
+
 def _run_router(ns: argparse.Namespace, metrics: Registry) -> int:
     """``--router``: front a fleet of backend service processes."""
     from . import router as jrouter
@@ -127,7 +154,9 @@ def _run_router(ns: argparse.Namespace, metrics: Registry) -> int:
     router = jrouter.Router(
         backends, metrics=metrics, name=ns.name,
         probe_interval_s=ns.probe_interval,
-        failure_threshold=ns.failure_threshold)
+        failure_threshold=ns.failure_threshold,
+        state_path=ns.state_path,
+        respawn=not ns.no_respawn)
     web_srv = None
     if ns.live_port is not None:
         from .. import web
@@ -165,7 +194,14 @@ def main(argv: Optional[list] = None) -> int:
                     "ingestion, per-tenant online verdicts, cross-"
                     "tenant device co-batching.")
     p.add_argument("--port", type=int, default=8089,
-                   help="ingestion port (POST /submit/<tenant>)")
+                   help="ingestion port (POST /submit/<tenant>); 0 "
+                        "binds an ephemeral port (see --port-file)")
+    p.add_argument("--port-file", default=None,
+                   help="write the BOUND ingestion port here "
+                        "(atomically, after bind) — the spawned-"
+                        "backend readiness protocol the router's "
+                        "respawn supervisor reads, immune to the "
+                        "probe-then-bind port race")
     p.add_argument("--model", choices=known_models(),
                    default="cas-register")
     p.add_argument("--model-args", default=None,
@@ -222,6 +258,23 @@ def main(argv: Optional[list] = None) -> int:
     p.add_argument("--failure-threshold", type=int, default=3,
                    help="consecutive failed probes before a backend "
                         "is declared lost and its tenants migrate")
+    p.add_argument("--state-path", default=None,
+                   help="router crash safety: append placement / "
+                        "orphan records / the placement epoch to this "
+                        "jsonl; a restarted router replays it and "
+                        "reconciles against live backend reality "
+                        "(docs/service.md 'Supervision & rolling "
+                        "restart')")
+    p.add_argument("--no-respawn", action="store_true",
+                   help="disable the respawn supervisor (equivalent "
+                        "to JEPSEN_NO_RESPAWN=1): dead spawned "
+                        "backends stay dead, the fleet runs on the "
+                        "survivors")
+    p.add_argument("--roll", metavar="ROUTER_URL", default=None,
+                   help="POST /roll to a RUNNING router (rolling "
+                        "restart: drain-migrate, respawn and re-adopt "
+                        "one backend at a time) and print the "
+                        "result; exits 0 when every backend rolled")
     p.add_argument("--simulate", type=int, default=None, metavar="N",
                    help="run N synthetic tenant streams through the "
                         "in-process seam instead of serving HTTP")
@@ -236,6 +289,8 @@ def main(argv: Optional[list] = None) -> int:
         level=logging.INFO,
         format="%(asctime)s{%(threadName)s} %(levelname)s %(name)s - "
                "%(message)s")
+    if ns.roll:
+        return _run_roll(ns.roll)
     metrics = Registry()
     if ns.router:
         return _run_router(ns, metrics)
@@ -258,7 +313,8 @@ def main(argv: Optional[list] = None) -> int:
                            invalid_tenants=ns.sim_invalid)
         else:
             try:
-                shttp.serve(service, port=ns.port)
+                shttp.serve(service, port=ns.port,
+                            port_file=ns.port_file)
                 fin = service.drain()  # serve_forever returned
             except KeyboardInterrupt:
                 print("draining…", file=sys.stderr)

@@ -28,6 +28,7 @@ from __future__ import annotations
 import json
 import logging
 import math
+import os
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 from urllib.parse import parse_qs, unquote, urlsplit
@@ -102,7 +103,10 @@ def make_handler(service: Service, max_body: int = MAX_BODY_BYTES):
                     self._adopt(path[len("/adopt/"):].strip("/"),
                                 query)
                 elif path.startswith("/release/"):
-                    self._release(path[len("/release/"):].strip("/"))
+                    self._release(path[len("/release/"):].strip("/"),
+                                  query)
+                elif path in ("/fence", "/fence/"):
+                    self._fence(query)
                 elif path in ("/drain", "/drain/"):
                     self._json(200, service.drain())
                 else:
@@ -126,20 +130,56 @@ def make_handler(service: Service, max_body: int = MAX_BODY_BYTES):
                 return None
             return self.rfile.read(length)
 
+        def _epoch_of(self, query: dict):
+            """Parse the optional fencing epoch; returns (ok, epoch)
+            — a non-integer epoch is a 400, not a silent unfenced
+            call (the fence would never learn the caller's
+            generation)."""
+            raw = (query.get("epoch") or [None])[0]
+            if raw is None:
+                return True, None
+            try:
+                return True, int(raw)
+            except ValueError:
+                self._json(400, {"error": "bad_epoch",
+                                 "detail": f"epoch {raw!r} is not an "
+                                           "integer"})
+                return False, None
+
+        def _fence(self, query: dict) -> None:
+            ok, epoch = self._epoch_of(query)
+            if not ok:
+                return
+            if epoch is None:
+                self._json(400, {"error": "bad_epoch",
+                                 "detail": "POST /fence?epoch=N"})
+                return
+            try:
+                self._json(200, service.fence(epoch))
+            except ServiceError as e:
+                self._json(e.http_status,
+                           {"error": e.code, "detail": str(e)})
+
         def _adopt(self, tenant: str, query: dict) -> None:
             # The migration seam: body = the tenant's journal (the
             # router's handover), ?cause= the typed migration reason
-            # (backend_lost). Typed refusals map like /submit's; a
-            # journal written for another model family is the 409 the
-            # PR-10 replay already types. The cap is the ADOPT cap —
-            # journals have no chunked resume protocol, and the
-            # submit-sized bound would orphan big tenants forever.
+            # (backend_lost), ?epoch= the caller's placement epoch
+            # (a stale ex-router is refused 409 `stale_epoch`). Typed
+            # refusals map like /submit's; a journal written for
+            # another model family is the 409 the PR-10 replay already
+            # types. The cap is the ADOPT cap — journals have no
+            # chunked resume protocol, and the submit-sized bound
+            # would orphan big tenants forever.
+            ok, epoch = self._epoch_of(query)
+            if not ok:
+                return
             body = self._read_body(tenant, limit=MAX_ADOPT_BODY_BYTES)
             if body is None:
                 return
             cause = (query.get("cause") or [None])[0]
             try:
-                doc = service.adopt(tenant, body, cause=cause)
+                doc = service.adopt(tenant, body, cause=cause,
+                                    epoch=epoch)
             except ServiceError as e:
                 self._json(e.http_status,
                            {"error": e.code, "tenant": tenant,
@@ -162,9 +202,12 @@ def make_handler(service: Service, max_body: int = MAX_BODY_BYTES):
                 return
             self._json(200, doc)
 
-        def _release(self, tenant: str) -> None:
+        def _release(self, tenant: str, query: dict) -> None:
+            ok, epoch = self._epoch_of(query)
+            if not ok:
+                return
             try:
-                doc = service.release(tenant)
+                doc = service.release(tenant, epoch=epoch)
             except ServiceError as e:
                 self._json(e.http_status,
                            {"error": e.code, "tenant": tenant,
@@ -229,9 +272,20 @@ def server(service: Service, port: int = 0) -> ThreadingHTTPServer:
     return ThreadingHTTPServer(("", port), make_handler(service))
 
 
-def serve(service: Service, port: int = 8089) -> None:
-    """Serve forever (the ``jepsen_tpu.service`` CLI's daemon mode)."""
+def serve(service: Service, port: int = 8089,
+          port_file: Optional[str] = None) -> None:
+    """Serve forever (the ``jepsen_tpu.service`` CLI's daemon mode).
+    ``port_file`` is the spawned-backend readiness protocol: the
+    BOUND port (``--port 0`` = ephemeral) is written atomically after
+    bind, so a supervisor never has to probe-then-bind a port it
+    could lose to another process (the TOCTOU the old
+    ``_free_port`` dance had)."""
     srv = server(service, port)
+    if port_file:
+        tmp = f"{port_file}.{os.getpid()}.tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            f.write(str(srv.server_address[1]))
+        os.replace(tmp, port_file)
     LOG.info("Service %s ingesting on http://0.0.0.0:%d",
              service.name, srv.server_address[1])
     print(f"Service {service.name} ingesting on "

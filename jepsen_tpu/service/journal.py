@@ -127,6 +127,58 @@ def scan(journal_dir: str) -> dict[str, str]:
     return out
 
 
+class ConsistentLines:
+    """The ONE torn-final-line reader every append-only jsonl replay
+    in the service shares (the tenant journal here, the router's
+    ``router_state.jsonl`` in service/supervisor.py — a rule patched
+    in one copy must not silently leave the other wrong). Iterates
+    the parseable JSON-dict records of the file's consistent prefix;
+    after iteration ``.torn`` says whether a torn tail was dropped
+    and ``.consistent_bytes`` is the exact byte length of that prefix
+    (the reopening writer's truncation offset).
+
+    Torn = the kill-9 signature: a final line missing its newline
+    (even when its bytes happen to parse — appending after it would
+    garble the next record, and the garbled line would make the NEXT
+    replay silently drop every later record), an undecodable or
+    unparseable line, or a non-dict record. Replay stops there; an
+    append-only writer cannot have put reachable records after it."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self.torn = False
+        self.consistent_bytes = 0
+
+    def __iter__(self):
+        with open(self.path, "rb") as f:
+            for raw in f:
+                if not raw.endswith(b"\n"):
+                    self.torn = True
+                    LOG.warning("%s: final line lacks its newline; "
+                                "dropping the torn tail", self.path)
+                    return
+                try:
+                    line = raw.decode("utf-8").strip()
+                except UnicodeDecodeError:
+                    self.torn = True
+                    return
+                if not line:
+                    self.consistent_bytes += len(raw)
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    self.torn = True
+                    LOG.warning("%s: torn line; replaying the "
+                                "consistent prefix", self.path)
+                    return
+                if not isinstance(rec, dict):
+                    self.torn = True
+                    return
+                self.consistent_bytes += len(raw)
+                yield rec
+
+
 class TenantJournal:
     """The append side: one open file, one record per decided segment.
     ``append_segment`` is called from the scheduler worker under the
@@ -380,81 +432,62 @@ def replay(path: str, model: Model) -> dict:
     # most the in-flight cuts at the crash): the restore keeps the
     # fold COUNTERS exact for the committed prefix but only the first
     # MAX_REPLAY_ROWS display rows (mirroring the scheduler's own
-    # bounded segment table). Binary read so consistent_bytes is an
-    # exact truncation offset.
-    with open(path, "rb") as f:
-        for raw in f:
-            try:
-                line = raw.decode("utf-8").strip()
-            except UnicodeDecodeError:
-                torn = True
-                break
-            if not line:
-                consistent_bytes += len(raw)
-                continue
-            try:
-                rec = json.loads(line)
-            except ValueError:
-                # Torn write: keep the consistent prefix. Anything
-                # AFTER a torn line is unreachable by an append-only
-                # writer (the reopen truncates to consistent_bytes),
-                # so stopping here never drops a good record.
-                torn = True
-                LOG.warning("journal %s: torn line after %d records; "
-                            "replaying the prefix", path, n_records)
-                break
-            if not isinstance(rec, dict):
-                torn = True
-                break
-            consistent_bytes += len(raw)
-            if header is None:
-                if rec.get("kind") != "header":
-                    # A parseable first record that is NOT a header
-                    # means this is some other file (e.g.
-                    # --journal-dir pointed at a directory holding
-                    # ledger.jsonl): a misconfiguration the operator
-                    # must see, not silently replay.
-                    raise JournalError(
-                        f"journal {path}: missing header record")
-                if rec.get("v") != FORMAT_VERSION:
-                    raise JournalError(
-                        f"journal {path}: unsupported format version "
-                        f"{rec.get('v')!r}")
-                if rec.get("model") != want:
-                    raise JournalModelMismatchError(
-                        f"journal {path} was written for model "
-                        f"{(rec.get('model') or {}).get('name')!r} "
-                        f"{rec.get('model')!r}; this service folds "
-                        f"{want!r} — refusing to seed carried states "
-                        "across model families")
-                header = rec
-                continue
-            n_records += 1
-            if rec.get("kind") != "segment":
-                continue
-            if rec.get("after_append_failure"):
-                degraded = True
-            pending.append(rec)
-            new_wm = int(rec.get("watermark", -1))
-            if new_wm > watermark:
-                watermark = new_wm
-                still = []
-                cover: dict = {}  # (seq, key) -> newest covered record
-                for p in pending:  # file order preserved
-                    if int(p.get("end_index", -1)) <= watermark:
-                        # Last-wins per (seq, key): after a crash, a
-                        # resubmission re-decides an UNCOVERED cut
-                        # under the same seq, and the next restart
-                        # sees both the stale record and the fresh
-                        # one — only the newest may fold (the stale
-                        # one would double-count and, folded last,
-                        # resurrect a stale carry).
-                        cover[(p.get("seq"), p.get("key"))] = p
-                    else:
-                        still.append(p)
-                pending = still
-                for p in cover.values():
-                    _fold(p)
+    # bounded segment table). The shared torn-final-line reader
+    # (ConsistentLines) decides what counts as the consistent prefix
+    # — a dropped torn record's ops sit above the reported watermark,
+    # so the resume protocol re-checks them: one-sided, never a flip.
+    lines = ConsistentLines(path)
+    for rec in lines:
+        if header is None:
+            if rec.get("kind") != "header":
+                # A parseable first record that is NOT a header
+                # means this is some other file (e.g.
+                # --journal-dir pointed at a directory holding
+                # ledger.jsonl): a misconfiguration the operator
+                # must see, not silently replay.
+                raise JournalError(
+                    f"journal {path}: missing header record")
+            if rec.get("v") != FORMAT_VERSION:
+                raise JournalError(
+                    f"journal {path}: unsupported format version "
+                    f"{rec.get('v')!r}")
+            if rec.get("model") != want:
+                raise JournalModelMismatchError(
+                    f"journal {path} was written for model "
+                    f"{(rec.get('model') or {}).get('name')!r} "
+                    f"{rec.get('model')!r}; this service folds "
+                    f"{want!r} — refusing to seed carried states "
+                    "across model families")
+            header = rec
+            continue
+        n_records += 1
+        if rec.get("kind") != "segment":
+            continue
+        if rec.get("after_append_failure"):
+            degraded = True
+        pending.append(rec)
+        new_wm = int(rec.get("watermark", -1))
+        if new_wm > watermark:
+            watermark = new_wm
+            still = []
+            cover: dict = {}  # (seq, key) -> newest covered record
+            for p in pending:  # file order preserved
+                if int(p.get("end_index", -1)) <= watermark:
+                    # Last-wins per (seq, key): after a crash, a
+                    # resubmission re-decides an UNCOVERED cut
+                    # under the same seq, and the next restart
+                    # sees both the stale record and the fresh
+                    # one — only the newest may fold (the stale
+                    # one would double-count and, folded last,
+                    # resurrect a stale carry).
+                    cover[(p.get("seq"), p.get("key"))] = p
+                else:
+                    still.append(p)
+            pending = still
+            for p in cover.values():
+                _fold(p)
+    torn = lines.torn
+    consistent_bytes = lines.consistent_bytes
     if header is None:
         # Empty file, or the HEADER line itself was torn (the process
         # died inside the very first write — an append-only writer

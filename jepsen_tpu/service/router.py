@@ -46,21 +46,42 @@ The pieces:
   ORPHANED: its router-level row folds ``unknown`` with the typed
   ``backend_lost`` / ``migration_interrupted`` causes
   (checker/provenance.py) — degraded one-sidedly, never flipped.
+- **Self-healing** (``service/supervisor.py``) — a dead spawned
+  backend is RESPAWNED (bounded exponential backoff, flap-damping
+  circuit, ``JEPSEN_NO_RESPAWN=1`` kill-switch) against the same
+  ``--journal-dir``; once the replacement passes ``/healthz`` the
+  router re-adopts tenants toward it (:func:`plan_readopt` over the
+  live ``/migrate`` machinery) so capacity returns to N.
+- **Crash-safe router state** — with ``state_path`` the placement
+  map, orphan records and a monotone placement *epoch* persist to an
+  append-only ``router_state.jsonl``; a restarted router replays it
+  and reconciles against live ``/healthz`` + journal-dir reality (a
+  record is a hint, reality wins), and the epoch rides every
+  ``/release``/``/adopt`` so a stale ex-router's in-flight migration
+  is refused with a typed 409 ``stale_epoch``.
+- **Rolling restart** — ``POST /roll`` (CLI ``--roll``) drains,
+  respawns and re-adopts one backend at a time through the live
+  ``/release`` path: zero-unknown-verdict upgrades.
 - **Chaos seams** — ``router.probe`` (an injected raise counts as a
-  failed health probe: the false-positive path) and
-  ``backend.process`` (the router SIGKILLs one of its own spawned
-  backend children: a real kill-9 of a real process).
+  failed health probe: the false-positive path), ``backend.process``
+  (the router SIGKILLs one of its own spawned backend children: a
+  real kill-9 of a real process) and ``router.crash`` (the router
+  itself dies mid-migration — after the checkpoint, before the
+  adopt; the restarted router must recover or orphan, never fork).
 
 ``JEPSEN_NO_MIGRATION=1`` is the operational kill-switch: no
 migrations, no rebalancing — dead backends simply orphan their
 tenants (checked per attempt, like every other kill-switch).
+``JEPSEN_NO_RESPAWN=1`` does the same for the respawn half.
 
 Telemetry: ``router_placements_total{backend}``,
 ``router_migrations_total{reason}``,
 ``router_failed_probes_total{backend}``, ``router_orphaned_tenants``,
-``router_migration_seconds``. The router registers on the web
-``/live`` feed and aggregates ``/tenants`` across backends. See
-docs/service.md "Scale-out & migration".
+``router_migration_seconds``, ``router_respawns_total{backend,
+outcome}``, ``router_respawn_seconds``, ``router_epoch``. The router
+registers on the web ``/live`` feed and aggregates ``/tenants``
+across backends. See docs/service.md "Scale-out & migration" and
+"Supervision & rolling restart".
 """
 
 from __future__ import annotations
@@ -68,13 +89,12 @@ from __future__ import annotations
 import json
 import logging
 import os
-import socket
 import subprocess
 import sys
 import threading
 import time as _time
 from dataclasses import dataclass, replace
-from typing import Any, Optional
+from typing import Any, Callable, Optional
 from urllib import error as _uerror
 from urllib import request as _urequest
 from urllib.parse import parse_qs, quote, unquote, urlsplit
@@ -83,6 +103,7 @@ from ..checker import provenance as _prov
 from ..parallel import resilience as _resilience
 from ..testing import chaos as _chaos
 from . import journal as _journal
+from . import supervisor as _supervisor
 
 LOG = logging.getLogger("jepsen.router")
 
@@ -125,6 +146,19 @@ class RouterConfig:
     # base unit; ~100 ops of journal lag weigh like one segment).
     lag_weight: float = 0.01
     register_live: bool = True
+    # Self-healing: respawn a dead spawned backend (bounded backoff +
+    # flap damping — see service/supervisor.py; JEPSEN_NO_RESPAWN=1
+    # overrides) and re-adopt tenants toward the replacement.
+    respawn: bool = True
+    respawn_base_backoff_s: float = 0.25
+    respawn_max_backoff_s: float = 15.0
+    respawn_window_s: float = 60.0
+    respawn_max_failures: int = 5
+    # Crash-safe router state: when set, placement/orphans/epoch
+    # persist to this append-only jsonl and a restarted router replays
+    # + reconciles it (docs/service.md "Supervision & rolling
+    # restart").
+    state_path: Optional[str] = None
 
 
 class Backend:
@@ -134,11 +168,18 @@ class Backend:
                  journal_dir: Optional[str] = None,
                  proc: Optional[subprocess.Popen] = None,
                  metrics=None, failure_threshold: int = 3,
-                 cooldown_s: float = 30.0) -> None:
+                 cooldown_s: float = 30.0,
+                 respawner: Optional[Callable] = None) -> None:
         self.name = name
         self.url = url.rstrip("/")
         self.journal_dir = journal_dir
         self.proc = proc
+        # The (re)spawn recipe: callable(backend) replaces proc/url
+        # with a fresh healthy incarnation on the SAME journal dir
+        # (service/supervisor.py). None = not respawnable (attached
+        # --backend-urls backends).
+        self.respawner = respawner
+        self.supervisor: Optional[_supervisor.BackendSupervisor] = None
         # One breaker per backend: the consecutive-failure /
         # cooldown / half-open-probe protocol is exactly the device
         # path's (parallel/resilience.py) with "device" = "backend".
@@ -147,6 +188,11 @@ class Backend:
             cooldown_s=cooldown_s, metrics=metrics)
         self.health: Optional[dict] = None  # last good /healthz doc
         self.down = False  # declared lost; tenants migrated away
+        # Mid-rolling-restart: excluded from NEW placement (a tenant
+        # placed after the drain snapshot would be killed un-drained)
+        # but still LIVE to everything else — probes keep running and
+        # _checkpoint must not steal journals from under it.
+        self.rolling = False
 
     def snapshot(self) -> dict:
         out = {
@@ -161,6 +207,15 @@ class Backend:
             out["tenant_count"] = self.health.get("tenant_count")
             out["scheduler_backlog"] = self.health.get(
                 "scheduler_backlog")
+        if self.supervisor is not None:
+            sup = self.supervisor.snapshot()
+            out["respawns"] = sup["respawns"]
+            if sup["gave_up"]:
+                # The typed supervision health state: the flap circuit
+                # tripped and this backend stays down until an
+                # operator intervenes (advisor rule respawn_backend).
+                out["state"] = "respawn_gave_up"
+                out["respawn_gave_up"] = True
         return out
 
 
@@ -224,6 +279,35 @@ def plan_rebalance(health_by_backend: dict, placement: dict, *,
     return tenant, src, dst
 
 
+def plan_readopt(placement: dict, target: str,
+                 live: set) -> Optional[tuple[str, str]]:
+    """Pick at most ONE (tenant, src) move toward ``target`` — a
+    just-respawned (or just-rolled), empty backend. Count-based, not
+    load-based: the respawned backend has no health doc yet and the
+    survivors may be idle, so `plan_rebalance`'s overload thresholds
+    would never fire; capacity, not load, is what the re-adoption
+    restores. Fires while the most-loaded OTHER live backend holds at
+    least two more tenants than ``target`` (so every move strictly
+    shrinks the imbalance and the loop terminates); deterministic
+    tie-breaks, pure — pinned closed-form in tests/test_router.py."""
+    if target not in live:
+        return None
+    counts = {n: 0 for n in live}
+    for t, n in placement.items():
+        if n in counts:
+            counts[n] += 1
+    others = sorted(n for n in live if n != target)
+    if not others:
+        return None
+    src = max(others, key=lambda n: (counts[n], n))
+    if counts[src] - counts.get(target, 0) < 2:
+        return None
+    cands = sorted(t for t, n in placement.items() if n == src)
+    if not cands:
+        return None
+    return cands[0], src
+
+
 # ---------------------------------------------------------------------------
 
 
@@ -267,6 +351,49 @@ class Router:
         self._draining = False
         self._finished: Optional[dict] = None
         self._stop = threading.Event()
+        self._roll_lock = threading.Lock()
+        # Supervision: one respawn supervisor per respawnable backend.
+        self._supervisors: dict[str, _supervisor.BackendSupervisor] = {}
+        if cfg.respawn:
+            policy = _supervisor.RespawnPolicy(
+                base_backoff_s=cfg.respawn_base_backoff_s,
+                max_backoff_s=cfg.respawn_max_backoff_s,
+                window_s=cfg.respawn_window_s,
+                max_failures_in_window=cfg.respawn_max_failures)
+            for b in backends:
+                if b.respawner is not None:
+                    sup = _supervisor.BackendSupervisor(
+                        b, b.respawner, policy, metrics=metrics,
+                        on_ready=self._on_backend_respawned)
+                    b.supervisor = sup
+                    self._supervisors[b.name] = sup
+        # Crash-safe router state: replay the journal (placement /
+        # orphans / epoch are HINTS), bump the epoch past everything
+        # replayed (this router generation supersedes any prior one),
+        # then reconcile the hints against live reality BEFORE the
+        # health loop starts.
+        self._epoch = 1
+        self._state: Optional[_supervisor.RouterState] = None
+        state_rep: Optional[dict] = None
+        if cfg.state_path:
+            state_rep = _supervisor.replay_state(cfg.state_path)
+            self._epoch = state_rep["epoch"] + 1
+            self._placement = dict(state_rep["placement"])
+            self._orphans = {t: dict(o)
+                             for t, o in state_rep["orphans"].items()}
+            self._state = _supervisor.RouterState(
+                cfg.state_path, epoch=self._epoch,
+                truncate_to=(state_rep["consistent_bytes"]
+                             if state_rep["torn_tail"] else None))
+        if metrics is not None:
+            metrics.gauge(
+                "router_epoch",
+                "This router generation's placement epoch (every "
+                "/release and /adopt carries it; stale epochs are "
+                "fenced with a typed 409)").set(self._epoch)
+        if state_rep is not None and (state_rep["records"]
+                                      or state_rep["torn_tail"]):
+            self._reconcile()
         self._thread = threading.Thread(
             target=self._health_loop, name="jepsen-router-health",
             daemon=True)
@@ -353,7 +480,8 @@ class Router:
                 b = self._backends.get(name)
                 if b is not None:
                     return b
-            cands = [b for b in self._backends.values() if not b.down]
+            cands = [b for b in self._backends.values()
+                     if not b.down and not b.rolling]
             if not cands:
                 raise NoBackendError("no live backend to place on")
             # Prefer backends whose probe circuit is quiet: a breaker
@@ -369,8 +497,14 @@ class Router:
                     key=lambda bb: (counts.get(bb.name, 0), bb.name))
             self._placement[tenant] = b.name
         self._count_placement(b.name)
+        self._state_append({"kind": "place", "tenant": tenant,
+                            "backend": b.name})
         LOG.info("placed tenant %s on backend %s", tenant, b.name)
         return b
+
+    def _state_append(self, rec: dict) -> None:
+        if self._state is not None:
+            self._state.append(rec)
 
     def placement(self) -> dict[str, str]:
         with self._lock:
@@ -510,12 +644,269 @@ class Router:
         b.breaker.record_failure()
         LOG.warning("backend %s declared LOST (%s); migrating its "
                     "tenants", b.name, why)
+        self._state_append({"kind": "lost", "backend": b.name,
+                            "why": why})
+        sup = self._supervisors.get(b.name)
+        if sup is not None:
+            sup.note_exit()  # count the death in the flap window
+        self._migrate_lost_tenants(b)
+        if sup is not None:
+            # Start the respawn worker only AFTER the migrations
+            # stole/renamed every recoverable journal: a replacement
+            # child booting mid-steal would replay a journal the
+            # router is about to hand to another backend — the same
+            # tenant live on two backends (the fork this module
+            # exists to prevent). Journals that could NOT be migrated
+            # (orphans) deliberately stay in place for the child's
+            # replay + the rescue path.
+            sup.kick()
+
+    def _migrate_lost_tenants(self, b: Backend) -> None:
         with self._lock:
             tenants = sorted(t for t, n in self._placement.items()
                              if n == b.name)
             self._migrating.update(tenants)
         for t in tenants:
-            self._migrate(t, b, reason="backend_lost")
+            try:
+                self._migrate(t, b, reason="backend_lost")
+            except Exception:  # noqa: BLE001 - incl. chaos raise
+                # A migration that RAISES (the router.crash seam's
+                # raise mode, an unexpected bug) must not abort the
+                # loop: the remaining tenants would sit in _migrating
+                # forever (terminal 503s, rebalancing wedged
+                # router-wide) with no typed record anywhere. The
+                # raising tenant gets an honest typed orphan — a
+                # later successful migration / respawn rescue clears
+                # it.
+                LOG.warning("migration of tenant %s raised mid-"
+                            "flight; orphaning", t, exc_info=True)
+                self._orphan(t, b,
+                             ["backend_lost", "migration_interrupted"],
+                             note="migration raised mid-flight")
+                with self._lock:
+                    self._migrating.discard(t)
+
+    # -- self-healing (service/supervisor.py drives these) -------------------
+
+    def _fence_backend(self, b: Backend) -> bool:
+        """Apply this generation's epoch fence to one backend (a few
+        attempts). A refusal is meaningful: a NEWER router generation
+        has fenced it higher, and this router must not bring it into
+        its own fleet."""
+        for _ in range(3):
+            status, _doc = self._request(
+                b, f"/fence?epoch={self._epoch}", data=b"")
+            if status == 200:
+                return True
+            _time.sleep(0.1)
+        return False
+
+    def _bring_up(self, b: Backend, why: str) -> bool:
+        """The ONE bring-up sequence respawn and roll share: fence the
+        fresh child at this generation's epoch (its in-memory fence
+        starts empty — serving unfenced would admit a stale
+        ex-router's in-flight /adopt), then mark it live and record
+        it. False = NOT brought up (fence refused/unreachable, or the
+        router is draining): the backend stays down."""
+        if not self._fence_backend(b):
+            LOG.error("backend %s passed /healthz but the epoch "
+                      "fence could not be applied; keeping it DOWN",
+                      b.name)
+            return False
+        with self._lock:
+            if self._draining:
+                return False
+            b.down = False
+            b.health = None
+        b.breaker.record_success()
+        self._state_append({"kind": "respawned", "backend": b.name,
+                            "url": b.url, "why": why})
+        return True
+
+    def _on_backend_respawned(self, b: Backend) -> bool:
+        """The supervisor's on_ready hook: the replacement child
+        passed /healthz — fence + mark the backend live, rescue any
+        orphans its journal replay restored, and re-adopt tenants
+        toward it so capacity returns to N. Returning False tells the
+        supervisor the bring-up failed (counted as a failed attempt,
+        backed off and retried under the flap circuit)."""
+        if not self._bring_up(b, "respawn"):
+            with self._lock:
+                draining = self._draining
+            return draining  # draining: nothing left to retry
+        LOG.info("backend %s is back (%s); re-adopting tenants",
+                 b.name, b.url)
+        self._rescue_orphans(b)
+        self._readopt(b)
+        return True
+
+    def _rescue_orphans(self, b: Backend) -> None:
+        """Orphans of this backend whose journals were never migrated
+        away are restored by the respawned child's own PR-10 replay —
+        they are LIVE there again. Flip placement back and clear the
+        orphan record (this IS the 'later migration that succeeds',
+        executed by the restart instead of a move)."""
+        with self._lock:
+            mine = sorted(t for t, o in self._orphans.items()
+                          if o.get("from") == b.name)
+        if not mine:
+            return
+        status, doc = self._request(
+            b, "/tenants", timeout=max(self.config.probe_timeout_s,
+                                       2.0))
+        if status != 200:
+            return
+        rows = doc.get("tenants") or {}
+        for t in mine:
+            if t not in rows:
+                continue
+            with self._lock:
+                self._placement[t] = b.name
+                if self._orphans.pop(t, None) is not None:
+                    self._set_orphans_gauge()
+            self._count_placement(b.name)
+            self._state_append({"kind": "place", "tenant": t,
+                                "backend": b.name,
+                                "why": "respawn_rescue"})
+            self._state_append({"kind": "orphan_clear", "tenant": t})
+            LOG.info("orphaned tenant %s restored by the respawn of "
+                     "backend %s", t, b.name)
+
+    def _readopt(self, target: Backend) -> int:
+        """Re-adopt tenants toward a just-respawned (or just-rolled)
+        backend via live migrations until the placement counts are
+        balanced (plan_readopt). Stops at the first refusal — a
+        half-balanced fleet still serves."""
+        if migration_disabled():
+            return 0
+        moved = 0
+        while moved < 256:
+            with self._lock:
+                placement = dict(self._placement)
+                live = {bb.name for bb in self._backends.values()
+                        if not bb.down}
+            plan = plan_readopt(placement, target.name, live)
+            if plan is None:
+                break
+            tenant, _src = plan
+            try:
+                if not self.migrate(tenant, target=target.name,
+                                    reason="readopt"):
+                    break
+            except Exception:  # noqa: BLE001 - re-adoption is
+                # best-effort: a half-balanced fleet still serves.
+                LOG.warning("re-adoption of tenant %s raised",
+                            tenant, exc_info=True)
+                break
+            moved += 1
+        return moved
+
+    def _reconcile(self) -> None:
+        """Router restart: the replayed state is a HINT — probe every
+        backend, fence the live ones at this generation's epoch, and
+        make reality win: a tenant a live backend actually hosts is
+        placed there; a backend dead while the router was down gets
+        the exact watched-death treatment (journal-backed migration or
+        typed orphaning); a tenant placed on a live backend that does
+        NOT host it (an interrupted migration's released stream) is
+        recovered through the ordinary checkpoint-rescue path."""
+        cfg = self.config
+        alive: dict[str, dict] = {}
+        for b in self._backends.values():
+            doc = None
+            # Match the declared liveness policy: a backend only
+            # counts as dead-at-restart after failure_threshold
+            # consecutive probe failures, same as the watched path.
+            for _ in range(max(cfg.failure_threshold, 1)):
+                try:
+                    doc = self._probe(b)
+                    break
+                except Exception:  # noqa: BLE001 - probe failure
+                    self._count_failed_probe(b.name)
+                    _time.sleep(0.05)
+            if doc is None:
+                continue
+            b.health = doc
+            alive[b.name] = doc.get("tenants") or {}
+            # Fence: this router generation supersedes any prior one;
+            # a stale ex-router's in-flight /adopt into this backend
+            # now gets the typed 409. A refusal here means a NEWER
+            # router already owns the fleet — surface it loudly (full
+            # concurrent-router HA is the ROADMAP's named remainder).
+            if not self._fence_backend(b):
+                LOG.error("backend %s refused epoch %d at reconcile "
+                          "— a newer router generation may own this "
+                          "fleet", b.name, self._epoch)
+        # Reality wins, pass 1: tenants a live backend actually hosts.
+        for name, rows in alive.items():
+            for t in rows:
+                with self._lock:
+                    stale = self._placement.get(t) != name
+                    if stale:
+                        self._placement[t] = name
+                    cleared = self._orphans.pop(t, None) is not None
+                    if cleared:
+                        self._set_orphans_gauge()
+                if stale or cleared:
+                    self._count_placement(name)
+                    self._state_append({"kind": "place", "tenant": t,
+                                        "backend": name,
+                                        "why": "reconcile"})
+                    if cleared:
+                        self._state_append({"kind": "orphan_clear",
+                                            "tenant": t})
+        # Pass 2: backends dead while the router was down — exactly as
+        # if the router had watched them die. Mark ALL dead first so a
+        # dead backend can never be picked as a migration target.
+        dead = [b for b in self._backends.values()
+                if b.name not in alive and not b.down]
+        for b in dead:
+            b.down = True
+            b.breaker.record_failure()
+            self._state_append({"kind": "lost", "backend": b.name,
+                                "why": "dead at router restart"})
+            sup = self._supervisors.get(b.name)
+            if sup is not None:
+                sup.note_exit()
+            LOG.warning("backend %s dead at router restart; migrating "
+                        "its tenants", b.name)
+        for b in dead:
+            self._migrate_lost_tenants(b)
+        for b in dead:
+            # Respawn only after the steals (same ordering as
+            # _on_backend_down: a child booting mid-steal would
+            # re-own a journal the router is handing elsewhere).
+            sup = self._supervisors.get(b.name)
+            if sup is not None:
+                sup.kick()
+        # Pass 3: placed on a live backend that does not host it — an
+        # interrupted migration released the stream (the `.migrated`
+        # checkpoint is recoverable) or the tenant was never admitted
+        # (no checkpoint: the placement stays a hint and the next
+        # submit admits it fresh, which is correct — it has no decided
+        # past anywhere).
+        hosted = {t for rows in alive.values() for t in rows}
+        with self._lock:
+            placement = dict(self._placement)
+            orphans = set(self._orphans)
+        for t, n in sorted(placement.items()):
+            if n not in alive or t in hosted or t in orphans:
+                continue
+            src = self._backends.get(n)
+            if src is None:
+                continue
+            with self._lock:
+                if t in self._migrating:
+                    continue
+                self._migrating.add(t)
+            try:
+                self._migrate(t, src, reason="router_restart")
+            except Exception:  # noqa: BLE001 - recovery best-effort
+                LOG.warning("restart recovery of tenant %s raised; "
+                            "it stays placed as a hint", t,
+                            exc_info=True)
+                with self._lock:
+                    self._migrating.discard(t)
 
     # -- migration -----------------------------------------------------------
 
@@ -546,7 +937,8 @@ class Router:
     def _pick_target(self, exclude: Backend) -> Optional[Backend]:
         with self._lock:
             cands = [b for b in self._backends.values()
-                     if not b.down and b.name != exclude.name]
+                     if not b.down and not b.rolling
+                     and b.name != exclude.name]
             if not cands:
                 return None
             counts: dict[str, int] = {}
@@ -563,9 +955,12 @@ class Router:
         journal_dir. Returns (journal_text, adopt_cause)."""
         # Socket timeout strictly ABOVE the backend's own quiesce
         # deadline: a release that takes the full quiesce window must
-        # not be abandoned on the wire just as it completes.
+        # not be abandoned on the wire just as it completes. The
+        # epoch rides along: a stale ex-router's release is fenced
+        # with a typed 409 before it can quiesce anything.
         status, doc = self._request(
-            src, f"/release/{quote(tenant, safe='')}", data=b"",
+            src, f"/release/{quote(tenant, safe='')}"
+                 f"?epoch={self._epoch}", data=b"",
             timeout=self.config.release_timeout_s + 15.0)
         if status == 200 and isinstance(doc.get("journal"), str):
             return doc["journal"], None
@@ -629,7 +1024,7 @@ class Router:
                 return False
             dst = target if target is not None \
                 else self._pick_target(exclude=src)
-            if dst is None or dst.down:
+            if dst is None or dst.down or dst.rolling:
                 entry["error"] = "no_target"
                 if lost:
                     self._orphan(tenant, src, ["backend_lost"],
@@ -644,9 +1039,17 @@ class Router:
                                  note="no journal checkpoint "
                                       "recoverable")
                 return False
-            path = f"/adopt/{quote(tenant, safe='')}"
+            # Chaos seam: the router dying MID-MIGRATION — checkpoint
+            # in hand, adopt not yet issued. `crash` mode is the real
+            # kill-9 (the restarted router's reconcile must recover
+            # the released stream or orphan it, never fork it);
+            # `raise` aborts the migration at the same point
+            # in-process.
+            _chaos.fire("router.crash")
+            path = f"/adopt/{quote(tenant, safe='')}" \
+                   f"?epoch={self._epoch}"
             if cause:
-                path += f"?cause={quote(cause, safe='')}"
+                path += f"&cause={quote(cause, safe='')}"
             status, doc = self._request(dst, path,
                                         data=jtext.encode("utf-8"))
             if status != 200:
@@ -672,9 +1075,19 @@ class Router:
                 # (docs/verdicts.md): this IS the later migration — a
                 # recovered tenant must serve again, not stay bricked
                 # behind the stale orphan record.
-                if self._orphans.pop(tenant, None) is not None:
+                cleared = self._orphans.pop(tenant, None) is not None
+                if cleared:
                     self._set_orphans_gauge()
             self._count_placement(dst.name)
+            # The durable placement flip; "from" is the tombstone of
+            # the previous owner (its `.migrated` file enforces it
+            # backend-side).
+            self._state_append({"kind": "place", "tenant": tenant,
+                                "backend": dst.name,
+                                "from": src.name})
+            if cleared:
+                self._state_append({"kind": "orphan_clear",
+                                    "tenant": tenant})
             entry["ok"] = True
             entry["watermark"] = doc.get("watermark")
             LOG.info("migrated tenant %s %s -> %s (%s, watermark %s)",
@@ -716,6 +1129,11 @@ class Router:
             if note:
                 o["note"] = note
             self._set_orphans_gauge()
+            rec = {"kind": "orphan", "tenant": tenant,
+                   "from": o["from"], "causes": dict(o["causes"])}
+            if note:
+                rec["note"] = note
+        self._state_append(rec)
         _prov.count_metric(self.metrics,
                            [_prov.cause(c) for c in codes],
                            tenant=tenant)
@@ -729,8 +1147,12 @@ class Router:
         with self._lock:
             if self._migrating:
                 return  # one migration at a time keeps causality easy
+            # A mid-roll backend is being EMPTIED — it reads as the
+            # least-loaded and would attract exactly the tenant the
+            # roll is about to kill un-drained.
             health = {n: b.health for n, b in self._backends.items()
-                      if not b.down and b.health is not None}
+                      if not b.down and not b.rolling
+                      and b.health is not None}
             placement = dict(self._placement)
         plan = plan_rebalance(health, placement,
                               min_load=cfg.rebalance_min_load,
@@ -745,6 +1167,121 @@ class Router:
             self.migrate(tenant, target=dst, reason="rebalance")
         except KeyError:
             pass  # placement changed under us; next tick re-plans
+
+    # -- rolling restart -----------------------------------------------------
+
+    def roll(self) -> dict:
+        """Rolling restart (``POST /roll`` / CLI ``--roll``): one
+        backend at a time, drain-migrate its tenants via the live
+        ``/release`` path, restart the process (respawner: fresh
+        child, same journal dir), wait for ``/healthz``, re-adopt a
+        fair share back — the fleet never drops below N-1 and every
+        move is a quiesced journal handover, so an upgrade costs zero
+        unknown verdicts. A backend whose tenants cannot all be moved
+        is NOT restarted (the moved ones stay moved; the fleet still
+        serves)."""
+        with self._lock:
+            if self._draining:
+                return {"router": self.name, "ok": False,
+                        "error": "draining", "backends": []}
+        if not self._roll_lock.acquire(blocking=False):
+            return {"router": self.name, "ok": False,
+                    "error": "roll_in_progress", "backends": []}
+        try:
+            return self._roll_locked()
+        finally:
+            self._roll_lock.release()
+
+    def _roll_locked(self) -> dict:
+        out: dict = {"router": self.name, "ok": True,
+                     "epoch": self._epoch, "backends": []}
+        for b in list(self._backends.values()):
+            entry: dict = {"backend": b.name}
+            out["backends"].append(entry)
+            if b.down:
+                entry["skipped"] = "down"
+                continue
+            if b.respawner is None:
+                entry["skipped"] = "no_respawner"
+                continue
+            t0 = _time.monotonic()
+            # Out of NEW placement from before the drain snapshot: a
+            # tenant placed onto the emptying backend after the
+            # snapshot would be killed un-drained, breaking the
+            # zero-unknown contract. `rolling` (unlike `down`) keeps
+            # the backend fully LIVE for everything else — probes,
+            # its existing tenants' ingestion, and _checkpoint's
+            # never-steal-from-a-live-backend invariant.
+            b.rolling = True
+            try:
+                with self._lock:
+                    tenants = sorted(t for t, n in
+                                     self._placement.items()
+                                     if n == b.name)
+                moved = []
+                fail = None
+                for t in tenants:
+                    try:
+                        if self.migrate(t, reason="roll"):
+                            moved.append(t)
+                        else:
+                            fail = t
+                            break
+                    except Exception:  # noqa: BLE001 - a raising
+                        # drain-migrate = this backend is not safely
+                        # drainable; don't restart it.
+                        fail = t
+                        break
+                entry["drained"] = moved
+                if fail is not None:
+                    # A healthy stream must never be restarted out
+                    # from under itself: skip this backend's restart
+                    # entirely.
+                    entry["error"] = f"drain_migrate_failed:{fail}"
+                    out["ok"] = False
+                    continue
+                # Marked down BEFORE the process dies so the
+                # supervision tick cannot race the exit into a
+                # spurious lost-backend migration + supervisor kick.
+                b.down = True
+                try:
+                    if b.proc is not None and b.proc.poll() is None:
+                        b.proc.terminate()
+                        try:
+                            b.proc.wait(timeout=10)
+                        except Exception:  # noqa: BLE001
+                            b.proc.kill()
+                            b.proc.wait(timeout=5)
+                    b.respawner(b)
+                except Exception as e:  # noqa: BLE001 - spawn failed
+                    entry["error"] = f"respawn_failed: {e}"
+                    out["ok"] = False
+                    # Hand the corpse to the supervisor — its backoff
+                    # / flap circuit decides what happens next.
+                    sup = self._supervisors.get(b.name)
+                    if sup is not None:
+                        sup.note_exit()
+                        sup.kick()
+                    continue
+            finally:
+                b.rolling = False
+            if not self._bring_up(b, "roll"):
+                entry["error"] = "bring_up_failed"
+                out["ok"] = False
+                # The child runs but cannot join the fleet (fence
+                # unreachable/refused): leave it down and let the
+                # supervisor's backoff / flap circuit own it.
+                sup = self._supervisors.get(b.name)
+                if sup is not None:
+                    sup.note_exit()
+                    sup.kick()
+                continue
+            entry["readopted"] = self._readopt(b)
+            entry["seconds"] = round(_time.monotonic() - t0, 4)
+            LOG.info("rolled backend %s in %.2fs (%d drained, %d "
+                     "re-adopted)", b.name, entry["seconds"],
+                     len(moved), entry["readopted"])
+        return out
 
     # -- aggregation ---------------------------------------------------------
 
@@ -790,6 +1327,7 @@ class Router:
         return {
             "router": self.name,
             "t": round(_time.time(), 3),
+            "epoch": self._epoch,
             "tenant_count": len(rows),
             "tenants": rows,
             "backends": backends_doc,
@@ -806,6 +1344,7 @@ class Router:
             "ok": True,
             "router": self.name,
             "draining": self._draining,
+            "epoch": self._epoch,
             "backends": {n: b.snapshot()
                          for n, b in self._backends.items()},
             "orphaned_tenants": n_orphans,
@@ -822,6 +1361,7 @@ class Router:
             "service": True,
             "router": True,
             "t": snap["t"],
+            "epoch": self._epoch,
             "draining": self._draining,
             "tenant_count": len(rows),
             "ops_observed": sum((r or {}).get("ops_observed") or 0
@@ -841,10 +1381,31 @@ class Router:
             migrations = [dict(m) for m in self.migrations]
             orphans = {t: dict(o) for t, o in self._orphans.items()}
             placement = dict(self._placement)
+        sups = {n: s.snapshot() for n, s in self._supervisors.items()}
+        respawn_secs = [s["last_respawn_s"] for s in sups.values()
+                        if s["last_respawn_s"] is not None]
         return {
             "placement": placement,
             "migrations": migrations,
             "orphaned": orphans,
+            "epoch": self._epoch,
+            # The fleet-capacity block the advisor's respawn_backend
+            # rule consumes (bench embeds it): is the fleet below its
+            # configured N, and is the supervision layer still
+            # working on that or has it stopped (disabled / flapped
+            # out)?
+            "fleet": {
+                "configured_backends": len(self._backends),
+                "live_backends": sum(
+                    1 for b in self._backends.values() if not b.down),
+                "respawn_disabled": (not self.config.respawn
+                                     or _supervisor.respawn_disabled()),
+                "respawn_gave_up": sorted(
+                    n for n, s in sups.items() if s["gave_up"]),
+                "respawns": sum(s["respawns"] for s in sups.values()),
+                "respawn_seconds": (max(respawn_secs)
+                                    if respawn_secs else None),
+            },
             # LIVE backends only (like _maybe_rebalance): a lost
             # backend's last-good health doc is stale — feeding it to
             # the advisor would compute skew against (and point advice
@@ -973,6 +1534,10 @@ class Router:
         if run_prov is not None:
             fin["provenance"] = run_prov
         self._finished = fin
+        for sup in self._supervisors.values():
+            sup.close()
+        if self._state is not None:
+            self._state.close()
         self._shutdown_children()
         if self.config.register_live:
             try:
@@ -1002,6 +1567,10 @@ class Router:
         """Stop supervision without draining (test teardown)."""
         self._stop.set()
         self._thread.join(timeout=5)
+        for sup in self._supervisors.values():
+            sup.close()
+        if self._state is not None:
+            self._state.close()
         self._shutdown_children()
         if self.config.register_live:
             try:
@@ -1016,12 +1585,6 @@ class Router:
 # Spawning real backend processes (the router CLI / bench / e2e tests).
 
 
-def _free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
-
-
 def spawn_backends(n: int, *, journal_root: str,
                    model: str = "cas-register", engine: str = "host",
                    max_configs: int = 500_000,
@@ -1031,49 +1594,42 @@ def spawn_backends(n: int, *, journal_root: str,
                    cooldown_s: float = 30.0,
                    wait_ready_s: float = 120.0) -> list[Backend]:
     """Spawn N backend service processes (``python -m
-    jepsen_tpu.service``), each with its own port and
-    ``--journal-dir`` under ``journal_root``, and wait for their
-    ``/healthz``. The returned Backends carry the child handles so the
-    router can detect exits and the ``backend.process`` chaos seam has
-    real processes to kill."""
+    jepsen_tpu.service``), each with its own ``--journal-dir`` under
+    ``journal_root``, and wait for their ``/healthz``. Each child
+    binds **port 0** and reports the bound port through an atomically
+    written ``--port-file`` — the old probe-a-free-port-then-bind
+    dance had a TOCTOU hole (another process could take the probed
+    port between probe and bind), which would crash-loop exactly the
+    respawn path that needs to rebind. The returned Backends carry
+    the child handles (exit detection, the ``backend.process`` chaos
+    seam) and a :class:`~jepsen_tpu.service.supervisor.
+    ProcessRespawner` so the router's supervision layer can respawn
+    them."""
     backends: list[Backend] = []
     try:
         for i in range(n):
-            port = _free_port()
             name = f"{name_prefix}-{i}"
             jdir = os.path.join(journal_root, name)
+            port_file = os.path.join(journal_root, f"{name}.port")
             cmd = [sys.executable, "-m", "jepsen_tpu.service",
-                   "--port", str(port), "--model", model,
-                   "--engine", engine, "--max-configs",
-                   str(max_configs), "--journal-dir", jdir,
-                   "--name", name, *extra_args]
-            proc = subprocess.Popen(cmd, env=env,
-                                    stdout=subprocess.DEVNULL,
-                                    stderr=subprocess.DEVNULL)
-            backends.append(Backend(
-                name, f"http://127.0.0.1:{port}", journal_dir=jdir,
-                proc=proc, metrics=metrics,
-                failure_threshold=failure_threshold,
-                cooldown_s=cooldown_s))
+                   "--port", "0", "--port-file", port_file,
+                   "--model", model, "--engine", engine,
+                   "--max-configs", str(max_configs),
+                   "--journal-dir", jdir, "--name", name,
+                   *extra_args]
+            respawner = _supervisor.ProcessRespawner(
+                cmd, port_file=port_file, env=env,
+                wait_ready_s=wait_ready_s)
+            os.makedirs(journal_root, exist_ok=True)
+            b = Backend(name, "http://127.0.0.1:0", journal_dir=jdir,
+                        metrics=metrics,
+                        failure_threshold=failure_threshold,
+                        cooldown_s=cooldown_s, respawner=respawner)
+            respawner.spawn(b)
+            backends.append(b)
         deadline = _time.monotonic() + wait_ready_s
         for b in backends:
-            while True:
-                try:
-                    with _urequest.urlopen(b.url + "/healthz",
-                                           timeout=2) as r:
-                        if r.status == 200:
-                            break
-                except Exception:  # noqa: BLE001 - not up yet
-                    pass
-                if b.proc.poll() is not None:
-                    raise RuntimeError(
-                        f"backend {b.name} exited rc={b.proc.poll()} "
-                        "before becoming healthy")
-                if _time.monotonic() > deadline:
-                    raise TimeoutError(
-                        f"backend {b.name} not healthy after "
-                        f"{wait_ready_s}s")
-                _time.sleep(0.1)
+            b.respawner.await_ready(b, deadline=deadline)
         return backends
     except BaseException:
         for b in backends:
@@ -1156,6 +1712,9 @@ def make_router_handler(router: Router):
                     ok = router.migrate(tenant, target=target)
                     self._json(200 if ok else 409,
                                {"tenant": tenant, "migrated": ok})
+                elif path in ("/roll", "/roll/"):
+                    doc = router.roll()
+                    self._json(200 if doc.get("ok") else 409, doc)
                 elif path in ("/drain", "/drain/"):
                     self._json(200, router.drain())
                 else:

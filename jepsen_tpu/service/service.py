@@ -181,6 +181,18 @@ class AdoptUnsupportedError(ServiceError):
     code = "no_journal"
 
 
+class StaleEpochError(ServiceError):
+    """The caller's placement epoch is below this backend's fence: a
+    newer router generation has taken ownership of the fleet, and
+    honoring a stale ex-router's in-flight ``/release``/``/adopt``
+    would split tenant ownership (two routers flipping placement
+    independently — the fork the fence exists to prevent)."""
+
+    http_status = 409
+    code = "stale_epoch"
+    retryable = False
+
+
 # ---------------------------------------------------------------------------
 
 
@@ -285,6 +297,11 @@ class Service:
         # explicit adopt (journal in hand) clears the tombstone.
         self._released_tenants: set[str] = set()
         self._tlock = threading.Lock()
+        # Epoch fence (multi-router HA): the highest placement epoch
+        # any /fence, /release or /adopt has presented; calls carrying
+        # a LOWER epoch are refused with the typed 409 StaleEpochError
+        # (guarded by _tlock).
+        self._fence_epoch = -1
         self._draining = False
         self._drain_lock = threading.Lock()
         self._finished: Optional[dict] = None
@@ -586,8 +603,39 @@ class Service:
 
     # -- live migration (the router's adopt/release seams) -------------------
 
+    def _check_epoch(self, epoch: Optional[int]) -> None:
+        """The fencing primitive: a ``/release``/``/adopt`` carrying a
+        placement epoch BELOW the fence is a stale ex-router's
+        in-flight migration — refuse it (typed 409) before it can
+        split ownership; an equal-or-higher epoch ratchets the fence
+        up. Epoch-less calls (direct operator curl, pre-epoch tests)
+        pass: fencing is opt-in per caller, the ratchet only ever
+        rises."""
+        if epoch is None:
+            return
+        if not isinstance(epoch, int):
+            raise ServiceError(f"invalid epoch {epoch!r}")
+        with self._tlock:
+            if epoch < self._fence_epoch:
+                raise StaleEpochError(
+                    f"epoch {epoch} is stale: this backend is fenced "
+                    f"at epoch {self._fence_epoch} (a newer router "
+                    "generation owns the fleet)")
+            self._fence_epoch = epoch
+
+    def fence(self, epoch: int) -> dict:
+        """Raise the epoch fence explicitly (``POST /fence`` — a
+        restarted router fences every live backend at its new epoch
+        during reconciliation, so a stale ex-router is refused even on
+        backends its own migrations never touched)."""
+        if not isinstance(epoch, int):
+            raise ServiceError(f"invalid epoch {epoch!r}")
+        self._check_epoch(epoch)
+        return {"ok": True, "epoch": epoch, "service": self.name}
+
     def adopt(self, tenant: str, journal_text: Any,
-              cause: Optional[str] = None) -> dict:
+              cause: Optional[str] = None,
+              epoch: Optional[int] = None) -> dict:
         """Adopt one migrated tenant: write its journal (handed over
         by the router — the tenant's complete checkpoint) under this
         backend's ``journal_dir`` and replay it behind ADMISSION —
@@ -602,6 +650,7 @@ class Service:
         the NEXT restart's ctor replay cannot trip over it."""
         from . import journal as _journal
 
+        self._check_epoch(epoch)  # fencing outranks every other check
         if not self.config.journal_dir:
             raise AdoptUnsupportedError(
                 "this backend runs without --journal-dir; it cannot "
@@ -712,7 +761,8 @@ class Service:
         }
 
     def release(self, tenant: str,
-                timeout: Optional[float] = 30.0) -> dict:
+                timeout: Optional[float] = 30.0,
+                epoch: Optional[int] = None) -> dict:
         """Live-migration handover of one tenant: stop admitting its
         ops (submits 503 with ``Retry-After`` — the router holds the
         client off while placement flips), QUIESCE it (queue drained,
@@ -727,6 +777,7 @@ class Service:
         never a verdict flipped."""
         from . import journal as _journal
 
+        self._check_epoch(epoch)  # fencing outranks every other check
         with self._tlock:
             if self._draining:
                 raise ServiceClosedError("service is draining")
@@ -841,6 +892,7 @@ class Service:
             "ok": True,
             "service": self.name,
             "draining": draining,
+            "fence_epoch": self._fence_epoch,
             "tenant_count": len(items),
             "scheduler_backlog": self.scheduler.backlog,
             "tenants": tenants,

@@ -55,6 +55,9 @@ LEDGER_METRICS: list[tuple[str, str, str]] = [
     ("p99_decision_latency_s", "p99_decision_latency_s", "lower"),
     ("utilization_pct", "utilization_pct", "higher"),
     ("ops_per_s", "ops_per_s", "higher"),
+    # Self-healing fleet: spawn → /healthz on the replacement child
+    # after the router bench leg's injected kill-9.
+    ("respawn_seconds", "respawn_seconds", "lower"),
     ("ops", "ops", "info"),
 ]
 
@@ -230,7 +233,9 @@ _BENCH_LEGS: list[tuple[str, Optional[str], str, dict]] = [
     ("service_router", "service_router", "host",
      {"value_s": "wall_s", "ops_per_s": "sustained_ops_per_s",
       "p99_decision_latency_s": "p99_decision_latency_s",
-      "ops": "n_ops_total", "verdict": "valid_all"}),
+      "ops": "n_ops_total", "verdict": "valid_all",
+      # Self-healing fleet: the repair half of the kill cycle.
+      "respawn_seconds": "respawn_seconds"}),
     ("batch_replay_100", "batch_replay_100", "device",
      {"value_s": "value_s"}),
     ("batch_replay_large", "batch_replay_large", "device",

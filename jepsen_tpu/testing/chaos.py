@@ -52,6 +52,15 @@ Fault points (the seams, in pipeline order):
   unflushed queues, dead TCP socket) and then observes the death
   through its normal probe/migration machinery. Routers with no
   spawned children cross the seam but have nothing to kill.
+- ``router.crash`` — the router itself, MID-MIGRATION (service/
+  router.py ``_migrate``): fired after the tenant's checkpoint is in
+  hand, before the adopt is issued — the worst instant for the router
+  to die (the source has already forgotten the tenant). ``crash``
+  mode is the real kill-9 of a real router process; a restarted
+  router with ``--state-path`` must reconcile the replayed placement
+  against live reality and RE-MIGRATE or orphan the released stream,
+  never fork it, and the epoch fence refuses the dead router's ghost.
+  ``raise`` mode aborts the same migration in-process.
 
 Modes: ``raise`` (raise ``exc`` on the Nth crossing, ``times`` times),
 ``delay`` (sleep ``delay_s``; models a slow device/disk), ``crash``
@@ -82,9 +91,58 @@ POINTS = (
     "journal.fsync",
     "router.probe",
     "backend.process",
+    "router.crash",
 )
 
 MODES = ("raise", "delay", "crash")
+
+# The one-sided-degradation contract, per seam: an unknown verdict
+# produced under an injected fault at `point` may carry ONLY these
+# why-unknown taxonomy codes (checker/provenance.py) — and the
+# `unattributed` backstop NEVER. The chaos differential matrix
+# (tests/test_chaos.py) and the router matrix (tests/test_router.py)
+# both pin against this map, so a new seam cannot ship without
+# declaring its blast radius here.
+_PIPELINE_UNKNOWN_CAUSES = frozenset({
+    # the PR-10/PR-13 pipeline codes any service-side unknown may
+    # legally carry while a fleet-level fault is in flight
+    "max_configs", "carry_lost", "poisoned_key", "lost_segments",
+    "undelivered_ops", "deadline", "worker_died", "round_failed",
+    "failover_exhausted", "journal_gap",
+})
+_ROUTER_UNKNOWN_CAUSES = (frozenset({"backend_lost",
+                                     "migration_interrupted"})
+                          | _PIPELINE_UNKNOWN_CAUSES)
+EXPECTED_UNKNOWN_CAUSES: dict[str, frozenset] = {
+    # a dead pump is pure backpressure; only the drain edge can
+    # degrade (truncated/unfed queue, late segments at close)
+    "service.pump": frozenset({"lost_segments", "undelivered_ops",
+                               "deadline"}),
+    # a double worker crash is terminal: pending segments fold
+    # worker_died, later segments are refused at the closed
+    # scheduler; the first crash's round may fold round_failed and
+    # carry losses cascade per key
+    "scheduler.worker": frozenset({"worker_died", "round_failed",
+                                   "carry_lost", "lost_segments"}),
+    # an oracle fault fails over to host re-dispatch; only an
+    # exhausted failover (or a round lost with it) degrades
+    "device.dispatch": frozenset({"failover_exhausted",
+                                  "round_failed", "carry_lost"}),
+    # a host-stacking fault surfaces as a failed device call and
+    # rides the same retry/failover path
+    "host.stack": frozenset({"failover_exhausted", "round_failed",
+                             "carry_lost"}),
+    # journal faults cost durability, never a verdict — an unknown
+    # here would be a bug (empty set: no cause is acceptable)
+    "journal.fsync": frozenset(),
+    # fleet-level faults (false-positive probe death, real backend
+    # kill-9, router crash mid-migration, respawn cycles): unknowns
+    # carry the router's typed codes or the pipeline codes the
+    # migration machinery can legitimately surface underneath
+    "router.probe": _ROUTER_UNKNOWN_CAUSES,
+    "backend.process": _ROUTER_UNKNOWN_CAUSES,
+    "router.crash": _ROUTER_UNKNOWN_CAUSES,
+}
 
 
 class ChaosError(RuntimeError):

@@ -729,6 +729,8 @@ async function tick() {
           const p99 = lat.p99_s === undefined ? '-' : lat.p99_s;
           head = '<p>' + (r.draining ? 'DRAINING · ' : '') +
             (r.router ? 'ROUTER · ' : '') +
+            (r.router && r.epoch !== undefined
+              ? 'epoch ' + r.epoch + ' · ' : '') +
             r.tenant_count + ' tenants' +
             ' · ' + r.ops_observed + ' ops observed' +
             ' · backlog ' + r.scheduler_backlog +
@@ -738,10 +740,14 @@ async function tick() {
             head += '<p>backends: ' +
               Object.entries(r.backends).map(([n, b]) => {
                 b = b || {};
+                // respawn_gave_up is the terminal supervision state
+                // (the flap circuit tripped); a respawn count next to
+                // a live backend means the supervisor healed it.
                 const bad = b.down || b.state === 'lost' ||
-                  b.state === 'open';
+                  b.state === 'open' || b.respawn_gave_up;
                 return (bad ? '<span class="stall">' : '') + n +
                   ' [' + (b.state || '?') + ']' +
+                  (b.respawns ? ' ⟳' + b.respawns : '') +
                   (bad ? '</span>' : '');
               }).join(' · ') + '</p>';
           }

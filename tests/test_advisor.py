@@ -55,6 +55,23 @@ class TestInputGathering:
             "backend-0": 520.0, "backend-1": 3.0}
         assert advisor.collect_backend_loads({}) == {}
 
+    def test_collect_fleet_takes_worst_deficit(self):
+        doc = {
+            "service_router": {"fleet": {
+                "configured_backends": 2, "live_backends": 2,
+                "respawn_disabled": False, "respawn_gave_up": []}},
+            "nested": {"fleet": {
+                "configured_backends": 3, "live_backends": 1,
+                "respawn_disabled": False,
+                "respawn_gave_up": ["backend-2"]}},
+        }
+        got = advisor.collect_fleet(doc)
+        # Worst capacity deficit wins: a healthy block must not mask
+        # a degraded one.
+        assert got["configured_backends"] == 3
+        assert got["live_backends"] == 1
+        assert advisor.collect_fleet({}) == {}
+
     def test_collect_skipped_legs(self):
         doc = {"mutex_5k": {"skipped": "device_slow_guard"},
                "elle_txn": {"value_s": 1.0},
@@ -140,6 +157,35 @@ class TestRulesClosedForm:
         # One backend: nowhere to move.
         assert advisor.advise({"service_router": {"backend_loads": {
             "b0": {"load": 9000.0}}}}) == []
+
+    def test_respawn_backend_rule(self):
+        # Below configured N with the flap circuit tripped: fires.
+        gave_up = {"service_router": {"fleet": {
+            "configured_backends": 2, "live_backends": 1,
+            "respawn_disabled": False,
+            "respawn_gave_up": ["backend-0"]}}}
+        recs = advisor.advise(gave_up)
+        assert ids(recs) == ["respawn_backend"]
+        assert recs[0]["severity"] == "high"
+        assert "backend-0" in recs[0]["advice"]
+        # Below N with respawn DISABLED: fires too.
+        disabled = {"service_router": {"fleet": {
+            "configured_backends": 2, "live_backends": 1,
+            "respawn_disabled": True, "respawn_gave_up": []}}}
+        assert ids(advisor.advise(disabled)) == ["respawn_backend"]
+        # Below N but the supervisor is still WORKING on it (not
+        # disabled, nobody gave up): quiet — mirrors the router,
+        # which is mid-heal and needs no operator.
+        healing = {"service_router": {"fleet": {
+            "configured_backends": 2, "live_backends": 1,
+            "respawn_disabled": False, "respawn_gave_up": []}}}
+        assert advisor.advise(healing) == []
+        # At capacity: quiet regardless of history.
+        whole = {"service_router": {"fleet": {
+            "configured_backends": 2, "live_backends": 2,
+            "respawn_disabled": True,
+            "respawn_gave_up": ["backend-0"]}}}
+        assert advisor.advise(whole) == []
 
     def test_device_baseline_and_cadence_rules(self):
         recs = advisor.advise(

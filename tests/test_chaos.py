@@ -295,26 +295,13 @@ class TestChaosDifferential:
     # Provenance pin (ISSUE 13): every injected fault whose outcome is
     # unknown must carry ONLY taxonomy codes from its seam's expected
     # set — never a free-text-only unknown, never the `unattributed`
-    # backstop (see docs/verdicts.md).
-    EXPECTED_UNKNOWN_CAUSES = {
-        # a dead pump is pure backpressure; only the drain edge can
-        # degrade (truncated/unfed queue, late segments at close)
-        "service.pump": {"lost_segments", "undelivered_ops",
-                         "deadline"},
-        # a double worker crash is terminal: pending segments fold
-        # worker_died, later segments are refused at the closed
-        # scheduler; the first crash's round may fold round_failed and
-        # carry losses cascade per key
-        "scheduler.worker": {"worker_died", "round_failed",
-                             "carry_lost", "lost_segments"},
-        # an oracle fault fails over to host re-dispatch; only an
-        # exhausted failover (or a round lost with it) degrades
-        "device.dispatch": {"failover_exhausted", "round_failed",
-                            "carry_lost"},
-        # journal faults cost durability, never a verdict — an unknown
-        # here would be a bug (empty set: no cause is acceptable)
-        "journal.fsync": set(),
-    }
+    # backstop (see docs/verdicts.md). The per-seam map now lives
+    # next to the seams themselves (testing/chaos.py) so the router
+    # matrix (tests/test_router.py) pins the fleet-level seams —
+    # router.probe / backend.process / router.crash — against the
+    # SAME declaration; a new seam cannot ship without declaring its
+    # blast radius there.
+    EXPECTED_UNKNOWN_CAUSES = chaos.EXPECTED_UNKNOWN_CAUSES
 
     @pytest.mark.parametrize("point", FAST_POINTS)
     @pytest.mark.parametrize("mode", ("raise", "delay"))

@@ -228,6 +228,39 @@ class TestEdgeCases:
         assert snap["resumed_from_journal"]["torn_tail"] is True
         svc2.drain(timeout=30)
 
+    def test_parseable_final_line_without_newline_is_torn(
+            self, tmp_path):
+        # A kill-9 can flush a COMPLETE record's bytes without the
+        # trailing newline. Its content parses, but treating it as
+        # consistent would make the reopening writer concatenate the
+        # next record onto it — and the garbled line would silently
+        # drop every later record at the SECOND restart. It must
+        # replay as a torn tail (record dropped: its ops sit above
+        # the reported watermark, so the resume protocol re-checks
+        # them — one-sided).
+        h = list(valid_history(29))
+        svc = mk(tmp_path)
+        for op in h[: len(h) // 2]:
+            svc.submit("t", op)
+        assert svc.flush(30.0)
+        before = svc.tenant_snapshot("t")
+        crash(svc)
+        path = jj.tenant_path(str(tmp_path), "t")
+        with open(path, "a", encoding="utf-8") as f:
+            # Complete JSON, no trailing newline: the boundary case.
+            f.write('{"kind": "segment", "seq": 9999, "key": "k", '
+                    '"valid": true, "end_index": 1, '
+                    '"watermark": 999999}')
+        rep = jj.replay(path, model())
+        assert rep["torn_tail"] is True
+        assert rep["watermark"] == before["watermark"]  # not 999999
+        # Reopen truncates; a fresh append + second restart keeps
+        # every real record.
+        svc2 = mk(tmp_path)
+        snap = svc2.tenant_snapshot("t")
+        assert snap["watermark"] == before["watermark"]
+        svc2.drain(timeout=30)
+
     def test_other_model_family_refused_typed(self, tmp_path):
         svc = mk(tmp_path)
         for op in valid_history(22, n_ops=60):
