@@ -756,6 +756,23 @@ def main() -> int:
                     "readopt_migrations": sum(
                         1 for m in mig_ok
                         if m.get("reason") == "readopt"),
+                    # Federated fleet observability (telemetry/
+                    # fleet.py): the REAL cross-process p99 (bucket-
+                    # merged histograms, not max-of-backend-p99s) and
+                    # the coldest backend's busy share — benchcmp
+                    # tracks both; the fleet block carries the full
+                    # federation/SLO detail for the advisor's
+                    # slo_burn / backend_underutilized / scrape_stale
+                    # rules.
+                    "fleet_p99_decision_latency_s":
+                        r_stats["fleet"].get("p99_decision_latency_s"),
+                    "fleet_min_backend_utilization_pct":
+                        r_stats["fleet"].get(
+                            "min_backend_utilization_pct"),
+                    "fleet_scrapes": {
+                        n: (m or {}).get("scrapes")
+                        for n, m in (r_stats["fleet"].get(
+                            "federation") or {}).items()},
                     "fleet": r_stats["fleet"],
                 }
                 if fin.get("provenance"):

@@ -52,6 +52,15 @@ TAIL_RATIO_THRESHOLD = 20.0
 # thresholds service/router.py's plan_rebalance defaults to.
 REBALANCE_MIN_LOAD = 256.0
 REBALANCE_SKEW_RATIO = 4.0
+# SLO burn-rate alert thresholds (the classic multiwindow pair): a
+# fast-window burn this hot exhausts the error budget in hours; a
+# slow-window burn this hot is a sustained leak. Gauges come from
+# telemetry.fleet.SloMonitor via the router's federated scrape.
+SLO_FAST_BURN_THRESHOLD = 14.0
+SLO_SLOW_BURN_THRESHOLD = 6.0
+# A federated backend busy less than this share of the fleet window is
+# underutilized — capacity the placement/rebalance policy is wasting.
+UNDERUTILIZED_BACKEND_PCT = 40.0
 
 
 # ---------------------------------------------------------------------------
@@ -478,6 +487,109 @@ def rule_respawn_backend(ctx: dict) -> Optional[dict]:
     }
 
 
+def rule_slo_burn(ctx: dict) -> Optional[dict]:
+    """Fleet SLO error budget burning too hot (telemetry.fleet.
+    SloMonitor's multiwindow gauges, embedded by the router bench leg
+    under ``fleet.slo``): the fast window alerts on a spike, the slow
+    window on a sustained leak — either past its threshold is worth an
+    operator's attention NOW, before the budget is gone."""
+    slo = (ctx["fleet"] or {}).get("slo")
+    windows = (slo or {}).get("windows") or {}
+    hot = {}
+    for wname, thresh in (("fast", SLO_FAST_BURN_THRESHOLD),
+                          ("slow", SLO_SLOW_BURN_THRESHOLD)):
+        w = windows.get(wname) or {}
+        for kind in ("availability", "latency"):
+            burn = w.get(f"{kind}_burn_rate")
+            if isinstance(burn, (int, float)) and burn > thresh:
+                hot[f"{wname}_{kind}"] = {"burn_rate": burn,
+                                          "threshold": thresh}
+    if not hot:
+        return None
+    return {
+        "severity": "high",
+        "title": "fleet SLO error budget is burning past its "
+                 "alert thresholds",
+        "advice": "the federated SLO monitor reports burn rates past "
+                  f"the fast ({SLO_FAST_BURN_THRESHOLD:g}x) / slow "
+                  f"({SLO_SLOW_BURN_THRESHOLD:g}x) thresholds: check "
+                  "which backends the rejects/slow decides concentrate "
+                  "on (fleet /metrics per-backend children), then "
+                  "raise the ingestion quota / queue_limit if the "
+                  "availability budget is burning, or grow fleet "
+                  "capacity (backends, max_ready_per_tenant) if the "
+                  "latency budget is",
+        "evidence": {"hot_windows": hot,
+                     "availability_target":
+                         (slo or {}).get("availability_target"),
+                     "latency_target_s":
+                         (slo or {}).get("latency_target_s")},
+    }
+
+
+def rule_backend_underutilized(ctx: dict) -> Optional[dict]:
+    """A live backend busy under UNDERUTILIZED_BACKEND_PCT of the
+    fleet window while some other backend runs hot: paid-for capacity
+    the placement policy is not using. Quiet when every backend is
+    cold (the fleet is simply idle — nothing to rebalance onto)."""
+    util = (ctx["fleet"] or {}).get("utilization") or {}
+    pcts = {n: u.get("utilization_pct") for n, u in util.items()
+            if isinstance(u, dict)
+            and isinstance(u.get("utilization_pct"), (int, float))}
+    if len(pcts) < 2:
+        return None
+    cold = {n: p for n, p in pcts.items()
+            if p < UNDERUTILIZED_BACKEND_PCT}
+    hot_enough = max(pcts.values()) >= UNDERUTILIZED_BACKEND_PCT
+    if not cold or not hot_enough or len(cold) == len(pcts):
+        return None
+    return {
+        "severity": "medium",
+        "title": "backend(s) underutilized while the fleet has work "
+                 f"(busy < {UNDERUTILIZED_BACKEND_PCT:g}%)",
+        "advice": "the fleet Gantt shows "
+                  + ", ".join(f"{n!r} at {p}%"
+                              for n, p in sorted(cold.items()))
+                  + " while the busiest backend runs at "
+                  f"{max(pcts.values())}% — lower "
+                  "`rebalance_min_load`/`rebalance_ratio` so the "
+                  "router spreads tenants sooner, or place fewer "
+                  "tenants per backend; idle capacity costs the same "
+                  "as busy capacity",
+        "evidence": {"utilization_pct": dict(sorted(pcts.items())),
+                     "threshold_pct": UNDERUTILIZED_BACKEND_PCT},
+    }
+
+
+def rule_scrape_stale(ctx: dict) -> Optional[dict]:
+    """Stale federation scrapes: backends whose last /metrics.json
+    snapshot is older than the staleness horizon. Their series are
+    frozen in every fleet total — the fleet p99 / SLO burn rates are
+    blind to whatever those backends are doing NOW."""
+    fleet = ctx["fleet"] or {}
+    stale = list(fleet.get("stale_backends") or [])
+    if not stale:
+        return None
+    fed = fleet.get("federation") or {}
+    ages = {n: (fed.get(n) or {}).get("scrape_age_s") for n in stale}
+    return {
+        "severity": "medium",
+        "title": "fleet metrics federation has stale backends — "
+                 "fleet totals are partially frozen",
+        "advice": "backends "
+                  + ", ".join(repr(n) for n in sorted(stale))
+                  + " have not answered a /metrics.json scrape within "
+                  "the staleness horizon: their last-good series "
+                  "still count in the fleet totals (frozen, never "
+                  "double-counted) but the fleet p99 and SLO burn "
+                  "rates no longer see them — check backend health / "
+                  "respawn state, and treat fleet-level verdict "
+                  "latency as a lower bound until the scrapes resume",
+        "evidence": {"stale_backends": sorted(stale),
+                     "scrape_age_s": ages},
+    }
+
+
 def rule_latency_tail(ctx: dict) -> Optional[dict]:
     tails = [(leg, p50, p99) for leg, p50, p99 in ctx["latency_tails"]
              if p99 / p50 > TAIL_RATIO_THRESHOLD]
@@ -505,9 +617,12 @@ RULES: list[tuple[str, Callable[[dict], Optional[dict]]]] = [
     ("failover_review", rule_failover_review),
     ("journal_durability", rule_journal_durability),
     ("respawn_backend", rule_respawn_backend),
+    ("slo_burn", rule_slo_burn),
     ("grow_batch_f", rule_grow_batch_f),
     ("feed_starved", rule_feed_starved),
     ("rebalance_tenants", rule_rebalance_tenants),
+    ("backend_underutilized", rule_backend_underutilized),
+    ("scrape_stale", rule_scrape_stale),
     ("prewarm_compiles", rule_prewarm_compiles),
     ("trend_regressions", rule_trend_regressions),
     ("latency_tail", rule_latency_tail),

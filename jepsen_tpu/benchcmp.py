@@ -127,6 +127,16 @@ METRICS: list[tuple[str, str, str]] = [
     # to N capacity got slower).
     ("router_respawn_seconds",
      "service_router.respawn_seconds", "lower"),
+    # Fleet observability (federation PR): the REAL cross-process p99
+    # from the router's bucket-merged federated histograms (growing =
+    # the fleet's decision tail got slower — this is the quantile the
+    # SLO monitor burns against, not a max of per-backend p99s), and
+    # the coldest backend's busy share over the bench window
+    # (shrinking = placement is leaving more paid-for capacity idle).
+    ("fleet_p99_decision_latency_s",
+     "service_router.fleet_p99_decision_latency_s", "lower"),
+    ("fleet_min_backend_utilization_pct",
+     "service_router.fleet_min_backend_utilization_pct", "higher"),
 ]
 
 DEFAULT_THRESHOLD = 0.10

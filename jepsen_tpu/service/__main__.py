@@ -63,7 +63,14 @@ def build_service(ns: argparse.Namespace,
         journal_dir=ns.journal_dir,
         journal_fsync=ns.journal_fsync,
     )
-    return Service(model, cfg, metrics=metrics, name=ns.name)
+    # Every daemon carries a span collector: the fleet's cross-process
+    # traces are observed by scraping each backend's GET /trace — a
+    # backend without a collector would be a hole in every trace that
+    # crosses it.
+    from .. import trace as _trace
+
+    return Service(model, cfg, metrics=metrics,
+                   collector=_trace.Collector(), name=ns.name)
 
 
 def simulate(service: Service, n_tenants: int, n_ops: int,

@@ -69,7 +69,9 @@ class ServiceClient:
     def __init__(self, tenant: str, *, chunk_ops: int = 256,
                  max_retries: int = 8, base_backoff_s: float = 0.05,
                  max_backoff_s: float = 2.0,
-                 sleep: Callable[[float], None] = _time.sleep) -> None:
+                 sleep: Callable[[float], None] = _time.sleep,
+                 trace_id: Optional[str] = None,
+                 trace_span: Optional[str] = None) -> None:
         if chunk_ops < 1:
             raise ValueError("chunk_ops must be >= 1")
         self.tenant = tenant
@@ -78,6 +80,13 @@ class ServiceClient:
         self.base_backoff_s = base_backoff_s
         self.max_backoff_s = max_backoff_s
         self._sleep = sleep
+        # Cross-process trace context: when set, every submit carries
+        # the propagation headers (trace.TRACE_HEADER /
+        # trace.PARENT_HEADER), so the router and every backend this
+        # tenant touches — including post-migration — record their
+        # spans under ONE trace id.
+        self.trace_id = trace_id
+        self.trace_span = trace_span
 
     # -- transport seam ------------------------------------------------------
 
@@ -180,7 +189,12 @@ class HttpServiceClient(ServiceClient):
                           for r in rows) + "\n").encode()
         url = (f"{self.base_url}/submit/"
                f"{quote(self.tenant, safe='')}")
-        req = _urequest.Request(url, data=body, method="POST")
+        from .. import trace as _trace
+
+        req = _urequest.Request(
+            url, data=body, method="POST",
+            headers=_trace.trace_headers(self.trace_id,
+                                         self.trace_span))
         try:
             with _urequest.urlopen(req, timeout=self.timeout_s) as resp:
                 doc = json.loads(resp.read().decode() or "{}")
@@ -235,10 +249,12 @@ class InProcessServiceClient(ServiceClient):
     def _post(self, rows: list[dict]) -> dict:
         from .service import ServiceError
 
+        trace = ((self.trace_id, self.trace_span)
+                 if self.trace_id else None)
         accepted = 0
         for row in rows:
             try:
-                self.service.submit(self.tenant, row)
+                self.service.submit(self.tenant, row, trace=trace)
             except ServiceError as e:
                 return {"status": e.http_status, "accepted": accepted,
                         "error": e.code,

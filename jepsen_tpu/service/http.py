@@ -76,7 +76,10 @@ def make_handler(service: Service, max_body: int = MAX_BODY_BYTES):
         def do_GET(self):
             path = unquote(self.path)
             try:
-                if path in ("/", "/tenants", "/tenants/"):
+                if path in ("/", "/tenants", "/tenants/",
+                            "/live", "/live/"):
+                    # /live is the alias the fleet page's per-backend
+                    # links target — same row the web dashboard polls.
                     self._json(200, service.live_snapshot())
                 elif path == "/healthz":
                     # Liveness PLUS the per-tenant overload signals
@@ -84,6 +87,29 @@ def make_handler(service: Service, max_body: int = MAX_BODY_BYTES):
                     # an external LB makes placement decisions from —
                     # no /metrics scrape needed.
                     self._json(200, service.health_snapshot())
+                elif path in ("/metrics", "/metrics/"):
+                    # The LIVE registry as Prometheus text (before,
+                    # prom export only landed in store files at drain —
+                    # nothing was scrape-able mid-run).
+                    self._metrics_text()
+                elif path in ("/metrics.json", "/metrics.json/"):
+                    # The federation scrape the router consumes:
+                    # samples + helps + the event-ring tail (see
+                    # telemetry.fleet.scrape_payload).
+                    self._metrics_json()
+                elif path in ("/trace", "/trace/"):
+                    # The service's span sink (when tracing is on) —
+                    # how a cross-process trace is observed without a
+                    # span-shipping sidecar: the test/operator scrapes
+                    # each backend's spans and joins on trace id.
+                    col = getattr(service, "collector", None)
+                    if col is None:
+                        self._json(404, {"error": "no_collector"})
+                    else:
+                        with col._lock:
+                            spans = list(col.spans)
+                        self._json(200, {"service": service.name,
+                                         "spans": spans})
                 else:
                     self._json(404, {"error": "not_found"})
             except Exception as e:  # noqa: BLE001 - never 500 silently
@@ -115,6 +141,41 @@ def make_handler(service: Service, max_body: int = MAX_BODY_BYTES):
                 LOG.warning("error serving %s", path, exc_info=True)
                 self._json(500, {"error": "internal",
                                  "detail": f"{type(e).__name__}: {e}"})
+
+        def _metrics_text(self) -> None:
+            reg = service.metrics
+            if reg is None:
+                self._json(404, {"error": "no_registry"})
+                return
+            from ..telemetry import export as _export
+
+            body = _export.prometheus_text(reg).encode()
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _metrics_json(self) -> None:
+            reg = service.metrics
+            if reg is None:
+                self._json(404, {"error": "no_registry"})
+                return
+            from ..telemetry import fleet as _fleet
+
+            self._json(200, _fleet.scrape_payload(
+                reg, service=service.name))
+
+        def _trace_ctx(self):
+            """The propagated cross-process trace context of this
+            request, or None (see trace.TRACE_HEADER)."""
+            from .. import trace as _trace
+
+            tid = self.headers.get(_trace.TRACE_HEADER)
+            if not tid:
+                return None
+            return (tid, self.headers.get(_trace.PARENT_HEADER))
 
         def _read_body(self, tenant: str, limit: Optional[int] = None):
             """Bounded body read shared by submit and adopt; None when
@@ -179,7 +240,8 @@ def make_handler(service: Service, max_body: int = MAX_BODY_BYTES):
             cause = (query.get("cause") or [None])[0]
             try:
                 doc = service.adopt(tenant, body, cause=cause,
-                                    epoch=epoch)
+                                    epoch=epoch,
+                                    trace=self._trace_ctx())
             except ServiceError as e:
                 self._json(e.http_status,
                            {"error": e.code, "tenant": tenant,
@@ -222,6 +284,7 @@ def make_handler(service: Service, max_body: int = MAX_BODY_BYTES):
             body = self._read_body(tenant)
             if body is None:
                 return
+            trace = self._trace_ctx()
             accepted = 0
             for line in body.splitlines():
                 line = line.strip()
@@ -236,7 +299,7 @@ def make_handler(service: Service, max_body: int = MAX_BODY_BYTES):
                         "detail": "unparseable ndjson line"})
                     return
                 try:
-                    service.submit(tenant, op)
+                    service.submit(tenant, op, trace=trace)
                 except ServiceError as e:
                     # Typed rejection: the client resumes after
                     # `accepted` lines (quota/backpressure are

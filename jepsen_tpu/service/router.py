@@ -82,6 +82,26 @@ outcome}``, ``router_respawn_seconds``, ``router_epoch``. The router
 registers on the web ``/live`` feed and aggregates ``/tenants``
 across backends. See docs/service.md "Scale-out & migration" and
 "Supervision & rolling restart".
+
+**Fleet observability** (``RouterConfig.federate``, on by default
+when a registry is attached): every supervision tick also scrapes
+each live backend's ``GET /metrics.json`` and feeds
+:class:`~jepsen_tpu.telemetry.fleet.FleetFederation` — the merged
+fleet registry (counters sum, gauges keep per-backend children +
+fleet totals, histograms bucket-merge so the fleet p99 is real) is
+served on the router's own ``GET /metrics`` alongside the router's
+registry, with per-backend scrape staleness
+(``fleet_scrape_age_seconds{backend}`` et al.) so a dead or
+respawning backend reads as STALE, never silently-zero.
+:class:`~jepsen_tpu.telemetry.fleet.SloMonitor` turns the federated
+histograms into availability / decision-latency burn-rate gauges.
+Router operations (placement, migration, respawn, roll, epoch bump)
+are minted as spans on the attached collector, and client trace
+context (``X-Trace-Id``/``X-Parent-Span``) is forwarded through the
+submit proxy and the migration ``/adopt`` — one tenant's life across
+kill-9 + migration + resume is ONE trace. ``GET /fleet`` joins the
+``router_state.jsonl`` timeline with per-backend utilization for the
+web fleet page. See docs/telemetry.md "Fleet federation & SLOs".
 """
 
 from __future__ import annotations
@@ -101,7 +121,9 @@ from urllib.parse import parse_qs, quote, unquote, urlsplit
 
 from ..checker import provenance as _prov
 from ..parallel import resilience as _resilience
+from ..telemetry import fleet as _fleet
 from ..testing import chaos as _chaos
+from .. import trace as _trace
 from . import journal as _journal
 from . import supervisor as _supervisor
 
@@ -159,6 +181,11 @@ class RouterConfig:
     # + reconciles it (docs/service.md "Supervision & rolling
     # restart").
     state_path: Optional[str] = None
+    # Fleet observability: scrape each live backend's /metrics.json on
+    # the probe cadence, merge into one fleet registry and drive the
+    # SLO burn-rate monitor (needs a metrics registry to matter; see
+    # docs/telemetry.md "Fleet federation & SLOs").
+    federate: bool = True
 
 
 class Backend:
@@ -187,6 +214,11 @@ class Backend:
             f"router:{name}", failure_threshold=failure_threshold,
             cooldown_s=cooldown_s, metrics=metrics)
         self.health: Optional[dict] = None  # last good /healthz doc
+        # Wall-clock time `health` was observed at: every aggregation
+        # that re-serves the doc stamps this alongside it, so a
+        # 10-seconds-stale row from a dying backend renders as 10
+        # seconds old instead of masquerading as current.
+        self.health_at: Optional[float] = None
         self.down = False  # declared lost; tenants migrated away
         # Mid-rolling-restart: excluded from NEW placement (a tenant
         # placed after the drain snapshot would be killed un-drained)
@@ -207,6 +239,10 @@ class Backend:
             out["tenant_count"] = self.health.get("tenant_count")
             out["scheduler_backlog"] = self.health.get(
                 "scheduler_backlog")
+            if self.health_at is not None:
+                out["observed_at"] = round(self.health_at, 3)
+                out["health_age_s"] = round(
+                    max(_time.time() - self.health_at, 0.0), 3)
         if self.supervisor is not None:
             sup = self.supervisor.snapshot()
             out["respawns"] = sup["respawns"]
@@ -318,7 +354,7 @@ class Router:
 
     def __init__(self, backends: list[Backend],
                  config: Optional[RouterConfig] = None, *,
-                 metrics=None, name: str = "router",
+                 metrics=None, collector=None, name: str = "router",
                  **overrides) -> None:
         cfg = config or RouterConfig()
         if overrides:
@@ -326,8 +362,13 @@ class Router:
         if not backends:
             raise ValueError("router needs at least one backend")
         self.config = cfg
-        self.metrics = metrics
         self.name = name
+        self.metrics = metrics
+        # Span sink for router operations (place / migrate / respawn /
+        # roll / epoch bump) — each span carries the epoch, and
+        # migration spans join the tenant's client trace id so the
+        # cross-process trace covers the handover.
+        self.collector = collector
         self._backends: dict[str, Backend] = {}
         for b in backends:
             if b.name in self._backends:
@@ -343,6 +384,11 @@ class Router:
         self._lock = threading.RLock()
         self._placement: dict[str, str] = {}  # tenant -> backend name
         self._migrating: set[str] = set()
+        # tenant -> (trace_id, parent_span_id): the last trace context
+        # a submit carried, so router-side spans (placement, the
+        # covering migration span) and the forwarded /adopt join the
+        # client's trace instead of starting disconnected ones.
+        self._tenant_traces: dict[str, tuple] = {}
         # tenant -> {"from": backend, "causes": {code: n}, "note": …}:
         # tenants the router could NOT move — their router-level rows
         # fold unknown with these causes, never a definite verdict.
@@ -391,6 +437,21 @@ class Router:
                 "This router generation's placement epoch (every "
                 "/release and /adopt carries it; stale epochs are "
                 "fenced with a typed 409)").set(self._epoch)
+        # Fleet federation + SLO burn-rate monitor: the supervision
+        # tick scrapes each backend's /metrics.json into `federation`
+        # and feeds the merged view to `slo` (None when federation is
+        # off or there is no registry to export through).
+        self.federation: Optional[_fleet.FleetFederation] = None
+        self.slo: Optional[_fleet.SloMonitor] = None
+        self._slo_doc: Optional[dict] = None
+        if cfg.federate and metrics is not None:
+            self.federation = _fleet.FleetFederation(metrics)
+            self.slo = _fleet.SloMonitor(metrics)
+        if state_rep is not None:
+            # The epoch bump IS a fleet-visible operation: every
+            # /release//adopt from here on carries the new epoch.
+            self._span("router.epoch_bump",
+                       prev_epoch=state_rep["epoch"])
         if state_rep is not None and (state_rep["records"]
                                       or state_rep["torn_tail"]):
             self._reconcile()
@@ -403,9 +464,38 @@ class Router:
                 from .. import web
 
                 web.register_live_source(self.name, self.live_snapshot)
+                web.register_fleet_source(self.name,
+                                          self.fleet_snapshot)
             except Exception:  # noqa: BLE001 - observability only
                 LOG.warning("could not register router live source",
                             exc_info=True)
+
+    # -- tracing -------------------------------------------------------------
+
+    def _span(self, name: str, *, t0_ns: Optional[int] = None,
+              trace: Optional[tuple] = None, **attrs) -> None:
+        """Mint one router-operation span (no-op without a collector).
+        ``trace`` is a (trace_id, parent_span_id) propagation tuple;
+        ``t0_ns`` makes it a covering span instead of a point."""
+        if self.collector is None:
+            return
+        now = _time.monotonic_ns()
+        tid = pid = None
+        if trace:
+            tid = trace[0]
+            pid = trace[1] if len(trace) > 1 else None
+        try:
+            self.collector.record(
+                name, start_ns=t0_ns if t0_ns is not None else now,
+                end_ns=now, trace_id=tid, parent_id=pid,
+                stage="router", router=self.name, epoch=self._epoch,
+                **attrs)
+        except Exception:  # noqa: BLE001 - observability only
+            LOG.debug("router span %s failed", name, exc_info=True)
+
+    def _trace_for(self, tenant: str) -> Optional[tuple]:
+        with self._lock:
+            return self._tenant_traces.get(tenant)
 
     # -- metrics -------------------------------------------------------------
 
@@ -451,11 +541,13 @@ class Router:
 
     def _request(self, b: Backend, path: str,
                  data: Optional[bytes] = None,
-                 timeout: Optional[float] = None) -> tuple[int, dict]:
+                 timeout: Optional[float] = None,
+                 headers: Optional[dict] = None) -> tuple[int, dict]:
         """One backend call; never raises. status 0 = unreachable."""
         req = _urequest.Request(
             b.url + path, data=data,
-            method="POST" if data is not None else "GET")
+            method="POST" if data is not None else "GET",
+            headers=headers or {})
         try:
             with _urequest.urlopen(
                     req, timeout=timeout
@@ -499,6 +591,8 @@ class Router:
         self._count_placement(b.name)
         self._state_append({"kind": "place", "tenant": tenant,
                             "backend": b.name})
+        self._span("router.place", trace=self._trace_for(tenant),
+                   tenant=tenant, backend=b.name)
         LOG.info("placed tenant %s on backend %s", tenant, b.name)
         return b
 
@@ -510,12 +604,20 @@ class Router:
         with self._lock:
             return dict(self._placement)
 
-    def submit(self, tenant: str, body: bytes) -> tuple[int, dict]:
+    def submit(self, tenant: str, body: bytes,
+               trace: Optional[tuple] = None) -> tuple[int, dict]:
         """Proxy one ndjson POST to the tenant's backend. Returns
         (status, response doc); 503s carry ``retry_after_s`` +
         ``retryable`` so the resume-aware client backs off and
-        re-anchors on the journaled watermark."""
+        re-anchors on the journaled watermark. ``trace`` is the
+        client's (trace_id, parent_span_id) propagation context —
+        remembered per tenant (so the covering migration span and the
+        forwarded ``/adopt`` join the same trace) and forwarded on the
+        proxied request."""
         cfg = self.config
+        if trace is not None and trace[0]:
+            with self._lock:
+                self._tenant_traces[tenant] = trace
         with self._lock:
             if self._draining:
                 return 503, {"error": "draining", "tenant": tenant,
@@ -539,8 +641,13 @@ class Router:
             return 503, {"error": "no_backend", "tenant": tenant,
                          "accepted": 0, "retryable": True,
                          "retry_after_s": cfg.migrate_retry_after_s}
+        hdrs = None
+        if trace is not None and trace[0]:
+            hdrs = _trace.trace_headers(
+                trace[0], trace[1] if len(trace) > 1 else None)
         status, doc = self._request(
-            b, f"/submit/{quote(tenant, safe='')}", data=body)
+            b, f"/submit/{quote(tenant, safe='')}", data=body,
+            headers=hdrs)
         if status == 0:
             # Fast-path death detection: the proxy saw the dead socket
             # before the probe loop did. Feed the breaker and let the
@@ -633,9 +740,40 @@ class Router:
                 continue
             b.breaker.record_success()
             b.health = doc
+            b.health_at = _time.time()
+            self._scrape_metrics(b)
+        if self.federation is not None:
+            # A backend that is down (or has never answered a scrape)
+            # must read as STALE in the fleet view — its last-good
+            # snapshot stays in the merge (its counters really did
+            # happen) but the staleness gauges mark the numbers as
+            # frozen, never silently current.
+            expected = [bb.name for bb in self._backends.values()
+                        if not bb.down]
+            self.federation.stale_backends(expected=expected)
+            if self.slo is not None:
+                try:
+                    self._slo_doc = self.slo.observe(
+                        self.federation.merged())
+                except Exception:  # noqa: BLE001 - observability only
+                    LOG.warning("SLO observe failed", exc_info=True)
         if (self.config.rebalance and not self._draining
                 and not migration_disabled()):
             self._maybe_rebalance()
+
+    def _scrape_metrics(self, b: Backend) -> None:
+        """Federation scrape, piggybacked on a SUCCESSFUL probe (same
+        cadence, same failure domain): pull the backend's live
+        registry snapshot and merge it under ``backend=<name>``."""
+        if self.federation is None:
+            return
+        status, doc = self._request(
+            b, "/metrics.json",
+            timeout=max(self.config.probe_timeout_s, 2.0))
+        if status == 200 and isinstance(doc.get("samples"), list):
+            self.federation.record_scrape(b.name, doc)
+        else:
+            self.federation.record_failure(b.name)
 
     def _on_backend_down(self, b: Backend, why: str) -> None:
         if b.down:
@@ -646,6 +784,7 @@ class Router:
                     "tenants", b.name, why)
         self._state_append({"kind": "lost", "backend": b.name,
                             "why": why})
+        self._span("router.backend_lost", backend=b.name, why=why)
         sup = self._supervisors.get(b.name)
         if sup is not None:
             sup.note_exit()  # count the death in the flap window
@@ -718,9 +857,19 @@ class Router:
                 return False
             b.down = False
             b.health = None
+            b.health_at = None
         b.breaker.record_success()
+        if self.federation is not None:
+            # The replacement process starts its counters from its
+            # journal replay, NOT from the dead generation's totals:
+            # dropping the old snapshot here is what makes the fleet
+            # merge generation-safe (no double count across respawns —
+            # the next scrape replaces, never accumulates).
+            self.federation.forget(b.name)
         self._state_append({"kind": "respawned", "backend": b.name,
                             "url": b.url, "why": why})
+        self._span("router.respawn", backend=b.name, why=why,
+                   url=b.url)
         return True
 
     def _on_backend_respawned(self, b: Backend) -> bool:
@@ -827,6 +976,7 @@ class Router:
             if doc is None:
                 continue
             b.health = doc
+            b.health_at = _time.time()
             alive[b.name] = doc.get("tenants") or {}
             # Fence: this router generation supersedes any prior one;
             # a stale ex-router's in-flight /adopt into this backend
@@ -1005,6 +1155,7 @@ class Router:
     def _migrate(self, tenant: str, src: Backend, reason: str,
                  target: Optional[Backend] = None) -> bool:
         t0 = _time.monotonic()
+        t0_ns = _time.monotonic_ns()
         entry: dict = {"tenant": tenant, "from": src.name,
                        "reason": reason, "ok": False}
         # Orphaning is for tenants whose SOURCE is gone (reason
@@ -1050,8 +1201,17 @@ class Router:
                    f"?epoch={self._epoch}"
             if cause:
                 path += f"&cause={quote(cause, safe='')}"
+            # Forward the tenant's trace context on the adopt: the
+            # TARGET backend's service.adopt span then joins the same
+            # trace the client and the source backend recorded under.
+            tctx = self._trace_for(tenant)
+            hdrs = (_trace.trace_headers(tctx[0],
+                                         tctx[1] if len(tctx) > 1
+                                         else None)
+                    if tctx and tctx[0] else None)
             status, doc = self._request(dst, path,
-                                        data=jtext.encode("utf-8"))
+                                        data=jtext.encode("utf-8"),
+                                        headers=hdrs)
             if status != 200:
                 entry["error"] = (f"adopt_{status}_"
                                   f"{doc.get('error') or 'failed'}")
@@ -1104,6 +1264,16 @@ class Router:
                 self._migrating.discard(tenant)
             if entry["ok"]:
                 self._count_migration(reason, seconds)
+            # EXACTLY ONE covering span per migration attempt (the
+            # whole checkpoint → adopt → flip window), joined to the
+            # tenant's client trace; a completed handover is the one
+            # span with ok=True.
+            extra = ({"error": entry["error"]}
+                     if entry.get("error") else {})
+            self._span("router.migrate", t0_ns=t0_ns,
+                       trace=self._trace_for(tenant), tenant=tenant,
+                       src=src.name, dst=entry.get("to"),
+                       reason=reason, ok=entry["ok"], **extra)
 
     def _spill_checkpoint(self, tenant: str, src: Backend,
                           jtext: str) -> None:
@@ -1193,6 +1363,7 @@ class Router:
             self._roll_lock.release()
 
     def _roll_locked(self) -> dict:
+        roll_t0_ns = _time.monotonic_ns()
         out: dict = {"router": self.name, "ok": True,
                      "epoch": self._epoch, "backends": []}
         for b in list(self._backends.values()):
@@ -1281,6 +1452,8 @@ class Router:
             LOG.info("rolled backend %s in %.2fs (%d drained, %d "
                      "re-adopted)", b.name, entry["seconds"],
                      len(moved), entry["readopted"])
+        self._span("router.roll", t0_ns=roll_t0_ns, ok=out["ok"],
+                   backends=len(out["backends"]))
         return out
 
     # -- aggregation ---------------------------------------------------------
@@ -1324,6 +1497,17 @@ class Router:
                 "provenance": _prov.block(causes),
                 "dominant_unknown_cause": _prov.dominant(causes),
             }
+        if self.federation is not None:
+            # Per-backend scrape freshness on every aggregated row:
+            # the /live fleet strip and /fleet page render row AGE
+            # instead of presenting a stale dead-backend row as
+            # current.
+            for n, m in self.federation.meta().items():
+                if n in backends_doc:
+                    backends_doc[n]["scrape_age_s"] = \
+                        m.get("scrape_age_s")
+                    backends_doc[n]["scrapes"] = m.get("scrapes")
+                    backends_doc[n]["scrape_stale"] = m.get("stale")
         return {
             "router": self.name,
             "t": round(_time.time(), 3),
@@ -1405,6 +1589,7 @@ class Router:
                 "respawns": sum(s["respawns"] for s in sups.values()),
                 "respawn_seconds": (max(respawn_secs)
                                     if respawn_secs else None),
+                **self._fleet_stats(),
             },
             # LIVE backends only (like _maybe_rebalance): a lost
             # backend's last-good health doc is stale — feeding it to
@@ -1424,6 +1609,122 @@ class Router:
                 for n, b in self._backends.items() if not b.down
             },
         }
+
+    # -- fleet observability -------------------------------------------------
+
+    def _fleet_stats(self) -> dict:
+        """The federated slice of ``stats()['fleet']`` — what bench
+        embeds and the advisor's slo_burn / backend_underutilized /
+        scrape_stale rules consume. Empty when federation is off."""
+        fed = self.federation
+        if fed is None:
+            return {}
+        expected = [n for n, b in self._backends.items()
+                    if not b.down]
+        util: dict[str, dict] = {}
+        for n in fed.backends():
+            u = fed.utilization(n)
+            if u is not None:
+                util[n] = {
+                    "utilization_pct": u.get("utilization_pct"),
+                    "source": u.get("source"),
+                }
+        vals = [u["utilization_pct"] for u in util.values()
+                if isinstance(u.get("utilization_pct"),
+                              (int, float))]
+        lat = fed.histogram_stats("decision_latency_seconds")
+        return {
+            "federation": fed.meta(),
+            "stale_backends": sorted(
+                fed.stale_backends(expected=expected)),
+            "utilization": util,
+            "min_backend_utilization_pct": (round(min(vals), 2)
+                                            if vals else None),
+            "p99_decision_latency_s": ((lat or {}).get("p99_s")),
+            "slo": self._slo_doc,
+        }
+
+    def _state_timeline(self, limit: int = 500) -> list[dict]:
+        """The raw ``router_state.jsonl`` event stream (placement
+        flips, orphans, lost/respawned backends, epoch headers) for
+        the /fleet timeline — newest ``limit`` records, torn tail
+        skipped. Empty without ``state_path``."""
+        path = self.config.state_path
+        if not path:
+            return []
+        out: list[dict] = []
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        continue  # torn tail / mid-write line
+                    if isinstance(rec, dict):
+                        out.append(rec)
+        except OSError:
+            return []
+        return out[-limit:]
+
+    def fleet_snapshot(self) -> dict:
+        """The web ``/fleet`` document: every backend's state +
+        scrape freshness + utilization, the router-state timeline,
+        and the current SLO burn rates — the fleet as ONE system."""
+        with self._lock:
+            placement = dict(self._placement)
+            orphans = sorted(self._orphans)
+        fed = self.federation
+        meta = fed.meta() if fed is not None else {}
+        backends: dict[str, dict] = {}
+        for n, b in self._backends.items():
+            row = b.snapshot()
+            m = meta.get(n)
+            if m:
+                row["scrape_age_s"] = m.get("scrape_age_s")
+                row["scrapes"] = m.get("scrapes")
+                row["scrape_failures"] = m.get("scrape_failures")
+                row["scrape_stale"] = m.get("stale")
+            if fed is not None:
+                row["utilization"] = fed.utilization(n)
+            row["tenants"] = sorted(t for t, bn in placement.items()
+                                    if bn == n)
+            backends[n] = row
+        doc: dict = {
+            "router": self.name,
+            "t": round(_time.time(), 3),
+            "epoch": self._epoch,
+            "draining": self._draining,
+            "backends": backends,
+            "orphaned": orphans,
+            "migrations": len(self.migrations),
+            "timeline": self._state_timeline(),
+        }
+        if fed is not None:
+            doc["decision_latency"] = fed.histogram_stats(
+                "decision_latency_seconds")
+            doc["slo"] = self._slo_doc
+            doc["stale_backends"] = sorted(fed.stale_backends(
+                expected=[n for n, b in self._backends.items()
+                          if not b.down]))
+        return doc
+
+    def metrics_text(self) -> str:
+        """Router ``GET /metrics``: the router's own registry plus the
+        federated per-backend + fleet-total series. The family sets
+        are disjoint by construction (backends emit service/scheduler
+        families, the router emits ``router_*``/``fleet_*``/``slo_*``)
+        so plain concatenation is a valid exposition."""
+        parts: list[str] = []
+        if self.metrics is not None:
+            from ..telemetry import export as _export
+
+            parts.append(_export.prometheus_text(self.metrics))
+        if self.federation is not None:
+            parts.append(self.federation.prometheus_text())
+        return "\n".join(p for p in parts if p)
 
     # -- drain / shutdown ----------------------------------------------------
 
@@ -1515,6 +1816,16 @@ class Router:
 
         with self._lock:
             migrations = [dict(m) for m in self.migrations]
+        # The federated fleet p99 is the REAL cross-process quantile
+        # (bucket-merged histograms, not a max of per-backend p99s);
+        # the conservative worst-backend max remains the fallback when
+        # federation is off or never scraped.
+        fleet_p99 = None
+        if self.federation is not None:
+            lat = self.federation.histogram_stats(
+                "decision_latency_seconds")
+            if lat and isinstance(lat.get("p99_s"), (int, float)):
+                fleet_p99 = lat["p99_s"]
         fin = {
             "router": self.name,
             "tenants": results,
@@ -1522,10 +1833,10 @@ class Router:
             "backends": per_backend,
             "valid": merge_valid(r.get("valid")
                                  for r in results.values()),
-            # Per-tenant p99s don't compose into one histogram across
-            # processes; the conservative router-level number is the
-            # worst backend's aggregate p99.
-            "p99_decision_latency_s": max(p99s) if p99s else None,
+            "p99_decision_latency_s": (
+                fleet_p99 if fleet_p99 is not None
+                else (max(p99s) if p99s else None)),
+            "fleet_p99_decision_latency_s": fleet_p99,
             "migrations": migrations,
         }
         run_prov = _prov.block(_prov.merge_counts(
@@ -1544,6 +1855,7 @@ class Router:
                 from .. import web
 
                 web.unregister_live_source(self.name)
+                web.unregister_fleet_source(self.name)
             except Exception:  # noqa: BLE001
                 pass
         return fin
@@ -1577,6 +1889,7 @@ class Router:
                 from .. import web
 
                 web.unregister_live_source(self.name)
+                web.unregister_fleet_source(self.name)
             except Exception:  # noqa: BLE001
                 pass
 
@@ -1675,6 +1988,18 @@ def make_router_handler(router: Router):
                     self._json(200, router.live_snapshot())
                 elif path in ("/backends", "/backends/"):
                     self._json(200, router.health_snapshot())
+                elif path == "/metrics":
+                    body = router.metrics_text().encode()
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type",
+                        "text/plain; version=0.0.4; charset=utf-8")
+                    self.send_header("Content-Length",
+                                     str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                elif path in ("/fleet", "/fleet/"):
+                    self._json(200, router.fleet_snapshot())
                 else:
                     self._json(404, {"error": "not_found"})
             except Exception as e:  # noqa: BLE001
@@ -1704,7 +2029,12 @@ def make_router_handler(router: Router):
                             "max_bytes": MAX_BODY_BYTES})
                         return
                     body = self.rfile.read(length)
-                    status, doc = router.submit(tenant, body)
+                    tid = self.headers.get(_trace.TRACE_HEADER)
+                    trace = ((tid,
+                              self.headers.get(_trace.PARENT_HEADER))
+                             if tid else None)
+                    status, doc = router.submit(tenant, body,
+                                                trace=trace)
                     self._json(status, doc)
                 elif path.startswith("/migrate/"):
                     tenant = path[len("/migrate/"):].strip("/")

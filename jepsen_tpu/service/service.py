@@ -269,6 +269,16 @@ class _Tenant:
         self.released = threading.Event()
         self.t0 = _time.monotonic()
         self.registered_at = _time.time()
+        # Propagated cross-process trace context: (trace_id, parent
+        # span id) from the newest submit that carried the headers,
+        # and the span id of the service.ingest span recorded for it
+        # (decide spans parent to it). Guarded by self.lock.
+        self.trace: Optional[tuple] = None
+        self.trace_span: Optional[str] = None
+        # Whether an ingest span was recorded under self.trace ON THIS
+        # backend: an adopt joins the context (so decide spans parent
+        # right) without consuming the resumed feed's ingest span.
+        self.trace_ingested = False
         # Token bucket (guarded by self.lock).
         self.allowance = float(cfg.quota_burst
                                if cfg.quota_burst is not None
@@ -289,6 +299,11 @@ class Service:
         self.model = model
         self.config = cfg
         self.metrics = metrics
+        # The span sink shared with the scheduler below: propagated
+        # trace context (client → router → here) is recorded against
+        # it, so cross-process spans land next to the in-process
+        # op/segment/member/oracle chain and join on stream + index.
+        self.collector = collector
         self.name = name
         self._tenants: dict[str, _Tenant] = {}
         # Tombstones of tenants released to another backend: _admit
@@ -635,7 +650,8 @@ class Service:
 
     def adopt(self, tenant: str, journal_text: Any,
               cause: Optional[str] = None,
-              epoch: Optional[int] = None) -> dict:
+              epoch: Optional[int] = None,
+              trace: Optional[tuple] = None) -> dict:
         """Adopt one migrated tenant: write its journal (handed over
         by the router — the tenant's complete checkpoint) under this
         backend's ``journal_dir`` and replay it behind ADMISSION —
@@ -751,6 +767,13 @@ class Service:
                  tenant, rep.get("watermark", -1),
                  rep.get("records", 0),
                  f", cause={cause}" if cause else "")
+        # The resume end of a migration handover: recorded against the
+        # router-propagated trace context so the tenant's life on THIS
+        # backend joins the same trace that covered its life on the
+        # source backend and the router's migration span between them.
+        self._record_trace(t, trace, "service.adopt",
+                           watermark=rep.get("watermark", -1),
+                           cause=cause, epoch=epoch)
         return {
             "tenant": tenant,
             "watermark": rep.get("watermark", -1),
@@ -900,13 +923,54 @@ class Service:
 
     # -- ingestion -----------------------------------------------------------
 
-    def submit(self, tenant: str, op: Any) -> None:
+    def _record_trace(self, t: _Tenant, trace: Optional[tuple],
+                      name: str, **attrs) -> None:
+        """Record one point-span against the propagated trace context
+        (no-op without a collector or context). The first span a new
+        context mints (``service.ingest``) is remembered as the parent
+        for this tenant's later ``service.decide`` spans — the
+        cross-process hop stays one tree per backend visit."""
+        if self.collector is None:
+            return
+        ctx = trace
+        with t.lock:
+            if ctx is None:
+                ctx = t.trace
+            elif ctx != t.trace:
+                t.trace = ctx
+                t.trace_span = None  # new context: new subtree root
+                t.trace_ingested = False
+            parent_span = t.trace_span
+        if ctx is None:
+            return
+        now = _time.monotonic_ns()
+        rec = self.collector.record(
+            name, start_ns=now, end_ns=now, trace_id=ctx[0],
+            parent_id=parent_span if parent_span is not None else ctx[1],
+            stage="service", tenant=t.name, service=self.name, **attrs)
+        if parent_span is None:
+            with t.lock:
+                t.trace_span = rec["span_id"]
+
+    def submit(self, tenant: str, op: Any,
+               trace: Optional[tuple] = None) -> None:
         """Accept one history op for ``tenant`` (auto-admitting it).
         Raises the typed rejections documented on the class; an
         accepted op WILL be fed through the tenant's segmenter (unless
         drain's deadline truncates the stream — reported per tenant as
-        ``undelivered_ops``)."""
+        ``undelivered_ops``). ``trace`` is the propagated cross-process
+        trace context ``(trace_id, parent_span_id)`` — recorded once
+        per context as a ``service.ingest`` span, not per op."""
         t = self._admit(tenant)
+        if trace is not None and self.collector is not None:
+            with t.lock:
+                is_new = trace != t.trace or not t.trace_ingested
+            if is_new:
+                self._record_trace(
+                    t, trace, "service.ingest",
+                    next_index=t.segmenter.next_index)
+                with t.lock:
+                    t.trace_ingested = True
         if t.released.is_set():
             raise TenantMigratingError(
                 f"tenant {t.name!r} is being migrated to another "
@@ -1041,12 +1105,21 @@ class Service:
 
     def _on_watermark(self, t: _Tenant, w: int) -> None:
         now_ns = _time.monotonic_ns()
+        popped = 0
         with t.lat_lock:
             while t.lat_pending and t.lat_pending[0][0] <= w:
                 _idx, t_ns = t.lat_pending.popleft()
                 lat = max(now_ns - t_ns, 0) / 1e9
                 self._lat.observe(lat)  # aggregate (all tenants)
                 self._lat.labels(tenant=t.name).observe(lat)
+                popped += 1
+        if popped and self.collector is not None:
+            # One decide span per watermark advance (never per op):
+            # the propagated trace's proof that ops SUBMITTED under it
+            # were DECIDED here — the "…→ resume → decide" tail of the
+            # cross-process chain.
+            self._record_trace(t, None, "service.decide",
+                               watermark=w, ops_covered=popped)
 
     def _on_violation(self, t: _Tenant, violation: dict) -> None:
         with t.lock:

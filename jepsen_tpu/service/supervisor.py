@@ -478,6 +478,10 @@ class RouterState:
                      "epoch": int(epoch)})
 
     def append(self, rec: dict) -> bool:
+        # Every record carries its wall-clock write time: the /fleet
+        # timeline joins these events with per-backend busy spans, and
+        # replay tolerates (ignores) unknown keys by construction.
+        rec = {**rec, "t": round(_time.time(), 3)}
         try:
             with self._lock:
                 self._f.write(json.dumps(rec, sort_keys=True) + "\n")

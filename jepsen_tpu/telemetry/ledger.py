@@ -58,6 +58,12 @@ LEDGER_METRICS: list[tuple[str, str, str]] = [
     # Self-healing fleet: spawn → /healthz on the replacement child
     # after the router bench leg's injected kill-9.
     ("respawn_seconds", "respawn_seconds", "lower"),
+    # Fleet federation: the bucket-merged cross-process p99 and the
+    # coldest backend's busy share (telemetry/fleet.py).
+    ("fleet_p99_decision_latency_s",
+     "fleet_p99_decision_latency_s", "lower"),
+    ("fleet_min_backend_utilization_pct",
+     "fleet_min_backend_utilization_pct", "higher"),
     ("ops", "ops", "info"),
 ]
 
@@ -235,7 +241,12 @@ _BENCH_LEGS: list[tuple[str, Optional[str], str, dict]] = [
       "p99_decision_latency_s": "p99_decision_latency_s",
       "ops": "n_ops_total", "verdict": "valid_all",
       # Self-healing fleet: the repair half of the kill cycle.
-      "respawn_seconds": "respawn_seconds"}),
+      "respawn_seconds": "respawn_seconds",
+      # Fleet federation: cross-process p99 + coldest backend busy
+      # share from the router's federated scrape.
+      "fleet_p99_decision_latency_s": "fleet_p99_decision_latency_s",
+      "fleet_min_backend_utilization_pct":
+          "fleet_min_backend_utilization_pct"}),
     ("batch_replay_100", "batch_replay_100", "device",
      {"value_s": "value_s"}),
     ("batch_replay_large", "batch_replay_large", "device",

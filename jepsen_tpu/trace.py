@@ -42,6 +42,29 @@ from typing import Any, Optional
 
 from . import client as jclient
 
+# Cross-process trace propagation (the fleet's one-trace spine): the
+# ndjson service client stamps these headers on every POST, the router
+# forwards them on the proxied request (and onto /release → /adopt
+# during a migration), and the backend's HTTP layer threads them into
+# ``Service.submit`` — so one tenant's life across a kill-9 + live
+# migration + resume is ONE trace id, joined to the in-process
+# op → segment → member → oracle chain by stream name + index range
+# (the same resolution rule op traces already use).
+TRACE_HEADER = "X-Trace-Id"
+PARENT_HEADER = "X-Parent-Span"
+
+
+def trace_headers(trace_id: Optional[str],
+                  parent_id: Optional[str] = None) -> dict:
+    """Propagation headers for one outbound request ({} when no trace
+    context is active — callers can always ``update`` with this)."""
+    if not trace_id:
+        return {}
+    out = {TRACE_HEADER: str(trace_id)}
+    if parent_id:
+        out[PARENT_HEADER] = str(parent_id)
+    return out
+
 
 class Collector:
     """Thread-safe span sink."""

@@ -71,6 +71,49 @@ def unregister_live_source(key: str) -> None:
         _LIVE_SOURCES.pop(key, None)
 
 
+# Fleet sources: a router's ``fleet_snapshot()`` (backend states +
+# scrape freshness + utilization, the router_state timeline, SLO burn
+# rates) — the ``/fleet`` page renders every registered one. Same
+# stable-ordinal registry semantics as the live sources.
+_FLEET_SOURCES: dict[str, tuple[int, Callable[[], dict]]] = {}
+
+
+def register_fleet_source(key: str, fn: Callable[[], dict]) -> None:
+    """Expose ``fn()`` (a ``Router.fleet_snapshot``-shaped dict) on
+    the ``/fleet`` page under ``key`` until unregistered."""
+    global _LIVE_SEQ
+    with _LIVE_LOCK:
+        prev = _FLEET_SOURCES.get(key)
+        if prev is not None:
+            _FLEET_SOURCES[key] = (prev[0], fn)
+        else:
+            _FLEET_SOURCES[key] = (_LIVE_SEQ, fn)
+            _LIVE_SEQ += 1
+
+
+def unregister_fleet_source(key: str) -> None:
+    with _LIVE_LOCK:
+        _FLEET_SOURCES.pop(key, None)
+
+
+def fleet_snapshots() -> list[dict]:
+    """One snapshot per registered fleet source, registration order; a
+    raising source yields an error row instead of sinking the page."""
+    with _LIVE_LOCK:
+        items = [(key, fn) for key, (order, fn)
+                 in sorted(_FLEET_SOURCES.items(),
+                           key=lambda kv: kv[1][0])]
+    out = []
+    for key, fn in items:
+        try:
+            snap = dict(fn())
+        except Exception as e:  # noqa: BLE001 - a poll must not 500
+            snap = {"error": f"{type(e).__name__}: {e}"}
+        snap.setdefault("router", key)
+        out.append(snap)
+    return out
+
+
 def live_snapshots() -> list[dict]:
     """One snapshot dict per registered source, in registration order;
     a source that raises yields an ``{"error": ...}`` line instead of
@@ -171,7 +214,8 @@ def _index_page(root: Path) -> str:
         '<a href="/runs">runs</a> · '
         '<a href="/online">online</a> · '
         '<a href="/verdicts">verdicts</a> · '
-        '<a href="/live.html">live</a></p><table>'
+        '<a href="/live.html">live</a> · '
+        '<a href="/fleet">fleet</a></p><table>'
         "<tr><th>Test</th><th>Started</th><th>Valid?</th>"
         "<th>Telemetry</th><th></th></tr>"
         + "".join(rows) + "</table></body></html>"
@@ -701,6 +745,7 @@ pre { background: #f6f6f6; padding: 0.6em; }</style></head>
 <body><h1>Live runs</h1>
 <p><a href="/">index</a> · <a href="/metrics">metrics</a> ·
 <a href="/online">online</a> · <a href="/verdicts">verdicts</a> ·
+<a href="/fleet">fleet</a> ·
 raw feed: <a href="/live">/live</a>
 (ndjson poll)</p>
 <div id="runs"><p id="none">polling /live…</p></div>
@@ -745,9 +790,25 @@ async function tick() {
                 // a live backend means the supervisor healed it.
                 const bad = b.down || b.state === 'lost' ||
                   b.state === 'open' || b.respawn_gave_up;
-                return (bad ? '<span class="stall">' : '') + n +
+                // Each backend row links to ITS OWN /live view; the
+                // scrape cell mirrors the missing-latency guard — a
+                // federated backend with no successful scrape renders
+                // a typed "no scrape" marker, never a blank that
+                // reads as healthy.
+                const label = b.url
+                  ? '<a href="' + b.url + '/live">' + n + '</a>' : n;
+                let scrape = '';
+                if (b.scrapes !== undefined) {
+                  scrape = (b.scrape_age_s === undefined ||
+                            b.scrape_age_s === null)
+                    ? ' · <span class="stall">no scrape</span>'
+                    : ' · scraped ' + b.scrape_age_s + 's ago' +
+                      (b.scrape_stale
+                        ? ' <span class="stall">STALE</span>' : '');
+                }
+                return (bad ? '<span class="stall">' : '') + label +
                   ' [' + (b.state || '?') + ']' +
-                  (b.respawns ? ' ⟳' + b.respawns : '') +
+                  (b.respawns ? ' ⟳' + b.respawns : '') + scrape +
                   (bad ? '</span>' : '');
               }).join(' · ') + '</p>';
           }
@@ -810,6 +871,191 @@ def _live_page() -> str:
     return _LIVE_HTML % _STYLE
 
 
+# ---------------------------------------------------------------------------
+# The fleet page: every registered router's fleet_snapshot — backend
+# states + scrape freshness, the router_state.jsonl timeline, SLO burn
+# rates, and a fleet Gantt (one lane per backend) over the scraped
+# busy-span reconstructions.
+
+
+def _merge_intervals(ivals: list) -> list[list[float]]:
+    out: list[list[float]] = []
+    for a, b in sorted((float(a), float(b)) for a, b in ivals):
+        if out and a <= out[-1][1]:
+            out[-1][1] = max(out[-1][1], b)
+        else:
+            out.append([a, b])
+    return out
+
+
+def _fleet_gantt(backends: dict) -> str:
+    """One Gantt lane per backend from its scraped utilization block
+    (chunk busy-spans when the backend ran device kernels, backlog
+    occupancy otherwise), re-offset onto ONE shared wall-clock window
+    so cross-backend idle gaps line up; unattributed idle renders as
+    ``no-work`` gaps."""
+    lanes = []  # (name, pct, abs window t0/t1, merged rel intervals)
+    for name in sorted(backends):
+        u = (backends[name] or {}).get("utilization") or {}
+        w = u.get("window") or {}
+        if not isinstance(w.get("t0"), (int, float)) \
+                or not isinstance(w.get("t1"), (int, float)):
+            continue
+        if u.get("source") == "chunks":
+            ivals = [iv for dev in (u.get("devices") or [])
+                     for iv in (dev.get("intervals") or [])]
+        else:
+            ivals = list(u.get("intervals") or [])
+        lanes.append((name, u.get("utilization_pct"),
+                      float(w["t0"]), float(w["t1"]),
+                      _merge_intervals(ivals)))
+    if not lanes:
+        return ""
+    w0 = min(ln[2] for ln in lanes)
+    w1 = max(ln[3] for ln in lanes)
+    if w1 <= w0:
+        return ""
+    devices = []
+    pcts = [ln[1] for ln in lanes if isinstance(ln[1], (int, float))]
+    for name, pct, t0, t1, ivals in lanes:
+        rel = [[round(a + t0 - w0, 6), round(b + t0 - w0, 6)]
+               for a, b in ivals]
+        gaps = []
+        cursor = round(t0 - w0, 6)
+        for a, b in rel + [[round(t1 - w0, 6), round(t1 - w0, 6)]]:
+            if a - cursor > 1e-6:
+                gaps.append({"t0_s": cursor, "t1_s": a,
+                             "wall_s": round(a - cursor, 4),
+                             "class": "no-work"})
+            cursor = max(cursor, b)
+        devices.append({"device": name, "utilization_pct": pct,
+                        "intervals": rel, "gaps": gaps})
+    util = {
+        "window": {"t0": round(w0, 6), "t1": round(w1, 6),
+                   "makespan_s": round(w1 - w0, 6)},
+        "summary": {"mean_utilization_pct":
+                    (round(sum(pcts) / len(pcts), 2)
+                     if pcts else None)},
+        "devices": devices,
+    }
+    from .telemetry import utilization as _util
+
+    return _util.render_gantt(util)
+
+
+def _fleet_section(snap: dict) -> str:
+    name = html.escape(str(snap.get("router") or "?"))
+    if snap.get("error"):
+        return (f"<h2>{name}</h2><p class=\"stall\">"
+                f"{html.escape(str(snap['error']))}</p>")
+    bits = [f"epoch {snap.get('epoch')}"]
+    if snap.get("draining"):
+        bits.append("DRAINING")
+    bits.append(f"{len(snap.get('backends') or {})} backends")
+    orphans = snap.get("orphaned") or []
+    if orphans:
+        bits.append('<span class="stall">'
+                    f"{len(orphans)} orphaned</span>")
+    lat = snap.get("decision_latency") or {}
+    if isinstance(lat.get("p99_s"), (int, float)):
+        bits.append(f"fleet p50/p99 decide {lat.get('p50_s')}/"
+                    f"{lat.get('p99_s')}s")
+    parts = [f"<h2>{name}</h2><p>{' · '.join(bits)}</p>"]
+    slo = snap.get("slo") or {}
+    windows = slo.get("windows") or {}
+    if windows:
+        rows = "".join(
+            f"<tr><td>{html.escape(k)}</td>"
+            f"<td>{w.get('window_s')}</td>"
+            f"<td>{w.get('availability_burn_rate')}</td>"
+            f"<td>{w.get('latency_burn_rate')}</td>"
+            f"<td>{w.get('decided')}</td>"
+            f"<td>{w.get('rejected')}</td></tr>"
+            for k, w in sorted(windows.items()))
+        parts.append(
+            "<h3>SLO burn rates</h3>"
+            f"<p>availability target {slo.get('availability_target')}"
+            f" · latency target {slo.get('latency_target_s')}s @ "
+            f"p{slo.get('latency_ratio')}</p>"
+            "<table><tr><th>window</th><th>s</th>"
+            "<th>availability burn</th><th>latency burn</th>"
+            "<th>decided</th><th>rejected</th></tr>"
+            + rows + "</table>")
+    backends = snap.get("backends") or {}
+    stale = set(snap.get("stale_backends") or [])
+    brows = []
+    for n in sorted(backends):
+        b = backends[n] or {}
+        bad = (b.get("down") or b.get("state") in ("lost", "open")
+               or b.get("respawn_gave_up"))
+        age = b.get("scrape_age_s")
+        # The missing-scrape guard (the PR-14 missing-latency guard's
+        # shape): a backend with no successful scrape renders a typed
+        # placeholder, never a blank cell that reads as healthy.
+        if age is None:
+            scrape = '<span class="stall">no scrape</span>'
+        else:
+            scrape = f"{age}s ago"
+            if b.get("scrape_stale") or n in stale:
+                scrape += ' <span class="stall">STALE</span>'
+        u = b.get("utilization") or {}
+        pct = u.get("utilization_pct")
+        util = "—" if pct is None else \
+            f"{pct}% ({html.escape(str(u.get('source')))})"
+        url = str(b.get("url") or "")
+        link = (f'<a href="{html.escape(url)}/live">'
+                f"{html.escape(n)}</a>" if url else html.escape(n))
+        cls = ' class="stall"' if bad else ""
+        brows.append(
+            f"<tr{cls}><td>{link}</td>"
+            f"<td>{html.escape(str(b.get('state') or '?'))}</td>"
+            f"<td>{scrape}</td><td>{b.get('scrapes', 0)}</td>"
+            f"<td>{util}</td>"
+            f"<td>{len(b.get('tenants') or [])}</td></tr>")
+    parts.append(
+        "<h3>Backends</h3><table><tr><th>backend</th><th>state</th>"
+        "<th>last scrape</th><th>scrapes</th><th>utilization</th>"
+        "<th>tenants</th></tr>" + "".join(brows) + "</table>")
+    gantt = _fleet_gantt(backends)
+    if gantt:
+        parts.append("<h3>Fleet timeline (busy spans)</h3>" + gantt)
+    timeline = snap.get("timeline") or []
+    if timeline:
+        trows = []
+        for rec in timeline[-40:]:
+            detail = ", ".join(
+                f"{k}={v}" for k, v in sorted(rec.items())
+                if k not in ("kind", "t"))
+            trows.append(
+                f"<tr><td>{html.escape(str(rec.get('t', '—')))}</td>"
+                f"<td>{html.escape(str(rec.get('kind')))}</td>"
+                f"<td>{html.escape(detail)}</td></tr>")
+        parts.append(
+            "<h3>Router events (router_state.jsonl)</h3>"
+            "<table><tr><th>t</th><th>kind</th><th>detail</th></tr>"
+            + "".join(trows) + "</table>")
+    return "".join(parts)
+
+
+def _fleet_page() -> str:
+    snaps = fleet_snapshots()
+    if snaps:
+        body = "".join(_fleet_section(s) for s in snaps)
+    else:
+        body = ("<p>No fleet sources — start a router with a metrics "
+                "registry (<code>RouterConfig.federate</code>, the "
+                "default) and <code>register_live=True</code>.</p>")
+    return (
+        "<html><head><title>Jepsen fleet</title>"
+        '<meta http-equiv="refresh" content="2">'
+        f"<style>{_STYLE}\n.stall {{ background: #f7c5c5; }}</style>"
+        "</head><body><h1>Fleet</h1>"
+        '<p><a href="/">index</a> · <a href="/live.html">live</a> · '
+        '<a href="/metrics">metrics</a> · '
+        'raw: <a href="/fleet.json">/fleet.json</a></p>'
+        + body + "</body></html>")
+
+
 def _listing_page(rel: str, d: Path) -> str:
     items = "".join(
         f'<li><a href="/files/{rel}{f.name}{"/" if f.is_dir() else ""}">'
@@ -865,6 +1111,16 @@ def make_handler(root: Path):
                     return
                 if path == "/live.html":
                     self._send(200, _live_page().encode())
+                    return
+                if path in ("/fleet", "/fleet/"):
+                    self._send(200, _fleet_page().encode())
+                    return
+                if path == "/fleet.json":
+                    self._send(
+                        200,
+                        json.dumps(fleet_snapshots(), sort_keys=True,
+                                   default=str).encode(),
+                        "application/json")
                     return
                 if path.startswith("/zip/"):
                     rel = path[len("/zip/"):].strip("/")

@@ -58,6 +58,13 @@ def pytest_configure(config):
         "(jepsen_tpu.service.router; select with -m router). "
         "In-process-backend tests stay tier-1; the real process-kill "
         "e2e is additionally marked slow")
+    config.addinivalue_line(
+        "markers",
+        "fleet: fleet observability tests (jepsen_tpu.telemetry."
+        "fleet — metrics federation, SLO burn rates, cross-process "
+        "trace propagation; select with -m fleet). Closed-form merge "
+        "and in-process cluster tests stay tier-1; the real "
+        "two-process trace e2e is additionally marked slow")
 
 
 def pytest_addoption(parser):

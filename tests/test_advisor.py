@@ -187,6 +187,102 @@ class TestRulesClosedForm:
             "respawn_gave_up": ["backend-0"]}}}
         assert advisor.advise(whole) == []
 
+    def test_slo_burn_rule(self):
+        # A healthy-capacity fleet block (deficit 0 so respawn rule
+        # stays quiet) whose FAST availability window burns past the
+        # 14x page threshold: fires high.
+        def fleet(slo):
+            return {"service_router": {"fleet": {
+                "configured_backends": 2, "live_backends": 2,
+                "respawn_disabled": False, "respawn_gave_up": [],
+                "slo": slo}}}
+
+        hot = fleet({"availability_target": 0.999,
+                     "latency_target_s": 30.0,
+                     "windows": {
+                         "fast": {"availability_burn_rate": 20.0,
+                                  "latency_burn_rate": 0.0},
+                         "slow": {"availability_burn_rate": 2.0,
+                                  "latency_burn_rate": 0.0}}})
+        recs = advisor.advise(hot)
+        assert ids(recs) == ["slo_burn"]
+        assert recs[0]["severity"] == "high"
+        assert recs[0]["evidence"]["hot_windows"] == {
+            "fast_availability": {"burn_rate": 20.0,
+                                  "threshold": 14.0}}
+        # A sustained latency leak past the SLOW threshold fires too.
+        slow_leak = fleet({"windows": {
+            "fast": {"latency_burn_rate": 1.0},
+            "slow": {"latency_burn_rate": 7.0}}})
+        recs2 = advisor.advise(slow_leak)
+        assert ids(recs2) == ["slo_burn"]
+        assert "slow_latency" in recs2[0]["evidence"]["hot_windows"]
+        # Burning within budget (fast 13x, slow 5x): quiet.
+        ok = fleet({"windows": {
+            "fast": {"availability_burn_rate": 13.0,
+                     "latency_burn_rate": 13.0},
+            "slow": {"availability_burn_rate": 5.0,
+                     "latency_burn_rate": 5.0}}})
+        assert advisor.advise(ok) == []
+        # No SLO block at all (federation off): quiet.
+        assert advisor.advise(fleet(None)) == []
+
+    def test_backend_underutilized_rule(self):
+        def fleet(util):
+            return {"service_router": {"fleet": {
+                "configured_backends": len(util),
+                "live_backends": len(util),
+                "respawn_disabled": False, "respawn_gave_up": [],
+                "utilization": util}}}
+
+        # One cold backend while another runs hot: fires medium.
+        recs = advisor.advise(fleet({
+            "b0": {"utilization_pct": 91.0, "source": "backlog"},
+            "b1": {"utilization_pct": 7.5, "source": "backlog"}}))
+        assert ids(recs) == ["backend_underutilized"]
+        assert recs[0]["severity"] == "medium"
+        assert recs[0]["evidence"]["utilization_pct"] == {
+            "b0": 91.0, "b1": 7.5}
+        # Every backend cold: the fleet is idle — nothing to
+        # rebalance onto, quiet.
+        assert advisor.advise(fleet({
+            "b0": {"utilization_pct": 3.0},
+            "b1": {"utilization_pct": 5.0}})) == []
+        # Balanced and busy: quiet.
+        assert advisor.advise(fleet({
+            "b0": {"utilization_pct": 80.0},
+            "b1": {"utilization_pct": 75.0}})) == []
+        # A single backend has no placement alternative: quiet.
+        assert advisor.advise(fleet({
+            "b0": {"utilization_pct": 2.0}})) == []
+        # Unmeasurable utilization (no events scraped): quiet.
+        assert advisor.advise(fleet({
+            "b0": {"utilization_pct": None},
+            "b1": {"utilization_pct": 90.0}})) == []
+
+    def test_scrape_stale_rule(self):
+        stale = {"service_router": {"fleet": {
+            "configured_backends": 2, "live_backends": 2,
+            "respawn_disabled": False, "respawn_gave_up": [],
+            "stale_backends": ["backend-1"],
+            "federation": {
+                "backend-0": {"scrape_age_s": 0.1, "stale": False},
+                "backend-1": {"scrape_age_s": 42.0, "stale": True}}}}}
+        recs = advisor.advise(stale)
+        assert ids(recs) == ["scrape_stale"]
+        assert recs[0]["severity"] == "medium"
+        assert recs[0]["evidence"]["scrape_age_s"] == {
+            "backend-1": 42.0}
+        assert "'backend-1'" in recs[0]["advice"]
+        # Fresh scrapes everywhere: quiet.
+        fresh = {"service_router": {"fleet": {
+            "configured_backends": 2, "live_backends": 2,
+            "respawn_disabled": False, "respawn_gave_up": [],
+            "stale_backends": [],
+            "federation": {
+                "backend-0": {"scrape_age_s": 0.1, "stale": False}}}}}
+        assert advisor.advise(fresh) == []
+
     def test_device_baseline_and_cadence_rules(self):
         recs = advisor.advise(
             {"mutex_5k": {"skipped": "device_slow_guard"}},

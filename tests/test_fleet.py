@@ -1,0 +1,778 @@
+"""Fleet observability plane (ISSUE 16): metrics federation,
+scrape staleness, SLO burn rates, cross-process trace propagation.
+
+Three tiers, mirroring docs/telemetry.md "Fleet federation & SLOs":
+
+- CLOSED-FORM: merge_samples / FleetFederation / SloMonitor /
+  backlog_occupancy semantics pinned on hand-built registries and
+  sample lists — counters sum, gauges keep children + total,
+  histograms bucket-merge (so the fleet p99 is a real quantile),
+  mismatched buckets never fabricate a total, dead backends read
+  stale (never silently-zero), a respawned generation's fresh
+  counters REPLACE the dead one's (no cross-generation double
+  count), and burn rates come out of the windowed deltas exactly.
+- IN-PROCESS CLUSTER (tier-1): two real Services with their own
+  registries behind real HTTP servers, a Router federating them —
+  the federated /metrics matches the closed-form merge, staleness
+  fires when a backend stops answering, and the /fleet snapshot +
+  observed_at-stamped health rows come out right.
+- CROSS-PROCESS E2E (slow): two spawned backend processes; one
+  trace id covers submit → kill-9 → migrate → resume → decide, with
+  exactly ONE covering router.migrate span per handover.
+"""
+
+import json
+import os
+import random
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from jepsen_tpu import trace as jtrace
+from jepsen_tpu import web
+from jepsen_tpu.models import CasRegister
+from jepsen_tpu.service import Service
+from jepsen_tpu.service import http as shttp
+from jepsen_tpu.service import router as jrouter
+from jepsen_tpu.service.client import HttpServiceClient
+from jepsen_tpu.telemetry import Registry
+from jepsen_tpu.telemetry import fleet
+from jepsen_tpu.telemetry.registry import bucket_quantile
+from jepsen_tpu.testing import chunked_register_history
+
+pytestmark = [pytest.mark.fleet, pytest.mark.service]
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def model():
+    return CasRegister(init=0)
+
+
+def valid_history(seed, n_ops=200):
+    return chunked_register_history(random.Random(seed), n_ops=n_ops,
+                                    n_procs=2, chunk_ops=30)
+
+
+def get_json(url, timeout=10.0):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return json.loads(r.read().decode())
+
+
+def get_text(url, timeout=10.0):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.read().decode(), r.headers.get("Content-Type")
+
+
+def sample_of(samples, name, labels=None):
+    want = dict(labels or {})
+    for s in samples:
+        if s.get("name") == name and (s.get("labels") or {}) == want:
+            return s
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Closed-form merge semantics (the federation's contract).
+
+
+class TestMergeSamples:
+    def test_counters_sum_with_per_backend_children(self):
+        r0, r1 = Registry(), Registry()
+        r0.counter("x_total", "xh").inc(3)
+        r1.counter("x_total", "xh").inc(4)
+        merged = fleet.merge_samples(
+            {"b0": r0.collect(), "b1": r1.collect()})
+        assert sample_of(merged, "x_total")["value"] == 7.0
+        assert sample_of(merged, "x_total",
+                         {"backend": "b0"})["value"] == 3.0
+        assert sample_of(merged, "x_total",
+                         {"backend": "b1"})["value"] == 4.0
+
+    def test_gauges_keep_children_and_fleet_total(self):
+        r0, r1 = Registry(), Registry()
+        r0.gauge("service_tenants", "t").set(2)
+        r1.gauge("service_tenants", "t").set(5)
+        merged = fleet.merge_samples(
+            {"b0": r0.collect(), "b1": r1.collect()})
+        # The total is the fleet-wide LEVEL (tenants anywhere), the
+        # children keep per-backend attribution.
+        assert sample_of(merged, "service_tenants")["value"] == 7.0
+        assert sample_of(merged, "service_tenants",
+                         {"backend": "b1"})["value"] == 5.0
+
+    def test_labeled_series_merge_per_original_labelset(self):
+        r0, r1 = Registry(), Registry()
+        r0.counter("rej_total", "r", labelnames=("reason",)).labels(
+            reason="quota").inc(2)
+        r1.counter("rej_total", "r", labelnames=("reason",)).labels(
+            reason="quota").inc(3)
+        r1.counter("rej_total", "r", labelnames=("reason",)).labels(
+            reason="queue").inc(1)
+        merged = fleet.merge_samples(
+            {"b0": r0.collect(), "b1": r1.collect()})
+        assert sample_of(merged, "rej_total",
+                         {"reason": "quota"})["value"] == 5.0
+        assert sample_of(merged, "rej_total",
+                         {"reason": "queue"})["value"] == 1.0
+        assert sample_of(merged, "rej_total",
+                         {"reason": "quota",
+                          "backend": "b1"})["value"] == 3.0
+
+    def test_histograms_bucket_merge_gives_real_fleet_quantile(self):
+        buckets = (1.0, 2.0, 4.0, 8.0)
+        r0, r1 = Registry(), Registry()
+        h0 = r0.histogram("lat_seconds", "l", buckets=buckets)
+        h1 = r1.histogram("lat_seconds", "l", buckets=buckets)
+        # b0 is fast (10 ops under 1s), b1 is slow (10 ops ~3s): the
+        # fleet p99 must come from the MERGED distribution, not an
+        # average of per-backend quantiles.
+        for _ in range(10):
+            h0.observe(0.5)
+            h1.observe(3.0)
+        merged = fleet.merge_samples(
+            {"b0": r0.collect(), "b1": r1.collect()})
+        tot = sample_of(merged, "lat_seconds")
+        assert tot["count"] == 20
+        assert tot["sum"] == pytest.approx(35.0)
+        assert tot["buckets"]["1.0"] == 10
+        assert tot["buckets"]["4.0"] == 10
+        stats = fleet.stats_from_sample(tot)
+        # Closed-form: the same quantile off the hand-merged counts.
+        want_p99 = bucket_quantile(
+            [1.0, 2.0, 4.0, 8.0], [10, 0, 10, 0, 0], 0.99)
+        assert stats["p99_s"] == pytest.approx(want_p99)
+        assert stats["count"] == 20
+        # Each backend alone would say p99 <= 1s or ~4s; the merged
+        # quantile lands in the slow half.
+        assert stats["p99_s"] > 2.0
+
+    def test_mismatched_buckets_keep_children_drop_total(self):
+        r0, r1 = Registry(), Registry()
+        r0.histogram("lat_seconds", "l", buckets=(1.0, 2.0)).observe(0.5)
+        r1.histogram("lat_seconds", "l", buckets=(1.0, 4.0)).observe(3.0)
+        merged = fleet.merge_samples(
+            {"b0": r0.collect(), "b1": r1.collect()})
+        # Merging mismatched bounds would fabricate a distribution.
+        assert sample_of(merged, "lat_seconds") is None
+        assert sample_of(merged, "lat_seconds",
+                         {"backend": "b0"})["count"] == 1
+        assert sample_of(merged, "lat_seconds",
+                         {"backend": "b1"})["count"] == 1
+
+    def test_prometheus_text_renders_children_totals_and_help(self):
+        r0, r1 = Registry(), Registry()
+        r0.counter("x_total", "the help").inc(3)
+        r1.counter("x_total", "the help").inc(4)
+        h = r0.histogram("lat_seconds", "l", buckets=(1.0, 2.0))
+        h.observe(0.5)
+        h.observe(1.5)
+        merged = fleet.merge_samples(
+            {"b0": r0.collect(), "b1": r1.collect()})
+        text = fleet.prometheus_text_for(merged, {"x_total": "the help"})
+        assert "# HELP x_total the help" in text
+        assert "# TYPE x_total counter" in text
+        assert 'x_total{backend="b0"} 3' in text
+        assert "\nx_total 7" in text
+        # Exposition buckets are CUMULATIVE per the prom text format.
+        assert 'lat_seconds_bucket{backend="b0",le="1.0"} 1' in text
+        assert 'lat_seconds_bucket{backend="b0",le="+Inf"} 2' in text
+        assert 'lat_seconds_count{backend="b0"} 2' in text
+
+
+class TestScrapePayload:
+    def test_payload_shape_and_event_bound(self):
+        reg = Registry()
+        reg.counter("x_total", "xh").inc()
+        for i in range(50):
+            reg.event("online_backlog", t=float(i), backlog=i % 3)
+        doc = fleet.scrape_payload(reg, service="svc-a", max_events=10)
+        assert doc["v"] == 1
+        assert doc["service"] == "svc-a"
+        assert sample_of(doc["samples"], "x_total")["value"] == 1.0
+        assert doc["helps"]["x_total"] == "xh"
+        assert len(doc["events"]) == 10
+        # The TAIL of the ring survives the bound, not the head.
+        assert doc["events"][-1]["t"] == 49.0
+
+    def test_payload_is_json_serializable(self):
+        reg = Registry()
+        reg.histogram("lat_seconds", "l", buckets=(1.0,)).observe(0.5)
+        json.dumps(fleet.scrape_payload(reg))
+
+
+class TestBacklogOccupancy:
+    def test_busy_share_and_window_relative_intervals(self):
+        evs = [
+            {"name": "online_backlog", "t": 100.0, "backlog": 1},
+            {"name": "online_backlog", "t": 105.0, "backlog": 0},
+            {"name": "online_backlog", "t": 108.0, "backlog": 2},
+        ]
+        occ = fleet.backlog_occupancy(evs, until=110.0)
+        # Busy [100,105] + [108,110] = 7s of a 10s window.
+        assert occ["utilization_pct"] == pytest.approx(70.0)
+        assert occ["window"]["makespan_s"] == pytest.approx(10.0)
+        assert occ["intervals"] == [[0.0, 5.0], [8.0, 10.0]]
+
+    def test_empty_and_idle_streams(self):
+        assert fleet.backlog_occupancy([]) is None
+        assert fleet.backlog_occupancy(
+            [{"name": "other", "t": 1.0}]) is None
+        occ = fleet.backlog_occupancy(
+            [{"name": "online_backlog", "t": 0.0, "backlog": 0},
+             {"name": "online_backlog", "t": 10.0, "backlog": 0}])
+        assert occ["utilization_pct"] == 0.0
+
+
+# ---------------------------------------------------------------------------
+# Staleness + generation semantics (FleetFederation).
+
+
+def payload_with_counter(value, service=None):
+    reg = Registry()
+    reg.counter("ops_total", "ops").inc(value)
+    return fleet.scrape_payload(reg, service=service)
+
+
+class TestFederationStaleness:
+    def test_age_grows_and_stale_fires_past_threshold(self):
+        met = Registry()
+        fed = fleet.FleetFederation(met, stale_after_s=5.0)
+        fed.record_scrape("b0", payload_with_counter(1), now=100.0)
+        assert fed.ages(now=103.0) == {"b0": pytest.approx(3.0)}
+        assert fed.stale_backends(now=103.0) == []
+        assert fed.stale_backends(now=106.0) == ["b0"]
+        # The gauges mirror it (the advisor / dashboards read these).
+        assert sample_of(met.collect(), "fleet_backends_stale")[
+            "value"] == 1.0
+        assert sample_of(met.collect(), "fleet_scrape_age_seconds",
+                         {"backend": "b0"})["value"] > 5.0
+
+    def test_expected_backend_never_scraped_is_stale(self):
+        fed = fleet.FleetFederation(stale_after_s=5.0)
+        fed.record_scrape("b0", payload_with_counter(1), now=100.0)
+        # b1 is expected live but has NEVER answered a scrape: it must
+        # read stale, not silently absent from every fleet total.
+        assert fed.stale_backends(expected=["b0", "b1"],
+                                  now=101.0) == ["b1"]
+
+    def test_failure_keeps_last_snapshot_and_counts(self):
+        met = Registry()
+        fed = fleet.FleetFederation(met, stale_after_s=5.0)
+        fed.record_scrape("b0", payload_with_counter(7), now=100.0)
+        fed.record_failure("b0")
+        fed.record_failure("b0")
+        # The last-good series still count (frozen), never dropped.
+        assert sample_of(fed.merged(), "ops_total")["value"] == 7.0
+        meta = fed.meta(now=101.0)["b0"]
+        assert meta["scrapes"] == 1
+        assert meta["scrape_failures"] == 2
+        assert meta["stale"] is False
+        assert sample_of(met.collect(), "fleet_scrape_failures_total",
+                         {"backend": "b0"})["value"] == 2.0
+
+    def test_respawned_generation_replaces_never_double_counts(self):
+        fed = fleet.FleetFederation()
+        fed.record_scrape("b0", payload_with_counter(100), now=100.0)
+        fed.record_scrape("b1", payload_with_counter(10), now=100.0)
+        assert sample_of(fed.merged(), "ops_total")["value"] == 110.0
+        # b0 dies and respawns: the fresh generation's LOWER counter
+        # replaces the dead one's — the fleet total must drop to the
+        # truth (5 + 10), not accumulate 100 + 5 + 10.
+        fed.record_scrape("b0", payload_with_counter(5), now=101.0)
+        assert sample_of(fed.merged(), "ops_total")["value"] == 15.0
+        assert fed.meta(now=101.0)["b0"]["scrapes"] == 2
+
+    def test_forget_drops_backend_entirely(self):
+        fed = fleet.FleetFederation()
+        fed.record_scrape("b0", payload_with_counter(3), now=100.0)
+        fed.record_failure("b0")
+        fed.forget("b0")
+        assert fed.backends() == []
+        assert fed.merged() == []
+        assert fed.meta() == {}
+
+    def test_utilization_backlog_fallback_from_scraped_events(self):
+        reg = Registry()
+        reg.counter("ops_total", "o").inc()
+        reg.event("online_backlog", t=100.0, backlog=1)
+        reg.event("online_backlog", t=105.0, backlog=0)
+        reg.event("online_backlog", t=110.0, backlog=0)
+        fed = fleet.FleetFederation()
+        fed.record_scrape("b0", fleet.scrape_payload(reg), now=110.0)
+        u = fed.utilization("b0")
+        # Host-engine backend: no chunk events, so the occupancy
+        # proxy carries the saturation view.
+        assert u["source"] == "backlog"
+        assert u["utilization_pct"] == pytest.approx(50.0)
+        assert fed.utilization("nope") is None
+
+    def test_fleet_histogram_stats_over_merged_total(self):
+        fed = fleet.FleetFederation()
+        for b, v in (("b0", 0.5), ("b1", 3.0)):
+            reg = Registry()
+            reg.histogram("decision_latency_seconds", "d",
+                          buckets=(1.0, 4.0)).observe(v)
+            fed.record_scrape(b, fleet.scrape_payload(reg), now=100.0)
+        stats = fed.histogram_stats("decision_latency_seconds")
+        assert stats["count"] == 2
+        assert fed.histogram_stats("no_such_family") is None
+
+
+# ---------------------------------------------------------------------------
+# SLO burn rates, closed-form.
+
+
+def slo_merged(decided, slow, rejects):
+    """A merged-samples list with the two families SloMonitor reads:
+    `decided` ops total of which `slow` landed above the 30s target,
+    plus a rejects counter. Fleet totals only (no backend label)."""
+    within = decided - slow
+    return [
+        {"name": "decision_latency_seconds", "type": "histogram",
+         "labels": {}, "count": decided, "sum": float(decided),
+         "buckets": {"10.0": within, "100.0": slow, "+Inf": 0}},
+        {"name": "service_rejects_total", "type": "counter",
+         "labels": {"reason": "quota"}, "value": float(rejects)},
+        # A per-backend child that must NOT be double-counted.
+        {"name": "service_rejects_total", "type": "counter",
+         "labels": {"reason": "quota", "backend": "b0"},
+         "value": float(rejects)},
+    ]
+
+
+class TestSloMonitor:
+    def test_burn_rates_from_windowed_deltas(self):
+        met = Registry()
+        mon = fleet.SloMonitor(met)
+        mon.observe(slo_merged(0, 0, 0), now=1000.0)
+        doc = mon.observe(slo_merged(100, 50, 100), now=1030.0)
+        fast = doc["windows"]["fast"]
+        # 100 rejected of 200 attempts = 0.5 bad over a 0.001 budget.
+        assert fast["attempts"] == 200
+        assert fast["rejected"] == 100.0
+        assert fast["availability_burn_rate"] == pytest.approx(500.0)
+        # 50 of 100 decides above 30s = 0.5 bad over a 0.01 budget.
+        assert fast["latency_burn_rate"] == pytest.approx(50.0)
+        assert doc["availability_target"] == 0.999
+        assert sample_of(met.collect(), "slo_availability_burn_rate",
+                         {"window": "fast"})["value"] == 500.0
+        assert sample_of(met.collect(), "slo_latency_burn_rate",
+                         {"window": "slow"})["value"] == 50.0
+
+    def test_healthy_fleet_burns_zero(self):
+        mon = fleet.SloMonitor()
+        mon.observe(slo_merged(0, 0, 0), now=1000.0)
+        doc = mon.observe(slo_merged(500, 0, 0), now=1030.0)
+        for w in doc["windows"].values():
+            assert w["availability_burn_rate"] == 0.0
+            assert w["latency_burn_rate"] == 0.0
+
+    def test_fast_window_forgets_old_badness(self):
+        mon = fleet.SloMonitor()
+        mon.observe(slo_merged(0, 0, 0), now=1000.0)
+        mon.observe(slo_merged(100, 0, 100), now=1010.0)  # a bad burst
+        doc = mon.observe(slo_merged(200, 0, 100), now=1200.0)
+        # 190s later the burst left the 60s fast window but still
+        # burns in the 600s slow window (100 rejected of 300
+        # attempts = 200 decided + 100 rejected).
+        assert doc["windows"]["fast"]["availability_burn_rate"] == 0.0
+        assert doc["windows"]["slow"][
+            "availability_burn_rate"] == pytest.approx(
+                (100 / 300) / 0.001, rel=1e-3)
+
+    def test_generation_reset_clamps_to_zero(self):
+        mon = fleet.SloMonitor()
+        mon.observe(slo_merged(100, 10, 50), now=1000.0)
+        # A backend respawn REPLACED its snapshot: fleet totals drop.
+        doc = mon.observe(slo_merged(20, 2, 5), now=1010.0)
+        for w in doc["windows"].values():
+            assert w["availability_burn_rate"] >= 0.0
+            assert w["latency_burn_rate"] >= 0.0
+            assert w["decided"] == 0  # clamped, never negative
+
+    def test_target_validation(self):
+        with pytest.raises(ValueError):
+            fleet.SloMonitor(availability_target=1.5)
+        with pytest.raises(ValueError):
+            fleet.SloMonitor(latency_ratio=0.0)
+
+
+# ---------------------------------------------------------------------------
+# The /fleet page renderer guards (satellite b).
+
+
+class TestFleetWebRender:
+    def snap(self, **backend_row):
+        return {"router": "router", "epoch": 3, "backends":
+                {"b0": {"state": "closed", "url": "http://x:1",
+                        **backend_row}},
+                "timeline": [{"kind": "place", "t": 1.0,
+                              "tenant": "t0", "backend": "b0"}]}
+
+    def test_missing_scrape_renders_typed_placeholder(self):
+        html_out = web._fleet_section(self.snap())
+        # The PR-14 missing-latency guard's shape: no blank cell that
+        # reads as healthy.
+        assert "no scrape" in html_out
+        assert 'href="http://x:1/live"' in html_out
+
+    def test_stale_scrape_flagged(self):
+        html_out = web._fleet_section(
+            self.snap(scrape_age_s=9.3, scrape_stale=True, scrapes=4))
+        assert "9.3s ago" in html_out
+        assert "STALE" in html_out
+        assert "no scrape" not in html_out
+
+    def test_timeline_rows_render(self):
+        html_out = web._fleet_section(self.snap(scrape_age_s=0.1))
+        assert "router_state.jsonl" in html_out
+        assert "tenant=t0" in html_out
+
+    def test_error_snapshot_renders_not_500(self):
+        out = web._fleet_section({"router": "r", "error": "boom"})
+        assert "boom" in out
+
+    def test_fleet_gantt_merges_windows_across_backends(self):
+        backends = {
+            "b0": {"utilization": {
+                "source": "backlog", "utilization_pct": 50.0,
+                "window": {"t0": 100.0, "t1": 110.0,
+                           "makespan_s": 10.0},
+                "intervals": [[0.0, 5.0]]}},
+            "b1": {"utilization": {
+                "source": "backlog", "utilization_pct": 100.0,
+                "window": {"t0": 105.0, "t1": 115.0,
+                           "makespan_s": 10.0},
+                "intervals": [[0.0, 10.0]]}},
+        }
+        svg = web._fleet_gantt(backends)
+        assert svg  # one lane per backend on a shared wall-clock axis
+        assert "b0" in svg and "b1" in svg
+        assert web._fleet_gantt({"b0": {}}) == ""
+
+
+# ---------------------------------------------------------------------------
+# In-process two-backend cluster: the federated view of a real fleet.
+
+
+class _FleetNode:
+    """One backend in-process: a real Service WITH its own registry
+    (the scrape source) behind a real HTTP server."""
+
+    def __init__(self, name, journal_dir):
+        self.name = name
+        self.metrics = Registry()
+        self.svc = Service(model(), journal_dir=str(journal_dir),
+                           name=name, engine="host",
+                           register_live=False, ledger=False,
+                           metrics=self.metrics,
+                           collector=jtrace.Collector())
+        self.srv = shttp.server(self.svc, port=0)
+        threading.Thread(
+            target=lambda: self.srv.serve_forever(poll_interval=0.02),
+            daemon=True).start()
+        self.url = f"http://127.0.0.1:{self.srv.server_address[1]}"
+        self.stopped = False
+        self.backend = jrouter.Backend(
+            name, self.url, journal_dir=str(journal_dir),
+            failure_threshold=2, cooldown_s=60.0)
+
+    def stop(self):
+        if not self.stopped:
+            self.stopped = True
+            self.srv.shutdown()
+            self.srv.server_close()
+            self.svc._pump_stop.set()
+            self.svc.scheduler.close(timeout=10)
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    nodes = [_FleetNode(f"fb{i}", tmp_path / f"fb{i}")
+             for i in range(2)]
+    rmet = Registry()
+    router = jrouter.Router(
+        [nd.backend for nd in nodes], metrics=rmet,
+        collector=jtrace.Collector(), register_live=False,
+        probe_interval_s=0.05, probe_timeout_s=1.0,
+        failure_threshold=2, migrate_retry_after_s=0.05,
+        rebalance=False, respawn=False,
+        state_path=str(tmp_path / "router_state.jsonl"))
+    rsrv = jrouter.server(router, port=0)
+    threading.Thread(
+        target=lambda: rsrv.serve_forever(poll_interval=0.02),
+        daemon=True).start()
+
+    class C:
+        pass
+
+    c = C()
+    c.nodes, c.router, c.rmet = nodes, router, rmet
+    c.url = f"http://127.0.0.1:{rsrv.server_address[1]}"
+
+    def wait(pred, timeout=30.0, what="condition"):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if pred():
+                return
+            time.sleep(0.02)
+        raise AssertionError(f"timed out waiting for {what}")
+
+    c.wait = wait
+    try:
+        yield c
+    finally:
+        try:
+            router.close()
+        finally:
+            rsrv.shutdown()
+            rsrv.server_close()
+            for nd in nodes:
+                nd.stop()
+
+
+class TestFleetCluster:
+    def test_federation_matches_closed_form_and_staleness(
+            self, cluster):
+        fed = cluster.router.federation
+        assert fed is not None
+        cluster.wait(lambda: set(fed.backends()) == {"fb0", "fb1"},
+                     what="both backends scraped")
+
+        # Place one tenant and let its decisions land.
+        h = valid_history(7, n_ops=120)
+        rep = HttpServiceClient(cluster.url, "t0", chunk_ops=30,
+                                max_retries=100,
+                                max_backoff_s=0.2).feed(h)
+        assert rep["error"] is None
+        cluster.wait(
+            lambda: (fed.fleet_histogram("decision_latency_seconds")
+                     or {}).get("count", 0) > 0,
+            what="fleet decision-latency total")
+
+        # The merged view is internally consistent: every fleet
+        # total equals the sum of its own per-backend children
+        # (counters AND gauges), histogram totals bucket-merge.
+        merged = fed.merged()
+        by_key = {}
+        for s in merged:
+            labels = dict(s.get("labels") or {})
+            b = labels.pop("backend", None)
+            key = (s["name"], tuple(sorted(labels.items())))
+            by_key.setdefault(key, {"total": None, "children": []})
+            if b is None:
+                by_key[key]["total"] = s
+            else:
+                by_key[key]["children"].append(s)
+        checked = 0
+        for (name, _), grp in by_key.items():
+            tot = grp["total"]
+            if tot is None or not grp["children"]:
+                continue
+            if tot["type"] == "histogram":
+                assert tot["count"] == sum(
+                    c["count"] for c in grp["children"]), name
+                for k, v in tot["buckets"].items():
+                    assert v == sum(c["buckets"][k]
+                                    for c in grp["children"]), name
+            else:
+                assert tot["value"] == pytest.approx(sum(
+                    c["value"] for c in grp["children"])), name
+            checked += 1
+        assert checked > 0
+
+        # service_tenants fleet total: exactly the one placed tenant.
+        assert sample_of(merged, "service_tenants")["value"] == 1.0
+
+        # The router's own /metrics concatenates its registry with
+        # the federated exposition.
+        text, ctype = get_text(cluster.url + "/metrics")
+        assert "version=0.0.4" in ctype
+        assert "fleet_scrapes_total" in text
+        assert 'backend="fb0"' in text
+        assert "router_epoch" in text
+
+        # /fleet: the one-system snapshot.
+        doc = get_json(cluster.url + "/fleet")
+        assert set(doc["backends"]) == {"fb0", "fb1"}
+        for row in doc["backends"].values():
+            assert row["scrapes"] >= 1
+            assert row["scrape_stale"] is False
+        assert doc["decision_latency"]["count"] > 0
+        assert any(rec.get("kind") == "place" and "t" in rec
+                   for rec in doc["timeline"])
+        assert doc["stale_backends"] == []
+
+        # SLO monitor ran on the scrape cadence and reads healthy.
+        slo = cluster.router.stats()["fleet"]["slo"]
+        assert set(slo["windows"]) == {"fast", "slow"}
+        assert slo["windows"]["fast"]["availability_burn_rate"] < 1.0
+
+        # The satellite-f bugfix: aggregation rows carry the probe
+        # time they were observed at.
+        for row in cluster.router.health_snapshot()[
+                "backends"].values():
+            assert isinstance(row["observed_at"], float)
+            assert row["health_age_s"] >= 0.0
+
+        # Kill fb1's HTTP server: its scrape goes stale (tightened
+        # horizon so tier-1 stays fast), the snapshot is kept.
+        fed.stale_after_s = 0.3
+        cluster.nodes[1].stop()
+        cluster.wait(lambda: "fb1" in (cluster.router.stats()["fleet"]
+                                       .get("stale_backends") or []),
+                     what="fb1 scrape staleness")
+        meta = fed.meta()
+        assert meta["fb1"]["stale"] is True
+        assert meta["fb1"]["scrapes"] >= 1  # last snapshot kept
+        rows = cluster.router.tenants_snapshot()["backends"]
+        assert rows["fb1"]["scrape_stale"] is True
+        # The live strip's guard inputs ride the same rows.
+        assert "scrape_age_s" in rows["fb0"]
+
+    def test_backend_metrics_endpoints_serve_live_registry(
+            self, cluster):
+        nd = cluster.nodes[0]
+        nd.metrics.counter("probe_check_total", "p").inc(3)
+        doc = get_json(nd.url + "/metrics.json")
+        assert doc["v"] == 1
+        assert doc["service"] == "fb0"
+        assert sample_of(doc["samples"],
+                         "probe_check_total")["value"] == 3.0
+        text, ctype = get_text(nd.url + "/metrics")
+        assert "version=0.0.4" in ctype
+        assert "probe_check_total 3" in text
+        # The fleet page's per-backend link target answers.
+        live = get_json(nd.url + "/live")
+        assert live["run"] == "fb0"
+        assert live["service"] is True
+
+
+# ---------------------------------------------------------------------------
+# Cross-process trace e2e (slow): one trace id across submit →
+# kill-9 → migrate → resume → decide over two REAL backend processes.
+
+
+@pytest.mark.slow
+class TestCrossProcessTraceE2E:
+    def test_one_trace_covers_kill9_migration_resume(self, tmp_path):
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   PYTHONPATH=REPO_ROOT)
+        backends = jrouter.spawn_backends(
+            2, journal_root=str(tmp_path), engine="host", env=env,
+            failure_threshold=2, cooldown_s=60.0)
+        collector = jtrace.Collector()
+        router = jrouter.Router(
+            backends, collector=collector, metrics=Registry(),
+            register_live=False, probe_interval_s=0.1,
+            failure_threshold=2, migrate_retry_after_s=0.1,
+            rebalance=False, respawn=False)
+        rsrv = jrouter.server(router, port=0)
+        threading.Thread(
+            target=lambda: rsrv.serve_forever(poll_interval=0.02),
+            daemon=True).start()
+        url = f"http://127.0.0.1:{rsrv.server_address[1]}"
+        tid = collector.mint_id()
+        try:
+            h = valid_history(21, n_ops=200)
+
+            def feed(rows):
+                rep = HttpServiceClient(
+                    url, "t0", chunk_ops=25, max_retries=200,
+                    max_backoff_s=0.2, trace_id=tid).feed(rows)
+                assert rep["error"] is None, rep
+
+            feed(h[:int(len(h) * 0.4)])
+            src_name = router.stats()["placement"]["t0"]
+            src = next(b for b in backends if b.name == src_name)
+            dst = next(b for b in backends if b.name != src_name)
+
+            def wm():
+                row = router.tenants_snapshot()["tenants"].get("t0")
+                return (row or {}).get("watermark")
+
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline \
+                    and not isinstance(wm(), int):
+                time.sleep(0.05)
+            assert isinstance(wm(), int)
+
+            # Scrape the source's spans BEFORE the kill — they die
+            # with the process; /trace is the only way to observe
+            # them (no span-shipping sidecar).
+            src_spans = get_json(src.url + "/trace")["spans"]
+            assert any(s["name"] == "service.ingest"
+                       and s.get("trace_id") == tid
+                       for s in src_spans)
+
+            src.proc.kill()  # the real kill-9
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline and \
+                    router.stats()["placement"].get("t0") != dst.name:
+                time.sleep(0.1)
+            assert router.stats()["placement"]["t0"] == dst.name
+            assert not router.stats()["orphaned"]
+
+            w = wm()
+            feed(h[next((k for k, op in enumerate(h)
+                         if isinstance(w, int) and op.index >= w),
+                        0):])
+            last = h[-1].index
+            deadline = time.monotonic() + 120
+            while time.monotonic() < deadline:
+                w = wm()
+                if isinstance(w, int) and w >= last:
+                    break
+                time.sleep(0.1)
+
+            def attrs(s):
+                return s.get("attrs") or {}
+
+            # Reassemble the trace from every process's span sink —
+            # the target's BEFORE drain (drain stops the children).
+            dst_spans = [
+                s for s in get_json(dst.url + "/trace")["spans"]
+                if s.get("trace_id") == tid]
+            fin = router.drain(timeout=120)
+            assert "t0" in fin["tenants"]
+            router_spans = [s for s in collector.spans
+                            if s.get("trace_id") == tid]
+            names_router = {s["name"] for s in router_spans}
+            names_src = {s["name"] for s in src_spans
+                         if s.get("trace_id") == tid}
+            names_dst = {s["name"] for s in dst_spans}
+
+            # ONE trace id covers the tenant's whole life:
+            # placement + migration on the router, ingest on the
+            # source, adopt + resumed ingest + decide on the target.
+            assert "router.place" in names_router
+            assert "router.migrate" in names_router
+            assert "service.ingest" in names_src
+            assert {"service.adopt", "service.ingest",
+                    "service.decide"} <= names_dst
+
+            # Exactly ONE covering migration span per handover.
+            migrations = [s for s in router_spans
+                          if s["name"] == "router.migrate"
+                          and attrs(s).get("tenant") == "t0"
+                          and attrs(s).get("ok")]
+            assert len(migrations) == 1
+            assert attrs(migrations[0])["src"] == src_name
+            assert attrs(migrations[0])["dst"] == dst.name
+            # Router spans carry the placement epoch.
+            assert all(isinstance(attrs(s).get("epoch"), int)
+                       for s in router_spans)
+        finally:
+            try:
+                router.close()
+            finally:
+                rsrv.shutdown()
+                rsrv.server_close()
+                for b in backends:
+                    try:
+                        b.proc.kill()
+                    except Exception:
+                        pass
