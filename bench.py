@@ -783,6 +783,170 @@ def main() -> int:
         finally:
             _chaos.reset()
 
+        # Offline decrease-and-conquer (segment planner PR): decide a
+        # fully RECORDED keyed history end to end via plan() → drive()
+        # — quiescent cuts × per-key splits fanned through ONE
+        # multi-stream scheduler (workers = plan streams). "Serial" is
+        # the pre-existing single-driver search (`check_history`
+        # backend=host), whose cost grows superlinearly with history
+        # length — a full-history serial run is infeasible (hours at
+        # 1M ops), so its rate is measured on a bounded sample of the
+        # SAME workload shape. Superlinearity means the sample
+        # OVERSTATES serial throughput, so `speedup_vs_serial` is a
+        # lower bound. The seeded-invalid twin (one perturbed read)
+        # pins refutation at scale; the 10M path (in-process drive for
+        # per-device attribution + 2-backend fleet fanout for
+        # per-backend) rides behind the device-slow guard.
+        _REC.begin("offline_segmented")
+        try:
+            n_off = int(os.environ.get("BENCH_OFFLINE_OPS", "1000000"))
+            off_workers = 4
+            # Measured on the dev box: generate+plan+drive ≈ n/5400 s
+            # per history; two histories + serial sample + slack.
+            if _left() < max(150, int(n_off / 2400)):
+                out["offline_segmented"] = {"skipped": "budget"}
+            else:
+                from jepsen_tpu import independent as _ind
+                from jepsen_tpu import offline as _off
+                from jepsen_tpu.history import History as _Hist
+                from jepsen_tpu.telemetry import Registry as _OReg
+                from jepsen_tpu.testing import (
+                    concurrent_register_history)
+
+                _okeys, _owriters = 8, 5
+
+                def _keyed_rec(seed, n, invalid=False):
+                    # 8 independent keys of fully-overlapping write
+                    # rounds (2^n_writers interleavings per round, an
+                    # n_writers-value carry set at every quiescent
+                    # cut) merged by wall time — the decide-dominant
+                    # shape a recorded contended history has, not the
+                    # nearly-sequential chunked one.
+                    ops = []
+                    for i in range(_okeys):
+                        rng = random.Random(seed + i)
+                        hk = concurrent_register_history(
+                            rng, n_ops=n // _okeys,
+                            n_writers=_owriters)
+                        if invalid and i == 0:
+                            hk = perturb_history(rng, hk)
+                        ops.extend(
+                            op.with_(process=op.process + 1000 * i,
+                                     value=_ind.KV(f"k{i}", op.value),
+                                     index=-1)
+                            for op in hk)
+                    ops.sort(key=lambda o: o.time)
+                    return _Hist(ops, reindex=True)
+
+                # Serial baseline: single-driver host search on an
+                # unkeyed sample of the same generator/params (== one
+                # key's subhistory by construction).
+                ser_h = concurrent_register_history(
+                    random.Random(9100), n_ops=1200,
+                    n_writers=_owriters)
+                t0 = time.perf_counter()
+                ser_ok = wgl.check_history(
+                    model, ser_h, backend="host")["valid"]
+                ser_rate = len(ser_h) / (time.perf_counter() - t0)
+
+                hist_v = _keyed_rec(9200, n_off)
+                plan_v = _off.plan(hist_v, streams=off_workers)
+                oreg = _OReg()
+                t0 = time.perf_counter()
+                run_v = _off.drive(plan_v, model, engine="auto",
+                                   metrics=oreg)
+                dec_s = time.perf_counter() - t0
+                rate = len(hist_v) / (plan_v.plan_seconds + dec_s)
+                util = (run_v.get("utilization") or {})
+                util_pct = util.get("mean_utilization_pct",
+                                    run_v.get("busy_pct"))
+
+                hist_i = _keyed_rec(9300, n_off, invalid=True)
+                plan_i = _off.plan(hist_i, streams=off_workers)
+                t0 = time.perf_counter()
+                run_i = _off.drive(plan_i, model, engine="auto")
+                inv_s = time.perf_counter() - t0
+
+                out["offline_segmented"] = {
+                    "n_ops": len(hist_v),
+                    "workers": off_workers,
+                    "engine": run_v["engine"],
+                    "valid": str(run_v["valid"]),
+                    "ops_per_s": round(rate, 1),
+                    "decide_seconds": round(dec_s, 3),
+                    "plan_seconds": round(plan_v.plan_seconds, 3),
+                    "serial_sample_ops": len(ser_h),
+                    "serial_sample_valid": str(ser_ok),
+                    "serial_ops_per_s": round(ser_rate, 1),
+                    "speedup_vs_serial": round(rate / ser_rate, 2),
+                    "utilization_pct": util_pct,
+                    "utilization": util or None,
+                    "plan": plan_v.stats(),
+                    "invalid": {
+                        "n_ops": len(hist_i),
+                        "valid": str(run_i["valid"]),
+                        "wall_s": round(inv_s, 3),
+                        "ops_per_s": round(
+                            len(hist_i)
+                            / (plan_i.plan_seconds + inv_s), 1),
+                    },
+                }
+
+                # The 10M-op path: in-process drive (per-DEVICE
+                # attribution off the registry's chunk timeline) plus
+                # a 2-backend fleet fanout (per-BACKEND attribution
+                # off the router's federated scrapes). ~40+ min on
+                # the dev box — device-slow-guarded and sized against
+                # the remaining budget, never silently truncated.
+                n_10m = int(os.environ.get("BENCH_OFFLINE_10M_OPS",
+                                           "10000000"))
+                if _device_slow(2400):
+                    out["offline_segmented"]["scale_10m"] = {
+                        "skipped": "device_slow_guard"}
+                elif _left() < max(600, int(n_10m / 3000)):
+                    out["offline_segmented"]["scale_10m"] = {
+                        "skipped": "budget"}
+                else:
+                    hist_x = _keyed_rec(9400, n_10m)
+                    plan_x = _off.plan(hist_x, streams=off_workers)
+                    xreg = _OReg()
+                    t0 = time.perf_counter()
+                    run_x = _off.drive(plan_x, model, engine="auto",
+                                       metrics=xreg)
+                    x_s = time.perf_counter() - t0
+                    x_util = (run_x.get("utilization") or {})
+                    t0 = time.perf_counter()
+                    fleet = _off.fanout_fleet(
+                        plan_x, backends=2, model="cas-register",
+                        engine="host")
+                    f_s = time.perf_counter() - t0
+                    fl = fleet.get("fleet") or {}
+                    out["offline_segmented"]["scale_10m"] = {
+                        "n_ops": len(hist_x),
+                        "valid": str(run_x["valid"]),
+                        "ops_per_s": round(
+                            len(hist_x)
+                            / (plan_x.plan_seconds + x_s), 1),
+                        "plan_seconds": round(
+                            plan_x.plan_seconds, 3),
+                        "device_utilization_pct": x_util.get(
+                            "device_utilization_pct"),
+                        "mean_device_utilization_pct": x_util.get(
+                            "mean_utilization_pct",
+                            run_x.get("busy_pct")),
+                        "fleet_valid": str(fleet["valid"]),
+                        "fleet_backends": 2,
+                        "fleet_wall_s": round(f_s, 3),
+                        "fleet_ops_per_s": round(len(hist_x) / f_s, 1),
+                        "backend_loads": fleet.get("backend_loads"),
+                        "backend_utilization": fl.get("utilization"),
+                        "min_backend_utilization_pct": fl.get(
+                            "min_backend_utilization_pct"),
+                    }
+        except Exception as e:  # noqa: BLE001
+            out["offline_segmented"] = {
+                "error": f"{type(e).__name__}: {e}"}
+
         # --- Device sections, costliest-compile last, each budgeted ----
         # A wedged TPU relay hangs the FIRST jax op forever (not an
         # exception — the per-section try/except can't catch it), which

@@ -61,6 +61,11 @@ SLO_SLOW_BURN_THRESHOLD = 6.0
 # A federated backend busy less than this share of the fleet window is
 # underutilized — capacity the placement/rebalance policy is wasting.
 UNDERUTILIZED_BACKEND_PCT = 40.0
+# Offline plan skew: the largest (stream × key × segment) item's op
+# count past this ratio × the mean per-worker share means one
+# segment's serial decide is the wall-clock floor — more workers
+# cannot help until the cut gets finer.
+PLAN_SKEW_RATIO = 2.0
 
 
 # ---------------------------------------------------------------------------
@@ -188,6 +193,37 @@ def collect_fleet(doc: Any) -> dict:
             for k, v in d.items():
                 if k != "fleet":
                     walk(v)
+        elif isinstance(d, list):
+            for v in d:
+                walk(v)
+
+    walk(doc)
+    return worst
+
+
+def collect_plan_skew(doc: Any) -> dict:
+    """The most skewed offline plan-stats block (``planner.Plan.
+    stats()`` shape: ``largest_item_ops`` + ``mean_worker_share_ops``)
+    found anywhere in the document — the offline bench leg and the
+    CLI result both embed one. "Most skewed" = largest tail/share
+    ratio; a balanced plan must not mask a skewed one."""
+    worst: dict = {}
+
+    def _ratio(d: dict) -> float:
+        tail, share = d.get("largest_item_ops"), \
+            d.get("mean_worker_share_ops")
+        if isinstance(tail, (int, float)) and \
+                isinstance(share, (int, float)) and share > 0:
+            return float(tail) / float(share)
+        return -1.0
+
+    def walk(d: Any) -> None:
+        nonlocal worst
+        if isinstance(d, dict):
+            if _ratio(d) > _ratio(worst):
+                worst = dict(d)
+            for v in d.values():
+                walk(v)
         elif isinstance(d, list):
             for v in d:
                 walk(v)
@@ -590,6 +626,43 @@ def rule_scrape_stale(ctx: dict) -> Optional[dict]:
     }
 
 
+def rule_segment_plan_skew(ctx: dict) -> Optional[dict]:
+    """One offline plan item dominating the wall: the largest
+    (stream × key × segment) work item carries more than
+    PLAN_SKEW_RATIO × the mean per-worker op share, so its SERIAL
+    decide is a lower bound on the whole run's wall clock — adding
+    workers/backends past that point only grows idle capacity. The
+    fix is a finer cut first, wider fan-out second."""
+    plan = ctx["plan_skew"]
+    tail = plan.get("largest_item_ops")
+    share = plan.get("mean_worker_share_ops")
+    if not isinstance(tail, (int, float)) or \
+            not isinstance(share, (int, float)) or share <= 0:
+        return None
+    ratio = float(tail) / float(share)
+    if ratio <= PLAN_SKEW_RATIO:
+        return None
+    return {
+        "severity": "medium",
+        "title": "offline plan is skew-bound — one segment's serial "
+                 "tail dominates the wall",
+        "advice": f"the plan's largest segment carries {tail:.0f} ops "
+                  f"vs a {share:.0f}-op mean per-worker share "
+                  f"({ratio:.1f}x): that item decides serially and "
+                  "floors the wall clock no matter how many workers "
+                  "or backends fan out — record quiescent points more "
+                  "often (shorter concurrent windows, or an explicit "
+                  "barrier in the workload) so the Segmenter can cut "
+                  "the hot key finer, and only then add streams/"
+                  "backends to absorb the extra items",
+        "evidence": {"largest_item_ops": tail,
+                     "mean_worker_share_ops": share,
+                     "ratio": round(ratio, 1),
+                     "largest_item_key": plan.get("largest_item_key"),
+                     "n_streams": plan.get("n_streams")},
+    }
+
+
 def rule_latency_tail(ctx: dict) -> Optional[dict]:
     tails = [(leg, p50, p99) for leg, p50, p99 in ctx["latency_tails"]
              if p99 / p50 > TAIL_RATIO_THRESHOLD]
@@ -621,6 +694,7 @@ RULES: list[tuple[str, Callable[[dict], Optional[dict]]]] = [
     ("grow_batch_f", rule_grow_batch_f),
     ("feed_starved", rule_feed_starved),
     ("rebalance_tenants", rule_rebalance_tenants),
+    ("segment_plan_skew", rule_segment_plan_skew),
     ("backend_underutilized", rule_backend_underutilized),
     ("scrape_stale", rule_scrape_stale),
     ("prewarm_compiles", rule_prewarm_compiles),
@@ -650,6 +724,7 @@ def advise(bench: dict, rounds: Optional[list] = None,
         "latency_tails": _latency_tails(bench or {}),
         "backend_loads": collect_backend_loads(bench or {}),
         "fleet": collect_fleet(bench or {}),
+        "plan_skew": collect_plan_skew(bench or {}),
     }
     out = []
     for rid, fn in RULES:

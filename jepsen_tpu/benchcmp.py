@@ -137,6 +137,17 @@ METRICS: list[tuple[str, str, str]] = [
      "service_router.fleet_p99_decision_latency_s", "lower"),
     ("fleet_min_backend_utilization_pct",
      "service_router.fleet_min_backend_utilization_pct", "higher"),
+    # Offline decrease-and-conquer (segment planner PR): end-to-end
+    # plan+decide throughput over a recorded ≥1M-op keyed history
+    # through the co-batching scheduler (shrinking = the planner or
+    # the ready-take pipeline got slower). `speedup_vs_serial` is
+    # "info": it divides by a sample-measured single-driver rate whose
+    # superlinear cost makes the ratio a machine-dependent lower
+    # bound — the scale pin asserts it in tests, the table shows it.
+    ("offline_segmented_ops_per_s",
+     "offline_segmented.ops_per_s", "higher"),
+    ("offline_segmented_speedup_vs_serial",
+     "offline_segmented.speedup_vs_serial", "info"),
 ]
 
 DEFAULT_THRESHOLD = 0.10

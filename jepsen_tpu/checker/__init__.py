@@ -200,13 +200,16 @@ def linearizable(options: Optional[dict] = None, **kw) -> Checker:
 
     - ``model``: a `jepsen_tpu.models.Model` (required).
     - ``backend``: "auto" (default) | "device" | "host" | "native" |
-      "sharded" — overridden by the test map's ``checker_backend`` when
-      present (the BASELINE ``:checker-backend :tpu`` dispatch; "tpu" is
-      accepted as an alias for "device"). "auto" prefers the native C
-      search for single histories and the device kernel for batches;
-      "sharded" runs the frontier-sharded multi-chip search
-      (jepsen_tpu.parallel.frontier) over the test's ``mesh`` (or the
-      default mesh).
+      "sharded" | "segmented" — overridden by the test map's
+      ``checker_backend`` when present (the BASELINE ``:checker-backend
+      :tpu`` dispatch; "tpu" is accepted as an alias for "device").
+      "auto" prefers the native C search for single histories and the
+      device kernel for batches; "sharded" runs the frontier-sharded
+      multi-chip search (jepsen_tpu.parallel.frontier) over the test's
+      ``mesh`` (or the default mesh); "segmented" plans the recorded
+      history with the offline decrease-and-conquer planner
+      (jepsen_tpu.offline, docs/offline.md) and decides the (stream ×
+      key × segment) DAG through the multi-stream scheduler.
 
     Mirrors checker.clj:182-213 (including truncating bulky diagnostics).
     """
@@ -235,6 +238,16 @@ def linearizable(options: Optional[dict] = None, **kw) -> Checker:
             return check_history_sharded(
                 model, ops, mesh=(test or {}).get("mesh"),
                 metrics=kw.get("metrics"))
+        if backend == "segmented":
+            # The offline decrease-and-conquer path (jepsen_tpu.
+            # offline): plan the recorded history into a (stream × key
+            # × segment) DAG and decide it through the multi-stream
+            # scheduler — the checker surface of
+            # ``check_history(parallel="segmented")``.
+            from .. import offline
+
+            return offline.check_offline(model, ops,
+                                         metrics=kw.get("metrics"))
         from ..ops import wgl
 
         return wgl.check_history(model, ops, backend=backend, **kw)

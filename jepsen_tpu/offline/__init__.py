@@ -1,0 +1,58 @@
+"""Offline decrease-and-conquer decision path for recorded histories.
+
+``jepsen_tpu.online`` decides a history WHILE it streams; this package
+decides a fully *recorded* history by planning the same quiescent-cut /
+per-key / carried-state decomposition up front and fanning the
+resulting static DAG across three axes at once:
+
+1. the batched device pipeline (many segments → one
+   ``check_encoded_batch`` program),
+2. the sharded mesh (``--engine sharded``), and
+3. the PR-14 backend fleet (streams as synthetic tenants).
+
+Entry points: :func:`plan` + :func:`drive` (one process),
+:func:`~jepsen_tpu.offline.fanout.fanout_fleet` (N backend processes),
+``python -m jepsen_tpu.offline HISTORY.ndjson`` (CLI), and
+``check_history(..., parallel="segmented")`` (the checker surface).
+See docs/offline.md.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from .driver import ENGINES, drive
+from .fanout import fanout_fleet, fanout_services
+from .planner import NonMonotoneHistoryError, Plan, PlanItem, plan
+
+__all__ = ["plan", "drive", "check_offline", "fanout_services",
+           "fanout_fleet", "Plan", "PlanItem", "ENGINES",
+           "NonMonotoneHistoryError"]
+
+
+def check_offline(model, history: Any, *, streams: int = 0,
+                  engine: str = "auto", backends: int = 0,
+                  metrics=None, max_configs: int = 500_000,
+                  **kw: Any) -> dict:
+    """Plan + decide a recorded history in one call — the
+    ``check_history(parallel="segmented")`` implementation.
+
+    ``streams=0`` picks a width automatically (one per key, capped at
+    8). ``backends=0`` decides in-process through the shared scheduler;
+    ``backends>=1`` fans the streams across that many real backend
+    processes via :func:`fanout_fleet`.
+    """
+    p = plan(history, streams=streams if streams >= 1 else 8)
+    if backends >= 1:
+        # Backend services speak auto/device/host; the mesh-sharded
+        # oracle is a single-process engine, so it maps to device.
+        svc_engine = "device" if engine == "sharded" else engine
+        out = fanout_fleet(p, backends=backends, model=model.name,
+                           engine=svc_engine,
+                           max_configs=max_configs, metrics=metrics,
+                           **kw)
+    else:
+        out = drive(p, model, engine=engine, metrics=metrics,
+                    max_configs=max_configs, **kw)
+    out["parallel"] = "segmented"
+    return out

@@ -1,0 +1,99 @@
+"""CLI: decide a recorded ndjson history offline.
+
+::
+
+    python -m jepsen_tpu.offline HISTORY.ndjson --model cas-register \
+        --engine auto --streams 8 --backends 0 [--keyed] [-o OUT.json]
+
+Each input line is one scheduler-shaped op map (the same rows the
+service ingestion endpoint parses); ``--keyed`` re-wraps two-element
+list values as ``independent`` [k v] pairs (JSON cannot distinguish a
+vector value from a key/value pair, so the caller must say which
+recording convention the file uses). ``--backends N`` spawns N real
+backend processes behind the tenant router and fans the plan's streams
+across them; ``--backends 0`` (default) decides in-process through the
+shared multi-stream scheduler.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from .. import independent as ind
+from ..models import known_models, model_by_name
+from . import ENGINES, drive, fanout_fleet, plan
+
+
+def _load_ndjson(path: str, keyed: bool) -> list:
+    ops = []
+    opener = (lambda: sys.stdin) if path == "-" else \
+        (lambda: open(path))
+    f = opener()
+    try:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            row = json.loads(line)
+            if keyed and isinstance(row.get("value"), list) \
+                    and len(row["value"]) == 2:
+                row = dict(row, value=ind.KV(*row["value"]))
+            ops.append(row)
+    finally:
+        if path != "-":
+            f.close()
+    return ops
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m jepsen_tpu.offline",
+        description="Decide a recorded ndjson history with the "
+                    "decrease-and-conquer segment planner.")
+    ap.add_argument("history", help="ndjson history file, or - for stdin")
+    ap.add_argument("--model", default="cas-register",
+                    choices=sorted(known_models()))
+    ap.add_argument("--engine", default="auto", choices=list(ENGINES))
+    ap.add_argument("--streams", type=int, default=0,
+                    help="fan-out width (0 = one per key, capped at 8)")
+    ap.add_argument("--backends", type=int, default=0,
+                    help="spawn N router backend processes and fan "
+                         "the streams across them (0 = in-process)")
+    ap.add_argument("--keyed", action="store_true",
+                    help="treat 2-element list values as [k v] pairs")
+    ap.add_argument("--max-configs", type=int, default=500_000)
+    ap.add_argument("-o", "--out", default=None,
+                    help="write the result JSON here (default stdout)")
+    args = ap.parse_args(argv)
+
+    model = model_by_name(args.model)
+    ops = _load_ndjson(args.history, args.keyed)
+    streams = args.streams if args.streams >= 1 else \
+        max(args.backends, 8) if args.backends else 8
+    p = plan(ops, streams=streams)
+    from ..telemetry import Registry
+
+    reg = Registry()
+    if args.backends >= 1:
+        engine = "device" if args.engine == "sharded" else args.engine
+        res = fanout_fleet(p, backends=args.backends, model=args.model,
+                           engine=engine, metrics=reg,
+                           max_configs=args.max_configs)
+    else:
+        res = drive(p, model, engine=args.engine, metrics=reg,
+                    max_configs=args.max_configs)
+    res["parallel"] = "segmented"
+    doc = json.dumps(res, indent=2, sort_keys=True, default=str)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(doc + "\n")
+    else:
+        print(doc)
+    v = res.get("valid")
+    return 0 if v is True else 2 if v is False else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -71,6 +71,7 @@ stream with many distinct keys from monopolizing round latency.
 
 from __future__ import annotations
 
+import collections as _collections
 import contextlib as _contextlib
 import logging
 import queue
@@ -183,11 +184,16 @@ class SegmentScheduler:
         collector=None,
         flight=None,
         max_ready_per_stream: Optional[int] = None,
+        mesh=None,
     ) -> None:
         if engine not in ("auto", "device", "host"):
             raise ValueError(f"unknown online engine {engine!r}")
         self.model = model
         self.engine = engine
+        # Device mesh for the batched oracle (offline driver's
+        # ``--engine sharded``): forwarded to check_encoded_batch so one
+        # co-batched round shards its members across the mesh's dp axis.
+        self.mesh = mesh
         self.metrics = metrics
         self.max_configs = max_configs
         self.batch_f = batch_f
@@ -201,7 +207,12 @@ class SegmentScheduler:
         self._lock = threading.Lock()
         self._inbox: "queue.SimpleQueue[Optional[tuple]]" = (
             queue.SimpleQueue())
-        self._pending: list[tuple] = []  # (stream, KeySegment)
+        # (stream, key) -> FIFO of undecided KeySegments in seq order.
+        # Keyed (not a flat list) so a ready-take round is O(live keys),
+        # not O(pending segments): an offline 1M-op plan parks tens of
+        # thousands of segments here at once, and the flat list's
+        # sort-and-scan per round made the whole drain quadratic.
+        self._pending: dict[tuple, _collections.deque] = {}
         # (stream, key) -> segments submitted but not yet decided
         # (guarded by _lock; the /live dashboard's queue-depth view).
         self._key_depth: dict[tuple, int] = {}
@@ -530,7 +541,25 @@ class SegmentScheduler:
             st.seq_outstanding[seg.seq] = (
                 st.seq_outstanding.get(seg.seq, 0) + 1)
             st.seq_end[seg.seq] = seg.end_index
-            self._pending.append((stream, seg))
+            dq = self._pending.setdefault(
+                (stream, seg.key), _collections.deque())
+            if dq and seg.seq < dq[-1].seq:
+                # Out-of-seq arrival (a submitter that batches cuts
+                # non-monotonically): restore seq order so the FIFO
+                # head stays the key's earliest segment — per-key
+                # in-order dispatch is a soundness invariant.
+                rows = sorted([*dq, seg], key=lambda s: s.seq)
+                dq.clear()
+                dq.extend(rows)
+            else:
+                dq.append(seg)
+
+    def _pending_items(self):
+        """Every undecided (stream, segment) pair — crash/death paths
+        only; round-hot code goes through _take_ready."""
+        for (stream, _key), dq in self._pending.items():
+            for seg in dq:
+                yield stream, seg
 
     def _run(self) -> None:
         # Top-level guard: an exception anywhere outside _decide_round's
@@ -575,7 +604,7 @@ class SegmentScheduler:
                 # seq_outstanding negative and could advance the
                 # watermark over a cut whose siblings are not yet
                 # recorded.
-                seen = {id(s) for _st, s in self._pending}
+                seen = {id(s) for _st, s in self._pending_items()}
                 if self._requeue is not None:
                     stream, batch = self._requeue
                     remaining = [s for s in batch if id(s) not in seen]
@@ -589,7 +618,7 @@ class SegmentScheduler:
                         break
                     if more is not None:
                         self._ingest(more[0], list(more[1]))
-                for stream, seg in self._pending:
+                for stream, seg in list(self._pending_items()):
                     self._streams[stream].carry[seg.key] = "unknown"
                     try:
                         self._record_locked(
@@ -600,7 +629,7 @@ class SegmentScheduler:
                             None)
                     except Exception:  # noqa: BLE001
                         pass
-                self._pending = []
+                self._pending.clear()
                 # Streams the death folds unknown WITHOUT a segment of
                 # their own (all-decided streams, or ones whose causes
                 # the loop above already recorded) materialize the
@@ -647,7 +676,7 @@ class SegmentScheduler:
         taken, self._round_taken = self._round_taken or [], None
         if item is not None:
             stream, batch = item
-            already = {id(s) for st2, s in self._pending
+            already = {id(s) for st2, s in self._pending_items()
                        if st2 == stream}
             remaining = [s for s in batch if id(s) not in already]
             if remaining:
@@ -772,23 +801,21 @@ class SegmentScheduler:
         contribution per round — deferred segments keep strict per-key
         order (a capped-out key blocks its later segments too)."""
         ready: list[tuple] = []
-        seen_keys: set = set()   # (stream, key) seen this pass
         per_stream: dict = {}
-        rest: list[tuple] = []
         cap = self.max_ready_per_stream
-        for stream, seg in sorted(self._pending,
-                                  key=lambda t: t[1].seq):
-            dk = (stream, seg.key)
-            if dk in seen_keys:
-                rest.append((stream, seg))
-                continue
-            seen_keys.add(dk)
+        # One segment per (stream, key) — the FIFO head, which _ingest
+        # keeps seq-minimal. Sorting the HEADS (one per live key, not
+        # one per pending segment) preserves the old lowest-seq-first
+        # pick order when the fairness cap has to defer keys.
+        for dk, dq in sorted(self._pending.items(),
+                             key=lambda kv: kv[1][0].seq):
+            stream = dk[0]
             if cap is not None and per_stream.get(stream, 0) >= cap:
-                rest.append((stream, seg))
                 continue
             per_stream[stream] = per_stream.get(stream, 0) + 1
-            ready.append((stream, seg))
-        self._pending = rest
+            ready.append((stream, dq.popleft()))
+        for dk in [dk for dk, dq in self._pending.items() if not dq]:
+            del self._pending[dk]
         return ready
 
     # -- deciding ------------------------------------------------------------
@@ -1028,7 +1055,7 @@ class SegmentScheduler:
         from ..parallel.batch import check_encoded_batch
 
         results = check_encoded_batch(
-            encs, f=self.batch_f, metrics=self.metrics)
+            encs, f=self.batch_f, mesh=self.mesh, metrics=self.metrics)
         for i, r in enumerate(results):
             if r.get("valid") == "unknown":
                 results[i] = wgl.check_encoded_device(encs[i],

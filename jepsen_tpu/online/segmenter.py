@@ -53,6 +53,30 @@ from ..ops.encode import EncodedHistory, encode_history
 SINGLE_KEY = "__single__"
 
 
+class NonMonotoneHistoryError(ValueError):
+    """A strict-mode segmenter saw a pre-indexed op BELOW the stream's
+    high-water mark.
+
+    The live path silently drops such ops as covered duplicates — the
+    resume protocol makes index < already-observed mean "resubmission",
+    never new work. A fully *recorded* history makes the opposite
+    promise: every op is new, in index order, exactly once — so an
+    out-of-order index there is corrupt input (a mis-merged log, a
+    shuffled ndjson), and dropping it would silently mis-cut the
+    history. Offline ingestion (``jepsen_tpu.offline.plan``) rejects it
+    with this typed error instead.
+    """
+
+    def __init__(self, index: int, floor: int) -> None:
+        self.index = index
+        self.floor = floor
+        super().__init__(
+            f"non-monotone recorded history: op index {index} arrived "
+            f"after index {floor - 1} was already observed (offline "
+            "histories must be in index order; re-sort the recording "
+            "or strip stale duplicates)")
+
+
 @dataclass(frozen=True)
 class KeySegment:
     """One key's slice of one closed segment of the stream.
@@ -87,7 +111,12 @@ class Segmenter:
     segment. Tracks in-flight invocations per process and cuts at
     quiescent points only (see module docstring for the rules)."""
 
-    def __init__(self) -> None:
+    def __init__(self, strict: bool = False) -> None:
+        # Offline/recorded-history mode: a pre-indexed op below the
+        # high-water mark raises NonMonotoneHistoryError instead of
+        # being dropped as a resume-protocol duplicate (see the
+        # exception's docstring for why the two paths must differ).
+        self.strict = strict
         self._buffer: list[Op] = []
         self._open: set = set()  # processes with an open invocation
         self._poisoned = False  # an :info interval is open to end of time
@@ -193,6 +222,9 @@ class Segmenter:
         seen_through = self._next_index  # BEFORE _as_op advances it
         op = self._as_op(op)
         if had_index and op.index < max(self._floor, seen_through):
+            if self.strict:
+                raise NonMonotoneHistoryError(
+                    op.index, max(self._floor, seen_through))
             self.dropped_covered += 1
             self.last_op = None
             return []

@@ -2059,6 +2059,7 @@ def check_history(
     history: History,
     backend: str = "auto",
     host_max_configs: int = 500_000,
+    parallel: Optional[str] = None,
     **kw,
 ) -> dict:
     """Unified entry: dispatch across the three engines.
@@ -2083,9 +2084,26 @@ def check_history(
     stay forcible). This is the seam the Checker layer's
     ``:checker-backend`` option rides (BASELINE dispatch story;
     reference seam checker.clj:49-64).
+
+    ``parallel="segmented"`` routes the whole call through the offline
+    decrease-and-conquer path instead (jepsen_tpu.offline): the history
+    is planned into a (stream × key × segment) DAG and decided through
+    the multi-stream scheduler on ``backend`` as the oracle engine —
+    the only entry that accepts keyed ([k v]) histories directly. The
+    verdict may degrade one-sidedly to "unknown" (typed provenance)
+    relative to the single-driver engines, never flip.
     """
     from . import wgl_c, wgl_host
 
+    if parallel is not None:
+        if parallel != "segmented":
+            raise ValueError(f"unknown parallel mode {parallel!r}")
+        from .. import offline
+
+        engine = backend if backend in offline.ENGINES else "auto"
+        return offline.check_offline(
+            model, history, engine=engine,
+            max_configs=host_max_configs, **kw)
     enc = encode_history(model, history)
     if backend == "competition" and model.device_capable:
         res = check_encoded_competition(enc, **kw)

@@ -50,10 +50,18 @@ def op_json(op: Any) -> dict:
     """One history op as the plain scheduler-dict shape the ingestion
     endpoint parses — INCLUDING the index when assigned (the resume
     protocol's drop floor is index-based; an unindexed resubmission
-    cannot be deduplicated server-side)."""
+    cannot be deduplicated server-side). ``independent`` [k v] values
+    are serialized as ``{"kv": [k, v]}`` — a plain JSON list would be
+    indistinguishable from a vector value, and the server needs the
+    key axis intact to run its P-compositional split (the ingestion
+    seam rehydrates the marker; see ``service._decode_kv``)."""
     if isinstance(op, Op):
+        from .. import independent as ind
+
+        value = ({"kv": [op.value.key, op.value.value]}
+                 if ind.is_tuple(op.value) else op.value)
         m: dict = {"type": op.type, "process": op.process, "f": op.f,
-                   "value": op.value, "time": op.time}
+                   "value": value, "time": op.time}
         if op.index >= 0:
             m["index"] = op.index
         if op.error is not None:

@@ -70,6 +70,25 @@ from ..testing import chaos as _chaos
 LOG = logging.getLogger("jepsen.service")
 
 
+def _decode_kv(op: Any) -> Any:
+    """Rehydrate the wire encoding of ``independent`` [k v] values.
+
+    JSON cannot distinguish a plain vector value from a key/value pair,
+    so ``client.op_json`` serializes KV values as ``{"kv": [k, v]}`` —
+    this (the one ingestion seam both transports share) turns the
+    marker back into the live ``independent.KV``, which the tenant's
+    segmenter needs to run the P-compositional key split server-side
+    (the offline fleet fanout's whole parallelism axis)."""
+    v = op.get("value") if isinstance(op, dict) else None
+    if (isinstance(v, dict) and len(v) == 1
+            and isinstance(v.get("kv"), (list, tuple))
+            and len(v["kv"]) == 2):
+        from .. import independent as ind
+
+        return dict(op, value=ind.KV(*v["kv"]))
+    return op
+
+
 # ---------------------------------------------------------------------------
 # Typed rejections (the ingestion layer maps these to HTTP statuses).
 
@@ -987,7 +1006,7 @@ class Service:
         # ops CAN sit here for seconds — a p99 stamped at pump-feed
         # time would hide exactly the regression the benchcmp gate
         # watches).
-        item = (op, _time.monotonic_ns())
+        item = (_decode_kv(op), _time.monotonic_ns())
         try:
             if self.config.backpressure == "block":
                 t.queue.put(item, timeout=self.config.block_timeout_s)

@@ -64,6 +64,14 @@ LEDGER_METRICS: list[tuple[str, str, str]] = [
      "fleet_p99_decision_latency_s", "lower"),
     ("fleet_min_backend_utilization_pct",
      "fleet_min_backend_utilization_pct", "higher"),
+    # Offline decrease-and-conquer: the segment planner's one-pass
+    # cut cost over the recorded history (growing = planning stopped
+    # being negligible next to deciding) and the end-to-end advantage
+    # over the single-driver serial search ("info": the serial rate is
+    # sample-measured and superlinear in history length, so the ratio
+    # is a machine-dependent lower bound — gated in tests, not here).
+    ("plan_seconds", "plan_seconds", "lower"),
+    ("speedup_vs_serial", "speedup_vs_serial", "info"),
     ("ops", "ops", "info"),
 ]
 
@@ -266,6 +274,14 @@ _BENCH_LEGS: list[tuple[str, Optional[str], str, dict]] = [
     ("max_verified_ops_device_sharded",
      "max_verified_ops_device_sharded", "sharded",
      {"ops": "ops", "value_s": "value_s"}),
+    # Offline decrease-and-conquer: plan() → drive() over a recorded
+    # ≥1M-op keyed history (segment × carried-state co-batching).
+    ("offline_segmented", "offline_segmented", "auto",
+     {"value_s": "decide_seconds", "ops_per_s": "ops_per_s",
+      "plan_seconds": "plan_seconds",
+      "speedup_vs_serial": "speedup_vs_serial",
+      "utilization_pct": "utilization_pct",
+      "ops": "n_ops", "verdict": "valid"}),
 ]
 
 

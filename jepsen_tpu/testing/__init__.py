@@ -656,6 +656,63 @@ def chunked_register_history(
     return History(ops, reindex=True)
 
 
+def concurrent_register_history(
+    rng: random.Random,
+    n_ops: int = 10_000,
+    n_writers: int = 8,
+    read_every: int = 1,
+) -> History:
+    """A linearizable-by-construction register history that is
+    genuinely CONCURRENT inside every segment — the offline planner's
+    decide-heavy bench/test vehicle.
+
+    Each round opens ``n_writers`` writes of distinct fresh values with
+    every invocation issued before any completion (all pairs overlap),
+    closes them in shuffled order, and — after the round's quiescent
+    point — issues one sequential read returning one of the round's
+    values. Writes commute, so the round linearizes in any order
+    (always valid), but the checker must consider all ``2^n_writers``
+    interleavings, and the round's feasible end-state set is the FULL
+    ``{v_1..v_n}`` — so the following read segment fans into
+    ``n_writers`` carried-state members. This makes decision cost per
+    op roughly ``n_writers · 2^n_writers`` host-BFS expansions —
+    decide-dominant where :func:`chunked_register_history` is
+    transport-dominant — which is exactly the regime the fleet fanout's
+    ``speedup_vs_serial`` exists to measure. ``read_every=k`` reads
+    after every k-th round (fewer carry handoffs, same concurrency).
+
+    Seeding an invalid variant: flip one ok-read's value to something
+    never written (``perturb_history`` does this) — the read's value
+    leaves the carried end-state set, so the violation is definite.
+    """
+    if n_writers < 1:
+        raise ValueError("n_writers must be >= 1")
+    ops: list[Op] = []
+    t = 0
+    val = 0
+    rounds = 0
+    while len(ops) < n_ops:
+        vals = [val + i for i in range(n_writers)]
+        val += n_writers
+        order = list(range(n_writers))
+        rng.shuffle(order)
+        for p in order:
+            t += 1
+            ops.append(Op("invoke", p, "write", vals[p], time=t))
+        rng.shuffle(order)
+        for p in order:
+            t += 1
+            ops.append(Op("ok", p, "write", vals[p], time=t))
+        rounds += 1
+        if read_every and rounds % read_every == 0:
+            seen = rng.choice(vals)
+            t += 1
+            ops.append(Op("invoke", 0, "read", None, time=t))
+            t += 1
+            ops.append(Op("ok", 0, "read", seen, time=t))
+    return History(ops, reindex=True)
+
+
 def perturb_history(rng: random.Random, history: History,
                     within: float = 1.0) -> History:
     """Mutate one completion value — usually breaking linearizability.

@@ -173,7 +173,8 @@ td, th { border: 1px solid #ccc; padding: 0.3em 0.8em; text-align: left; }
 # tracing + profiling sinks; everything else is reachable through the
 # file listing).
 _TELEMETRY_FILES = ("metrics.jsonl", "metrics.prom", "spans.jsonl",
-                    "profile.json", "flightrecord.json", "online.json")
+                    "profile.json", "flightrecord.json", "online.json",
+                    "offline.json")
 
 # Jepsen-parity plot/timeline artifacts (checker/perf.py writes the
 # pngs, checker/timeline.py the html) — they existed in the store but
@@ -704,6 +705,39 @@ def _online_section(doc: dict) -> str:
     return head + table
 
 
+def _offline_section(doc: dict) -> str:
+    """Render an offline (segment-planner) result — the JSON
+    ``python -m jepsen_tpu.offline -o .../offline.json`` writes (see
+    docs/offline.md): verdict, plan shape, and per-stream decide
+    attribution."""
+    v = doc.get("valid")
+    vs = {True: "valid", False: "INVALID",
+          "unknown": "unknown"}.get(v, str(v))
+    cls = {True: "valid-true", False: "valid-false",
+           "unknown": "valid-unknown"}.get(v, "")
+    plan = doc.get("plan") or {}
+    util = (doc.get("utilization") or {}).get("mean_utilization_pct")
+    busy = util if util is not None else doc.get("busy_pct")
+    head = (
+        f'<p class="{cls}">offline verdict: <b>{html.escape(vs)}</b> · '
+        f"{doc.get('n_ops')} ops · engine {doc.get('engine')} · "
+        f"{plan.get('n_items', '—')} items / "
+        f"{plan.get('n_streams', '—')} streams · "
+        f"plan {plan.get('plan_seconds', '—')} s · "
+        f"wall {doc.get('wall_s', '—')} s"
+        + (f" · busy {busy}%" if busy is not None else "") + "</p>")
+    rows = "".join(
+        "<tr>" + "".join(
+            f"<td>{html.escape(str((row or {}).get(k, '—')))}</td>"
+            for k in ("valid", "segments_decided", "decide_s"))
+        + f"<td>{html.escape(name)}</td></tr>"
+        for name, row in sorted((doc.get("streams") or {}).items()))
+    table = (
+        "<table><tr><th>valid</th><th>segments</th><th>decide s</th>"
+        "<th>stream</th></tr>" + rows + "</table>" if rows else "")
+    return head + table
+
+
 def _online_page(root: Path) -> str:
     sections = []
     tests = store.tests(root=root)
@@ -711,23 +745,39 @@ def _online_page(root: Path) -> str:
         for start in sorted(tests[name], reverse=True):
             run = tests[name][start]
             f = run / "online.json"
-            if not f.exists():
+            off = run / "offline.json"
+            if not f.exists() and not off.exists():
                 continue
-            try:
-                doc = json.loads(f.read_text())
-            except Exception:
-                doc = None
-            sections.append(
+            part = (
                 f'<h2><a href="/files/{name}/{start}/">'
-                f"{html.escape(name)} / {html.escape(start)}</a></h2>"
-                f'<p><a href="/files/{name}/{start}/online.json">'
-                "online.json</a></p>"
-                + (_online_section(doc) if doc is not None
-                   else "<p>(unparseable online.json)</p>"))
+                f"{html.escape(name)} / {html.escape(start)}</a></h2>")
+            if f.exists():
+                try:
+                    doc = json.loads(f.read_text())
+                except Exception:
+                    doc = None
+                part += (
+                    f'<p><a href="/files/{name}/{start}/online.json">'
+                    "online.json</a></p>"
+                    + (_online_section(doc) if doc is not None
+                       else "<p>(unparseable online.json)</p>"))
+            if off.exists():
+                try:
+                    odoc = json.loads(off.read_text())
+                except Exception:
+                    odoc = None
+                part += (
+                    f'<p><a href="/files/{name}/{start}/offline.json">'
+                    "offline.json</a></p>"
+                    + (_offline_section(odoc) if odoc is not None
+                       else "<p>(unparseable offline.json)</p>"))
+            sections.append(part)
     if not sections:
         sections.append(
             "<p>No runs with online monitoring yet — run a test with "
-            "<code>--online</code>.</p>")
+            "<code>--online</code>, or decide a recording with "
+            "<code>python -m jepsen_tpu.offline ... -o "
+            "store/&lt;test&gt;/&lt;start&gt;/offline.json</code>.</p>")
     return (
         f"<html><head><title>Jepsen online monitor</title>"
         f"<style>{_STYLE}</style></head>"

@@ -60,6 +60,13 @@ def pytest_configure(config):
         "e2e is additionally marked slow")
     config.addinivalue_line(
         "markers",
+        "offline: offline segment-planner tests (jepsen_tpu.offline "
+        "— plan/drive/fanout over fully recorded histories; select "
+        "with -m offline). The small-history differential matrix "
+        "stays tier-1; the 1M-op scale pin and the real-process "
+        "fleet-fanout e2e are additionally marked slow")
+    config.addinivalue_line(
+        "markers",
         "fleet: fleet observability tests (jepsen_tpu.telemetry."
         "fleet — metrics federation, SLO burn rates, cross-process "
         "trace propagation; select with -m fleet). Closed-form merge "

@@ -158,6 +158,37 @@ class TestRulesClosedForm:
         assert advisor.advise({"service_router": {"backend_loads": {
             "b0": {"load": 9000.0}}}}) == []
 
+    def test_segment_plan_skew_rule(self):
+        # The offline planner's largest (stream × key × segment) item
+        # past 2x the mean per-worker share: the serial tail floors
+        # the wall clock — fires with the cut-finer advice.
+        skew = {"offline_segmented": {"plan": {
+            "largest_item_ops": 5000, "mean_worker_share_ops": 1000.0,
+            "largest_item_key": "'k3'", "n_streams": 4}}}
+        recs = advisor.advise(skew)
+        assert ids(recs) == ["segment_plan_skew"]
+        ev = recs[0]["evidence"]
+        assert ev["ratio"] == 5.0
+        assert ev["largest_item_key"] == "'k3'"
+        # At/below the 2x ratio: balanced enough, quiet.
+        assert advisor.advise({"offline_segmented": {"plan": {
+            "largest_item_ops": 2000,
+            "mean_worker_share_ops": 1000.0}}}) == []
+        # A zero share (empty plan) must not divide — quiet.
+        assert advisor.advise({"offline_segmented": {"plan": {
+            "largest_item_ops": 10,
+            "mean_worker_share_ops": 0}}}) == []
+        # Collector keeps the MOST skewed block, wherever nested.
+        doc = {
+            "offline_segmented": {"plan": {
+                "largest_item_ops": 100,
+                "mean_worker_share_ops": 100.0},
+                "scale_10m": {"plan": {
+                    "largest_item_ops": 900,
+                    "mean_worker_share_ops": 100.0}}}}
+        worst = advisor.collect_plan_skew(doc)
+        assert worst["largest_item_ops"] == 900
+
     def test_respawn_backend_rule(self):
         # Below configured N with the flap circuit tripped: fires.
         gave_up = {"service_router": {"fleet": {

@@ -1,0 +1,476 @@
+"""Offline decrease-and-conquer planner tests (jepsen_tpu.offline).
+
+The spine is the **differential contract**: for every matrix history,
+the segmented-offline verdict equals the single-driver verdict, and any
+degradation is one-sided — a definite single-driver verdict may become
+"unknown" (with typed provenance causes from the closed taxonomy, never
+``unattributed``) but can never flip True<->False. The matrix runs
+tier-1 on small decide-heavy histories; the 1M-op scale pin and the
+real-process fleet fanout ride behind the ``slow`` marker.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import subprocess
+import sys
+import time
+
+import pytest
+
+from jepsen_tpu import independent as ind
+from jepsen_tpu import offline
+from jepsen_tpu.checker import merge_valid
+from jepsen_tpu.checker.provenance import TAXONOMY
+from jepsen_tpu.history import History, Op
+from jepsen_tpu.models import (CasRegister, ValueTable, known_models,
+                               model_by_name)
+from jepsen_tpu.online.segmenter import (NonMonotoneHistoryError,
+                                         Segmenter)
+from jepsen_tpu.ops import wgl
+from jepsen_tpu.testing import (chaos, concurrent_register_history,
+                                perturb_history)
+
+pytestmark = pytest.mark.offline
+
+
+def model():
+    return CasRegister(init=0)
+
+
+def keyed_history(seed, n_ops=360, n_keys=3, n_writers=4,
+                  invalid=False) -> History:
+    """n_keys independent concurrent-register sub-histories wrapped as
+    [k v] values and interleaved by time — the planner's fan-out
+    vehicle. ``invalid=True`` perturbs one read of key 0 (definite
+    violation on that key; the fold must surface it as False)."""
+    ops = []
+    per_key = max(n_writers * 2, n_ops // n_keys)
+    for i in range(n_keys):
+        h = concurrent_register_history(
+            random.Random(seed + i), n_ops=per_key, n_writers=n_writers)
+        if invalid and i == 0:
+            h = perturb_history(random.Random(seed + 100), h, within=0.5)
+        for op in h:
+            ops.append(op.with_(process=op.process + 1000 * i,
+                                value=ind.KV(f"k{i}", op.value),
+                                index=-1))
+    ops.sort(key=lambda o: o.time)
+    return History(ops, reindex=True)
+
+
+def poisoned_tail(h) -> History:
+    """Flip the history's last ok write to :info so the tail segment is
+    a real TERMINAL segment — terminal segments are what cross the
+    scheduler's oracle (and therefore the ``device.dispatch`` chaos
+    seam); a fully-quiesced history decides entirely in the carry
+    enumerator and never dispatches."""
+    ops = list(h)
+    k = max(j for j in range(len(ops))
+            if ops[j].is_ok and ops[j].f == "write")
+    ops[k] = ops[k].with_(type="info")
+    return History(ops, reindex=True)
+
+
+def single_driver_verdict(h, max_configs=500_000):
+    """The differential baseline: one driver, host oracle; keyed
+    histories decide per key through independent.subhistory and fold
+    through merge_valid — exactly what the offline DAG must match."""
+    keys = sorted({op.value.key for op in h if ind.is_tuple(op.value)})
+    if not keys:
+        return wgl.check_history(model(), h, backend="host",
+                                 host_max_configs=max_configs)["valid"]
+    return merge_valid(
+        wgl.check_history(model(), ind.subhistory(k, h), backend="host",
+                          host_max_configs=max_configs)["valid"]
+        for k in keys)
+
+
+def assert_typed_provenance(res):
+    """Unknown verdicts must carry provenance whose causes all come
+    from the closed taxonomy — ``unattributed`` is the backstop code
+    that must never actually fire."""
+    prov = res.get("provenance")
+    if res.get("valid") == "unknown":
+        assert prov, f"unknown verdict without provenance: {res}"
+    if prov is not None:
+        causes = prov.get("causes") or {}
+        assert causes, f"provenance block without causes: {prov}"
+        unknown_codes = set(causes) - set(TAXONOMY)
+        assert not unknown_codes, \
+            f"causes outside the closed taxonomy: {unknown_codes}"
+        assert "unattributed" not in causes
+
+
+# ---------------------------------------------------------------------------
+# Satellite 1: strict offline ingestion
+
+
+class TestStrictIngestion:
+    def swapped(self, seed=3):
+        ops = list(concurrent_register_history(
+            random.Random(seed), n_ops=60, n_writers=3))
+        ops[5], ops[20] = ops[20], ops[5]
+        return ops
+
+    def test_strict_segmenter_rejects_non_monotone(self):
+        seg = Segmenter(strict=True)
+        with pytest.raises(NonMonotoneHistoryError) as ei:
+            for op in self.swapped():
+                seg.offer(op)
+        assert ei.value.index < ei.value.floor
+        assert "index order" in str(ei.value)
+
+    def test_live_segmenter_drops_the_same_input_silently(self):
+        seg = Segmenter()  # the resume-protocol path: drop, don't raise
+        for op in self.swapped():
+            seg.offer(op)
+        seg.finish()
+
+    def test_plan_rejects_shuffled_recordings(self):
+        with pytest.raises(NonMonotoneHistoryError):
+            offline.plan(self.swapped())
+
+    def test_plan_stamps_unindexed_ndjson_rows(self):
+        rows, t = [], 0
+        for i in range(12):
+            t += 1
+            rows.append({"type": "invoke", "process": 0, "f": "write",
+                         "value": i, "time": t})
+            t += 1
+            rows.append({"type": "ok", "process": 0, "f": "write",
+                         "value": i, "time": t})
+        p = offline.plan(rows, streams=2)
+        assert p.n_ops == len(rows)
+        res = offline.drive(p, model(), engine="host")
+        assert res["valid"] is True
+
+
+# ---------------------------------------------------------------------------
+# Planner shape: the static DAG's structural invariants
+
+
+class TestPlannerShape:
+    def test_stream_seqs_dense_keys_partitioned_carry_chained(self):
+        h = keyed_history(7, n_ops=480, n_keys=4, n_writers=3)
+        p = offline.plan(h, streams=2)
+        assert p.n_streams == 2
+        assert sum(len(ops) for ops in p.stream_ops.values()) == p.n_ops
+        for name, items in p.streams.items():
+            seqs = [it.seq for it in items]
+            assert seqs == sorted(seqs)
+            assert sorted(set(seqs)) == list(range(max(seqs) + 1))
+            # Keys live wholly on their assigned stream.
+            for it in items:
+                assert p.key_to_stream[it.key] == name
+            # Carry edges: each key's chain links to its predecessor.
+            last = {}
+            for it in items:
+                assert it.depends_on == last.get(it.key)
+                last[it.key] = it.seq
+        # stream_ops retain the [k v] wrapping for the fleet fanout.
+        for name, ops in p.stream_ops.items():
+            for op in ops:
+                assert p.key_to_stream[op.value.key] == name
+
+    def test_width_clamps_to_one_for_unkeyed_histories(self):
+        h = concurrent_register_history(random.Random(5), n_ops=80,
+                                        n_writers=3)
+        p = offline.plan(h, streams=4)
+        assert p.n_streams == 1
+
+    def test_no_quiescence_history_plans_as_one_item(self):
+        # One giant round, no read: the only cut is the finish() flush.
+        h = concurrent_register_history(random.Random(9), n_ops=16,
+                                        n_writers=8, read_every=0)
+        p = offline.plan(h, streams=4)
+        assert p.n_items == 1
+        assert p.items[0].depends_on is None
+
+    def test_stats_feed_the_advisor_skew_rule(self):
+        p = offline.plan(keyed_history(11, n_ops=360, n_keys=3),
+                         streams=3)
+        s = p.stats()
+        assert s["largest_item_ops"] > 0
+        assert s["mean_worker_share_ops"] > 0
+        assert set(s["stream_ops"]) == {str(n) for n in p.streams}
+
+    def test_mixed_keyed_keyless_degrades_typed(self):
+        ops = list(keyed_history(13, n_ops=120, n_keys=2, n_writers=3))
+        t = max(op.time for op in ops)
+        ops.append(Op("invoke", 99, "write", 999, time=t + 1))
+        ops.append(Op("ok", 99, "write", 999, time=t + 2))
+        p = offline.plan(History(ops, reindex=True), streams=2)
+        assert p.mixed
+        res = offline.drive(p, model(), engine="host")
+        assert res["valid"] == "unknown"
+        assert "mixed_keys" in res["provenance"]["causes"]
+        assert_typed_provenance(res)
+
+
+# ---------------------------------------------------------------------------
+# The differential matrix (tier-1): segmented verdict == single driver
+
+
+MATRIX = {
+    "valid_unkeyed": lambda: concurrent_register_history(
+        random.Random(21), n_ops=200, n_writers=4),
+    "invalid_unkeyed": lambda: perturb_history(
+        random.Random(22), concurrent_register_history(
+            random.Random(21), n_ops=200, n_writers=4), within=0.5),
+    "valid_keyed": lambda: keyed_history(23, n_ops=360, n_keys=3),
+    "invalid_keyed": lambda: keyed_history(24, n_ops=360, n_keys=3,
+                                           invalid=True),
+    "no_quiescence": lambda: concurrent_register_history(
+        random.Random(25), n_ops=20, n_writers=10, read_every=0),
+}
+
+
+class TestDifferential:
+    @pytest.mark.parametrize("name", sorted(MATRIX))
+    def test_matrix_host_engine(self, name):
+        h = MATRIX[name]()
+        base = single_driver_verdict(h)
+        assert base in (True, False)
+        res = offline.check_offline(model(), h, streams=4,
+                                    engine="host")
+        assert res["parallel"] == "segmented"
+        assert res["valid"] == base
+        assert res["segments_decided"] >= 1
+        assert_typed_provenance(res)
+
+    @pytest.mark.parametrize("name", ["valid_keyed", "invalid_keyed"])
+    def test_matrix_auto_engine(self, name):
+        h = MATRIX[name]()
+        base = single_driver_verdict(h)
+        res = offline.check_offline(model(), h, streams=4,
+                                    engine="auto")
+        # One-sided: auto may degrade to a typed unknown, never flip.
+        assert res["valid"] in (base, "unknown")
+        assert_typed_provenance(res)
+
+    def test_overflow_degrades_one_sided_and_typed(self):
+        h = MATRIX["valid_keyed"]()
+        base = single_driver_verdict(h)
+        res = offline.check_offline(model(), h, streams=4,
+                                    engine="host", max_configs=1)
+        # A starved config budget can only push the verdict toward
+        # unknown — with causes from the closed set — never flip it.
+        assert res["valid"] in (base, "unknown")
+        assert res["valid"] is not (not base)
+        assert res["valid"] == "unknown"  # budget of 1 must starve
+        assert_typed_provenance(res)
+
+    def test_check_history_parallel_segmented_surface(self):
+        h = keyed_history(29, n_ops=240, n_keys=2, n_writers=3)
+        res = wgl.check_history(model(), h, parallel="segmented",
+                                backend="host", streams=2)
+        assert res["parallel"] == "segmented"
+        assert res["valid"] is True
+        with pytest.raises(ValueError):
+            wgl.check_history(model(), h, parallel="bisect")
+
+    def test_linearizable_checker_segmented_backend(self):
+        from jepsen_tpu import checker as C
+
+        h = keyed_history(31, n_ops=240, n_keys=2, n_writers=3)
+        chk = C.linearizable(model=model(), backend="segmented")
+        res = chk.check({}, h, {})
+        assert res["valid"] is True
+        assert res["parallel"] == "segmented"
+
+
+# ---------------------------------------------------------------------------
+# Chaos pin: injected oracle faults stay one-sided with typed causes
+
+
+@pytest.mark.chaos
+class TestChaosPin:
+    def test_dispatch_fault_never_flips_the_verdict(self):
+        h = poisoned_tail(keyed_history(17, n_ops=360, n_keys=3))
+        base = single_driver_verdict(h)
+        assert base is True
+        with chaos.inject("device.dispatch", on_call=1):
+            res = offline.check_offline(model(), h, streams=3,
+                                        engine="host")
+            assert chaos.fired("device.dispatch") == 1
+        assert res["valid"] in (True, "unknown")
+        assert_typed_provenance(res)
+
+    def test_dispatch_fault_on_invalid_history_stays_one_sided(self):
+        h = poisoned_tail(
+            keyed_history(18, n_ops=360, n_keys=3, invalid=True))
+        assert single_driver_verdict(h) is False
+        with chaos.inject("device.dispatch", on_call=2):
+            res = offline.check_offline(model(), h, streams=3,
+                                        engine="host")
+        assert res["valid"] in (False, "unknown")
+        assert_typed_provenance(res)
+
+
+# ---------------------------------------------------------------------------
+# Fleet fanout, in-process transport (tier-1)
+
+
+class TestFanoutServices:
+    def test_two_backends_fold_to_the_plan_verdict(self):
+        h = keyed_history(19, n_ops=320, n_keys=4, n_writers=3)
+        p = offline.plan(h, streams=2)
+        out = offline.fanout_services(p, model(), backends=2,
+                                      engine="host")
+        assert out["valid"] is True
+        assert out["backends"] == 2
+        expect = {f"offline-{s}" for s in p.stream_ops
+                  if p.stream_ops[s]}
+        assert set(out["tenants"]) == expect
+        assert_typed_provenance(out)
+
+    def test_two_backends_surface_a_seeded_violation(self):
+        h = keyed_history(20, n_ops=320, n_keys=4, n_writers=3,
+                          invalid=True)
+        p = offline.plan(h, streams=2)
+        out = offline.fanout_services(p, model(), backends=2,
+                                      engine="host")
+        assert out["valid"] is False
+
+
+# ---------------------------------------------------------------------------
+# Satellite 3: encode_state/decode_state round-trips across all models
+
+
+def build_model(name):
+    if name == "multi-register":
+        return model_by_name(name, init={"x": 1, "y": 2})
+    return model_by_name(name)
+
+
+def noisy_table(n):
+    t = ValueTable()
+    for i in range(n):
+        t.intern(f"noise-{i}")
+    return t
+
+
+class TestStateCodecs:
+    @pytest.mark.parametrize("name", known_models())
+    def test_round_trip_and_rebuilt_table_reintern(self, name):
+        m = build_model(name)
+        t1 = noisy_table(5)
+        lanes = m.init_state(t1)
+        if m.device_capable:  # queues carry variable-length host state
+            assert len(lanes) == m.state_width
+        decoded = m.decode_state(lanes, t1)
+        # decode∘encode is the identity on semantic states.
+        assert m.decode_state(m.encode_state(decoded, t1), t1) == decoded
+        # The carry contract: the SAME semantic state re-encoded into a
+        # REBUILT table (different intern order) decodes identically.
+        t2 = noisy_table(11)
+        lanes2 = m.encode_state(decoded, t2)
+        assert m.decode_state(lanes2, t2) == decoded
+
+    @pytest.mark.parametrize("name", ["cas-register", "register"])
+    def test_register_lanes_are_table_relative(self, name):
+        m = build_model(name)
+        decoded = ("payload",)
+        t1, t2 = noisy_table(5), noisy_table(0)
+        l1, l2 = m.encode_state(decoded, t1), m.encode_state(decoded, t2)
+        assert l1 != l2  # ids shifted by the tables' intern history
+        assert m.decode_state(l1, t1) == decoded
+        assert m.decode_state(l2, t2) == decoded
+
+    def test_owner_aware_mutex_owner_round_trips(self):
+        m = build_model("owner-aware-mutex")
+        held = (("process", 3),)
+        t1, t2 = noisy_table(4), noisy_table(9)
+        for t in (t1, t2):
+            lanes = m.encode_state(held, t)
+            assert lanes[0] != 0  # 0 is the free sentinel
+            assert m.decode_state(lanes, t) == held
+        assert m.decode_state(m.encode_state((None,), t1), t1) == (None,)
+
+    @pytest.mark.parametrize("name", ["fifo-queue", "unordered-queue"])
+    def test_queue_values_round_trip(self, name):
+        m = build_model(name)
+        decoded = m.decode_state(m.init_state(noisy_table(0)),
+                                 noisy_table(0))
+        t1, t2 = noisy_table(3), noisy_table(7)
+        for t in (t1, t2):
+            assert m.decode_state(m.encode_state(decoded, t), t) \
+                == decoded
+
+    def test_fenced_mutex_mixed_lanes(self):
+        m = build_model("fenced-mutex")
+        decoded = (("process", 1), 42)
+        t = noisy_table(6)
+        assert m.decode_state(m.encode_state(decoded, t), t) == decoded
+        t2 = noisy_table(2)
+        assert m.decode_state(m.encode_state(decoded, t2), t2) == decoded
+
+
+# ---------------------------------------------------------------------------
+# Slow: the scale pin, the real-process fleet e2e, and the CLI
+
+
+@pytest.mark.slow
+class TestScale:
+    def test_1m_op_scale_pin_speedup_vs_serial(self):
+        n = int(os.environ.get("JEPSEN_OFFLINE_SCALE_OPS", "1000000"))
+        h = keyed_history(41, n_ops=n, n_keys=8, n_writers=5)
+        # Serial baseline: the single-driver host oracle on a bounded
+        # sample. Its per-op cost GROWS with history length (value
+        # table, config fan-out), so the sampled rate OVERSTATES serial
+        # throughput and the asserted speedup is a lower bound.
+        sample = concurrent_register_history(random.Random(42),
+                                             n_ops=1200, n_writers=5)
+        t0 = time.perf_counter()
+        base = wgl.check_history(model(), sample, backend="host")
+        serial_rate = len(sample) / (time.perf_counter() - t0)
+        assert base["valid"] is True
+        p = offline.plan(h, streams=4)
+        assert p.n_streams >= 2  # the pin requires real fan-out width
+        run = offline.drive(p, model(), engine="auto", timeout=3600)
+        assert run["valid"] is True
+        rate = p.n_ops / (p.plan_seconds + run["wall_s"])
+        assert rate / serial_rate > 1.5, \
+            (f"segmented {rate:.0f} ops/s vs serial "
+             f"{serial_rate:.0f} ops/s")
+
+    def test_fanout_fleet_real_processes(self):
+        h = keyed_history(43, n_ops=2400, n_keys=4, n_writers=4)
+        p = offline.plan(h, streams=2)
+        out = offline.fanout_fleet(p, backends=2, model="cas-register",
+                                   engine="host")
+        assert out["valid"] is True
+        assert out["backends"] == 2
+        expect = {f"offline-{s}" for s in p.stream_ops
+                  if p.stream_ops[s]}
+        assert set(out["tenants"]) == expect
+        assert out["backend_loads"]  # the router's per-backend scrape
+        assert_typed_provenance(out)
+
+    def test_cli_decides_an_ndjson_recording(self, tmp_path):
+        rows, t = [], 0
+        for i in range(40):
+            t += 1
+            rows.append({"type": "invoke", "process": i % 3,
+                         "f": "write", "value": i, "time": t})
+            t += 1
+            rows.append({"type": "ok", "process": i % 3, "f": "write",
+                         "value": i, "time": t})
+        src = tmp_path / "history.ndjson"
+        src.write_text("".join(json.dumps(r) + "\n" for r in rows))
+        dst = tmp_path / "out.json"
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        proc = subprocess.run(
+            [sys.executable, "-m", "jepsen_tpu.offline", str(src),
+             "--model", "cas-register", "--engine", "host",
+             "-o", str(dst)],
+            cwd="/root/repo", env=env, capture_output=True, text=True,
+            timeout=300)
+        assert proc.returncode == 0, proc.stderr
+        res = json.loads(dst.read_text())
+        assert res["valid"] is True
+        assert res["parallel"] == "segmented"
+        assert res["n_ops"] == len(rows)
