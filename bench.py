@@ -524,9 +524,14 @@ def main() -> int:
                 base[k] = base[k].with_(type="info")
                 histories[f"tenant-{i}"] = _History(base, reindex=True)
             sreg = _SReg()
+            # alerts=True: the live alerting plane evaluates its rule
+            # catalogue on the pump cadence for the whole leg — the
+            # leg then asserts the chaos contract (fired ⊆ the armed
+            # seam's EXPECTED_ALERTS, canary never) and prices the
+            # evaluation overhead against the wall clock.
             svc = Service(model, engine="host", metrics=sreg,
                           register_live=False, ledger=False,
-                          name="bench-service")
+                          name="bench-service", alerts=True)
             t0 = time.perf_counter()
 
             # The resume-aware client (jepsen_tpu/service/client.py)
@@ -581,9 +586,93 @@ def main() -> int:
                 "failover_rounds": sum(
                     1 for ev in rounds if ev.get("failover")),
             }
+            # Chaos alert contract (telemetry/alerts.py): the armed
+            # seam may raise ONLY its expected alerts, and the
+            # unattributed-cause canary may NEVER fire. The overhead
+            # gate prices rule evaluation against the leg's wall
+            # clock (< 2% or the plane is too expensive to keep on).
+            from jepsen_tpu.telemetry import alerts as _alerts_mod
+            eng = svc.alert_engine
+            fired = eng.fired_rules() if eng is not None else set()
+            expected = _alerts_mod.EXPECTED_ALERTS["device.dispatch"]
+            overhead = (100.0 * eng.eval_seconds / t_total
+                        if eng is not None and t_total > 0 else None)
+            out["service_streams"].update({
+                "alerts_fired": sorted(fired),
+                "alerts_unexpected": sorted(fired - expected),
+                "alerts_ok": (fired <= expected
+                              and "unattributed_causes" not in fired),
+                "alert_evaluations":
+                    eng.evaluations if eng is not None else 0,
+                "alert_eval_overhead_pct": (
+                    round(overhead, 4) if overhead is not None
+                    else None),
+            })
             if fin.get("provenance"):
                 # Service-wide why-unknown Pareto (docs/verdicts.md).
                 out["service_streams"]["provenance"] = fin["provenance"]
+
+            # Detection latency micro-bench: a small journaled
+            # service runs CLEAN first (zero alerts — the false-
+            # positive half of the chaos contract), then the
+            # journal.fsync seam is armed and the clock runs from the
+            # first swallowed append to the pump evaluation that
+            # flips `journal_errors` to firing.
+            try:
+                import tempfile as _tempfile
+                _chaos.reset()
+                det_dir = _tempfile.mkdtemp(prefix="jepsen-alert-det-")
+                det_hist = _History(list(chunked_register_history(
+                    random.Random(3199), n_ops=400, n_procs=4,
+                    chunk_ops=60)), reindex=True)
+                det_svc = Service(model, engine="host",
+                                  metrics=_SReg(),
+                                  register_live=False, ledger=False,
+                                  name="bench-alert-det",
+                                  journal_dir=det_dir, alerts=True)
+                rows = list(det_hist)
+                half = len(rows) // 2
+                InProcessServiceClient(det_svc, "det").feed(
+                    _History(rows[:half], reindex=True))
+                det_svc.flush(60.0)
+                det_eng = det_svc.alert_engine
+                # The pump thread owns evaluation (the engine is not
+                # locked); give it one full cadence past the clean
+                # feed, then read the false-positive half of the
+                # contract off the fired set.
+                time.sleep(1.5 * _alerts_mod.ALERT_EVAL_INTERVAL_S)
+                clean_zero = not det_eng.fired_rules()
+                t_inj = time.perf_counter()
+                detect_s = None
+                with _chaos.inject("journal.fsync", mode="raise",
+                                   times=1_000_000):
+                    InProcessServiceClient(det_svc, "det").feed(
+                        _History(rows, reindex=True))
+                    det_svc.flush(60.0)
+                    deadline = time.monotonic() + 30.0
+                    while time.monotonic() < deadline:
+                        if "journal_errors" in det_eng.firing():
+                            detect_s = time.perf_counter() - t_inj
+                            break
+                        time.sleep(0.02)
+                det_svc.drain(timeout=60)
+                det_fired = det_eng.fired_rules()
+                det_exp = _alerts_mod.EXPECTED_ALERTS["journal.fsync"]
+                out["service_streams"].update({
+                    "alerts_clean_zero": clean_zero,
+                    "alert_detection_seconds": (
+                        round(detect_s, 4)
+                        if detect_s is not None else None),
+                    "alert_detection_ok": (
+                        detect_s is not None
+                        and det_fired <= det_exp
+                        and "unattributed_causes" not in det_fired),
+                })
+            except Exception as e:  # noqa: BLE001
+                out["service_streams"]["alert_detection_error"] = \
+                    f"{type(e).__name__}: {e}"
+            finally:
+                _chaos.reset()
         except Exception as e:  # noqa: BLE001
             out["service_streams"] = {"error": f"{type(e).__name__}: {e}"}
         finally:
@@ -620,11 +709,15 @@ def main() -> int:
                 backends = _jrouter.spawn_backends(
                     2, journal_root=tmpd, engine="host", metrics=rreg,
                     failure_threshold=2, cooldown_s=60.0, env=env)
+                # alerts=True: the router's health loop evaluates the
+                # rule catalogue over the FEDERATED totals each tick;
+                # the leg asserts the kill raises only the fleet seam's
+                # expected alerts (and the canary never).
                 router = _jrouter.Router(
                     backends, metrics=rreg, name="bench-router",
                     register_live=False, probe_interval_s=0.1,
                     failure_threshold=2, migrate_retry_after_s=0.1,
-                    rebalance=False)
+                    rebalance=False, alerts=True)
                 rsrv = _jrouter.server(router, port=0)
                 _threading.Thread(target=rsrv.serve_forever,
                                   daemon=True).start()
@@ -775,6 +868,24 @@ def main() -> int:
                             "federation") or {}).items()},
                     "fleet": r_stats["fleet"],
                 }
+                # Chaos alert contract on the fleet seam: the kill-9
+                # may raise only the fleet set (scrape_stale /
+                # slo_burn / respawn_gave_up / latency_tail /
+                # perf_regression), never the canary.
+                from jepsen_tpu.telemetry import alerts as _alerts_mod
+                aeng = router.alert_engine
+                afired = (aeng.fired_rules()
+                          if aeng is not None else set())
+                aexp = _alerts_mod.EXPECTED_ALERTS["backend.process"]
+                out["service_router"].update({
+                    "alerts_fired": sorted(afired),
+                    "alerts_unexpected": sorted(afired - aexp),
+                    "alerts_ok": (afired <= aexp
+                                  and "unattributed_causes"
+                                  not in afired),
+                    "alert_evaluations":
+                        aeng.evaluations if aeng is not None else 0,
+                })
                 if fin.get("provenance"):
                     out["service_router"]["provenance"] = \
                         fin["provenance"]

@@ -38,26 +38,31 @@ import sys
 from typing import Any, Callable, Optional
 
 from .checker import provenance as _prov
+# The alerting plane (telemetry/alerts.py) owns the operational
+# predicates and their thresholds; the advisor's rules and the live
+# alert rules MUST agree, so both import from the single source.
+from .telemetry.alerts import (
+    SLO_FAST_BURN_THRESHOLD,
+    SLO_SLOW_BURN_THRESHOLD,
+    TAIL_RATIO_THRESHOLD,
+    journal_gap_count,
+    respawn_capacity_deficit,
+    slo_hot_windows,
+    stale_backend_list,
+    tail_is_pathological,
+)
 
 # Gap-attribution share past which an idle class is "dominating" a
 # leg's device timeline and worth acting on.
 GAP_SHARE_THRESHOLD = 0.25
 # Provenance share past which one cause code dominates the unknowns.
 CAUSE_SHARE_THRESHOLD = 0.5
-# p99/p50 decision-latency ratio past which the tail is pathological.
-TAIL_RATIO_THRESHOLD = 20.0
 # Per-backend load skew (router scale-out): the loaded backend must
 # exceed BOTH an absolute floor and this ratio × the least-loaded one
 # before a rebalance migration is worth its outage window — the same
 # thresholds service/router.py's plan_rebalance defaults to.
 REBALANCE_MIN_LOAD = 256.0
 REBALANCE_SKEW_RATIO = 4.0
-# SLO burn-rate alert thresholds (the classic multiwindow pair): a
-# fast-window burn this hot exhausts the error budget in hours; a
-# slow-window burn this hot is a sustained leak. Gauges come from
-# telemetry.fleet.SloMonitor via the router's federated scrape.
-SLO_FAST_BURN_THRESHOLD = 14.0
-SLO_SLOW_BURN_THRESHOLD = 6.0
 # A federated backend busy less than this share of the fleet window is
 # underutilized — capacity the placement/rebalance policy is wasting.
 UNDERUTILIZED_BACKEND_PCT = 40.0
@@ -438,8 +443,8 @@ def rule_failover_review(ctx: dict) -> Optional[dict]:
 
 
 def rule_journal_durability(ctx: dict) -> Optional[dict]:
-    counts = ctx["provenance"]
-    if not counts.get("journal_gap"):
+    gaps = journal_gap_count(ctx["provenance"])
+    if not gaps:
         return None
     return {
         "severity": "high",
@@ -449,7 +454,7 @@ def rule_journal_durability(ctx: dict) -> Optional[dict]:
                   "(journal_gap): the restored folds are pinned off "
                   "definite-True. Check disk space/health under "
                   "--journal-dir and consider --journal-fsync",
-        "evidence": {"journal_gap": counts["journal_gap"]},
+        "evidence": {"journal_gap": gaps},
     }
 
 
@@ -487,16 +492,13 @@ def rule_respawn_backend(ctx: dict) -> Optional[dict]:
     `rebalance_tenants` mirrors `plan_rebalance`: while the
     supervisor is still working on a respawn the advisor stays quiet
     (the fleet is healing itself), exactly as the router does."""
-    fleet = ctx["fleet"]
-    conf = fleet.get("configured_backends")
-    live = fleet.get("live_backends")
-    if not isinstance(conf, int) or not isinstance(live, int) \
-            or live >= conf:
-        return None
-    disabled = bool(fleet.get("respawn_disabled"))
-    gave_up = list(fleet.get("respawn_gave_up") or [])
-    if not disabled and not gave_up:
+    deficit = respawn_capacity_deficit(ctx["fleet"])
+    if deficit is None:
         return None  # the supervisor is on it; no operator action yet
+    conf = deficit["configured_backends"]
+    live = deficit["live_backends"]
+    disabled = deficit["respawn_disabled"]
+    gave_up = deficit["respawn_gave_up"]
     what = []
     if disabled:
         what.append("respawn is disabled (JEPSEN_NO_RESPAWN / "
@@ -530,16 +532,7 @@ def rule_slo_burn(ctx: dict) -> Optional[dict]:
     window on a sustained leak — either past its threshold is worth an
     operator's attention NOW, before the budget is gone."""
     slo = (ctx["fleet"] or {}).get("slo")
-    windows = (slo or {}).get("windows") or {}
-    hot = {}
-    for wname, thresh in (("fast", SLO_FAST_BURN_THRESHOLD),
-                          ("slow", SLO_SLOW_BURN_THRESHOLD)):
-        w = windows.get(wname) or {}
-        for kind in ("availability", "latency"):
-            burn = w.get(f"{kind}_burn_rate")
-            if isinstance(burn, (int, float)) and burn > thresh:
-                hot[f"{wname}_{kind}"] = {"burn_rate": burn,
-                                          "threshold": thresh}
+    hot = slo_hot_windows(slo)
     if not hot:
         return None
     return {
@@ -603,7 +596,7 @@ def rule_scrape_stale(ctx: dict) -> Optional[dict]:
     frozen in every fleet total — the fleet p99 / SLO burn rates are
     blind to whatever those backends are doing NOW."""
     fleet = ctx["fleet"] or {}
-    stale = list(fleet.get("stale_backends") or [])
+    stale = stale_backend_list(fleet)
     if not stale:
         return None
     fed = fleet.get("federation") or {}
@@ -665,7 +658,7 @@ def rule_segment_plan_skew(ctx: dict) -> Optional[dict]:
 
 def rule_latency_tail(ctx: dict) -> Optional[dict]:
     tails = [(leg, p50, p99) for leg, p50, p99 in ctx["latency_tails"]
-             if p99 / p50 > TAIL_RATIO_THRESHOLD]
+             if tail_is_pathological(p50, p99)]
     if not tails:
         return None
     return {

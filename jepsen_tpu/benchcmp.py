@@ -110,6 +110,15 @@ METRICS: list[tuple[str, str, str]] = [
     # trajectory — more or fewer failovers is a configuration fact,
     # not a regression.
     ("service_failovers_total", "service_streams.failovers", "info"),
+    # Alerting plane (alerts PR): how long the armed journal fault
+    # took to flip `journal_errors` to firing (growing = the watchdog
+    # reacts slower), and what the rule catalogue's evaluation cost
+    # against the service leg's wall clock (growing = the always-on
+    # plane stopped being negligible; the bench gates it under 2%).
+    ("alert_detection_seconds",
+     "service_streams.alert_detection_seconds", "lower"),
+    ("alert_eval_overhead_pct",
+     "service_streams.alert_eval_overhead_pct", "lower"),
     # Horizontal service resilience (router PR): 2 backend processes ×
     # 4 tenants behind the tenant router with one injected kill-9
     # mid-run — the sustained throughput is the RECOVERED-after-

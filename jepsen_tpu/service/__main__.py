@@ -62,6 +62,9 @@ def build_service(ns: argparse.Namespace,
         store_root=ns.store_root,
         journal_dir=ns.journal_dir,
         journal_fsync=ns.journal_fsync,
+        alerts=ns.alerts,
+        alerts_path=ns.alerts_path,
+        alerts_sink=ns.alerts_sink,
     )
     # Every daemon carries a span collector: the fleet's cross-process
     # traces are observed by scraping each backend's GET /trace — a
@@ -163,7 +166,10 @@ def _run_router(ns: argparse.Namespace, metrics: Registry) -> int:
         probe_interval_s=ns.probe_interval,
         failure_threshold=ns.failure_threshold,
         state_path=ns.state_path,
-        respawn=not ns.no_respawn)
+        respawn=not ns.no_respawn,
+        alerts=ns.alerts,
+        alerts_path=ns.alerts_path,
+        alerts_sink=ns.alerts_sink)
     web_srv = None
     if ns.live_port is not None:
         from .. import web
@@ -277,6 +283,19 @@ def main(argv: Optional[list] = None) -> int:
                         "to JEPSEN_NO_RESPAWN=1): dead spawned "
                         "backends stay dead, the fleet runs on the "
                         "survivors")
+    p.add_argument("--alerts", action="store_true",
+                   help="evaluate the built-in alert rule catalogue "
+                        "on the existing pump/probe cadence and serve "
+                        "GET /alerts (docs/alerts.md)")
+    p.add_argument("--alerts-path", default=None,
+                   help="durable alerts.jsonl (implies --alerts); a "
+                        "restart replays it to the same firing set. "
+                        "Routers default to an alerts.jsonl next to "
+                        "--state-path when alerting is on")
+    p.add_argument("--alerts-sink", default=None,
+                   help="fan alert transitions out to an http(s):// "
+                        "webhook (JSON POST per transition, bounded "
+                        "retry) or an ndjson file (implies --alerts)")
     p.add_argument("--roll", metavar="ROUTER_URL", default=None,
                    help="POST /roll to a RUNNING router (rolling "
                         "restart: drain-migrate, respawn and re-adopt "

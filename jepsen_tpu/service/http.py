@@ -110,6 +110,11 @@ def make_handler(service: Service, max_body: int = MAX_BODY_BYTES):
                             spans = list(col.spans)
                         self._json(200, {"service": service.name,
                                          "spans": spans})
+                elif path in ("/alerts", "/alerts/"):
+                    # The alert plane's lifecycle view: firing set,
+                    # rule catalogue, recent transitions
+                    # ({"enabled": false} without an alert config).
+                    self._json(200, service.alerts_snapshot())
                 else:
                     self._json(404, {"error": "not_found"})
             except Exception as e:  # noqa: BLE001 - never 500 silently

@@ -186,6 +186,15 @@ class RouterConfig:
     # SLO burn-rate monitor (needs a metrics registry to matter; see
     # docs/telemetry.md "Fleet federation & SLOs").
     federate: bool = True
+    # Alerting plane (docs/alerts.md): evaluate the built-in rule
+    # catalogue over the FEDERATED totals on this same tick — one rule
+    # set covers the fleet. Off by default; enabling any of the three
+    # lazily imports telemetry/alerts.py. alerts_path defaults to an
+    # alerts.jsonl next to state_path, so a kill-9'd router restarted
+    # on the same state dir replays its firing set.
+    alerts: bool = False
+    alerts_path: Optional[str] = None
+    alerts_sink: Optional[str] = None
 
 
 class Backend:
@@ -447,6 +456,24 @@ class Router:
         if cfg.federate and metrics is not None:
             self.federation = _fleet.FleetFederation(metrics)
             self.slo = _fleet.SloMonitor(metrics)
+        # Alerting plane: built ONLY when configured (the off-path pin
+        # — telemetry/alerts.py is never imported otherwise).
+        self.alert_engine = None
+        self._sentinel = None
+        if cfg.alerts or cfg.alerts_path or cfg.alerts_sink:
+            from ..telemetry import alerts as _alerts
+
+            apath = cfg.alerts_path
+            if apath is None and cfg.state_path:
+                apath = os.path.join(
+                    os.path.dirname(os.path.abspath(cfg.state_path)),
+                    "alerts.jsonl")
+            sink = (_alerts.AlertSink(cfg.alerts_sink)
+                    if cfg.alerts_sink else None)
+            self._sentinel = _alerts.RegressionSentinel()
+            self.alert_engine = _alerts.AlertEngine(
+                metrics=metrics, path=apath, sink=sink,
+                source=self.name)
         if state_rep is not None:
             # The epoch bump IS a fleet-visible operation: every
             # /release//adopt from here on carries the new epoch.
@@ -747,16 +774,24 @@ class Router:
             # must read as STALE in the fleet view — its last-good
             # snapshot stays in the merge (its counters really did
             # happen) but the staleness gauges mark the numbers as
-            # frozen, never silently current.
-            expected = [bb.name for bb in self._backends.values()
-                        if not bb.down]
-            self.federation.stale_backends(expected=expected)
+            # frozen, never silently current. Expected is every
+            # CONFIGURED backend (down included — a kill-9'd backend
+            # mid-respawn still belongs to the fleet); a snapshot held
+            # for a name no longer configured at all is decommissioned
+            # and expires instead of pinning the staleness signal.
+            self.federation.stale_backends(
+                expected=list(self._backends))
             if self.slo is not None:
                 try:
                     self._slo_doc = self.slo.observe(
                         self.federation.merged())
                 except Exception:  # noqa: BLE001 - observability only
                     LOG.warning("SLO observe failed", exc_info=True)
+        if self.alert_engine is not None:
+            try:
+                self._evaluate_alerts()
+            except Exception:  # noqa: BLE001 - observability only
+                LOG.warning("alert evaluation failed", exc_info=True)
         if (self.config.rebalance and not self._draining
                 and not migration_disabled()):
             self._maybe_rebalance()
@@ -1612,6 +1647,59 @@ class Router:
 
     # -- fleet observability -------------------------------------------------
 
+    def _alert_fleet_ctx(self) -> dict:
+        """The light fleet block the alert predicates read each tick —
+        capacity/respawn state + staleness, WITHOUT the per-backend
+        utilization reconstruction ``_fleet_stats`` pays for (this
+        runs on the probe cadence; reconstruction is page-cadence)."""
+        sups = {n: s.snapshot() for n, s in self._supervisors.items()}
+        out: dict = {
+            "configured_backends": len(self._backends),
+            "live_backends": sum(
+                1 for b in self._backends.values() if not b.down),
+            "respawn_disabled": (not self.config.respawn
+                                 or _supervisor.respawn_disabled()),
+            "respawn_gave_up": sorted(
+                n for n, s in sups.items() if s["gave_up"]),
+        }
+        if self.federation is not None:
+            out["stale_backends"] = self.federation.stale_backends(
+                expected=list(self._backends))
+        return out
+
+    def _evaluate_alerts(self) -> None:
+        """One alert pass over the federated totals (the `_tick`
+        hook): the rule set sees the fleet as ONE system — merged
+        samples, the SLO doc, capacity/respawn state, and the
+        change-point sentinel's live p99 series."""
+        eng = self.alert_engine
+        if eng is None:
+            return
+        from ..telemetry import alerts as _alerts
+
+        merged = (self.federation.merged()
+                  if self.federation is not None else [])
+        sentinel: list = []
+        if self._sentinel is not None:
+            tail = _alerts.decision_tail(merged)
+            if tail is not None and tail[1] is not None:
+                self._sentinel.observe("fleet:p99_decision_latency_s",
+                                       tail[1], lower_is_better=True)
+            sentinel = self._sentinel.active()
+        eng.evaluate({
+            "samples": merged,
+            "slo": self._slo_doc,
+            "fleet": self._alert_fleet_ctx(),
+            "sentinel": sentinel,
+        })
+
+    def alerts_snapshot(self) -> dict:
+        """The router ``GET /alerts`` document ({"enabled": False}
+        without an alert config)."""
+        if self.alert_engine is None:
+            return {"enabled": False, "router": self.name}
+        return {"router": self.name, **self.alert_engine.snapshot()}
+
     def _fleet_stats(self) -> dict:
         """The federated slice of ``stats()['fleet']`` — what bench
         embeds and the advisor's slo_burn / backend_underutilized /
@@ -1619,8 +1707,7 @@ class Router:
         fed = self.federation
         if fed is None:
             return {}
-        expected = [n for n, b in self._backends.items()
-                    if not b.down]
+        expected = list(self._backends)
         util: dict[str, dict] = {}
         for n in fed.backends():
             u = fed.utilization(n)
@@ -1634,7 +1721,7 @@ class Router:
                               (int, float))]
         lat = fed.histogram_stats("decision_latency_seconds")
         return {
-            "federation": fed.meta(),
+            "federation": fed.meta(expected=expected),
             "stale_backends": sorted(
                 fed.stale_backends(expected=expected)),
             "utilization": util,
@@ -1677,7 +1764,8 @@ class Router:
             placement = dict(self._placement)
             orphans = sorted(self._orphans)
         fed = self.federation
-        meta = fed.meta() if fed is not None else {}
+        meta = fed.meta(expected=list(self._backends)) \
+            if fed is not None else {}
         backends: dict[str, dict] = {}
         for n, b in self._backends.items():
             row = b.snapshot()
@@ -1692,6 +1780,14 @@ class Router:
             row["tenants"] = sorted(t for t, bn in placement.items()
                                     if bn == n)
             backends[n] = row
+        timeline = self._state_timeline()
+        if self.alert_engine is not None:
+            # Alert transitions join the placement/respawn event
+            # stream: one timeline answers "what fired while that
+            # backend was being respawned?".
+            timeline = sorted(
+                timeline + self.alert_engine.timeline_rows(),
+                key=lambda r: (r.get("t") or 0))
         doc: dict = {
             "router": self.name,
             "t": round(_time.time(), 3),
@@ -1700,15 +1796,19 @@ class Router:
             "backends": backends,
             "orphaned": orphans,
             "migrations": len(self.migrations),
-            "timeline": self._state_timeline(),
+            "timeline": timeline,
         }
         if fed is not None:
             doc["decision_latency"] = fed.histogram_stats(
                 "decision_latency_seconds")
             doc["slo"] = self._slo_doc
             doc["stale_backends"] = sorted(fed.stale_backends(
-                expected=[n for n, b in self._backends.items()
-                          if not b.down]))
+                expected=list(self._backends)))
+        if self.alert_engine is not None:
+            doc["alerts"] = {
+                "firing": self.alert_engine.firing(),
+                "recent": self.alert_engine.history(20),
+            }
         return doc
 
     def metrics_text(self) -> str:
@@ -1847,6 +1947,8 @@ class Router:
         self._finished = fin
         for sup in self._supervisors.values():
             sup.close()
+        if self.alert_engine is not None:
+            self.alert_engine.close()
         if self._state is not None:
             self._state.close()
         self._shutdown_children()
@@ -1881,6 +1983,8 @@ class Router:
         self._thread.join(timeout=5)
         for sup in self._supervisors.values():
             sup.close()
+        if self.alert_engine is not None:
+            self.alert_engine.close()
         if self._state is not None:
             self._state.close()
         self._shutdown_children()
@@ -2000,6 +2104,8 @@ def make_router_handler(router: Router):
                     self.wfile.write(body)
                 elif path in ("/fleet", "/fleet/"):
                     self._json(200, router.fleet_snapshot())
+                elif path in ("/alerts", "/alerts/"):
+                    self._json(200, router.alerts_snapshot())
                 else:
                     self._json(404, {"error": "not_found"})
             except Exception as e:  # noqa: BLE001

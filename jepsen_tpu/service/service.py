@@ -248,6 +248,16 @@ class ServiceConfig:
     # history (docs/service.md "Crash-safe verdict journal").
     journal_dir: Optional[str] = None
     journal_fsync: bool = False  # fsync every record (slow, kill-safe)
+    # Alerting plane (docs/alerts.md): evaluate the built-in rule
+    # catalogue over this service's own registry/health on the pump
+    # cadence (throttled to ALERT_EVAL_INTERVAL_S — no new thread) and
+    # serve GET /alerts. Off by default; enabling any of the three
+    # lazily imports telemetry/alerts.py. alerts_path makes the
+    # lifecycle durable (alerts.jsonl, ConsistentLines discipline);
+    # alerts_sink fans transitions to a webhook/ndjson target.
+    alerts: bool = False
+    alerts_path: Optional[str] = None
+    alerts_sink: Optional[str] = None
 
     def __post_init__(self):
         if self.backpressure not in ("reject", "block"):
@@ -374,6 +384,25 @@ class Service:
             except BaseException:
                 self.scheduler.close(timeout=10.0)
                 raise
+        # Alerting plane: built ONLY when configured (the off-path pin
+        # — telemetry/alerts.py is never imported otherwise), and
+        # evaluated from the pump thread on a throttle, never a new
+        # thread.
+        self.alert_engine = None
+        self._sentinel = None
+        self._alerts_mod = None
+        self._next_alert_eval = 0.0
+        self._alert_prev_ops: Optional[tuple] = None
+        if cfg.alerts or cfg.alerts_path or cfg.alerts_sink:
+            from ..telemetry import alerts as _alerts
+
+            self._alerts_mod = _alerts
+            sink = (_alerts.AlertSink(cfg.alerts_sink)
+                    if cfg.alerts_sink else None)
+            self._sentinel = _alerts.RegressionSentinel()
+            self.alert_engine = _alerts.AlertEngine(
+                metrics=metrics, path=cfg.alerts_path, sink=sink,
+                source=self.name)
         self._wake = threading.Event()
         self._pump_stop = threading.Event()
         self._pump_thread = threading.Thread(
@@ -929,6 +958,12 @@ class Service:
                 row["journal_lag_ops"] = max(
                     t.segmenter.next_index
                     - (t.journaled_watermark + 1), 0)
+                if t.journal.append_failures:
+                    # Durability compromised (the journal_errors alert
+                    # predicate reads this; degraded above already
+                    # folded it in).
+                    row["journal_append_failures"] = \
+                        t.journal.append_failures
             tenants[name] = row
         return {
             "ok": True,
@@ -1042,6 +1077,9 @@ class Service:
                 if not self._pump_once():
                     self._wake.wait(0.05)
                     self._wake.clear()
+                # Alerting rides the existing sweep cadence (throttled
+                # inside; no-op without an alert config).
+                self._maybe_evaluate_alerts()
         except Exception:  # noqa: BLE001
             LOG.error("service pump died; ingest queues will fill",
                       exc_info=True)
@@ -1119,6 +1157,63 @@ class Service:
         # the count and the submit.
         with t.lock:
             t.ops_observed += 1
+
+    # -- the alert plane (docs/alerts.md) ------------------------------------
+
+    def _maybe_evaluate_alerts(self, now: Optional[float] = None
+                               ) -> list:
+        """One throttled alert pass (the pump-loop hook): samples from
+        this service's registry, the /healthz document, and the
+        change-point sentinel fed the live sustained-ops/s and p99
+        windows. Fully guarded — alerting must never kill the pump."""
+        eng = self.alert_engine
+        if eng is None:
+            return []
+        now = _time.monotonic() if now is None else now
+        if now < self._next_alert_eval:
+            return []
+        self._next_alert_eval = (
+            now + self._alerts_mod.ALERT_EVAL_INTERVAL_S)
+        try:
+            sentinel: list = []
+            if self._sentinel is not None:
+                with self._tlock:
+                    tenants = list(self._tenants.values())
+                total = 0
+                for t in tenants:
+                    with t.lock:
+                        total += t.ops_observed
+                if self._alert_prev_ops is not None:
+                    t_prev, n_prev = self._alert_prev_ops
+                    dt = now - t_prev
+                    if dt > 0:
+                        self._sentinel.observe(
+                            f"{self.name}:ops_per_s",
+                            (total - n_prev) / dt,
+                            lower_is_better=False)
+                self._alert_prev_ops = (now, total)
+                p99 = self._lat.quantile(0.99)
+                if p99 is not None:
+                    self._sentinel.observe(
+                        f"{self.name}:p99_decision_latency_s", p99,
+                        lower_is_better=True)
+                sentinel = self._sentinel.active()
+            return eng.evaluate({
+                "samples": (self.metrics.collect()
+                            if self.metrics is not None else []),
+                "health": self.health_snapshot(),
+                "sentinel": sentinel,
+            })
+        except Exception:  # noqa: BLE001 - observability only
+            LOG.warning("alert evaluation failed", exc_info=True)
+            return []
+
+    def alerts_snapshot(self) -> dict:
+        """The service ``GET /alerts`` document ({"enabled": False}
+        without an alert config)."""
+        if self.alert_engine is None:
+            return {"enabled": False, "service": self.name}
+        return {"service": self.name, **self.alert_engine.snapshot()}
 
     # -- scheduler hooks (worker thread, scheduler lock held) ----------------
 
@@ -1233,7 +1328,7 @@ class Service:
         rows = {name: self.tenant_snapshot(name) for name, _t in items}
         totals_obs = sum((r or {}).get("ops_observed") or 0
                          for r in rows.values())
-        return {
+        doc = {
             "run": self.name,
             "service": True,
             "t": round(_time.time(), 3),
@@ -1245,6 +1340,10 @@ class Service:
             "decision_latency": self._lat.stats(),
             "tenants": rows,
         }
+        if self.alert_engine is not None:
+            # The /live badge row: which rules are firing right now.
+            doc["alerts"] = sorted(self.alert_engine.firing())
+        return doc
 
     # -- drain / shutdown ----------------------------------------------------
 
@@ -1412,6 +1511,13 @@ class Service:
                 web.unregister_live_source(self.name)
             except Exception:  # noqa: BLE001
                 pass
+        if self.alert_engine is not None:
+            # One final pass (the pump is gone) so a condition that
+            # only materialized during drain still transitions, then
+            # seal the journal.
+            self._next_alert_eval = 0.0
+            self._maybe_evaluate_alerts()
+            self.alert_engine.close()
         fin = {
             "service": self.name,
             "tenants": results,

@@ -358,10 +358,26 @@ class FleetFederation:
     def stale_backends(self, expected: Optional[Iterable[str]] = None,
                        *, now: Optional[float] = None) -> list[str]:
         """Backends whose snapshot aged past the threshold, plus any
-        ``expected`` name never scraped at all."""
+        ``expected`` name never scraped at all.
+
+        When ``expected`` is given it is the CURRENT config: a
+        snapshot held for a backend no longer listed is decommissioned
+        — once its age passes the threshold it is expired (forgotten)
+        rather than reported, so removing a backend from config can't
+        pin the staleness signal (and its alert) forever. Until expiry
+        the snapshot still merges (a just-removed backend's counters
+        drain out after ``stale_after_s``, not instantly)."""
+        expected_set = set(expected) if expected is not None else None
         ages = self.ages(now=now)
+        if expected_set is not None:
+            for b, a in ages.items():
+                if b not in expected_set and a > self.stale_after_s:
+                    self.forget(b)
+                    if self.metrics is not None:
+                        self._g_age.labels(backend=b).set(0.0)
+            ages = {b: a for b, a in ages.items() if b in expected_set}
         stale = {b for b, a in ages.items() if a > self.stale_after_s}
-        stale.update(b for b in (expected or ()) if b not in ages)
+        stale.update(b for b in (expected_set or ()) if b not in ages)
         out = sorted(stale)
         if self.metrics is not None:
             self._g_stale.set(len(out))
@@ -409,10 +425,16 @@ class FleetFederation:
 
     # -- per-backend introspection (the /fleet page + bench block) -----------
 
-    def meta(self, *, now: Optional[float] = None) -> dict[str, dict]:
+    def meta(self, *, now: Optional[float] = None,
+             expected: Optional[Iterable[str]] = None) -> dict[str, dict]:
         """Per-backend scrape bookkeeping: last-scrape stamp/age,
-        scrape + failure counts, staleness."""
+        scrape + failure counts, staleness. With ``expected`` (the
+        current config), a held snapshot for an unlisted backend is
+        flagged ``decommissioned`` — it merges until
+        :meth:`stale_backends` expires it, but no longer counts
+        against fleet health."""
         now = _time.time() if now is None else float(now)
+        expected_set = set(expected) if expected is not None else None
         with self._lock:
             snaps = dict(self._snaps)
             failures = dict(self._failures)
@@ -423,6 +445,8 @@ class FleetFederation:
                 "scrapes": s["scrapes"] if s else 0,
                 "scrape_failures": failures.get(b, 0),
             }
+            if expected_set is not None and b not in expected_set:
+                row["decommissioned"] = True
             if s is not None:
                 age = max(now - s["at"], 0.0)
                 row["scraped_at"] = round(s["at"], 3)

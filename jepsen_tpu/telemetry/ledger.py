@@ -72,6 +72,12 @@ LEDGER_METRICS: list[tuple[str, str, str]] = [
     # is a machine-dependent lower bound — gated in tests, not here).
     ("plan_seconds", "plan_seconds", "lower"),
     ("speedup_vs_serial", "speedup_vs_serial", "info"),
+    # Alerting plane (telemetry/alerts.py): how long the armed
+    # journal-fault took to flip `journal_errors` to firing, and what
+    # rule evaluation cost against the service leg's wall clock —
+    # both growing means the watchdog got slower or heavier.
+    ("alert_detection_seconds", "alert_detection_seconds", "lower"),
+    ("alert_eval_overhead_pct", "alert_eval_overhead_pct", "lower"),
     ("ops", "ops", "info"),
 ]
 
@@ -243,7 +249,11 @@ _BENCH_LEGS: list[tuple[str, Optional[str], str, dict]] = [
     ("service_streams", "service_streams", "host",
      {"value_s": "wall_s", "ops_per_s": "sustained_ops_per_s",
       "p99_decision_latency_s": "p99_decision_latency_s",
-      "ops": "n_ops_total", "verdict": "valid_all"}),
+      "ops": "n_ops_total", "verdict": "valid_all",
+      # Alerting plane: detection latency of the armed journal fault
+      # and the rule-evaluation overhead share of the leg's wall.
+      "alert_detection_seconds": "alert_detection_seconds",
+      "alert_eval_overhead_pct": "alert_eval_overhead_pct"}),
     ("service_router", "service_router", "host",
      {"value_s": "wall_s", "ops_per_s": "sustained_ops_per_s",
       "p99_decision_latency_s": "p99_decision_latency_s",
@@ -443,6 +453,13 @@ def main(argv: Optional[list] = None) -> int:
     p.add_argument("--last", type=int, default=8,
                    help="table columns per group (default 8)")
     p.add_argument("--json", action="store_true", dest="as_json")
+    p.add_argument("--alerts", default=None, metavar="ALERTS_JSONL",
+                   help="with --check: append each flagged group as a "
+                        "`perf_regression` alert record to this "
+                        "alerts.jsonl (the alerting plane's durable "
+                        "format — `python -m jepsen_tpu.alerts` tails "
+                        "it), so offline ledger gating and the live "
+                        "sentinel share one alert stream")
     ns = p.parse_args(argv)
 
     records = load(ns.path)
@@ -450,6 +467,18 @@ def main(argv: Optional[list] = None) -> int:
         records = [r for r in records
                    if str(r.get("workload")) == ns.workload]
     flagged = check(records, threshold=ns.threshold) if records else []
+    if ns.alerts and ns.check:
+        from . import alerts as _alerts
+        for b in flagged:
+            _alerts.append_finding(ns.alerts, {
+                "key": b["key"],
+                "regressions": b["regressions"],
+                "deltas": {m: b["deltas"][m]
+                           for m in b["regressions"]
+                           if m in (b.get("deltas") or {})},
+                "threshold": ns.threshold,
+            }, rule="perf_regression", severity="medium",
+                source="ledger")
     if ns.as_json:
         print(json.dumps({
             "groups": trend(records, threshold=ns.threshold,

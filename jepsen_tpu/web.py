@@ -795,7 +795,7 @@ pre { background: #f6f6f6; padding: 0.6em; }</style></head>
 <body><h1>Live runs</h1>
 <p><a href="/">index</a> · <a href="/metrics">metrics</a> ·
 <a href="/online">online</a> · <a href="/verdicts">verdicts</a> ·
-<a href="/fleet">fleet</a> ·
+<a href="/fleet">fleet</a> · <a href="/alerts">alerts</a> ·
 raw feed: <a href="/live">/live</a>
 (ndjson poll)</p>
 <div id="runs"><p id="none">polling /live…</p></div>
@@ -830,6 +830,12 @@ async function tick() {
             ' · ' + r.ops_observed + ' ops observed' +
             ' · backlog ' + r.scheduler_backlog +
             ' · p50/p99 decide ' + p50 + '/' + p99 + 's' +
+            // Firing alerts ride the service's own /live line (the
+            // alerting plane's rule names), red-badged inline.
+            ((r.alerts && r.alerts.length)
+              ? ' · alerts: ' + r.alerts.map(a =>
+                  '<span class="stall">' + a + '</span>').join(' ')
+              : '') +
             '</p>';
           if (r.backends) {
             head += '<p>backends: ' +
@@ -1031,6 +1037,14 @@ def _fleet_section(snap: dict) -> str:
             "<th>availability burn</th><th>latency burn</th>"
             "<th>decided</th><th>rejected</th></tr>"
             + rows + "</table>")
+    alerts = snap.get("alerts") or {}
+    firing = sorted(alerts.get("firing") or [])
+    if firing:
+        parts.append(
+            "<h3>Alerts firing</h3><p>"
+            + " ".join(f'<span class="stall">{html.escape(r)}</span>'
+                       for r in firing)
+            + ' · <a href="/alerts">details</a></p>')
     backends = snap.get("backends") or {}
     stale = set(snap.get("stale_backends") or [])
     brows = []
@@ -1076,12 +1090,20 @@ def _fleet_section(snap: dict) -> str:
             detail = ", ".join(
                 f"{k}={v}" for k, v in sorted(rec.items())
                 if k not in ("kind", "t"))
+            # Alert transitions ride the same timeline as placement /
+            # respawn events so an operator can read them joined; a
+            # firing row gets the stall tint.
+            cls = ' class="stall"' if (rec.get("kind") == "alert"
+                                       and rec.get("state") == "firing"
+                                       ) else ""
             trows.append(
-                f"<tr><td>{html.escape(str(rec.get('t', '—')))}</td>"
+                f"<tr{cls}>"
+                f"<td>{html.escape(str(rec.get('t', '—')))}</td>"
                 f"<td>{html.escape(str(rec.get('kind')))}</td>"
                 f"<td>{html.escape(detail)}</td></tr>")
         parts.append(
-            "<h3>Router events (router_state.jsonl)</h3>"
+            "<h3>Router events &amp; alerts "
+            "(router_state.jsonl + alerts.jsonl)</h3>"
             "<table><tr><th>t</th><th>kind</th><th>detail</th></tr>"
             + "".join(trows) + "</table>")
     return "".join(parts)
@@ -1104,6 +1126,82 @@ def _fleet_page() -> str:
         '<a href="/metrics">metrics</a> · '
         'raw: <a href="/fleet.json">/fleet.json</a></p>'
         + body + "</body></html>")
+
+
+def alert_snapshots() -> list[dict]:
+    """One row per registered source that carries an alerting plane:
+    routers contribute their fleet snapshot's ``alerts`` block (firing
+    set + recent transitions), services contribute the firing-rule list
+    their ``/live`` line carries. Sources without alerts are skipped —
+    an empty store answers with an empty list, never an error."""
+    out = []
+    for snap in fleet_snapshots():
+        al = snap.get("alerts")
+        if isinstance(al, dict):
+            out.append({
+                "source": str(snap.get("router") or "?"),
+                "kind": "router",
+                "firing": sorted(al.get("firing") or []),
+                "recent": list(al.get("recent") or []),
+            })
+    for line in live_snapshots():
+        if line.get("router"):
+            continue  # already covered via its fleet source
+        al = line.get("alerts")
+        if isinstance(al, list):
+            out.append({
+                "source": str(line.get("run") or "?"),
+                "kind": "service",
+                "firing": sorted(al),
+                "recent": [],
+            })
+    return out
+
+
+def _alerts_page() -> str:
+    rows = alert_snapshots()
+    parts = []
+    for row in rows:
+        name = html.escape(f"{row['source']} ({row['kind']})")
+        firing = row["firing"]
+        if firing:
+            badge = " ".join(
+                f'<span class="stall">{html.escape(r)}</span>'
+                for r in firing)
+            parts.append(f"<h2>{name}</h2><p>firing: {badge}</p>")
+        else:
+            parts.append(f"<h2>{name}</h2><p>no alerts firing</p>")
+        recent = row["recent"]
+        if recent:
+            trows = []
+            for rec in recent[-40:]:
+                cls = ' class="stall"' \
+                    if rec.get("state") == "firing" else ""
+                trows.append(
+                    f"<tr{cls}>"
+                    f"<td>{html.escape(str(rec.get('t', '—')))}</td>"
+                    f"<td>{html.escape(str(rec.get('rule')))}</td>"
+                    f"<td>{html.escape(str(rec.get('state')))}</td>"
+                    f"<td>{html.escape(str(rec.get('severity')))}</td>"
+                    f"<td>{rec.get('generation')}</td></tr>")
+            parts.append(
+                "<table><tr><th>t</th><th>rule</th><th>state</th>"
+                "<th>severity</th><th>gen</th></tr>"
+                + "".join(trows) + "</table>")
+    if not parts:
+        parts.append(
+            "<p>No alert sources — start a service or router with "
+            "<code>--alerts</code> (or <code>alerts=True</code>) and "
+            "<code>register_live=True</code>.</p>")
+    return (
+        "<html><head><title>Jepsen alerts</title>"
+        '<meta http-equiv="refresh" content="2">'
+        f"<style>{_STYLE}\n.stall {{ background: #f7c5c5; }}</style>"
+        "</head><body><h1>Alerts</h1>"
+        '<p><a href="/">index</a> · <a href="/fleet">fleet</a> · '
+        '<a href="/live.html">live</a> · '
+        'raw: <a href="/alerts.json">/alerts.json</a></p>'
+        + "".join(parts) + "</body></html>")
 
 
 def _listing_page(rel: str, d: Path) -> str:
@@ -1164,6 +1262,16 @@ def make_handler(root: Path):
                     return
                 if path in ("/fleet", "/fleet/"):
                     self._send(200, _fleet_page().encode())
+                    return
+                if path in ("/alerts", "/alerts/"):
+                    self._send(200, _alerts_page().encode())
+                    return
+                if path == "/alerts.json":
+                    self._send(
+                        200,
+                        json.dumps(alert_snapshots(), sort_keys=True,
+                                   default=str).encode(),
+                        "application/json")
                     return
                 if path == "/fleet.json":
                     self._send(

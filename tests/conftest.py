@@ -72,6 +72,12 @@ def pytest_configure(config):
         "trace propagation; select with -m fleet). Closed-form merge "
         "and in-process cluster tests stay tier-1; the real "
         "two-process trace e2e is additionally marked slow")
+    config.addinivalue_line(
+        "markers",
+        "alerts: alerting & watchdog plane tests (jepsen_tpu."
+        "telemetry.alerts — rule lifecycle, durable alerts.jsonl "
+        "replay, CUSUM regression sentinel, chaos alert matrix; "
+        "select with -m alerts)")
 
 
 def pytest_addoption(parser):

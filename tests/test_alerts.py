@@ -1,0 +1,782 @@
+"""Alerting & watchdog plane (ISSUE 18): rule lifecycle, durable
+alerts.jsonl replay, CUSUM regression sentinel, chaos alert matrix.
+
+Tiers, mirroring docs/alerts.md:
+
+- CLOSED-FORM: the lifecycle state machine (pending hold, resolve
+  hysteresis, monotone generations), the CUSUM detector (step fires,
+  drift fires, white noise stays silent), predicate semantics over
+  hand-built contexts, and the advisor↔alert shared-predicate
+  identity (one definition of "when" per condition).
+- DURABILITY: two restarts over the same alerts.jsonl with a torn
+  final line each time — the firing set and generation counters
+  replay exactly (the tenant-journal ConsistentLines discipline).
+- WIRED (tier-1): a real Service under the journal.fsync chaos seam
+  raises ONLY that seam's expected alerts and a clean run raises
+  none (the canary never fires anywhere); a Router with a dead
+  backend restores its firing set across a restart.
+- OFF-PATH: without an alert config the module is never imported
+  (the telemetry/utilization poisoned-import convention).
+"""
+
+import json
+import os
+import random
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from jepsen_tpu import advisor
+from jepsen_tpu.history import History
+from jepsen_tpu.models import CasRegister
+from jepsen_tpu.service import Service
+from jepsen_tpu.service import router as jrouter
+from jepsen_tpu.service.client import InProcessServiceClient
+from jepsen_tpu.telemetry import Registry
+from jepsen_tpu.telemetry import alerts
+from jepsen_tpu.testing import chaos, chunked_register_history
+
+pytestmark = [pytest.mark.alerts]
+
+
+def rule(name="r", severity="medium", pred=None, **kw):
+    return alerts.AlertRule(name, severity,
+                            pred or (lambda ctx: ctx.get(name)), **kw)
+
+
+def states_of(recs):
+    return [(r["rule"], r["state"]) for r in recs]
+
+
+# ---------------------------------------------------------------------------
+# Lifecycle state machine.
+
+
+class TestLifecycle:
+    def test_fire_resolve_refire_generations_monotone(self):
+        eng = alerts.AlertEngine([rule()])
+        assert states_of(eng.evaluate({"r": {"x": 1}}, now=1.0)) == \
+            [("r", "firing")]
+        assert eng.firing()["r"]["generation"] == 1
+        assert eng.firing()["r"]["evidence"] == {"x": 1}
+        # holding: no new transition, evidence refreshes
+        assert eng.evaluate({"r": {"x": 2}}, now=2.0) == []
+        assert eng.firing()["r"]["evidence"] == {"x": 2}
+        assert states_of(eng.evaluate({}, now=3.0)) == \
+            [("r", "resolved")]
+        assert eng.firing() == {}
+        assert states_of(eng.evaluate({"r": {"x": 3}}, now=4.0)) == \
+            [("r", "firing")]
+        # a re-fire after resolve is a NEW generation
+        assert eng.firing()["r"]["generation"] == 2
+        assert eng.fired_rules() == {"r"}
+
+    def test_pending_hold_before_firing(self):
+        eng = alerts.AlertEngine([rule(for_s=5.0)])
+        assert states_of(eng.evaluate({"r": {"on": 1}}, now=10.0)) == \
+            [("r", "pending")]
+        assert eng.firing() == {}  # pending is not firing
+        assert eng.evaluate({"r": {"on": 1}}, now=12.0) == []  # hold not met
+        assert states_of(eng.evaluate({"r": {"on": 1}}, now=15.0)) == \
+            [("r", "firing")]
+
+    def test_pending_clears_without_firing(self):
+        eng = alerts.AlertEngine([rule(for_s=5.0)])
+        eng.evaluate({"r": {"on": 1}}, now=10.0)
+        # condition clears inside the hold: back to inactive, never
+        # fired, no generation consumed
+        assert states_of(eng.evaluate({}, now=12.0)) == \
+            [("r", "inactive")]
+        assert eng.fired_rules() == set()
+        eng.evaluate({"r": {"on": 1}}, now=20.0)
+        assert states_of(eng.evaluate({"r": {"on": 1}}, now=25.0)) == \
+            [("r", "firing")]
+        assert eng.firing()["r"]["generation"] == 1
+
+    def test_resolve_hysteresis(self):
+        eng = alerts.AlertEngine([rule(resolve_for_s=5.0)])
+        eng.evaluate({"r": {"on": 1}}, now=1.0)
+        # a clean blip shorter than resolve_for_s does NOT resolve
+        assert eng.evaluate({}, now=2.0) == []
+        assert "r" in eng.firing()
+        assert eng.evaluate({"r": {"on": 1}}, now=3.0) == []  # re-dirty
+        assert eng.evaluate({}, now=4.0) == []
+        assert states_of(eng.evaluate({}, now=9.5)) == \
+            [("r", "resolved")]
+
+    def test_broken_predicate_reads_as_not_firing(self):
+        def boom(ctx):
+            raise RuntimeError("rule bug")
+
+        eng = alerts.AlertEngine([rule(pred=boom)])
+        assert eng.evaluate({}, now=1.0) == []
+        assert eng.firing() == {}
+
+    def test_duplicate_rule_names_rejected(self):
+        with pytest.raises(ValueError, match="duplicate"):
+            alerts.AlertEngine([rule(), rule()])
+
+    def test_bad_severity_rejected(self):
+        with pytest.raises(ValueError, match="severity"):
+            rule(severity="apocalyptic")
+
+    def test_metrics_families(self):
+        reg = Registry()
+        eng = alerts.AlertEngine([rule()], metrics=reg)
+        eng.evaluate({"r": {"on": 1}}, now=1.0)
+        s = reg.summary()
+        assert s["alerts_total{rule=r,severity=medium}"] == 1
+        assert s["alerts_total"] == 1  # aggregate child
+        assert s["alerts_firing{rule=r}"] == 1
+        assert s["alerts_firing"] == 1
+        eng.evaluate({}, now=2.0)
+        s = reg.summary()
+        assert s["alerts_firing{rule=r}"] == 0
+        assert s["alerts_firing"] == 0
+        assert s["alerts_total"] == 1  # transitions, not state
+
+
+# ---------------------------------------------------------------------------
+# Durable alerts.jsonl: torn-final-line two-restart replay.
+
+
+class TestDurability:
+    def test_two_restart_torn_tail_replay(self, tmp_path):
+        path = str(tmp_path / "alerts.jsonl")
+        a = alerts.AlertEngine([rule("a"), rule("b")], path=path)
+        a.evaluate({"a": {"n": 1}}, now=1.0)
+        a.evaluate({"a": {"n": 1}, "b": {"on": 1}}, now=2.0)
+        a.close()
+        # kill-9 mid-append: a torn final line
+        with open(path, "a", encoding="utf-8") as f:
+            f.write('{"rule": "b", "state": "resol')
+
+        b = alerts.AlertEngine([rule("a"), rule("b")], path=path)
+        assert b.replay_torn is True
+        assert b.replayed == 2
+        assert sorted(b.firing()) == ["a", "b"]
+        assert b.firing()["a"]["evidence"] == {"n": 1}
+        # generations CONTINUE monotonically across the restart
+        b.evaluate({"b": {"on": 1}}, now=3.0)   # a resolves
+        b.evaluate({"a": {"on": 1}, "b": {"on": 1}}, now=4.0)  # a re-fires
+        assert b.firing()["a"]["generation"] == 2
+        b.close()
+        with open(path, "a", encoding="utf-8") as f:
+            f.write("garbage not json")
+
+        c = alerts.AlertEngine([rule("a"), rule("b")], path=path)
+        assert c.replay_torn is True
+        assert sorted(c.firing()) == ["a", "b"]
+        assert c.firing()["a"]["generation"] == 2
+        # the torn tails were truncated away: a fresh replay of the
+        # file itself folds to the same firing set
+        folded = alerts.replay(path)
+        assert sorted(folded["firing"]) == ["a", "b"]
+        assert folded["torn"] is False
+        c.close()
+
+    def test_replay_restores_resolved_as_inactive(self, tmp_path):
+        path = str(tmp_path / "alerts.jsonl")
+        a = alerts.AlertEngine([rule()], path=path)
+        a.evaluate({"r": {"on": 1}}, now=1.0)
+        a.evaluate({}, now=2.0)
+        a.close()
+        b = alerts.AlertEngine([rule()], path=path)
+        assert b.firing() == {}
+        b.evaluate({"r": {"on": 1}}, now=3.0)
+        assert b.firing()["r"]["generation"] == 2
+        b.close()
+
+    def test_unknown_rule_in_journal_is_history_only(self, tmp_path):
+        path = str(tmp_path / "alerts.jsonl")
+        with open(path, "w", encoding="utf-8") as f:
+            f.write(json.dumps({"t": 1.0, "rule": "retired_rule",
+                                "state": "firing", "generation": 3,
+                                "severity": "high"}) + "\n")
+        eng = alerts.AlertEngine([rule()], path=path)
+        assert eng.firing() == {}  # not resurrected as a live rule
+        assert eng.replayed == 1
+        eng.close()
+
+    def test_pathless_engine_is_memory_only(self):
+        eng = alerts.AlertEngine([rule()])
+        eng.evaluate({"r": {"on": 1}}, now=1.0)
+        assert eng.path is None
+        assert eng.append_failures == 0
+
+
+# ---------------------------------------------------------------------------
+# CUSUM change-point sentinel.
+
+
+class TestCusum:
+    def test_step_up_fires(self):
+        det = alerts.Cusum(min_n=8)
+        for i in range(8):
+            assert det.update(10.0 + 0.1 * (i % 2)) is None
+        fired = [det.update(20.0) for _ in range(6)]
+        assert "up" in fired
+
+    def test_step_down_fires(self):
+        det = alerts.Cusum(min_n=8)
+        for i in range(8):
+            det.update(10.0 + 0.1 * (i % 2))
+        fired = [det.update(2.0) for _ in range(6)]
+        assert "down" in fired
+
+    def test_slow_drift_fires(self):
+        det = alerts.Cusum(min_n=8)
+        shifts = []
+        for i in range(120):
+            # calibrated near-flat, then a sustained upward creep
+            x = 10.0 + 0.1 * (i % 2) + max(0, i - 8) * 0.05
+            s = det.update(x)
+            if s:
+                shifts.append(s)
+        assert shifts and shifts[0] == "up"
+
+    def test_noise_stays_silent(self):
+        import math
+
+        det = alerts.Cusum(min_n=8)
+        for i in range(200):
+            assert det.update(10.0 + math.sin(i * 1.7)) is None
+
+    def test_reanchors_after_detection(self):
+        det = alerts.Cusum(min_n=4)
+        for i in range(4):
+            det.update(10.0 + 0.1 * (i % 2))
+        while det.update(20.0) is None:
+            pass
+        # recalibrated on the new level: 20s are the new normal...
+        for i in range(4):
+            assert det.update(20.0 + 0.1 * (i % 2)) is None
+        # ...and the shift BACK fires again
+        fired = [det.update(10.0) for _ in range(6)]
+        assert "down" in fired
+
+    def test_flat_reference_sigma_floor(self):
+        det = alerts.Cusum(min_n=4)
+        for _ in range(4):
+            det.update(100.0)  # zero-variance calibration window
+        fired = [det.update(101.0) for _ in range(8)]
+        assert "up" in fired  # the σ floor keeps z finite
+
+    def test_non_finite_ignored(self):
+        det = alerts.Cusum(min_n=2)
+        assert det.update(float("nan")) is None
+        assert det.n == 0
+
+
+class TestRegressionSentinel:
+    def feed(self, sent, series, values, **kw):
+        out = []
+        for i, v in enumerate(values):
+            f = sent.observe(series, v, t=float(i), **kw)
+            if f:
+                out.append(f)
+        return out
+
+    def test_throughput_drop_is_regression(self):
+        sent = alerts.RegressionSentinel()
+        vals = [100.0 + (i % 2) for i in range(8)] + [40.0] * 8
+        got = self.feed(sent, "ops", vals, lower_is_better=False)
+        assert got and got[0]["shift"] == "down"
+        assert got[0]["regression"] is True
+        assert sent.active(now=float(len(vals)))
+
+    def test_latency_rise_is_regression_when_lower_is_better(self):
+        sent = alerts.RegressionSentinel()
+        vals = [0.010 + 0.0001 * (i % 2) for i in range(8)] + [0.5] * 8
+        got = self.feed(sent, "p99", vals, lower_is_better=True)
+        assert got and got[0]["shift"] == "up"
+        assert got[0]["regression"] is True
+
+    def test_improvement_is_not_a_finding(self):
+        sent = alerts.RegressionSentinel()
+        vals = [100.0 + (i % 2) for i in range(8)] + [400.0] * 8
+        got = self.feed(sent, "ops", vals, lower_is_better=False)
+        for f in got:
+            assert f["regression"] is False
+        assert sent.active(now=1e9) == []
+
+    def test_active_window_expires(self):
+        sent = alerts.RegressionSentinel()
+        vals = [100.0 + (i % 2) for i in range(8)] + [40.0] * 8
+        self.feed(sent, "ops", vals, lower_is_better=False)
+        assert sent.active(now=10.0)
+        assert sent.active(
+            now=10.0 + alerts.REGRESSION_ACTIVE_S + 1) == []
+
+    def test_observe_ledger_series_per_group_and_metric(self):
+        sent = alerts.RegressionSentinel()
+        recs = []
+        for i in range(16):
+            recs.append({"kind": "bench-leg",
+                         "workload": "service_streams",
+                         "engine": "host", "ts": float(i),
+                         "ops_per_s": (100.0 + (i % 2) if i < 8
+                                       else 40.0),
+                         "ops": 1000})
+        found = sent.observe_ledger(recs)
+        assert found
+        assert all("ops_per_s" in f["series"] for f in found)
+        # "info"-direction metrics (ops) are never watched
+        assert not any(f["series"].endswith(":ops") for f in found)
+
+    def test_perf_regression_alert_rides_the_sentinel(self):
+        eng = alerts.AlertEngine()
+        recs = eng.evaluate(
+            {"sentinel": [{"series": "x", "shift": "down",
+                           "regression": True, "t": 1.0}]}, now=1.0)
+        assert ("perf_regression", "firing") in states_of(recs)
+        assert eng.evaluate({"sentinel": []}, now=2.0)[0]["state"] == \
+            "resolved"
+
+
+# ---------------------------------------------------------------------------
+# Shared predicates: the advisor and the alert catalogue must agree.
+
+
+class TestAdvisorSharedPredicates:
+    def test_thresholds_are_the_same_objects(self):
+        assert advisor.SLO_FAST_BURN_THRESHOLD \
+            is alerts.SLO_FAST_BURN_THRESHOLD
+        assert advisor.SLO_SLOW_BURN_THRESHOLD \
+            is alerts.SLO_SLOW_BURN_THRESHOLD
+        assert advisor.TAIL_RATIO_THRESHOLD \
+            is alerts.TAIL_RATIO_THRESHOLD
+
+    def test_slo_burn_rule_equals_shared_predicate(self):
+        slo = {"availability_target": 0.999, "latency_target_s": 0.1,
+               "windows": {
+                   "fast": {"availability_burn_rate": 20.0,
+                            "latency_burn_rate": 1.0},
+                   "slow": {"availability_burn_rate": 2.0,
+                            "latency_burn_rate": 7.0}}}
+        hot = alerts.slo_hot_windows(slo)
+        assert set(hot) == {"fast_availability", "slow_latency"}
+        adv = advisor.rule_slo_burn({"fleet": {"slo": slo}})
+        assert adv is not None
+        assert adv["evidence"]["hot_windows"] == hot
+        # and both stay silent together
+        cold = {"windows": {"fast": {"availability_burn_rate": 1.0}}}
+        assert alerts.slo_hot_windows(cold) == {}
+        assert advisor.rule_slo_burn({"fleet": {"slo": cold}}) is None
+
+    def test_scrape_stale_rule_equals_shared_predicate(self):
+        fleet = {"stale_backends": ["b1", "b0"],
+                 "federation": {"b0": {"scrape_age_s": 9.0},
+                                "b1": {"scrape_age_s": 12.0}}}
+        stale = alerts.stale_backend_list(fleet)
+        assert stale == ["b0", "b1"]
+        adv = advisor.rule_scrape_stale({"fleet": fleet})
+        assert adv["evidence"]["stale_backends"] == stale
+        assert advisor.rule_scrape_stale({"fleet": {}}) is None
+        assert alerts.stale_backend_list({}) == []
+
+    def test_respawn_rule_equals_shared_predicate(self):
+        fleet = {"configured_backends": 3, "live_backends": 1,
+                 "respawn_disabled": False,
+                 "respawn_gave_up": ["b2"]}
+        deficit = alerts.respawn_capacity_deficit(fleet)
+        assert deficit == {"configured_backends": 3,
+                           "live_backends": 1,
+                           "respawn_disabled": False,
+                           "respawn_gave_up": ["b2"]}
+        adv = advisor.rule_respawn_backend({"fleet": fleet})
+        assert adv["evidence"] == deficit
+        # the supervisor-is-on-it gate holds for BOTH
+        healing = {"configured_backends": 3, "live_backends": 1,
+                   "respawn_disabled": False, "respawn_gave_up": []}
+        assert alerts.respawn_capacity_deficit(healing) is None
+        assert advisor.rule_respawn_backend({"fleet": healing}) is None
+
+    def test_journal_rule_equals_shared_predicate(self):
+        assert alerts.journal_gap_count({"journal_gap": 4}) == 4
+        adv = advisor.rule_journal_durability(
+            {"provenance": {"journal_gap": 4}})
+        assert adv["evidence"]["journal_gap"] == 4
+        assert alerts.journal_gap_count({"other": 1}) == 0
+        assert advisor.rule_journal_durability(
+            {"provenance": {"other": 1}}) is None
+
+    def test_latency_tail_rule_equals_shared_predicate(self):
+        assert alerts.tail_is_pathological(0.001, 0.5)
+        assert not alerts.tail_is_pathological(0.1, 0.5)
+        adv = advisor.rule_latency_tail(
+            {"latency_tails": [("leg", 0.001, 0.5),
+                               ("ok", 0.1, 0.5)]})
+        assert set(adv["evidence"]) == {"leg"}
+
+
+# ---------------------------------------------------------------------------
+# Predicate semantics over hand-built contexts.
+
+
+class TestPredicates:
+    def test_journal_errors_from_health_rows(self):
+        ctx = {"health": {"tenants": {
+            "t0": {"journal_append_failures": 3},
+            "t1": {"journal_lag_ops":
+                   alerts.JOURNAL_LAG_ALERT_OPS + 1},
+            "ok": {"journal_lag_ops": 5}}}}
+        ev = alerts._pred_journal_errors(ctx)
+        assert set(ev["tenants"]) == {"t0", "t1"}
+
+    def test_watermark_stall_gauge(self):
+        samples = [{"name": "online_watermark_stall_seconds",
+                    "type": "gauge", "labels": {},
+                    "value": alerts.WATERMARK_STALL_ALERT_S + 5}]
+        ev = alerts._pred_watermark_stall({"samples": samples})
+        assert ev["stall_seconds"]["total"] > \
+            alerts.WATERMARK_STALL_ALERT_S
+        assert alerts._pred_watermark_stall({"samples": []}) is None
+
+    def test_circuit_open_gauge(self):
+        samples = [{"name": "circuit_state", "type": "gauge",
+                    "labels": {"device": "d0"}, "value": 2},
+                   {"name": "circuit_state", "type": "gauge",
+                    "labels": {"device": "d1"}, "value": 0}]
+        ev = alerts._pred_circuit_open({"samples": samples})
+        assert set(ev["open_circuits"]) == {"d0"}
+
+    def test_canary_counts_samples_and_provenance(self):
+        ctx = {"samples": [{"name": "verdict_causes_total",
+                            "labels": {"code": "unattributed",
+                                       "tenant": "t0"}, "value": 2}],
+               "health": {"provenance": {"unattributed": 1}}}
+        assert alerts._pred_unattributed(ctx) == {"unattributed": 3}
+        assert alerts._pred_unattributed({}) is None
+
+    def test_decision_tail_from_histogram_total(self):
+        reg = Registry()
+        h = reg.histogram("decision_latency_seconds",
+                          buckets=(0.001, 0.01, 0.1, 1.0))
+        for _ in range(98):
+            h.observe(0.0005)
+        h.observe(0.5)
+        h.observe(0.5)
+        p50, p99 = alerts.decision_tail(reg.collect())
+        assert p50 < 0.01
+        assert p99 > 0.1
+        assert alerts.decision_tail([]) is None
+
+    def test_every_predicate_tolerates_empty_ctx(self):
+        for r in alerts.catalogue():
+            assert r.predicate({}) is None
+
+    def test_expected_alerts_matrix_shape(self):
+        names = {r.name for r in alerts.catalogue()}
+        assert set(alerts.EXPECTED_ALERTS) == set(chaos.POINTS)
+        for point, allowed in alerts.EXPECTED_ALERTS.items():
+            assert allowed <= names, point
+            # the canary appears in NO seam's expected set
+            assert "unattributed_causes" not in allowed, point
+
+
+# ---------------------------------------------------------------------------
+# Webhook / ndjson sink.
+
+
+class TestAlertSink:
+    def test_ndjson_sink(self, tmp_path):
+        target = str(tmp_path / "sink" / "alerts.ndjson")
+        sink = alerts.AlertSink(target)
+        r = sink.emit({"rule": "r", "state": "firing"})
+        assert r["ok"] is True
+        sink.emit({"rule": "r", "state": "resolved"})
+        rows = [json.loads(x) for x in
+                open(target, encoding="utf-8")]
+        assert [x["state"] for x in rows] == ["firing", "resolved"]
+        assert sink.emitted == 2 and sink.failures == 0
+
+    def test_http_sink_retries_503_then_succeeds(self):
+        hits = []
+
+        class H(BaseHTTPRequestHandler):
+            def do_POST(self):
+                body = self.rfile.read(
+                    int(self.headers["Content-Length"]))
+                hits.append(json.loads(body))
+                code = 503 if len(hits) == 1 else 200
+                self.send_response(code)
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+
+            def log_message(self, *a):
+                pass
+
+        srv = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        threading.Thread(target=srv.serve_forever,
+                         daemon=True).start()
+        try:
+            slept = []
+            sink = alerts.AlertSink(
+                f"http://127.0.0.1:{srv.server_address[1]}/hook",
+                base_backoff_s=0.01, sleep=slept.append)
+            r = sink.emit({"rule": "r", "state": "firing"})
+            assert r == {"ok": True, "status": 200, "attempts": 2}
+            assert len(hits) == 2
+            assert slept == [0.01]  # client.py's exponential idiom
+        finally:
+            srv.shutdown()
+            srv.server_close()
+
+    def test_http_sink_gives_up_bounded(self):
+        slept = []
+        sink = alerts.AlertSink("http://127.0.0.1:1/hook",
+                                max_retries=3, base_backoff_s=0.01,
+                                sleep=slept.append)
+        r = sink.emit({"rule": "r"})
+        assert r["ok"] is False
+        assert r["attempts"] == 3
+        assert slept == [0.01, 0.02]  # doubling, bounded
+        assert sink.failures == 1
+
+    def test_engine_survives_raising_sink(self):
+        class Boom:
+            def emit(self, rec):
+                raise RuntimeError("webhook down")
+
+        eng = alerts.AlertEngine([rule()], sink=Boom())
+        assert states_of(eng.evaluate({"r": {"on": 1}}, now=1.0)) == \
+            [("r", "firing")]
+
+
+# ---------------------------------------------------------------------------
+# CLI: python -m jepsen_tpu.alerts.
+
+
+class TestCli:
+    def write(self, tmp_path):
+        path = str(tmp_path / "alerts.jsonl")
+        eng = alerts.AlertEngine([rule("a"), rule("b")], path=path)
+        eng.evaluate({"a": {"on": 1}, "b": {"on": 1}}, now=1.0)
+        eng.evaluate({"a": {"on": 1}}, now=2.0)  # b resolves
+        eng.close()
+        return path
+
+    def test_replay_and_firing_exit_code(self, tmp_path, capsys):
+        path = self.write(tmp_path)
+        assert alerts.main([path]) == 0
+        out = capsys.readouterr().out
+        assert "firing" in out and "resolved" in out
+        assert alerts.main([path, "--firing"]) == 1  # a still firing
+        out = capsys.readouterr().out
+        assert "FIRING" in out and "a" in out and "b" not in out
+
+    def test_json_mode(self, tmp_path, capsys):
+        path = self.write(tmp_path)
+        assert alerts.main([path, "--json"]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert sorted(doc["firing"]) == ["a"]
+        assert len(doc["records"]) == 3
+
+    def test_missing_file(self, tmp_path, capsys):
+        assert alerts.main([str(tmp_path / "nope.jsonl")]) == 2
+
+    def test_module_entrypoint(self, tmp_path):
+        import subprocess
+        import sys
+
+        path = self.write(tmp_path)
+        r = subprocess.run(
+            [sys.executable, "-m", "jepsen_tpu.alerts", path,
+             "--firing"],
+            capture_output=True, text=True, timeout=120)
+        assert r.returncode == 1
+        assert "FIRING" in r.stdout
+
+
+# ---------------------------------------------------------------------------
+# Wired: the chaos alert contract on a real Service / Router.
+
+
+def _history(seed, n_ops=240):
+    return History(list(chunked_register_history(
+        random.Random(seed), n_ops=n_ops, n_procs=4, chunk_ops=60)),
+        reindex=True)
+
+
+@pytest.mark.service
+@pytest.mark.chaos
+class TestServiceChaosMatrix:
+    def _run(self, tmp_path, inject_point=None):
+        reg = Registry()
+        svc = Service(CasRegister(), engine="host", metrics=reg,
+                      register_live=False, ledger=False,
+                      journal_dir=str(tmp_path / "j"), alerts=True,
+                      alerts_path=str(tmp_path / "alerts.jsonl"))
+        try:
+            if inject_point:
+                # on_call=2: the tenant journal's HEADER write (call
+                # 1) must land so the journal opens; every append
+                # after it fails for the rest of the feed.
+                with chaos.inject(inject_point, mode="raise",
+                                  on_call=2, times=1_000_000):
+                    InProcessServiceClient(svc, "t0").feed(
+                        _history(71))
+                    svc.flush(60.0)
+            else:
+                InProcessServiceClient(svc, "t0").feed(_history(71))
+                svc.flush(60.0)
+            fin = svc.drain(timeout=60)
+        finally:
+            chaos.reset()
+        return svc, fin
+
+    def test_journal_fault_raises_only_expected_alerts(self, tmp_path):
+        svc, fin = self._run(tmp_path, inject_point="journal.fsync")
+        fired = svc.alert_engine.fired_rules()
+        # drain's final forced pass saw the failing appends
+        assert "journal_errors" in fired
+        assert fired <= alerts.EXPECTED_ALERTS["journal.fsync"]
+        assert "unattributed_causes" not in fired
+        # the verdicts themselves are untouched by journal loss
+        assert fin["tenants"]["t0"]["valid"] is True
+        # ...and the firing set survives a restart of the plane
+        folded = alerts.replay(str(tmp_path / "alerts.jsonl"))
+        assert "journal_errors" in folded["firing"]
+
+    def test_clean_run_raises_no_alerts(self, tmp_path):
+        svc, fin = self._run(tmp_path)
+        assert svc.alert_engine.fired_rules() == set()
+        assert svc.alert_engine.evaluations >= 1
+        assert fin["tenants"]["t0"]["valid"] is True
+        assert alerts.replay(
+            str(tmp_path / "alerts.jsonl"))["firing"] == {}
+
+
+@pytest.mark.service
+@pytest.mark.router
+class TestRouterAlerts:
+    def test_dead_backend_fires_and_replays_across_restart(
+            self, tmp_path):
+        state = str(tmp_path / "router_state.jsonl")
+
+        def mk(name):
+            return jrouter.Router(
+                [jrouter.Backend("b0", "http://127.0.0.1:1")],
+                metrics=Registry(), name=name, probe_interval_s=0.05,
+                failure_threshold=2, state_path=state, alerts=True,
+                register_live=False, respawn=False)
+
+        r = mk("r-alerts")
+        try:
+            deadline = time.monotonic() + 20
+            while time.monotonic() < deadline and \
+                    "respawn_gave_up" not in r.alert_engine.firing():
+                time.sleep(0.05)
+            fired = r.alert_engine.fired_rules()
+            assert "respawn_gave_up" in fired
+            assert "scrape_stale" in fired
+            assert fired <= alerts.EXPECTED_ALERTS["backend.process"]
+            # alerts.jsonl defaults to a SIBLING of --state-path
+            apath = r.alert_engine.path
+            assert os.path.dirname(apath) == \
+                os.path.dirname(os.path.abspath(state))
+            # the /fleet snapshot joins alert transitions into the
+            # state timeline
+            snap = r.fleet_snapshot()
+            kinds = {row.get("kind") for row in snap["timeline"]}
+            assert "alert" in kinds
+            assert sorted(snap["alerts"]["firing"]) == \
+                sorted(r.alert_engine.firing())
+            firing_before = sorted(r.alert_engine.firing())
+        finally:
+            r.close()
+        # restart over the same state dir: the firing set replays
+        r2 = mk("r-alerts-2")
+        try:
+            assert r2.alert_engine.replayed > 0
+            assert sorted(r2.alert_engine.firing()) == firing_before
+        finally:
+            r2.close()
+
+    def test_alerts_snapshot_route(self, tmp_path):
+        r = jrouter.Router(
+            [jrouter.Backend("b0", "http://127.0.0.1:1")],
+            metrics=Registry(), name="r-snap", probe_interval_s=5.0,
+            alerts=True,
+            alerts_path=str(tmp_path / "alerts.jsonl"),
+            register_live=False, respawn=False)
+        try:
+            snap = r.alerts_snapshot()
+            assert snap["enabled"] is True
+            assert snap["router"] == "r-snap"
+            assert {x["name"] for x in snap["rules"]} == \
+                {x.name for x in alerts.catalogue()}
+        finally:
+            r.close()
+
+    def test_router_without_alerts_has_none(self):
+        r = jrouter.Router(
+            [jrouter.Backend("b0", "http://127.0.0.1:1")],
+            metrics=Registry(), name="r-off", probe_interval_s=5.0,
+            register_live=False, respawn=False)
+        try:
+            assert r.alert_engine is None
+            assert r.alerts_snapshot() == {"enabled": False,
+                                           "router": "r-off"}
+        finally:
+            r.close()
+
+
+@pytest.mark.service
+class TestServiceWiring:
+    def test_service_without_alerts_has_none(self):
+        svc = Service(CasRegister(), engine="host",
+                      register_live=False, ledger=False)
+        try:
+            assert svc.alert_engine is None
+            assert svc.alerts_snapshot()["enabled"] is False
+        finally:
+            svc.drain(timeout=30)
+
+    def test_http_alerts_route(self, tmp_path):
+        from jepsen_tpu.service import http as shttp
+        import urllib.request
+
+        svc = Service(CasRegister(), engine="host",
+                      register_live=False, ledger=False,
+                      alerts=True,
+                      alerts_path=str(tmp_path / "alerts.jsonl"))
+        srv = shttp.server(svc, port=0)
+        threading.Thread(target=srv.serve_forever,
+                         daemon=True).start()
+        try:
+            url = f"http://127.0.0.1:{srv.server_address[1]}/alerts"
+            with urllib.request.urlopen(url, timeout=10) as r:
+                doc = json.loads(r.read())
+            assert doc["enabled"] is True
+            assert doc["firing"] == {}
+        finally:
+            srv.shutdown()
+            srv.server_close()
+            svc.drain(timeout=30)
+
+
+# ---------------------------------------------------------------------------
+# Off-path: no alert config, no import, no overhead.
+
+
+class TestOffPath:
+    def test_service_off_path_never_imports_alerts(self):
+        import subprocess
+        import sys
+
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import sys\n"
+             "from jepsen_tpu.models import CasRegister\n"
+             "from jepsen_tpu.service import Service\n"
+             "s = Service(CasRegister(), engine='host', "
+             "register_live=False, ledger=False)\n"
+             "s.drain(timeout=30)\n"
+             "assert 'jepsen_tpu.telemetry.alerts' not in "
+             "sys.modules, 'alerts imported on the off path'"],
+            capture_output=True, text=True, timeout=300)
+        assert r.returncode == 0, r.stderr

@@ -258,6 +258,41 @@ class TestFederationStaleness:
         assert fed.stale_backends(expected=["b0", "b1"],
                                   now=101.0) == ["b1"]
 
+    def test_decommissioned_backend_expires_from_totals(self):
+        # Regression (alerts PR): a backend REMOVED from the expected
+        # set used to keep its last snapshot in every fleet total
+        # forever — frozen series, phantom capacity. Once expected= no
+        # longer lists it AND its snapshot has aged past the staleness
+        # horizon, it must be forgotten, not reported stale forever.
+        met = Registry()
+        fed = fleet.FleetFederation(met, stale_after_s=5.0)
+        fed.record_scrape("b0", payload_with_counter(1), now=100.0)
+        fed.record_scrape("b9", payload_with_counter(50), now=100.0)
+        # Inside the horizon the decommissioned snapshot still counts
+        # (it may be a rename mid-flight) but is flagged.
+        assert fed.stale_backends(expected=["b0"], now=102.0) == []
+        assert fed.meta(now=102.0, expected=["b0"])["b9"][
+            "decommissioned"] is True
+        # Past the horizon it expires entirely: not stale-reported,
+        # not merged, gone from meta. (b0 keeps answering scrapes.)
+        fed.record_scrape("b0", payload_with_counter(2), now=105.0)
+        assert fed.stale_backends(expected=["b0"], now=106.0) == []
+        assert "b9" not in fed.backends()
+        assert sample_of(fed.merged(), "ops_total")["value"] == 2.0
+        assert "b9" not in fed.meta(now=106.0, expected=["b0"])
+        # b0 itself still ages into staleness normally.
+        assert fed.stale_backends(expected=["b0"], now=112.0) == ["b0"]
+
+    def test_down_but_expected_backend_stays_stale_reported(self):
+        # The flip side: a backend still in expected= (configured but
+        # down, mid-respawn) must KEEP reading stale — expiry is only
+        # for names the configuration no longer claims.
+        fed = fleet.FleetFederation(stale_after_s=5.0)
+        fed.record_scrape("b0", payload_with_counter(1), now=100.0)
+        assert fed.stale_backends(expected=["b0"],
+                                  now=120.0) == ["b0"]
+        assert sample_of(fed.merged(), "ops_total")["value"] == 1.0
+
     def test_failure_keeps_last_snapshot_and_counts(self):
         met = Registry()
         fed = fleet.FleetFederation(met, stale_after_s=5.0)

@@ -186,6 +186,70 @@ class TestCli:
             capture_output=True, text=True, timeout=60)
         assert r.returncode == 0
         assert "--check" in r.stdout
+        assert "--alerts" in r.stdout
+
+
+@pytest.mark.alerts
+class TestAlertsEmission:
+    """--check --alerts PATH bridges ledger regressions into the durable
+    alert stream: each flagged trend group appends one perf_regression
+    record that jepsen_tpu.telemetry.alerts.replay folds back into the
+    firing set, with per-rule generations continuing across invocations."""
+
+    def _regressing(self, tmp_path):
+        p = tmp_path / "l.jsonl"
+        ledger.append(_rec(1, checker_seconds=0.4), path=p)
+        ledger.append(_rec(2, checker_seconds=0.9), path=p)
+        return p
+
+    def test_check_alerts_appends_a_perf_regression_record(
+            self, tmp_path, capsys):
+        from jepsen_tpu.telemetry import alerts
+        p = self._regressing(tmp_path)
+        ap = tmp_path / "alerts.jsonl"
+        assert ledger.main([str(p), "--check", "--alerts", str(ap)]) == 1
+        capsys.readouterr()
+        recs = [json.loads(l) for l in ap.read_text().splitlines()]
+        assert len(recs) == 1
+        (rec,) = recs
+        assert rec["rule"] == "perf_regression"
+        assert rec["severity"] == "medium"
+        assert rec["state"] == "firing"
+        assert rec["source"] == "ledger"
+        assert rec["generation"] == 1
+        assert "checker_seconds" in rec["evidence"]["regressions"]
+        assert rec["evidence"]["key"]["workload"] == "cas-register"
+        rep = alerts.replay(ap)
+        assert "perf_regression" in rep["firing"]
+        assert rep["torn"] is False
+
+    def test_generations_continue_across_invocations(
+            self, tmp_path, capsys):
+        p = self._regressing(tmp_path)
+        ap = tmp_path / "alerts.jsonl"
+        assert ledger.main([str(p), "--check", "--alerts", str(ap)]) == 1
+        assert ledger.main([str(p), "--check", "--alerts", str(ap)]) == 1
+        capsys.readouterr()
+        gens = [json.loads(l)["generation"]
+                for l in ap.read_text().splitlines()]
+        assert gens == [1, 2]
+
+    def test_clean_ledger_writes_no_alerts(self, tmp_path, capsys):
+        p = tmp_path / "l.jsonl"
+        ledger.append(_rec(1, checker_seconds=0.4), path=p)
+        ledger.append(_rec(2, checker_seconds=0.39), path=p)
+        ap = tmp_path / "alerts.jsonl"
+        assert ledger.main([str(p), "--check", "--alerts", str(ap)]) == 0
+        capsys.readouterr()
+        assert not ap.exists()
+
+    def test_alerts_without_check_is_inert(self, tmp_path, capsys):
+        p = self._regressing(tmp_path)
+        ap = tmp_path / "alerts.jsonl"
+        # Trend display only; nothing gates, nothing is emitted.
+        assert ledger.main([str(p), "--alerts", str(ap)]) == 0
+        capsys.readouterr()
+        assert not ap.exists()
 
 
 class TestCoreRunAppends:

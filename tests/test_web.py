@@ -64,7 +64,7 @@ def get(tmp_path):
 
 
 PAGES = ("/", "/metrics", "/profile", "/online", "/utilization",
-         "/runs", "/verdicts", "/live.html")
+         "/runs", "/verdicts", "/live.html", "/fleet", "/alerts")
 
 
 class TestEndpointsWithoutTelemetry:
@@ -82,6 +82,13 @@ class TestEndpointsWithoutTelemetry:
         assert "ledger.jsonl" in get("/runs")[2]
         # /verdicts lists the closed taxonomy even on an empty store.
         assert "overflow_top_rung" in get("/verdicts")[2]
+        assert "--alerts" in get("/alerts")[2]
+
+    def test_alerts_json_empty(self, get):
+        status, ctype, body = get("/alerts.json")
+        assert status == 200
+        assert ctype.startswith("application/json")
+        assert json.loads(body) == []
 
     def test_live_is_wellformed_ndjson_with_no_live_run(self, get):
         status, ctype, body = get("/live")
@@ -94,6 +101,58 @@ class TestEndpointsWithoutTelemetry:
         with pytest.raises(urllib.error.HTTPError) as e:
             get("/no-such-page")
         assert e.value.code == 404
+
+
+@pytest.mark.alerts
+class TestAlertsPage:
+    """/alerts aggregates every registered source's alerting plane;
+    /fleet joins alert transitions into the router-event timeline."""
+
+    @pytest.fixture()
+    def sources(self):
+        web.register_fleet_source("r0", lambda: {
+            "epoch": 1, "backends": {},
+            "alerts": {"firing": {"slo_burn": {"severity": "high"}},
+                       "recent": [
+                           {"t": 10.0, "rule": "slo_burn",
+                            "state": "firing", "severity": "high",
+                            "generation": 2}]},
+            "timeline": [
+                {"t": 9.0, "kind": "place", "tenant": "t0"},
+                {"t": 10.0, "kind": "alert", "rule": "slo_burn",
+                 "state": "firing", "severity": "high"}]})
+        web.register_live_source("s0", lambda: {
+            "tenants": {}, "tenant_count": 0, "ops_observed": 0,
+            "scheduler_backlog": 0, "alerts": ["journal_errors"]})
+        try:
+            yield
+        finally:
+            web.unregister_fleet_source("r0")
+            web.unregister_live_source("s0")
+
+    def test_alerts_page_lists_router_and_service(self, get, sources):
+        status, _, body = get("/alerts")
+        assert status == 200
+        assert "slo_burn" in body
+        assert "journal_errors" in body
+        doc = json.loads(get("/alerts.json")[2])
+        by_source = {d["source"]: d for d in doc}
+        assert by_source["r0"]["kind"] == "router"
+        assert by_source["r0"]["firing"] == ["slo_burn"]
+        assert by_source["s0"]["firing"] == ["journal_errors"]
+
+    def test_fleet_page_annotated_with_alerts(self, get, sources):
+        _, _, body = get("/fleet")
+        assert "Alerts firing" in body
+        assert "slo_burn" in body
+        # the alert transition rides the joined timeline table
+        assert "alert" in body and "place" in body
+
+    def test_live_line_carries_firing_rules(self, get, sources):
+        lines = [json.loads(l)
+                 for l in get("/live")[2].splitlines()]
+        svc = [l for l in lines if l.get("run") == "s0"]
+        assert svc and svc[0]["alerts"] == ["journal_errors"]
 
 
 class TestEndpointsWithTelemetry:
