@@ -1354,19 +1354,23 @@ def main() -> int:
                         h.append({"type": "ok", "f": "txn", "value": done,
                                   "process": 0})
                 elle_append.check(h, device=True)  # warm
+                _rep = {}
                 t0 = time.perf_counter()
-                res = elle_append.check(h, device=True)
+                res = elle_append.check(h, device=True, report=_rep)
                 out["elle_txn"] = {
                     "mops": mops, "txns": len(h),
                     "value_s": round(time.perf_counter() - t0, 3),
                     "valid": res["valid"],
+                    "engine": _rep.get("engine"),
                 }
                 # Invalid companion: a 4096-node cyclic component with
-                # 16 anti-dependency edges — enough distinct queries
-                # that the per-SCC reachability escalates to ONE
-                # device-resident MXU closure (built on device from the
-                # edge arrays; only queried scalars cross the relay).
+                # 16 anti-dependency edges. The batched engine decides
+                # every taxonomy mask of it in ONE vmapped dispatch
+                # (bucket 4096, three bit-packed members) — asserted
+                # via the elle_batch_chunk count.
                 try:
+                    from jepsen_tpu import telemetry as jtel
+
                     big = DepGraph(4096)
                     for i in range(4095):
                         big.add(i, i + 1, WW)
@@ -1374,17 +1378,103 @@ def main() -> int:
                     for i in range(0, 4096, 256):
                         big.add((i + 7) % 4096, i, RW)
                     cycle_anomalies(big, device=True)  # warm
+                    treg = jtel.Registry()
+                    _rep = {}
                     t0 = time.perf_counter()
-                    bad = cycle_anomalies(big, device=True)
-                    out["elle_txn"]["big_scc_4096"] = {
+                    bad = cycle_anomalies(big, device=True,
+                                          metrics=treg, report=_rep)
+                    bleg = {
                         "value_s": round(time.perf_counter() - t0, 3),
                         "anomalies": sorted(bad),
+                        "engine": _rep.get("engine"),
+                        "chunks": len(treg.events("elle_batch_chunk")),
                     }
+                    if bleg["chunks"] != 1:
+                        bleg["error"] = (
+                            f"big_scc_4096 took {bleg['chunks']} device "
+                            f"dispatches; the batched engine contract "
+                            f"is ONE")
+                    out["elle_txn"]["big_scc_4096"] = bleg
                 except Exception as e:  # keep the 20k-txn number
                     out["elle_txn"]["big_scc_4096"] = {
                         "error": f"{type(e).__name__}: {e}"}
         except Exception as e:  # noqa: BLE001
             out["elle_txn"] = {"error": f"{type(e).__name__}: {e}"}
+
+        # Batched Elle SCC/closure engine (the tentpole's headline
+        # number): 32 random dependency graphs spanning two size
+        # buckets decided through <= one vmapped dispatch per bucket,
+        # vs the serial per-graph engine baseline sampled in-leg.
+        # Sized for the CPU dev box — no device-slow guard, unlike
+        # elle_txn.
+        _REC.begin("elle_scc_batched")
+        try:
+            if _left() < 60 or not devices_ok:
+                out["elle_scc_batched"] = {"skipped": "budget"}
+            else:
+                import random as _random
+
+                from jepsen_tpu import telemetry as jtel
+                from jepsen_tpu.elle import DepGraph, RW, WR, WW, \
+                    cycle_anomalies, cycle_anomalies_batch
+
+                rng = _random.Random(19)
+                sizes = [rng.choice((48, 72, 96, 120))
+                         for _ in range(30)] + [160, 220]
+                graphs = []
+                for gn in sizes:
+                    g = DepGraph(gn)
+                    for _ in range(3 * gn):
+                        a, b = rng.randrange(gn), rng.randrange(gn)
+                        g.add(a, b, rng.choice((WW, WW, WR, RW)))
+                    graphs.append(g)
+                n_txns = sum(g.n for g in graphs)
+                cycle_anomalies_batch(graphs, device=True)  # warm
+                cycle_anomalies(graphs[0], device=True)
+                cycle_anomalies(graphs[-1], device=True)
+                treg = jtel.Registry()
+                _rep = {}
+                t0 = time.perf_counter()
+                batched = cycle_anomalies_batch(
+                    graphs, device=True, metrics=treg, report=_rep)
+                batch_s = time.perf_counter() - t0
+                chunk_events = treg.events("elle_batch_chunk")
+                buckets = sorted({e["bucket"] for e in chunk_events})
+                # Serial per-graph baseline sampled in-leg (every 4th
+                # graph through the same engine, extrapolated).
+                sample = graphs[::4]
+                t0 = time.perf_counter()
+                for g in sample:
+                    cycle_anomalies(g, device=True)
+                serial_s = (time.perf_counter() - t0) \
+                    * (len(graphs) / max(1, len(sample)))
+                leg = {
+                    "graphs": len(graphs),
+                    "n_txns": n_txns,
+                    "value_s": round(batch_s, 4),
+                    "elle_txns_per_s": round(n_txns / batch_s, 1),
+                    "serial_est_s": round(serial_s, 4),
+                    "elle_batch_speedup_x": round(serial_s / batch_s, 2),
+                    "chunks": len(chunk_events),
+                    "buckets": buckets,
+                    "invalid_graphs": sum(1 for a in batched if a),
+                }
+                # Perf pins (leg-local error fields, like the smoke):
+                # <= one vmapped program per populated bucket, and the
+                # co-batch must beat the serial engine by >= 2x.
+                if len(chunk_events) > len(buckets):
+                    leg["error"] = (
+                        f"batch took {len(chunk_events)} dispatches "
+                        f"for {len(buckets)} buckets; contract is <= "
+                        f"one per bucket")
+                elif leg["elle_batch_speedup_x"] < 2:
+                    leg["error"] = (
+                        f"elle_batch_speedup_x "
+                        f"{leg['elle_batch_speedup_x']} < 2x vs the "
+                        f"serial per-graph baseline")
+                out["elle_scc_batched"] = leg
+        except Exception as e:  # noqa: BLE001
+            out["elle_scc_batched"] = {"error": f"{type(e).__name__}: {e}"}
 
         # Mutex-model linearizability (hazelcast CP lock config): a 5k-op
         # correct lock-service history on the device kernel. Worst case

@@ -57,6 +57,9 @@ from .telemetry.alerts import (
 GAP_SHARE_THRESHOLD = 0.25
 # Provenance share past which one cause code dominates the unknowns.
 CAUSE_SHARE_THRESHOLD = 0.5
+# Elle engine degradations are rarer events than search unknowns; a
+# persistent 20% share already means the bucket ceiling is mis-sized.
+ELLE_FALLBACK_SHARE_THRESHOLD = 0.2
 # Per-backend load skew (router scale-out): the loaded backend must
 # exceed BOTH an absolute floor and this ratio × the least-loaded one
 # before a rebalance migration is worth its outage window — the same
@@ -303,6 +306,29 @@ def rule_raise_max_configs(ctx: dict) -> Optional[dict]:
                   "unknown): raise `max_configs` on the "
                   "checker/monitor/service so enumeration completes "
                   "and carries survive",
+        "evidence": {"share_pct": round(share * 100, 1),
+                     "causes": counts},
+    }
+
+
+def rule_elle_device_fallbacks(ctx: dict) -> Optional[dict]:
+    counts = ctx["provenance"]
+    share = _share(counts, "elle_bucket_ceiling", "elle_device_oom")
+    if share <= ELLE_FALLBACK_SHARE_THRESHOLD:
+        return None
+    return {
+        "severity": "medium",
+        "title": "elle cycle engine keeps falling back to the host "
+                 "path — raise the bucket ceiling",
+        "advice": "a persistent share of verdict causes is elle engine "
+                  "degradations (`elle_bucket_ceiling` / "
+                  "`elle_device_oom`): dependency graphs outgrow the "
+                  "batched engine's largest size bucket or its "
+                  "dispatches keep failing, so cycle checks pay the "
+                  "host Tarjan/BFS price. Raise the bucket ceiling "
+                  "(jepsen_tpu/elle/ops.py BUCKETS) or provide a mesh "
+                  "so big graphs take the block-row sharded closure "
+                  "instead of degrading",
         "evidence": {"share_pct": round(share * 100, 1),
                      "causes": counts},
     }
@@ -680,6 +706,7 @@ def rule_latency_tail(ctx: dict) -> Optional[dict]:
 RULES: list[tuple[str, Callable[[dict], Optional[dict]]]] = [
     ("extend_f_schedule", rule_extend_f_schedule),
     ("raise_max_configs", rule_raise_max_configs),
+    ("elle_device_fallbacks", rule_elle_device_fallbacks),
     ("failover_review", rule_failover_review),
     ("journal_durability", rule_journal_durability),
     ("respawn_backend", rule_respawn_backend),

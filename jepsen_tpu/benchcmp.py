@@ -51,6 +51,13 @@ METRICS: list[tuple[str, str, str]] = [
     ("smoke_8x10k_s", "batch_replay_large.smoke_8x10k.value_s", "lower"),
     ("elle_txn_s", "elle_txn.value_s", "lower"),
     ("big_scc_4096_s", "elle_txn.big_scc_4096.value_s", "lower"),
+    # Batched Elle SCC/closure engine (ISSUE 19): co-batched
+    # throughput across size buckets, and the speedup over the serial
+    # per-graph engine baseline sampled in-leg (info: the pin lives in
+    # the leg's own error field).
+    ("elle_txns_per_s", "elle_scc_batched.elle_txns_per_s", "higher"),
+    ("elle_batch_speedup_x", "elle_scc_batched.elle_batch_speedup_x",
+     "info"),
     ("mutex_5k_s", "mutex_5k.value_s", "lower"),
     ("device_kernel_s", "device_kernel_s", "lower"),
     ("per_level_ms", "per_level_ms", "lower"),

@@ -127,6 +127,17 @@ TAXONOMY: dict[str, tuple[str, str]] = {
         "a tenant migration failed partway (adopt refused, target "
         "unreachable, or JEPSEN_NO_MIGRATION); the tenant is orphaned "
         "and folds unknown until a later migration succeeds"),
+    # -- elle cycle engine ---------------------------------------------------
+    "elle_bucket_ceiling": (
+        "elle",
+        "a dependency graph outgrew the batched cycle engine's largest "
+        "size bucket with no mesh available for the sharded closure; "
+        "the verdict folded to the host Tarjan/BFS path"),
+    "elle_device_oom": (
+        "elle",
+        "a batched/sharded closure dispatch kept failing past the "
+        "chunk-halving escalation budget (device OOM or runtime "
+        "fault); the verdict folded to the host Tarjan/BFS path"),
     # -- testing ------------------------------------------------------------
     "chaos": (
         "testing",

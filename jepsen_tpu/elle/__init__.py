@@ -28,9 +28,10 @@ reference's option semantics.
 
 from __future__ import annotations
 
-from typing import Iterable, Optional
+from typing import Iterable, Optional, Sequence
 
 
+from . import ops as _ops
 from .graph import (
     DepGraph,
     PROC,
@@ -77,23 +78,56 @@ def expand_anomalies(anomalies: Iterable[str]) -> set:
     return out
 
 
+def _live_passes(g: DepGraph, extra: Iterable[str]) -> list:
+    """(bit, name) taxonomy passes this graph actually needs: the pure
+    pass plus each requested extra graph with edges present."""
+    passes = [(0, "")]
+    for name in extra:
+        bit = EXTRA_BITS[name]
+        if any(k & bit for k in g.edges.values()):
+            passes.append((bit, name))
+    return passes
+
+
+def _pass_masks(passes: Sequence[tuple]) -> list:
+    """The closure masks the engine must materialize for ``passes``
+    (WW / WW|WR / full per pass, de-duplicated in order)."""
+    masks: list = []
+    for bit, _name in passes:
+        for m in (WW | bit, WW | WR | bit, DEP_MASK | bit):
+            if m not in masks:
+                masks.append(m)
+    return masks
+
+
 def cycle_anomalies(g: DepGraph, device: Optional[bool] = None,
                     extra: Iterable[str] = (),
-                    n_txns: Optional[int] = None) -> dict:
+                    n_txns: Optional[int] = None,
+                    metrics=None, report: Optional[dict] = None,
+                    mesh=None, min_bucket: Optional[int] = None) -> dict:
     """Classify cycles in a typed dependency graph. Returns
     {anomaly-type: [witness]} where a witness is {"cycle": [txn indices],
     "kinds": [edge kinds along it]}.
 
-    SCC-condensed design (replaces the r2 dense n×n closure, whose
-    O(n²) memory capped histories near 8k txns): the taxonomy's closure
-    consumers are all EDGE-ENDPOINT reachability queries, and any
-    qualifying path + its closing edge is a cycle — so it lies within
-    one strongly connected component. Tarjan (O(V+E)) finds the
-    components per mask; valid histories short-circuit with no cycles
-    at all; queries inside large components run as ONE dense bf16 MXU
-    closure of the component-induced subgraph (memory bounded by the
-    largest SCC, not the history). ``device``: None = auto (MXU for
-    components ≥ DEVICE_MIN_TXNS), False = host BFS only.
+    Batched-engine design (jepsen_tpu/elle/engine.py): when the device
+    is engaged, ALL taxonomy masks of ALL passes land in one bit-packed
+    vmapped closure dispatch through the shared power-of-two bucket
+    table; SCC membership and every reachability predicate are then
+    host-side bit tests, and host graph walks run only to extract
+    witnesses. ``device``: None = auto (engine for graphs ≥
+    DEVICE_MIN_TXNS), True = force the engine, False = host
+    Tarjan/BFS only (``JEPSEN_ELLE_DEVICE`` overrides all three). The
+    host path is the r13 SCC-condensed flow — Tarjan per mask, BFS or
+    per-component device closures (SccReach) inside big components —
+    and remains the differential oracle plus the typed-cause fallback
+    target: engine degradations (bucket ceiling, dispatch OOM past the
+    escalation budget) fold one-sidedly to the host verdict.
+
+    ``mesh`` escalates every closure to the block-row mesh-sharded
+    kernel (graphs beyond the bucket ceiling stay on device this way).
+    ``metrics``/``report`` observe engine behavior (``elle_batch_chunk``
+    events, fallback causes — docs/telemetry.md); ``min_bucket`` pins a
+    floor bucket (bucket-padding equality tests).
 
     ``extra`` composes additional precedence graphs already present as
     RT/PROC edges in ``g`` (append.clj:49-50): for each name in
@@ -108,15 +142,75 @@ def cycle_anomalies(g: DepGraph, device: Optional[bool] = None,
     n = g.n
     if n == 0 or not g.edges:
         return {}
-    use_device = device if device is not None else True
+    use_device, forced = _ops.resolve_device(device)
     nt = n_txns if n_txns is not None else n
     out: dict = {}
-    _taxonomy_pass(g, out, 0, "", use_device, nt)
-    for name in extra:
-        bit = EXTRA_BITS[name]
-        if any(k & bit for k in g.edges.values()):
+    passes = _live_passes(g, extra)
+    views = None
+    if use_device and (forced or n >= DEVICE_MIN_TXNS):
+        from . import engine as _engine
+
+        views = _engine.graph_closures(
+            g, _pass_masks(passes), metrics=metrics, report=report,
+            mesh=mesh, min_bucket=min_bucket)
+    if report is not None:
+        report["engine"] = "device" if views is not None else "host"
+    if views is not None:
+        for bit, name in passes:
+            _taxonomy_pass_closures(g, out, bit, name, nt, views)
+    else:
+        for bit, name in passes:
             _taxonomy_pass(g, out, bit, name, use_device, nt)
     return out
+
+
+def cycle_anomalies_batch(graphs: Sequence[DepGraph],
+                          device: Optional[bool] = None,
+                          extra: Iterable[str] = (),
+                          metrics=None,
+                          report: Optional[dict] = None,
+                          min_bucket: Optional[int] = None) -> list:
+    """Decide MANY dependency graphs in as few device dispatches as
+    possible: every (graph, mask) closure of every engaged graph joins
+    one co-batched engine plan (≤ one vmapped program per populated
+    size bucket — the elle_scc_batched bench leg's contract), then
+    anomalies classify per graph from the packed closures. Graphs the
+    engine declines (kill-switch, too small in auto mode, bucket
+    ceiling, dispatch faults past the escalation budget) fold to the
+    host path one-sidedly — the returned anomaly dicts are identical
+    to per-graph :func:`cycle_anomalies` either way."""
+    use_device, forced = _ops.resolve_device(device)
+    results: list = [None] * len(graphs)
+    jobs = []
+    jmeta = []  # (graph index, passes)
+    for i, g in enumerate(graphs):
+        if g.n == 0 or not g.edges:
+            results[i] = {}
+            continue
+        if use_device and (forced or g.n >= DEVICE_MIN_TXNS):
+            passes = _live_passes(g, extra)
+            jobs.append((g, _pass_masks(passes)))
+            jmeta.append((i, passes))
+    if jobs:
+        from . import engine as _engine
+
+        views_list = _engine.batch_closures(
+            jobs, metrics=metrics, report=report, min_bucket=min_bucket)
+        for (i, passes), views in zip(jmeta, views_list):
+            if views is None:
+                continue
+            out: dict = {}
+            for bit, name in passes:
+                _taxonomy_pass_closures(graphs[i], out, bit, name,
+                                        graphs[i].n, views)
+            results[i] = out
+    for i, g in enumerate(graphs):
+        if results[i] is None:
+            out = {}
+            for bit, name in _live_passes(g, extra):
+                _taxonomy_pass(g, out, bit, name, use_device, g.n)
+            results[i] = out
+    return results
 
 
 def _taxonomy_pass(g: DepGraph, out: dict, bit: int, name: str,
@@ -204,6 +298,91 @@ def _taxonomy_pass(g: DepGraph, out: dict, bit: int, name: str,
                 g_single = _witness(g, cyc, nt)
         if want_g2 and g2 is None and not wwr_back:
             cyc = find_cycle_with_edge_lists(succ_full, a, b)
+            if cyc:
+                g2 = _witness(g, cyc, nt)
+        if (g_single is not None or not want_single) \
+                and (g2 is not None or not want_g2):
+            break
+    if g_single is not None:
+        out.setdefault(f"G-single{sfx}", []).append(g_single)
+    if g2 is not None:
+        out.setdefault(f"G2{sfx}", []).append(g2)
+
+
+def _taxonomy_pass_closures(g: DepGraph, out: dict, bit: int, name: str,
+                            nt: int, views: dict) -> None:
+    """:func:`_taxonomy_pass` with every SCC/reachability predicate
+    answered by the engine's bit-packed closures instead of host
+    Tarjan/BFS — same pass gating, same sorted-edge scan order, same
+    break conditions, and witness extraction via the SAME host cycle
+    walks, so the two paths return identical anomaly sets with
+    identical witnesses.
+
+    The predicate equivalences (each edge (a, b) has a != b — DepGraph
+    drops self-loops): nontrivial same-SCC membership under a mask ⟺
+    mutual closure reach; the host's component-restricted wwr
+    back-query ⟺ the global wwr closure bit, because under the
+    same-full-SCC precondition any global wwr path b→…→a closes a full
+    cycle through the rw edge and so stays inside the component."""
+    sfx = f"-{name}" if name else ""
+    n, edges = g.n, g.edges
+    cw = views[WW | bit]
+    cwwr = views[WW | WR | bit]
+    cfull = views[DEP_MASK | bit]
+    succ_cache: dict = {}
+
+    def succ(mask):  # witness-extraction walks only — lazy
+        if mask not in succ_cache:
+            succ_cache[mask] = succ_lists(edges, n, mask)
+        return succ_cache[mask]
+
+    if not name:
+        if cw.diag_any():
+            # Witness identity with the host path: first sorted WW SCC,
+            # same cycle walk (Tarjan here runs only on witness
+            # extraction, never to decide).
+            succ_ww = succ(WW | bit)
+            ww_sccs = sccs_lists(succ_ww)
+            cyc = find_cycle_lists(succ_ww, ww_sccs[0])
+            if cyc:
+                out.setdefault("G0", []).append(_witness(g, cyc, nt))
+    elif "G0" not in out:
+        for (a, b), k in sorted(edges.items()):
+            if k & bit and cw.same_scc(a, b):
+                cyc = find_cycle_with_edge_lists(succ(WW | bit), a, b)
+                if cyc:
+                    out.setdefault(f"G0{sfx}", []).append(
+                        _witness(g, cyc, nt))
+                    break
+
+    if not name or "G1c" not in out:
+        for (a, b), k in sorted(edges.items()):
+            if k & WR and cwwr.same_scc(a, b):
+                cyc = find_cycle_with_edge_lists(
+                    succ(WW | WR | bit), a, b)
+                if cyc:
+                    out.setdefault(f"G1c{sfx}", []).append(
+                        _witness(g, cyc, nt))
+                    break
+
+    want_single = not name or "G-single" not in out
+    want_g2 = not name or "G2" not in out
+    if not (want_single or want_g2):
+        return
+    g_single = None
+    g2 = None
+    for (a, b), kind in sorted(edges.items()):
+        if not kind & RW:
+            continue
+        if not cfull.same_scc(a, b):
+            continue
+        wwr_back = cwwr.reach(b, a)
+        if want_single and g_single is None and wwr_back:
+            cyc = find_cycle_with_edge_lists(succ(WW | WR | bit), a, b)
+            if cyc:
+                g_single = _witness(g, cyc, nt)
+        if want_g2 and g2 is None and not wwr_back:
+            cyc = find_cycle_with_edge_lists(succ(DEP_MASK | bit), a, b)
             if cyc:
                 g2 = _witness(g, cyc, nt)
         if (g_single is not None or not want_single) \

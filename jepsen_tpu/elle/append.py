@@ -41,7 +41,9 @@ def _mops(op):
 
 def check(history, anomalies: Iterable[str] = DEFAULT_ANOMALIES,
           device: Optional[bool] = None,
-          additional_graphs: Iterable[str] = ()) -> dict:
+          additional_graphs: Iterable[str] = (),
+          metrics=None, report: Optional[dict] = None,
+          mesh=None) -> dict:
     """Check a list-append history. Mirrors elle.list-append/check's
     result shape: {"valid", "anomaly_types", "anomalies"}.
 
@@ -51,7 +53,14 @@ def check(history, anomalies: Iterable[str] = DEFAULT_ANOMALIES,
     history — bare completion lists set "realtime_unavailable"),
     "process" to strong session serializability. Violations visible
     only with the extra edges report as suffixed anomalies
-    ("G-single-realtime", …)."""
+    ("G-single-realtime", …).
+
+    ``metrics``/``report``/``mesh`` observe and steer the batched
+    device cycle engine (jepsen_tpu/elle/engine.py): chunk events and
+    fallback causes land in ``metrics``, the engine/chunks/causes
+    summary in ``report`` (also attached to the result as
+    ``"engine"``), and ``mesh`` escalates closures to the mesh-sharded
+    kernel."""
     requested = expand_anomalies(anomalies)
     extra = _check_extra(additional_graphs)
     requested = suffixed_requests(requested, extra)
@@ -205,7 +214,8 @@ def check(history, anomalies: Iterable[str] = DEFAULT_ANOMALIES,
             g, extra, history, nodes, paired_intervals(history))
 
     problems.update(cycle_anomalies(g, device=device, extra=extra,
-                                    n_txns=n))
+                                    n_txns=n, metrics=metrics,
+                                    report=report, mesh=mesh))
 
     def txn_of(i):
         if i < len(oks):
@@ -215,6 +225,8 @@ def check(history, anomalies: Iterable[str] = DEFAULT_ANOMALIES,
     res = result_map(problems, requested | {
         "duplicate-appends", "incompatible-order", "unknown-value"}, txn_of)
     res["txn_count"] = n
+    if report is not None:
+        res["engine"] = dict(report)
     if rt_unavailable:
         res["realtime_unavailable"] = True
     return res

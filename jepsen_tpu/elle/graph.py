@@ -5,12 +5,16 @@ jepsen/src/jepsen/tests/cycle/append.clj:11-22, cycle/wr.clj:14-54), whose
 core is cycle search over a typed dependency graph (ww/wr/rw edges between
 transactions). TPU-first re-design:
 
-- **Device path** (:func:`closures_device`): the graph lives as a dense
-  bool adjacency matrix; transitive closure = ``ceil(log2 n)`` squarings
-  ``A ← A ∨ A·A`` where the bool matmul runs on the MXU in f32. One fused
-  jit computes the closures of the WW, WW∪WR, and full graphs — exactly
-  the masks the G0/G1c/G-single/G2 taxonomy needs (cycle/wr.clj:31-45).
-  n = #txns; a 10k-txn graph is a 10k×10k matmul chain — MXU territory.
+- **Device path** (:func:`closures_device` / :class:`SccReach`): the
+  closure of each masked subgraph — WW, WW∪WR, and full, exactly the
+  masks the G0/G1c/G-single/G2 taxonomy needs (cycle/wr.clj:31-45) —
+  runs as ``ceil(log2 n)`` bf16 squarings ``A ← min(A + A·A, 1)`` on
+  the MXU through the shared power-of-two bucket table in
+  :mod:`jepsen_tpu.elle.ops` (ONE vmapped dispatch for all masks;
+  results return bit-packed, 16x under bf16 dense, and every later
+  query is a host bit test). The r13 per-exact-shape ``lru_cache(16)``
+  kernels were retired for the shared table: a long-lived service
+  seeing many distinct component sizes recompiled in a loop.
 - **Host path** (:func:`sccs_host`): iterative Tarjan SCC — the oracle the
   device path is differentially tested against, the witness-cycle
   extractor for reports, and the small-n fast path.
@@ -20,10 +24,11 @@ Edge kinds are bitmasks so one int8 matrix carries the typed graph.
 
 from __future__ import annotations
 
-import functools
 from typing import Iterable, Optional
 
 import numpy as np
+
+from . import ops as _ops
 
 WW = 1  # write -> write (version order)
 WR = 2  # write -> read  (reader observed writer)
@@ -228,11 +233,13 @@ class SccReach:
     Small components — and the first few queries of any component —
     answer by cached host BFS (O(E) each); once a component of at least
     ``device_min`` nodes has absorbed several distinct-source queries,
-    it computes ONE dense bf16 MXU closure of the induced subgraph.
-    The dense matrix is BUILT ON DEVICE from the (tiny) edge arrays and
-    the closure stays device-resident with per-query scalar reads — on
-    a tunneled TPU, shipping a 4096² matrix each way costs ~5 s while
-    the matmuls cost milliseconds."""
+    it computes ONE bf16 MXU closure of the induced subgraph through
+    the shared bucket table (:func:`ops.closure_rows_packed`). The
+    dense matrix is BUILT ON DEVICE from the (tiny) edge arrays and the
+    closure comes back BIT-PACKED in one transfer (uint32 row words,
+    16x under bf16 dense — on a tunneled TPU, shipping a 4096² bf16
+    matrix costs ~5 s while the matmuls cost milliseconds); every later
+    query is a host bit test."""
 
     # Distinct BFS sources a big component absorbs before the closure
     # pays for itself (each BFS is O(E); the closure answers all later
@@ -251,26 +258,24 @@ class SccReach:
                 self.node_comp[v] = ci
         self._bfs_cache: dict = {}
         self._bfs_sources: dict = {}  # comp_id -> distinct-source count
-        self._closures: dict = {}
-        self._rows: dict = {}  # (comp_id, src) -> host closure row
+        self._closures: dict = {}  # comp_id -> (packed closure, local)
 
     def same_comp(self, a: int, b: int):
         ca = self.node_comp.get(a)
         return ca is not None and ca == self.node_comp.get(b), ca
 
     def prefetch(self, pairs) -> None:
-        """Batch the closure rows for upcoming ``query(comp, src, *)``
-        calls: ONE device gather + ONE host transfer per component.
-        Each separate device->host read pays a full relay round trip
-        (~0.13 s measured on a tunneled v5e — eight scalar/row reads
-        were the entire 1 s cost of the 4096-node bench component).
-        Only components already in closure mode — or big enough that
-        this batch alone would push them there — are materialized;
-        everything else keeps the cheap per-source BFS."""
+        """Materialize closures ahead of upcoming ``query(comp, src,
+        *)`` calls: ONE device dispatch + ONE bit-packed host transfer
+        per component (each separate device->host read pays a full
+        relay round trip — ~0.13 s measured on a tunneled v5e; eight
+        scalar/row reads were the entire 1 s cost of the 4096-node
+        bench component). Only components already in closure mode — or
+        big enough that this batch alone would push them there — are
+        materialized; everything else keeps the cheap per-source
+        BFS."""
         by_comp: dict = {}
         for comp_id, src in pairs:
-            if (comp_id, src) in self._rows:
-                continue
             by_comp.setdefault(comp_id, set()).add(src)
         for comp_id, srcs in by_comp.items():
             comp = self.sccs[comp_id]
@@ -279,12 +284,7 @@ class SccReach:
                         and len(srcs) + self._bfs_sources.get(comp_id, 0)
                         >= self.BFS_BEFORE_CLOSURE)):
                 continue
-            cl, local = self._closure(comp_id)
-            order = sorted(srcs)
-            idx = np.asarray([local[s] for s in order], np.int32)
-            rows = np.asarray(cl[idx])
-            for s, r in zip(order, rows):
-                self._rows[(comp_id, s)] = r
+            self._closure(comp_id)
 
     def query(self, comp_id: int, src: int, dst: int) -> bool:
         """Is there a ``succ``-path src→dst inside component comp_id?"""
@@ -293,16 +293,8 @@ class SccReach:
                 self.device and len(comp) >= self.device_min
                 and self._bfs_sources.get(comp_id, 0)
                 >= self.BFS_BEFORE_CLOSURE):
-            cl, local = self._closure(comp_id)
-            # Fetch the source's whole closure ROW once and answer
-            # later queries host-side: a per-query scalar read costs a
-            # full relay round trip (~0.1 s on a tunneled chip — the
-            # row is the same single transfer, n bools instead of one).
-            row = self._rows.get((comp_id, src))
-            if row is None:
-                row = np.asarray(cl[local[src]])
-                self._rows[(comp_id, src)] = row
-            return bool(row[local[dst]])
+            packed, local = self._closure(comp_id)
+            return _ops.row_bit(packed[local[src]], local[dst])
         key = (comp_id, src)
         reach = self._bfs_cache.get(key)
         if reach is None:
@@ -328,8 +320,6 @@ class SccReach:
             return hit
         comp = sorted(self.sccs[comp_id])
         local = {v: i for i, v in enumerate(comp)}
-        s = len(comp)
-        pad = max(128, 1 << (s - 1).bit_length())
         srcs, dsts = [], []
         for i, v in enumerate(comp):
             for w in self.succ[v]:
@@ -337,40 +327,13 @@ class SccReach:
                 if j is not None:
                     srcs.append(i)
                     dsts.append(j)
-        ne = max(len(srcs), 1)
-        epad = 1 << (ne - 1).bit_length()
-        # Padding edges write to the sacrificial row/col `pad` (sliced
-        # off inside the kernel), so edge-count buckets share programs.
-        srcs = np.asarray(srcs + [pad] * (epad - len(srcs)), np.int32)
-        dsts = np.asarray(dsts + [pad] * (epad - len(dsts)), np.int32)
-        cl = _closure_from_edges_kernel(pad, epad)(srcs, dsts)
-        self._closures[comp_id] = (cl, local)
-        return cl, local
-
-
-@functools.lru_cache(maxsize=16)
-def _closure_from_edges_kernel(n: int, epad: int):
-    """Transitive closure on the MXU from edge-index arrays (bf16
-    squaring — see the note on _build_closures_kernel for why bf16 is
-    sound). Input: [epad] src/dst arrays padded with ``n``; output: the
-    [n, n] bool closure, LEFT ON DEVICE (callers read single entries —
-    the matrices never cross the relay)."""
-    import jax
-    import jax.numpy as jnp
-    from jax import lax
-
-    def close(src, dst):
-        a = jnp.zeros((n + 1, n + 1), jnp.bfloat16)
-        a = a.at[src, dst].set(jnp.bfloat16(1.0))[:n, :n]
-
-        def step(a, _):
-            return jnp.minimum(a + a @ a, jnp.bfloat16(1.0)), None
-
-        steps = max(1, int(np.ceil(np.log2(max(n, 2)))))
-        a, _ = lax.scan(step, a, None, length=steps)
-        return a > 0
-
-    return jax.jit(close)
+        # Shared power-of-two bucket table (jepsen_tpu/elle/ops.py):
+        # padding edges write to a sacrificial row/col sliced off
+        # in-kernel, so the compiled-program set stays bounded no
+        # matter how many distinct component sizes a service sees.
+        packed, _labels = _ops.closure_rows_packed(srcs, dsts, len(comp))
+        self._closures[comp_id] = (packed, local)
+        return packed, local
 
 
 def closure_host(adj: np.ndarray, mask: int) -> np.ndarray:
@@ -388,50 +351,28 @@ def closure_host(adj: np.ndarray, mask: int) -> np.ndarray:
 
 
 # ---------------------------------------------------------------------------
-# Device path: fused closures on the MXU
-
-
-@functools.lru_cache(maxsize=16)
-def _build_closures_kernel(n: int):
-    import jax
-    import jax.numpy as jnp
-
-    def close(a):  # [n, n] 0/1
-        # bf16 is sound for boolean reachability: entries are
-        # non-negative path counts, so nonzero stays nonzero under
-        # rounding and min(.,1) re-binarizes each squaring. Halves HBM
-        # (the capacity ceiling on txn count) and runs the MXU at its
-        # bf16 rate.
-        a = a.astype(jnp.bfloat16)
-
-        def step(a, _):
-            return jnp.minimum(a + a @ a, jnp.bfloat16(1.0)), None
-
-        steps = max(1, int(np.ceil(np.log2(max(n, 2)))))
-        from jax import lax
-        a, _ = lax.scan(step, a, None, length=steps)
-        return a.astype(jnp.float32)
-
-    def kernel(ww, wwr, full):
-        cw, cwr, cf = close(ww), close(wwr), close(full)
-        return (
-            jnp.any(jnp.diag(cw) > 0),
-            jnp.any(jnp.diag(cwr) > 0),
-            jnp.any(jnp.diag(cf) > 0),
-            cwr,
-            cf,
-        )
-
-    return jax.jit(kernel)
+# Device path: batched closures on the MXU (shared bucket table)
 
 
 def closures_device(adj: np.ndarray):
     """Compute (has_ww_cycle, has_wwr_cycle, has_full_cycle,
-    closure(ww|wr), closure(full)) on the default JAX backend."""
+    closure(ww|wr), closure(full)) on the default JAX backend — all
+    three taxonomy masks as members of ONE vmapped bucket dispatch
+    (:func:`ops.batched_closure_kernel`); results transfer bit-packed
+    and unpack on the host."""
     n = adj.shape[0]
-    ww = ((adj & WW) > 0).astype(np.float32)
-    wwr = ((adj & (WW | WR)) > 0).astype(np.float32)
-    full = (adj > 0).astype(np.float32)
-    kern = _build_closures_kernel(n)
-    g0, g1c, g2, cwr, cf = kern(ww, wwr, full)
-    return bool(g0), bool(g1c), bool(g2), np.asarray(cwr) > 0, np.asarray(cf) > 0
+    pad = _ops.bucket_for(n) or _ops.closure_pad(n)
+    members = []
+    for mask in (WW, WW | WR, 0xFF):
+        s, d = np.nonzero(adj & mask)
+        members.append((s, d))
+    epad = _ops.edge_pad(max(len(s) for s, _d in members))
+    padded = [_ops.pad_edges(s, d, pad, epad) for s, d in members]
+    S = np.stack([p[0] for p in padded])
+    D = np.stack([p[1] for p in padded])
+    packed, _labels = _ops.batched_closure_kernel(pad, epad)(S, D)
+    packed = np.asarray(packed)
+    cw, cwr, cf = (_ops.unpack_bits_host(packed[i], pad)[:n, :n]
+                   for i in range(3))
+    return (bool(cw.diagonal().any()), bool(cwr.diagonal().any()),
+            bool(cf.diagonal().any()), cwr, cf)

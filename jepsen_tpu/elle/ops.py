@@ -1,0 +1,357 @@
+"""Device primitives for the batched Elle cycle engine.
+
+Dependency-graph cycle detection is dense boolean linear algebra — the
+workload the MXU is built for (arXiv 2112.09017's recipe applied to
+closure-by-repeated-squaring). This module owns the device-facing
+pieces the :mod:`jepsen_tpu.elle.engine` driver composes:
+
+- **Bit-packed adjacency/closure rows.** A boolean [n, n] matrix is
+  stored and transferred as uint32 row blocks ([n, n/32] words, bit j
+  of word w = column w*32+j): 16x smaller than the bf16 dense form.
+  Tiles are unpacked to bf16 only at the matmul, so HBM residency and
+  host<->device transfer pay the packed price while the MXU still runs
+  at its bf16 rate (:func:`packed_closure_bytes` /
+  :func:`dense_closure_bytes` are the analytic model the perf-floor
+  tests pin at <= 1/16).
+
+- **A shared power-of-two bucket table.** Every closure kernel is
+  compiled at a bucket size from :data:`BUCKETS` (nodes) x a
+  power-of-two edge pad floored at :data:`EDGE_PAD_MIN` — NOT at the
+  exact (n, n_edges) of each call (the r13 ``lru_cache(16)`` kernels
+  keyed per exact shape recompiled in a loop when a long-lived service
+  saw many distinct component sizes). The table bounds the set of
+  distinct programs to ~|BUCKETS| x log(edge range).
+
+- **The batched closure+SCC kernel** (:func:`batched_closure_kernel`):
+  vmapped over B (graph, mask) members of one bucket, each member's
+  closure by ``ceil(log2 pad)`` bf16 squarings ``A <- min(A + A@A, 1)``
+  (sound in bf16: entries are non-negative path counts, nonzero stays
+  nonzero under rounding, and min(.,1) re-binarizes), SCC labels by the
+  closure ∧ closureᵀ row-match (label[i] = first j with mutual reach —
+  replacing per-component host Tarjan on the device path), results
+  bit-packed on device before the single host transfer.
+
+- **The mesh-sharded closure** (:func:`sharded_closure`): one huge
+  graph's closure block-row distributed over the mesh — each device
+  owns P = pad/D rows, each squaring step does ONE collective (an
+  all_gather of the current matrix, bit-PACKED in the default
+  ``exchange="packed"`` mode, raw bf16 in the legacy ``"dense"`` mode
+  — the differential oracle and the `JEPSEN_ELLE_EXCHANGE` rollback),
+  then a local [P, pad] @ [pad, pad] matmul.
+  :func:`shard_exchange_bytes_per_step` is the analytic byte model
+  (packed ships exactly 1/16 of dense).
+
+Kill-switches (read per call; env overrides explicit arguments, per
+the docs/telemetry.md contract): ``JEPSEN_ELLE_DEVICE=0`` restores the
+host-only Tarjan/BFS path everywhere, ``=1`` forces the device engine;
+``JEPSEN_ELLE_EXCHANGE`` pins the sharded exchange mode.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Optional, Tuple
+
+import numpy as np
+
+# Power-of-two node buckets the batched kernels compile at. Graphs pad
+# to the smallest bucket that fits; graphs beyond CEILING escalate to
+# the mesh-sharded closure (when a mesh is available) or degrade to the
+# host path with a typed provenance cause.
+BUCKETS: Tuple[int, ...] = (128, 256, 512, 1024, 2048, 4096, 8192)
+CEILING = BUCKETS[-1]
+
+# Edge arrays pad to a power of two floored here, so tiny edge-count
+# differences don't mint new programs (padding edges write to a
+# sacrificial row/col and are free).
+EDGE_PAD_MIN = 256
+
+WORD_BITS = 32
+
+
+def bucket_for(n: int) -> Optional[int]:
+    """The bucket a graph of ``n`` nodes pads to; None above CEILING."""
+    for b in BUCKETS:
+        if n <= b:
+            return b
+    return None
+
+
+def closure_pad(n: int) -> int:
+    """Uncapped power-of-two pad (>= 128) — the sharded path and
+    SccReach components beyond CEILING still need a padded size."""
+    return max(BUCKETS[0], 1 << max(0, int(n) - 1).bit_length())
+
+
+def edge_pad(n_edges: int) -> int:
+    return max(EDGE_PAD_MIN, 1 << max(0, int(n_edges) - 1).bit_length())
+
+
+def resolve_device(flag: Optional[bool]) -> Tuple[bool, bool]:
+    """(use_device, forced) under the ``JEPSEN_ELLE_DEVICE``
+    kill-switch. The env overrides explicit arguments (a fleet
+    rollback must not miss a code path passing its own options) and is
+    read per call: ``0`` kills every device path, ``1`` forces the
+    batched engine even where callers defaulted to auto."""
+    env = os.environ.get("JEPSEN_ELLE_DEVICE")
+    if env is not None and env.strip() != "":
+        on = env.strip().lower() not in ("0", "false", "no", "off")
+        return on, on
+    if flag is None:
+        return True, False
+    return bool(flag), bool(flag)
+
+
+def resolve_exchange(mode: Optional[str]) -> str:
+    """Sharded-closure exchange mode: ``JEPSEN_ELLE_EXCHANGE`` env >
+    explicit argument > ``"packed"`` default."""
+    env = os.environ.get("JEPSEN_ELLE_EXCHANGE")
+    mode = (env or mode or "packed").strip().lower()
+    if mode not in ("packed", "dense"):
+        raise ValueError(
+            f"unknown elle exchange mode {mode!r}; expected 'packed' "
+            f"or 'dense'")
+    return mode
+
+
+# ---------------------------------------------------------------------------
+# Byte model (analytic; pinned by tests/test_perf_floors.py)
+
+
+def packed_words(n: int) -> int:
+    return -(-int(n) // WORD_BITS)
+
+
+def packed_closure_bytes(n: int) -> int:
+    """Host<->device bytes for one bit-packed [pad, pad/32] closure."""
+    pad = closure_pad(n)
+    return pad * packed_words(pad) * 4
+
+
+def dense_closure_bytes(n: int, bytes_per_entry: int = 2) -> int:
+    """The same closure shipped dense (bf16 by default) — the r13
+    transfer floor the packed encoding divides by 16."""
+    pad = closure_pad(n)
+    return pad * pad * bytes_per_entry
+
+
+def shard_exchange_bytes_per_step(n: int, n_devices: int,
+                                  mode: str = "packed") -> int:
+    """Bytes RECEIVED per device per squaring step by the sharded
+    closure's one collective (the all_gather reconstituting the full
+    [pad, pad] matrix from every device's row block). ``packed`` ships
+    uint32 bit-rows (pad * pad/32 words), ``dense`` raw bf16 — exactly
+    16x more. ``n_devices`` keeps the model honest about shape (the
+    gather total is mesh-size independent; pad must cover the mesh)."""
+    pad = max(closure_pad(n), WORD_BITS * int(n_devices))
+    if mode == "packed":
+        return pad * packed_words(pad) * 4
+    if mode == "dense":
+        return pad * pad * 2
+    raise ValueError(f"unknown exchange mode {mode!r}")
+
+
+# ---------------------------------------------------------------------------
+# Bit packing (host + device)
+
+
+def pack_bits_host(mat: np.ndarray) -> np.ndarray:
+    """Bool [n, m] -> uint32 [n, ceil(m/32)] row words (bit j of word w
+    = column w*32+j)."""
+    mat = np.asarray(mat, dtype=bool)
+    n, m = mat.shape
+    mp = packed_words(m) * WORD_BITS
+    if mp != m:
+        buf = np.zeros((n, mp), dtype=bool)
+        buf[:, :m] = mat
+        mat = buf
+    b = mat.reshape(n, -1, WORD_BITS).astype(np.uint32)
+    shifts = np.arange(WORD_BITS, dtype=np.uint32)
+    return np.bitwise_or.reduce(b << shifts, axis=-1).astype(np.uint32)
+
+
+def unpack_bits_host(packed: np.ndarray, m: int) -> np.ndarray:
+    """Inverse of :func:`pack_bits_host`: uint32 [n, w] -> bool [n, m]."""
+    packed = np.asarray(packed, dtype=np.uint32)
+    shifts = np.arange(WORD_BITS, dtype=np.uint32)
+    bits = (packed[:, :, None] >> shifts) & np.uint32(1)
+    return bits.reshape(packed.shape[0], -1)[:, :m].astype(bool)
+
+
+def row_bit(packed_row: np.ndarray, j: int) -> bool:
+    """One closure entry from a packed row (host-side query)."""
+    return bool((int(packed_row[j >> 5]) >> (j & 31)) & 1)
+
+
+def _pack_device(reach):
+    """Bool [..., r, c] (c % 32 == 0) -> uint32 [..., r, c/32] on
+    device — the packing that makes the result transfer 16x smaller
+    than bf16 dense."""
+    import jax.numpy as jnp
+
+    r = reach.reshape(reach.shape[:-1] + (-1, WORD_BITS))
+    shifts = jnp.arange(WORD_BITS, dtype=jnp.uint32)
+    return jnp.sum(r.astype(jnp.uint32) << shifts, axis=-1,
+                   dtype=jnp.uint32)
+
+
+def _unpack_device(words, m: int):
+    """uint32 [..., r, w] -> bool [..., r, m] on device (tile unpack at
+    the matmul)."""
+    import jax.numpy as jnp
+
+    shifts = jnp.arange(WORD_BITS, dtype=jnp.uint32)
+    bits = (words[..., None] >> shifts) & jnp.uint32(1)
+    return bits.reshape(words.shape[:-1] + (-1,))[..., :m] > 0
+
+
+# ---------------------------------------------------------------------------
+# Batched closure + SCC-label kernel (the shared bucket table)
+
+
+@functools.lru_cache(maxsize=64)
+def batched_closure_kernel(pad: int, epad: int):
+    """One jitted program per (bucket, edge-pad): vmapped over B
+    members, each an edge-array graph padded to ``pad`` nodes /
+    ``epad`` edges (padding edges target the sacrificial row/col
+    ``pad``, sliced off in-kernel). Returns per member:
+
+    - the bit-packed closure (uint32 [pad, pad/32]; reachability by
+      paths of length >= 1), and
+    - int32 SCC labels (label[i] = first j with closure[i,j] ∧
+      closure[j,i], diagonal forced on — nodes sharing a label share a
+      strongly connected component).
+
+    Cache keys are drawn from the power-of-two bucket tables only, so
+    a long-lived service compiles a bounded program set (the r13
+    per-exact-shape kernels thrashes this fixed).
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    steps = max(1, int(np.ceil(np.log2(max(pad, 2)))))
+
+    def one(src, dst):
+        a = jnp.zeros((pad + 1, pad + 1), jnp.bfloat16)
+        a = a.at[src, dst].set(jnp.bfloat16(1.0))[:pad, :pad]
+
+        def step(a, _):
+            return jnp.minimum(a + a @ a, jnp.bfloat16(1.0)), None
+
+        a, _ = lax.scan(step, a, None, length=steps)
+        reach = a > jnp.bfloat16(0.0)
+        both = (reach & reach.T) | jnp.eye(pad, dtype=bool)
+        labels = jnp.argmax(both, axis=1).astype(jnp.int32)
+        return _pack_device(reach), labels
+
+    return jax.jit(jax.vmap(one))
+
+
+def pad_edges(srcs, dsts, pad: int, epad: int):
+    """Edge arrays padded to ``epad`` with the sacrificial index
+    ``pad`` (int32, kernel-ready)."""
+    k = len(srcs)
+    s = np.full(epad, pad, np.int32)
+    d = np.full(epad, pad, np.int32)
+    s[:k] = srcs
+    d[:k] = dsts
+    return s, d
+
+
+def closure_rows_packed(srcs, dsts, n: int):
+    """One graph's packed closure + SCC labels through the shared
+    bucket table (the single-member front end SccReach uses). Returns
+    (uint32 [pad, pad/32] host array, int32 [pad] labels); callers
+    index rows/bits for their n < pad real nodes."""
+    pad = closure_pad(n)
+    epad = edge_pad(len(srcs))
+    s, d = pad_edges(srcs, dsts, pad, epad)
+    kern = batched_closure_kernel(pad, epad)
+    packed, labels = kern(s[None], d[None])
+    return np.asarray(packed[0]), np.asarray(labels[0])
+
+
+def sccs_from_labels(labels: np.ndarray, packed: np.ndarray,
+                     n: int) -> list:
+    """Nontrivial SCCs (size > 1, or an explicit self-loop) from the
+    kernel's label array — the host Tarjan's output shape, for the
+    differential suite and witness extraction."""
+    groups: dict = {}
+    for i in range(n):
+        groups.setdefault(int(labels[i]), []).append(i)
+    out = []
+    for _lbl, comp in sorted(groups.items()):
+        if len(comp) > 1 or row_bit(packed[comp[0]], comp[0]):
+            out.append(sorted(comp))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Mesh-sharded closure (block-row distribution, one collective/step)
+
+
+@functools.lru_cache(maxsize=8)
+def _sharded_closure_kernel(mesh, pad: int, exchange: str):
+    """jit(shard_map) closure over ``mesh``'s leading axis: each device
+    owns P = pad/D contiguous rows (uint32-packed in and out); each of
+    the ceil(log2 pad) squaring steps does exactly ONE collective — an
+    all_gather of the current matrix, bit-packed (``packed``) or raw
+    bf16 (``dense``) — then the local [P, pad] @ [pad, pad] matmul."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    try:
+        from jax import shard_map  # jax >= 0.8
+    except ImportError:  # pragma: no cover - older jax
+        from jax.experimental.shard_map import shard_map
+
+    axis = mesh.axis_names[0]
+    steps = max(1, int(np.ceil(np.log2(max(pad, 2)))))
+
+    def raw(words):  # [P, pad/32] uint32: this device's packed rows
+        block = _unpack_device(words, pad).astype(jnp.bfloat16)
+
+        def step(b, _):
+            if exchange == "packed":
+                pw = _pack_device(b > jnp.bfloat16(0.0))
+                allw = lax.all_gather(pw, axis, axis=0, tiled=True)
+                full = _unpack_device(allw, pad).astype(jnp.bfloat16)
+            else:
+                full = lax.all_gather(b, axis, axis=0, tiled=True)
+            return jnp.minimum(b + b @ full, jnp.bfloat16(1.0)), None
+
+        b, _ = lax.scan(step, block, None, length=steps)
+        return _pack_device(b > jnp.bfloat16(0.0))
+
+    try:  # jax >= 0.8 renamed check_rep -> check_vma
+        smapped = shard_map(raw, mesh=mesh, in_specs=P(axis, None),
+                            out_specs=P(axis, None), check_vma=False)
+    except TypeError:  # pragma: no cover - older jax
+        smapped = shard_map(raw, mesh=mesh, in_specs=P(axis, None),
+                            out_specs=P(axis, None), check_rep=False)
+    return jax.jit(smapped)
+
+
+def sharded_closure(srcs, dsts, n: int, mesh,
+                    exchange: Optional[str] = None) -> np.ndarray:
+    """One huge graph's bit-packed closure, block-row sharded over
+    ``mesh``. Both directions of the host<->device transfer and (in
+    the default mode) the per-step collective ship packed uint32 rows.
+    Returns the uint32 [pad, pad/32] closure on the host."""
+    exchange = resolve_exchange(exchange)
+    axis = mesh.axis_names[0]
+    D = int(mesh.shape[axis])
+    if D & (D - 1):
+        raise ValueError(f"sharded closure needs a power-of-two mesh "
+                         f"axis, got {D}")
+    pad = max(closure_pad(n), WORD_BITS * D)
+    adj = np.zeros((pad, pad), dtype=bool)
+    if len(srcs):
+        adj[np.asarray(srcs, np.int64), np.asarray(dsts, np.int64)] = True
+    words = pack_bits_host(adj)
+    out = _sharded_closure_kernel(mesh, pad, exchange)(words)
+    return np.asarray(out)

@@ -40,7 +40,9 @@ def _ret_index(op):
 def check(history, anomalies: Iterable[str] = DEFAULT_ANOMALIES,
           linearizable_keys: bool = False, sequential_keys: bool = False,
           wfr_keys: bool = False, device: Optional[bool] = None,
-          additional_graphs: Iterable[str] = ()) -> dict:
+          additional_graphs: Iterable[str] = (),
+          metrics=None, report: Optional[dict] = None,
+          mesh=None) -> dict:
     """Check a read/write-register history.
 
     ``wfr_keys`` is the reference's :wfr-keys? (cycle/wr.clj:28-30):
@@ -181,11 +183,14 @@ def check(history, anomalies: Iterable[str] = DEFAULT_ANOMALIES,
             [(i, op, True) for i, op in enumerate(oks)], intervals)
 
     problems.update(cycle_anomalies(g, device=device, extra=extra,
-                                    n_txns=n_txns))
+                                    n_txns=n_txns, metrics=metrics,
+                                    report=report, mesh=mesh))
     res = result_map(
         problems, requested | {"duplicate-writes"}, lambda i: repr(oks[i])
     )
     res["txn_count"] = n_txns
+    if report is not None:
+        res["engine"] = dict(report)
     if rt_unavailable:
         res["realtime_unavailable"] = True
     return res

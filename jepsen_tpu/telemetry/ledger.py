@@ -273,6 +273,11 @@ _BENCH_LEGS: list[tuple[str, Optional[str], str, dict]] = [
      {"value_s": "value_s", "utilization_pct": "utilization_pct"}),
     ("elle_txn", "elle_txn", "device",
      {"value_s": "value_s", "ops": "mops"}),
+    # Batched Elle SCC/closure engine: N graphs across >=2 size
+    # buckets through <= one vmapped dispatch per bucket.
+    ("elle_scc_batched", "elle_scc_batched", "device",
+     {"value_s": "value_s", "ops_per_s": "elle_txns_per_s",
+      "ops": "n_txns", "speedup_vs_serial": "elle_batch_speedup_x"}),
     ("mutex_5k", "mutex_5k", "device", {"value_s": "value_s"}),
     ("device_kernel", None, "device",
      {"value_s": "device_kernel_s",

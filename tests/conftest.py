@@ -74,6 +74,13 @@ def pytest_configure(config):
         "two-process trace e2e is additionally marked slow")
     config.addinivalue_line(
         "markers",
+        "elle: batched Elle cycle-engine tests (jepsen_tpu.elle.ops/"
+        "engine — bit-packed closures, size buckets, sharded closure, "
+        "typed degradations; select with -m elle). The randomized "
+        "differential and degradation pins stay tier-1; the big "
+        "device-vmap differential is additionally marked slow")
+    config.addinivalue_line(
+        "markers",
         "alerts: alerting & watchdog plane tests (jepsen_tpu."
         "telemetry.alerts — rule lifecycle, durable alerts.jsonl "
         "replay, CUSUM regression sentinel, chaos alert matrix; "

@@ -336,6 +336,23 @@ class TestRulesClosedForm:
         assert advisor.advise({}, comparison={
             "from": "a", "to": "b", "regressions": []}) == []
 
+    def test_elle_device_fallbacks_rule(self):
+        # Above the 20% share: the elle degradation codes (bucket
+        # ceiling + dispatch OOM) recommend raising the bucket ceiling.
+        recs = advisor.advise({"provenance": {
+            "causes": {"elle_bucket_ceiling": 2, "elle_device_oom": 2,
+                       "beam_loss": 3, "max_configs": 3}}})
+        assert ids(recs) == ["elle_device_fallbacks"]
+        assert recs[0]["severity"] == "medium"
+        assert "bucket" in recs[0]["advice"]
+        assert recs[0]["evidence"]["share_pct"] == 40.0
+        # The threshold literal tracks the advisor policy constant.
+        assert advisor.ELLE_FALLBACK_SHARE_THRESHOLD == 0.2
+        # At/below the threshold the rule is silent.
+        assert advisor.advise({"provenance": {
+            "causes": {"elle_device_oom": 2, "beam_loss": 4,
+                       "max_configs": 4}}}) == []
+
     def test_severity_ordering(self):
         recs = advisor.advise({
             "provenance": {"causes": {"journal_gap": 1}},

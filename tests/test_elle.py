@@ -11,7 +11,14 @@ from jepsen_tpu import txn as jtxn
 from jepsen_tpu.elle import append as ea
 from jepsen_tpu.elle import graph as eg
 from jepsen_tpu.elle import wr as ew
-from jepsen_tpu.elle import cycle_anomalies, DepGraph, RW, WR, WW
+from jepsen_tpu.elle import (
+    cycle_anomalies,
+    cycle_anomalies_batch,
+    DepGraph,
+    RW,
+    WR,
+    WW,
+)
 
 
 def T(value, type="ok", process=0):
@@ -768,3 +775,331 @@ class TestAnomalyArtifacts:
                                   "start-time": "t"}, h, {})
         assert res["valid"] is False
         assert "directory" not in res
+
+
+# ---------------------------------------------------------------------------
+# Batched bit-packed SCC/closure engine (jepsen_tpu/elle/ops.py + engine.py)
+
+
+def _counter_value(reg, name, **labels):
+    for s in reg.collect():
+        if s["name"] == name and s["labels"] == labels:
+            return s.get("value", 0.0)
+    return 0.0
+
+
+def _edges_graph(n, edges, kind=WW):
+    g = DepGraph(n)
+    for a, b in edges:
+        g.add(a, b, kind)
+    return g
+
+
+@pytest.mark.elle
+class TestElleOps:
+    """Device primitives: bit packing, bucket tables, the batched
+    closure+label kernel vs the host Tarjan/closure oracle, and the
+    mesh-sharded closure."""
+
+    def test_pack_roundtrip(self):
+        from jepsen_tpu.elle import ops
+
+        rng = np.random.default_rng(0)
+        for shape in ((1, 1), (3, 31), (5, 32), (7, 33), (64, 130)):
+            m = rng.random(shape) < 0.3
+            packed = ops.pack_bits_host(m)
+            assert packed.shape == (shape[0], -(-shape[1] // 32))
+            assert np.array_equal(ops.unpack_bits_host(packed, shape[1]), m)
+            for i in range(shape[0]):
+                for j in range(shape[1]):
+                    assert ops.row_bit(packed[i], j) == m[i, j]
+
+    def test_bucket_tables(self):
+        from jepsen_tpu.elle import ops
+
+        assert ops.bucket_for(1) == 128
+        assert ops.bucket_for(128) == 128
+        assert ops.bucket_for(129) == 256
+        assert ops.bucket_for(ops.CEILING) == ops.CEILING
+        assert ops.bucket_for(ops.CEILING + 1) is None
+        # closure_pad keeps growing past the ceiling (SccReach / the
+        # sharded path still need a padded size).
+        assert ops.closure_pad(ops.CEILING + 1) == 2 * ops.CEILING
+        assert ops.edge_pad(0) == ops.EDGE_PAD_MIN
+        assert ops.edge_pad(257) == 512
+
+    def _closure_cases(self):
+        rng = random.Random(3)
+        cases = [
+            (5, []),                                   # empty graph
+            (5, [(2, 2)]),                             # self-loop only
+            (6, [(0, 1), (1, 0), (3, 4), (4, 3)]),     # disconnected sccs
+            (4, [(0, 1), (1, 2), (2, 3)]),             # acyclic chain
+        ]
+        # All-one-SCC rings straddling the first bucket boundary.
+        for n in (126, 127, 128, 129, 130):
+            cases.append((n, [(i, (i + 1) % n) for i in range(n)]))
+        for n in (17, 100, 200):                       # random, both buckets
+            cases.append((n, [(rng.randrange(n), rng.randrange(n))
+                              for _ in range(3 * n)]))
+        return cases
+
+    def test_closure_and_labels_vs_host(self):
+        from jepsen_tpu.elle import ops
+
+        for n, edges in self._closure_cases():
+            adj = np.zeros((n, n), np.uint8)
+            for a, b in edges:
+                adj[a, b] = 1
+            srcs = [a for a, _b in edges]
+            dsts = [b for _a, b in edges]
+            packed, labels = ops.closure_rows_packed(srcs, dsts, n)
+            pad = ops.closure_pad(n)
+            got = ops.unpack_bits_host(packed[:n], pad)[:, :n]
+            want = eg.closure_host(adj, 1)
+            assert np.array_equal(got, want), (n, len(edges))
+            comps = ops.sccs_from_labels(labels, packed, n)
+            # Host Tarjan reports only size>1 components (in completion
+            # order); the device labels additionally isolate explicit
+            # self-loops and sort by minimum member.
+            assert sorted(c for c in comps if len(c) > 1) == \
+                sorted(eg.sccs_host(adj, 1)), (n, len(edges))
+            for a, b in edges:
+                if a == b:  # self-loop nodes are nontrivial: a
+                    # singleton comp unless a bigger SCC absorbs them
+                    assert any(a in c for c in comps)
+
+    def test_sharded_closure_matches_host(self):
+        from jepsen_tpu.elle import ops
+        from jepsen_tpu.parallel import make_mesh
+
+        rng = random.Random(5)
+        n = 40
+        edges = [(rng.randrange(n), rng.randrange(n)) for _ in range(110)]
+        adj = np.zeros((n, n), np.uint8)
+        for a, b in edges:
+            adj[a, b] = 1
+        want = eg.closure_host(adj, 1)
+        mesh = make_mesh(2, shape=(2, 1))
+        for mode in ("packed", "dense"):
+            packed = ops.sharded_closure(
+                [a for a, _ in edges], [b for _, b in edges], n, mesh,
+                exchange=mode)
+            pad = packed.shape[0]
+            got = ops.unpack_bits_host(packed[:n], pad)[:, :n]
+            assert np.array_equal(got, want), mode
+
+    def test_sharded_requires_power_of_two(self):
+        from jepsen_tpu.elle import ops
+        from jepsen_tpu.parallel import make_mesh
+
+        mesh = make_mesh(3, shape=(3, 1))
+        with pytest.raises(ValueError):
+            ops.sharded_closure([0], [1], 4, mesh)
+
+    def test_exchange_env_overrides_argument(self, monkeypatch):
+        from jepsen_tpu.elle import ops
+
+        monkeypatch.setenv("JEPSEN_ELLE_EXCHANGE", "dense")
+        assert ops.resolve_exchange("packed") == "dense"
+        monkeypatch.delenv("JEPSEN_ELLE_EXCHANGE")
+        assert ops.resolve_exchange(None) == "packed"
+        with pytest.raises(ValueError):
+            ops.resolve_exchange("bogus")
+
+
+@pytest.mark.elle
+class TestElleEngine:
+    """The batched driver: engine-vs-host anomaly identity, bucket
+    padding equality, kill-switch, and the one-sided typed-cause
+    degradation contract."""
+
+    def _random_typed_graph(self, rng, n, extra_edges=False):
+        g = DepGraph(n)
+        kinds = [WW, WW, WR, RW]
+        if extra_edges:
+            from jepsen_tpu.elle import PROC, RT
+
+            kinds += [RT, PROC]
+        for _ in range(3 * n):
+            a, b = rng.randrange(n), rng.randrange(n)
+            g.add(a, b, rng.choice(kinds))
+        return g
+
+    def test_engine_matches_host_randomized(self):
+        for seed in range(20):
+            rng = random.Random(seed)
+            n = rng.randrange(20, 160)
+            g = self._random_typed_graph(rng, n)
+            host = cycle_anomalies(g, device=False)
+            dev = cycle_anomalies(g, device=True)
+            assert dev == host, seed  # identical witnesses too
+
+    def test_engine_matches_host_suffixed_passes(self):
+        for seed in range(8):
+            rng = random.Random(1000 + seed)
+            g = self._random_typed_graph(rng, rng.randrange(20, 120),
+                                         extra_edges=True)
+            extra = ("realtime", "process")
+            host = cycle_anomalies(g, device=False, extra=extra)
+            dev = cycle_anomalies(g, device=True, extra=extra)
+            assert dev == host, seed
+
+    def test_bucket_padding_equality(self):
+        """Same graph, adjacent buckets => identical anomalies (the
+        pad is invisible to the verdict)."""
+        rng = random.Random(9)
+        g = self._random_typed_graph(rng, 100)
+        base = cycle_anomalies(g, device=True)
+        padded = cycle_anomalies(g, device=True, min_bucket=256)
+        assert base == padded
+
+    def test_kill_switch_env(self, monkeypatch):
+        rng = random.Random(11)
+        g = self._random_typed_graph(rng, 30)
+        monkeypatch.setenv("JEPSEN_ELLE_DEVICE", "0")
+        rep0: dict = {}
+        host = cycle_anomalies(g, device=True, report=rep0)
+        assert rep0["engine"] == "host"
+        monkeypatch.setenv("JEPSEN_ELLE_DEVICE", "1")
+        rep1: dict = {}
+        dev = cycle_anomalies(g, device=False, report=rep1)
+        assert rep1["engine"] == "device"
+        assert dev == host
+
+    def test_auto_mode_small_graph_stays_host(self):
+        rng = random.Random(12)
+        g = self._random_typed_graph(rng, 30)
+        rep: dict = {}
+        cycle_anomalies(g, report=rep)  # device=None auto, n < 512
+        assert rep["engine"] == "host"
+
+    def test_oom_degrades_to_host_with_typed_cause(self, monkeypatch):
+        """Forced dispatch failure past the escalation budget: host
+        verdict, typed elle_device_oom cause, fallback counter — and
+        never `unattributed`, never a flip."""
+        from jepsen_tpu import telemetry as jtel
+        from jepsen_tpu.elle import ops
+
+        def boom(pad, epad):
+            raise RuntimeError("RESOURCE_EXHAUSTED: injected")
+
+        rng = random.Random(13)
+        g = self._random_typed_graph(rng, 60)
+        host = cycle_anomalies(g, device=False)
+        monkeypatch.setattr(ops, "batched_closure_kernel", boom)
+        reg = jtel.Registry()
+        rep: dict = {}
+        dev = cycle_anomalies(g, device=True, metrics=reg, report=rep)
+        assert dev == host  # one-sided: the verdict never flips
+        assert rep["engine"] == "host"
+        codes = [c["code"] for c in rep["causes"]]
+        # One cause per failed (graph, mask) request — never a flip,
+        # never `unattributed`.
+        assert codes and set(codes) == {"elle_device_oom"}
+        assert _counter_value(reg, "elle_device_fallback_total",
+                              cause="elle_device_oom") == len(codes)
+        # The causes also land in the shared verdict Pareto.
+        assert _counter_value(reg, "verdict_causes_total",
+                              code="elle_device_oom", tenant="") == len(codes)
+
+    def test_bucket_ceiling_degrades_with_typed_cause(self):
+        from jepsen_tpu import telemetry as jtel
+        from jepsen_tpu.elle import ops
+
+        g = _edges_graph(ops.CEILING + 1,
+                         [(0, 1), (1, 2), (2, 0), (5, 6)])
+        host = cycle_anomalies(g, device=False)
+        reg = jtel.Registry()
+        rep: dict = {}
+        dev = cycle_anomalies(g, device=True, metrics=reg, report=rep)
+        assert dev == host
+        assert rep["engine"] == "host"
+        codes = [c["code"] for c in rep["causes"]]
+        assert codes and set(codes) == {"elle_bucket_ceiling"}
+        assert _counter_value(reg, "elle_device_fallback_total",
+                              cause="elle_bucket_ceiling") == len(codes)
+
+    @pytest.mark.chaos
+    def test_chaos_fault_costs_a_rung_not_the_verdict(self):
+        """A transient dispatch fault at the chaos seam: the ladder
+        halves the chunk and retries — same verdict, engine stays on
+        device, no degradation cause."""
+        from jepsen_tpu import telemetry as jtel
+        from jepsen_tpu.testing import chaos
+
+        rng = random.Random(14)
+        g = self._random_typed_graph(rng, 60)
+        host = cycle_anomalies(g, device=False)
+        reg = jtel.Registry()
+        rep: dict = {}
+        with chaos.inject("device.dispatch", mode="raise", on_call=1):
+            dev = cycle_anomalies(g, device=True, metrics=reg, report=rep)
+        assert chaos.fired("device.dispatch") >= 1
+        assert dev == host
+        assert rep["engine"] == "device"
+        assert not rep.get("causes")
+
+    def test_batch_matches_host_and_chunk_contract(self):
+        """cycle_anomalies_batch: identical verdicts to per-graph host
+        checks, decided through <= one vmapped dispatch per populated
+        bucket."""
+        from jepsen_tpu import telemetry as jtel
+
+        rng = random.Random(15)
+        graphs = [DepGraph(0), _edges_graph(5, [])]
+        graphs += [self._random_typed_graph(rng, rng.randrange(10, 200))
+                   for _ in range(10)]
+        host = [cycle_anomalies(g, device=False) for g in graphs]
+        reg = jtel.Registry()
+        rep: dict = {}
+        dev = cycle_anomalies_batch(graphs, device=True, metrics=reg,
+                                    report=rep)
+        assert dev == host
+        events = reg.events("elle_batch_chunk")
+        buckets = {e["bucket"] for e in events}
+        assert len(events) == len(buckets) <= 2
+        assert rep["chunks"] == len(events)
+        for e in events:
+            assert e["t0"] <= e["t1"]
+            assert e["stage"] in ("compile", "execute")
+        occ = [s for s in reg.collect()
+               if s["name"] == "elle_batch_occupancy"]
+        assert occ and all(0 < s["value"] <= 1 for s in occ)
+        assert _counter_value(reg, "elle_closure_bytes_total") > 0
+
+    def test_append_check_threads_engine_report(self):
+        h = [
+            T([["append", "x", 1], ["r", "y", [1]]]),
+            T([["append", "y", 1], ["r", "x", [1]]]),
+        ]
+        rep: dict = {}
+        res = ea.check(h, device=True, report=rep)
+        assert res["valid"] is False
+        assert res["engine"]["engine"] == "device"
+
+    def test_sharded_engine_matches_host(self):
+        """mesh= escalates every closure to the block-row sharded
+        kernel; verdicts must equal the host path."""
+        from jepsen_tpu.parallel import make_mesh
+
+        rng = random.Random(16)
+        g = self._random_typed_graph(rng, 48)
+        host = cycle_anomalies(g, device=False)
+        mesh = make_mesh(2, shape=(2, 1))
+        rep: dict = {}
+        dev = cycle_anomalies(g, device=True, mesh=mesh, report=rep)
+        assert dev == host
+        assert rep["engine"] == "device"
+
+    @pytest.mark.slow
+    def test_big_vmap_differential(self):
+        """Larger graphs across the 512/1024 buckets through the
+        vmapped device path (compile-heavy: tier-2)."""
+        for seed in range(6):
+            rng = random.Random(2000 + seed)
+            n = rng.randrange(300, 700)
+            g = self._random_typed_graph(rng, n)
+            host = cycle_anomalies(g, device=False)
+            dev = cycle_anomalies(g, device=True)
+            assert dev == host, seed

@@ -151,3 +151,41 @@ def test_native_engine_throughput_floor():
     dt = time.perf_counter() - t0
     assert res["valid"] is True
     assert dt < 5.0, f"{dt:.2f}s for 10k ops"
+
+
+class TestElleByteModel:
+    """Analytic pins on the elle closure byte model (ISSUE 19
+    acceptance) — pure arithmetic over the packed representation's
+    static shapes, no device, so these run in tier-1 unconditionally."""
+
+    def test_packed_closure_is_16x_under_dense(self):
+        """uint32 bit-rows hold a pad x pad boolean closure in exactly
+        1/16 the bytes of the bf16 dense matrix, at every bucket and at
+        off-bucket sizes (pads are multiples of 32, so the ratio never
+        rounds away)."""
+        from jepsen_tpu.elle import ops
+
+        for n in (1, 17, 127, 128, 129, 500, 4096, 8192, 8193, 20000):
+            packed = ops.packed_closure_bytes(n)
+            dense = ops.dense_closure_bytes(n)
+            assert packed * 16 == dense, (n, packed, dense)
+
+    def test_shard_exchange_packed_vs_dense(self):
+        """The sharded closure's per-step collective: packed uint32
+        rows move exactly 1/16 the bytes of the dense bf16 gather, for
+        every mesh size the kernel accepts."""
+        from jepsen_tpu.elle import ops
+
+        for n in (64, 256, 1000, 8192):
+            for d in (1, 2, 4, 8, 64):
+                packed = ops.shard_exchange_bytes_per_step(n, d, "packed")
+                dense = ops.shard_exchange_bytes_per_step(n, d, "dense")
+                assert packed * 16 == dense, (n, d)
+
+    def test_byte_models_monotone_in_n(self):
+        from jepsen_tpu.elle import ops
+
+        sizes = (1, 100, 128, 129, 1024, 8192, 8193)
+        for model in (ops.packed_closure_bytes, ops.dense_closure_bytes):
+            vals = [model(n) for n in sizes]
+            assert vals == sorted(vals), model.__name__
