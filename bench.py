@@ -1476,6 +1476,72 @@ def main() -> int:
         except Exception as e:  # noqa: BLE001
             out["elle_scc_batched"] = {"error": f"{type(e).__name__}: {e}"}
 
+        # Trace ingestion throughput: a 10k-op synthetic etcd
+        # request/response recording (valid by construction) through
+        # the full adapter → pairing → classification → segmented-WGL
+        # path. Host-side — parsing is pure Python; the pins assert
+        # the differential contract, not speed: the verdict must be a
+        # definite True and NOTHING may fall off the mapped path.
+        _REC.begin("ingest_etcd_10k")
+        try:
+            if _left() < 60:
+                out["ingest_etcd_10k"] = {"skipped": "budget"}
+            else:
+                import json as _json
+
+                from jepsen_tpu import ingest as _ingest
+
+                ilines = []
+                it = 1_000
+                iv = 0
+                for i in range(2500):
+                    key = f"r{i % 4}"
+                    ilines.append(_json.dumps(
+                        {"ts": it, "conn": "c-w", "id": i,
+                         "phase": "request", "op": "put", "key": key,
+                         "value": iv})); it += 7
+                    ilines.append(_json.dumps(
+                        {"ts": it, "conn": "c-w", "id": i,
+                         "phase": "response", "ok": True})); it += 7
+                    ilines.append(_json.dumps(
+                        {"ts": it, "conn": "c-r", "id": 10_000 + i,
+                         "phase": "request", "op": "range",
+                         "key": key})); it += 7
+                    ilines.append(_json.dumps(
+                        {"ts": it, "conn": "c-r", "id": 10_000 + i,
+                         "phase": "response", "ok": True,
+                         "value": iv})); it += 7
+                    if key == "r3":
+                        iv += 1
+                t0 = time.perf_counter()
+                ires = _ingest.ingest_check(ilines, "etcd",
+                                            check="segmented")
+                ingest_s = time.perf_counter() - t0
+                leg = {
+                    "value_s": round(ingest_s, 4),
+                    "ingest_ops_per_s": round(
+                        ires["n_ops"] / ingest_s, 1),
+                    "ops": ires["n_ops"],
+                    "lines": len(ilines),
+                    "valid": ires["valid"],
+                    "workload": ires["workload"],
+                    "unmapped": ires["unmapped"],
+                }
+                # Differential pins (leg-local error fields): a fully
+                # mapped, valid-by-construction recording must come
+                # back definite-True with zero unmapped lines.
+                if ires["valid"] is not True:
+                    leg["error"] = (
+                        f"ingested verdict {ires['valid']!r}; a valid-"
+                        f"by-construction recording must be True")
+                elif ires["unmapped"]:
+                    leg["error"] = (
+                        f"{ires['unmapped']} unmapped lines on a "
+                        f"fully mapped recording")
+                out["ingest_etcd_10k"] = leg
+        except Exception as e:  # noqa: BLE001
+            out["ingest_etcd_10k"] = {"error": f"{type(e).__name__}: {e}"}
+
         # Mutex-model linearizability (hazelcast CP lock config): a 5k-op
         # correct lock-service history on the device kernel. Worst case
         # ~120 s (two BFS passes of ~3.6k levels).

@@ -60,6 +60,9 @@ CAUSE_SHARE_THRESHOLD = 0.5
 # Elle engine degradations are rarer events than search unknowns; a
 # persistent 20% share already means the bucket ceiling is mis-sized.
 ELLE_FALLBACK_SHARE_THRESHOLD = 0.2
+# Trace ingestion: any unmapped op folds its tenant unknown, so even a
+# small persistent share means the adapter is leaking real traffic.
+INGEST_UNMAPPED_SHARE_THRESHOLD = 0.05
 # Per-backend load skew (router scale-out): the loaded backend must
 # exceed BOTH an absolute floor and this ratio × the least-loaded one
 # before a rebalance migration is worth its outage window — the same
@@ -703,10 +706,36 @@ def rule_latency_tail(ctx: dict) -> Optional[dict]:
     }
 
 
+def rule_ingest_unmapped(ctx: dict) -> Optional[dict]:
+    counts = ctx["provenance"]
+    share = _share(counts, "ingest_unmapped_op")
+    if share <= INGEST_UNMAPPED_SHARE_THRESHOLD:
+        return None
+    return {
+        "severity": "medium",
+        "title": "ingested traces keep leaking unmapped ops — the "
+                 "adapter is not covering the recording",
+        "advice": "a persistent share of verdict causes is "
+                  "`ingest_unmapped_op`: trace lines the adapter could "
+                  "not parse (or orphan responses whose request never "
+                  "appeared) fold every affected tenant to unknown. "
+                  "Fix the column mapping / adapter rules — extend the "
+                  "adapter's parse table for the unrecognised "
+                  "commands, correct the `jsonl` column mapping, or "
+                  "widen `reorder_window_ns` if requests and responses "
+                  "are recorded out of order — so the recording maps "
+                  "cleanly and verdicts become definite again",
+        "evidence": {"share_pct": round(share * 100, 1),
+                     "unmapped": counts.get("ingest_unmapped_op", 0),
+                     "causes": counts},
+    }
+
+
 RULES: list[tuple[str, Callable[[dict], Optional[dict]]]] = [
     ("extend_f_schedule", rule_extend_f_schedule),
     ("raise_max_configs", rule_raise_max_configs),
     ("elle_device_fallbacks", rule_elle_device_fallbacks),
+    ("ingest_unmapped", rule_ingest_unmapped),
     ("failover_review", rule_failover_review),
     ("journal_durability", rule_journal_durability),
     ("respawn_backend", rule_respawn_backend),

@@ -58,6 +58,11 @@ METRICS: list[tuple[str, str, str]] = [
     ("elle_txns_per_s", "elle_scc_batched.elle_txns_per_s", "higher"),
     ("elle_batch_speedup_x", "elle_scc_batched.elle_batch_speedup_x",
      "info"),
+    # Trace ingestion (ISSUE 20): raw etcd recording → adapter →
+    # pairing → segmented WGL; the verdict/unmapped pins live in the
+    # leg's own error field.
+    ("ingest_ops_per_s", "ingest_etcd_10k.ingest_ops_per_s", "higher"),
+    ("ingest_etcd_10k_s", "ingest_etcd_10k.value_s", "lower"),
     ("mutex_5k_s", "mutex_5k.value_s", "lower"),
     ("device_kernel_s", "device_kernel_s", "lower"),
     ("per_level_ms", "per_level_ms", "lower"),

@@ -138,6 +138,13 @@ TAXONOMY: dict[str, tuple[str, str]] = {
         "a batched/sharded closure dispatch kept failing past the "
         "chunk-halving escalation budget (device OOM or runtime "
         "fault); the verdict folded to the host Tarjan/BFS path"),
+    # -- trace ingestion ------------------------------------------------------
+    "ingest_unmapped_op": (
+        "ingest",
+        "a recorded trace line (or parsed op) no adapter rule or "
+        "workload model explains; the op was dropped from the checked "
+        "history, so no definite verdict can cover the recording — the "
+        "fold is one-sidedly unknown, never a flip"),
     # -- testing ------------------------------------------------------------
     "chaos": (
         "testing",

@@ -28,10 +28,11 @@ from __future__ import annotations
 
 from typing import Any, Iterable, Optional
 
-from . import DEFAULT_ANOMALIES, DepGraph, RW, WR, WW, _check_extra, \
+from . import DEFAULT_ANOMALIES, DepGraph, _check_extra, \
     compose_additional_graphs, cycle_anomalies, expand_anomalies, \
     op_f as _f, op_type as _type, op_value as _value, paired_intervals, \
     result_map, suffixed_requests
+from .graphs import add_read_edges, add_version_chain
 from ..history import FAIL, INFO, OK
 
 
@@ -171,40 +172,25 @@ def check(history, anomalies: Iterable[str] = DEFAULT_ANOMALIES,
         if v not in longest.get(k, []):
             unobserved.setdefault(k, []).append(node_of_ok[i])
     for k in keys:
-        order = longest.get(k, [])
-        # ww: adjacent observed versions.
-        for i in range(len(order) - 1):
-            a = author_node(k, order[i])
-            b = author_node(k, order[i + 1])
-            if a is not None and b is not None and a != b:
-                g.add(a, b, WW)
-        # ww: last observed version -> each unobserved appender.
-        if order:
-            a = author_node(k, order[-1])
-            if a is not None:
-                for u in unobserved.get(k, []):
-                    if u != a:
-                        g.add(a, u, WW)
+        # ww: adjacent observed versions, then last observed -> each
+        # unobserved appender (the shared builder, elle/graphs.py).
+        add_version_chain(
+            g, [author_node(k, v) for v in longest.get(k, [])],
+            unobserved.get(k, []))
     for ri, op in enumerate(oks):
         for f, k, v in _mops(op):
             if f != "r" or v is None:
                 continue
             order = longest.get(k, [])
-            if v:
-                w = author_node(k, v[-1])
-                if w is not None and w != ri:
-                    g.add(w, ri, WR)
             nxt_pos = len(v)
             if nxt_pos < len(order):
-                w = author_node(k, order[nxt_pos])
-                if w is not None and w != ri:
-                    g.add(ri, w, RW)
+                nxt = [author_node(k, order[nxt_pos])]
             else:
                 # Read saw the whole observed order; every unobserved
                 # appender wrote a later version it missed.
-                for u in unobserved.get(k, []):
-                    if u != ri:
-                        g.add(ri, u, RW)
+                nxt = unobserved.get(k, [])
+            add_read_edges(g, ri,
+                           author_node(k, v[-1]) if v else None, nxt)
 
     rt_unavailable = False
     if extra:

@@ -24,10 +24,11 @@ from __future__ import annotations
 
 from typing import Iterable, Optional
 
-from . import DEFAULT_ANOMALIES, DepGraph, RW, WR, WW, _check_extra, \
+from . import DEFAULT_ANOMALIES, DepGraph, _check_extra, \
     compose_additional_graphs, cycle_anomalies, expand_anomalies, \
     op_f as _f, op_proc as _proc, op_type as _type, op_value as _value, \
     paired_intervals, result_map, suffixed_requests
+from .graphs import add_read_edges, add_write_chains
 from ..history import FAIL, INFO, OK
 from ..txn import ext_reads, ext_writes
 
@@ -105,12 +106,11 @@ def check(history, anomalies: Iterable[str] = DEFAULT_ANOMALIES,
                     {"key": k, "value": v, "reader": repr(op)})
 
     g = DepGraph(len(oks))
-    # wr edges: writer -> reader (external reads only).
+    # wr edges: writer -> reader (external reads only; the shared
+    # builder, elle/graphs.py).
     for ri, op in enumerate(oks):
         for k, v in ext_reads(_value(op) or []).items():
-            w = author.get((k, v))
-            if w is not None and w != ri:
-                g.add(w, ri, WR)
+            add_read_edges(g, ri, author.get((k, v)))
 
     intervals = (
         paired_intervals(history)
@@ -157,13 +157,10 @@ def check(history, anomalies: Iterable[str] = DEFAULT_ANOMALIES,
                     i1 = author.get((k, r))
                     if i1 is not None and i1 != i2:
                         chains.append((i1, i2))
-            for i1, i2 in chains:
-                g.add(i1, i2, WW)
-            # rw edges: reader of version v -> any write FORCED after v's
-            # writer (conservative: only chain successors).
-            succ: dict = {}
-            for i1, i2 in chains:
-                succ.setdefault(i1, set()).add(i2)
+            # ww for the forced pairs, then rw edges: reader of
+            # version v -> any write FORCED after v's writer
+            # (conservative: only chain successors).
+            succ = add_write_chains(g, chains)
             for ri, op in enumerate(oks):
                 r = ext_reads(_value(op) or []).get(k)
                 if r is None:
@@ -171,9 +168,7 @@ def check(history, anomalies: Iterable[str] = DEFAULT_ANOMALIES,
                 w = author.get((k, r))
                 if w is None:
                     continue
-                for i2 in succ.get(w, ()):
-                    if i2 != ri:
-                        g.add(ri, i2, RW)
+                add_read_edges(g, ri, None, succ.get(w, ()))
 
     n_txns = len(oks)
     rt_unavailable = False

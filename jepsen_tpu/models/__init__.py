@@ -182,8 +182,14 @@ def known_models() -> Sequence[str]:
 from . import register as _register_mod  # noqa: E402,F401
 from . import mutex as _mutex_mod  # noqa: E402,F401
 from . import queue as _queue_mod  # noqa: E402,F401
+from . import counter as _counter_mod  # noqa: E402,F401
+from . import sets as _sets_mod  # noqa: E402,F401
+from . import bank as _bank_mod  # noqa: E402,F401
 
 from .register import Register, CasRegister, MultiRegister  # noqa: E402,F401
+from .counter import Counter  # noqa: E402,F401
+from .sets import LwSet  # noqa: E402,F401
+from .bank import Bank  # noqa: E402,F401
 from .mutex import (  # noqa: E402,F401
     Mutex,
     ReentrantMutex,

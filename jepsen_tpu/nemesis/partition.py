@@ -1,0 +1,97 @@
+"""Simulated network partitions: the in-memory Net + completion seam.
+
+The real-cluster :class:`~jepsen_tpu.nemesis.Partitioner` already
+speaks grudges through ``test["net"]`` (jepsen_tpu/net.py). This
+module makes that same nemesis drivable inside the simulated generator
+(jepsen_tpu.generator.sim): :class:`SimNet` is a
+:class:`~jepsen_tpu.net.Net` + :class:`~jepsen_tpu.net.PartitionAll`
+that *records* the grudge instead of programming iptables, and
+:func:`partitioned_completions` is the sim complete-fn that consults
+it — ops invoked by a process bound to an isolated node complete
+``:info`` (the client can't reach a quorum; the op may or may not have
+happened), which is exactly the open-interval fault the segmenter's
+no-quiescence slow path and the checker's UNKNOWN-read handling must
+absorb.
+
+Use with the UNCHANGED Partitioner::
+
+    net = SimNet()
+    test = {"net": net, "nodes": ["n1", "n2", "n3"]}
+    nem = nemesis.partitioner(nemesis.complete_grudge_of(...))  # or any
+    g = gen.nemesis(partition_track, gen.clients(client_gen))
+    hist = sim.simulate(g, sim.with_nemesis(
+        nem, partitioned_completions(net, node_of), test),
+        sim.n_plus_nemesis_context(n))
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from .. import net as jnet
+
+
+class SimNet(jnet.Net, jnet.PartitionAll):
+    """An in-memory Net: drop/heal mutate a recorded grudge
+    ({dst: set(srcs dropped as seen by dst)}); queries answer from
+    it. The same object is both the Partitioner's target and the
+    completion function's oracle."""
+
+    def __init__(self) -> None:
+        self.grudge: dict = {}
+        self.healed_count = 0
+
+    # -- Net protocol ----------------------------------------------------
+    def drop(self, test, src, dest):
+        self.grudge.setdefault(dest, set()).add(src)
+
+    def heal(self, test):
+        self.grudge.clear()
+        self.healed_count += 1
+
+    def slow(self, test, mean_ms=50, variance_ms=10,
+             distribution="normal"):
+        pass  # latency shaping lives in the completion fn
+
+    def flaky(self, test):
+        pass
+
+    def fast(self, test):
+        pass
+
+    # -- PartitionAll fast path -------------------------------------------
+    def drop_all(self, test, grudge):
+        for dst, srcs in grudge.items():
+            self.grudge.setdefault(dst, set()).update(srcs)
+
+    # -- queries -----------------------------------------------------------
+    def isolated(self, node) -> bool:
+        """True when any live link touching ``node`` is cut — the
+        conservative client view: a node on either side of a partition
+        may be unable to assemble a quorum."""
+        if node in self.grudge and self.grudge[node]:
+            return True
+        return any(node in srcs for srcs in self.grudge.values())
+
+    def __repr__(self):
+        return f"<net.sim grudge={self.grudge!r}>"
+
+
+def partitioned_completions(net: SimNet,
+                            node_of: Optional[Callable] = None,
+                            latency: int = 10):
+    """A sim complete-fn: ops whose process's node is isolated in
+    ``net`` complete ``:info`` (indeterminate — the request may have
+    been applied server-side before the partition ate the response);
+    everything else completes ok after ``latency`` ns. ``node_of``
+    maps a process id to its node (default: processes ARE nodes)."""
+    node_of = node_of or (lambda p: p)
+
+    def complete(ctx, op):
+        node = node_of(op.get("process"))
+        if net.isolated(node):
+            return {**op, "type": "info", "time": op["time"] + latency,
+                    "error": "partitioned"}
+        return {**op, "type": "ok", "time": op["time"] + latency}
+
+    return complete

@@ -1,0 +1,84 @@
+"""Delivery-reorder nemesis: scramble completion timestamps in a
+bounded window.
+
+Real recordings are rarely perfectly ordered — multi-shard log merges,
+NIC hardware timestamps and fan-in collectors all deliver events a few
+microseconds out of true order. This nemesis reproduces that fault
+inside the simulated generator so the ingest layer's bounded
+reorder-window repair (jepsen_tpu.ingest.adapters.repair_order) is
+exercised end-to-end: while ``start`` is live, each completion's
+timestamp gains a deterministic pseudo-random extra delay in
+``[0, window)`` ns, so the *recorded* order (sort by time) differs
+from the true invocation order by at most ``window`` — inside the
+repair window the ingested verdict must match the native one; a
+recording scrambled beyond the window is the corrupt-input case the
+strict :class:`~jepsen_tpu.online.segmenter.NonMonotoneHistoryError`
+rejects.
+
+Op shapes (generator nemesis track)::
+
+    {"type": "info", "f": "start", "value": window_ns | None}
+    {"type": "info", "f": "stop"}
+"""
+
+from __future__ import annotations
+
+from . import Nemesis, Reflection
+
+DEFAULT_WINDOW_NS = 500
+
+
+def _lcg(x: int) -> int:
+    """One step of the classic LCG — a deterministic jitter source
+    (NOT Python's salted hash, which would make runs unrepeatable)."""
+    return (1103515245 * x + 12345) % (2**31)
+
+
+class DeliveryReorder(Nemesis, Reflection):
+    """Toggleable bounded timestamp scrambling."""
+
+    def __init__(self, window_ns: int = DEFAULT_WINDOW_NS,
+                 seed: int = 45100):
+        self.window_ns = int(window_ns)
+        self.active = False
+        self._rng = _lcg(seed)
+
+    def invoke(self, test, op):
+        f = op.get("f")
+        if f == "start":
+            if op.get("value") is not None:
+                self.window_ns = int(op["value"])
+            self.active = True
+            return {**op, "value": ["reordering", self.window_ns]}
+        if f == "stop":
+            self.active = False
+            return {**op, "value": "delivery-ordered"}
+        raise ValueError(f"delivery-reorder nemesis: unknown f {f!r}")
+
+    def teardown(self, test):
+        self.active = False
+
+    def jitter(self) -> int:
+        """Next deterministic extra delay in ``[0, window_ns)``."""
+        self._rng = _lcg(self._rng)
+        return self._rng % max(self.window_ns, 1)
+
+    def fs(self):
+        return ["start", "stop"]
+
+    def __repr__(self):
+        return (f"<nemesis.delivery-reorder active={self.active} "
+                f"window={self.window_ns}ns>")
+
+
+def reordered_completions(reorder: DeliveryReorder, latency: int = 10):
+    """A sim complete-fn: while the nemesis is active, completions
+    land at ``invoke + latency + jitter`` with jitter < window — the
+    recorded (time-sorted) order is a bounded shuffle of the true
+    order. Compose with ``sim.with_nemesis``."""
+
+    def complete(ctx, op):
+        dt = latency + (reorder.jitter() if reorder.active else 0)
+        return {**op, "type": "ok", "time": op["time"] + dt}
+
+    return complete

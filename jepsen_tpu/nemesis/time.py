@@ -238,3 +238,82 @@ def clock_gen():
         {"type": "info", "f": "check-offsets"},
         gen.mix([reset_gen, bump_gen, strobe_gen, skew_gen]),
     )
+
+
+# ---------------------------------------------------------------------------
+# Simulated clock skew (the faketime seam, in-process)
+
+
+class SimClockSkew(Nemesis, Reflection):
+    """Per-process clock skew for the simulated generator — the
+    in-process twin of wrapping a DB binary under
+    ``faketime -f "<±offset>s x<rate>"`` (jepsen_tpu.faketime.script):
+    each process's *recorded* timestamps are warped by an offset and a
+    rate while its true schedule is untouched. A trace recorded off a
+    skewed node is exactly this fault, so the ingest layer's bounded
+    reorder repair (and, past the window, its strict non-monotone
+    rejection) is exercised without a cluster.
+
+    Ops (generator nemesis track)::
+
+        {"type": "info", "f": "bump",  "value": {proc: offset_ns}}
+        {"type": "info", "f": "rate",  "value": {proc: rate}}
+        {"type": "info", "f": "reset", "value": [proc, ...] | None}
+
+    ``rate`` values come from :func:`jepsen_tpu.faketime.rand_factor`
+    in the canonical schedules (a random factor near 1, max/min
+    bounded)."""
+
+    def __init__(self) -> None:
+        self.offsets: dict = {}
+        self.rates: dict = {}
+
+    def invoke(self, test, op):
+        f = op.get("f")
+        v = op.get("value")
+        if f == "bump":
+            for p, off in (v or {}).items():
+                self.offsets[p] = self.offsets.get(p, 0) + int(off)
+            return {**op, "clock-offsets": dict(self.offsets)}
+        if f == "rate":
+            for p, r in (v or {}).items():
+                self.rates[p] = float(r)
+            return {**op, "clock-rates": dict(self.rates)}
+        if f == "reset":
+            procs = list(self.offsets) + list(self.rates) \
+                if v is None else v
+            for p in procs:
+                self.offsets.pop(p, None)
+                self.rates.pop(p, None)
+            return {**op, "clock-offsets": dict(self.offsets)}
+        raise ValueError(f"sim-clock-skew nemesis: unknown f {f!r}")
+
+    def teardown(self, test):
+        self.offsets.clear()
+        self.rates.clear()
+
+    def warp(self, process, t: int) -> int:
+        """The recorded timestamp a skewed process reports for true
+        time ``t`` (faketime's offset + rate model)."""
+        rate = self.rates.get(process, 1.0)
+        return int(t * rate) + self.offsets.get(process, 0)
+
+    def fs(self):
+        return ["bump", "rate", "reset"]
+
+    def __repr__(self):
+        return (f"<nemesis.sim-clock-skew offsets={self.offsets!r} "
+                f"rates={self.rates!r}>")
+
+
+def skewed_completions(skew: SimClockSkew, latency: int = 10):
+    """A sim complete-fn: completions land at the true time but their
+    *recorded* timestamp is the process's warped clock — a merged
+    recording of skewed processes is out of order by up to the offset
+    spread. Compose with ``sim.with_nemesis``."""
+
+    def complete(ctx, op):
+        t = op["time"] + latency
+        return {**op, "type": "ok", "time": skew.warp(op.get("process"), t)}
+
+    return complete

@@ -285,6 +285,11 @@ class _Tenant:
         # the ops are observed but their verdict contribution is lost,
         # so a definite True can no longer cover the stream.
         self.lost_segments = False
+        # Ingest-side taints: {taxonomy code: count} of trace lines /
+        # ops the ?adapter= front door could not explain — the checked
+        # history is incomplete, so the drain fold degrades ANY
+        # definite verdict (True or False) to unknown, one-sidedly.
+        self.taints: dict = {}
         self.rejected = {"quota": 0, "queue": 0, "aborted": 0}
         self.detection: Optional[dict] = None
         self.journal = None           # TenantJournal when journaling
@@ -1059,6 +1064,21 @@ class Service:
             t.ops_ingested += 1
         self._wake.set()
 
+    def taint(self, tenant: str, code: str, count: int = 1) -> None:
+        """Record ``count`` occurrences of a typed degradation the
+        caller observed while producing this tenant's ops (the ingest
+        front door's unmapped trace lines: ``ingest_unmapped_op``).
+        A tainted tenant's drain verdict folds one-sidedly to unknown
+        — the checked history is known to be incomplete, so neither a
+        definite True nor a definite False may stand. ``code`` must be
+        in the closed provenance taxonomy."""
+        _prov.cause(code)  # closed-taxonomy validation
+        if count < 1:
+            return
+        t = self._admit(tenant)
+        with t.lock:
+            t.taints[code] = t.taints.get(code, 0) + int(count)
+
     # -- the pump ------------------------------------------------------------
 
     # Ops drained per tenant per sweep: small enough that a flooding
@@ -1282,7 +1302,7 @@ class Service:
             # already compromised (lost segments at a closed
             # scheduler, unknown-folded segments from a crashed round
             # / failover that couldn't decide) — the /live row flag.
-            "degraded": bool(t.lost_segments
+            "degraded": bool(t.lost_segments or t.taints
                              or ss.get("segments_unknown")),
             "decision_latency": self._lat.stats(
                 labels={"tenant": t.name}),
@@ -1293,6 +1313,11 @@ class Service:
             (ss.get("provenance") or {}).get("causes") or {})
         if t.lost_segments:
             _prov.add_counts(prov_counts, ["lost_segments"])
+        if t.taints:
+            with t.lock:
+                prov_counts = _prov.merge_counts(
+                    prov_counts,
+                    {code: int(n) for code, n in t.taints.items()})
         if prov_counts:
             snap["provenance"] = _prov.block(prov_counts)
             # The /live row's one-glance answer to "why unknown".
@@ -1476,6 +1501,27 @@ class Service:
             prov_counts = _prov.add_counts(dict(
                 (res.get("provenance") or {}).get("causes") or {}),
                 svc_causes)
+            with t.lock:
+                taints = dict(t.taints)
+            if taints:
+                # Ingest taints (unexplained trace lines behind the
+                # ?adapter= front door): the checked history is
+                # incomplete, so BOTH a definite True (a dropped write
+                # could be the anomaly) and a definite False (a
+                # dropped write could explain the impossible read)
+                # fold to unknown. One-sided — never a flip.
+                out["tainted_ops"] = int(sum(taints.values()))
+                if out["valid"] != "unknown":
+                    out["valid"] = "unknown"
+                    out["info"] = ("ingest taints (unexplained trace "
+                                   "lines); verdict degraded to "
+                                   "unknown")
+                svc_causes.extend(
+                    _prov.cause(code, count=int(n))
+                    for code, n in sorted(taints.items()))
+                prov_counts = _prov.merge_counts(
+                    prov_counts,
+                    {code: int(n) for code, n in taints.items()})
             if out["valid"] not in (True, False) and not prov_counts:
                 # The one unknown no segment record explains: work
                 # still in flight when the drain deadline closed the

@@ -387,6 +387,9 @@ EXPECTED_ALERTS: dict[str, frozenset] = {
     "host.stack": frozenset({"circuit_open", "slo_burn",
                              "latency_tail", "perf_regression"}),
     "journal.fsync": frozenset({"journal_errors", "perf_regression"}),
+    # A parse fault costs exactly the lines it hit — a typed
+    # ingest_unmapped_op verdict cause, not an operational page.
+    "ingest.parse": frozenset({"perf_regression"}),
     "router.probe": _FLEET_ALERTS,
     "backend.process": _FLEET_ALERTS,
     "router.crash": _FLEET_ALERTS,

@@ -78,6 +78,9 @@ LEDGER_METRICS: list[tuple[str, str, str]] = [
     # both growing means the watchdog got slower or heavier.
     ("alert_detection_seconds", "alert_detection_seconds", "lower"),
     ("alert_eval_overhead_pct", "alert_eval_overhead_pct", "lower"),
+    # Trace ingestion (jepsen_tpu.ingest): raw-recording parse+check
+    # throughput of the adapter front door.
+    ("ingest_ops_per_s", "ingest_ops_per_s", "higher"),
     ("ops", "ops", "info"),
 ]
 
@@ -297,6 +300,11 @@ _BENCH_LEGS: list[tuple[str, Optional[str], str, dict]] = [
       "speedup_vs_serial": "speedup_vs_serial",
       "utilization_pct": "utilization_pct",
       "ops": "n_ops", "verdict": "valid"}),
+    # Trace ingestion: a 10k-op synthetic etcd recording through
+    # adapter → pairing → classification → segmented WGL.
+    ("ingest_etcd_10k", "ingest_etcd_10k", "host",
+     {"value_s": "value_s", "ingest_ops_per_s": "ingest_ops_per_s",
+      "ops": "ops", "verdict": "valid"}),
 ]
 
 

@@ -92,6 +92,7 @@ POINTS = (
     "router.probe",
     "backend.process",
     "router.crash",
+    "ingest.parse",
 )
 
 MODES = ("raise", "delay", "crash")
@@ -142,6 +143,13 @@ EXPECTED_UNKNOWN_CAUSES: dict[str, frozenset] = {
     "router.probe": _ROUTER_UNKNOWN_CAUSES,
     "backend.process": _ROUTER_UNKNOWN_CAUSES,
     "router.crash": _ROUTER_UNKNOWN_CAUSES,
+    # a mid-parse fault costs exactly the lines it hit: each is
+    # counted unmapped and the verdict folds one-sidedly to unknown
+    # via ingest_unmapped_op; downstream the ordinary pipeline codes
+    # may ride along (the trace that DID parse still flows through
+    # the segmented/service machinery)
+    "ingest.parse": frozenset({"ingest_unmapped_op"})
+    | _PIPELINE_UNKNOWN_CAUSES,
 }
 
 

@@ -85,6 +85,14 @@ def pytest_configure(config):
         "telemetry.alerts — rule lifecycle, durable alerts.jsonl "
         "replay, CUSUM regression sentinel, chaos alert matrix; "
         "select with -m alerts)")
+    config.addinivalue_line(
+        "markers",
+        "ingest: trace-ingestion tests (jepsen_tpu.ingest — "
+        "per-system adapters, invoke/ok pairing, workload "
+        "classification, golden-trace differential pins, the "
+        "nemesis x workload x engine matrix; select with -m ingest). "
+        "All ingest tests run on synthetic recordings and stay "
+        "tier-1")
 
 
 def pytest_addoption(parser):

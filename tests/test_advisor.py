@@ -353,6 +353,24 @@ class TestRulesClosedForm:
             "causes": {"elle_device_oom": 2, "beam_loss": 4,
                        "max_configs": 4}}}) == []
 
+    def test_ingest_unmapped_rule(self):
+        # Above the 5% share: unmapped trace lines recommend fixing
+        # the adapter / column mapping.
+        recs = advisor.advise({"provenance": {
+            "causes": {"ingest_unmapped_op": 2, "beam_loss": 9,
+                       "max_configs": 9}}})
+        assert ids(recs) == ["ingest_unmapped"]
+        assert recs[0]["severity"] == "medium"
+        assert "column mapping" in recs[0]["advice"]
+        assert recs[0]["evidence"]["share_pct"] == 10.0
+        assert recs[0]["evidence"]["unmapped"] == 2
+        # The threshold literal tracks the advisor policy constant.
+        assert advisor.INGEST_UNMAPPED_SHARE_THRESHOLD == 0.05
+        # At/below the threshold the rule is silent.
+        assert advisor.advise({"provenance": {
+            "causes": {"ingest_unmapped_op": 1, "beam_loss": 9,
+                       "max_configs": 9, "elle_device_oom": 1}}}) == []
+
     def test_severity_ordering(self):
         recs = advisor.advise({
             "provenance": {"causes": {"journal_gap": 1}},

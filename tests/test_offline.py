@@ -343,6 +343,8 @@ class TestFanoutServices:
 def build_model(name):
     if name == "multi-register":
         return model_by_name(name, init={"x": 1, "y": 2})
+    if name == "bank":
+        return model_by_name(name, init={"a": 10, "b": 5})
     return model_by_name(name)
 
 
